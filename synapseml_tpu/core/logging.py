@@ -1,0 +1,163 @@
+"""Structured logging + phase instrumentation.
+
+Analog of the reference's ``SynapseMLLogging`` trait (core/.../logging/
+SynapseMLLogging.scala: every stage logs construction via logClass and wraps
+fit/transform in timed, structured log records) and of the LightGBM phase
+instrumentation (lightgbm/.../LightGBMPerformance.scala: InstrumentationMeasures /
+TaskInstrumentationMeasures with mark*Start/Stop spans). Spans integrate with the
+JAX profiler when active (jax.profiler.TraceAnnotation), so phase marks show up in
+TPU traces — the SURVEY §5.1 recommendation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("synapseml_tpu")
+
+PROTOCOL_VERSION = "1.0.0"
+
+
+def _framework_version() -> str:
+    try:
+        from .. import __version__
+
+        return __version__
+    except Exception:
+        return "unknown"
+
+
+class SynapseMLLogging:
+    """Mixin: structured JSON log records for class creation and verbs."""
+
+    def log_class(self) -> None:
+        self._log_base("constructor")
+
+    def _log_base(self, method: str, extra: Optional[Dict[str, Any]] = None, level=logging.DEBUG) -> None:
+        payload = {
+            "uid": getattr(self, "uid", None),
+            "className": type(self).__name__,
+            "method": method,
+            "libraryVersion": _framework_version(),
+            "protocolVersion": PROTOCOL_VERSION,
+        }
+        if extra:
+            payload.update(extra)
+        logger.log(level, json.dumps(payload, default=str))
+
+    @contextlib.contextmanager
+    def log_verb(self, verb: str, **info):
+        """Time a fit/transform body, logging duration or typed error payloads
+        (the logFit/logTransform/logVerb analog)."""
+        t0 = time.perf_counter()
+        try:
+            with _maybe_jax_annotation(f"{type(self).__name__}.{verb}"):
+                yield
+        except Exception as e:
+            self._log_base(verb, {"error": type(e).__name__, "message": str(e)[:500],
+                                  **info}, level=logging.ERROR)
+            raise
+        else:
+            ms = (time.perf_counter() - t0) * 1e3
+            self._log_base(verb, {"durationMs": round(ms, 3), **info}, level=logging.INFO)
+
+
+@contextlib.contextmanager
+def _maybe_jax_annotation(name: str):
+    # guard only annotation setup — never the yield itself (a guarded yield
+    # would catch exceptions thrown into the body and yield a second time)
+    try:
+        import jax.profiler
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+class StopWatch:
+    """Reference: core/.../core/utils/StopWatch.scala — ad-hoc timing."""
+
+    def __init__(self):
+        self._t0 = None
+        self.elapsed_s = 0.0
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self) -> float:
+        if self._t0 is not None:
+            self.elapsed_s += time.perf_counter() - self._t0
+            self._t0 = None
+        return self.elapsed_s
+
+    @contextlib.contextmanager
+    def measure(self):
+        self.start()
+        try:
+            yield self
+        finally:
+            self.stop()
+
+
+class InstrumentationMeasures:
+    """Named phase spans, aggregatable across hosts — the LightGBMPerformance
+    analog. Usage::
+
+        m = InstrumentationMeasures()
+        with m.span("dataPreparation"): ...
+        m.report()  # {"dataPreparation": seconds, ...}
+    """
+
+    def __init__(self):
+        self.spans: Dict[str, float] = {}
+        self.counters: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            with _maybe_jax_annotation(name):
+                yield
+        finally:
+            self.spans[name] = self.spans.get(name, 0.0) + time.perf_counter() - t0
+
+    def count(self, name: str, n: int = 1):
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def report(self) -> Dict[str, float]:
+        out: Dict[str, Any] = dict(self.spans)
+        out.update({f"count:{k}": v for k, v in self.counters.items()})
+        return out
+
+    def merge(self, other: "InstrumentationMeasures") -> "InstrumentationMeasures":
+        for k, v in other.spans.items():
+            self.spans[k] = self.spans.get(k, 0.0) + v
+        for k, v in other.counters.items():
+            self.counters[k] = self.counters.get(k, 0) + v
+        return self
+
+
+def retry_with_timeout(fn, retries: int = 3, initial_delay_s: float = 1.0, timeout_s: Optional[float] = None):
+    """Reference: core/.../core/utils/FaultToleranceUtils.scala:9-22 (retryWithTimeout)
+    and NetworkManager.scala:195-218 (exponential backoff). Host-side only."""
+    delay = initial_delay_s
+    last_exc: Optional[Exception] = None
+    deadline = time.monotonic() + timeout_s if timeout_s else None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — generic retry wrapper by design
+            last_exc = e
+            if deadline and time.monotonic() > deadline:
+                break
+            if attempt < retries - 1:
+                time.sleep(delay)
+                delay *= 2
+    raise last_exc  # type: ignore[misc]

@@ -1,0 +1,3 @@
+from .grower import Forest, GrowerConfig, TreeArrays, forest_predict, grow_tree, stack_trees  # noqa: F401
+from .objectives import METRICS, Objective, get_objective, make_grouped, ndcg_at_k  # noqa: F401
+from .boosting import Booster, BoosterConfig, train_booster  # noqa: F401

@@ -1,0 +1,537 @@
+"""Boosting driver: the training-iteration loop and the Booster model.
+
+The analog of the reference's TrainUtils.scala (booster creation :16-29, iteration
+loop with early stopping + custom fobj :77-135, eval-metric extraction :137-151)
+plus the serializable model of booster/LightGBMBooster.scala. The per-iteration
+work (gradients → tree growth → score update) is jitted XLA; the loop itself is
+host Python (one dispatch per tree), matching the reference's structure where the
+JVM loop calls LGBM_BoosterUpdateOneIter per iteration.
+
+Boosting modes (SURVEY §2.1 N1): gbdt, rf (bagged trees, averaged output), dart
+(tree dropout with 1/(k+1) normalization), goss (top-|g| keep + amplified random
+sample of the rest). GOSS/bagging/instance weights all funnel into the same
+(grad, hess, in_bag) triple consumed by the grower.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quantize import BinMapper, apply_bins, bin_threshold_to_value, compute_bin_mapper
+from .grower import Forest, GrowerConfig, TreeArrays, forest_predict, grow_tree, stack_trees
+from .objectives import (METRICS, HIGHER_IS_BETTER, Objective, get_objective,
+                         lambdarank_objective, make_grouped, ndcg_at_k)
+
+
+@dataclasses.dataclass
+class BoosterConfig:
+    """Training configuration — the native-param surface the reference renders
+    through ParamsStringBuilder (LightGBMBase.scala:374-386). Field names follow
+    LightGBM's canonical param names."""
+
+    objective: str = "regression"
+    boosting_type: str = "gbdt"          # gbdt | rf | dart | goss
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    max_bin: int = 255
+    max_depth: int = -1
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    top_rate: float = 0.2                # goss
+    other_rate: float = 0.1              # goss
+    drop_rate: float = 0.1               # dart
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    uniform_drop: bool = False
+    num_class: int = 1
+    sigmoid: float = 1.0
+    alpha: float = 0.9                   # huber / quantile
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    max_delta_step: float = 0.0
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    monotone_constraints: Optional[Sequence[int]] = None
+    early_stopping_round: int = 0
+    metric: Optional[str] = None
+    seed: int = 0
+    boost_from_average: bool = True
+    bin_sample_count: int = 200_000
+    # lambdarank
+    lambdarank_truncation_level: int = 30
+    max_position: int = 30
+
+    def grower(self, has_categorical: bool = False) -> GrowerConfig:
+        lr = 1.0 if self.boosting_type == "rf" else self.learning_rate
+        return GrowerConfig(
+            has_categorical=has_categorical,
+            num_leaves=self.num_leaves,
+            num_bins=self.max_bin,
+            max_depth=self.max_depth,
+            lambda_l1=self.lambda_l1,
+            lambda_l2=self.lambda_l2,
+            min_data_in_leaf=self.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.min_gain_to_split,
+            learning_rate=lr,
+            max_delta_step=self.max_delta_step,
+            cat_smooth=self.cat_smooth,
+            max_cat_threshold=self.max_cat_threshold,
+        )
+
+
+class Booster:
+    """A trained forest + binning metadata; the LightGBMBooster analog
+    (booster/LightGBMBooster.scala): scoring, leaf prediction, SHAP, model-string
+    save/load, feature importances."""
+
+    def __init__(self, mapper: BinMapper, config: BoosterConfig,
+                 trees: List[TreeArrays], tree_weights: List[float],
+                 base_score: np.ndarray, feature_names: Optional[List[str]] = None,
+                 best_iteration: int = -1,
+                 thresholds: Optional[List[np.ndarray]] = None):
+        self.mapper = mapper
+        self.config = config
+        self.trees = trees
+        self.tree_weights = list(tree_weights)
+        self.base_score = np.atleast_1d(np.asarray(base_score, np.float64))
+        self.feature_names = feature_names or [f"Column_{i}" for i in range(mapper.num_features)]
+        self.best_iteration = best_iteration
+        # real-valued thresholds per tree; None → resolve from the bin mapper.
+        # Loaded native models carry raw thresholds directly (no mapper).
+        self.thresholds = thresholds
+        self._forest_cache: Optional[Forest] = None
+
+    # --- structure ------------------------------------------------------
+    @property
+    def num_class(self) -> int:
+        return max(self.config.num_class, 1)
+
+    @property
+    def models_per_iter(self) -> int:
+        return self.num_class if self.config.objective in ("multiclass", "softmax", "multiclassova") else 1
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    @property
+    def average_output(self) -> bool:
+        return self.config.boosting_type == "rf"
+
+    def _thresholds(self, index: int) -> np.ndarray:
+        if self.thresholds is not None:
+            return np.asarray(self.thresholds[index], np.float32)
+        tree = self.trees[index]
+        sf = np.asarray(tree.split_feature)
+        sb = np.asarray(tree.split_bin)
+        return np.array([bin_threshold_to_value(self.mapper, int(f), int(b))
+                         for f, b in zip(sf, sb)], np.float32)
+
+    def forest(self) -> Forest:
+        if self._forest_cache is None or self._forest_cache.num_trees != len(self.trees):
+            trees = self.trees
+            weights = np.asarray(self.tree_weights, np.float32)
+            if self.average_output:
+                per_class = max(len(trees) // self.models_per_iter, 1)
+                weights = weights / per_class
+            weighted = [t._replace(leaf_value=jnp.asarray(t.leaf_value) * w)
+                        for t, w in zip(trees, weights)]
+            self._forest_cache = stack_trees(
+                weighted, [self._thresholds(i) for i in range(len(trees))])
+        return self._forest_cache
+
+    # --- inference ------------------------------------------------------
+    def raw_score(self, X, binned: bool = False) -> np.ndarray:
+        """(N,) or (N, K) raw margin."""
+        per_tree = forest_predict(self.forest(), jnp.asarray(X), binned=binned,
+                                  output="per_tree")              # (N, T)
+        k = self.models_per_iter
+        n, t = per_tree.shape
+        out = per_tree.reshape(n, t // k, k).sum(axis=1) + self.base_score[None, :k]
+        return np.asarray(out[:, 0] if k == 1 else out)
+
+    def predict(self, X, binned: bool = False) -> np.ndarray:
+        """Probability / response-space prediction."""
+        raw = self.raw_score(X, binned=binned)
+        obj = self._objective_for_transform()
+        return np.asarray(obj.transform(jnp.asarray(raw)))
+
+    def predict_leaf(self, X) -> np.ndarray:
+        """(N, T) leaf indices (predictLeaf parity, LightGBMBooster.scala:408)."""
+        return np.asarray(forest_predict(self.forest(), jnp.asarray(X), output="leaf"))
+
+    def feature_importances(self, importance_type: str = "split") -> np.ndarray:
+        """split count or total gain per feature (getFeatureImportances parity,
+        LightGBMBooster.scala:490-505)."""
+        imp = np.zeros(self.mapper.num_features)
+        for t in self.trees:
+            ns = int(t.num_splits)
+            sf = np.asarray(t.split_feature)[:ns]
+            if importance_type == "gain":
+                np.add.at(imp, sf, np.asarray(t.split_gain)[:ns])
+            else:
+                np.add.at(imp, sf, 1.0)
+        return imp
+
+    def feature_shap(self, X) -> np.ndarray:
+        from .shap import forest_shap
+        return forest_shap(self, np.asarray(X, np.float32))
+
+    def _objective_for_transform(self) -> Objective:
+        cfg = self.config
+        name = cfg.objective
+        if name == "lambdarank":
+            from .objectives import regression_objective
+            return regression_objective()
+        return get_objective(name, num_class=self.num_class, sigmoid=cfg.sigmoid,
+                             alpha=cfg.alpha, fair_c=cfg.fair_c,
+                             poisson_max_delta_step=cfg.poisson_max_delta_step,
+                             tweedie_variance_power=cfg.tweedie_variance_power)
+
+    # --- persistence ----------------------------------------------------
+    def model_string(self) -> str:
+        from .model_io import booster_to_string
+        return booster_to_string(self)
+
+    @staticmethod
+    def from_model_string(s: str) -> "Booster":
+        from .model_io import booster_from_string
+        return booster_from_string(s)
+
+    def save_native(self, path: str) -> None:
+        """saveNativeModel parity (LightGBMBooster.scala:458-470)."""
+        with open(path, "w") as f:
+            f.write(self.model_string())
+
+
+# ---------------------------------------------------------------------------
+# Training
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _leaf_gather(leaf_value, node_of_row):
+    return leaf_value[node_of_row]
+
+
+def _tree_assign_binned(tree: TreeArrays, binned) -> jnp.ndarray:
+    """Leaf assignment of (already-binned) rows for one tree — used for
+    validation-score streaming updates."""
+    f = Forest(split_feature=tree.split_feature[None], threshold=jnp.zeros_like(
+        tree.split_gain)[None], split_bin=tree.split_bin[None],
+        split_type=tree.split_type[None], cat_bitset=tree.cat_bitset[None],
+        left_child=tree.left_child[None], right_child=tree.right_child[None],
+        leaf_value=tree.leaf_value[None])
+    return forest_predict(f, binned, binned=True, output="leaf")[:, 0]
+
+
+def train_booster(
+    X: np.ndarray,
+    y: np.ndarray,
+    config: BoosterConfig,
+    sample_weight: Optional[np.ndarray] = None,
+    init_score: Optional[np.ndarray] = None,
+    categorical_features: Optional[Sequence[int]] = None,
+    group_sizes: Optional[np.ndarray] = None,
+    valid: Optional[tuple] = None,            # (Xv, yv) or (Xv, yv, wv, group_sizes_v) for ranking
+    fobj: Optional[Callable] = None,          # custom objective (FObjTrait analog)
+    feature_names: Optional[List[str]] = None,
+    init_model: Optional[Booster] = None,     # warm start (modelString param analog)
+    callbacks: Optional[List[Callable]] = None,
+    mapper: Optional[BinMapper] = None,       # pre-computed reference dataset analog
+    mesh=None,                                # jax.sharding.Mesh: shard rows over DATA_AXIS
+) -> Booster:
+    cfg = config
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.float32)
+    if X.ndim != 2 or X.shape[0] == 0:
+        raise ValueError(f"training data must be a non-empty 2-D matrix, got shape {X.shape}")
+    if len(y) != X.shape[0]:
+        raise ValueError(f"label length {len(y)} != row count {X.shape[0]}")
+    n_orig, nfeat = X.shape
+    w = (np.ones(n_orig, np.float32) if sample_weight is None
+         else np.asarray(sample_weight, np.float32))
+    rng = np.random.default_rng(cfg.seed)
+
+    if mapper is None:
+        mapper = compute_bin_mapper(X, cfg.max_bin, cfg.bin_sample_count,
+                                    categorical_features, cfg.seed)
+
+    # Multi-chip: pad rows to the data-axis size and shard. The padding rows get
+    # in_bag = 0, so they contribute nothing to histograms or leaf stats; GSPMD
+    # then turns the histogram scatter into per-shard partials + one psum over
+    # ICI — the entire replacement for LightGBM's socket-ring allreduce.
+    valid_mask_np = np.ones(n_orig, np.float32)
+    if mesh is not None:
+        from ..parallel.mesh import DATA_AXIS as _DA
+        ndata = mesh.shape[_DA]
+        rem = (-n_orig) % ndata
+        if rem:
+            X = np.concatenate([X, np.repeat(X[-1:], rem, axis=0)])
+            y = np.concatenate([y, np.zeros(rem, np.float32)])
+            w = np.concatenate([w, np.zeros(rem, np.float32)])
+            valid_mask_np = np.concatenate([valid_mask_np, np.zeros(rem, np.float32)])
+            if init_score is not None:
+                init_score = np.concatenate(
+                    [np.asarray(init_score), np.zeros(rem, np.float32)])
+    n = X.shape[0]
+    binned = apply_bins(mapper, X)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import DATA_AXIS as _DA
+        row2 = NamedSharding(mesh, P(_DA, None))
+        row1 = NamedSharding(mesh, P(_DA))
+        binned = jax.device_put(binned, row2)
+
+    # objective
+    k = cfg.num_class if cfg.objective in ("multiclass", "softmax", "multiclassova") else 1
+    if cfg.objective == "lambdarank":
+        if group_sizes is None:
+            raise ValueError("lambdarank requires group_sizes")
+        gidx = make_grouped(y, group_sizes)
+        obj = lambdarank_objective(jnp.asarray(gidx), cfg.sigmoid,
+                                   cfg.lambdarank_truncation_level)
+    else:
+        obj = get_objective(cfg.objective, num_class=k, sigmoid=cfg.sigmoid,
+                            alpha=cfg.alpha, fair_c=cfg.fair_c,
+                            poisson_max_delta_step=cfg.poisson_max_delta_step,
+                            tweedie_variance_power=cfg.tweedie_variance_power)
+
+    if cfg.boosting_type == "rf" and not (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+                                          or cfg.feature_fraction < 1.0):
+        # native LightGBM rejects the same degenerate config (identical trees)
+        raise ValueError("boosting_type='rf' requires bagging (bagging_freq > 0 and "
+                         "bagging_fraction < 1) and/or feature_fraction < 1")
+
+    yj, wj = jnp.asarray(y), jnp.asarray(w)
+    valid_mask = jnp.asarray(valid_mask_np)
+    base = (np.atleast_1d(np.asarray(obj.init_score(yj, wj), np.float64))
+            if cfg.boost_from_average else np.zeros(max(k, 1)))
+    # the fixed margin every iteration starts from: base score + user init_score
+    init_margin = jnp.zeros((n, k)) + jnp.asarray(base[None, :k], jnp.float32)
+    if init_score is not None:
+        init_margin = init_margin + jnp.asarray(
+            np.asarray(init_score).reshape(n, -1), jnp.float32)
+    score = init_margin
+    if mesh is not None:
+        score = jax.device_put(score, row2)
+        yj = jax.device_put(yj, row1)
+        wj = jax.device_put(wj, row1)
+        valid_mask = jax.device_put(valid_mask, row1)
+
+    trees: List[TreeArrays] = []
+    tree_weights: List[float] = []
+    # dart only: per-tree train contribution, stored as (class, (N,) values)
+    tree_contribs: List[tuple] = []
+    if init_model is not None:
+        trees = list(init_model.trees)
+        tree_weights = list(init_model.tree_weights)
+        base = init_model.base_score
+        prior_k = init_model.models_per_iter
+        score = jnp.asarray(init_model.raw_score(X).reshape(n, k), jnp.float32)
+        init_margin = jnp.zeros((n, k)) + jnp.asarray(
+            init_model.base_score[None, :k], jnp.float32)
+        if init_score is not None:
+            extra = jnp.asarray(np.asarray(init_score).reshape(n, -1), jnp.float32)
+            score = score + extra
+            init_margin = init_margin + extra
+        if cfg.boosting_type == "dart":
+            # warm-started DART needs per-tree contributions of the PRIOR trees
+            # too (they are drop candidates); recover them by raw traversal with
+            # weights divided back out
+            from .grower import forest_predict as _fp
+
+            unweighted = Booster(init_model.mapper, init_model.config,
+                                 init_model.trees, [1.0] * len(init_model.trees),
+                                 np.zeros_like(init_model.base_score))
+            per_tree = np.asarray(_fp(unweighted.forest(), jnp.asarray(X),
+                                      output="per_tree"))     # (N, T)
+            for ti in range(per_tree.shape[1]):
+                tree_contribs.append((ti % prior_k, per_tree[:, ti].astype(np.float32)))
+
+    grower_cfg = cfg.grower(has_categorical=bool(mapper.is_categorical.any()))
+    is_cat = jnp.asarray(mapper.is_categorical)
+    mono = np.zeros(nfeat, np.int32)
+    if cfg.monotone_constraints is not None:
+        mc = np.asarray(cfg.monotone_constraints, np.int32)
+        mono[: len(mc)] = mc
+    mono = jnp.asarray(mono)
+
+    # validation state
+    has_valid = valid is not None
+    if has_valid:
+        Xv, yv = np.asarray(valid[0], np.float32), np.asarray(valid[1], np.float32)
+        binned_v = apply_bins(mapper, Xv)
+        score_v = jnp.zeros((Xv.shape[0], k)) + jnp.asarray(base[None, :k], jnp.float32)
+        if init_model is not None:
+            score_v = jnp.asarray(init_model.raw_score(Xv).reshape(Xv.shape[0], k), jnp.float32)
+        metric_name = cfg.metric or _default_metric(cfg.objective)
+        best_metric, best_iter = None, -1
+        higher_better = metric_name.split("@")[0] in HIGHER_IS_BETTER
+
+    gh_fn = fobj if fobj is not None else obj.grad_hess
+    rf_mode, dart_mode, goss_mode = (cfg.boosting_type == "rf", cfg.boosting_type == "dart",
+                                     cfg.boosting_type == "goss")
+    in_bag_cur = jnp.ones(n, jnp.float32)
+
+    for it in range(cfg.num_iterations):
+        # ---- dart: drop trees and de-weight the score -------------------
+        if dart_mode and trees:
+            nt = len(trees)
+            if rng.random() >= cfg.skip_drop:
+                p = cfg.drop_rate
+                drop = np.nonzero(rng.random(nt) < p)[0][: cfg.max_drop]
+            else:
+                drop = np.array([], np.int64)
+            kdrop = len(drop)
+            if kdrop:
+                dropped = np.zeros((n, k), np.float32)
+                for j in drop:
+                    cls_j, vec = tree_contribs[j]
+                    dropped[:, cls_j] += tree_weights[j] * vec
+                score_it = score - jnp.asarray(dropped)
+            else:
+                score_it = score
+        else:
+            score_it, drop, kdrop = score, None, 0
+
+        g, h = gh_fn(score_it[:, 0] if k == 1 else score_it, yj, wj)
+        g = jnp.reshape(g, (n, k))
+        h = jnp.reshape(h, (n, k))
+
+        # ---- row sampling ----------------------------------------------
+        if goss_mode:
+            gnorm = np.asarray(jnp.abs(g).sum(axis=1))
+            top_n = int(cfg.top_rate * n)
+            rand_n = int(cfg.other_rate * n)
+            order = np.argsort(-gnorm)
+            topk = order[:top_n]
+            rest = order[top_n:]
+            picked = rest[rng.permutation(len(rest))[:rand_n]] if len(rest) else rest
+            amp = (1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)
+            wmask = np.zeros(n, np.float32)
+            wmask[topk] = 1.0
+            wmask[picked] = amp
+            wmask *= valid_mask_np
+            in_bag = jnp.asarray((wmask > 0).astype(np.float32))
+            g = g * jnp.asarray(wmask)[:, None]
+            h = h * jnp.asarray(wmask)[:, None]
+        elif (rf_mode or cfg.bagging_freq > 0) and cfg.bagging_fraction < 1.0:
+            if cfg.bagging_freq <= 1 or it % cfg.bagging_freq == 0:
+                in_bag_cur = jnp.asarray(
+                    (rng.random(n) < cfg.bagging_fraction).astype(np.float32)
+                    * valid_mask_np)
+            in_bag = in_bag_cur
+        else:
+            in_bag = valid_mask
+
+        # ---- feature sampling ------------------------------------------
+        if cfg.feature_fraction < 1.0:
+            nf = max(1, int(math.ceil(cfg.feature_fraction * nfeat)))
+            mask = np.zeros(nfeat, bool)
+            mask[rng.permutation(nfeat)[:nf]] = True
+            feat_mask = jnp.asarray(mask)
+        else:
+            feat_mask = jnp.ones(nfeat, bool)
+
+        # ---- grow K trees ----------------------------------------------
+        new_weight = 1.0
+        if dart_mode and kdrop:
+            new_weight = 1.0 / (kdrop + 1.0)
+        for cls in range(k):
+            tree, node = grow_tree(binned, g[:, cls], h[:, cls], in_bag,
+                                   feat_mask, is_cat, mono, grower_cfg)
+            contrib = _leaf_gather(tree.leaf_value, node)          # (N,)
+            if dart_mode:
+                tree_contribs.append((cls, np.asarray(contrib, np.float32)))
+                if kdrop and cls == k - 1:
+                    # dropped trees scaled by kdrop/(kdrop+1), then rebuild the
+                    # score from the fixed init margin + all weighted per-tree
+                    # contributions
+                    factor = kdrop / (kdrop + 1.0)
+                    for j in drop:
+                        tree_weights[j] *= factor
+                    total = np.zeros((n, k), np.float32)
+                    for (cls_j, vec), wt in zip(tree_contribs, tree_weights):
+                        total[:, cls_j] += wt * vec
+                    score = init_margin + jnp.asarray(total)
+                elif not kdrop:
+                    score = score.at[:, cls].add(contrib * new_weight)
+            elif rf_mode:
+                pass  # rf: gradients always from the base score; trees averaged at predict
+            else:
+                score = score.at[:, cls].add(contrib)
+            trees.append(jax.tree.map(np.asarray, tree))
+            tree_weights.append(new_weight)
+
+            if has_valid and not (rf_mode or dart_mode):
+                leaf_v = _tree_assign_binned(trees[-1], binned_v)
+                score_v = score_v.at[:, cls].add(
+                    jnp.asarray(trees[-1].leaf_value)[leaf_v] * new_weight)
+
+        # ---- validation metric / early stopping ------------------------
+        if has_valid:
+            if rf_mode or dart_mode:
+                # tree weights change (dart) / output is averaged (rf): recompute
+                bst = Booster(mapper, cfg, trees, tree_weights, base, feature_names)
+                raw_v = jnp.asarray(bst.raw_score(Xv).reshape(-1, k))
+            else:
+                raw_v = score_v
+            pred_v = obj.transform(raw_v[:, 0] if k == 1 else raw_v)
+            mval = float(_eval_metric(metric_name, yv, pred_v, raw_v, valid, k))
+            improved = (best_metric is None
+                        or (mval > best_metric if higher_better else mval < best_metric))
+            if improved:
+                best_metric, best_iter = mval, it
+            if cfg.early_stopping_round > 0 and it - best_iter >= cfg.early_stopping_round:
+                cut = (best_iter + 1) * k
+                trees = trees[:cut]
+                tree_weights = tree_weights[:cut]
+                break
+
+        if callbacks:
+            for cb in callbacks:
+                cb(it, trees)
+
+    return Booster(mapper, cfg, trees, tree_weights, base, feature_names,
+                   best_iteration=(best_iter if has_valid else -1))
+
+
+def _default_metric(objective: str) -> str:
+    return {
+        "binary": "auc",
+        "multiclass": "multi_logloss",
+        "softmax": "multi_logloss",
+        "multiclassova": "multi_logloss",
+        "regression_l1": "mae",
+        "lambdarank": "ndcg@5",
+    }.get(objective, "rmse")
+
+
+def _eval_metric(name, yv, pred_v, raw_v, valid, k):
+    if name.startswith("ndcg"):
+        at = int(name.split("@")[1]) if "@" in name else 5
+        if len(valid) < 4:
+            raise ValueError(
+                "ranking validation requires valid=(Xv, yv, wv_or_None, group_sizes_v)")
+        gidx = make_grouped(yv, valid[3])
+        return ndcg_at_k(jnp.asarray(yv), raw_v[:, 0], jnp.asarray(gidx), at)
+    fn = METRICS[name]
+    return fn(jnp.asarray(yv), pred_v)

@@ -1,0 +1,405 @@
+"""Leaf-wise histogram tree grower — one jitted XLA program per tree.
+
+TPU-native redesign of the LightGBM serial/data-parallel tree learner the
+reference drives through LGBM_BoosterUpdateOneIter (reference call stack:
+booster/LightGBMBooster.scala:355-392 → C++ ConstructHistograms / FindBestSplit /
+Split loop; SURVEY.md §3.1 "the hot loop"). Design choices for XLA (SURVEY §7
+"hard parts" — dynamic shapes):
+
+  * The ENTIRE leaf-wise growth loop is a single ``lax.fori_loop`` with static
+    shapes: exactly ``num_leaves - 1`` iterations; once no leaf has a valid
+    split, remaining iterations no-op.
+  * Per iteration, histograms for ALL active leaves are rebuilt with one
+    scatter-add keyed by (leaf, feature, bin) (ops/histogram.py). A masked
+    single-leaf pass would read the same (N, F) bytes, so recompute-all costs
+    the same HBM traffic as LightGBM's smaller-child trick while keeping every
+    shape static — and GSPMD turns the same scatter into partial histograms +
+    one psum when rows are sharded over the ``data`` mesh axis.
+  * Leaf numbering matches LightGBM's Tree::Split: splitting leaf ``l`` at step
+    ``i`` creates internal node ``i``; the left child keeps leaf id ``l`` and the
+    right child becomes the new leaf ``i + 1``. Child pointers use the
+    ``~leaf_index`` convention, so the arrays serialize directly into the
+    LightGBM model-string format (gbdt/model_io.py).
+  * Categorical splits: bins sorted by grad/(hess + cat_smooth) per (leaf,
+    feature), prefix-scan over the sorted order, chosen prefix encoded as a
+    bitset — the LightGBM many-vs-many category algorithm, vectorized.
+  * Monotone constraints ("basic" mode): candidate child outputs compared
+    according to the per-feature constraint sign; violating splits are masked.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.histogram import leaf_histograms
+
+BITS = 32  # bitset word width for categorical splits
+
+
+class GrowerConfig(NamedTuple):
+    """Static (compile-time) grower configuration."""
+
+    num_leaves: int = 31
+    num_bins: int = 255
+    max_depth: int = -1          # <=0: unlimited (bounded by num_leaves anyway)
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    learning_rate: float = 0.1
+    max_delta_step: float = 0.0
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    has_categorical: bool = False  # static: traces out the categorical path entirely
+
+
+class TreeArrays(NamedTuple):
+    """One grown tree in structure-of-arrays form (serializes to the LightGBM
+    model-string fields of the same names — gbdt/model_io.py)."""
+
+    split_feature: jnp.ndarray   # (L-1,) i32
+    split_bin: jnp.ndarray       # (L-1,) i32 — bin-space threshold (left if bin <= t)
+    split_gain: jnp.ndarray      # (L-1,) f32
+    split_type: jnp.ndarray      # (L-1,) i32 — 0 numeric, 1 categorical
+    cat_bitset: jnp.ndarray      # (L-1, ceil(B/32)) u32 — membership → left
+    left_child: jnp.ndarray      # (L-1,) i32 — >=0 internal node, ~leaf otherwise
+    right_child: jnp.ndarray     # (L-1,) i32
+    internal_value: jnp.ndarray  # (L-1,) f32 (shrunk output the node would emit)
+    internal_count: jnp.ndarray  # (L-1,) i32
+    leaf_value: jnp.ndarray      # (L,) f32 (shrinkage applied, LightGBM-style)
+    leaf_weight: jnp.ndarray     # (L,) f32 (sum of hessians)
+    leaf_count: jnp.ndarray      # (L,) i32
+    num_splits: jnp.ndarray      # () i32
+
+
+def _threshold_l1(g, l1):
+    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+
+
+def _leaf_objective(g, h, l1, l2):
+    """LightGBM GetLeafSplitGain: ThresholdL1(G)^2 / (H + l2)."""
+    gt = _threshold_l1(g, l1)
+    return gt * gt / (h + l2)
+
+
+def _leaf_output(g, h, cfg: GrowerConfig):
+    out = -_threshold_l1(g, cfg.lambda_l1) / (h + cfg.lambda_l2)
+    if cfg.max_delta_step > 0:
+        out = jnp.clip(out, -cfg.max_delta_step, cfg.max_delta_step)
+    return out
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def grow_tree(
+    binned: jnp.ndarray,         # (N, F) uint8/uint16 bin ids
+    grad: jnp.ndarray,           # (N,) f32 — pre-weighted (instance weight / GOSS amp)
+    hess: jnp.ndarray,           # (N,) f32
+    in_bag: jnp.ndarray,         # (N,) f32 — 1 participating, 0 bagged-out/padding
+    feature_active: jnp.ndarray, # (F,) bool — feature_fraction mask
+    is_categorical: jnp.ndarray, # (F,) bool
+    monotone: jnp.ndarray,       # (F,) i32 in {-1, 0, +1}
+    cfg: GrowerConfig,
+) -> tuple:
+    """Grow one tree; returns (TreeArrays, node_of_row) where node_of_row is each
+    row's final leaf index (used for the O(1) training-score update)."""
+    n, f = binned.shape
+    L, B = cfg.num_leaves, cfg.num_bins
+    bw = (B + BITS - 1) // BITS
+    g = jnp.asarray(grad, jnp.float32) * in_bag
+    h = jnp.asarray(hess, jnp.float32) * in_bag
+
+    l1 = jnp.float32(cfg.lambda_l1)
+    l2 = jnp.float32(cfg.lambda_l2)
+
+    def best_splits(hist):
+        """Per-leaf best split over all (feature, bin)/(feature, prefix).
+        hist: (L, F, B, 3) → gain (L,), feat (L,), bin (L,), plus totals."""
+        totals = hist[:, 0, :, :].sum(axis=1)                    # (L, 3) — feature 0 partitions the leaf
+        G, H, C = totals[:, 0], totals[:, 1], totals[:, 2]
+        parent_obj = _leaf_objective(G, H, l1, l2)                # (L,)
+
+        def scan_gains(cum):
+            GL, HL, CL = cum[..., 0], cum[..., 1], cum[..., 2]
+            GR = G[:, None, None] - GL
+            HR = H[:, None, None] - HL
+            CR = C[:, None, None] - CL
+            gain = (_leaf_objective(GL, HL, l1, l2) + _leaf_objective(GR, HR, l1, l2)
+                    - parent_obj[:, None, None])
+            valid = ((CL >= cfg.min_data_in_leaf) & (CR >= cfg.min_data_in_leaf)
+                     & (HL >= cfg.min_sum_hessian_in_leaf)
+                     & (HR >= cfg.min_sum_hessian_in_leaf))
+            return gain, valid, (GL, HL, GR, HR)
+
+        # numeric: natural bin order
+        cum_num = jnp.cumsum(hist, axis=2)
+        gain_num, valid_num, (GL, HL, GR, HR) = scan_gains(cum_num)
+        mc = monotone[None, :, None]
+        vl = -GL / (HL + l2)
+        vr = -GR / (HR + l2)
+        mono_ok = jnp.where(mc == 0, True,
+                            jnp.where(mc > 0, vl <= vr, vl >= vr))
+        gain_num = jnp.where(valid_num & mono_ok, gain_num, -jnp.inf)
+
+        if cfg.has_categorical:
+            # categorical: sort bins by G/(H + cat_smooth), empty bins last
+            cnt = hist[..., 2]
+            key = jnp.where(cnt > 0, hist[..., 0] / (hist[..., 1] + cfg.cat_smooth), jnp.inf)
+            order = jnp.argsort(key, axis=2)                     # (L, F, B)
+            hist_sorted = jnp.take_along_axis(hist, order[..., None], axis=2)
+            cum_cat = jnp.cumsum(hist_sorted, axis=2)
+            gain_cat, valid_cat, _ = scan_gains(cum_cat)
+            k = jnp.arange(B)[None, None, :]
+            nonempty = (cnt > 0).sum(axis=2)[:, :, None]
+            valid_k = (k < cfg.max_cat_threshold) & (k < nonempty)
+            gain_cat = jnp.where(valid_cat & valid_k, gain_cat, -jnp.inf)
+            gain = jnp.where(is_categorical[None, :, None], gain_cat, gain_num)
+        else:
+            order = None
+            gain = gain_num
+        gain = jnp.where(feature_active[None, :, None], gain, -jnp.inf)
+
+        flat = gain.reshape(L, f * B)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        return best_gain, (best // B).astype(jnp.int32), (best % B).astype(jnp.int32), order, totals
+
+    neg1 = -jnp.ones((), jnp.int32)
+
+    class S(NamedTuple):
+        node_of_row: jnp.ndarray
+        depth: jnp.ndarray
+        leaf_parent: jnp.ndarray
+        leaf_is_right: jnp.ndarray
+        split_feature: jnp.ndarray
+        split_bin: jnp.ndarray
+        split_gain: jnp.ndarray
+        split_type: jnp.ndarray
+        cat_bitset: jnp.ndarray
+        left_child: jnp.ndarray
+        right_child: jnp.ndarray
+        internal_value: jnp.ndarray
+        internal_count: jnp.ndarray
+        num_splits: jnp.ndarray
+
+    init = S(
+        node_of_row=jnp.zeros((n,), jnp.int32),
+        depth=jnp.zeros((L,), jnp.int32),
+        leaf_parent=jnp.full((L,), -1, jnp.int32),
+        leaf_is_right=jnp.zeros((L,), bool),
+        split_feature=jnp.zeros((max(L - 1, 1),), jnp.int32),
+        split_bin=jnp.full((max(L - 1, 1),), B - 1, jnp.int32),
+        split_gain=jnp.zeros((max(L - 1, 1),), jnp.float32),
+        split_type=jnp.zeros((max(L - 1, 1),), jnp.int32),
+        cat_bitset=jnp.zeros((max(L - 1, 1), bw), jnp.uint32),
+        left_child=jnp.full((max(L - 1, 1),), ~0, jnp.int32),
+        right_child=jnp.full((max(L - 1, 1),), ~0, jnp.int32),
+        internal_value=jnp.zeros((max(L - 1, 1),), jnp.float32),
+        internal_count=jnp.zeros((max(L - 1, 1),), jnp.int32),
+        num_splits=jnp.zeros((), jnp.int32),
+    )
+
+    def body(i, s: S):
+        hist = leaf_histograms(binned, jnp.where(in_bag > 0, s.node_of_row, -1),
+                               g, h, L, B)
+        best_gain, best_feat, best_bin, order, totals = best_splits(hist)
+
+        leaf_ids = jnp.arange(L)
+        active = leaf_ids <= i
+        if cfg.max_depth > 0:
+            active &= s.depth < cfg.max_depth
+        # a leaf is only splittable if it was actually created (i.e. <= num_splits)
+        active &= leaf_ids <= s.num_splits
+        masked_gain = jnp.where(active, best_gain, -jnp.inf)
+        l = jnp.argmax(masked_gain).astype(jnp.int32)
+        gain_l = masked_gain[l]
+        do = gain_l > cfg.min_gain_to_split
+        fsel = best_feat[l]
+        bsel = best_bin[l]
+        rows_bin = binned[:, fsel].astype(jnp.int32)
+        if cfg.has_categorical:
+            is_cat = is_categorical[fsel]
+            # categorical bitset: first (bsel+1) bins in sorted order go left
+            order_lf = order[l, fsel]                            # (B,)
+            take = jnp.arange(B) <= bsel
+            bit_words = (order_lf >> 5).astype(jnp.int32)
+            bit_vals = (jnp.uint32(1) << (order_lf & 31).astype(jnp.uint32))
+            bitset = jnp.zeros((bw,), jnp.uint32).at[bit_words].add(
+                jnp.where(take, bit_vals, jnp.uint32(0)))
+            member = ((bitset[rows_bin >> 5] >> (rows_bin & 31).astype(jnp.uint32)) & 1).astype(bool)
+            go_right = jnp.where(is_cat, ~member, rows_bin > bsel)
+        else:
+            is_cat = jnp.zeros((), bool)
+            bitset = jnp.zeros((bw,), jnp.uint32)
+            go_right = rows_bin > bsel
+        new_node = jnp.where(do & (s.node_of_row == l) & go_right, i + 1, s.node_of_row)
+
+        # tree bookkeeping for internal node i
+        G_l, H_l, C_l = totals[l, 0], totals[l, 1], totals[l, 2]
+        parent_out = _leaf_output(G_l, H_l, cfg) * cfg.learning_rate
+
+        def setw(arr, idx, val):
+            return arr.at[idx].set(jnp.where(do, val, arr[idx]))
+
+        p = s.leaf_parent[l]
+        p_idx = jnp.maximum(p, 0)
+        lc = s.left_child.at[p_idx].set(
+            jnp.where(do & (p >= 0) & ~s.leaf_is_right[l], i, s.left_child[p_idx]))
+        rc = s.right_child.at[p_idx].set(
+            jnp.where(do & (p >= 0) & s.leaf_is_right[l], i, s.right_child[p_idx]))
+        lc = lc.at[i].set(jnp.where(do, ~l, lc[i]))
+        rc = rc.at[i].set(jnp.where(do, ~(i + 1), rc[i]))
+
+        return S(
+            node_of_row=new_node,
+            depth=s.depth.at[l].add(jnp.where(do, 1, 0))
+                        .at[i + 1].set(jnp.where(do, s.depth[l] + 1, s.depth[i + 1])),
+            leaf_parent=s.leaf_parent.at[l].set(jnp.where(do, i, s.leaf_parent[l]))
+                                  .at[i + 1].set(jnp.where(do, i, s.leaf_parent[i + 1])),
+            leaf_is_right=s.leaf_is_right.at[l].set(jnp.where(do, False, s.leaf_is_right[l]))
+                                     .at[i + 1].set(jnp.where(do, True, s.leaf_is_right[i + 1])),
+            split_feature=setw(s.split_feature, i, fsel),
+            split_bin=setw(s.split_bin, i, bsel),
+            split_gain=setw(s.split_gain, i, gain_l),
+            split_type=setw(s.split_type, i, is_cat.astype(jnp.int32)),
+            cat_bitset=s.cat_bitset.at[i].set(jnp.where(do, bitset, s.cat_bitset[i])),
+            left_child=lc,
+            right_child=rc,
+            internal_value=setw(s.internal_value, i, parent_out),
+            internal_count=setw(s.internal_count, i, C_l.astype(jnp.int32)),
+            num_splits=s.num_splits + jnp.where(do, 1, 0),
+        )
+
+    s = jax.lax.fori_loop(0, L - 1, body, init) if L > 1 else init
+
+    # final leaf stats from the terminal assignment
+    vals = jnp.stack([g, h, in_bag], -1)
+    leaf_tot = jnp.zeros((L, 3), jnp.float32).at[
+        jnp.where(in_bag > 0, s.node_of_row, L)].add(vals, mode="drop")
+    leaf_value = _leaf_output(leaf_tot[:, 0], leaf_tot[:, 1], cfg) * cfg.learning_rate
+    # leaves that never came into existence emit 0 (they are unreachable anyway)
+    exists = jnp.arange(L) <= s.num_splits
+    leaf_value = jnp.where(exists, leaf_value, 0.0)
+
+    tree = TreeArrays(
+        split_feature=s.split_feature,
+        split_bin=s.split_bin,
+        split_gain=s.split_gain,
+        split_type=s.split_type,
+        cat_bitset=s.cat_bitset,
+        left_child=s.left_child,
+        right_child=s.right_child,
+        internal_value=s.internal_value,
+        internal_count=s.internal_count,
+        leaf_value=leaf_value,
+        leaf_weight=leaf_tot[:, 1],
+        leaf_count=leaf_tot[:, 2].astype(jnp.int32),
+        num_splits=s.num_splits,
+    )
+    return tree, s.node_of_row
+
+
+# ---------------------------------------------------------------------------
+# Stacked-forest prediction
+# ---------------------------------------------------------------------------
+
+class Forest(NamedTuple):
+    """All trees stacked on a leading tree axis; ``threshold`` is in raw feature
+    space (bin upper bounds), ``split_bin`` in bin space (for binned traversal).
+    Inference is a ``lax.scan`` over trees of a vectorized pointer-chase, batched
+    over rows — the reference instead does row-at-a-time JNI predict
+    (LightGBMBooster.scala:520-560), which SURVEY §3.2 flags as unbatched."""
+
+    split_feature: jnp.ndarray   # (T, L-1)
+    threshold: jnp.ndarray       # (T, L-1) f32
+    split_bin: jnp.ndarray       # (T, L-1) i32
+    split_type: jnp.ndarray      # (T, L-1) i32
+    cat_bitset: jnp.ndarray      # (T, L-1, BW) u32
+    left_child: jnp.ndarray      # (T, L-1)
+    right_child: jnp.ndarray     # (T, L-1)
+    leaf_value: jnp.ndarray      # (T, L)
+
+    @property
+    def num_trees(self) -> int:
+        return self.split_feature.shape[0]
+
+    @property
+    def num_leaves(self) -> int:
+        return self.leaf_value.shape[1]
+
+
+def _descend(X, sf, thr, sbin, stype, bits, lc, rc, binned: bool, depth: int):
+    """Vectorized pointer-chase for one tree; returns leaf index per row."""
+    n = X.shape[0]
+    node = jnp.zeros((n,), jnp.int32)
+
+    def step(_, node):
+        f = sf[jnp.maximum(node, 0)]
+        x = jnp.take_along_axis(X, f[:, None].astype(jnp.int32), axis=1)[:, 0]
+        if binned:
+            num_right = x.astype(jnp.int32) > sbin[jnp.maximum(node, 0)]
+            c = x.astype(jnp.int32)
+        else:
+            t = thr[jnp.maximum(node, 0)]
+            num_right = ~(x <= t)          # NaN → right
+            c = jnp.clip(jnp.nan_to_num(x, nan=-1.0), -1, bits.shape[1] * BITS - 1).astype(jnp.int32)
+        cw = jnp.maximum(c, 0)
+        word = bits[jnp.maximum(node, 0), cw >> 5]
+        member = ((word >> (cw & 31).astype(jnp.uint32)) & 1).astype(bool) & (c >= 0)
+        is_cat = stype[jnp.maximum(node, 0)] == 1
+        go_right = jnp.where(is_cat, ~member, num_right)
+        nxt = jnp.where(go_right, rc[jnp.maximum(node, 0)], lc[jnp.maximum(node, 0)])
+        return jnp.where(node < 0, node, nxt)
+
+    node = jax.lax.fori_loop(0, depth, step, node)
+    return ~node  # leaf index
+
+
+@partial(jax.jit, static_argnames=("binned", "output"))
+def forest_predict(forest: Forest, X: jnp.ndarray, binned: bool = False,
+                   output: str = "sum") -> jnp.ndarray:
+    """Sum of tree outputs (raw score) per row. ``output='leaf'`` returns the
+    (N, T) leaf indices (predictLeaf parity — LightGBMBooster.scala:408-419);
+    ``output='per_tree'`` returns (N, T) leaf values (for DART drop handling)."""
+    X = jnp.asarray(X, jnp.float32 if not binned else X.dtype)
+    L = forest.leaf_value.shape[1]
+    depth = max(L - 1, 1)
+
+    def one_tree(carry, t):
+        sf, thr, sbin, stype, bits, lc, rc, lv = t
+        leaf = _descend(X, sf, thr, sbin, stype, bits, lc, rc, binned, depth)
+        val = lv[leaf]
+        return carry, (leaf, val)
+
+    _, (leaves, vals) = jax.lax.scan(
+        one_tree, 0,
+        (forest.split_feature, forest.threshold, forest.split_bin, forest.split_type,
+         forest.cat_bitset, forest.left_child, forest.right_child, forest.leaf_value))
+    if output == "leaf":
+        return leaves.T          # (N, T)
+    if output == "per_tree":
+        return vals.T            # (N, T)
+    return vals.sum(axis=0)      # (N,)
+
+
+def stack_trees(trees: list, thresholds: list) -> Forest:
+    """Host-side: stack per-tree TreeArrays (+ real-valued thresholds resolved
+    from the BinMapper) into a Forest."""
+    def cat(field):
+        return jnp.stack([np.asarray(getattr(t, field)) for t in trees])
+
+    return Forest(
+        split_feature=cat("split_feature"),
+        threshold=jnp.stack([np.asarray(t, np.float32) for t in thresholds]),
+        split_bin=cat("split_bin"),
+        split_type=cat("split_type"),
+        cat_bitset=cat("cat_bitset"),
+        left_child=cat("left_child"),
+        right_child=cat("right_child"),
+        leaf_value=cat("leaf_value"),
+    )

@@ -1,0 +1,302 @@
+"""LightGBM-compatible model-string serialization.
+
+The reference's model artifact IS the LightGBM text model string (saved via
+saveNativeModel, LightGBMBooster.scala:458-470; loaded into models at
+LightGBMClassifier.scala:196-211). Emitting the same format keeps trained models
+interoperable with the LightGBM ecosystem (native lib, treelite, shap, ...), and
+lets this framework load models trained elsewhere.
+
+Format notes (LightGBM `tree` v3 text format):
+  * child pointers: >= 0 → internal node index, negative → ~leaf_index
+  * decision_type bitfield: bit0 categorical, bit1 default_left, bits2-3
+    missing_type (0 none, 1 zero, 2 nan). We emit 8 (= nan missing, no
+    default-left) for numeric and 1|8 for categorical splits.
+  * categorical thresholds: `threshold` holds an index into cat_boundaries;
+    cat_threshold stores uint32 bitset words.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..ops.quantize import BinMapper
+from .grower import BITS, TreeArrays
+
+_NUMERIC_DT = 8       # missing_type = nan
+_CATEGORICAL_DT = 9   # categorical | nan missing
+
+
+def _fmt(arr, fmt="{:g}") -> str:
+    return " ".join(fmt.format(x) for x in arr)
+
+
+def booster_to_string(booster) -> str:
+    cfg = booster.config
+    mapper: BinMapper = booster.mapper
+    k = booster.models_per_iter
+    lines: List[str] = [
+        "tree",
+        "version=v3",
+        f"num_class={booster.num_class}",
+        f"num_tree_per_iteration={k}",
+        "label_index=0",
+        f"max_feature_idx={mapper.num_features - 1}",
+        f"objective={_objective_string(cfg)}",
+        ("average_output" if booster.average_output else ""),
+        "feature_names=" + " ".join(booster.feature_names),
+        "feature_infos=" + " ".join(_feature_info(mapper, j) for j in range(mapper.num_features)),
+    ]
+    lines = [l for l in lines if l != ""]
+
+    tree_blocks = []
+    for ti, tree in enumerate(booster.trees):
+        # LightGBM stores no base score: boost_from_average is folded into leaf
+        # values. Fold into the first tree of each class (every tree when the
+        # output is averaged, so the mean shifts by base).
+        base_shift = 0.0
+        if booster.average_output:
+            base_shift = float(booster.base_score[ti % k])
+        elif ti < k:
+            base_shift = float(booster.base_score[ti])
+        tree_blocks.append(_tree_to_string(ti, tree, booster._thresholds(ti),
+                                           booster.tree_weights[ti], cfg.learning_rate,
+                                           base_shift))
+    sizes = [len(b) + 1 for b in tree_blocks]
+    lines.append("tree_sizes=" + " ".join(str(s) for s in sizes))
+    lines.append("")
+    out = "\n".join(lines) + "\n" + "\n".join(tree_blocks)
+    out += "\nend of trees\n\nfeature_importances:\n"
+    imp = booster.feature_importances("split")
+    order = np.argsort(-imp)
+    for j in order:
+        if imp[j] > 0:
+            out += f"{booster.feature_names[j]}={int(imp[j])}\n"
+    out += "\nparameters:\n[boosting: {}]\n[objective: {}]\n[learning_rate: {}]\n[num_leaves: {}]\nend of parameters\n".format(
+        cfg.boosting_type, cfg.objective, cfg.learning_rate, cfg.num_leaves)
+    out += "\npandas_categorical:null\n"
+    return out
+
+
+def _objective_string(cfg) -> str:
+    if cfg.objective == "binary":
+        return f"binary sigmoid:{cfg.sigmoid:g}"
+    if cfg.objective in ("multiclass", "softmax"):
+        return f"multiclass num_class:{cfg.num_class}"
+    if cfg.objective == "multiclassova":
+        return f"multiclassova num_class:{cfg.num_class} sigmoid:{cfg.sigmoid:g}"
+    if cfg.objective == "lambdarank":
+        return "lambdarank"
+    return cfg.objective
+
+
+def _feature_info(mapper: BinMapper, j: int) -> str:
+    if mapper.is_categorical[j]:
+        nb = int(mapper.num_bins[j])
+        return ":".join(str(i) for i in range(max(nb - 1, 1)))
+    b = mapper.boundaries[j]
+    finite = b[np.isfinite(b)]
+    if finite.size == 0:
+        return "none"
+    return f"[{finite[0]:g}:{finite[-1]:g}]"
+
+
+def _tree_to_string(index: int, tree: TreeArrays, thresholds: np.ndarray,
+                    weight: float, shrinkage: float, base_shift: float = 0.0) -> str:
+    ns = int(tree.num_splits)
+    nleaves = ns + 1
+    sf = np.asarray(tree.split_feature)[:ns]
+    stype = np.asarray(tree.split_type)[:ns]
+    thr = np.asarray(thresholds)[:ns].astype(np.float64)
+    lc = np.asarray(tree.left_child)[:ns]
+    rc = np.asarray(tree.right_child)[:ns]
+    lv = np.asarray(tree.leaf_value)[:nleaves].astype(np.float64) * weight + base_shift
+    lw = np.asarray(tree.leaf_weight)[:nleaves]
+    lcnt = np.asarray(tree.leaf_count)[:nleaves]
+    gain = np.asarray(tree.split_gain)[:ns]
+    iv = np.asarray(tree.internal_value)[:ns]
+    icnt = np.asarray(tree.internal_count)[:ns]
+    bits = np.asarray(tree.cat_bitset)[:ns]
+
+    # leaf pointers beyond the actual leaf count can appear when num_splits <
+    # num_leaves-1; clamp any dangling internal pointer to a leaf
+    def fix_child(c):
+        return np.where((c >= 0) & (c < ns), c, np.where(c >= 0, ~0, c))
+
+    lc, rc = fix_child(lc), fix_child(rc)
+
+    dt = np.where(stype == 1, _CATEGORICAL_DT, _NUMERIC_DT)
+
+    lines = [f"Tree={index}", f"num_leaves={max(nleaves, 1)}"]
+    cat_lines = []
+    if (stype == 1).any():
+        # threshold for categorical nodes = index into cat_boundaries
+        cat_idx = np.cumsum(stype) - 1
+        thr = np.where(stype == 1, cat_idx.astype(np.float64), thr)
+        bw = bits.shape[1]
+        boundaries = [0]
+        words: List[int] = []
+        for i in range(ns):
+            if stype[i] == 1:
+                words.extend(int(w) for w in bits[i])
+                boundaries.append(len(words))
+        cat_lines = [f"num_cat={int((stype == 1).sum())}",
+                     "cat_boundaries=" + _fmt(boundaries, "{:d}"),
+                     "cat_threshold=" + _fmt(words, "{:d}")]
+    else:
+        lines.append("num_cat=0")
+
+    if ns == 0:
+        # single-leaf tree: LightGBM emits leaf_value only
+        lines += cat_lines
+        lines.append("leaf_value=" + _fmt(lv, "{:.17g}"))
+        lines.append(f"shrinkage={shrinkage:g}")
+        return "\n".join(lines) + "\n"
+
+    lines += [
+        "split_feature=" + _fmt(sf, "{:d}"),
+        "split_gain=" + _fmt(gain),
+        "threshold=" + _fmt(thr, "{:.17g}"),
+        "decision_type=" + _fmt(dt, "{:d}"),
+        "left_child=" + _fmt(lc, "{:d}"),
+        "right_child=" + _fmt(rc, "{:d}"),
+        "leaf_value=" + _fmt(lv, "{:.17g}"),
+        "leaf_weight=" + _fmt(lw),
+        "leaf_count=" + _fmt(lcnt, "{:d}"),
+        "internal_value=" + _fmt(iv),
+        # internal hessian sums are not tracked separately; counts are the
+        # closest available weight proxy (harmless to downstream loaders)
+        "internal_weight=" + _fmt(np.maximum(icnt.astype(np.float64), 1.0)),
+        "internal_count=" + _fmt(icnt, "{:d}"),
+    ] + cat_lines + [
+        "is_linear=0",
+        f"shrinkage={shrinkage:g}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Parsing (load models produced by us or by native LightGBM)
+# ---------------------------------------------------------------------------
+
+def booster_from_string(s: str):
+    from .boosting import Booster, BoosterConfig
+
+    if not s.lstrip().startswith("tree"):
+        raise ValueError("not a LightGBM model string (must start with 'tree')")
+    header, _, rest = s.partition("\nTree=")
+    if not rest:
+        raise ValueError("model string contains no trees")
+    hdr = {}
+    for line in header.splitlines():
+        if "=" in line:
+            key, _, val = line.partition("=")
+            hdr[key.strip()] = val.strip()
+    num_class = int(hdr.get("num_class", 1))
+    ntpi = int(hdr.get("num_tree_per_iteration", 1))
+    obj_str = hdr.get("objective", "regression").split()
+    objective = obj_str[0] if obj_str else "regression"
+    feature_names = hdr.get("feature_names", "").split()
+    nfeat = int(hdr.get("max_feature_idx", len(feature_names) - 1)) + 1
+    average_output = "average_output" in header
+
+    cfg = BoosterConfig(objective=objective, num_class=num_class,
+                        boosting_type="rf" if average_output else "gbdt")
+    for tok in obj_str[1:]:
+        if tok.startswith("sigmoid:"):
+            cfg.sigmoid = float(tok.split(":")[1])
+
+    trees = []
+    max_leaves = 2
+    blocks = ("Tree=" + rest).split("\nTree=")
+    parsed = []
+    for b in blocks:
+        if not b.strip() or b.startswith("end of trees"):
+            continue
+        body = b.split("end of trees")[0]
+        fields = {}
+        for line in body.splitlines():
+            if "=" in line:
+                key, _, val = line.partition("=")
+                fields[key.strip()] = val.strip()
+        parsed.append(fields)
+        max_leaves = max(max_leaves, int(fields.get("num_leaves", 1)))
+
+    # bitset width: wide enough for the largest categorical node in the model
+    # (native LightGBM models can exceed 256 categories)
+    bw = 8
+    for fields in parsed:
+        if int(fields.get("num_cat", 0)) > 0 and fields.get("cat_boundaries"):
+            bounds = np.array(fields["cat_boundaries"].split(), dtype=np.int64)
+            if len(bounds) > 1:
+                bw = max(bw, int(np.diff(bounds).max()))
+    for fields in parsed:
+        nleaves = int(fields.get("num_leaves", 1))
+        ns = nleaves - 1
+        L = max_leaves
+
+        def arr(name, dtype, size, default=0):
+            if name in fields and fields[name]:
+                a = np.array(fields[name].split(), dtype=np.float64)
+            else:
+                a = np.full(size, default, np.float64)
+            out = np.full(max(size, 1), default, np.float64)
+            out[: min(len(a), size)] = a[:size]
+            return out.astype(dtype)
+
+        sf = arr("split_feature", np.int32, max(L - 1, 1))
+        thr = arr("threshold", np.float32, max(L - 1, 1))
+        dt = arr("decision_type", np.int32, max(L - 1, 1))
+        lc = arr("left_child", np.int32, max(L - 1, 1), ~0)
+        rc = arr("right_child", np.int32, max(L - 1, 1), ~0)
+        lv = arr("leaf_value", np.float32, L)
+        lw = arr("leaf_weight", np.float32, L)
+        lcn = arr("leaf_count", np.int32, L)
+        gain = arr("split_gain", np.float32, max(L - 1, 1))
+        iv = arr("internal_value", np.float32, max(L - 1, 1))
+        icn = arr("internal_count", np.int32, max(L - 1, 1))
+        stype = (dt & 1).astype(np.int32)
+
+        bitset = np.zeros((max(L - 1, 1), bw), np.uint32)
+        if int(fields.get("num_cat", 0)) > 0:
+            bounds = np.array(fields["cat_boundaries"].split(), dtype=np.int64)
+            words = np.array(fields["cat_threshold"].split(), dtype=np.uint64)
+            ci = 0
+            for i in range(ns):
+                if stype[i]:
+                    w = words[bounds[ci]: bounds[ci + 1]]
+                    bitset[i, : len(w)] = w.astype(np.uint32)
+                    ci += 1
+                    thr[i] = 0.0
+
+        trees.append(TreeArrays(
+            split_feature=sf, split_bin=np.zeros_like(sf), split_gain=gain,
+            split_type=stype, cat_bitset=bitset, left_child=lc, right_child=rc,
+            internal_value=iv, internal_count=icn, leaf_value=lv, leaf_weight=lw,
+            leaf_count=lcn, num_splits=np.int32(ns)))
+
+    # synthesize a mapper (loaded models predict from raw values only); the
+    # parsed real-valued thresholds ride along as explicit overrides
+    mapper = BinMapper(boundaries=np.full((nfeat, 254), np.inf, np.float32),
+                       num_bins=np.full(nfeat, 255, np.int32),
+                       is_categorical=np.zeros(nfeat, bool), max_bin=255)
+    thresholds = _collect_thr(parsed, max_leaves)
+    return Booster(mapper, cfg, trees, [1.0] * len(trees),
+                   np.zeros(max(num_class, 1)),
+                   feature_names if feature_names else None,
+                   thresholds=thresholds)
+
+
+def _collect_thr(parsed, L):
+    out = []
+    for fields in parsed:
+        size = max(L - 1, 1)
+        if "threshold" in fields and fields["threshold"]:
+            a = np.array(fields["threshold"].split(), dtype=np.float64)
+        else:
+            a = np.zeros(size)
+        pad = np.zeros(size)
+        pad[: min(len(a), size)] = a[:size]
+        out.append(pad.astype(np.float32))
+    return out

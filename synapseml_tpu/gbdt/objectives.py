@@ -1,0 +1,352 @@
+"""Boosting objectives: gradients/hessians, init scores, and eval metrics.
+
+Parity targets: LightGBM's objective set as exposed through the reference's
+``objective`` param (lightgbm/.../params/LightGBMParams.scala — binary,
+multiclass, multiclassova, regression, regression_l1, huber, fair, poisson,
+quantile, mape, gamma, tweedie, lambdarank) and the custom-objective hook
+(``FObjTrait``, lightgbm/.../params/FObjParam.scala; applied per iteration at
+TrainUtils.scala:80-86). All are pure jax functions of (score, label, weight)
+so they fuse into the boosting step.
+
+Scores are raw margins; ``init_score`` implements boost_from_average.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Objective(NamedTuple):
+    name: str
+    num_model_per_iteration: int                    # K for multiclass, else 1
+    grad_hess: Callable                             # (score, label, weight) -> (g, h)
+    init_score: Callable                            # (label, weight) -> scalar or (K,)
+    transform: Callable                             # raw score -> prediction space
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def binary_objective(sigmoid: float = 1.0) -> Objective:
+    s = sigmoid
+
+    def gh(score, y, w):
+        p = _sigmoid(s * score)
+        g = s * (p - y)
+        h = s * s * p * (1.0 - p)
+        return g * w, jnp.maximum(h * w, 1e-16)
+
+    def init(y, w):
+        p = jnp.clip(jnp.average(y, weights=w), 1e-12, 1 - 1e-12)
+        return jnp.log(p / (1 - p)) / s
+
+    return Objective("binary", 1, gh, init, lambda sc: _sigmoid(s * sc))
+
+
+def multiclass_objective(num_class: int) -> Objective:
+    def gh(score, y, w):  # score (N, K), y (N,) int
+        p = jax.nn.softmax(score, axis=-1)
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
+        g = (p - onehot) * w[:, None]
+        h = 2.0 * p * (1.0 - p) * w[:, None]   # LightGBM's factor-2 softmax hessian
+        return g, jnp.maximum(h, 1e-16)
+
+    def init(y, w):
+        counts = jnp.zeros(num_class).at[y.astype(jnp.int32)].add(w)
+        p = jnp.clip(counts / counts.sum(), 1e-12, 1.0)
+        return jnp.log(p)
+
+    return Objective("multiclass", num_class, gh, init,
+                     lambda sc: jax.nn.softmax(sc, axis=-1))
+
+
+def multiclassova_objective(num_class: int, sigmoid: float = 1.0) -> Objective:
+    s = sigmoid
+
+    def gh(score, y, w):
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
+        p = _sigmoid(s * score)
+        g = s * (p - onehot) * w[:, None]
+        h = s * s * p * (1 - p) * w[:, None]
+        return g, jnp.maximum(h, 1e-16)
+
+    def init(y, w):
+        counts = jnp.zeros(num_class).at[y.astype(jnp.int32)].add(w)
+        p = jnp.clip(counts / counts.sum(), 1e-12, 1 - 1e-12)
+        return jnp.log(p / (1 - p)) / s
+
+    def tf(sc):
+        p = _sigmoid(s * sc)
+        return p / p.sum(axis=-1, keepdims=True)
+
+    return Objective("multiclassova", num_class, gh, init, tf)
+
+
+def regression_objective() -> Objective:
+    def gh(score, y, w):
+        return (score - y) * w, w
+
+    return Objective("regression", 1, gh,
+                     lambda y, w: jnp.average(y, weights=w), lambda sc: sc)
+
+
+def regression_l1_objective() -> Objective:
+    def gh(score, y, w):
+        return jnp.sign(score - y) * w, w  # LightGBM uses hessian=weight for L1
+
+    def init(y, w):
+        return jnp.median(y)  # weighted median approximated by median
+
+    return Objective("regression_l1", 1, gh, init, lambda sc: sc)
+
+
+def huber_objective(alpha: float = 0.9) -> Objective:
+    def gh(score, y, w):
+        d = score - y
+        g = jnp.where(jnp.abs(d) <= alpha, d, alpha * jnp.sign(d))
+        return g * w, w
+
+    return Objective("huber", 1, gh, lambda y, w: jnp.average(y, weights=w), lambda sc: sc)
+
+
+def fair_objective(c: float = 1.0) -> Objective:
+    def gh(score, y, w):
+        d = score - y
+        g = c * d / (jnp.abs(d) + c)
+        h = c * c / (jnp.abs(d) + c) ** 2
+        return g * w, jnp.maximum(h * w, 1e-16)
+
+    return Objective("fair", 1, gh, lambda y, w: jnp.average(y, weights=w), lambda sc: sc)
+
+
+def poisson_objective(max_delta_step: float = 0.7) -> Objective:
+    def gh(score, y, w):
+        ex = jnp.exp(score)
+        return (ex - y) * w, jnp.maximum(ex * jnp.exp(max_delta_step) * w, 1e-16)
+
+    def init(y, w):
+        return jnp.log(jnp.maximum(jnp.average(y, weights=w), 1e-12))
+
+    return Objective("poisson", 1, gh, init, lambda sc: jnp.exp(sc))
+
+
+def quantile_objective(alpha: float = 0.5) -> Objective:
+    def gh(score, y, w):
+        d = score - y
+        g = jnp.where(d >= 0, 1.0 - alpha, -alpha)
+        return g * w, w
+
+    def init(y, w):
+        return jnp.quantile(y, alpha)
+
+    return Objective("quantile", 1, gh, init, lambda sc: sc)
+
+
+def mape_objective() -> Objective:
+    def gh(score, y, w):
+        scale = 1.0 / jnp.maximum(jnp.abs(y), 1.0)
+        return jnp.sign(score - y) * scale * w, scale * w
+
+    def init(y, w):
+        return jnp.median(y)
+
+    return Objective("mape", 1, gh, init, lambda sc: sc)
+
+
+def gamma_objective() -> Objective:
+    def gh(score, y, w):
+        ey = y * jnp.exp(-score)
+        return (1.0 - ey) * w, jnp.maximum(ey * w, 1e-16)
+
+    def init(y, w):
+        return jnp.log(jnp.maximum(jnp.average(y, weights=w), 1e-12))
+
+    return Objective("gamma", 1, gh, init, lambda sc: jnp.exp(sc))
+
+
+def tweedie_objective(rho: float = 1.5) -> Objective:
+    def gh(score, y, w):
+        a = -y * jnp.exp((1.0 - rho) * score)
+        b = jnp.exp((2.0 - rho) * score)
+        g = a + b
+        h = a * (1.0 - rho) + b * (2.0 - rho)
+        return g * w, jnp.maximum(h * w, 1e-16)
+
+    def init(y, w):
+        return jnp.log(jnp.maximum(jnp.average(y, weights=w), 1e-12))
+
+    return Objective("tweedie", 1, gh, init, lambda sc: jnp.exp(sc))
+
+
+# ---------------------------------------------------------------------------
+# LambdaRank (grouped, padded-matrix formulation)
+# ---------------------------------------------------------------------------
+
+def make_grouped(labels: np.ndarray, group_sizes: np.ndarray, max_group: Optional[int] = None):
+    """Host-side: rows must already be group-contiguous (the analog of the
+    reference's repartition-by-group, LightGBMRanker.scala:88-116). Returns
+    (group_id_per_row, padded row-index matrix (Q, Gmax) with -1 padding)."""
+    sizes = np.asarray(group_sizes, np.int64)
+    q = len(sizes)
+    gmax = int(max_group or sizes.max())
+    idx = np.full((q, gmax), -1, np.int64)
+    start = 0
+    for i, sz in enumerate(sizes):
+        sz = min(int(sz), gmax)
+        idx[i, :sz] = np.arange(start, start + sz)
+        start += int(group_sizes[i])
+    return idx
+
+
+def lambdarank_objective(group_index: jnp.ndarray, sigmoid: float = 2.0,
+                         truncation: int = 30) -> Objective:
+    """LambdaRank with NDCG weighting (LightGBM lambdarank). ``group_index`` is
+    the (Q, Gmax) padded row-index matrix from :func:`make_grouped`. Gradients
+    computed per group over the (Gmax, Gmax) pair matrix — MXU/VPU-friendly."""
+    gi = jnp.asarray(group_index)
+
+    def gh(score, y, w):
+        pad = gi < 0
+        safe = jnp.maximum(gi, 0)
+        s = jnp.where(pad, -jnp.inf, score[safe])          # (Q, G)
+        rel = jnp.where(pad, 0.0, y[safe])
+        gain = 2.0 ** rel - 1.0
+
+        # rank by current score (descending)
+        order = jnp.argsort(-s, axis=1)
+        ranks = jnp.argsort(order, axis=1)                 # rank position of each item
+        disc = 1.0 / jnp.log2(ranks + 2.0)
+        disc = jnp.where(ranks < truncation, disc, 0.0)
+
+        # ideal DCG for normalization
+        ideal = jnp.sort(gain, axis=1)[:, ::-1]
+        k = jnp.arange(gain.shape[1])
+        ideal_disc = jnp.where(k < truncation, 1.0 / jnp.log2(k + 2.0), 0.0)
+        idcg = (ideal * ideal_disc[None, :]).sum(axis=1)
+        inv_idcg = jnp.where(idcg > 0, 1.0 / idcg, 0.0)
+
+        ds = s[:, :, None] - s[:, None, :]                 # (Q, G, G)
+        rho = jax.nn.sigmoid(-sigmoid * ds)                # 1/(1+e^{sigma*ds})
+        delta = jnp.abs((gain[:, :, None] - gain[:, None, :])
+                        * (disc[:, :, None] - disc[:, None, :])) * inv_idcg[:, None, None]
+        better = rel[:, :, None] > rel[:, None, :]
+        valid = better & ~pad[:, :, None] & ~pad[:, None, :]
+        lam = jnp.where(valid, -sigmoid * rho * delta, 0.0)
+        hs = jnp.where(valid, sigmoid * sigmoid * rho * (1 - rho) * delta, 0.0)
+
+        g_item = lam.sum(axis=2) - lam.sum(axis=1)         # winners pulled up, losers down
+        h_item = hs.sum(axis=2) + hs.sum(axis=1)
+
+        g = jnp.zeros_like(score).at[safe.reshape(-1)].add(
+            jnp.where(pad, 0.0, g_item).reshape(-1))
+        h = jnp.zeros_like(score).at[safe.reshape(-1)].add(
+            jnp.where(pad, 0.0, h_item).reshape(-1))
+        return g * w, jnp.maximum(h * w, 1e-16)
+
+    return Objective("lambdarank", 1, gh, lambda y, w: jnp.float32(0.0), lambda sc: sc)
+
+
+# ---------------------------------------------------------------------------
+
+_FACTORIES = {
+    "binary": lambda p: binary_objective(p.get("sigmoid", 1.0)),
+    "multiclass": lambda p: multiclass_objective(p["num_class"]),
+    "softmax": lambda p: multiclass_objective(p["num_class"]),
+    "multiclassova": lambda p: multiclassova_objective(p["num_class"], p.get("sigmoid", 1.0)),
+    "regression": lambda p: regression_objective(),
+    "mean_squared_error": lambda p: regression_objective(),
+    "l2": lambda p: regression_objective(),
+    "regression_l1": lambda p: regression_l1_objective(),
+    "l1": lambda p: regression_l1_objective(),
+    "mae": lambda p: regression_l1_objective(),
+    "huber": lambda p: huber_objective(p.get("alpha", 0.9)),
+    "fair": lambda p: fair_objective(p.get("fair_c", 1.0)),
+    "poisson": lambda p: poisson_objective(p.get("poisson_max_delta_step", 0.7)),
+    "quantile": lambda p: quantile_objective(p.get("alpha", 0.5)),
+    "mape": lambda p: mape_objective(),
+    "gamma": lambda p: gamma_objective(),
+    "tweedie": lambda p: tweedie_objective(p.get("tweedie_variance_power", 1.5)),
+}
+
+
+def get_objective(name: str, **params) -> Objective:
+    if name not in _FACTORIES:
+        raise ValueError(f"unknown objective {name!r}; known: {sorted(_FACTORIES)} + lambdarank")
+    return _FACTORIES[name](params)
+
+
+# ---------------------------------------------------------------------------
+# Metrics (eval + early stopping; reference extracts native eval metrics at
+# TrainUtils.scala:137-151 — here they are jnp reductions)
+# ---------------------------------------------------------------------------
+
+def auc(y_true, y_score, sample_weight=None):
+    y_true = jnp.asarray(y_true, jnp.float32)
+    y_score = jnp.asarray(y_score, jnp.float32)
+    w = jnp.ones_like(y_true) if sample_weight is None else jnp.asarray(sample_weight, jnp.float32)
+    order = jnp.argsort(y_score)
+    ys, ws = y_true[order], w[order]
+    cum_neg = jnp.cumsum(jnp.where(ys == 0, ws, 0.0))
+    auc_sum = jnp.sum(jnp.where(ys > 0, ws * cum_neg, 0.0))
+    pos = jnp.sum(jnp.where(ys > 0, ws, 0.0))
+    neg = jnp.sum(jnp.where(ys == 0, ws, 0.0))
+    # tie-correction omitted (scores rarely tie for GBDT margins)
+    return auc_sum / jnp.maximum(pos * neg, 1e-12)
+
+
+def binary_logloss(y_true, p, eps=1e-15):
+    p = jnp.clip(p, eps, 1 - eps)
+    return -jnp.mean(y_true * jnp.log(p) + (1 - y_true) * jnp.log1p(-p))
+
+
+def multi_logloss(y_true, p, eps=1e-15):
+    p = jnp.clip(p, eps, 1.0)
+    return -jnp.mean(jnp.log(jnp.take_along_axis(p, y_true.astype(jnp.int32)[:, None], 1)[:, 0]))
+
+
+def rmse(y_true, pred):
+    return jnp.sqrt(jnp.mean((y_true - pred) ** 2))
+
+
+def mae(y_true, pred):
+    return jnp.mean(jnp.abs(y_true - pred))
+
+
+def ndcg_at_k(labels, scores, group_index, k: int = 5):
+    """Mean NDCG@k over groups; group_index as in :func:`make_grouped`."""
+    gi = jnp.asarray(group_index)
+    pad = gi < 0
+    safe = jnp.maximum(gi, 0)
+    s = jnp.where(pad, -jnp.inf, scores[safe])
+    rel = jnp.where(pad, 0.0, labels[safe])
+    gain = 2.0 ** rel - 1.0
+    order = jnp.argsort(-s, axis=1)
+    ranks = jnp.argsort(order, axis=1)
+    disc = jnp.where(ranks < k, 1.0 / jnp.log2(ranks + 2.0), 0.0)
+    dcg = (gain * disc).sum(axis=1)
+    ideal = jnp.sort(gain, axis=1)[:, ::-1]
+    j = jnp.arange(gain.shape[1])
+    idisc = jnp.where(j < k, 1.0 / jnp.log2(j + 2.0), 0.0)
+    idcg = (ideal * idisc[None, :]).sum(axis=1)
+    return jnp.mean(jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 1.0))
+
+
+METRICS = {
+    "auc": lambda y, pred, **kw: auc(y, pred, kw.get("weight")),
+    "binary_logloss": lambda y, pred, **kw: binary_logloss(y, pred),
+    "binary_error": lambda y, pred, **kw: jnp.mean((pred > 0.5) != (y > 0.5)),
+    "multi_logloss": lambda y, pred, **kw: multi_logloss(y, pred),
+    "multi_error": lambda y, pred, **kw: jnp.mean(jnp.argmax(pred, -1) != y),
+    "rmse": lambda y, pred, **kw: rmse(y, pred),
+    "l2": lambda y, pred, **kw: jnp.mean((y - pred) ** 2),
+    "mse": lambda y, pred, **kw: jnp.mean((y - pred) ** 2),
+    "mae": lambda y, pred, **kw: mae(y, pred),
+    "l1": lambda y, pred, **kw: mae(y, pred),
+}
+
+HIGHER_IS_BETTER = {"auc", "ndcg", "map"}

@@ -1,0 +1,156 @@
+"""TreeSHAP feature contributions.
+
+Parity target: the reference's ``featuresShap`` output (predict_contrib through
+LGBM_BoosterPredictForMat, booster/LightGBMBooster.scala:424-432 and
+LightGBMModelMethods.scala getFeatureShaps). Implements the polynomial-time
+TreeSHAP recursion (Lundberg & Lee, "Consistent Individualized Feature
+Attribution for Tree Ensembles") host-side in numpy; trees are small so the
+recursion cost is negligible next to device work. Returns (N, F+1): per-feature
+contributions plus the expected value in the last column — LightGBM's
+predict(pred_contrib=True) layout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _Path:
+    """Decomposed path state: parallel arrays over path elements."""
+
+    __slots__ = ("feat", "zero", "one", "w")
+
+    def __init__(self, capacity: int):
+        self.feat = np.full(capacity, -1, np.int64)
+        self.zero = np.zeros(capacity)
+        self.one = np.zeros(capacity)
+        self.w = np.zeros(capacity)
+
+    def copy(self, depth: int) -> "_Path":
+        p = _Path(len(self.feat))
+        p.feat[: depth + 1] = self.feat[: depth + 1]
+        p.zero[: depth + 1] = self.zero[: depth + 1]
+        p.one[: depth + 1] = self.one[: depth + 1]
+        p.w[: depth + 1] = self.w[: depth + 1]
+        return p
+
+
+def _extend(p: _Path, depth: int, pz: float, po: float, pi: int) -> None:
+    p.feat[depth] = pi
+    p.zero[depth] = pz
+    p.one[depth] = po
+    p.w[depth] = 1.0 if depth == 0 else 0.0
+    for i in range(depth - 1, -1, -1):
+        p.w[i + 1] += po * p.w[i] * (i + 1) / (depth + 1)
+        p.w[i] = pz * p.w[i] * (depth - i) / (depth + 1)
+
+
+def _unwind(p: _Path, depth: int, idx: int) -> None:
+    one, zero = p.one[idx], p.zero[idx]
+    nxt = p.w[depth]
+    for i in range(depth - 1, -1, -1):
+        if one != 0:
+            tmp = p.w[i]
+            p.w[i] = nxt * (depth + 1) / ((i + 1) * one)
+            nxt = tmp - p.w[i] * zero * (depth - i) / (depth + 1)
+        else:
+            p.w[i] = p.w[i] * (depth + 1) / (zero * (depth - i))
+    for i in range(idx, depth):
+        p.feat[i] = p.feat[i + 1]
+        p.zero[i] = p.zero[i + 1]
+        p.one[i] = p.one[i + 1]
+
+
+def _unwound_sum(p: _Path, depth: int, idx: int) -> float:
+    one, zero = p.one[idx], p.zero[idx]
+    nxt = p.w[depth]
+    total = 0.0
+    for i in range(depth - 1, -1, -1):
+        if one != 0:
+            tmp = nxt * (depth + 1) / ((i + 1) * one)
+            total += tmp
+            nxt = p.w[i] - tmp * zero * (depth - i) / (depth + 1)
+        else:
+            total += p.w[i] * (depth + 1) / (zero * (depth - i))
+    return total
+
+
+def _shap_recurse(tree, x, phi, node, depth, path: _Path, pz, po, pi):
+    path = path.copy(depth - 1 if depth > 0 else 0)
+    _extend(path, depth, pz, po, pi)
+    if node < 0:  # leaf
+        leaf_val = tree["lv"][~node]
+        for i in range(1, depth + 1):
+            w = _unwound_sum(path, depth, i)
+            phi[path.feat[i]] += w * (path.one[i] - path.zero[i]) * leaf_val
+        return
+    f = int(tree["sf"][node])
+    if tree["stype"][node] == 1:
+        xv = x[f]
+        c = int(xv) if np.isfinite(xv) and xv >= 0 else -1
+        in_set = (0 <= c < tree["bits"].shape[1] * 32 and
+                  bool((tree["bits"][node, c >> 5] >> (c & 31)) & 1))
+        hot, cold = ((tree["lc"][node], tree["rc"][node]) if in_set
+                     else (tree["rc"][node], tree["lc"][node]))
+    else:
+        go_left = x[f] <= tree["thr"][node]
+        hot, cold = ((tree["lc"][node], tree["rc"][node]) if go_left
+                     else (tree["rc"][node], tree["lc"][node]))
+
+    def cover(nd):
+        return tree["leaf_cover"][~nd] if nd < 0 else tree["cover"][nd]
+
+    iz, io = 1.0, 1.0
+    found = -1
+    for i in range(1, depth + 1):
+        if path.feat[i] == f:
+            found = i
+            break
+    if found >= 0:
+        iz, io = path.zero[found], path.one[found]
+        _unwind(path, depth, found)
+        depth -= 1
+    hz = cover(hot) / tree["cover"][node]
+    cz = cover(cold) / tree["cover"][node]
+    _shap_recurse(tree, x, phi, hot, depth + 1, path, iz * hz, io, f)
+    _shap_recurse(tree, x, phi, cold, depth + 1, path, iz * cz, 0.0, f)
+
+
+def forest_shap(booster, X: np.ndarray) -> np.ndarray:
+    n, nfeat = X.shape
+    out = np.zeros((n, nfeat + 1), np.float64)
+    if booster.models_per_iter > 1:
+        raise NotImplementedError("multiclass SHAP: compute per class via booster slices")
+    out[:, -1] += booster.base_score[0]
+
+    weights = np.asarray(booster.tree_weights, np.float64)
+    if booster.average_output:
+        weights = weights / max(len(booster.trees), 1)
+
+    for ti, t in enumerate(booster.trees):
+        ns = int(t.num_splits)
+        nleaves = ns + 1
+        lv = np.asarray(t.leaf_value, np.float64)[:nleaves] * weights[ti]
+        if ns == 0:
+            out[:, -1] += lv[0]
+            continue
+        leaf_cover = np.maximum(np.asarray(t.leaf_count, np.float64)[:nleaves], 1.0)
+        tree = {
+            "sf": np.asarray(t.split_feature)[:ns],
+            "thr": booster._thresholds(ti)[:ns].astype(np.float64),
+            "lc": np.asarray(t.left_child)[:ns],
+            "rc": np.asarray(t.right_child)[:ns],
+            "lv": lv,
+            "cover": np.maximum(np.asarray(t.internal_count, np.float64)[:ns], 1.0),
+            "leaf_cover": leaf_cover,
+            "stype": np.asarray(t.split_type)[:ns],
+            "bits": np.asarray(t.cat_bitset)[:ns],
+        }
+        ev = float((lv * leaf_cover).sum() / leaf_cover.sum())
+        out[:, -1] += ev
+        cap = ns + 3
+        for r in range(n):
+            phi = np.zeros(nfeat + 1)
+            _shap_recurse(tree, X[r].astype(np.float64), phi, 0, 0, _Path(cap), 1.0, 1.0, -1)
+            out[r, :nfeat] += phi[:nfeat]
+    return out

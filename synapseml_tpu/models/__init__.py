@@ -1,0 +1,8 @@
+from .gbdt import (  # noqa: F401
+    LightGBMClassifier,
+    LightGBMClassificationModel,
+    LightGBMRegressor,
+    LightGBMRegressionModel,
+    LightGBMRanker,
+    LightGBMRankerModel,
+)

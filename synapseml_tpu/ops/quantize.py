@@ -1,0 +1,126 @@
+"""Quantile bin mapper — the "reference dataset" concept on TPU.
+
+The reference computes LightGBM bin boundaries on the driver from a row sample and
+broadcasts a serialized reference dataset to all workers (LightGBMBase.scala:509-550,
+dataset/ReferenceDatasetUtils.scala, dataset/SampledData.scala). Here the bin
+boundaries are computed host-side with numpy from a sample (exact same role), and
+binning itself is a jitted XLA op so the (N, F) → (N, F) uint8/uint16 quantized
+matrix is produced TPU-resident.
+
+Bin semantics (matching LightGBM's BinMapper):
+  * boundaries[f] is a sorted vector of bin upper bounds (length <= max_bin - 1);
+    bin(x) = first i with x <= boundaries[f][i]; x beyond all bounds → last bin.
+  * NaN → last bin (missing handled as "always right of any split"; LightGBM's
+    learned default_left is not implemented — documented deviation).
+  * categorical features use the category's integer value as its bin, capped by
+    max_bin; rare categories overflow into bin 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BinMapper(NamedTuple):
+    """Per-feature binning metadata. ``boundaries`` is padded to a rectangle
+    (num_features, max_bin-1) with +inf so it ships to device as one array."""
+
+    boundaries: np.ndarray      # (F, max_bin-1) float32, +inf padded
+    num_bins: np.ndarray        # (F,) int32 — actual bin count per feature
+    is_categorical: np.ndarray  # (F,) bool
+    max_bin: int
+
+    @property
+    def num_features(self) -> int:
+        return self.boundaries.shape[0]
+
+    @property
+    def total_bins(self) -> int:
+        return self.max_bin
+
+
+def compute_bin_mapper(
+    X: np.ndarray,
+    max_bin: int = 255,
+    sample_count: int = 200_000,
+    categorical_features: Optional[Sequence[int]] = None,
+    seed: int = 0,
+) -> BinMapper:
+    """Driver-side boundary computation from a sample (the analog of
+    LightGBMBase.getSampledRows + LGBM_DatasetCreateFromSampledColumn;
+    binSampleCount param default 200000 — params/LightGBMParams.scala)."""
+    X = np.asarray(X, dtype=np.float32)
+    n, f = X.shape
+    cat = np.zeros(f, dtype=bool)
+    if categorical_features:
+        cat[list(categorical_features)] = True
+
+    if n > sample_count:
+        rng = np.random.default_rng(seed)
+        X = X[rng.choice(n, size=sample_count, replace=False)]
+
+    bounds = np.full((f, max_bin - 1), np.inf, dtype=np.float32)
+    nbins = np.zeros(f, dtype=np.int32)
+    for j in range(f):
+        col = X[:, j]
+        col = col[~np.isnan(col)]
+        if cat[j]:
+            # categories are small non-negative ints; identity binning capped at max_bin
+            hi = int(col.max()) if col.size else 0
+            nbins[j] = min(hi + 1, max_bin - 1) + 1  # +1 for the NaN/overflow bin
+            continue
+        uniq = np.unique(col)
+        if uniq.size <= 1:
+            nbins[j] = 2
+            continue
+        if uniq.size <= max_bin - 1:
+            # few distinct values: boundary at midpoints → exact value bins
+            b = (uniq[:-1] + uniq[1:]) * 0.5
+        else:
+            qs = np.linspace(0.0, 1.0, max_bin)[1:-1]
+            b = np.unique(np.quantile(col, qs).astype(np.float32))
+        bounds[j, : b.size] = b
+        nbins[j] = b.size + 2  # values beyond last bound + NaN share the last bin
+    return BinMapper(boundaries=bounds, num_bins=nbins, is_categorical=cat, max_bin=max_bin)
+
+
+@partial(jax.jit, static_argnames=("out_dtype",))
+def _apply_bins_numeric(X: jnp.ndarray, boundaries: jnp.ndarray, out_dtype=jnp.uint8):
+    def bin_one_feature(col, bounds):
+        return jnp.searchsorted(bounds, col, side="left")
+
+    binned = jax.vmap(bin_one_feature, in_axes=(1, 0), out_axes=1)(X, boundaries)
+    return binned.astype(out_dtype)
+
+
+def apply_bins(mapper: BinMapper, X) -> jnp.ndarray:
+    """(N, F) raw floats → (N, F) bin ids. NaN and +inf overflow land in the last
+    usable bin (searchsorted over +inf-padded bounds returns the pad start; NaN
+    compares false with every bound and also returns the end)."""
+    dtype = jnp.uint8 if mapper.max_bin <= 256 else jnp.uint16
+    X = jnp.asarray(X, jnp.float32)
+    binned = _apply_bins_numeric(X, jnp.asarray(mapper.boundaries), dtype)
+    # clamp into each feature's actual bin range (NaN/overflow → num_bins-1)
+    limit = jnp.asarray(mapper.num_bins - 1, binned.dtype)
+    binned = jnp.minimum(binned, limit[None, :])
+    if mapper.is_categorical.any():
+        cats = jnp.asarray(mapper.is_categorical)
+        ident = jnp.clip(jnp.nan_to_num(X, nan=0.0), 0, mapper.max_bin - 1).astype(binned.dtype)
+        ident = jnp.minimum(ident, limit[None, :])
+        binned = jnp.where(cats[None, :], ident, binned)
+    return binned
+
+
+def bin_threshold_to_value(mapper: BinMapper, feature: int, bin_id: int) -> float:
+    """Real-valued split threshold for a numeric split at ``bin_id`` (the stored
+    LightGBM model threshold, i.e. the bin's upper boundary)."""
+    b = mapper.boundaries[feature]
+    if bin_id < len(b) and np.isfinite(b[bin_id]):
+        return float(b[bin_id])
+    finite = b[np.isfinite(b)]
+    return float(finite[-1]) if finite.size else 0.0

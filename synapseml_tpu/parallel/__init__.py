@@ -1,0 +1,22 @@
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    EXPERT_AXIS,
+    initialize_distributed,
+    make_mesh,
+    data_sharding,
+    replicated,
+    shard_rows,
+    process_topology,
+)
+from .collectives import (  # noqa: F401
+    allreduce_sum,
+    allreduce_mean,
+    reduce_scatter_sum,
+    allgather,
+    ppermute_ring,
+    axis_rank,
+    shard_apply,
+    topk_vote,
+)

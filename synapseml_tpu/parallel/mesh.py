@@ -1,0 +1,121 @@
+"""Device mesh construction + distributed bootstrap.
+
+This single module replaces ALL THREE of the reference's distributed coordination
+backends (SURVEY.md §5.8): the LightGBM driver-socket rendezvous + native TCP ring
+(lightgbm/.../NetworkManager.scala:59-218), VowpalWabbit's driver-hosted spanning
+tree (vw/.../VowpalWabbitClusterUtil.scala:15-45), and Horovod's NCCL/Gloo rings
+(deep-learning/.../dl/utils.py:31-54). On TPU all of that collapses into
+``jax.distributed.initialize`` + a named-axis ``jax.sharding.Mesh``: XLA compiles
+the collectives onto ICI within a slice and DCN across slices, and pods are
+inherently gang-scheduled, so there is no rendezvous protocol to implement.
+
+Canonical axis names (fixed across the framework so shardings compose):
+  ``data``  — batch/row sharding (the reference's only parallelism style)
+  ``model`` — tensor parallelism (not in the reference; free on TPU, SURVEY §2.2)
+  ``seq``   — sequence/context parallelism (ring attention, §5.7 stance)
+  ``expert``— expert parallelism
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap. The entire NetworkManager rendezvous
+    (driver ServerSocket + "status:host:port:partition:executor" messages +
+    machine-list broadcast, NetworkManager.scala:25-218) reduces to this call;
+    rank/world come from the TPU runtime or explicit args."""
+    if coordinator_address is None and num_processes is None:
+        return  # single-process: nothing to do (the local[*] analog)
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def make_mesh(shape: Optional[dict] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named-axis mesh. Default: all devices on the ``data`` axis
+    (parity with the reference, which is data-parallel only — SURVEY §2.2).
+
+    ``shape`` maps axis name → size, e.g. ``{"data": 4, "model": 2}``;
+    a size of -1 means "whatever is left".
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if not shape:
+        shape = {DATA_AXIS: len(devs)}
+    names, sizes = list(shape), list(shape.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devs) // known
+    total = int(np.prod(sizes))
+    if total > len(devs):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, have {len(devs)}")
+    arr = np.array(devs[:total]).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def data_sharding(mesh: Mesh, *trailing_unsharded: int) -> NamedSharding:
+    """Rows sharded over the data axis, trailing dims replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * len(trailing_unsharded))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_rows(mesh: Mesh, *arrays):
+    """Place host arrays onto the mesh with rows split over ``data``. Pads rows
+    to a multiple of the data-axis size (padding repeats the last row; callers
+    mask via the returned valid-row count)."""
+    ndata = mesh.shape[DATA_AXIS]
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        n = a.shape[0]
+        rem = (-n) % ndata
+        if rem:
+            a = np.concatenate([a, np.repeat(a[-1:], rem, axis=0)])
+        sh = NamedSharding(mesh, P(DATA_AXIS, *([None] * (a.ndim - 1))))
+        out.append(jax.device_put(a, sh))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+@contextlib.contextmanager
+def local_cpu_devices(n: int = 8):
+    """Testing harness note: the in-process SPMD analog of the reference's
+    `local[*]` Spark testing (SURVEY §4.1) is a forked CPU platform with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` — set in
+    tests/conftest.py BEFORE jax import. This helper only documents/asserts it."""
+    if jax.device_count() < n:
+        raise RuntimeError(
+            f"need {n} devices for the virtual mesh; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count={n} JAX_PLATFORMS=cpu "
+            "before importing jax (see tests/conftest.py)")
+    yield jax.devices()[:n]
+
+
+def process_topology() -> dict:
+    """ClusterUtil analog (core/.../core/utils/ClusterUtil.scala:14-161 computes
+    executors, tasks/executor, rows/partition from Spark): on TPU the topology is
+    a runtime property, not something to discover over sockets."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+    }

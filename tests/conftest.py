@@ -1,0 +1,52 @@
+"""Test harness: in-process SPMD on a virtual 8-device CPU mesh.
+
+The analog of the reference's `local[*]` TestBase (core/.../core/test/base/
+TestBase.scala:28-104): Spark local mode runs N partition-tasks in one JVM, which
+exercises the whole distributed path without a cluster; here a forked CPU
+platform with 8 XLA host devices exercises mesh sharding + collectives without a
+TPU pod (SURVEY.md §4 "implication for the rebuild").
+
+MUST run before any jax import: sets XLA_FLAGS and pins the platform to cpu
+(the axon TPU tunnel is not used for unit tests).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual CPU devices (XLA_FLAGS not applied early enough)")
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def binary_data():
+    from sklearn.datasets import load_breast_cancer
+    from sklearn.model_selection import train_test_split
+
+    X, y = load_breast_cancer(return_X_y=True)
+    return train_test_split(X.astype(np.float32), y.astype(np.float32),
+                            test_size=0.3, random_state=42)
+
+
+@pytest.fixture(scope="session")
+def regression_data():
+    from sklearn.datasets import load_diabetes
+    from sklearn.model_selection import train_test_split
+
+    X, y = load_diabetes(return_X_y=True)
+    return train_test_split(X.astype(np.float32), y.astype(np.float32),
+                            test_size=0.3, random_state=42)

@@ -1,0 +1,113 @@
+"""Core runtime tests: params, Table, pipeline, save/load.
+
+Mirrors the reference's fuzzing-style coverage (SURVEY §4.2): every stage must
+survive getter/setter roundtrips and save/load."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import (Estimator, Param, Params, Pipeline, PipelineStage,
+                                Table, Transformer, assemble_features)
+
+
+class _ToyParams(Params):
+    alpha = Param("alpha", "test float", float, 1.5)
+    name = Param("name", "test str", str, "x")
+    items = Param("items", "test list", list)
+
+
+def test_param_defaults_and_setters():
+    p = _ToyParams()
+    assert p.getAlpha() == 1.5
+    p.setAlpha(2.0)
+    assert p.alpha == 2.0
+    assert p.getName() == "x"
+    p2 = _ToyParams(alpha=3, name="y")           # int coerced to float
+    assert p2.getAlpha() == 3.0 and isinstance(p2.getAlpha(), float)
+
+
+def test_param_validation_errors():
+    with pytest.raises(ValueError):
+        _ToyParams(nosuch=1)
+    with pytest.raises(TypeError):
+        _ToyParams(name=3.5)
+
+
+def test_param_copy_isolated():
+    p = _ToyParams(alpha=2.0)
+    q = p.copy({"alpha": 5.0})
+    assert p.getAlpha() == 2.0 and q.getAlpha() == 5.0
+
+
+def test_explain_params():
+    text = _ToyParams().explainParams()
+    assert "alpha" in text and "test float" in text
+
+
+def test_table_basic_ops():
+    t = Table({"a": np.arange(5), "b": np.linspace(0, 1, 5)})
+    assert t.num_rows == 5 and t.columns == ["a", "b"]
+    assert t.filter(t["a"] > 2).num_rows == 2
+    assert t.select(["b"]).columns == ["b"]
+    assert t.drop("a").columns == ["b"]
+    t2 = t.with_column("c", np.ones((5, 3)))     # vector column
+    assert t2["c"].shape == (5, 3)
+    assert t.concat(t).num_rows == 10
+    parts = t.random_split([0.6, 0.4], seed=0)
+    assert sum(p.num_rows for p in parts) == 5
+
+
+def test_table_shard_padding():
+    t = Table({"a": np.arange(10)})
+    shards = t.shard(4)
+    assert all(s.num_rows == 3 for s in shards)
+
+
+def test_table_pandas_roundtrip():
+    import pandas as pd
+
+    df = pd.DataFrame({"x": [1.0, 2.0], "s": ["a", "b"]})
+    t = Table.from_pandas(df)
+    back = t.to_pandas()
+    assert list(back["s"]) == ["a", "b"]
+
+
+def test_assemble_features():
+    t = Table({"a": np.arange(4.0), "b": np.ones((4, 2))})
+    out = assemble_features(t, ["a", "b"])
+    assert out["features"].shape == (4, 3)
+
+
+class _AddOne(Transformer):
+    def _transform(self, df):
+        return df.with_column("out", df["x"] + 1)
+
+
+class _MeanFit(Estimator):
+    def _fit(self, df):
+        m = float(np.mean(df["x"]))
+
+        class _M(Transformer):
+            def _transform(self, inner):
+                return inner.with_column("centered", inner["x"] - m)
+
+        return _M()
+
+
+def test_pipeline_fit_transform():
+    df = Table({"x": np.arange(6.0)})
+    pipe = Pipeline([_AddOne(), _MeanFit()])
+    model = pipe.fit(df)
+    out = model.transform(df)
+    assert "out" in out and "centered" in out
+    assert abs(float(np.mean(out["centered"]))) < 1e-6
+
+
+def test_stage_save_load(tmp_path):
+    t = _AddOne()
+    p = str(tmp_path / "stage")
+    t.save(p)
+    loaded = PipelineStage.load(p)
+    assert type(loaded).__name__ == "_AddOne"
+    out = loaded.transform(Table({"x": np.arange(3.0)}))
+    assert np.allclose(out["out"], [1, 2, 3])

@@ -1,0 +1,173 @@
+"""GBDT engine unit tests: binning, histograms, grower, objectives, model IO."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from synapseml_tpu.gbdt import BoosterConfig, train_booster
+from synapseml_tpu.gbdt.boosting import Booster
+from synapseml_tpu.gbdt.grower import GrowerConfig, forest_predict, grow_tree, stack_trees
+from synapseml_tpu.ops.histogram import leaf_histograms
+from synapseml_tpu.ops.quantize import apply_bins, compute_bin_mapper
+
+
+def test_bin_mapper_quantiles():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5000, 3)).astype(np.float32)
+    m = compute_bin_mapper(X, max_bin=64)
+    binned = np.asarray(apply_bins(m, X))
+    assert binned.max() < 64
+    # bins should be roughly balanced for a continuous feature
+    counts = np.bincount(binned[:, 0], minlength=64)
+    nz = counts[counts > 0]
+    assert nz.min() > 15
+
+
+def test_bin_mapper_few_distinct_values():
+    X = np.repeat(np.array([[0.0], [1.0], [2.0]], np.float32), 10, axis=0)
+    m = compute_bin_mapper(X, max_bin=255)
+    binned = np.asarray(apply_bins(m, X)).ravel()
+    assert len(np.unique(binned)) == 3
+
+
+def test_bin_mapper_nan_goes_last():
+    X = np.array([[0.0], [1.0], [np.nan]], np.float32)
+    base = np.linspace(0, 1, 100)[:, None].astype(np.float32)
+    m = compute_bin_mapper(np.concatenate([X, base]), max_bin=16)
+    binned = np.asarray(apply_bins(m, X)).ravel()
+    assert binned[2] == binned.max()
+    assert binned[2] > binned[1] > binned[0]
+
+
+def test_leaf_histogram_matches_numpy():
+    rng = np.random.default_rng(1)
+    n, f, b, leaves = 500, 4, 16, 3
+    binned = rng.integers(0, b, size=(n, f)).astype(np.uint8)
+    node = rng.integers(0, leaves, size=n).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.uniform(0.1, 1, size=n).astype(np.float32)
+    hist = np.asarray(leaf_histograms(jnp.asarray(binned), jnp.asarray(node),
+                                      jnp.asarray(g), jnp.asarray(h), leaves, b))
+    for leaf in range(leaves):
+        for feat in range(f):
+            mask = node == leaf
+            expect_g = np.bincount(binned[mask, feat], weights=g[mask], minlength=b)
+            np.testing.assert_allclose(hist[leaf, feat, :, 0], expect_g, rtol=1e-4, atol=1e-4)
+    # count channel sums to n for every feature
+    assert np.allclose(hist[..., 2].sum(axis=(0, 2)), n)
+
+
+def test_grow_tree_perfect_split():
+    """A single feature perfectly separating labels must be found."""
+    n = 200
+    X = np.linspace(0, 1, n)[:, None].astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    # max_bin > #distinct values → midpoint boundaries → the exact 0.5 split exists
+    m = compute_bin_mapper(X, max_bin=255)
+    binned = apply_bins(m, X)
+    g = jnp.asarray(0.5 - y)   # logistic grad at score 0
+    h = jnp.full(n, 0.25)
+    cfg = GrowerConfig(num_leaves=4, num_bins=255, min_data_in_leaf=5)
+    tree, node = grow_tree(binned, g, h, jnp.ones(n), jnp.ones(1, bool),
+                           jnp.zeros(1, bool), jnp.zeros(1, jnp.int32), cfg)
+    assert int(tree.num_splits) >= 1
+    # first split must be on feature 0 near the middle
+    assert int(tree.split_feature[0]) == 0
+    node = np.asarray(node)
+    # left group gets positive leaf value (negative grad sum → pulls up)
+    vals = np.asarray(tree.leaf_value)[node]
+    assert (vals[y == 1] > 0).all() and (vals[y == 0] < 0).all()
+
+
+def test_monotone_constraint_enforced():
+    rng = np.random.default_rng(2)
+    n = 2000
+    X = rng.uniform(size=(n, 1)).astype(np.float32)
+    y = np.sin(X[:, 0] * 6).astype(np.float32)    # non-monotone target
+    cfg = BoosterConfig(objective="regression", num_iterations=20,
+                        monotone_constraints=[1])
+    bst = train_booster(X, y, cfg)
+    grid = np.linspace(0.01, 0.99, 50)[:, None].astype(np.float32)
+    pred = bst.predict(grid)
+    assert (np.diff(pred) >= -1e-6).all()
+
+
+def test_categorical_split():
+    rng = np.random.default_rng(3)
+    n = 2000
+    cats = rng.integers(0, 10, size=n)
+    y = np.isin(cats, [2, 5, 7]).astype(np.float32)   # value only via subset
+    X = np.stack([cats.astype(np.float32), rng.normal(size=n).astype(np.float32)], 1)
+    cfg = BoosterConfig(objective="binary", num_iterations=10)
+    bst = train_booster(X, y, cfg, categorical_features=[0])
+    p = bst.predict(X)
+    assert ((p > 0.5) == (y > 0.5)).mean() > 0.99
+
+
+def test_objectives_gradient_check():
+    from synapseml_tpu.gbdt.objectives import get_objective
+
+    rng = np.random.default_rng(4)
+    score = jnp.asarray(rng.normal(size=50).astype(np.float32))
+    w = jnp.ones(50)
+    for name, y in [
+        ("binary", (rng.uniform(size=50) > 0.5).astype(np.float32)),
+        ("regression", rng.normal(size=50).astype(np.float32)),
+        ("poisson", rng.poisson(3.0, size=50).astype(np.float32)),
+        ("tweedie", rng.gamma(2.0, size=50).astype(np.float32)),
+    ]:
+        import jax
+
+        obj = get_objective(name, num_class=1)
+        loss = {
+            "binary": lambda s: -jnp.mean(yj * jax.nn.log_sigmoid(s)
+                                          + (1 - yj) * jax.nn.log_sigmoid(-s)) * 50,
+            "regression": lambda s: 0.5 * jnp.sum((s - yj) ** 2),
+            "poisson": lambda s: jnp.sum(jnp.exp(s) - yj * s),
+            "tweedie": lambda s: jnp.sum(-yj * jnp.exp((1 - 1.5) * s) / (1 - 1.5)
+                                         + jnp.exp((2 - 1.5) * s) / (2 - 1.5)),
+        }[name]
+        yj = jnp.asarray(y)
+        g_expect = jax.grad(loss)(score)
+        g, h = obj.grad_hess(score, yj, w)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_expect), rtol=2e-3, atol=2e-3)
+        assert (np.asarray(h) > 0).all()
+
+
+def test_model_string_roundtrip(binary_data):
+    Xtr, Xte, ytr, yte = binary_data
+    cfg = BoosterConfig(objective="binary", num_iterations=10)
+    bst = train_booster(Xtr, ytr, cfg)
+    s = bst.model_string()
+    assert s.startswith("tree\nversion=v3")
+    b2 = Booster.from_model_string(s)
+    np.testing.assert_allclose(b2.predict(Xte), bst.predict(Xte), atol=1e-5)
+
+
+def test_feature_importances(binary_data):
+    Xtr, _, ytr, _ = binary_data
+    bst = train_booster(Xtr, ytr, BoosterConfig(objective="binary", num_iterations=5))
+    imp = bst.feature_importances("split")
+    assert imp.sum() > 0 and (imp >= 0).all()
+    gain = bst.feature_importances("gain")
+    assert gain.sum() > 0
+
+
+def test_shap_additivity(binary_data):
+    Xtr, Xte, ytr, _ = binary_data
+    bst = train_booster(Xtr, ytr, BoosterConfig(objective="binary", num_iterations=10))
+    sh = bst.feature_shap(Xte[:20])
+    raw = bst.raw_score(Xte[:20])
+    np.testing.assert_allclose(sh.sum(axis=1), raw, atol=1e-4)
+
+
+def test_warm_start_continues(binary_data):
+    Xtr, Xte, ytr, yte = binary_data
+    cfg = BoosterConfig(objective="binary", num_iterations=5)
+    b1 = train_booster(Xtr, ytr, cfg)
+    b2 = train_booster(Xtr, ytr, BoosterConfig(objective="binary", num_iterations=5),
+                       init_model=b1)
+    assert b2.num_trees == 10
+    from sklearn.metrics import log_loss
+
+    assert log_loss(yte, b2.predict(Xte)) < log_loss(yte, b1.predict(Xte))

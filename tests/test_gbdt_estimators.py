@@ -1,0 +1,195 @@
+"""Estimator-level tests incl. accuracy regression vs the reference's tolerance
+CSVs (benchmarks_VerifyLightGBMClassifierStreamBasic.csv — the breast-cancer AUC
+row is 0.9920 ±0.1 per boosting type; we check the sklearn breast-cancer dataset
+against the same bar)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import PipelineStage, Table, assemble_features
+from synapseml_tpu.models import (LightGBMClassifier, LightGBMRanker,
+                                  LightGBMRegressor)
+
+
+def _as_table(X, y, extra=None):
+    t = Table({"features": np.asarray(X, np.float32), "label": np.asarray(y, np.float32)})
+    if extra:
+        for k, v in extra.items():
+            t[k] = v
+    return t
+
+
+# reference: lightgbm/src/test/resources/benchmarks/benchmarks_VerifyLightGBMClassifierStreamBasic.csv
+# breast-cancer rows: gbdt 0.9920, rf 0.9874, dart 0.9898, goss 0.9920, precision 0.1
+REFERENCE_BREAST_CANCER_AUC = {"gbdt": 0.9920, "rf": 0.9874, "dart": 0.9898, "goss": 0.9920}
+TOLERANCE = 0.1
+
+
+@pytest.mark.parametrize("boosting", ["gbdt", "rf", "dart", "goss"])
+def test_classifier_auc_vs_reference(binary_data, boosting):
+    from sklearn.metrics import roc_auc_score
+
+    Xtr, Xte, ytr, yte = binary_data
+    clf = LightGBMClassifier(boostingType=boosting, numIterations=30,
+                             baggingFraction=0.8, baggingFreq=1, seed=42)
+    model = clf.fit(_as_table(Xtr, ytr))
+    out = model.transform(_as_table(Xte, yte))
+    auc = roc_auc_score(yte, out["probability"][:, 1])
+    assert auc >= REFERENCE_BREAST_CANCER_AUC[boosting] - TOLERANCE
+    # prediction column consistent with probability argmax
+    assert np.array_equal(out["prediction"], out["probability"].argmax(1))
+
+
+def test_classifier_multiclass():
+    from sklearn.datasets import load_iris
+
+    X, y = load_iris(return_X_y=True)
+    model = LightGBMClassifier(numIterations=30).fit(_as_table(X, y))
+    out = model.transform(_as_table(X, y))
+    assert out["probability"].shape == (len(y), 3)
+    assert (out["prediction"] == y).mean() > 0.95
+
+
+def test_classifier_weights_and_unbalance(binary_data):
+    Xtr, Xte, ytr, yte = binary_data
+    w = np.where(ytr > 0, 2.0, 1.0).astype(np.float32)
+    m = LightGBMClassifier(numIterations=10, weightCol="w", isUnbalance=True).fit(
+        _as_table(Xtr, ytr, {"w": w}))
+    out = m.transform(_as_table(Xte, yte))
+    assert out["probability"].shape[1] == 2
+
+
+def test_classifier_validation_early_stopping(binary_data):
+    Xtr, Xte, ytr, yte = binary_data
+    n = len(ytr)
+    vmask = np.zeros(n, bool)
+    vmask[: n // 4] = True
+    clf = LightGBMClassifier(numIterations=300, earlyStoppingRound=5,
+                             validationIndicatorCol="isVal")
+    model = clf.fit(_as_table(Xtr, ytr, {"isVal": vmask}))
+    assert model.booster.num_trees < 300
+
+
+def test_regressor_rmse(regression_data):
+    Xtr, Xte, ytr, yte = regression_data
+    m = LightGBMRegressor(numIterations=100).fit(_as_table(Xtr, ytr))
+    pred = m.transform(_as_table(Xte, yte))["prediction"]
+    rmse = float(np.sqrt(np.mean((pred - yte) ** 2)))
+    assert rmse < np.std(yte)          # clearly better than predicting the mean
+
+
+@pytest.mark.parametrize("objective", ["regression_l1", "huber", "quantile", "poisson", "tweedie"])
+def test_regressor_objectives(objective):
+    rng = np.random.default_rng(0)
+    X = rng.uniform(size=(500, 3)).astype(np.float32)
+    y = (2 * X[:, 0] + X[:, 1] + 0.05 * rng.normal(size=500)).astype(np.float32)
+    if objective in ("poisson", "tweedie"):
+        y = np.exp(y * 0.3).astype(np.float32)
+    # alpha=0.5 for quantile (median): the default 0.9 converges slowly by design
+    m = LightGBMRegressor(objective=objective, numIterations=60,
+                          alpha=0.5 if objective == "quantile" else 0.9).fit(_as_table(X, y))
+    pred = m.transform(_as_table(X, y))["prediction"]
+    assert np.corrcoef(pred, y)[0, 1] > 0.8
+
+
+def test_ranker_ndcg_improves():
+    rng = np.random.default_rng(1)
+    num_groups, per_group = 40, 12
+    n = num_groups * per_group
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    rel = np.clip((X[:, 0] + 0.3 * rng.normal(size=n)) * 2 + 2, 0, 4).astype(np.float32)
+    gid = np.repeat(np.arange(num_groups), per_group)
+    t = _as_table(X, rel.round(), {"group": gid})
+    m = LightGBMRanker(groupCol="group", numIterations=30).fit(t)
+    scores = m.transform(t)["prediction"]
+    # scores must order items within groups by relevance better than random
+    from scipy.stats import spearmanr
+
+    rho = np.mean([spearmanr(scores[gid == g], rel[gid == g]).statistic
+                   for g in range(num_groups)])
+    assert rho > 0.5
+
+
+def test_model_save_load_native(tmp_path, binary_data):
+    Xtr, Xte, ytr, yte = binary_data
+    model = LightGBMClassifier(numIterations=10).fit(_as_table(Xtr, ytr))
+    p = str(tmp_path / "model.txt")
+    model.saveNativeModel(p)
+    with open(p) as f:
+        assert f.read().startswith("tree\n")
+
+
+def test_model_stage_save_load(tmp_path, binary_data):
+    Xtr, Xte, ytr, yte = binary_data
+    model = LightGBMClassifier(numIterations=10).fit(_as_table(Xtr, ytr))
+    p1 = model.transform(_as_table(Xte, yte))["probability"]
+    path = str(tmp_path / "stage")
+    model.save(path)
+    loaded = PipelineStage.load(path)
+    p2 = loaded.transform(_as_table(Xte, yte))["probability"]
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+
+def test_leaf_and_shap_output_cols(binary_data):
+    Xtr, Xte, ytr, yte = binary_data
+    model = LightGBMClassifier(numIterations=5, leafPredictionCol="leaves",
+                               featuresShapCol="shap").fit(_as_table(Xtr, ytr))
+    out = model.transform(_as_table(Xte[:10], yte[:10]))
+    assert out["leaves"].shape == (10, 5)
+    assert out["shap"].shape == (10, Xtr.shape[1] + 1)
+
+
+def test_num_batches_warm_start(binary_data):
+    from sklearn.metrics import roc_auc_score
+
+    Xtr, Xte, ytr, yte = binary_data
+    m = LightGBMClassifier(numIterations=10, numBatches=2).fit(_as_table(Xtr, ytr))
+    assert m.booster.num_trees == 20       # 2 batches × 10 iterations
+    out = m.transform(_as_table(Xte, yte))
+    assert roc_auc_score(yte, out["probability"][:, 1]) > 0.9
+
+
+def test_pass_through_args(binary_data):
+    Xtr, _, ytr, _ = binary_data
+    m = LightGBMClassifier(numIterations=5,
+                           passThroughArgs="num_leaves=7 lambda_l2=3.5").fit(_as_table(Xtr, ytr))
+    assert m.booster.config.num_leaves == 7
+    assert m.booster.config.lambda_l2 == 3.5
+
+
+def test_feature_importances_surface(binary_data):
+    Xtr, _, ytr, _ = binary_data
+    m = LightGBMClassifier(numIterations=5).fit(_as_table(Xtr, ytr))
+    imp = m.getFeatureImportances()
+    assert len(imp) == Xtr.shape[1]
+
+
+def test_noncontiguous_labels_roundtrip(binary_data):
+    """Labels {3, 7} must train correctly and predict original values
+    (code-review regression: objectives assume 0..K-1)."""
+    Xtr, Xte, ytr, yte = binary_data
+    y2 = np.where(ytr > 0, 7.0, 3.0)
+    m = LightGBMClassifier(numIterations=10).fit(_as_table(Xtr, y2))
+    out = m.transform(_as_table(Xte, np.where(yte > 0, 7.0, 3.0)))
+    assert set(np.unique(out["prediction"])) <= {3.0, 7.0}
+    acc = (out["prediction"] == np.where(yte > 0, 7.0, 3.0)).mean()
+    assert acc > 0.9
+
+
+def test_dart_warm_start(binary_data):
+    """DART + numBatches warm start must not corrupt drop bookkeeping
+    (code-review regression: tree_contribs/trees index misalignment)."""
+    Xtr, Xte, ytr, yte = binary_data
+    m = LightGBMClassifier(boostingType="dart", numIterations=8, numBatches=2,
+                           dropRate=0.5, seed=0).fit(_as_table(Xtr, ytr))
+    assert m.booster.num_trees == 16
+    from sklearn.metrics import roc_auc_score
+
+    out = m.transform(_as_table(Xte, yte))
+    assert roc_auc_score(yte, out["probability"][:, 1]) > 0.9
+
+
+def test_rf_without_bagging_rejected(binary_data):
+    Xtr, _, ytr, _ = binary_data
+    with pytest.raises(ValueError, match="rf"):
+        LightGBMClassifier(boostingType="rf", numIterations=5).fit(_as_table(Xtr, ytr))

@@ -1,0 +1,4 @@
+from .backbones import BACKBONES, ResNet, TinyCNN, make_backbone, resnet18, resnet50  # noqa: F401
+from .trainer import FlaxTrainer, TrainConfig, freeze_mask  # noqa: F401
+from .vision import DeepVisionClassifier, DeepVisionModel  # noqa: F401
+from .text import DeepTextClassifier, DeepTextModel, TransformerEncoder, hash_tokenize  # noqa: F401

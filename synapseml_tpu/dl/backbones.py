@@ -1,0 +1,156 @@
+"""Flax vision backbones.
+
+The reference's DeepVisionClassifier wraps torchvision backbones
+(deep-learning/src/main/python/synapse/ml/dl/LitDeepVisionModel.py:56-110:
+resnet/mobilenet families with the classifier head swapped and earlier layers
+optionally frozen). Here the backbones are native Flax modules designed for TPU:
+NHWC layouts, bfloat16-friendly, BatchNorm with mutable batch_stats, so XLA maps
+convs straight onto the MXU.
+
+Pretrained weights: the reference downloads torchvision checkpoints at fit time;
+this framework accepts a local checkpoint (``pretrained_path`` — an .npz/msgpack
+of params) instead, since weight download is an environment concern, not a
+framework one.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       dtype=self.dtype)
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), (self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       dtype=self.dtype)
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1), (self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet; ``num_classes=0`` → headless feature extractor (the
+    ImageFeaturizer use case, reference onnx/ImageFeaturizer.scala)."""
+
+    stage_sizes: Sequence[int]
+    block: Any
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.float32
+    small_images: bool = False    # CIFAR-style stem (3x3, no max-pool)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.small_images:
+            x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype, name="stem_conv")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="stem_bn")(x)
+        x = nn.relu(x)
+        if not self.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, size in enumerate(self.stage_sizes):
+            for j in range(size):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(self.width * 2 ** i, strides, self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))            # global average pool
+        if self.num_classes:
+            x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+def resnet18(num_classes=1000, **kw):
+    return ResNet([2, 2, 2, 2], ResNetBlock, num_classes, **kw)
+
+
+def resnet34(num_classes=1000, **kw):
+    return ResNet([3, 4, 6, 3], ResNetBlock, num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw):
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes, **kw)
+
+
+def resnet101(num_classes=1000, **kw):
+    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes, **kw)
+
+
+class TinyCNN(nn.Module):
+    """Small fast backbone for tests (the fake-backend analog of the reference's
+    CallbackBackend DL tests — deep-learning/src/test/python/.../conftest.py)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(16, (3, 3), (2, 2), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(32, (3, 3), (2, 2), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+BACKBONES: dict = {
+    "resnet18": resnet18,
+    "resnet34": resnet34,
+    "resnet50": resnet50,
+    "resnet101": resnet101,
+    "tiny": lambda num_classes=10, **kw: TinyCNN(num_classes=num_classes),
+}
+
+
+def make_backbone(name: str, num_classes: int, dtype=jnp.float32,
+                  small_images: bool = False):
+    if name not in BACKBONES:
+        raise ValueError(f"unknown backbone {name!r}; available: {sorted(BACKBONES)}")
+    if name == "tiny":
+        return BACKBONES[name](num_classes=num_classes)
+    return BACKBONES[name](num_classes=num_classes, dtype=dtype, small_images=small_images)

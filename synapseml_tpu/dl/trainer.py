@@ -1,0 +1,266 @@
+"""Flax fine-tune engine — the Horovod/Lightning replacement.
+
+The reference trains via horovod.spark.lightning TorchEstimator: one process per
+executor, NCCL ring allreduce of gradients, petastorm reader feeding torch
+DataLoaders (SURVEY.md §3.4). On TPU the whole stack collapses to one jitted
+train step over a named-axis mesh: the batch is sharded on ``data``, parameters
+are replicated (or sharded on ``model`` for TP — free generality the reference
+lacks, SURVEY §2.2 "NOT PRESENT"), and XLA inserts the gradient psum over ICI.
+
+Layer freezing mirrors LitDeepVisionModel._update_transfer_learning
+(reference LitDeepVisionModel.py:56-110): a regex over parameter paths selects
+trainable leaves; frozen leaves get zero updates via optax.masked.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax.core import freeze, unfreeze
+from flax import traverse_util
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    batch_size: int = 64
+    max_epochs: int = 1
+    learning_rate: float = 1e-3
+    weight_decay: float = 0.0
+    optimizer: str = "adam"            # adam | adamw | sgd | momentum
+    lr_schedule: str = "constant"      # constant | cosine
+    warmup_steps: int = 0
+    grad_clip_norm: float = 0.0
+    freeze_regex: Optional[str] = None  # param paths matching this are frozen
+    compute_dtype: str = "float32"     # float32 | bfloat16
+    seed: int = 0
+    shuffle: bool = True
+    steps_per_epoch: Optional[int] = None
+
+
+def _make_tx(cfg: TrainConfig, total_steps: int, trainable_mask=None):
+    if cfg.lr_schedule == "cosine":
+        sched = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.learning_rate, max(cfg.warmup_steps, 1),
+            max(total_steps, cfg.warmup_steps + 1))
+    else:
+        sched = optax.linear_schedule(cfg.learning_rate, cfg.learning_rate, 1) \
+            if cfg.warmup_steps == 0 else optax.warmup_cosine_decay_schedule(
+                0.0, cfg.learning_rate, cfg.warmup_steps, total_steps, cfg.learning_rate)
+    opts = {
+        "adam": lambda: optax.adam(sched),
+        "adamw": lambda: optax.adamw(sched, weight_decay=cfg.weight_decay),
+        "sgd": lambda: optax.sgd(sched),
+        "momentum": lambda: optax.sgd(sched, momentum=0.9),
+    }
+    if cfg.optimizer not in opts:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    tx = opts[cfg.optimizer]()
+    if cfg.grad_clip_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+    if trainable_mask is not None:
+        tx = optax.chain(optax.masked(optax.set_to_zero(),
+                                      jax.tree.map(lambda t: not t, trainable_mask)), tx)
+    return tx
+
+
+def freeze_mask(params, freeze_regex: Optional[str]):
+    """True = trainable. Paths are '/'-joined flax param paths."""
+    if not freeze_regex:
+        return None
+    pat = re.compile(freeze_regex)
+    flat = traverse_util.flatten_dict(unfreeze(params))
+    mask = {k: not pat.search("/".join(str(p) for p in k)) for k in flat}
+    return traverse_util.unflatten_dict(mask)
+
+
+class FlaxTrainer:
+    """Generic supervised fine-tune loop for a flax module with optional
+    BatchNorm state. Loss: softmax CE (classification) or MSE (labels float &
+    num_classes==1)."""
+
+    def __init__(self, model, config: TrainConfig, mesh: Optional[Mesh] = None,
+                 loss: str = "softmax"):
+        self.model = model
+        self.cfg = config
+        self.mesh = mesh
+        self.loss = loss
+        self.params = None
+        self.batch_stats = None
+
+    # --- setup ----------------------------------------------------------
+    def init(self, sample_x):
+        rng = jax.random.PRNGKey(self.cfg.seed)
+        variables = self.model.init(rng, jnp.asarray(sample_x[:1]), train=False)
+        self.params = variables["params"]
+        self.batch_stats = variables.get("batch_stats", {})
+        return self
+
+    def load_params(self, params, batch_stats=None):
+        self.params = params
+        if batch_stats is not None:
+            self.batch_stats = batch_stats
+        return self
+
+    # --- data -----------------------------------------------------------
+    def _batches(self, X, y, rng: np.random.Generator) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        n = len(X)
+        if n == 0:
+            raise ValueError("cannot train on an empty dataset")
+        idx = rng.permutation(n) if self.cfg.shuffle else np.arange(n)
+        bs = self.cfg.batch_size
+        if n < bs:
+            # fewer rows than one batch: train on all of them each step
+            yield X[idx], y[idx]
+            return
+        limit = self.cfg.steps_per_epoch
+        for s, start in enumerate(range(0, n - bs + 1, bs)):
+            if limit and s >= limit:
+                return
+            sel = idx[start: start + bs]
+            yield X[sel], y[sel]
+
+    def _shard(self, arr):
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        spec = P(DATA_AXIS, *([None] * (np.ndim(arr) - 1)))
+        return jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
+
+    # --- train ----------------------------------------------------------
+    def fit(self, X, y, valid: Optional[tuple] = None,
+            log_fn: Optional[Callable] = None):
+        cfg = self.cfg
+        X = np.asarray(X)
+        y = np.asarray(y)
+        if self.params is None:
+            self.init(X)
+        n = len(X)
+        steps_per_epoch = cfg.steps_per_epoch or max(n // cfg.batch_size, 1)
+        total_steps = steps_per_epoch * cfg.max_epochs
+        mask = freeze_mask(self.params, cfg.freeze_regex)
+        tx = _make_tx(cfg, total_steps, mask)
+        opt_state = tx.init(self.params)
+
+        compute_dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+        has_bn = bool(self.batch_stats)
+        model, loss_kind = self.model, self.loss
+
+        def cast_in(xb):
+            # only float inputs get the compute dtype; integer token ids must
+            # stay integral for embedding lookups
+            return xb.astype(compute_dtype) if jnp.issubdtype(xb.dtype, jnp.floating) else xb
+
+        def loss_fn(params, batch_stats, xb, yb, rng):
+            variables = {"params": params}
+            rngs = {"dropout": rng}
+            if has_bn:
+                variables["batch_stats"] = batch_stats
+                logits, mutated = model.apply(variables, cast_in(xb),
+                                              train=True, mutable=["batch_stats"],
+                                              rngs=rngs)
+                new_bs = mutated["batch_stats"]
+            else:
+                logits = model.apply(variables, cast_in(xb), train=True, rngs=rngs)
+                new_bs = batch_stats
+            logits = logits.astype(jnp.float32)
+            if loss_kind == "softmax":
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb.astype(jnp.int32)).mean()
+                acc = (logits.argmax(-1) == yb).mean()
+            else:
+                loss = jnp.mean((logits.squeeze(-1) - yb) ** 2)
+                acc = -loss
+            return loss, (new_bs, acc)
+
+        @jax.jit
+        def train_step(params, batch_stats, opt_state, xb, yb, step):
+            rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), step)
+            (loss, (new_bs, acc)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch_stats, xb, yb, rng)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_bs, opt_state, loss, acc
+
+        params, batch_stats = self.params, self.batch_stats
+        rng = np.random.default_rng(cfg.seed)
+        history = []
+        step_idx = 0
+        for epoch in range(cfg.max_epochs):
+            losses = []
+            for xb, yb in self._batches(X, y, rng):
+                xb, yb = self._shard(xb), self._shard(yb)
+                params, batch_stats, opt_state, loss, acc = train_step(
+                    params, batch_stats, opt_state, xb, yb, step_idx)
+                step_idx += 1
+                losses.append(loss)
+            ep = {"epoch": epoch, "loss": float(np.mean([float(l) for l in losses]))}
+            if valid is not None:
+                ep["val_acc"] = float(self.evaluate(valid[0], valid[1],
+                                                    params=params, batch_stats=batch_stats))
+            history.append(ep)
+            if log_fn:
+                log_fn(ep)
+        self.params, self.batch_stats = params, batch_stats
+        self.history = history
+        return self
+
+    # --- eval / predict ---------------------------------------------------
+    def _forward_fn(self):
+        # one jitted forward per trainer (variables passed as an argument so the
+        # compile cache survives across predict calls and param updates)
+        if not hasattr(self, "_fwd_cached"):
+            model = self.model
+
+            @jax.jit
+            def fwd(variables, xb):
+                return model.apply(variables, xb, train=False).astype(jnp.float32)
+
+            self._fwd_cached = fwd
+        return self._fwd_cached
+
+    def predict_logits(self, X, batch_size: Optional[int] = None,
+                       params=None, batch_stats=None):
+        params = self.params if params is None else params
+        batch_stats = self.batch_stats if batch_stats is None else batch_stats
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        fwd_v = self._forward_fn()
+
+        def fwd(xb):
+            return fwd_v(variables, xb)
+
+        bs = batch_size or self.cfg.batch_size
+        outs = []
+        X = np.asarray(X)
+        for start in range(0, len(X), bs):
+            xb = X[start: start + bs]
+            pad = 0
+            if len(xb) < bs and len(outs):   # keep shapes static for the jit cache
+                pad = bs - len(xb)
+                xb = np.concatenate([xb, np.repeat(xb[-1:], pad, axis=0)])
+            o = np.asarray(fwd(jnp.asarray(xb)))
+            outs.append(o[: len(o) - pad] if pad else o)
+        return np.concatenate(outs)
+
+    def evaluate(self, X, y, params=None, batch_stats=None) -> float:
+        logits = self.predict_logits(X, params=params, batch_stats=batch_stats)
+        if self.loss == "softmax":
+            return float((logits.argmax(-1) == np.asarray(y)).mean())
+        return -float(np.mean((logits.squeeze(-1) - np.asarray(y)) ** 2))
+
+
+def softmax_np(logits: np.ndarray) -> np.ndarray:
+    """Numerically-stable softmax on host arrays (shared by the DL model
+    transforms)."""
+    z = logits - logits.max(-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(-1, keepdims=True)

@@ -1,0 +1,130 @@
+"""Image decode + TPU-resident preprocessing ops.
+
+Parity target: the reference's OpenCV-backed ImageTransformer stage set
+(opencv/src/main/scala/.../ImageTransformer.scala:31-283 — ResizeImage,
+CropImage, CenterCropImage, ColorFormat, Flip, Blur, Threshold, GaussianKernel;
+CHW tensor conversion + per-channel normalization at :654-684). Decode runs
+host-side (PIL / torchvision io); everything after decode is jax so the tensors
+land on-device and fuse — the "feed TPU directly" north star of SURVEY §2.1 N4.
+
+All device ops operate on float32 NHWC batches in [0,1].
+"""
+
+from __future__ import annotations
+
+import io
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# host-side decode (ImageTransformer's decode modes :702-710: image schema /
+# binary file / raw bytes)
+# --------------------------------------------------------------------------
+
+def decode_image_bytes(data: bytes, size: Optional[int] = None) -> np.ndarray:
+    """JPEG/PNG bytes → HWC uint8 RGB."""
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    if size:
+        img = img.resize((size, size), Image.BILINEAR)
+    return np.asarray(img, np.uint8)
+
+
+def decode_image_files(paths: Sequence[str], size: Optional[int] = None) -> np.ndarray:
+    imgs = [decode_image_bytes(open(p, "rb").read(), size) for p in paths]
+    if size is None:
+        shapes = {im.shape for im in imgs}
+        if len(shapes) > 1:
+            raise ValueError(f"images have mixed shapes {shapes}; pass a resize size")
+    return np.stack(imgs)
+
+
+# --------------------------------------------------------------------------
+# device-side ops (jit; NHWC float32)
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("height", "width", "method"))
+def resize(images: jnp.ndarray, height: int, width: int, method: str = "bilinear"):
+    """ResizeImage analog (ImageTransformer.scala:88-118)."""
+    n, _, _, c = images.shape
+    return jax.image.resize(images, (n, height, width, c), method=method)
+
+
+@partial(jax.jit, static_argnames=("x", "y", "height", "width"))
+def crop(images: jnp.ndarray, x: int, y: int, height: int, width: int):
+    """CropImage analog (:120-149): rectangle at (x, y)."""
+    return jax.lax.dynamic_slice(images, (0, y, x, 0),
+                                 (images.shape[0], height, width, images.shape[3]))
+
+
+@partial(jax.jit, static_argnames=("height", "width"))
+def center_crop(images: jnp.ndarray, height: int, width: int):
+    """CenterCropImage analog (:151-180)."""
+    h, w = images.shape[1], images.shape[2]
+    y = max((h - height) // 2, 0)
+    x = max((w - width) // 2, 0)
+    return crop(images, x, y, min(height, h), min(width, w))
+
+
+@partial(jax.jit, static_argnames=("flip_code",))
+def flip(images: jnp.ndarray, flip_code: int = 1):
+    """Flip analog (:216-235). OpenCV codes: 0 vertical, >0 horizontal, <0 both."""
+    if flip_code == 0:
+        return images[:, ::-1]
+    if flip_code > 0:
+        return images[:, :, ::-1]
+    return images[:, ::-1, ::-1]
+
+
+def gaussian_kernel(aperture: int, sigma: float) -> jnp.ndarray:
+    """GaussianKernel analog (:260-283)."""
+    r = (aperture - 1) / 2.0
+    xs = jnp.arange(aperture) - r
+    k1 = jnp.exp(-(xs ** 2) / (2 * sigma ** 2))
+    k = jnp.outer(k1, k1)
+    return k / k.sum()
+
+
+@partial(jax.jit, static_argnames=("ksize",))
+def blur(images: jnp.ndarray, ksize: int = 3, sigma: float = 1.0):
+    """Blur analog (:182-199) as a depthwise gaussian conv (MXU-friendly)."""
+    k = gaussian_kernel(ksize, sigma)
+    c = images.shape[-1]
+    kern = jnp.tile(k[:, :, None, None], (1, 1, 1, c))   # HWIO, feature_group=c
+    return jax.lax.conv_general_dilated(
+        images, kern, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c)
+
+
+@jax.jit
+def threshold(images: jnp.ndarray, thresh: float, maxval: float = 1.0):
+    """Threshold analog (:237-258), THRESH_BINARY."""
+    return jnp.where(images > thresh, maxval, 0.0)
+
+
+@jax.jit
+def color_to_gray(images: jnp.ndarray):
+    """ColorFormat(GRAY) analog (:201-214), ITU-R 601 luma."""
+    w = jnp.array([0.299, 0.587, 0.114], images.dtype)
+    return (images * w[None, None, None, :]).sum(-1, keepdims=True)
+
+
+@jax.jit
+def normalize(images: jnp.ndarray, mean, std, scale: float = 1.0):
+    """Per-channel normalize + global scale (tensor output path :654-684)."""
+    mean = jnp.asarray(mean, images.dtype)
+    std = jnp.asarray(std, images.dtype)
+    return (images * scale - mean[None, None, None, :]) / std[None, None, None, :]
+
+
+@jax.jit
+def to_chw(images: jnp.ndarray):
+    """NHWC → NCHW tensor output (toTensor path :654-684). On TPU NHWC is the
+    native layout; CHW is provided for reference-schema compatibility only."""
+    return jnp.transpose(images, (0, 3, 1, 2))

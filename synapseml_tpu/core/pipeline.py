@@ -47,6 +47,7 @@ class PipelineStage(Params, SynapseMLLogging):
         }
         with open(os.path.join(path, _META_FILE), "w") as f:
             json.dump(meta, f, indent=1, default=_json_default)
+        self._save_complex_params(path)
         self._save_extra(path)
 
     @staticmethod
@@ -61,6 +62,7 @@ class PipelineStage(Params, SynapseMLLogging):
             if stage.hasParam(k):
                 stage.set(k, v)
         stage.uid = meta.get("uid", stage.uid)
+        stage._load_complex_params(path)
         stage._load_extra(path)
         return stage
 
@@ -69,6 +71,58 @@ class PipelineStage(Params, SynapseMLLogging):
 
     def _load_extra(self, path: str) -> None:
         pass
+
+    # Complex params (callables, stages, arrays) can't go in metadata.json; they
+    # are pickled per-param — the analog of ComplexParam's own serialization
+    # (reference: core/.../core/serialize/ComplexParam.scala). Values that
+    # cannot pickle are skipped with a warning rather than failing the save.
+    def _save_complex_params(self, path: str) -> None:
+        import warnings
+
+        try:
+            import cloudpickle as pickler
+        except ImportError:  # pragma: no cover
+            import pickle as pickler
+        complex_set = {k: v for k, v in self._paramMap.items()
+                       if self._params[k].is_complex and v is not None}
+        if not complex_set:
+            return
+        saved = []
+        os.makedirs(os.path.join(path, "complexParams"), exist_ok=True)
+        for name, value in complex_set.items():
+            if isinstance(value, PipelineStage):
+                value.save(os.path.join(path, "complexParams", name + ".stage"))
+                saved.append([name, "stage"])
+                continue
+            try:
+                blob = pickler.dumps(value)
+            except Exception as e:  # noqa: BLE001
+                warnings.warn(f"{type(self).__name__}.{name}: not serializable ({e}); "
+                              "set it again after load")
+                continue
+            with open(os.path.join(path, "complexParams", name + ".pkl"), "wb") as f:
+                f.write(blob)
+            saved.append([name, "pickle"])
+        with open(os.path.join(path, "complexParams", "index.json"), "w") as f:
+            json.dump(saved, f)
+
+    def _load_complex_params(self, path: str) -> None:
+        idx_file = os.path.join(path, "complexParams", "index.json")
+        if not os.path.exists(idx_file):
+            return
+        try:
+            import cloudpickle as pickler
+        except ImportError:  # pragma: no cover
+            import pickle as pickler
+        with open(idx_file) as f:
+            saved = json.load(f)
+        for name, kind in saved:
+            if kind == "stage":
+                value = PipelineStage.load(os.path.join(path, "complexParams", name + ".stage"))
+            else:
+                with open(os.path.join(path, "complexParams", name + ".pkl"), "rb") as f:
+                    value = pickler.loads(f.read())
+            self.set(name, value)
 
 
 class Transformer(PipelineStage):

@@ -25,11 +25,14 @@ import numpy as np
 class Table:
     """An ordered mapping of column name → numpy array, all with equal length."""
 
-    __slots__ = ("_cols", "_nrows")
+    __slots__ = ("_cols", "_nrows", "num_shards_hint", "concurrency_hint")
 
     def __init__(self, cols: Optional[Mapping[str, Any]] = None):
         self._cols: dict[str, np.ndarray] = {}
         self._nrows: Optional[int] = None
+        # execution hints attached by Repartition / PartitionConsolidator stages
+        self.num_shards_hint: Optional[int] = None
+        self.concurrency_hint: Optional[int] = None
         if cols:
             for k, v in cols.items():
                 self[k] = v
@@ -150,6 +153,8 @@ class Table:
         t = Table()
         t._cols = dict(self._cols)
         t._nrows = self._nrows
+        t.num_shards_hint = self.num_shards_hint
+        t.concurrency_hint = self.concurrency_hint
         return t
 
     def take(self, indices) -> "Table":

@@ -67,8 +67,11 @@ def _make_tx(cfg: TrainConfig, total_steps: int, trainable_mask=None):
     if cfg.grad_clip_norm > 0:
         tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
     if trainable_mask is not None:
-        tx = optax.chain(optax.masked(optax.set_to_zero(),
-                                      jax.tree.map(lambda t: not t, trainable_mask)), tx)
+        # mask AFTER the optimizer: adamw's weight decay contributes updates
+        # even for zero gradients, so zeroing grads alone lets frozen params
+        # decay — zero the final update on frozen leaves instead
+        frozen = jax.tree.map(lambda t: not t, trainable_mask)
+        tx = optax.chain(tx, optax.masked(optax.set_to_zero(), frozen))
     return tx
 
 
@@ -241,6 +244,9 @@ class FlaxTrainer:
         bs = batch_size or self.cfg.batch_size
         outs = []
         X = np.asarray(X)
+        if len(X) == 0:
+            dummy = np.zeros((1,) + X.shape[1:], X.dtype if X.dtype != object else np.float32)
+            return np.asarray(fwd(jnp.asarray(dummy)))[:0]
         for start in range(0, len(X), bs):
             xb = X[start: start + bs]
             pad = 0

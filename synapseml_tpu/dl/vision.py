@@ -38,12 +38,32 @@ def _resolve_images(col, image_size: Optional[int]) -> np.ndarray:
 
             arr = decode_image_files(list(arr), image_size)
         else:
-            arr = np.stack([np.asarray(a) for a in arr])
+            imgs = [np.asarray(a) for a in arr]
+            if image_size:
+                imgs = [_resize_host(im, image_size) for im in imgs]
+            elif len({im.shape for im in imgs}) > 1:
+                raise ValueError(
+                    "image column contains arrays of differing shapes; set imageSize "
+                    "to resize them to a common size")
+            arr = np.stack(imgs)
+    elif image_size and arr.ndim >= 3 and arr.shape[1] != image_size:
+        arr = np.stack([_resize_host(im, image_size) for im in arr])
     if arr.ndim == 3:
         arr = arr[..., None]
     if arr.dtype == np.uint8:
         arr = arr.astype(np.float32) / 255.0
     return np.ascontiguousarray(arr, np.float32)
+
+
+def _resize_host(img: np.ndarray, size: int) -> np.ndarray:
+    """Bilinear resize of one HWC (or HW) image on host via jax.image (CPU)."""
+    import jax
+
+    if img.shape[:2] == (size, size):
+        return img
+    shape = (size, size) + img.shape[2:]
+    out = jax.image.resize(img.astype(np.float32), shape, method="bilinear")
+    return np.asarray(out)
 
 
 def _normalize(images: np.ndarray) -> np.ndarray:
@@ -107,6 +127,7 @@ class DeepVisionClassifier(Estimator, HasLabelCol, HasPredictionCol):
         m = DeepVisionModel(trainer=trainer, classes=classes)
         m.set("backbone", self.getBackbone())
         m.set("smallImages", self.getSmallImages())
+        m.set("precision", self.getPrecision())
         m._input_shape = list(X.shape[1:])
         for p in ("imageCol", "predictionCol", "imageSize"):
             if self.isSet(p):
@@ -124,7 +145,15 @@ class DeepVisionClassifier(Estimator, HasLabelCol, HasPredictionCol):
                                                       jnp.zeros_like(jnp.asarray(X[:1])),
                                                       train=False))
         top = list(variables["params"].keys())
-        blocks = [t for t in top if "Block" in t]
+        # flax returns dict keys alphabetically (Block_10 < Block_2); order by
+        # the numeric suffix so "trailing k blocks" means network order
+        import re as _re
+
+        def _block_order(name):
+            m = _re.search(r"(\d+)$", name)
+            return int(m.group(1)) if m else -1
+
+        blocks = sorted([t for t in top if "Block" in t], key=_block_order)
         if not blocks or k >= len(blocks):
             return None   # blockless backbone, or unfreeze request covers all blocks
         trainable = set(blocks[len(blocks) - k:] if k else [])
@@ -140,6 +169,7 @@ class DeepVisionModel(Model, HasPredictionCol):
     imageSize = Param("imageSize", "Resize target (square); 0 = as-is", int, 0)
     backbone = Param("backbone", "Backbone name (for reload)", str, "resnet50")
     smallImages = Param("smallImages", "CIFAR-style stem", bool, False)
+    precision = Param("precision", "float32 or bfloat16 compute", str, "float32")
 
     def __init__(self, trainer: Optional[FlaxTrainer] = None,
                  classes: Optional[np.ndarray] = None, **kwargs):
@@ -182,8 +212,9 @@ class DeepVisionModel(Model, HasPredictionCol):
         with open(os.path.join(path, "arch.json")) as f:
             self._input_shape = json.load(f)["input_shape"]
         model = make_backbone(self.getBackbone(), len(self.classes),
+                              dtype=jnp.bfloat16 if self.getPrecision() == "bfloat16" else jnp.float32,
                               small_images=self.getSmallImages())
-        trainer = FlaxTrainer(model, TrainConfig())
+        trainer = FlaxTrainer(model, TrainConfig(compute_dtype=self.getPrecision()))
         trainer.init(np.zeros([1] + list(self._input_shape), np.float32))
         with open(os.path.join(path, "params.msgpack"), "rb") as f:
             blob = from_bytes({"params": trainer.params,

@@ -1,0 +1,32 @@
+"""Generic pipeline stages.
+
+TPU-native analogs of the reference's ``core/.../stages/`` package (21 files,
+SURVEY.md §2.7): mini-batching, flattening, UDF application, repartitioning,
+column plumbing, text preprocessing, summarization, and class balancing —
+re-expressed over the columnar :class:`~synapseml_tpu.core.table.Table` instead
+of Spark DataFrames. Batching here feeds jitted TPU programs (fixed shapes),
+which is why FixedMiniBatchTransformer supports padding to a static batch size.
+"""
+
+from .batchers import (  # noqa: F401
+    DynamicMiniBatchTransformer,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    TimeIntervalMiniBatchTransformer,
+)
+from .basic import (  # noqa: F401
+    Cacher,
+    DropColumns,
+    Explode,
+    Lambda,
+    RenameColumn,
+    SelectColumns,
+    Repartition,
+    Timer,
+    UDFTransformer,
+)
+from .balance import ClassBalancer, ClassBalancerModel, StratifiedRepartition  # noqa: F401
+from .ensemble import EnsembleByKey, PartitionConsolidator  # noqa: F401
+from .text import TextPreprocessor, UnicodeNormalize  # noqa: F401
+from .summarize import SummarizeData  # noqa: F401
+from .adapter import MultiColumnAdapter  # noqa: F401

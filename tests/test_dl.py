@@ -166,3 +166,43 @@ def test_freeze_more_layers_than_blocks_trains_all():
     stem0 = np.asarray(fresh["stem_conv"]["kernel"])
     stem1 = np.asarray(model.trainer.params["stem_conv"]["kernel"])
     assert np.abs(stem0 - stem1).max() > 0   # stem actually trained
+
+
+def test_freeze_regex_orders_blocks_numerically():
+    """Regression: flax returns params alphabetically (Block_10 < Block_2); the
+    trailing-k selection must use network order, not lexical order."""
+    import numpy as np
+
+    from synapseml_tpu.dl import resnet50
+    from synapseml_tpu.dl.vision import DeepVisionClassifier
+
+    est = DeepVisionClassifier(backbone="resnet50", additionalLayersToTrain=2)
+    X = np.zeros((1, 32, 32, 3), np.float32)
+    model = resnet50(num_classes=2)
+    regex = est._freeze_regex(model, X)
+    # resnet50 has 16 bottleneck blocks (0..15); trailing 2 = 14, 15 must train
+    assert "_14" not in regex and "_15" not in regex
+    assert "_13/" in regex or "_13|" in regex or "_13)" in regex or "BottleneckBlock_13" in regex
+
+
+def test_frozen_params_not_decayed_by_adamw():
+    """Regression: weight decay must not update frozen leaves."""
+    import numpy as np
+
+    from synapseml_tpu.dl import FlaxTrainer, TrainConfig, make_backbone
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(size=(16, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 2, 16)
+    cfg = TrainConfig(batch_size=8, max_epochs=2, optimizer="adamw",
+                      weight_decay=0.1, freeze_regex=r"^Conv_0/")
+    tr = FlaxTrainer(make_backbone("tiny", 2), cfg)
+    tr.init(X)
+    import jax
+
+    before = jax.tree.map(np.array, tr.params)
+    tr.fit(X, y.astype(np.float32))
+    after = tr.params
+    frozen_before = before["Conv_0"]["kernel"]
+    frozen_after = np.asarray(after["Conv_0"]["kernel"])
+    np.testing.assert_array_equal(frozen_before, frozen_after)

@@ -1,0 +1,216 @@
+"""Tests for synapseml_tpu.stages (reference test model: core/src/test/scala/
+.../stages/*Suite.scala — functional checks per stage)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import Table
+from synapseml_tpu.stages import (
+    Cacher,
+    ClassBalancer,
+    DropColumns,
+    DynamicMiniBatchTransformer,
+    EnsembleByKey,
+    Explode,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    Lambda,
+    MultiColumnAdapter,
+    PartitionConsolidator,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    SummarizeData,
+    TextPreprocessor,
+    TimeIntervalMiniBatchTransformer,
+    Timer,
+    UDFTransformer,
+    UnicodeNormalize,
+)
+
+
+@pytest.fixture
+def df():
+    return Table({
+        "x": np.arange(10, dtype=np.float32),
+        "y": np.arange(10) % 3,
+        "s": np.asarray([f"w{i}" for i in range(10)], dtype=object),
+    })
+
+
+def test_fixed_minibatch_roundtrip(df):
+    batched = FixedMiniBatchTransformer(batchSize=3).transform(df)
+    assert batched.num_rows == 4  # 3+3+3+1
+    assert len(batched["x"][0]) == 3 and len(batched["x"][3]) == 1
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_array_equal(flat["x"], df["x"])
+    np.testing.assert_array_equal(flat["s"], df["s"])
+
+
+def test_fixed_minibatch_padding(df):
+    batched = FixedMiniBatchTransformer(batchSize=4, padBatches=True).transform(df)
+    assert all(len(b) == 4 for b in batched["x"])
+    flat = FlattenBatch().transform(batched)
+    assert flat.num_rows == 10
+    np.testing.assert_array_equal(flat["x"], df["x"])
+
+
+def test_dynamic_and_time_interval_batchers(df):
+    b1 = DynamicMiniBatchTransformer().transform(df)
+    assert b1.num_rows == 1 and len(b1["x"][0]) == 10
+    b2 = TimeIntervalMiniBatchTransformer(maxBatchSize=6).transform(df)
+    assert [len(b) for b in b2["x"]] == [6, 4]
+
+
+def test_udf_transformer_vectorized(df):
+    t = UDFTransformer(inputCol="x", outputCol="x2").setUDF(lambda x: x * 2)
+    out = t.transform(df)
+    np.testing.assert_allclose(out["x2"], df["x"] * 2)
+
+
+def test_udf_transformer_multi_input_rowwise(df):
+    t = (UDFTransformer(vectorized=False, outputCol="z")
+         .setInputCols(["x", "y"]).setUDF(lambda x, y: float(x) + float(y)))
+    out = t.transform(df)
+    np.testing.assert_allclose(out["z"], df["x"] + df["y"])
+
+
+def test_lambda_and_cacher(df):
+    lam = Lambda().setTransform(lambda t: t.with_column("c", t["x"] + 1))
+    out = Cacher().transform(lam.transform(df))
+    np.testing.assert_allclose(out["c"], df["x"] + 1)
+
+
+def test_timer_wraps_transformer(df):
+    t = Timer().setStage(UDFTransformer(inputCol="x", outputCol="o").setUDF(lambda x: x))
+    out = t.transform(df)
+    assert "o" in out and t.elapsed_transform_s >= 0
+
+
+def test_column_plumbing(df):
+    assert SelectColumns(cols=["x"]).transform(df).columns == ["x"]
+    assert "y" not in DropColumns(cols=["y"]).transform(df)
+    out = RenameColumn(inputCol="x", outputCol="xx").transform(df)
+    assert "xx" in out and "x" not in out
+
+
+def test_explode():
+    df = Table({"k": np.asarray([1, 2]),
+                "v": np.asarray([np.asarray([1, 2, 3]), np.asarray([4])], dtype=object)})
+    out = Explode(inputCol="v").transform(df)
+    np.testing.assert_array_equal(out["k"], [1, 1, 1, 2])
+    np.testing.assert_array_equal(out["v"], [1, 2, 3, 4])
+
+
+def test_class_balancer(df):
+    model = ClassBalancer(inputCol="y").fit(df)
+    out = model.transform(df)
+    # class 0 occurs 4x, classes 1/2 occur 3x → weights 1.0 and 4/3
+    w = out["weight"]
+    np.testing.assert_allclose(w[df["y"] == 0], 1.0)
+    np.testing.assert_allclose(w[df["y"] == 1], 4 / 3)
+
+
+def test_stratified_repartition():
+    labels = np.asarray([0] * 8 + [1] * 2)
+    df = Table({"label": labels, "i": np.arange(10)})
+    out = StratifiedRepartition(mode="original").transform(df)
+    assert out.num_rows == 10
+    # each half (shard) should contain at least one of the minority class
+    halves = [out["label"][:5], out["label"][5:]]
+    assert all((h == 1).any() for h in halves)
+    eq = StratifiedRepartition(mode="equal").transform(df)
+    vals, counts = np.unique(eq["label"], return_counts=True)
+    assert counts[0] == counts[1]
+
+
+def test_ensemble_by_key():
+    df = Table({"k": np.asarray(["a", "a", "b"]),
+                "score": np.asarray([1.0, 3.0, 5.0])})
+    out = EnsembleByKey().setKeys(["k"]).setCols(["score"]).transform(df)
+    m = dict(zip(out["k"], out["mean(score)"]))
+    assert m["a"] == 2.0 and m["b"] == 5.0
+    joined = (EnsembleByKey(collapseGroup=False)
+              .setKeys(["k"]).setCols(["score"]).transform(df))
+    assert joined.num_rows == 3
+    np.testing.assert_allclose(joined["mean(score)"], [2.0, 2.0, 5.0])
+
+
+def test_partition_consolidator(df):
+    out = PartitionConsolidator(numPartitions=2, concurrency=3).transform(df)
+    assert out.num_shards_hint == 2 and out.concurrency_hint == 3
+
+
+def test_repartition(df):
+    out = Repartition(n=4).transform(df)
+    assert out.num_shards_hint == 4
+    shards = out.shard(4)
+    assert len(shards) == 4
+
+
+def test_text_preprocessor():
+    df = Table({"text": np.asarray(["The happy sad"], dtype=object)})
+    t = (TextPreprocessor(inputCol="text", outputCol="out", normFunc="lowercase")
+         .setMap({"happy": "sad", "the": "a"}))
+    assert t.transform(df)["out"][0] == "a sad sad"
+
+
+def test_unicode_normalize():
+    df = Table({"text": np.asarray(["Ça Va"], dtype=object)})
+    out = UnicodeNormalize(inputCol="text", outputCol="n", form="NFKD").transform(df)
+    assert out["n"][0] == "ça va".encode().decode() or "c" in out["n"][0]
+
+
+def test_summarize_data(df):
+    out = SummarizeData().transform(df)
+    feats = list(out["Feature"])
+    assert "x" in feats
+    row = out.filter(out["Feature"] == "x")
+    assert row["Count"][0] == 10
+    np.testing.assert_allclose(row["Mean"][0], 4.5)
+    np.testing.assert_allclose(row["Quantile 50%"][0], 4.5)
+
+
+def test_multi_column_adapter(df):
+    base = UDFTransformer().setUDF(lambda x: x)  # identity unary stage
+    adapter = (MultiColumnAdapter()
+               .setInputCols(["x", "y"]).setOutputCols(["x2", "y2"])
+               .setBaseStage(base))
+    out = adapter.fit(df).transform(df)
+    np.testing.assert_array_equal(out["x2"], df["x"])
+    np.testing.assert_array_equal(out["y2"], df["y"])
+
+
+def test_stage_save_load_roundtrip(tmp_path, df):
+    from synapseml_tpu.core.pipeline import PipelineStage
+
+    t = FixedMiniBatchTransformer(batchSize=7)
+    t.save(str(tmp_path / "s"))
+    loaded = PipelineStage.load(str(tmp_path / "s"))
+    assert isinstance(loaded, FixedMiniBatchTransformer)
+    assert loaded.getBatchSize() == 7
+
+
+def test_complex_param_save_load(tmp_path, df):
+    """Complex params (callables, nested stages) must survive save/load —
+    pickled per-param by PipelineStage._save_complex_params."""
+    from synapseml_tpu.core.pipeline import Pipeline, PipelineStage
+
+    pipe = Pipeline([
+        UDFTransformer(inputCol="x", outputCol="x2").setUDF(lambda x: x * 3),
+        Lambda().setTransform(lambda t: t.with_column("c", t["x2"] + 1)),
+    ])
+    model = pipe.fit(df)
+    expected = model.transform(df)
+    model.save(str(tmp_path / "p"))
+    loaded = PipelineStage.load(str(tmp_path / "p"))
+    out = loaded.transform(df)
+    np.testing.assert_allclose(out["x2"], expected["x2"])
+    np.testing.assert_allclose(out["c"], expected["c"])
+
+    # nested-stage complex param (Timer wraps a stage)
+    timer = Timer().setStage(UDFTransformer(inputCol="x", outputCol="o").setUDF(lambda x: x))
+    timer.save(str(tmp_path / "t"))
+    lt = PipelineStage.load(str(tmp_path / "t"))
+    assert "o" in lt.transform(df)

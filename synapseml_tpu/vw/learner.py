@@ -1,0 +1,308 @@
+"""Batched sparse SGD engine — the TPU-native VowpalWabbit core (SURVEY §2.1 N3).
+
+The reference's native learner consumes one example at a time (JNI `vw.learn`)
+and averages weights across workers with a spanning-tree AllReduce at pass /
+sync-schedule boundaries (VowpalWabbitBaseLearner.scala:130-188,
+VowpalWabbitSyncSchedule.scala:22-62). On TPU the same capability is expressed
+as an XLA program:
+
+  - examples are padded sparse batches: ``idx``/``val`` arrays of shape (B, P)
+    (P = max active features per example); a whole pass is one `lax.scan` over
+    (num_batches, B, P) — static shapes, MXU/VPU-friendly
+  - the model is a dense weight vector of size 2**num_bits; sparse dot =
+    gather + multiply; updates = scatter-add (both native XLA ops on TPU)
+  - adaptive (adagrad) updates mirror VW's `--adaptive` default; invariant
+    lr-decay `--power_t` for the non-adaptive path
+  - data parallelism: rows sharded over the mesh ``data`` axis with
+    `shard_map`; weights are `pmean`-averaged at each sync-segment boundary —
+    the spanning-tree AllReduce collapsed into one ICI collective
+  - progressive validation loss is accumulated pre-update per batch, matching
+    VW's reported progressive loss semantics
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import DATA_AXIS
+
+SPARSE_DTYPE = np.dtype([("idx", "<i4"), ("val", "<f4")])
+
+
+def make_sparse_batch(indices_list, values_list, pad_to: Optional[int] = None) -> np.ndarray:
+    """Pack per-row (indices, values) into a (N, P) structured array.
+
+    Padded slots use idx=0, val=0 — a gather/scatter no-op (value 0 contributes
+    nothing to dot products or gradients)."""
+    n = len(indices_list)
+    p = max((len(ix) for ix in indices_list), default=1)
+    p = max(p, 1)
+    if pad_to is not None:
+        p = max(p, pad_to)
+    out = np.zeros((n, p), dtype=SPARSE_DTYPE)
+    for i, (ix, vv) in enumerate(zip(indices_list, values_list)):
+        k = len(ix)
+        if k:
+            out["idx"][i, :k] = ix
+            out["val"][i, :k] = vv
+    return out
+
+
+@dataclass(frozen=True)
+class VWConfig:
+    """Mirrors the reference's VW arg surface (VowpalWabbitBase.scala:213+
+    ParamsStringBuilder args: -b, -l, --power_t, --l1, --l2, --loss_function,
+    --passes, --hash_seed, --interactions)."""
+    num_bits: int = 18
+    learning_rate: float = 0.5
+    power_t: float = 0.5
+    initial_t: float = 0.0
+    l1: float = 0.0
+    l2: float = 0.0
+    loss_function: str = "squared"     # squared | logistic | hinge | quantile
+    quantile_tau: float = 0.5
+    adaptive: bool = True
+    num_passes: int = 1
+    batch_size: int = 256
+    hash_seed: int = 0
+    # sync schedule: how many weight-averaging AllReduce segments per pass
+    # (VowpalWabbitSyncScheduleSplits); 1 = average only at pass end.
+    sync_splits: int = 1
+    num_actions: int = 0               # >0 → contextual bandit cost regression
+    cb_type: str = "ips"               # ips | mtr
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class VWState:
+    """Learner state: dense weights + adagrad accumulator + progressive stats."""
+    weights: jnp.ndarray        # (2**num_bits,) f32
+    acc: jnp.ndarray            # (2**num_bits,) f32 — sum of squared gradients
+    bias: jnp.ndarray           # () f32
+    bias_acc: jnp.ndarray       # () f32
+    t: jnp.ndarray              # () f32 — example counter
+    loss_sum: jnp.ndarray       # () f32 — progressive validation loss
+    weight_sum: jnp.ndarray     # () f32
+
+    def tree_flatten(self):
+        return ((self.weights, self.acc, self.bias, self.bias_acc,
+                 self.t, self.loss_sum, self.weight_sum), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def progressive_loss(self) -> float:
+        return float(self.loss_sum / jnp.maximum(self.weight_sum, 1e-12))
+
+    @staticmethod
+    def init(num_bits: int) -> "VWState":
+        n = 1 << num_bits
+        return VWState(jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32),
+                       *(jnp.zeros((), jnp.float32) for _ in range(5)))
+
+    _FIELDS = ("weights", "acc", "bias", "bias_acc", "t", "loss_sum", "weight_sum")
+
+    def to_bytes(self) -> bytes:
+        """Serialized model bytes — the VW `initialModel` warm-start analog
+        (VowpalWabbitBaseLearner.scala:180-182)."""
+        import io
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **{k: np.asarray(getattr(self, k)) for k in self._FIELDS})
+        return buf.getvalue()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "VWState":
+        import io
+        z = np.load(io.BytesIO(data))
+        return VWState(*(jnp.asarray(z[k]) for k in VWState._FIELDS))
+
+
+def _loss_and_grad(p, y, loss: str, tau: float):
+    """Returns (loss_value, dloss/dp). y convention: logistic/hinge use ±1."""
+    if loss == "squared":
+        return (p - y) ** 2, 2.0 * (p - y)
+    if loss == "logistic":
+        m = p * y
+        return jnp.log1p(jnp.exp(-m)), -y * jax.nn.sigmoid(-m)
+    if loss == "hinge":
+        m = p * y
+        return jnp.maximum(0.0, 1.0 - m), jnp.where(m < 1.0, -y, 0.0)
+    if loss == "quantile":
+        e = y - p
+        return jnp.where(e >= 0, tau * e, (tau - 1.0) * e), jnp.where(e >= 0, -tau, 1.0 - tau)
+    raise ValueError(f"unknown loss_function {loss!r}")
+
+
+def _raw_predict(weights, bias, idx, val):
+    return (weights[idx] * val).sum(axis=-1) + bias
+
+
+def _pass_body(cfg: VWConfig):
+    """Build the jittable single-segment scan body over (nb, B, P) batches."""
+    lr, l1, l2 = cfg.learning_rate, cfg.l1, cfg.l2
+
+    def step(state: VWState, batch):
+        idx, val, y, sw = batch
+        p = _raw_predict(state.weights, state.bias, idx, val)
+        loss, dldp = _loss_and_grad(p, y, cfg.loss_function, cfg.quantile_tau)
+        loss_sum = state.loss_sum + (loss * sw).sum()
+        weight_sum = state.weight_sum + sw.sum()
+
+        g_ex = dldp * sw                              # (B,)
+        g = g_ex[:, None] * val                       # (B, P) sparse grads
+        if cfg.adaptive:
+            acc = state.acc.at[idx.reshape(-1)].add((g * g).reshape(-1))
+            denom = jnp.sqrt(acc[idx]) + 1e-6
+            delta = -lr * g / denom
+            bias_acc = state.bias_acc + (g_ex * g_ex).sum()
+            bias_delta = -lr * g_ex.sum() / (jnp.sqrt(bias_acc) + 1e-6)
+        else:
+            t = state.t + sw.sum()
+            eta = lr * (cfg.initial_t + t) ** (-cfg.power_t)
+            acc = state.acc
+            delta = -eta * g
+            bias_acc = state.bias_acc
+            bias_delta = -eta * g_ex.sum()
+        if l2 > 0.0:
+            delta = delta - lr * l2 * state.weights[idx] * (val != 0)
+        w = state.weights.at[idx.reshape(-1)].add(delta.reshape(-1))
+        if l1 > 0.0:
+            touched = w[idx]
+            w = w.at[idx.reshape(-1)].set(
+                (jnp.sign(touched) * jnp.maximum(jnp.abs(touched) - lr * l1, 0.0)
+                 ).reshape(-1))
+        new_state = VWState(w, acc, state.bias + bias_delta, bias_acc,
+                            state.t + sw.sum(), loss_sum, weight_sum)
+        return new_state, p
+
+    return step
+
+
+def _pack(idx, val, y, sw, batch_size):
+    """Pad rows to a batch multiple and reshape to (nb, B, ...)."""
+    n, p = idx.shape
+    nb = max((n + batch_size - 1) // batch_size, 1)
+    total = nb * batch_size
+    pad = total - n
+
+    def padded(a, fill=0):
+        if pad == 0:
+            return a
+        width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, width, constant_values=fill)
+
+    return (padded(idx).reshape(nb, batch_size, p),
+            padded(val).reshape(nb, batch_size, p),
+            padded(y).reshape(nb, batch_size),
+            padded(sw).reshape(nb, batch_size))
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _run_pass(state: VWState, batches, cfg: VWConfig):
+    step = _pass_body(cfg)
+    state, preds = jax.lax.scan(step, state, batches)
+    return state, preds
+
+
+def _run_pass_sharded(mesh, cfg: VWConfig):
+    """shard_map'd pass: each device scans its local row shard; weights are
+    pmean-averaged after each of ``cfg.sync_splits`` segments (the AllReduce
+    sync-schedule analog)."""
+    from jax.sharding import PartitionSpec as P
+
+    step = _pass_body(cfg)
+
+    def local_pass(state: VWState, batches):
+        idx, val, y, sw = batches
+        nb = idx.shape[0]
+        s = cfg.sync_splits if nb % cfg.sync_splits == 0 else 1
+        seg = nb // s
+
+        def run_segment(st, seg_batch):
+            st, _ = jax.lax.scan(step, st, seg_batch)
+            avg = jax.lax.pmean(st.weights, DATA_AXIS)
+            bias = jax.lax.pmean(st.bias, DATA_AXIS)
+            acc = jax.lax.pmean(st.acc, DATA_AXIS)
+            return VWState(avg, acc, bias, jax.lax.pmean(st.bias_acc, DATA_AXIS),
+                           jax.lax.pmean(st.t, DATA_AXIS),
+                           jax.lax.psum(st.loss_sum, DATA_AXIS),
+                           jax.lax.psum(st.weight_sum, DATA_AXIS)), None
+
+        seg_batches = jax.tree.map(
+            lambda a: a.reshape((s, seg) + a.shape[1:]), batches)
+        state, _ = jax.lax.scan(run_segment, state, seg_batches)
+        return state
+
+    spec_b = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+    return jax.jit(jax.shard_map(local_pass, mesh=mesh,
+                                 in_specs=(P(), spec_b), out_specs=P(),
+                                 check_vma=False))
+
+
+def train_vw(idx: np.ndarray, val: np.ndarray, y: np.ndarray,
+             cfg: VWConfig, sample_weight: Optional[np.ndarray] = None,
+             mesh=None, initial_state: Optional[VWState] = None,
+             collect_progressive: bool = False):
+    """Train; returns (VWState, progressive_predictions | None).
+
+    idx/val: (N, P) int32/f32 padded sparse rows; y: (N,) — for logistic/hinge
+    losses callers must pass labels in ±1."""
+    n = idx.shape[0]
+    sw = np.ones(n, np.float32) if sample_weight is None else np.asarray(sample_weight, np.float32)
+    state = initial_state if initial_state is not None else VWState.init(cfg.num_bits)
+    progressive = None
+
+    if mesh is None:
+        batches = _pack(np.asarray(idx, np.int32), np.asarray(val, np.float32),
+                        np.asarray(y, np.float32), sw, cfg.batch_size)
+        batches = jax.tree.map(jnp.asarray, batches)
+        for p in range(cfg.num_passes):
+            state, preds = _run_pass(state, batches, cfg)
+            if collect_progressive and p == 0:
+                progressive = np.asarray(preds).reshape(-1)[:n]
+    else:
+        ndev = mesh.devices.size
+        # equal local row counts per device, then equal local batch counts
+        per = -(-n // ndev)
+        per = -(-per // cfg.batch_size) * cfg.batch_size
+
+        def shard_pad(a, fill=0):
+            pad = per * ndev - a.shape[0]
+            width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, width, constant_values=fill) if pad else a
+
+        idx_s = shard_pad(np.asarray(idx, np.int32))
+        val_s = shard_pad(np.asarray(val, np.float32))
+        y_s = shard_pad(np.asarray(y, np.float32))
+        sw_s = shard_pad(sw)
+        nb_local = per // cfg.batch_size
+        p_dim = idx.shape[1]
+        batches = (idx_s.reshape(ndev * nb_local, cfg.batch_size, p_dim),
+                   val_s.reshape(ndev * nb_local, cfg.batch_size, p_dim),
+                   y_s.reshape(ndev * nb_local, cfg.batch_size),
+                   sw_s.reshape(ndev * nb_local, cfg.batch_size))
+        run = _run_pass_sharded(mesh, cfg)
+        for _ in range(cfg.num_passes):
+            state = run(state, jax.tree.map(jnp.asarray, batches))
+    return state, progressive
+
+
+@partial(jax.jit, donate_argnums=())
+def _predict_jit(weights, bias, idx, val):
+    return _raw_predict(weights, bias, idx, val)
+
+
+def vw_predict(state: VWState, idx, val, link: str = "identity") -> np.ndarray:
+    p = _predict_jit(state.weights, state.bias,
+                     jnp.asarray(idx, jnp.int32), jnp.asarray(val, jnp.float32))
+    if link == "logistic":
+        p = jax.nn.sigmoid(p)
+    return np.asarray(p)

@@ -1,0 +1,339 @@
+"""VW-capability module tests (SURVEY §2.6): hashing parity-style checks,
+featurizer, learners (incl. 8-device mesh model averaging), text parsing,
+policy evaluation."""
+
+import numpy as np
+import pytest
+
+
+# --- hashing -----------------------------------------------------------------
+
+def test_murmur3_known_vectors():
+    from synapseml_tpu.vw.hashing import murmur3_32
+
+    # canonical MurmurHash3_x86_32 test vectors
+    assert murmur3_32(b"", 0) == 0
+    assert murmur3_32(b"", 1) == 0x514E28B7
+    assert murmur3_32(b"hello", 0) == 0x248BFA47
+    assert murmur3_32(b"hello, world", 0) == 0x149BBB7F
+    assert murmur3_32(b"The quick brown fox jumps over the lazy dog", 0) == 0x2E4FF723
+
+
+def test_hash_feature_numeric_names_index_directly():
+    from synapseml_tpu.vw.hashing import hash_feature
+
+    assert hash_feature("42", 100) == 142
+    assert hash_feature("a", 0) != hash_feature("a", 1)
+
+
+# --- featurizer --------------------------------------------------------------
+
+def test_featurizer_numeric_string_vector():
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitFeaturizer
+    from synapseml_tpu.vw.learner import SPARSE_DTYPE
+
+    df = Table({
+        "age": np.array([25.0, 0.0, 40.0], np.float32),
+        "city": np.array(["nyc", "sf", "nyc"], object),
+        "vec": np.arange(6, dtype=np.float32).reshape(3, 2),
+    })
+    out = VowpalWabbitFeaturizer(inputCols=["age", "city", "vec"]).transform(df)
+    feats = out["features"]
+    assert feats.dtype == SPARSE_DTYPE
+    # row 0: age + city + 1 nonzero vec slot (vec[0] = [0, 1])
+    live0 = (feats["val"][0] != 0).sum()
+    assert live0 == 3
+    # zero-valued numerics are dropped (row 1 age == 0)
+    assert (feats["val"][1] != 0).sum() == 3  # city + 2 vec slots
+    # same string in rows 0 and 2 hashes identically
+    nyc0 = set(feats["idx"][0][feats["val"][0] != 0]) & set(
+        feats["idx"][2][feats["val"][2] != 0])
+    assert nyc0
+
+
+def test_interactions_cross_columns():
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+
+    df = Table({"a": np.array(["x", "y"], object), "b": np.array([2.0, 3.0], np.float32)})
+    df = VowpalWabbitFeaturizer(inputCols=["a"], outputCol="fa").transform(df)
+    df = VowpalWabbitFeaturizer(inputCols=["b"], outputCol="fb").transform(df)
+    out = VowpalWabbitInteractions(inputCols=["fa", "fb"]).transform(df)
+    inter = out["interactions"]
+    assert (inter["val"][0] != 0).sum() == 1
+    assert inter["val"][0][0] == pytest.approx(2.0)  # 1 * 2.0
+
+
+# --- learner -----------------------------------------------------------------
+
+def _separable(n=400, d=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y01 = (X[:, 0] - 0.7 * X[:, 1] > 0).astype(np.float32)
+    return X, y01
+
+
+def test_classifier_learns_dense():
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitClassifier
+
+    X, y = _separable()
+    df = Table({"features": X, "label": y})
+    model = VowpalWabbitClassifier(numPasses=6, learningRate=0.5).fit(df)
+    out = model.transform(df)
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.9
+    assert out["probability"].shape == (len(y), 2)
+    stats = model.getPerformanceStatistics()
+    assert stats["examples"] > 0
+
+
+def test_classifier_sparse_pipeline_and_save_load(tmp_path):
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitClassifier, VowpalWabbitFeaturizer
+
+    X, y = _separable(d=4)
+    df = Table({f"f{j}": X[:, j] for j in range(4)})
+    df["label"] = y
+    df = VowpalWabbitFeaturizer(inputCols=[f"f{j}" for j in range(4)]).transform(df)
+    model = VowpalWabbitClassifier(numPasses=6).fit(df)
+    acc = (model.transform(df)["prediction"] == y).mean()
+    assert acc > 0.85
+
+    p = str(tmp_path / "vw_model")
+    model.save(p)
+    from synapseml_tpu.core.pipeline import PipelineStage
+    loaded = PipelineStage.load(p)
+    np.testing.assert_allclose(loaded.transform(df)["rawPrediction"],
+                               model.transform(df)["rawPrediction"], rtol=1e-6)
+
+
+def test_regressor_quantile_and_squared():
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitRegressor
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(500, 5)).astype(np.float32)
+    y = (X @ np.array([1.0, -2.0, 0.5, 0.0, 3.0], np.float32) + 0.5).astype(np.float32)
+    df = Table({"features": X, "label": y})
+    m = VowpalWabbitRegressor(numPasses=10, learningRate=0.8).fit(df)
+    pred = m.transform(df)["prediction"]
+    resid = np.abs(pred - y).mean() / np.abs(y).std()
+    assert resid < 0.25
+
+    mq = VowpalWabbitRegressor(lossFunction="quantile", numPasses=10).fit(df)
+    assert np.isfinite(mq.transform(df)["prediction"]).all()
+
+
+def test_pass_through_args_override():
+    from synapseml_tpu.vw.estimators import VowpalWabbitRegressor
+
+    est = VowpalWabbitRegressor(passThroughArgs="-b 20 -l 0.1 --passes 3 --loss_function quantile")
+    cfg = est._config("squared")
+    assert cfg.num_bits == 20
+    assert cfg.learning_rate == pytest.approx(0.1)
+    assert cfg.num_passes == 3
+    assert cfg.loss_function == "quantile"
+
+
+def test_mesh_data_parallel_training(eight_devices):
+    """Model-averaged data-parallel training (the spanning-tree AllReduce
+    analog) learns as well as single-device."""
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.parallel import make_mesh
+    from synapseml_tpu.vw import VowpalWabbitClassifier
+
+    X, y = _separable(n=800)
+    df = Table({"features": X, "label": y})
+    est = VowpalWabbitClassifier(numPasses=6, numSyncsPerPass=2, batchSize=32)
+    est.mesh = make_mesh({"data": 8}, devices=eight_devices)
+    model = est.fit(df)
+    acc = (model.transform(df)["prediction"] == y).mean()
+    assert acc > 0.85
+
+
+# --- generic / text format ---------------------------------------------------
+
+def test_parse_example_namespaces_and_values():
+    from synapseml_tpu.vw.textparse import parse_example
+
+    lab, imp, idx, val = parse_example("1 2.0 |a x:2 y |b z", 18)
+    assert lab == 1.0 and imp == 2.0
+    assert len(idx) == 3
+    assert sorted(val) == [1.0, 1.0, 2.0]
+
+
+def test_generic_and_progressive():
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitGeneric, VowpalWabbitGenericProgressive
+
+    rng = np.random.default_rng(2)
+    lines = []
+    for _ in range(300):
+        x1, x2 = rng.normal(), rng.normal()
+        label = 1 if x1 - x2 > 0 else -1
+        lines.append(f"{label} |f x1:{x1:.4f} x2:{x2:.4f}")
+    df = Table({"value": np.array(lines, object)})
+    model = VowpalWabbitGeneric(passThroughArgs="--loss_function logistic --passes 5").fit(df)
+    pred = model.transform(df)["prediction"]
+    y = np.array([1.0 if l.startswith("1") else 0.0 for l in lines])
+    acc = ((pred > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.85
+
+    prog = VowpalWabbitGenericProgressive().transform(df)
+    assert len(prog["prediction"]) == 300
+
+
+# --- contextual bandit -------------------------------------------------------
+
+def test_contextual_bandit_learns_best_action():
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitContextualBandit
+    from synapseml_tpu.vw.learner import make_sparse_batch
+
+    rng = np.random.default_rng(3)
+    n, k = 400, 3
+    rows = []
+    for i in range(n):
+        ctx = rng.normal()
+        # action features: one-hot action id + context interaction
+        actions = []
+        for a in range(k):
+            sp = make_sparse_batch([[a + 1, 10 + a]], [[1.0, ctx]])
+            actions.append(sp[0])
+        chosen = int(rng.integers(1, k + 1))
+        # true cost: action 2 best when ctx>0 else action 0
+        best = 2 if ctx > 0 else 0
+        cost = 0.0 if chosen - 1 == best else 1.0
+        rows.append({"features": actions, "chosenAction": chosen,
+                     "label": cost, "probability": 1.0 / k})
+    df = Table.from_rows(rows)
+    model = VowpalWabbitContextualBandit(numPasses=5, cbType="ips").fit(df)
+    out = model.transform(df)
+    assert out["prediction"][0].shape == (k,)
+    np.testing.assert_allclose(out["prediction"][0].sum(), 1.0, rtol=1e-5)
+    # the greedy policy should beat uniform on the logged data
+    correct = 0
+    for i, r in enumerate(rows):
+        ctx = r["features"][0]["val"][1]
+        best = 2 if ctx > 0 else 0
+        correct += int(out["chosenActionPrediction"][i] - 1 == best)
+    assert correct / n > 0.6
+
+
+# --- policy eval -------------------------------------------------------------
+
+def test_policy_eval_estimators():
+    from synapseml_tpu.vw import (cressie_read_estimate, cressie_read_interval,
+                                  ips_estimate, snips_estimate)
+
+    rng = np.random.default_rng(4)
+    n = 2000
+    # logging policy uniform over 2 actions; target always picks action 0;
+    # action 0 reward ~ Bernoulli(0.7)
+    logged_action = rng.integers(0, 2, n)
+    p_log = np.full(n, 0.5)
+    p_target = (logged_action == 0).astype(np.float64)
+    reward = np.where(logged_action == 0, rng.random(n) < 0.7, rng.random(n) < 0.2).astype(float)
+
+    ips = ips_estimate(reward, p_log, p_target)
+    snips = snips_estimate(reward, p_log, p_target)
+    cr = cressie_read_estimate(reward, p_log, p_target)
+    assert abs(ips - 0.7) < 0.08
+    assert abs(snips - 0.7) < 0.08
+    assert abs(cr - 0.7) < 0.08
+    lo, hi = cressie_read_interval(reward, p_log, p_target)
+    assert lo <= cr <= hi
+
+
+def test_kahan_sum():
+    from synapseml_tpu.vw import KahanSum
+
+    s = KahanSum()
+    for _ in range(10_000):
+        s.add(0.1)
+    assert abs(float(s) - 1000.0) < 1e-9
+
+
+def test_dsjson_and_cse_transformers():
+    import json
+
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import (VowpalWabbitCSETransformer,
+                                  VowpalWabbitDSJsonTransformer)
+
+    lines = [json.dumps({"EventId": f"e{i}", "_label_cost": -1.0 if i % 2 else 0.0,
+                         "_label_probability": 0.5, "_labelIndex": i % 2,
+                         "a": [1, 2], "p": [0.5, 0.5]}) for i in range(10)]
+    df = Table({"value": np.array(lines, object)})
+    parsed = VowpalWabbitDSJsonTransformer().transform(df)
+    assert parsed.num_rows == 10
+    assert "cost" in parsed
+
+    parsed["reward"] = -parsed["cost"]
+    parsed["probabilityPredicted"] = np.full(10, 0.5)
+    summary = VowpalWabbitCSETransformer().transform(parsed)
+    assert summary.num_rows == 1
+    assert 0.0 <= summary["snips"][0] <= 1.0
+
+
+def test_generic_interactions_survive_transform():
+    """Regression: -q interactions must apply at predict time too (XOR data)."""
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitGeneric
+
+    rng = np.random.default_rng(5)
+    lines = []
+    for _ in range(400):
+        x1, x2 = rng.choice([-1.0, 1.0]), rng.choice([-1.0, 1.0])
+        label = 1 if x1 * x2 > 0 else -1
+        lines.append(f"{label} |a x1:{x1}|b x2:{x2}")
+    df = Table({"value": np.array(lines, object)})
+    # spaced '-q ab' form must be accepted
+    model = VowpalWabbitGeneric(
+        passThroughArgs="--loss_function logistic --passes 10 -q ab").fit(df)
+    pred = model.transform(df)["prediction"]
+    y = np.array([1.0 if l.startswith("1") else 0.0 for l in lines])
+    acc = ((pred > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.95  # without interactions XOR is unlearnable (~0.5)
+
+
+def test_initial_model_warm_start():
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitRegressor
+
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    y = (X @ np.array([1.0, -1.0, 2.0, 0.5], np.float32)).astype(np.float32)
+    df = Table({"features": X, "label": y})
+    m1 = VowpalWabbitRegressor(numPasses=2).fit(df)
+    warm = VowpalWabbitRegressor(numPasses=2, initialModel=m1.state.to_bytes()).fit(df)
+    cold = VowpalWabbitRegressor(numPasses=2).fit(df)
+    err_warm = np.abs(warm.transform(df)["prediction"] - y).mean()
+    err_cold = np.abs(cold.transform(df)["prediction"] - y).mean()
+    assert err_warm < err_cold  # warm start = 4 effective passes
+
+
+def test_cb_chosen_action_out_of_range_raises():
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitContextualBandit
+    from synapseml_tpu.vw.learner import make_sparse_batch
+
+    sp = make_sparse_batch([[1]], [[1.0]])
+    rows = [{"features": [sp[0], sp[0]], "chosenAction": 5,
+             "label": 0.0, "probability": 0.5}]
+    df = Table.from_rows(rows)
+    with pytest.raises(ValueError, match="chosenAction out of range"):
+        VowpalWabbitContextualBandit().fit(df)
+
+
+def test_dsjson_chosen_action_is_one_based():
+    import json
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitDSJsonTransformer
+
+    lines = [json.dumps({"_labelIndex": 0, "_label_cost": 0, "_label_probability": 0.5,
+                         "a": [1, 2], "p": [0.5, 0.5]})]
+    out = VowpalWabbitDSJsonTransformer().transform(Table({"value": np.array(lines, object)}))
+    assert out["chosenAction"][0] == 1

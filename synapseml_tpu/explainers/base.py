@@ -1,0 +1,109 @@
+"""LocalExplainer base machinery.
+
+Reference: core/.../explainers/LocalExplainer.scala:12-32 (factory),
+SharedParams.scala (model/targetCol/targetClasses params), KernelSHAPBase.scala
+/ LIMEBase.scala transform scaffolding: per row, generate S perturbed samples,
+score them through the wrapped model, fit a weighted local surrogate, output
+the coefficients."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.params import Param
+from ..core.pipeline import Transformer
+from ..core.table import Table
+
+
+class LocalExplainerBase(Transformer):
+    model = Param("model", "The model/pipeline Transformer to explain", object)
+    targetCol = Param("targetCol", "Model output column to explain "
+                      "(probability/prediction/...)", str, "probability")
+    targetClasses = Param("targetClasses", "Class indices to explain (classification)",
+                          list, [0])
+    targetClassesCol = Param("targetClassesCol", "Per-row class indices column", str)
+    outputCol = Param("outputCol", "Output column of explanation weights", str, "explanation")
+    metricsCol = Param("metricsCol", "Surrogate-fit metric column (r2)", str, "r2")
+    numSamples = Param("numSamples", "Perturbed samples per row", int)
+
+    def _score(self, samples: Table) -> np.ndarray:
+        """Run the wrapped model over perturbed samples → (n, K) targets where
+        K = len(targetClasses) for vector targets, else 1."""
+        model = self.model
+        if model is None:
+            raise ValueError("explainer requires the `model` param (a fitted Transformer)")
+        scored = model.transform(samples)
+        tcol = self.targetCol
+        if tcol not in scored:
+            raise KeyError(f"targetCol {tcol!r} not in model output "
+                           f"(columns: {scored.columns})")
+        out = scored[tcol]
+        out = np.asarray(out, np.float32) if out.dtype != object else \
+            np.stack([np.asarray(o, np.float32) for o in out])
+        if out.ndim == 1:
+            return out[:, None]
+        classes = [int(c) for c in (self.targetClasses or [0])]
+        return out[:, classes]
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        m = self.get("model")
+        if m is not None:
+            m.save(os.path.join(path, "explained_model"))
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        from ..core.pipeline import PipelineStage
+        p = os.path.join(path, "explained_model")
+        if os.path.isdir(p):
+            self.set("model", PipelineStage.load(p))
+
+
+def lime_kernel_weights(distances: np.ndarray, kernel_width: float) -> np.ndarray:
+    """exp(-d²/w²) locality kernel (LIMEBase)."""
+    return np.exp(-(distances ** 2) / (kernel_width ** 2)).astype(np.float32)
+
+
+def shap_kernel_weights(num_features: int, coalition_sizes: np.ndarray,
+                        inf_weight: float = 1e8) -> np.ndarray:
+    """Shapley kernel π(z) = (M-1) / (C(M,|z|)·|z|·(M-|z|)); empty/full
+    coalitions get infWeight (KernelSHAPBase infWeight param)."""
+    from math import comb
+    m = num_features
+    w = np.empty(len(coalition_sizes), np.float64)
+    for i, s in enumerate(coalition_sizes):
+        s = int(s)
+        if s == 0 or s == m:
+            w[i] = inf_weight
+        else:
+            w[i] = (m - 1) / (comb(m, s) * s * (m - s))
+    return w.astype(np.float32)
+
+
+def sample_coalitions(rng: np.random.Generator, num_features: int,
+                      num_samples: int) -> np.ndarray:
+    """Coalition matrix (num_samples, M) ∈ {0,1}: first the empty and full
+    coalitions, then sizes drawn ~ Shapley-kernel mass (KernelSHAPSampler)."""
+    m = num_features
+    if num_samples < 2:
+        raise ValueError(f"numSamples must be >= 2 (empty + full coalition), got {num_samples}")
+    out = np.zeros((num_samples, m), np.float32)
+    out[1] = 1.0
+    if num_samples == 2:
+        return out
+    sizes = np.arange(1, m)
+    if len(sizes):
+        p = (m - 1) / (sizes * (m - sizes))
+        p = p / p.sum()
+        draw = rng.choice(sizes, size=num_samples - 2, p=p)
+        for i, s in enumerate(draw):
+            on = rng.choice(m, size=s, replace=False)
+            out[i + 2, on] = 1.0
+    return out
+
+
+def default_num_samples(num_features: int, cap: int = 5000) -> int:
+    """2M+2048 heuristic (KernelSHAPBase default sample count)."""
+    return min(2 * num_features + 2048, cap)

@@ -1,0 +1,102 @@
+"""Weighted least-squares and lasso solvers for local surrogate models.
+
+Reference: core/.../explainers/{LeastSquaresRegression,LassoRegression,
+RegressionBase}.scala — per-row Breeze solves on executors (SURVEY §2.1 N9).
+Here every row's local regression is solved in ONE vmapped, jitted XLA call:
+(R rows) × (S samples, D features[, K targets]) → (R, D, K) coefficients, so a
+whole DataFrame's explanations become a single batched linear-algebra program
+on the MXU instead of R driver-side solves.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FitResult(NamedTuple):
+    coefs: jnp.ndarray       # (D, K)
+    intercept: jnp.ndarray   # (K,)
+    r2: jnp.ndarray          # (K,)
+
+
+def _weighted_r2(X, y, w, coefs, intercept):
+    pred = X @ coefs + intercept
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    ybar = (w[:, None] * y).sum(0) / wsum
+    ss_res = (w[:, None] * (y - pred) ** 2).sum(0)
+    ss_tot = jnp.maximum((w[:, None] * (y - ybar) ** 2).sum(0), 1e-12)
+    return 1.0 - ss_res / ss_tot
+
+
+def _lstsq_single(X, y, w, ridge: float):
+    """Weighted least squares with intercept: X (S,D), y (S,K), w (S,)."""
+    S, D = X.shape
+    Xa = jnp.concatenate([X, jnp.ones((S, 1), X.dtype)], axis=1)
+    Xw = Xa * w[:, None]
+    A = Xw.T @ Xa + ridge * jnp.eye(D + 1, dtype=X.dtype)
+    b = Xw.T @ y
+    sol = jnp.linalg.solve(A, b)                       # (D+1, K)
+    coefs, intercept = sol[:-1], sol[-1]
+    return FitResult(coefs, intercept, _weighted_r2(X, y, w, coefs, intercept))
+
+
+def _lasso_single(X, y, w, lam: float, iters: int = 200):
+    """Weighted lasso by FISTA on the normal equations (jit/scan friendly,
+    fixed iteration count — the LARS solve in LassoRegression.scala done the
+    XLA way). X (S,D), y (S,K), w (S,)."""
+    S, D = X.shape
+    K = y.shape[1]
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    # center (weighted) so the intercept drops out of the prox step
+    xbar = (w[:, None] * X).sum(0) / wsum
+    ybar = (w[:, None] * y).sum(0) / wsum
+    Xc = (X - xbar) * jnp.sqrt(w)[:, None]
+    yc = (y - ybar) * jnp.sqrt(w)[:, None]
+    G = Xc.T @ Xc
+    L = jnp.maximum(jnp.trace(G), 1e-8)                # cheap Lipschitz bound
+    eta = 1.0 / L
+    Xty = Xc.T @ yc
+
+    def body(carry, _):
+        beta, z, t = carry
+        grad = G @ z - Xty
+        b_new = z - eta * grad
+        b_new = jnp.sign(b_new) * jnp.maximum(jnp.abs(b_new) - eta * lam * S, 0.0)
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        z_new = b_new + ((t - 1.0) / t_new) * (b_new - beta)
+        return (b_new, z_new, t_new), None
+
+    beta0 = jnp.zeros((D, K), X.dtype)
+    (beta, _, _), _ = jax.lax.scan(body, (beta0, beta0, jnp.ones(())), None, length=iters)
+    intercept = ybar - xbar @ beta
+    return FitResult(beta, intercept, _weighted_r2(X, y, w, beta, intercept))
+
+
+@partial(jax.jit, static_argnames=("ridge",))
+def batched_lstsq(X, y, w, ridge: float = 1e-6):
+    """vmapped weighted LS: X (R,S,D), y (R,S,K), w (R,S) → FitResult batched."""
+    return jax.vmap(lambda a, b, c: _lstsq_single(a, b, c, ridge))(X, y, w)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def batched_lasso(X, y, w, lam, iters: int = 200):
+    """vmapped weighted lasso; lam scalar or (R,)."""
+    lam = jnp.broadcast_to(jnp.asarray(lam, X.dtype), (X.shape[0],))
+    return jax.vmap(lambda a, b, c, l: _lasso_single(a, b, c, l, iters))(X, y, w, lam)
+
+
+def solve_batched(X, y, w, regularization: float = 0.0) -> FitResult:
+    """Dispatch: lasso when regularization > 0, else (near-)OLS — mirroring
+    LIMEBase's regParam semantics. Host-facing: accepts numpy, returns device
+    arrays."""
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    w = jnp.asarray(w, jnp.float32)
+    if regularization > 0.0:
+        return batched_lasso(X, y, w, regularization)
+    return batched_lstsq(X, y, w)

@@ -1,0 +1,9 @@
+"""Image utilities (JVM `image/` package analog, SURVEY §2.7):
+Superpixel clustering (SLIC) for image LIME/SHAP, SuperpixelTransformer,
+UnrollImage, ImageSetAugmenter."""
+
+from .superpixel import slic_segments, grid_segments, Superpixel, SuperpixelTransformer
+from .unroll import UnrollImage, ImageSetAugmenter
+
+__all__ = ["slic_segments", "grid_segments", "Superpixel", "SuperpixelTransformer",
+           "UnrollImage", "ImageSetAugmenter"]

@@ -93,7 +93,8 @@ class _VWParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
                 updates[k] = typ(args[i + 1])
                 i += 2
             elif a == "--noconstant":
-                i += 1  # handled implicitly: bias stays ~0 if never updated
+                updates["no_constant"] = True
+                i += 1
             elif a == "--adaptive":
                 updates["adaptive"] = True
                 i += 1
@@ -158,22 +159,15 @@ class _VWModelBase(Model, HasFeaturesCol, HasPredictionCol):
     def _save_extra(self, path: str) -> None:
         import os
         if self.state is not None:
-            np.savez_compressed(
-                os.path.join(path, "vw_state.npz"),
-                weights=np.asarray(self.state.weights), acc=np.asarray(self.state.acc),
-                bias=np.asarray(self.state.bias), bias_acc=np.asarray(self.state.bias_acc),
-                t=np.asarray(self.state.t), loss_sum=np.asarray(self.state.loss_sum),
-                weight_sum=np.asarray(self.state.weight_sum))
+            with open(os.path.join(path, "vw_state.npz"), "wb") as f:
+                f.write(self.state.to_bytes())
 
     def _load_extra(self, path: str) -> None:
         import os
-        import jax.numpy as jnp
         f = os.path.join(path, "vw_state.npz")
         if os.path.exists(f):
-            z = np.load(f)
-            self.state = VWState(*(jnp.asarray(z[k]) for k in
-                                   ("weights", "acc", "bias", "bias_acc",
-                                    "t", "loss_sum", "weight_sum")))
+            with open(f, "rb") as fh:
+                self.state = VWState.from_bytes(fh.read())
 
     def getPerformanceStatistics(self) -> dict:
         """TrainingStats analog (VowpalWabbitBaseLearner.scala:20-40)."""

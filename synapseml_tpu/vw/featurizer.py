@@ -15,7 +15,7 @@ import numpy as np
 from ..core.params import Param, HasInputCols, HasOutputCol
 from ..core.pipeline import Transformer
 from ..core.table import Table
-from .hashing import hash_feature, interaction_hash, namespace_hash
+from .hashing import hash_feature, hash_strings, interaction_hash, namespace_hash
 from .learner import SPARSE_DTYPE, make_sparse_batch
 
 
@@ -52,8 +52,8 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
             a = df[col]
             seed = namespace_hash("", self.hashSeed)
             if a.ndim == 2:                                  # numeric vector column
-                hs = np.array([hash_feature(f"{col}_{j}", seed) & mask
-                               for j in range(a.shape[1])], np.int64)
+                hs = hash_strings([f"{col}_{j}" for j in range(a.shape[1])],
+                                  seed, num_bits=bits)
                 for i in range(n):
                     row = np.asarray(a[i], np.float32)
                     nz = np.nonzero(row)[0]

@@ -78,10 +78,6 @@ def hash_feature(name: str, ns_seed: int = 0) -> int:
     return murmur3_32(name.encode("utf-8"), ns_seed)
 
 
-def mask_index(h: int, num_bits: int) -> int:
-    return h & ((1 << num_bits) - 1)
-
-
 def interaction_hash(h1: int, h2: int) -> int:
     """Quadratic-interaction index combine (VW: h1 * FNV_prime XOR h2)."""
     return ((h1 * 0x01000193) ^ h2) & _M32

@@ -76,6 +76,7 @@ class VWConfig:
     sync_splits: int = 1
     num_actions: int = 0               # >0 → contextual bandit cost regression
     cb_type: str = "ips"               # ips | mtr
+    no_constant: bool = False          # --noconstant: no intercept term
 
 
 @jax.tree_util.register_pytree_node_class
@@ -157,20 +158,24 @@ def _pass_body(cfg: VWConfig):
         weight_sum = state.weight_sum + sw.sum()
 
         g_ex = dldp * sw                              # (B,)
+        if cfg.no_constant:
+            g_ex_bias = jnp.zeros_like(g_ex)          # --noconstant: frozen intercept
+        else:
+            g_ex_bias = g_ex
         g = g_ex[:, None] * val                       # (B, P) sparse grads
         if cfg.adaptive:
             acc = state.acc.at[idx.reshape(-1)].add((g * g).reshape(-1))
             denom = jnp.sqrt(acc[idx]) + 1e-6
             delta = -lr * g / denom
-            bias_acc = state.bias_acc + (g_ex * g_ex).sum()
-            bias_delta = -lr * g_ex.sum() / (jnp.sqrt(bias_acc) + 1e-6)
+            bias_acc = state.bias_acc + (g_ex_bias * g_ex_bias).sum()
+            bias_delta = -lr * g_ex_bias.sum() / (jnp.sqrt(bias_acc) + 1e-6)
         else:
             t = state.t + sw.sum()
             eta = lr * (cfg.initial_t + t) ** (-cfg.power_t)
             acc = state.acc
             delta = -eta * g
             bias_acc = state.bias_acc
-            bias_delta = -eta * g_ex.sum()
+            bias_delta = -eta * g_ex_bias.sum()
         if l2 > 0.0:
             delta = delta - lr * l2 * state.weights[idx] * (val != 0)
         w = state.weights.at[idx.reshape(-1)].add(delta.reshape(-1))

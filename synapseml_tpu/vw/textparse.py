@@ -6,8 +6,8 @@ host-side into padded sparse batches.
 
 Supported grammar (the common core):
     [label] [importance [initial]] ['tag] |ns[:ns_scale] feat[:value] ... |ns2 ...
-Contextual-bandit (--cb_adf style multiline is handled in estimators.py):
-    action:cost:probability | features...
+Contextual-bandit data enters through VowpalWabbitContextualBandit's columnar
+API (sparse action-feature columns), not through this text parser.
 """
 
 from __future__ import annotations
@@ -88,9 +88,3 @@ def parse_lines(lines, num_bits: int, interactions: Tuple[str, ...] = (),
         vals.append(vv)
     sp = make_sparse_batch(idxs, vals)
     return sp, np.asarray(labels, np.float32), np.asarray(weights, np.float32)
-
-
-def parse_cb_label(tok: str) -> Tuple[int, float, float]:
-    """'action:cost:prob' → (action 1-based, cost, prob)."""
-    a, c, p = tok.split(":")
-    return int(a), float(c), float(p)

@@ -1,0 +1,229 @@
+"""Explainer tests (SURVEY §2.7): solver correctness, LIME/SHAP recovering
+known feature attributions on a linear model, ICE curves, image/text paths."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.pipeline import Transformer
+from synapseml_tpu.core.table import Table
+
+
+class LinearModel(Transformer):
+    """Deterministic stand-in model: probability = sigmoid(w·x)."""
+
+    def __init__(self, w, featuresCol="features", **kw):
+        super().__init__(**kw)
+        self.w = np.asarray(w, np.float32)
+        self.featuresCol = featuresCol
+
+    def _transform(self, df):
+        X = np.asarray(df[self.featuresCol], np.float32)
+        z = X @ self.w
+        p = 1 / (1 + np.exp(-z))
+        return df.with_column("probability", np.stack([1 - p, p], 1))
+
+
+def test_batched_lstsq_recovers_coefficients():
+    from synapseml_tpu.explainers.solvers import batched_lstsq
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3, 200, 4)).astype(np.float32)
+    true = rng.normal(size=(3, 4, 1)).astype(np.float32)
+    y = np.einsum("rsd,rdk->rsk", X, true) + 2.0
+    w = np.ones((3, 200), np.float32)
+    fit = batched_lstsq(X, y, w)
+    np.testing.assert_allclose(np.asarray(fit.coefs), true, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(fit.intercept), 2.0, atol=1e-3)
+    assert (np.asarray(fit.r2) > 0.99).all()
+
+
+def test_batched_lasso_sparsifies():
+    from synapseml_tpu.explainers.solvers import batched_lasso
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(1, 300, 6)).astype(np.float32)
+    true = np.array([[3.0], [0.0], [0.0], [-2.0], [0.0], [0.0]], np.float32)
+    y = X[0] @ true + 0.01 * rng.normal(size=(300, 1)).astype(np.float32)
+    fit = batched_lasso(X, y[None], np.ones((1, 300), np.float32), 0.5)
+    c = np.asarray(fit.coefs)[0, :, 0]
+    assert abs(c[0]) > 1.0 and abs(c[3]) > 0.5
+    assert np.abs(c[[1, 2, 4, 5]]).max() < 0.2
+
+
+def test_vector_lime_ranks_features():
+    from synapseml_tpu.explainers import VectorLIME
+
+    w = np.array([2.0, 0.0, -1.0, 0.0], np.float32)
+    model = LinearModel(w)
+    rng = np.random.default_rng(2)
+    df = Table({"features": rng.normal(size=(5, 4)).astype(np.float32)})
+    out = VectorLIME(model=model, targetCol="probability", targetClasses=[1],
+                     numSamples=400).transform(df)
+    for i in range(5):
+        ex = out["explanation"][i][0]          # class-1 weights, (4,)
+        assert abs(ex[0]) > abs(ex[1])
+        assert abs(ex[2]) > abs(ex[3])
+        assert ex[0] > 0 and ex[2] < 0
+    assert (out["r2"] > 0.5).all()
+
+
+def test_vector_shap_additivity_and_ranking():
+    from synapseml_tpu.explainers import VectorSHAP
+
+    w = np.array([1.5, 0.0, -1.0], np.float32)
+    model = LinearModel(w)
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(4, 3)).astype(np.float32)
+    df = Table({"features": X})
+    out = VectorSHAP(model=model, targetCol="probability", targetClasses=[1],
+                     numSamples=600).transform(df)
+    p = 1 / (1 + np.exp(-(X @ w)))
+    for i in range(4):
+        vals = out["explanation"][i][0]        # (M+1,): [base, shap...]
+        # local accuracy: base + sum(shap) ≈ f(x)
+        np.testing.assert_allclose(vals.sum(), p[i], atol=0.05)
+        assert abs(vals[2]) < max(abs(vals[1]), abs(vals[3])) + 1e-3
+
+
+def test_tabular_lime_and_shap_named_columns():
+    from synapseml_tpu.explainers import TabularLIME, TabularSHAP
+
+    class ColModel(Transformer):
+        def _transform(self, df):
+            z = 3.0 * np.asarray(df["a"], np.float32) - 1.0 * np.asarray(df["b"], np.float32)
+            p = 1 / (1 + np.exp(-z))
+            return df.with_column("probability", np.stack([1 - p, p], 1))
+
+    rng = np.random.default_rng(4)
+    df = Table({"a": rng.normal(size=6).astype(np.float32),
+                "b": rng.normal(size=6).astype(np.float32),
+                "c": rng.normal(size=6).astype(np.float32)})
+    lime = TabularLIME(model=ColModel(), inputCols=["a", "b", "c"], targetClasses=[1],
+                       numSamples=400).transform(df)
+    ex = lime["explanation"][0][0]
+    assert abs(ex[0]) > abs(ex[2]) and abs(ex[1]) > abs(ex[2])
+
+    shap = TabularSHAP(model=ColModel(), inputCols=["a", "b", "c"], targetClasses=[1],
+                       numSamples=400).transform(df)
+    sv = shap["explanation"][0][0]
+    assert abs(sv[1]) > abs(sv[3]) and abs(sv[2]) > abs(sv[3])
+
+
+def test_text_lime_finds_signal_token():
+    from synapseml_tpu.explainers import TextLIME
+
+    class TextModel(Transformer):
+        def _transform(self, df):
+            p = np.array([1.0 if "good" in t else 0.0 for t in df["text"]], np.float32)
+            return df.with_column("probability", np.stack([1 - p, p], 1))
+
+    df = Table({"text": np.array(["this is a good movie", "bad film overall"], object)})
+    out = TextLIME(model=TextModel(), targetClasses=[1], numSamples=200).transform(df)
+    toks = out["tokens"][0]
+    weights = out["explanation"][0][0]
+    assert weights[toks.index("good")] == weights.max()
+
+
+def test_image_lime_and_superpixels():
+    from synapseml_tpu.explainers import ImageLIME
+
+    class BrightModel(Transformer):
+        def _transform(self, df):
+            # scores mean brightness of the top-left quadrant
+            p = np.array([np.asarray(im)[:8, :8].mean() / 255.0 for im in df["image"]],
+                         np.float32)
+            return df.with_column("probability", np.stack([1 - p, p], 1))
+
+    img = np.zeros((16, 16, 3), np.float32)
+    img[:8, :8] = 255.0                       # bright top-left quadrant
+    df = Table({"image": np.array([img], object)})
+    out = ImageLIME(model=BrightModel(), targetClasses=[1], cellSize=8.0,
+                    numSamples=64).transform(df)
+    segs = out["superpixels"][0]
+    weights = out["explanation"][0][0]
+    assert segs.shape == (16, 16)
+    # the superpixel covering the bright quadrant should get the top weight
+    bright_seg = segs[2, 2]
+    assert weights[bright_seg] == weights.max()
+
+
+def test_ice_individual_and_pdp():
+    from synapseml_tpu.explainers import ICETransformer
+
+    w = np.array([2.0, -1.0], np.float32)
+
+    class ColModel(Transformer):
+        def _transform(self, df):
+            z = 2.0 * np.asarray(df["x1"], np.float32) - np.asarray(df["x2"], np.float32)
+            return df.with_column("prediction", z)
+
+    rng = np.random.default_rng(5)
+    df = Table({"x1": rng.normal(size=8).astype(np.float32),
+                "x2": rng.normal(size=8).astype(np.float32)})
+    ice = ICETransformer(model=ColModel(), targetCol="prediction",
+                         numericFeatures=[{"name": "x1", "numSplits": 4}]).transform(df)
+    curves = ice["explanation_x1"]
+    assert curves[0].shape == (5, 1)
+    # increasing x1 grid → increasing prediction (slope 2)
+    assert (np.diff(curves[0][:, 0]) > 0).all()
+
+    pdp = ICETransformer(model=ColModel(), targetCol="prediction", kind="average",
+                         numericFeatures=[{"name": "x1", "numSplits": 4}],
+                         categoricalFeatures=[]).transform(df)
+    assert pdp.num_rows == 1
+    assert pdp["featureNames"][0] == "x1"
+
+
+def test_explainer_requires_model():
+    from synapseml_tpu.explainers import VectorLIME
+
+    df = Table({"features": np.zeros((2, 3), np.float32)})
+    with pytest.raises((ValueError, TypeError)):
+        VectorLIME(numSamples=10).transform(df)
+
+
+def test_slic_segments_cover_image():
+    from synapseml_tpu.image import slic_segments
+
+    rng = np.random.default_rng(6)
+    img = rng.uniform(0, 255, size=(32, 32, 3)).astype(np.float32)
+    segs = slic_segments(img, cell_size=8)
+    assert segs.shape == (32, 32)
+    k = segs.max() + 1
+    assert 4 <= k <= 32
+    assert set(np.unique(segs)) == set(range(k))
+
+
+def test_unroll_and_augment():
+    from synapseml_tpu.image import ImageSetAugmenter, UnrollImage
+
+    imgs = np.empty(2, object)
+    imgs[0] = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    imgs[1] = np.ones((2, 2, 3), np.float32)
+    df = Table({"image": imgs})
+    un = UnrollImage(inputCol="image").transform(df)
+    assert un["features"].shape == (2, 12)
+
+    aug = ImageSetAugmenter(inputCol="image", outputCol="image").transform(df)
+    assert aug.num_rows == 4
+    np.testing.assert_allclose(aug["image"][2], np.flip(imgs[0], axis=1))
+
+
+def test_augmenter_preserves_extra_columns():
+    from synapseml_tpu.image import ImageSetAugmenter
+
+    imgs = np.empty(2, object)
+    imgs[0] = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    imgs[1] = np.ones((2, 2, 3), np.float32)
+    df = Table({"image": imgs, "label": np.array([0, 1])})
+    aug = ImageSetAugmenter(inputCol="image", outputCol="image").transform(df)
+    assert aug.num_rows == 4
+    np.testing.assert_array_equal(aug["label"], [0, 1, 0, 1])
+
+
+def test_slic_tiny_image_single_segment():
+    from synapseml_tpu.image import slic_segments
+
+    segs = slic_segments(np.zeros((3, 3, 3), np.float32), 16)
+    assert segs.shape == (3, 3)
+    assert segs.max() == 0

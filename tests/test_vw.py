@@ -337,3 +337,17 @@ def test_dsjson_chosen_action_is_one_based():
                          "a": [1, 2], "p": [0.5, 0.5]})]
     out = VowpalWabbitDSJsonTransformer().transform(Table({"value": np.array(lines, object)}))
     assert out["chosenAction"][0] == 1
+
+
+def test_noconstant_keeps_zero_bias():
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitRegressor
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(200, 3)).astype(np.float32)
+    y = (X @ np.array([1.0, 2.0, -1.0], np.float32) + 5.0).astype(np.float32)
+    df = Table({"features": X, "label": y})
+    m = VowpalWabbitRegressor(numPasses=3, passThroughArgs="--noconstant").fit(df)
+    assert float(m.state.bias) == 0.0
+    m2 = VowpalWabbitRegressor(numPasses=3).fit(df)
+    assert abs(float(m2.state.bias)) > 0.5  # intercept learns the +5 offset

@@ -1,0 +1,11 @@
+"""AutoML (SURVEY §2.7 automl/, 800 LoC in reference): hyperparameter spaces,
+TuneHyperparameters (random/grid search with parallel cross-validation), and
+FindBestModel."""
+
+from .hyperparams import (DiscreteHyperParam, GridSpace, HyperparamBuilder,
+                          RandomSpace, RangeHyperParam)
+from .tune import FindBestModel, FindBestModelResult, TuneHyperparameters, TuneHyperparametersModel
+
+__all__ = ["DiscreteHyperParam", "RangeHyperParam", "HyperparamBuilder",
+           "GridSpace", "RandomSpace", "TuneHyperparameters",
+           "TuneHyperparametersModel", "FindBestModel", "FindBestModelResult"]

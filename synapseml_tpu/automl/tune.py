@@ -1,0 +1,161 @@
+"""TuneHyperparameters + FindBestModel.
+
+Reference: core/.../automl/TuneHyperparameters.scala:38-228 (random/grid search
+with parallel cross-validation over a thread pool; metric selects best) and
+FindBestModel.scala (evaluate fitted models on a dataset, pick the winner).
+
+Parallelism note: candidate fits run on a host thread pool like the reference;
+each fit's device work is XLA-serialized per chip, so threads mainly overlap
+host-side featurization + dispatch. On multi-chip meshes candidates can be
+placed on disjoint device subsets by the caller."""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.params import Param, HasLabelCol
+from ..core.pipeline import Estimator, Model, Transformer
+from ..core.table import Table
+from ..train.metrics import auc_score, regression_metrics
+from .hyperparams import GridSpace, RandomSpace
+
+_MAXIMIZE = {"AUC", "accuracy", "precision", "recall", "f1", "R^2", "ndcg"}
+
+
+def _evaluate(model: Transformer, df: Table, metric: str, label_col: str) -> float:
+    scored = model.transform(df)
+    y = np.asarray(df[label_col], np.float64)
+    if metric == "AUC":
+        s = scored["probability"][:, -1] if "probability" in scored else \
+            np.asarray(scored["prediction"], np.float64)
+        return auc_score(y, s)
+    if metric in ("accuracy", "precision", "recall", "f1"):
+        from ..train.metrics import binary_classification_metrics
+        return float(binary_classification_metrics(
+            y, np.asarray(scored["prediction"], np.float64))[metric])
+    m = regression_metrics(y, scored["prediction"])
+    return float(m[metric if metric in m else "rmse"])
+
+
+class TuneHyperparameters(Estimator, HasLabelCol):
+    """Random/grid hyperparameter search with k-fold CV."""
+    model = Param("model", "Base estimator (its copy is refit per candidate)", object)
+    paramSpace = Param("paramSpace", "Dict name→hyperparam space "
+                       "(HyperparamBuilder.build())", object)
+    searchMode = Param("searchMode", "random | grid", str, "random")
+    numRuns = Param("numRuns", "Candidates for random search", int, 10)
+    numFolds = Param("numFolds", "Cross-validation folds", int, 3)
+    evaluationMetric = Param("evaluationMetric", "AUC | accuracy | f1 | rmse | ...",
+                             str, "AUC")
+    parallelism = Param("parallelism", "Concurrent candidate fits", int, 4)
+    seed = Param("seed", "Search/CV seed", int, 0)
+
+    def _candidates(self) -> List[Dict[str, Any]]:
+        space = self.paramSpace
+        if self.searchMode == "grid":
+            return list(GridSpace(space))
+        return list(RandomSpace(space, self.numRuns, self.seed))
+
+    def _fit(self, df: Table) -> "TuneHyperparametersModel":
+        candidates = self._candidates()
+        k = max(self.numFolds, 2)
+        n = df.num_rows
+        rng = np.random.default_rng(self.seed)
+        perm = rng.permutation(n)
+        folds = np.array_split(perm, k)
+        metric = self.evaluationMetric
+        maximize = metric in _MAXIMIZE
+
+        def run(params: Dict[str, Any]) -> float:
+            scores = []
+            for f in range(k):
+                val_idx = folds[f]
+                train_idx = np.concatenate([folds[j] for j in range(k) if j != f])
+                est = self.model.copy(extra=params)
+                fitted = est.fit(df.take(train_idx))
+                scores.append(_evaluate(fitted, df.take(val_idx), metric, self.labelCol))
+            return float(np.nanmean(scores))
+
+        with ThreadPoolExecutor(max_workers=max(self.parallelism, 1)) as pool:
+            results = list(pool.map(run, candidates))
+
+        order = np.argsort(results)
+        best_i = int(order[-1] if maximize else order[0])
+        best_params = candidates[best_i]
+        best_model = self.model.copy(extra=best_params).fit(df)
+        return TuneHyperparametersModel(
+            bestModel=best_model, bestParams=best_params,
+            bestMetric=float(results[best_i]),
+            allResults=[{"params": c, "metric": r} for c, r in zip(candidates, results)])
+
+
+class TuneHyperparametersModel(Model):
+    bestModel = Param("bestModel", "Winning fitted model", object)
+    bestParams = Param("bestParams", "Winning hyperparameters", object)
+    bestMetric = Param("bestMetric", "Winning CV metric value", float)
+    allResults = Param("allResults", "All (params, metric) results", list)
+
+    def _transform(self, df: Table) -> Table:
+        return self.bestModel.transform(df)
+
+    def getBestModel(self):
+        return self.bestModel
+
+    def getBestModelInfo(self) -> dict:
+        return {"params": self.bestParams, "metric": self.bestMetric}
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        if self.get("bestModel") is not None:
+            self.bestModel.save(os.path.join(path, "bestModel"))
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        from ..core.pipeline import PipelineStage
+        p = os.path.join(path, "bestModel")
+        if os.path.isdir(p):
+            self.set("bestModel", PipelineStage.load(p))
+
+
+class FindBestModelResult(Model):
+    bestModel = Param("bestModel", "Winning fitted model", object)
+    allModelMetrics = Param("allModelMetrics", "Per-model metric values", list)
+
+    def _transform(self, df: Table) -> Table:
+        return self.bestModel.transform(df)
+
+    def _save_extra(self, path: str) -> None:
+        import os
+        if self.get("bestModel") is not None:
+            self.bestModel.save(os.path.join(path, "bestModel"))
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        from ..core.pipeline import PipelineStage
+        p = os.path.join(path, "bestModel")
+        if os.path.isdir(p):
+            self.set("bestModel", PipelineStage.load(p))
+
+
+class FindBestModel(Estimator, HasLabelCol):
+    """Pick the best of several already-fitted models on an evaluation dataset
+    (FindBestModel.scala)."""
+    models = Param("models", "Fitted Transformer list to compare", list)
+    evaluationMetric = Param("evaluationMetric", "Metric name", str, "AUC")
+
+    def _fit(self, df: Table) -> FindBestModelResult:
+        models = self.models or []
+        if not models:
+            raise ValueError("FindBestModel requires a non-empty `models` list")
+        metric = self.evaluationMetric
+        maximize = metric in _MAXIMIZE
+        scores = [_evaluate(m, df, metric, self.labelCol) for m in models]
+        order = np.argsort(scores)
+        best = models[int(order[-1] if maximize else order[0])]
+        return FindBestModelResult(
+            bestModel=best,
+            allModelMetrics=[{"model": type(m).__name__, "metric": s}
+                             for m, s in zip(models, scores)])

@@ -66,41 +66,58 @@ def lime_kernel_weights(distances: np.ndarray, kernel_width: float) -> np.ndarra
     return np.exp(-(distances ** 2) / (kernel_width ** 2)).astype(np.float32)
 
 
-def shap_kernel_weights(num_features: int, coalition_sizes: np.ndarray,
-                        inf_weight: float = 1e8) -> np.ndarray:
-    """Shapley kernel π(z) = (M-1) / (C(M,|z|)·|z|·(M-|z|)); empty/full
-    coalitions get infWeight (KernelSHAPBase infWeight param)."""
+def shap_kernel_lut(num_features: int, inf_weight: float = 1e8) -> np.ndarray:
+    """Size-indexed Shapley kernel weights: lut[s] = (M-1)/(C(M,s)·s·(M-s));
+    lut[0] = lut[M] = inf_weight (the weights depend only on coalition size)."""
     from math import comb
     m = num_features
-    w = np.empty(len(coalition_sizes), np.float64)
-    for i, s in enumerate(coalition_sizes):
-        s = int(s)
-        if s == 0 or s == m:
-            w[i] = inf_weight
-        else:
-            w[i] = (m - 1) / (comb(m, s) * s * (m - s))
-    return w.astype(np.float32)
+    lut = np.full(m + 1, inf_weight, np.float64)
+    for s in range(1, m):
+        lut[s] = (m - 1) / (comb(m, s) * s * (m - s))
+    return lut.astype(np.float32)
+
+
+def shap_kernel_weights(num_features: int, coalition_sizes: np.ndarray,
+                        inf_weight: float = 1e8) -> np.ndarray:
+    """Shapley kernel π(z) for a vector of coalition sizes (LUT-indexed)."""
+    lut = shap_kernel_lut(num_features, inf_weight)
+    return lut[np.asarray(coalition_sizes, np.int64)]
+
+
+def sample_coalitions_batch(rng: np.random.Generator, num_features: int,
+                            num_samples: int, num_rows: int = 1) -> np.ndarray:
+    """Coalition tensor (R, S, M) ∈ {0,1}: per row, sample 0 = empty coalition,
+    sample 1 = full, the rest uniform-within-size with sizes drawn ~
+    Shapley-kernel mass (KernelSHAPSampler). Fully vectorized: size-s masks via
+    rank-thresholded random keys."""
+    m, s, r = num_features, num_samples, num_rows
+    if s < 2:
+        raise ValueError(f"numSamples must be >= 2 (empty + full coalition), got {s}")
+    out = np.zeros((r, s, m), np.float32)
+    out[:, 1] = 1.0
+    if s > 2 and m > 1:
+        sizes = np.arange(1, m)
+        p = (m - 1) / (sizes * (m - sizes))
+        p = p / p.sum()
+        draw = rng.choice(sizes, size=(r, s - 2), p=p)            # (R, S-2)
+        keys = rng.random((r, s - 2, m))
+        ranks = np.argsort(np.argsort(keys, axis=-1), axis=-1)    # uniform ranks
+        out[:, 2:] = (ranks < draw[:, :, None]).astype(np.float32)
+    return out
 
 
 def sample_coalitions(rng: np.random.Generator, num_features: int,
                       num_samples: int) -> np.ndarray:
-    """Coalition matrix (num_samples, M) ∈ {0,1}: first the empty and full
-    coalitions, then sizes drawn ~ Shapley-kernel mass (KernelSHAPSampler)."""
-    m = num_features
-    if num_samples < 2:
-        raise ValueError(f"numSamples must be >= 2 (empty + full coalition), got {num_samples}")
-    out = np.zeros((num_samples, m), np.float32)
-    out[1] = 1.0
-    if num_samples == 2:
-        return out
-    sizes = np.arange(1, m)
-    if len(sizes):
-        p = (m - 1) / (sizes * (m - sizes))
-        p = p / p.sum()
-        draw = rng.choice(sizes, size=num_samples - 2, p=p)
-        for i, s in enumerate(draw):
-            on = rng.choice(m, size=s, replace=False)
-            out[i + 2, on] = 1.0
+    """(S, M) single-row convenience wrapper over sample_coalitions_batch."""
+    return sample_coalitions_batch(rng, num_features, num_samples, 1)[0]
+
+
+def coefs_to_column(coefs: np.ndarray) -> np.ndarray:
+    """(R, D, K) solver output → object column of per-row (K, D) matrices."""
+    r = coefs.shape[0]
+    out = np.empty(r, object)
+    for i in range(r):
+        out[i] = coefs[i].T
     return out
 
 

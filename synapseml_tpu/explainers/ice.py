@@ -35,7 +35,12 @@ class ICETransformer(LocalExplainerBase):
         splits = int(spec.get("numSplits", 10))
         lo = float(spec.get("rangeMin", np.nanmin(col)))
         hi = float(spec.get("rangeMax", np.nanmax(col)))
-        return np.linspace(lo, hi, splits + 1).astype(np.float64)
+        grid = np.linspace(lo, hi, splits + 1)
+        if np.issubdtype(col.dtype, np.integer):
+            # integer feature: evaluate at integer values only and report THE
+            # SAME values, so curves and featureValues stay aligned
+            grid = np.unique(np.round(grid)).astype(np.float64)
+        return grid.astype(np.float64)
 
     def _grid_for_categorical(self, spec: dict, col: np.ndarray) -> np.ndarray:
         top = int(spec.get("numTopValues", 100))

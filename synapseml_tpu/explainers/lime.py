@@ -19,7 +19,7 @@ import numpy as np
 from ..core.params import Param
 from ..core.table import Table
 from ..image.superpixel import Superpixel, slic_segments
-from .base import LocalExplainerBase, lime_kernel_weights
+from .base import LocalExplainerBase, coefs_to_column, lime_kernel_weights
 from .solvers import solve_batched
 
 
@@ -54,11 +54,7 @@ class VectorLIME(_LIMEParams):
         flat = Table({self.inputCol: samples.reshape(n * s, d)})
         y = self._score(flat).reshape(n, s, -1)
         fit = solve_batched(states, y, weights, self.regularization)
-        coefs = np.asarray(fit.coefs)                        # (n, d, k)
-        out_col = np.empty(n, object)
-        for i in range(n):
-            out_col[i] = coefs[i].T                          # (k, d)
-        out = df.with_column(self.outputCol, out_col)
+        out = df.with_column(self.outputCol, coefs_to_column(np.asarray(fit.coefs)))
         return out.with_column(self.metricsCol, np.asarray(fit.r2))
 
 
@@ -97,19 +93,19 @@ class TabularLIME(_LIMEParams):
                 mu, sd = float(bgv.mean()), float(bgv.std()) + 1e-12
                 noise = rng.normal(size=(n, s)).astype(np.float32)
                 draw = inst[:, None].astype(np.float32) + noise * sd
+                if np.issubdtype(inst.dtype, np.integer):
+                    # score and regress on the SAME values: round first so the
+                    # surrogate never sees variation the model didn't
+                    draw = np.round(draw)
                 states[:, :, j] = (draw - mu) / sd
-                dist2 += noise ** 2
+                dist2 += ((draw - inst[:, None]) / sd) ** 2
                 sample_cols[c] = draw.reshape(-1).astype(inst.dtype, copy=False)
         weights = lime_kernel_weights(np.sqrt(dist2), kw)
 
         flat = Table(sample_cols)
         y = self._score(flat).reshape(n, s, -1)
         fit = solve_batched(states, y, weights, self.regularization)
-        coefs = np.asarray(fit.coefs)
-        out_col = np.empty(n, object)
-        for i in range(n):
-            out_col[i] = coefs[i].T
-        out = df.with_column(self.outputCol, out_col)
+        out = df.with_column(self.outputCol, coefs_to_column(np.asarray(fit.coefs)))
         return out.with_column(self.metricsCol, np.asarray(fit.r2))
 
 

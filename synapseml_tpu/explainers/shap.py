@@ -16,7 +16,7 @@ from ..core.params import Param
 from ..core.table import Table
 from ..image.superpixel import Superpixel, slic_segments
 from .base import (LocalExplainerBase, default_num_samples, sample_coalitions,
-                   shap_kernel_weights)
+                   sample_coalitions_batch, shap_kernel_lut)
 from .solvers import solve_batched
 
 
@@ -55,9 +55,10 @@ class _SHAPParams(LocalExplainerBase):
                 out[i] = np.concatenate([base[i][:, None], delta[i][:, None]], 1)
             return out, np.ones((r, k), np.float32)
 
-        # per-row kernel weights — each row has its own coalition draw
-        w = np.stack([shap_kernel_weights(m, coalitions[i].sum(1), inf_weight=0.0)
-                      for i in range(r)])      # empty/full rows get weight 0
+        # per-row kernel weights — each row has its own coalition draw; the
+        # kernel depends only on |z| so one size-indexed LUT serves all rows
+        lut = shap_kernel_lut(m, inf_weight=0.0)   # empty/full rows get weight 0
+        w = lut[coalitions.sum(axis=2).astype(np.int64)]
         z_last = coalitions[:, :, -1:]
         Zr = coalitions[:, :, :-1] - z_last    # (R, S, M-1)
         target = y - base[:, None, :] - z_last * delta[:, None, :]
@@ -93,7 +94,7 @@ class VectorSHAP(_SHAPParams):
         s = self.get("numSamples") or default_num_samples(d)
         rng = np.random.default_rng(0)
 
-        coalitions = np.stack([sample_coalitions(rng, d, s) for _ in range(n)])
+        coalitions = sample_coalitions_batch(rng, d, s, n)
         bg_rows = bgX[rng.integers(0, len(bgX), size=(n, s))]
         samples = np.where(coalitions > 0, X[:, None, :], bg_rows)
         y = self._score(Table({self.inputCol: samples.reshape(n * s, d)})).reshape(n, s, -1)
@@ -118,7 +119,7 @@ class TabularSHAP(_SHAPParams):
         s = self.get("numSamples") or default_num_samples(d)
         rng = np.random.default_rng(0)
 
-        coalitions = np.stack([sample_coalitions(rng, d, s) for _ in range(n)])
+        coalitions = sample_coalitions_batch(rng, d, s, n)
         bg_idx = rng.integers(0, bg.num_rows, size=(n, s))
         sample_cols = {}
         for j, c in enumerate(cols):

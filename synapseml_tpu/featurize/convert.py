@@ -1,0 +1,41 @@
+"""DataConversion: cast columns between types (reference:
+core/.../featurize/DataConversion.scala — convertTo boolean/byte/short/integer/
+long/float/double/string/toCategorical/clearCategorical/date)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.params import Param
+from ..core.pipeline import Transformer
+from ..core.table import Table
+
+_CASTS = {
+    "boolean": np.bool_, "byte": np.int8, "short": np.int16, "integer": np.int32,
+    "long": np.int64, "float": np.float32, "double": np.float64,
+}
+
+
+class DataConversion(Transformer):
+    cols = Param("cols", "Columns to convert", list)
+    convertTo = Param("convertTo", "Target type: boolean|byte|short|integer|long|"
+                      "float|double|string|date", str, "double")
+    dateTimeFormat = Param("dateTimeFormat", "Format for date conversion", str,
+                           "yyyy-MM-dd HH:mm:ss")
+
+    def _transform(self, df: Table) -> Table:
+        out = df.copy()
+        for c in (self.cols or []):
+            a = df[c]
+            t = self.convertTo
+            if t == "string":
+                out[c] = np.array([str(v) for v in a], dtype=object)
+            elif t == "date":
+                out[c] = np.asarray(a, dtype="datetime64[s]")
+            elif t in _CASTS:
+                out[c] = np.asarray(a, dtype=object if a.dtype == object else a.dtype
+                                    ).astype(_CASTS[t])
+            else:
+                raise ValueError(f"unknown convertTo {t!r}; options: "
+                                 f"{sorted(_CASTS) + ['string', 'date']}")
+        return out

@@ -24,10 +24,13 @@ class UnrollImage(Transformer, HasInputCol, HasOutputCol):
     def _transform(self, df: Table) -> Table:
         imgs = df[self.inputCol]
         flat = [np.asarray(imgs[i], np.float32).ravel() for i in range(df.num_rows)]
-        d = max((len(f) for f in flat), default=0)
-        out = np.zeros((df.num_rows, d), np.float32)
-        for i, f in enumerate(flat):
-            out[i, :len(f)] = f
+        dims = {len(f) for f in flat}
+        if len(dims) > 1:
+            raise ValueError(
+                f"UnrollImage requires uniformly-sized images; got flattened "
+                f"lengths {sorted(dims)} — resize/crop first (ops.image)")
+        d = dims.pop() if dims else 0
+        out = np.stack(flat) if flat else np.zeros((0, d), np.float32)
         return df.with_column(self.outputCol, out)
 
 

@@ -1,0 +1,236 @@
+"""Featurize / train helpers / AutoML tests (SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.table import Table
+
+
+def _mixed_df(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    return Table({
+        "num": rng.normal(size=n).astype(np.float32),
+        "missing": np.where(rng.random(n) < 0.2, np.nan, rng.normal(size=n)),
+        "cat": rng.choice(["a", "b", "c"], size=n).astype(object),
+        "label": (rng.random(n) > 0.5).astype(np.float32),
+    })
+
+
+def test_featurize_mixed_types():
+    from synapseml_tpu.featurize import Featurize
+
+    df = _mixed_df()
+    model = Featurize(inputCols=["num", "missing", "cat"]).fit(df)
+    out = model.transform(df)
+    X = out["features"]
+    assert X.shape == (60, 1 + 1 + 3)  # num + missing + 3 one-hot levels
+    assert np.isfinite(X).all()        # NaNs imputed
+    assert model.feature_dim == 5
+
+
+def test_featurize_high_cardinality_hashes():
+    from synapseml_tpu.featurize import Featurize
+
+    rng = np.random.default_rng(1)
+    df = Table({"id": np.array([f"user{i}" for i in range(50)], object)})
+    model = Featurize(inputCols=["id"], numFeatures=16).fit(df)
+    assert model.transform(df)["features"].shape == (50, 16)
+
+
+def test_clean_missing_data_modes():
+    from synapseml_tpu.featurize import CleanMissingData
+
+    df = Table({"x": np.array([1.0, np.nan, 3.0, np.nan], np.float64)})
+    mean = CleanMissingData(inputCols=["x"]).fit(df).transform(df)
+    np.testing.assert_allclose(mean["x"], [1, 2, 3, 2])
+    med = CleanMissingData(inputCols=["x"], cleaningMode="Median").fit(df).transform(df)
+    np.testing.assert_allclose(med["x"], [1, 2, 3, 2])
+    cust = CleanMissingData(inputCols=["x"], cleaningMode="Custom",
+                            customValue=-1.0).fit(df).transform(df)
+    np.testing.assert_allclose(cust["x"], [1, -1, 3, -1])
+
+
+def test_value_indexer_round_trip():
+    from synapseml_tpu.featurize import IndexToValue, ValueIndexer
+
+    df = Table({"c": np.array(["b", "a", "c", "a"], object)})
+    model = ValueIndexer(inputCol="c", outputCol="ci").fit(df)
+    out = model.transform(df)
+    np.testing.assert_array_equal(out["ci"], [1, 0, 2, 0])
+    back = IndexToValue(inputCol="ci", outputCol="cv", levels=model.levels).transform(out)
+    assert list(back["cv"]) == ["b", "a", "c", "a"]
+    # unseen value gets unknownIndex
+    out2 = model.transform(Table({"c": np.array(["z"], object)}))
+    assert out2["ci"][0] == -1
+
+
+def test_count_selector_drops_zero_slots():
+    from synapseml_tpu.featurize import CountSelector
+
+    X = np.zeros((10, 4), np.float32)
+    X[:, 1] = 1.0
+    X[::2, 3] = 2.0
+    df = Table({"features": X})
+    out = CountSelector().fit(df).transform(df)
+    assert out["features"].shape == (10, 2)
+
+
+def test_data_conversion():
+    from synapseml_tpu.featurize import DataConversion
+
+    df = Table({"x": np.array([1.7, 2.2]), "s": np.array([1, 2])})
+    out = DataConversion(cols=["x"], convertTo="integer").transform(df)
+    assert out["x"].dtype == np.int32
+    out2 = DataConversion(cols=["s"], convertTo="string").transform(df)
+    assert out2["s"].dtype == object and out2["s"][0] == "1"
+    with pytest.raises(ValueError, match="unknown convertTo"):
+        DataConversion(cols=["x"], convertTo="complex").transform(df)
+
+
+def test_text_featurizer_idf_pipeline():
+    from synapseml_tpu.featurize import TextFeaturizer
+
+    texts = np.array(["the cat sat", "the dog ran fast", "cat and dog play"], object)
+    df = Table({"text": texts})
+    model = TextFeaturizer(inputCol="text", numFeatures=64, useIDF=True).fit(df)
+    X = model.transform(df)["features"]
+    assert X.shape == (3, 64)
+    # 'the' appears in 2 docs → lower idf weight than 'sat' (1 doc)
+    assert (X != 0).any()
+
+
+def test_multi_ngram_and_page_splitter():
+    from synapseml_tpu.featurize import MultiNGram, PageSplitter
+
+    toks = np.empty(1, object)
+    toks[0] = ["a", "b", "c"]
+    out = MultiNGram(inputCol="tokens", outputCol="grams",
+                     lengths=[1, 2]).transform(Table({"tokens": toks}))
+    assert out["grams"][0] == ["a", "b", "c", "a b", "b c"]
+
+    text = np.array(["word " * 100], object)   # 500 chars
+    pages = PageSplitter(inputCol="t", maximumPageLength=120,
+                         minimumPageLength=80).transform(Table({"t": text}))["pages"][0]
+    assert all(len(p) <= 120 for p in pages)
+    assert "".join(pages) == text[0]
+
+
+def test_compute_model_statistics_classification_and_regression():
+    from synapseml_tpu.train import ComputeModelStatistics
+
+    df = Table({"label": np.array([0, 0, 1, 1], np.float64),
+                "prediction": np.array([0, 1, 1, 1], np.float64),
+                "probability": np.array([[0.9, 0.1], [0.4, 0.6], [0.2, 0.8], [0.1, 0.9]])})
+    stats = ComputeModelStatistics(evaluationMetric="classification",
+                                   scoresCol="probability").transform(df)
+    assert stats["accuracy"][0] == pytest.approx(0.75)
+    assert stats["AUC"][0] == pytest.approx(1.0)
+
+    dfr = Table({"label": np.array([1.0, 2.0, 3.0]),
+                 "prediction": np.array([1.1, 2.1, 2.9])})
+    statsr = ComputeModelStatistics(evaluationMetric="regression").transform(dfr)
+    assert statsr["rmse"][0] == pytest.approx(0.1, abs=1e-6)
+    assert statsr["R^2"][0] > 0.95
+
+
+def test_per_instance_statistics():
+    from synapseml_tpu.train import ComputePerInstanceStatistics
+
+    df = Table({"label": np.array([0.0, 1.0]),
+                "prediction": np.array([0.0, 1.0]),
+                "probability": np.array([[0.8, 0.2], [0.3, 0.7]])})
+    out = ComputePerInstanceStatistics().transform(df)
+    np.testing.assert_allclose(out["log_loss"], [-np.log(0.8), -np.log(0.7)], rtol=1e-6)
+
+
+def test_train_classifier_end_to_end(binary_data):
+    from synapseml_tpu.models import LightGBMClassifier
+    from synapseml_tpu.train import TrainClassifier
+
+    Xtr, Xte, ytr, yte = binary_data
+    df = Table({f"f{j}": Xtr[:, j] for j in range(6)})
+    df["label"] = np.where(ytr > 0, "pos", "neg").astype(object)  # string labels
+    est = TrainClassifier(model=LightGBMClassifier(numIterations=20), labelCol="label")
+    model = est.fit(df)
+    te = Table({f"f{j}": Xte[:, j] for j in range(6)})
+    out = model.transform(te)
+    assert "scored_labels" in out
+    acc = (out["scored_labels"] == np.where(yte > 0, "pos", "neg")).mean()
+    assert acc > 0.85
+
+
+def test_train_regressor_end_to_end(regression_data):
+    from synapseml_tpu.models import LightGBMRegressor
+    from synapseml_tpu.train import TrainRegressor
+    from synapseml_tpu.train.metrics import regression_metrics
+
+    Xtr, Xte, ytr, yte = regression_data
+    df = Table({f"f{j}": Xtr[:, j] for j in range(Xtr.shape[1])})
+    df["label"] = ytr
+    model = TrainRegressor(model=LightGBMRegressor(numIterations=30)).fit(df)
+    te = Table({f"f{j}": Xte[:, j] for j in range(Xte.shape[1])})
+    pred = model.transform(te)["prediction"]
+    m = regression_metrics(yte, pred)
+    assert m["R^2"] > 0.2
+
+
+def test_hyperparam_spaces():
+    from synapseml_tpu.automl import (DiscreteHyperParam, GridSpace,
+                                      HyperparamBuilder, RandomSpace, RangeHyperParam)
+
+    space = (HyperparamBuilder()
+             .addHyperparam("numLeaves", DiscreteHyperParam([7, 15]))
+             .addHyperparam("learningRate", RangeHyperParam(0.01, 0.3, log=True))
+             .build())
+    grid = list(GridSpace(space, grid_points=3))
+    assert len(grid) == 2 * 3
+    rand = list(RandomSpace(space, 5, seed=1))
+    assert len(rand) == 5
+    assert all(0.01 <= c["learningRate"] <= 0.3 for c in rand)
+    assert all(c["numLeaves"] in (7, 15) for c in rand)
+
+
+def test_tune_hyperparameters_cv(binary_data):
+    from synapseml_tpu.automl import (DiscreteHyperParam, HyperparamBuilder,
+                                      TuneHyperparameters)
+    from synapseml_tpu.models import LightGBMClassifier
+
+    Xtr, Xte, ytr, yte = binary_data
+    df = Table({"features": Xtr[:150], "label": ytr[:150]})
+    space = (HyperparamBuilder()
+             .addHyperparam("numLeaves", DiscreteHyperParam([3, 15]))
+             .build())
+    tuned = TuneHyperparameters(model=LightGBMClassifier(numIterations=10),
+                                paramSpace=space, searchMode="grid", numFolds=2,
+                                evaluationMetric="AUC", parallelism=2).fit(df)
+    info = tuned.getBestModelInfo()
+    assert info["params"]["numLeaves"] in (3, 15)
+    assert 0.5 < info["metric"] <= 1.0
+    out = tuned.transform(Table({"features": Xte}))
+    assert "prediction" in out
+    assert len(tuned.allResults) == 2
+
+
+def test_find_best_model(binary_data):
+    from synapseml_tpu.automl import FindBestModel
+    from synapseml_tpu.models import LightGBMClassifier
+
+    Xtr, Xte, ytr, yte = binary_data
+    tr = Table({"features": Xtr, "label": ytr})
+    te = Table({"features": Xte, "label": yte})
+    weak = LightGBMClassifier(numIterations=1, numLeaves=2).fit(tr)
+    strong = LightGBMClassifier(numIterations=30).fit(tr)
+    best = FindBestModel(models=[weak, strong], evaluationMetric="AUC").fit(te)
+    assert best.bestModel is strong
+    assert len(best.allModelMetrics) == 2
+
+
+def test_ranking_ndcg_metric():
+    from synapseml_tpu.train import ranking_ndcg
+
+    y = np.array([3, 2, 1, 0, 3, 0])
+    g = np.array([0, 0, 0, 0, 1, 1])
+    perfect = ranking_ndcg(y, y.astype(float), g)
+    assert perfect == pytest.approx(1.0)
+    worst = ranking_ndcg(y, -y.astype(float), g)
+    assert worst < 1.0

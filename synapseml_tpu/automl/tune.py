@@ -82,8 +82,9 @@ class TuneHyperparameters(Estimator, HasLabelCol):
         with ThreadPoolExecutor(max_workers=max(self.parallelism, 1)) as pool:
             results = list(pool.map(run, candidates))
 
-        order = np.argsort(results)
-        best_i = int(order[-1] if maximize else order[0])
+        if np.all(np.isnan(results)):
+            raise ValueError("every candidate scored NaN — check labels/folds")
+        best_i = int(np.nanargmax(results) if maximize else np.nanargmin(results))
         best_params = candidates[best_i]
         best_model = self.model.copy(extra=best_params).fit(df)
         return TuneHyperparametersModel(
@@ -153,8 +154,9 @@ class FindBestModel(Estimator, HasLabelCol):
         metric = self.evaluationMetric
         maximize = metric in _MAXIMIZE
         scores = [_evaluate(m, df, metric, self.labelCol) for m in models]
-        order = np.argsort(scores)
-        best = models[int(order[-1] if maximize else order[0])]
+        if np.all(np.isnan(scores)):
+            raise ValueError("every model scored NaN — check labels/metric")
+        best = models[int(np.nanargmax(scores) if maximize else np.nanargmin(scores))]
         return FindBestModelResult(
             bestModel=best,
             allModelMetrics=[{"model": type(m).__name__, "metric": s}

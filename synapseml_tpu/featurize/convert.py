@@ -31,7 +31,18 @@ class DataConversion(Transformer):
             if t == "string":
                 out[c] = np.array([str(v) for v in a], dtype=object)
             elif t == "date":
-                out[c] = np.asarray(a, dtype="datetime64[s]")
+                fmt = self.dateTimeFormat
+                if a.dtype == object and fmt:
+                    from datetime import datetime
+                    # translate the reference's Java-style pattern to strptime
+                    py_fmt = (fmt.replace("yyyy", "%Y").replace("MM", "%m")
+                              .replace("dd", "%d").replace("HH", "%H")
+                              .replace("mm", "%M").replace("ss", "%S"))
+                    out[c] = np.array(
+                        [np.datetime64(datetime.strptime(str(v), py_fmt), "s")
+                         for v in a], dtype="datetime64[s]")
+                else:
+                    out[c] = np.asarray(a, dtype="datetime64[s]")
             elif t in _CASTS:
                 out[c] = np.asarray(a, dtype=object if a.dtype == object else a.dtype
                                     ).astype(_CASTS[t])

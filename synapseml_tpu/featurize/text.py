@@ -48,7 +48,7 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
     useTokenizer = Param("useTokenizer", "Tokenize the input", bool, True)
     tokenizerPattern = Param("tokenizerPattern", "Split regex", str, r"\W+")
     toLowercase = Param("toLowercase", "Lowercase before tokenizing", bool, True)
-    minTokenLength = Param("minTokenLength", "Minimum token length", int, 0)
+    minTokenLength = Param("minTokenLength", "Minimum token length", int, 1)
     useStopWordsRemover = Param("useStopWordsRemover", "Remove stop words", bool, False)
     useNGram = Param("useNGram", "Produce n-grams", bool, False)
     nGramLength = Param("nGramLength", "n-gram length", int, 2)
@@ -94,7 +94,7 @@ class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
     useTokenizer = Param("useTokenizer", "Tokenize the input", bool, True)
     tokenizerPattern = Param("tokenizerPattern", "Split regex", str, r"\W+")
     toLowercase = Param("toLowercase", "Lowercase before tokenizing", bool, True)
-    minTokenLength = Param("minTokenLength", "Minimum token length", int, 0)
+    minTokenLength = Param("minTokenLength", "Minimum token length", int, 1)
     useStopWordsRemover = Param("useStopWordsRemover", "Remove stop words", bool, False)
     useNGram = Param("useNGram", "Produce n-grams", bool, False)
     nGramLength = Param("nGramLength", "n-gram length", int, 2)

@@ -1,0 +1,22 @@
+"""Nearest neighbors — TPU-native maximum-inner-product search.
+
+Reference: core/src/main/scala/com/microsoft/azure/synapse/ml/nn/
+(BallTree.scala, KNN.scala:49-127, ConditionalKNN.scala; SURVEY.md §2.7).
+The reference answers max-inner-product queries with a serial ball-tree
+pointer chase per row (driver-built, broadcast, UDF per query). On TPU the
+idiomatic design is batched: all queries × all keys as blocked matmuls on the
+MXU with ``lax.top_k``, with an optional two-level ball index that prunes key
+blocks by an inner-product upper bound for large corpora.
+"""
+
+from .balltree import BallTree, ConditionalBallTree
+from .knn import KNN, KNNModel, ConditionalKNN, ConditionalKNNModel
+
+__all__ = [
+    "BallTree",
+    "ConditionalBallTree",
+    "KNN",
+    "KNNModel",
+    "ConditionalKNN",
+    "ConditionalKNNModel",
+]

@@ -35,7 +35,6 @@ def auc_score(y_true: np.ndarray, score: np.ndarray) -> float:
     order = np.argsort(s, kind="mergesort")
     ranks = np.empty(len(s), np.float64)
     sorted_s = s[order]
-    ranks[order] = np.arange(1, len(s) + 1)
     # average ranks over ties
     _, inv, counts = np.unique(sorted_s, return_inverse=True, return_counts=True)
     cum = np.cumsum(counts)

@@ -36,10 +36,11 @@ class TrainClassifier(_TrainBase):
         indexer = ValueIndexer(inputCol=self.labelCol,
                                outputCol="__label_indexed").fit(work)
         work = indexer.transform(work)
-        est = self.model
-        if est is None:
+        if self.model is None:
             from ..models import LightGBMClassifier
             est = LightGBMClassifier()
+        else:
+            est = self.model.copy()  # never mutate the caller's estimator
         est.set("labelCol", "__label_indexed")
         est.set("featuresCol", self.featuresCol)
         fitted = est.fit(work)
@@ -53,10 +54,11 @@ class TrainRegressor(_TrainBase):
     def _fit(self, df: Table) -> "TrainedRegressorModel":
         fz = self._featurizer(df)
         work = fz.transform(df) if fz is not None else df
-        est = self.model
-        if est is None:
+        if self.model is None:
             from ..models import LightGBMRegressor
             est = LightGBMRegressor()
+        else:
+            est = self.model.copy()  # never mutate the caller's estimator
         est.set("labelCol", self.labelCol)
         est.set("featuresCol", self.featuresCol)
         fitted = est.fit(work)

@@ -1,0 +1,105 @@
+"""Nearest-neighbor tests (reference: core nn test suites — KNN/ConditionalKNN
+max-inner-product correctness and serialization fuzzing, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.pipeline import PipelineStage
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.nn import (BallTree, ConditionalBallTree, ConditionalKNN,
+                              KNN)
+
+
+def _random_keys(n=200, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, d)).astype(np.float32)
+
+
+class TestBallTree:
+    def test_exact_vs_numpy(self):
+        keys = _random_keys()
+        tree = BallTree(keys, leaf_size=16)
+        q = _random_keys(8, 16, seed=1)
+        idx, scores = tree.query_batch(q, k=5)
+        ref = q @ keys.T
+        for r in range(len(q)):
+            expect = np.argsort(-ref[r])[:5]
+            np.testing.assert_array_equal(idx[r], expect)
+            np.testing.assert_allclose(scores[r], ref[r][expect], rtol=1e-4)
+
+    def test_single_query_api(self):
+        keys = _random_keys(50)
+        tree = BallTree(keys, values=[f"v{i}" for i in range(50)])
+        matches = tree.find_maximum_inner_products(keys[7], k=1)
+        assert matches[0].index == 7
+        assert tree.values[matches[0].index] == "v7"
+
+    def test_pruned_matches_exact(self):
+        keys = _random_keys(3000, 8)
+        tree = BallTree(keys, leaf_size=32)
+        q = _random_keys(4, 8, seed=3)
+        i_exact, s_exact = tree.query_batch(q, k=3, prune=False)
+        i_pruned, s_pruned = tree.query_batch(q, k=3, prune=True)
+        np.testing.assert_allclose(np.sort(s_pruned, axis=1),
+                                   np.sort(s_exact, axis=1), rtol=1e-3)
+
+    def test_save_load(self, tmp_path):
+        keys = _random_keys(30)
+        tree = BallTree(keys)
+        p = str(tmp_path / "tree.pkl")
+        tree.save(p)
+        loaded = BallTree.load(p)
+        np.testing.assert_array_equal(loaded.keys, tree.keys)
+
+
+class TestConditionalBallTree:
+    def test_conditioner_restricts(self):
+        keys = _random_keys(100)
+        labels = ["a" if i % 2 == 0 else "b" for i in range(100)]
+        tree = ConditionalBallTree(keys, labels)
+        matches = tree.find_maximum_inner_products(keys[1], {"a"}, k=5)
+        for m in matches:
+            assert labels[m.index] == "a"
+
+
+class TestKNNEstimators:
+    def test_knn_fit_transform(self):
+        keys = _random_keys(64)
+        df = Table({"features": keys,
+                    "values": np.array([f"id{i}" for i in range(64)])})
+        model = KNN(k=3).fit(df)
+        out = model.transform(Table({"features": keys[:5]}))
+        col = out[model.getOutputCol()]
+        assert len(col) == 5
+        assert {"value", "distance"} <= set(col[0][0].keys())
+        ref = keys[:5] @ keys.T
+        for r in range(5):
+            assert col[r][0]["value"] == f"id{np.argmax(ref[r])}"
+        assert len(col[0]) == 3
+
+    def test_conditional_knn(self):
+        keys = _random_keys(60)
+        labels = np.array(["x" if i < 30 else "y" for i in range(60)])
+        df = Table({"features": keys, "values": np.arange(60),
+                    "labels": labels})
+        model = ConditionalKNN(k=4).fit(df)
+        conds = np.empty(3, dtype=object)
+        for i in range(3):
+            conds[i] = ["y"]
+        out = model.transform(Table({"features": keys[:3],
+                                     "conditioner": conds}))
+        for row in out[model.getOutputCol()]:
+            for m in row:
+                assert m["value"] >= 30
+
+    def test_model_save_load(self, tmp_path):
+        keys = _random_keys(40)
+        df = Table({"features": keys, "values": np.arange(40)})
+        model = KNN(k=2).fit(df)
+        p = str(tmp_path / "knn_model")
+        model.save(p)
+        loaded = PipelineStage.load(p)
+        out1 = model.transform(Table({"features": keys[:4]}))
+        out2 = loaded.transform(Table({"features": keys[:4]}))
+        for a, b in zip(out1[model.getOutputCol()], out2[loaded.getOutputCol()]):
+            assert [m["distance"] for m in a] == [m["distance"] for m in b]

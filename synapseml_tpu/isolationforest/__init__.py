@@ -1,0 +1,13 @@
+"""Isolation forest anomaly detection.
+
+Reference: core/.../isolationforest/IsolationForest.scala:17-72 — a thin wrapper
+over LinkedIn's com.linkedin.isolation-forest estimator (SURVEY.md §2 N8:
+"Own iForest implementation (vectorizable in XLA)"). Here the forest itself is
+implemented: trees are grown host-side on small subsamples (cheap), encoded as
+flat arrays, and scoring is a batched fixed-depth gather walk over all trees at
+once under ``jit`` — no per-row recursion.
+"""
+
+from .iforest import IsolationForest, IsolationForestModel
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

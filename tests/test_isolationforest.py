@@ -1,0 +1,56 @@
+"""Isolation-forest tests (reference: isolationforest wrapper + LinkedIn
+estimator behavior; SURVEY.md §2 N8)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.pipeline import PipelineStage
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.isolationforest import IsolationForest
+
+
+def _data(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    X[:5] += 8.0  # obvious outliers
+    return Table({"features": X})
+
+
+class TestIsolationForest:
+    def test_outliers_score_higher(self):
+        df = _data()
+        model = IsolationForest(numEstimators=50, maxSamples=64.0,
+                                randomSeed=7).fit(df)
+        out = model.transform(df)
+        s = out[model.getScoreCol()]
+        assert s.shape == (300,)
+        assert (0 <= s).all() and (s <= 1).all()
+        # the 5 shifted rows should rank in the top scores
+        top10 = np.argsort(-s)[:10]
+        assert len(set(range(5)) & set(top10)) >= 4
+
+    def test_contamination_thresholds_labels(self):
+        df = _data()
+        model = IsolationForest(numEstimators=50, maxSamples=64.0,
+                                contamination=0.02, randomSeed=7).fit(df)
+        out = model.transform(df)
+        labels = out[model.getPredictionCol()]
+        assert 1 <= labels.sum() <= 20
+        # without contamination, all labels are 0
+        m0 = IsolationForest(numEstimators=20, maxSamples=32.0).fit(df)
+        assert m0.transform(df)[m0.getPredictionCol()].sum() == 0
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            IsolationForest().fit(Table({"features": np.zeros((0, 3))}))
+
+    def test_save_load(self, tmp_path):
+        df = _data(100)
+        model = IsolationForest(numEstimators=10, maxSamples=32.0,
+                                randomSeed=1).fit(df)
+        p = str(tmp_path / "iforest")
+        model.save(p)
+        loaded = PipelineStage.load(p)
+        np.testing.assert_allclose(
+            loaded.transform(df)[loaded.getScoreCol()],
+            model.transform(df)[model.getScoreCol()])

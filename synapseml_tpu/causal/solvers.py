@@ -1,0 +1,108 @@
+"""Causal solvers: OLS with standard errors, simplex-constrained least squares.
+
+Reference: causal/opt/ConstrainedLeastSquare.scala + MirrorDescent.scala —
+the synthetic-control weight solve ``min ‖A w − b‖² + λ‖w‖²`` s.t. ``w ≥ 0,
+Σw = 1`` done there as a driver-coordinated mirror-descent over distributed
+vectors (causal/linalg). Here the whole solve is one jitted
+exponentiated-gradient loop (`lax.fori_loop`) on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def linear_regression_with_se(X: np.ndarray, y: np.ndarray,
+                              weights: Optional[np.ndarray] = None,
+                              fit_intercept: bool = True
+                              ) -> Tuple[np.ndarray, np.ndarray]:
+    """(coefficients, standard_errors) of OLS/WLS — the final-stage regression
+    of every estimator here (reference fitLinearModel,
+    BaseDiffInDiffEstimator.scala:49-72). Intercept, if fit, is the last
+    coefficient."""
+    X = np.asarray(X, dtype=np.float64)
+    if X.ndim == 1:
+        X = X[:, None]
+    y = np.asarray(y, dtype=np.float64)
+    n = X.shape[0]
+    if fit_intercept:
+        X = np.concatenate([X, np.ones((n, 1))], axis=1)
+    w = np.ones(n) if weights is None else np.asarray(weights, dtype=np.float64)
+    Xw = X * w[:, None]
+    XtX = Xw.T @ X
+    beta = np.linalg.solve(XtX + 1e-12 * np.eye(X.shape[1]), Xw.T @ y)
+    resid = y - X @ beta
+    dof = max(n - X.shape[1], 1)
+    sigma2 = float((w * resid ** 2).sum() / dof)
+    cov = sigma2 * np.linalg.inv(XtX + 1e-12 * np.eye(X.shape[1]))
+    return beta, np.sqrt(np.diag(cov))
+
+
+def constrained_least_squares(A: np.ndarray, b: np.ndarray,
+                              lambda_: float = 0.0,
+                              fit_intercept: bool = False,
+                              max_iter: int = 200,
+                              num_iter_no_change: Optional[int] = None,
+                              tol: float = 1e-8) -> Tuple[np.ndarray, float]:
+    """``min_w ‖A w − b‖² + λn‖w‖²  s.t. w in simplex`` via exponentiated
+    gradient (mirror descent with entropy mirror map). Returns (w, intercept).
+
+    Reference: causal/opt/ConstrainedLeastSquare.scala (step-size line search +
+    numIterNoChange early stop) built on MirrorDescent.scala. The jitted
+    ``while_loop`` keeps the best iterate seen and stops after
+    ``num_iter_no_change`` iterations without a > ``tol`` improvement.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    A = np.asarray(A, dtype=np.float32)
+    b = np.asarray(b, dtype=np.float32)
+    m, n = A.shape
+    patience = max_iter if num_iter_no_change is None else int(num_iter_no_change)
+
+    def _solve(Aj, bj):
+        lam = jnp.float32(lambda_ * n)
+
+        def loss_and_intercept(w):
+            r = Aj @ w - bj
+            c = jnp.mean(r) if fit_intercept else jnp.float32(0.0)
+            r = r - c
+            return jnp.sum(r ** 2) + lam * jnp.sum(w ** 2), c
+
+        def grad(w):
+            r = Aj @ w - bj
+            if fit_intercept:
+                r = r - jnp.mean(r)
+            return 2.0 * (Aj.T @ r) + 2.0 * lam * w
+
+        def cond(state):
+            i, _, _, _, stall = state
+            return (i < max_iter) & (stall < patience)
+
+        def body(state):
+            i, w, best_w, best_loss, stall = state
+            g = grad(w)
+            # exponentiated-gradient step; eta ~ 1/(1+i) damping
+            eta = jnp.float32(1.0) / (1.0 + 0.1 * i)
+            logw = jnp.log(jnp.clip(w, 1e-20)) - eta * g
+            logw = logw - jnp.max(logw)
+            w_new = jnp.exp(logw)
+            w_new = w_new / jnp.sum(w_new)
+            loss, _ = loss_and_intercept(w_new)
+            improved = loss < best_loss - tol
+            best_w = jnp.where(improved, w_new, best_w)
+            stall = jnp.where(improved, 0, stall + 1)
+            best_loss = jnp.minimum(best_loss, loss)
+            return i + 1, w_new, best_w, best_loss, stall
+
+        w0 = jnp.full((n,), 1.0 / n, dtype=jnp.float32)
+        l0, _ = loss_and_intercept(w0)
+        _, w, best_w, best_loss, _ = jax.lax.while_loop(
+            cond, body, (0, w0, w0, l0, 0))
+        _, c = loss_and_intercept(best_w)
+        return best_w, c
+
+    w, c = jax.jit(_solve)(A, b)
+    return np.asarray(w, dtype=np.float64), float(c)

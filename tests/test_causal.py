@@ -1,0 +1,145 @@
+"""Causal inference tests (reference: causal test suites — DoubleML ATE
+recovery, DiD interaction coefficient, synthetic control weights; SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.causal import (DiffInDiffEstimator, DoubleMLEstimator,
+                                  OrthoForestDMLEstimator, ResidualTransformer,
+                                  SyntheticControlEstimator,
+                                  SyntheticDiffInDiffEstimator,
+                                  constrained_least_squares,
+                                  linear_regression_with_se)
+
+
+def _dml_data(n=600, true_ate=2.0, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    propensity = 1 / (1 + np.exp(-X[:, 0]))
+    T = (rng.uniform(size=n) < propensity).astype(np.float64)
+    Y = true_ate * T + X[:, 1] + 0.5 * X[:, 0] + rng.normal(scale=0.5, size=n)
+    return Table({"features": X.astype(np.float32), "treatment": T, "outcome": Y})
+
+
+class TestSolvers:
+    def test_ols_recovers_coefficients(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(500, 2))
+        y = 3.0 * X[:, 0] - 1.0 * X[:, 1] + 0.5 + rng.normal(scale=0.1, size=500)
+        beta, se = linear_regression_with_se(X, y)
+        np.testing.assert_allclose(beta, [3.0, -1.0, 0.5], atol=0.05)
+        assert (se > 0).all()
+
+    def test_constrained_ls_on_simplex(self):
+        rng = np.random.default_rng(1)
+        A = rng.normal(size=(50, 5)).astype(np.float32)
+        w_true = np.array([0.6, 0.4, 0, 0, 0])
+        b = A @ w_true
+        w, _ = constrained_least_squares(A, b, max_iter=500)
+        assert w.min() >= 0 and abs(w.sum() - 1) < 1e-5
+        np.testing.assert_allclose(w[:2], [0.6, 0.4], atol=0.05)
+
+
+class TestDoubleML:
+    def test_recovers_ate(self):
+        from synapseml_tpu.models import LightGBMRegressor
+
+        df = _dml_data()
+        dml = DoubleMLEstimator(
+            treatmentModel=LightGBMRegressor(numIterations=20),
+            outcomeModel=LightGBMRegressor(numIterations=20),
+            maxIter=6, seed=3)
+        model = dml.fit(df)
+        ate = model.get_avg_treatment_effect()
+        assert ate == pytest.approx(2.0, abs=0.5)
+        lo, hi = model.get_confidence_interval()
+        assert lo < ate < hi
+        assert 0 <= model.get_pvalue() <= 1
+
+    def test_missing_models_rejected(self):
+        with pytest.raises(ValueError, match="treatmentModel"):
+            DoubleMLEstimator().fit(_dml_data(50))
+
+
+class TestDiffInDiff:
+    def _panel(self, effect=1.5, n_units=30, n_times=10, seed=0):
+        rng = np.random.default_rng(seed)
+        unit_fe = rng.normal(size=n_units)
+        time_fe = np.linspace(0, 1, n_times)
+        treated = np.arange(n_units) < 6
+        post = np.arange(n_times) >= 6
+        rows = {"unit": [], "time": [], "outcome": [], "treatment": [],
+                "postTreatment": []}
+        for u in range(n_units):
+            for t in range(n_times):
+                y = unit_fe[u] + time_fe[t] + rng.normal(scale=0.05)
+                if treated[u] and post[t]:
+                    y += effect
+                rows["unit"].append(u)
+                rows["time"].append(t)
+                rows["outcome"].append(y)
+                rows["treatment"].append(float(treated[u]))
+                rows["postTreatment"].append(float(post[t]))
+        return Table({k: np.asarray(v) for k, v in rows.items()})
+
+    def test_did_interaction(self):
+        model = DiffInDiffEstimator().fit(self._panel())
+        s = model.getSummary()
+        assert s.treatmentEffect == pytest.approx(1.5, abs=0.1)
+        assert s.standardError > 0
+
+    def test_synthetic_control(self):
+        model = SyntheticControlEstimator(maxIter=300).fit(self._panel())
+        s = model.getSummary()
+        assert s.treatmentEffect == pytest.approx(1.5, abs=0.2)
+        assert s.unitWeights is not None and s.unitWeights.min() >= 0
+
+    def test_synthetic_did(self):
+        model = SyntheticDiffInDiffEstimator(maxIter=300).fit(self._panel())
+        s = model.getSummary()
+        assert s.treatmentEffect == pytest.approx(1.5, abs=0.2)
+        assert s.timeWeights is not None
+
+    def test_no_controls_rejected(self):
+        df = self._panel()
+        df = Table({k: df[k] for k in df.columns})
+        df["treatment"] = np.ones(df.num_rows)
+        with pytest.raises(ValueError, match="treated and control"):
+            SyntheticControlEstimator().fit(df)
+
+
+class TestOrthoForest:
+    def test_heterogeneous_effect_sign(self):
+        from synapseml_tpu.models import LightGBMRegressor
+
+        rng = np.random.default_rng(0)
+        n = 800
+        X = rng.normal(size=(n, 3)).astype(np.float32)
+        H = rng.uniform(-1, 1, size=(n, 1)).astype(np.float32)
+        T = (rng.uniform(size=n) < 0.5).astype(np.float64)
+        effect = np.where(H[:, 0] > 0, 3.0, -1.0)
+        Y = effect * T + X[:, 0] + rng.normal(scale=0.3, size=n)
+        df = Table({"features": X, "heterogeneityFeatures": H,
+                    "treatment": T, "outcome": Y})
+        est = OrthoForestDMLEstimator(
+            treatmentModel=LightGBMRegressor(numIterations=10),
+            outcomeModel=LightGBMRegressor(numIterations=10),
+            numTrees=30)
+        out = est.fit(df).transform(df)
+        eff = out["EffectAverage"]
+        assert eff[H[:, 0] > 0.3].mean() > eff[H[:, 0] < -0.3].mean() + 1.0
+
+
+class TestResidual:
+    def test_residual(self):
+        df = Table({"label": np.array([1.0, 0.0]),
+                    "prediction": np.array([0.8, 0.3])})
+        out = ResidualTransformer().transform(df)
+        np.testing.assert_allclose(out["residual"], [0.2, -0.3])
+
+    def test_probability_vector(self):
+        df = Table({"label": np.array([1.0]),
+                    "prediction": np.array([[0.3, 0.7]])})
+        out = ResidualTransformer().transform(df)
+        np.testing.assert_allclose(out["residual"], [0.3])

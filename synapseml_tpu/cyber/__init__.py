@@ -1,0 +1,23 @@
+"""CyberML — access-anomaly detection and cyber feature engineering.
+
+Reference: core/src/main/python/synapse/ml/cyber/ (~2.5k LoC pure PySpark;
+SURVEY.md §2.7): anomaly/collaborative_filtering.py (AccessAnomaly — ALS over
+user×resource access likelihoods, standardized anomaly scores),
+anomaly/complement_access.py, feature/indexers.py, feature/scalers.py.
+The reference runs Spark ALS per tenant; here each tenant's factorization is a
+dense jitted alternating-ridge solve (vmapped batched linear solves on the MXU).
+"""
+
+from .access_anomaly import (AccessAnomaly, AccessAnomalyConfig,
+                             AccessAnomalyModel, ComplementAccessTransformer)
+from .indexers import IdIndexer, IdIndexerModel, MultiIndexer, MultiIndexerModel
+from .scalers import (LinearScalarScaler, LinearScalarScalerModel,
+                      StandardScalarScaler, StandardScalarScalerModel)
+
+__all__ = [
+    "AccessAnomaly", "AccessAnomalyConfig", "AccessAnomalyModel",
+    "ComplementAccessTransformer",
+    "IdIndexer", "IdIndexerModel", "MultiIndexer", "MultiIndexerModel",
+    "StandardScalarScaler", "StandardScalarScalerModel",
+    "LinearScalarScaler", "LinearScalarScalerModel",
+]

@@ -1,0 +1,121 @@
+"""CyberML tests (reference: cyber module pytest suites — anomaly scores for
+unusual accesses, per-tenant isolation, indexer/scaler round-trips)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.cyber import (AccessAnomaly, ComplementAccessTransformer,
+                                 IdIndexer, LinearScalarScaler, MultiIndexer,
+                                 StandardScalarScaler)
+
+
+def _access_log(seed=0):
+    """Two user groups with disjoint resource habits inside one tenant."""
+    rng = np.random.default_rng(seed)
+    rows = {"tenant": [], "user": [], "res": [], "likelihood": []}
+    for u in range(8):
+        group = "a" if u < 4 else "b"
+        for _ in range(12):
+            r = rng.integers(0, 4) if group == "a" else rng.integers(4, 8)
+            rows["tenant"].append("t0")
+            rows["user"].append(f"u{u}")
+            rows["res"].append(f"r{r}")
+            rows["likelihood"].append(float(rng.integers(1, 5)))
+    return Table({k: np.asarray(v) for k, v in rows.items()})
+
+
+class TestIndexers:
+    def test_per_partition_indices(self):
+        df = Table({"tenant": np.array(["a", "a", "b"]),
+                    "user": np.array(["x", "y", "x"])})
+        model = IdIndexer(inputCol="user", partitionKey="tenant",
+                          outputCol="user_ix").fit(df)
+        out = model.transform(df)
+        assert out["user_ix"].tolist() == [1, 2, 1]  # b restarts at 1
+        back = model.undo_transform(out)
+        assert back["user"].tolist() == ["x", "y", "x"]
+
+    def test_multi_indexer(self):
+        df = Table({"tenant": np.array(["a", "a"]),
+                    "user": np.array(["x", "y"]),
+                    "res": np.array(["p", "q"])})
+        mi = MultiIndexer(indexers=[
+            IdIndexer(inputCol="user", partitionKey="tenant", outputCol="u"),
+            IdIndexer(inputCol="res", partitionKey="tenant", outputCol="r")])
+        model = mi.fit(df)
+        out = model.transform(df)
+        assert "u" in out and "r" in out
+        assert model.get_model_by_input_col("res").getOutputCol() == "r"
+
+
+class TestScalers:
+    def test_standard_scaler_per_tenant(self):
+        df = Table({"tenant": np.array(["a"] * 3 + ["b"] * 3),
+                    "v": np.array([1.0, 2.0, 3.0, 10.0, 20.0, 30.0])})
+        model = StandardScalarScaler(inputCol="v", partitionKey="tenant",
+                                     outputCol="z").fit(df)
+        out = model.transform(df)
+        za, zb = out["z"][:3], out["z"][3:]
+        assert abs(za.mean()) < 1e-9 and abs(zb.mean()) < 1e-9
+
+    def test_linear_scaler_range(self):
+        df = Table({"tenant": np.array(["a"] * 4),
+                    "v": np.array([0.0, 1.0, 2.0, 4.0])})
+        model = LinearScalarScaler(inputCol="v", partitionKey="tenant",
+                                   outputCol="s", minRequiredValue=5.0,
+                                   maxRequiredValue=10.0).fit(df)
+        s = model.transform(df)["s"]
+        assert s.min() == 5.0 and s.max() == 10.0
+
+
+class TestAccessAnomaly:
+    def test_cross_group_access_is_anomalous(self):
+        df = _access_log()
+        model = AccessAnomaly(maxIter=12, rankParam=6).fit(df)
+        # in-pattern access vs cross-group access
+        probe = Table({"tenant": np.array(["t0", "t0"]),
+                       "user": np.array(["u0", "u0"]),
+                       "res": np.array(["r0", "r7"])})
+        scores = model.transform(probe)[model.getOutputCol()]
+        assert scores[1] > scores[0]  # unfamiliar resource scores higher
+
+    def test_unseen_user_scores_zero(self):
+        model = AccessAnomaly(maxIter=4, rankParam=4).fit(_access_log())
+        probe = Table({"tenant": np.array(["t0"]),
+                       "user": np.array(["stranger"]),
+                       "res": np.array(["r0"])})
+        assert model.transform(probe)[model.getOutputCol()][0] == 0.0
+
+    def test_training_scores_standardized(self):
+        df = _access_log()
+        model = AccessAnomaly(maxIter=12, rankParam=6).fit(df)
+        scores = model.transform(df)[model.getOutputCol()]
+        assert abs(scores.mean()) < 0.15 and 0.5 < scores.std() < 2.0
+
+    def test_explicit_mode(self):
+        df = _access_log()
+        model = AccessAnomaly(maxIter=8, rankParam=4,
+                              applyImplicitCf=False).fit(df)
+        scores = model.transform(df)[model.getOutputCol()]
+        assert np.isfinite(scores).all()
+
+
+class TestComplementAccess:
+    def test_complement_pairs_unseen(self):
+        df = Table({"tenant": np.array(["t"] * 4),
+                    "user": np.array(["a", "a", "b", "b"]),
+                    "res": np.array(["x", "y", "x", "y"])})
+        # complement of a complete bipartite set is empty
+        out = ComplementAccessTransformer(
+            indexedColNamesArr=["user", "res"]).transform(df)
+        assert out.num_rows == 0
+
+        df2 = Table({"tenant": np.array(["t"] * 2),
+                     "user": np.array(["a", "b"]),
+                     "res": np.array(["x", "y"])})
+        out2 = ComplementAccessTransformer(
+            indexedColNamesArr=["user", "res"]).transform(df2)
+        seen = set(zip(df2["user"], df2["res"]))
+        for u, r in zip(out2["user"], out2["res"]):
+            assert (u, r) not in seen

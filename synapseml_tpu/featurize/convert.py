@@ -32,7 +32,7 @@ class DataConversion(Transformer):
                 out[c] = np.array([str(v) for v in a], dtype=object)
             elif t == "date":
                 fmt = self.dateTimeFormat
-                if a.dtype == object and fmt:
+                if (a.dtype == object or a.dtype.kind in "US") and fmt:
                     from datetime import datetime
                     # translate the reference's Java-style pattern to strptime
                     py_fmt = (fmt.replace("yyyy", "%Y").replace("MM", "%m")

@@ -38,9 +38,17 @@ class DataConversion(Transformer):
                     py_fmt = (fmt.replace("yyyy", "%Y").replace("MM", "%m")
                               .replace("dd", "%d").replace("HH", "%H")
                               .replace("mm", "%M").replace("ss", "%S"))
-                    out[c] = np.array(
-                        [np.datetime64(datetime.strptime(str(v), py_fmt), "s")
-                         for v in a], dtype="datetime64[s]")
+
+                    def parse_one(v):
+                        try:
+                            return np.datetime64(
+                                datetime.strptime(str(v), py_fmt), "s")
+                        except ValueError:
+                            # ISO-8601 strings parse regardless of the format
+                            return np.datetime64(str(v), "s")
+
+                    out[c] = np.array([parse_one(v) for v in a],
+                                      dtype="datetime64[s]")
                 else:
                     out[c] = np.asarray(a, dtype="datetime64[s]")
             elif t in _CASTS:

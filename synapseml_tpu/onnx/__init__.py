@@ -1,0 +1,24 @@
+"""ONNX inference on TPU — importer, batch transformer, hub, featurizer.
+
+Reference: deep-learning module ONNX components (ONNXModel.scala:145-423,
+ONNXRuntime.scala:25-107, ONNXUtils.scala, ONNXHub.scala,
+ImageFeaturizer.scala; SURVEY.md §2.4 / N5). The reference executes via ONNX
+Runtime JNI sessions per Spark partition; here ONNX protobufs are parsed
+directly (protoio.py — no onnx package needed), imported into pure JAX
+functions (importer.py + ops.py registry), and executed as jitted XLA programs
+with mini-batched, device-resident tensors.
+"""
+
+from .protoio import Attribute, Graph, Model, Node, Tensor, ValueInfo
+from .importer import OnnxFunction, fold_constants, import_model
+from .model import ONNXModel
+from .hub import ONNXHub, ONNXModelInfo
+from .featurizer import ImageFeaturizer
+from .ops import REGISTRY as OP_REGISTRY
+
+__all__ = [
+    "Attribute", "Graph", "Model", "Node", "Tensor", "ValueInfo",
+    "OnnxFunction", "fold_constants", "import_model",
+    "ONNXModel", "ONNXHub", "ONNXModelInfo", "ImageFeaturizer",
+    "OP_REGISTRY",
+]

@@ -1,0 +1,138 @@
+"""ONNX graph → jittable JAX function.
+
+Reference behavior being replaced: ONNX Runtime session execution
+(deep-learning/.../onnx/ONNXRuntime.scala:25-44 create, :58-107 batch apply)
+and graph surgery for fetching intermediate outputs
+(ONNXModel.scala:203-227, ONNXUtils.scala). Here the graph is imported once
+into a pure function ``f(inputs) -> outputs`` that XLA compiles for TPU; "model
+slicing at an intermediate output" is just asking the evaluator for that tensor
+name — the dead tail of the graph is never traced.
+
+Constant folding: nodes whose inputs are all initializers/constants are
+evaluated at import (host, numpy semantics via jax) so shape-valued tensors
+(Reshape targets, Slice indices) are static by the time the function is jitted.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .ops import REGISTRY
+from .protoio import Graph, Model, Node
+
+
+class OnnxFunction:
+    """Callable wrapper: ``fn(feeds: dict) -> dict`` over requested outputs."""
+
+    def __init__(self, model: Model, outputs: Optional[Sequence[str]] = None):
+        self.model = model
+        g = model.graph
+        self.graph_inputs = [vi.name for vi in g.inputs
+                             if vi.name not in g.initializers]
+        self.input_info = {vi.name: vi for vi in g.inputs}
+        self.outputs = list(outputs) if outputs else [vi.name for vi in g.outputs]
+        self._plan = self._make_plan(g, self.outputs)
+
+    @staticmethod
+    def _make_plan(g: Graph, outputs: Sequence[str]) -> List[Node]:
+        """Nodes needed for ``outputs``, in topological order (graph slicing:
+        the ONNXModel.scala:203-227 analog)."""
+        producer: Dict[str, Node] = {}
+        for n in g.nodes:
+            for o in n.outputs:
+                producer[o] = n
+        known = set(g.initializers) | {vi.name for vi in g.inputs}
+        plan: List[Node] = []
+        seen = set()
+
+        def visit(name: str, stack: Tuple[str, ...]) -> None:
+            if name in known or name == "":
+                return
+            n = producer.get(name)
+            if n is None:
+                raise ValueError(f"tensor {name!r} has no producer and is not "
+                                 f"a graph input/initializer")
+            if id(n) in seen:
+                return
+            if name in stack:
+                raise ValueError(f"cycle through {name!r}")
+            for i in n.inputs:
+                visit(i, stack + (name,))
+            seen.add(id(n))
+            plan.append(n)
+
+        for o in outputs:
+            visit(o, ())
+        return plan
+
+    def __call__(self, feeds: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        g = self.model.graph
+        env: Dict[str, np.ndarray] = {k: t.array()
+                                      for k, t in g.initializers.items()}
+        for name in self.graph_inputs:
+            if name not in feeds:
+                raise ValueError(
+                    f"missing input {name!r}; expected {self.graph_inputs}")
+        env.update(feeds)
+        for node in self._plan:
+            impl = REGISTRY.get(node.op_type)
+            if impl is None:
+                raise NotImplementedError(
+                    f"ONNX op {node.op_type!r} (node {node.name!r}) is not "
+                    f"supported; supported: {sorted(REGISTRY)}")
+            args = [env[i] if i else None for i in node.inputs]
+            out = impl(node, *args)
+            if not isinstance(out, tuple):
+                out = (out,)
+            for name, val in zip(node.outputs, out):
+                if name:
+                    env[name] = val
+        return {o: env[o] for o in self.outputs}
+
+    def as_jax(self):
+        """(fn, input_names): positional jit-friendly callable."""
+        names = list(self.graph_inputs)
+
+        def fn(*arrays):
+            return tuple(self({n: a for n, a in zip(names, arrays)}).values())
+
+        return fn, names
+
+
+def import_model(model_bytes: bytes,
+                 outputs: Optional[Sequence[str]] = None) -> OnnxFunction:
+    return OnnxFunction(Model.parse(model_bytes), outputs)
+
+
+def fold_constants(model: Model) -> Model:
+    """Evaluate nodes with all-constant inputs once, promoting results to
+    initializers (host-side; keeps Reshape/Slice args static under jit)."""
+    g = model.graph
+    const = dict(g.initializers)
+    env = {k: t.array() for k, t in const.items()}
+    keep: List[Node] = []
+    from .protoio import Tensor
+
+    for node in g.nodes:
+        impl = REGISTRY.get(node.op_type)
+        inputs_const = all((not i) or (i in env) for i in node.inputs)
+        # Shape of a known-rank input is NOT constant in general (batch dim);
+        # only fold Shape when the producer value is itself constant.
+        if impl is not None and inputs_const and node.op_type != "Shape":
+            try:
+                out = impl(node, *[env[i] if i else None for i in node.inputs])
+            except Exception:
+                keep.append(node)
+                continue
+            if not isinstance(out, tuple):
+                out = (out,)
+            for name, val in zip(node.outputs, out):
+                if name:
+                    env[name] = np.asarray(val)
+                    g.initializers[name] = Tensor.from_array(name, np.asarray(val))
+        else:
+            keep.append(node)
+    g.nodes = keep
+    return model

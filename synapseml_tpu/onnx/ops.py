@@ -1,0 +1,579 @@
+"""ONNX op → JAX implementations.
+
+Coverage targets ResNet-class CNNs and BERT-class transformers first
+(SURVEY.md §7 hard part 4), plus the elementwise/shape plumbing common in
+exported graphs. Each impl takes (node, *input arrays) and returns one array
+or a tuple. Everything is traceable: ops with shape-valued inputs (Reshape,
+Slice, ...) require those inputs to be constants (initializers or Constant
+nodes), which the importer folds before tracing — the standard static-shape
+discipline for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+REGISTRY: Dict[str, Callable] = {}
+
+
+def op(*names):
+    def deco(fn):
+        for n in names:
+            REGISTRY[n] = fn
+        return fn
+
+    return deco
+
+
+def _static(x, name, node):
+    """Shape-carrying inputs must be compile-time constants."""
+    if hasattr(x, "aval") and not isinstance(x, np.ndarray):
+        try:
+            return np.asarray(x)
+        except Exception:
+            raise ValueError(
+                f"{node.op_type} '{node.name}': input {name} must be a "
+                "constant (initializer / Constant node) for XLA static shapes")
+    return np.asarray(x)
+
+
+# --- elementwise -----------------------------------------------------------
+
+@op("Add")
+def _add(node, a, b):
+    return a + b
+
+
+@op("Sub")
+def _sub(node, a, b):
+    return a - b
+
+
+@op("Mul")
+def _mul(node, a, b):
+    return a * b
+
+
+@op("Div")
+def _div(node, a, b):
+    return a / b
+
+
+@op("Pow")
+def _pow(node, a, b):
+    return a ** b
+
+
+@op("Neg")
+def _neg(node, a):
+    return -a
+
+
+@op("Sqrt")
+def _sqrt(node, a):
+    return _jnp().sqrt(a)
+
+
+@op("Exp")
+def _exp(node, a):
+    return _jnp().exp(a)
+
+
+@op("Log")
+def _log(node, a):
+    return _jnp().log(a)
+
+
+@op("Abs")
+def _abs(node, a):
+    return _jnp().abs(a)
+
+
+@op("Erf")
+def _erf(node, a):
+    import jax
+
+    return jax.scipy.special.erf(a)
+
+
+@op("Relu")
+def _relu(node, a):
+    return _jnp().maximum(a, 0)
+
+
+@op("LeakyRelu")
+def _leaky(node, a):
+    alpha = node.attr("alpha", 0.01)
+    return _jnp().where(a >= 0, a, alpha * a)
+
+
+@op("Sigmoid")
+def _sigmoid(node, a):
+    import jax
+
+    return jax.nn.sigmoid(a)
+
+
+@op("Tanh")
+def _tanh(node, a):
+    return _jnp().tanh(a)
+
+
+@op("Gelu")
+def _gelu(node, a):
+    import jax
+
+    return jax.nn.gelu(a, approximate=node.attr("approximate", "none") != "none")
+
+
+@op("Clip")
+def _clip(node, a, *mm):
+    jnp = _jnp()
+    lo = mm[0] if len(mm) > 0 else node.attr("min")
+    hi = mm[1] if len(mm) > 1 else node.attr("max")
+    return jnp.clip(a, lo, hi)
+
+
+@op("Min")
+def _min(node, *xs):
+    jnp = _jnp()
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.minimum(out, x)
+    return out
+
+
+@op("Max")
+def _max(node, *xs):
+    jnp = _jnp()
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.maximum(out, x)
+    return out
+
+
+@op("Sum")
+def _sum(node, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@op("Where")
+def _where(node, c, a, b):
+    return _jnp().where(c, a, b)
+
+
+@op("Equal")
+def _equal(node, a, b):
+    return a == b
+
+
+@op("Greater")
+def _greater(node, a, b):
+    return a > b
+
+
+@op("Less")
+def _less(node, a, b):
+    return a < b
+
+
+@op("Not")
+def _not(node, a):
+    return ~a
+
+
+@op("Cast")
+def _cast(node, a):
+    from .protoio import DTYPES
+
+    return a.astype(DTYPES[node.attr("to")])
+
+
+@op("Identity", "Dropout")
+def _identity(node, a, *rest):
+    return a
+
+
+# --- reductions / normalization -------------------------------------------
+
+def _axes(node, extra_inputs, rank):
+    axes = node.attr("axes")
+    if axes is None and extra_inputs:
+        axes = [int(v) for v in np.asarray(extra_inputs[0]).ravel()]
+    if axes is None:
+        axes = list(range(rank))
+    return tuple(int(a) % rank for a in axes)
+
+
+@op("ReduceMean")
+def _rmean(node, a, *rest):
+    keep = bool(node.attr("keepdims", 1))
+    return _jnp().mean(a, axis=_axes(node, rest, a.ndim), keepdims=keep)
+
+
+@op("ReduceSum")
+def _rsum(node, a, *rest):
+    keep = bool(node.attr("keepdims", 1))
+    return _jnp().sum(a, axis=_axes(node, rest, a.ndim), keepdims=keep)
+
+
+@op("ReduceMax")
+def _rmax(node, a, *rest):
+    keep = bool(node.attr("keepdims", 1))
+    return _jnp().max(a, axis=_axes(node, rest, a.ndim), keepdims=keep)
+
+
+@op("Softmax")
+def _softmax(node, a):
+    import jax
+
+    return jax.nn.softmax(a, axis=node.attr("axis", -1))
+
+
+@op("LogSoftmax")
+def _logsoftmax(node, a):
+    import jax
+
+    return jax.nn.log_softmax(a, axis=node.attr("axis", -1))
+
+
+@op("ArgMax")
+def _argmax(node, a):
+    axis = node.attr("axis", 0)
+    keep = bool(node.attr("keepdims", 1))
+    out = _jnp().argmax(a, axis=axis)
+    return _jnp().expand_dims(out, axis) if keep else out
+
+
+@op("LayerNormalization")
+def _layernorm(node, x, scale, bias=None):
+    jnp = _jnp()
+    # ONNX: normalization runs over axes [axis .. rank-1], not just `axis`
+    axis = node.attr("axis", -1) % x.ndim
+    axes = tuple(range(axis, x.ndim))
+    eps = node.attr("epsilon", 1e-5)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + eps) * scale
+    return out + bias if bias is not None else out
+
+
+@op("BatchNormalization")
+def _batchnorm(node, x, scale, bias, mean, var):
+    jnp = _jnp()
+    eps = node.attr("epsilon", 1e-5)
+    shape = [1, -1] + [1] * (x.ndim - 2)  # params along channel dim (NCHW)
+    return ((x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
+            * scale.reshape(shape) + bias.reshape(shape))
+
+
+# --- matmul / linear -------------------------------------------------------
+
+@op("MatMul")
+def _matmul(node, a, b):
+    return _jnp().matmul(a, b)
+
+
+@op("Gemm")
+def _gemm(node, a, b, c=None):
+    jnp = _jnp()
+    alpha = node.attr("alpha", 1.0)
+    beta = node.attr("beta", 1.0)
+    if node.attr("transA", 0):
+        a = a.T
+    if node.attr("transB", 0):
+        b = b.T
+    out = alpha * (a @ b)
+    if c is not None:
+        out = out + beta * c
+    return out
+
+
+@op("Einsum")
+def _einsum(node, *xs):
+    return _jnp().einsum(node.attr("equation"), *xs)
+
+
+# --- conv / pool (NCHW, matching ONNX layout) ------------------------------
+
+def _conv_pads(node, spatial):
+    pads = node.attr("pads")
+    auto = node.attr("auto_pad", "NOTSET")
+    if pads is not None:
+        half = len(pads) // 2
+        return [(pads[i], pads[i + half]) for i in range(half)], auto
+    return [(0, 0)] * spatial, auto
+
+
+def _same_pads(in_sizes, kernel, strides, dils, lower: bool):
+    """Explicit SAME padding; SAME_LOWER puts the odd element at the start
+    (XLA's 'SAME' string is SAME_UPPER, so SAME_LOWER needs explicit pads)."""
+    out = []
+    for size, k, s, d in zip(in_sizes, kernel, strides, dils):
+        eff = (k - 1) * d + 1
+        total = max((int(np.ceil(size / s)) - 1) * s + eff - size, 0)
+        small, big = total // 2, total - total // 2
+        out.append((big, small) if lower else (small, big))
+    return out
+
+
+@op("Conv")
+def _conv(node, x, w, b=None):
+    import jax
+
+    jnp = _jnp()
+    spatial = x.ndim - 2
+    strides = node.attr("strides", [1] * spatial)
+    dil = node.attr("dilations", [1] * spatial)
+    groups = node.attr("group", 1)
+    pads, auto = _conv_pads(node, spatial)
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        padding = _same_pads(x.shape[2:], w.shape[2:], strides, dil,
+                             lower=(auto == "SAME_LOWER"))
+    else:
+        padding = pads
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if spatial == 2 else
+        ("NCW", "OIW", "NCW") if spatial == 1 else
+        ("NCDHW", "OIDHW", "NCDHW"))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+def _pool(node, x, kind):
+    import jax
+
+    jnp = _jnp()
+    spatial = x.ndim - 2
+    k = node.attr("kernel_shape")
+    strides = node.attr("strides", [1] * spatial)
+    pads, auto = _conv_pads(node, spatial)
+    window = (1, 1) + tuple(k)
+    strides_full = (1, 1) + tuple(strides)
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        padding = [(0, 0), (0, 0)] + _same_pads(
+            x.shape[2:], k, strides, [1] * spatial,
+            lower=(auto == "SAME_LOWER"))
+    else:
+        padding = [(0, 0), (0, 0)] + pads
+    if kind == "max":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                     strides_full, padding)
+    ones = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add, window,
+                                 strides_full, padding)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full,
+                              padding)
+    if node.attr("count_include_pad", 0):
+        return s / float(np.prod(k))
+    return s / ones
+
+
+@op("MaxPool")
+def _maxpool(node, x):
+    return _pool(node, x, "max")
+
+
+@op("AveragePool")
+def _avgpool(node, x):
+    return _pool(node, x, "avg")
+
+
+@op("GlobalAveragePool")
+def _gap(node, x):
+    return _jnp().mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@op("GlobalMaxPool")
+def _gmp(node, x):
+    return _jnp().max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+# --- shape plumbing --------------------------------------------------------
+
+@op("Reshape")
+def _reshape(node, x, shape):
+    shape = [int(v) for v in _static(shape, "shape", node).ravel()]
+    # ONNX: 0 means copy input dim
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return x.reshape(shape)
+
+
+@op("Flatten")
+def _flatten(node, x):
+    axis = node.attr("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return x.reshape(lead, -1)
+
+
+@op("Transpose")
+def _transpose(node, x):
+    perm = node.attr("perm", list(range(x.ndim))[::-1])
+    return _jnp().transpose(x, perm)
+
+
+@op("Concat")
+def _concat(node, *xs):
+    return _jnp().concatenate(xs, axis=node.attr("axis", 0))
+
+
+@op("Split")
+def _split(node, x, *rest):
+    jnp = _jnp()
+    axis = node.attr("axis", 0)
+    splits = node.attr("split")
+    if splits is None and rest:
+        splits = [int(v) for v in _static(rest[0], "split", node).ravel()]
+    if splits is None:
+        n_out = len(node.outputs)
+        return tuple(jnp.split(x, n_out, axis=axis))
+    idx = np.cumsum(splits)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@op("Squeeze")
+def _squeeze(node, x, *rest):
+    axes = node.attr("axes")
+    if axes is None and rest:
+        axes = [int(v) for v in _static(rest[0], "axes", node).ravel()]
+    if axes is None:
+        return _jnp().squeeze(x)
+    return _jnp().squeeze(x, axis=tuple(int(a) % x.ndim for a in axes))
+
+
+@op("Unsqueeze")
+def _unsqueeze(node, x, *rest):
+    axes = node.attr("axes")
+    if axes is None and rest:
+        axes = [int(v) for v in _static(rest[0], "axes", node).ravel()]
+    out = x
+    for a in sorted(int(a) for a in axes):
+        out = _jnp().expand_dims(out, a)
+    return out
+
+
+@op("Gather")
+def _gather(node, x, idx):
+    return _jnp().take(x, idx.astype("int32"), axis=node.attr("axis", 0))
+
+
+@op("Slice")
+def _slice(node, x, *rest):
+    if rest:  # opset >= 10: starts/ends/axes/steps as inputs
+        starts = [int(v) for v in _static(rest[0], "starts", node).ravel()]
+        ends = [int(v) for v in _static(rest[1], "ends", node).ravel()]
+        axes = ([int(v) for v in _static(rest[2], "axes", node).ravel()]
+                if len(rest) > 2 else list(range(len(starts))))
+        steps = ([int(v) for v in _static(rest[3], "steps", node).ravel()]
+                 if len(rest) > 3 else [1] * len(starts))
+    else:
+        starts = node.attr("starts")
+        ends = node.attr("ends")
+        axes = node.attr("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    sl = [slice(None)] * x.ndim
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        sl[int(a) % x.ndim] = slice(s, None if e >= 2 ** 31 - 1 else e, st)
+    return x[tuple(sl)]
+
+
+@op("Expand")
+def _expand(node, x, shape):
+    jnp = _jnp()
+    shape = [int(v) for v in _static(shape, "shape", node).ravel()]
+    # ONNX Expand = broadcast with 1s allowed on either side
+    target = list(np.broadcast_shapes(tuple(x.shape), tuple(shape)))
+    return jnp.broadcast_to(x, target)
+
+
+@op("Shape")
+def _shape(node, x):
+    return np.asarray(x.shape, dtype=np.int64)
+
+
+@op("Constant")
+def _constant(node):
+    t = node.attr("value")
+    if t is not None:
+        return t.array()
+    for k in ("value_float", "value_int"):
+        v = node.attr(k)
+        if v is not None:
+            return np.asarray(v)
+    raise ValueError(f"Constant node {node.name}: no value attribute")
+
+
+@op("ConstantOfShape")
+def _const_of_shape(node, shape):
+    shape = [int(v) for v in _static(shape, "shape", node).ravel()]
+    t = node.attr("value")
+    fill = t.array().ravel()[0] if t is not None else np.float32(0)
+    return _jnp().full(shape, fill, dtype=np.asarray(fill).dtype)
+
+
+@op("Pad")
+def _pad(node, x, *rest):
+    jnp = _jnp()
+    pads = node.attr("pads")
+    if pads is None and rest:
+        pads = [int(v) for v in _static(rest[0], "pads", node).ravel()]
+    value = node.attr("value", 0.0)
+    if len(rest) > 1:
+        value = float(np.asarray(rest[1]).ravel()[0])
+    half = len(pads) // 2
+    widths = [(pads[i], pads[i + half]) for i in range(half)]
+    mode = node.attr("mode", "constant")
+    if mode == "constant":
+        return jnp.pad(x, widths, constant_values=value)
+    return jnp.pad(x, widths, mode={"reflect": "reflect", "edge": "edge"}[mode])
+
+
+@op("Tile")
+def _tile(node, x, reps):
+    reps = [int(v) for v in _static(reps, "repeats", node).ravel()]
+    return _jnp().tile(x, reps)
+
+
+@op("Range")
+def _range(node, start, limit, delta):
+    s = float(np.asarray(start).ravel()[0])
+    l = float(np.asarray(limit).ravel()[0])
+    d = float(np.asarray(delta).ravel()[0])
+    return np.arange(s, l, d).astype(np.asarray(start).dtype)
+
+
+@op("Resize")
+def _resize(node, x, *rest):
+    """Nearest/linear resize (scales or sizes input); enough for CNN heads."""
+    import jax
+
+    jnp = _jnp()
+    # inputs: roi (ignored), scales, sizes
+    sizes = None
+    if len(rest) >= 3 and rest[2] is not None:
+        sizes = [int(v) for v in _static(rest[2], "sizes", node).ravel()]
+    elif len(rest) >= 2 and rest[1] is not None and np.asarray(rest[1]).size:
+        scales = np.asarray(_static(rest[1], "scales", node)).ravel()
+        sizes = [int(round(s * d)) for s, d in zip(scales, x.shape)]
+    if sizes is None:
+        raise ValueError("Resize: needs scales or sizes")
+    method = {"nearest": "nearest", "linear": "linear", "cubic": "cubic"}[
+        node.attr("mode", "nearest")]
+    return jax.image.resize(x, sizes, method=method)

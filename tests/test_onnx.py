@@ -1,0 +1,319 @@
+"""ONNX module tests: wire-format round-trip, op correctness vs numpy, CNN and
+transformer subgraphs, ONNXModel transformer semantics (minibatch, slicing,
+softmax/argmax post-ops). Reference test analog: ONNXModel suites in
+deep-learning/src/test (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.onnx import (Attribute, Graph, ImageFeaturizer, Model, Node,
+                                ONNXModel, OnnxFunction, Tensor, ValueInfo,
+                                fold_constants, import_model)
+
+
+def _attr_i(name, v):
+    return Attribute(name=name, type=2, i=v)
+
+
+def _attr_is(name, vs):
+    return Attribute(name=name, type=7, ints=list(vs))
+
+
+def _attr_f(name, v):
+    return Attribute(name=name, type=1, f=v)
+
+
+def _attr_s(name, v):
+    return Attribute(name=name, type=3, s=v.encode())
+
+
+def _vi(name, shape):
+    return ValueInfo(name=name, elem_type=1, shape=list(shape))
+
+
+def _mlp_model(rng):
+    """x[?,4] -> Gemm W1 -> Relu(hidden) -> Gemm W2 -> out[?,3]"""
+    W1 = rng.normal(size=(4, 8)).astype(np.float32)
+    b1 = rng.normal(size=(8,)).astype(np.float32)
+    W2 = rng.normal(size=(8, 3)).astype(np.float32)
+    g = Graph(
+        nodes=[
+            Node(op_type="Gemm", inputs=["x", "W1", "b1"], outputs=["h0"],
+                 name="fc1"),
+            Node(op_type="Relu", inputs=["h0"], outputs=["hidden"], name="relu"),
+            Node(op_type="MatMul", inputs=["hidden", "W2"], outputs=["out"],
+                 name="fc2"),
+        ],
+        initializers={"W1": Tensor.from_array("W1", W1),
+                      "b1": Tensor.from_array("b1", b1),
+                      "W2": Tensor.from_array("W2", W2)},
+        inputs=[_vi("x", ["N", 4])],
+        outputs=[_vi("out", ["N", 3])],
+    )
+    return Model(graph=g), (W1, b1, W2)
+
+
+class TestProtoIO:
+    def test_roundtrip(self):
+        rng = np.random.default_rng(0)
+        model, _ = _mlp_model(rng)
+        data = model.encode()
+        back = Model.parse(data)
+        assert [n.op_type for n in back.graph.nodes] == ["Gemm", "Relu", "MatMul"]
+        assert back.graph.inputs[0].name == "x"
+        assert back.graph.inputs[0].shape == ["N", 4]
+        np.testing.assert_array_equal(
+            back.graph.initializers["W1"].array(),
+            model.graph.initializers["W1"].array())
+
+    def test_attribute_types(self):
+        n = Node(op_type="T", attrs={
+            "i": _attr_i("i", -3), "f": _attr_f("f", 2.5),
+            "s": _attr_s("s", "hello"), "ints": _attr_is("ints", [1, -2, 3])})
+        back = Node.parse(n.encode())
+        assert back.attr("i") == -3
+        assert back.attr("f") == pytest.approx(2.5)
+        assert back.attr("s") == "hello"
+        assert back.attr("ints") == [1, -2, 3]
+
+
+class TestExecution:
+    def test_mlp_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        model, (W1, b1, W2) = _mlp_model(rng)
+        fn = import_model(model.encode())
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        out = fn({"x": x})["out"]
+        ref = np.maximum(x @ W1 + b1, 0) @ W2
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_intermediate_output_slicing(self):
+        rng = np.random.default_rng(2)
+        model, (W1, b1, _) = _mlp_model(rng)
+        fn = import_model(model.encode(), outputs=["hidden"])
+        # the sliced plan must not include the fc2 node
+        assert [n.name for n in fn._plan] == ["fc1", "relu"]
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        np.testing.assert_allclose(fn({"x": x})["hidden"],
+                                   np.maximum(x @ W1 + b1, 0), rtol=1e-5)
+
+    def test_missing_input_rejected(self):
+        model, _ = _mlp_model(np.random.default_rng(0))
+        fn = import_model(model.encode())
+        with pytest.raises(ValueError, match="missing input"):
+            fn({})
+
+    def test_unsupported_op_message(self):
+        g = Graph(nodes=[Node(op_type="FancyOp", inputs=["x"], outputs=["y"])],
+                  inputs=[_vi("x", [2])], outputs=[_vi("y", [2])])
+        fn = import_model(Model(graph=g).encode())
+        with pytest.raises(NotImplementedError, match="FancyOp"):
+            fn({"x": np.zeros(2, np.float32)})
+
+    def test_conv_bn_pool_block(self):
+        """ResNet-style stem: Conv -> BatchNorm -> Relu -> MaxPool -> GAP."""
+        rng = np.random.default_rng(3)
+        W = rng.normal(scale=0.2, size=(4, 3, 3, 3)).astype(np.float32)
+        gamma = np.abs(rng.normal(size=4)).astype(np.float32)
+        beta = rng.normal(size=4).astype(np.float32)
+        mean = rng.normal(size=4).astype(np.float32)
+        var = np.abs(rng.normal(size=4)).astype(np.float32) + 0.5
+        g = Graph(
+            nodes=[
+                Node(op_type="Conv", inputs=["x", "W"], outputs=["c"],
+                     attrs={"pads": _attr_is("pads", [1, 1, 1, 1]),
+                            "strides": _attr_is("strides", [1, 1])}),
+                Node(op_type="BatchNormalization",
+                     inputs=["c", "gamma", "beta", "mean", "var"],
+                     outputs=["bn"],
+                     attrs={"epsilon": _attr_f("epsilon", 1e-5)}),
+                Node(op_type="Relu", inputs=["bn"], outputs=["r"]),
+                Node(op_type="MaxPool", inputs=["r"], outputs=["p"],
+                     attrs={"kernel_shape": _attr_is("kernel_shape", [2, 2]),
+                            "strides": _attr_is("strides", [2, 2])}),
+                Node(op_type="GlobalAveragePool", inputs=["p"], outputs=["gap"]),
+                Node(op_type="Flatten", inputs=["gap"], outputs=["feat"],
+                     attrs={"axis": _attr_i("axis", 1)}),
+            ],
+            initializers={k: Tensor.from_array(k, v) for k, v in
+                          [("W", W), ("gamma", gamma), ("beta", beta),
+                           ("mean", mean), ("var", var)]},
+            inputs=[_vi("x", ["N", 3, 8, 8])],
+            outputs=[_vi("feat", ["N", 4])],
+        )
+        fn = import_model(Model(graph=g).encode())
+        x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        out = fn({"x": x})["feat"]
+        assert out.shape == (2, 4)
+        # reference computation with scipy-free numpy conv
+        import jax
+
+        ref_c = jax.lax.conv_general_dilated(
+            x, W, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                x.shape, W.shape, ("NCHW", "OIHW", "NCHW")))
+        ref = (np.asarray(ref_c) - mean[None, :, None, None]) / np.sqrt(
+            var[None, :, None, None] + 1e-5) * gamma[None, :, None, None] \
+            + beta[None, :, None, None]
+        ref = np.maximum(ref, 0)
+        ref = ref.reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))
+        ref = ref.mean(axis=(2, 3))
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_attention_block(self):
+        """Single-head attention: the BERT-class core (MatMul/Softmax/LayerNorm)."""
+        rng = np.random.default_rng(4)
+        d = 8
+        Wq, Wk, Wv = (rng.normal(scale=0.3, size=(d, d)).astype(np.float32)
+                      for _ in range(3))
+        gamma = np.ones(d, np.float32)
+        beta = np.zeros(d, np.float32)
+        scale = np.float32(1.0 / np.sqrt(d))
+        g = Graph(
+            nodes=[
+                Node(op_type="MatMul", inputs=["x", "Wq"], outputs=["q"]),
+                Node(op_type="MatMul", inputs=["x", "Wk"], outputs=["k"]),
+                Node(op_type="MatMul", inputs=["x", "Wv"], outputs=["v"]),
+                Node(op_type="Transpose", inputs=["k"], outputs=["kT"],
+                     attrs={"perm": _attr_is("perm", [0, 2, 1])}),
+                Node(op_type="MatMul", inputs=["q", "kT"], outputs=["qk"]),
+                Node(op_type="Mul", inputs=["qk", "scale"], outputs=["qks"]),
+                Node(op_type="Softmax", inputs=["qks"], outputs=["attn"],
+                     attrs={"axis": _attr_i("axis", -1)}),
+                Node(op_type="MatMul", inputs=["attn", "v"], outputs=["ctx"]),
+                Node(op_type="Add", inputs=["ctx", "x"], outputs=["res"]),
+                Node(op_type="LayerNormalization",
+                     inputs=["res", "gamma", "beta"], outputs=["out"],
+                     attrs={"axis": _attr_i("axis", -1),
+                            "epsilon": _attr_f("epsilon", 1e-5)}),
+            ],
+            initializers={k: Tensor.from_array(k, v) for k, v in
+                          [("Wq", Wq), ("Wk", Wk), ("Wv", Wv),
+                           ("gamma", gamma), ("beta", beta),
+                           ("scale", np.asarray(scale))]},
+            inputs=[_vi("x", ["N", 6, d])],
+            outputs=[_vi("out", ["N", 6, d])],
+        )
+        fn = import_model(Model(graph=g).encode())
+        x = rng.normal(size=(2, 6, d)).astype(np.float32)
+        out = fn({"x": x})["out"]
+        # numpy reference
+        q, k, v = x @ Wq, x @ Wk, x @ Wv
+        s = (q @ k.transpose(0, 2, 1)) * scale
+        a = np.exp(s - s.max(-1, keepdims=True))
+        a /= a.sum(-1, keepdims=True)
+        res = a @ v + x
+        mu = res.mean(-1, keepdims=True)
+        ref = (res - mu) / np.sqrt(res.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-5)
+
+    def test_constant_folding(self):
+        g = Graph(
+            nodes=[
+                Node(op_type="Constant", outputs=["two"],
+                     attrs={"value": Attribute(
+                         name="value", type=4,
+                         t=Tensor.from_array("", np.asarray([2.0], np.float32)))}),
+                Node(op_type="Mul", inputs=["two", "three"], outputs=["six"]),
+                Node(op_type="Mul", inputs=["x", "six"], outputs=["y"]),
+            ],
+            initializers={"three": Tensor.from_array(
+                "three", np.asarray([3.0], np.float32))},
+            inputs=[_vi("x", ["N"])],
+            outputs=[_vi("y", ["N"])],
+        )
+        m = fold_constants(Model(graph=g))
+        assert len(m.graph.nodes) == 1  # only the data-dependent Mul remains
+        fn = OnnxFunction(m)
+        np.testing.assert_allclose(
+            fn({"x": np.asarray([1.0, 2.0], np.float32)})["y"], [6.0, 12.0])
+
+
+class TestONNXModelTransformer:
+    def _model(self):
+        model, weights = _mlp_model(np.random.default_rng(5))
+        m = ONNXModel(miniBatchSize=4)
+        m.setModelPayload(model.encode())
+        return m, weights
+
+    def test_transform_with_post_ops(self):
+        m, (W1, b1, W2) = self._model()
+        m.setFeedDict({"x": "features"})
+        m.setFetchDict({"rawPrediction": "out"})
+        m.setSoftMaxDict({"rawPrediction": "probability"})
+        m.setArgMaxDict({"rawPrediction": "prediction"})
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(10, 4)).astype(np.float32)  # not a multiple of 4
+        out = m.transform(Table({"features": X}))
+        ref = np.maximum(X @ W1 + b1, 0) @ W2
+        np.testing.assert_allclose(out["rawPrediction"], ref, rtol=1e-4)
+        np.testing.assert_allclose(out["probability"].sum(axis=1),
+                                   np.ones(10), rtol=1e-5)
+        np.testing.assert_array_equal(out["prediction"],
+                                      ref.argmax(axis=1).astype(np.float64))
+
+    def test_fetch_intermediate(self):
+        m, (W1, b1, _) = self._model()
+        m.setFeedDict({"x": "features"})
+        m.setFetchDict({"embedding": "hidden"})
+        X = np.random.default_rng(7).normal(size=(3, 4)).astype(np.float32)
+        out = m.transform(Table({"features": X}))
+        np.testing.assert_allclose(out["embedding"],
+                                   np.maximum(X @ W1 + b1, 0), rtol=1e-4)
+
+    def test_model_introspection(self):
+        m, _ = self._model()
+        assert m.modelInput()["x"]["shape"] == ["N", 4]
+        assert m.modelOutput() == ["out"]
+
+    def test_save_load(self, tmp_path):
+        from synapseml_tpu.core.pipeline import PipelineStage
+
+        m, _ = self._model()
+        m.setFeedDict({"x": "features"})
+        m.setFetchDict({"out": "out"})
+        X = np.random.default_rng(8).normal(size=(4, 4)).astype(np.float32)
+        expected = m.transform(Table({"features": X}))["out"]
+        p = str(tmp_path / "onnx_model")
+        m.save(p)
+        loaded = PipelineStage.load(p)
+        np.testing.assert_allclose(
+            loaded.transform(Table({"features": X}))["out"], expected,
+            rtol=1e-5)
+
+
+class TestImageFeaturizer:
+    def test_headless_features(self):
+        rng = np.random.default_rng(9)
+        model, (W1, b1, W2) = _mlp_model(rng)
+        # build a conv model instead: reuse stem from conv test is complex;
+        # here use an image-shaped MLP: flatten -> gemm head
+        W = rng.normal(scale=0.1, size=(27, 5)).astype(np.float32)
+        Whead = rng.normal(size=(5, 2)).astype(np.float32)
+        g = Graph(
+            nodes=[
+                Node(op_type="Flatten", inputs=["img"], outputs=["flat"],
+                     attrs={"axis": _attr_i("axis", 1)}),
+                Node(op_type="MatMul", inputs=["flat", "W"], outputs=["feat"]),
+                Node(op_type="Relu", inputs=["feat"], outputs=["featr"]),
+                Node(op_type="MatMul", inputs=["featr", "Whead"],
+                     outputs=["logits"]),
+            ],
+            initializers={"W": Tensor.from_array("W", W),
+                          "Whead": Tensor.from_array("Whead", Whead)},
+            inputs=[_vi("img", ["N", 3, 3, 3])],
+            outputs=[_vi("logits", ["N", 2])],
+        )
+        payload = Model(graph=g).encode()
+        imgs = rng.uniform(size=(4, 3, 3, 3)).astype(np.float32)  # HWC
+        fz = ImageFeaturizer(inputCol="image", outputCol="features",
+                             imageHeight=3, imageWidth=3, headless=True)
+        fz.setModelPayload(payload)
+        out = fz.transform(Table({"image": imgs}))
+        assert out["features"].shape == (4, 5)  # penultimate (featr) width
+        logits = ImageFeaturizer(inputCol="image", outputCol="logits",
+                                 imageHeight=3, imageWidth=3, headless=False)
+        logits.setModelPayload(payload)
+        out2 = logits.transform(Table({"image": imgs}))
+        assert out2["logits"].shape == (4, 2)

@@ -1,0 +1,26 @@
+"""IO — HTTP-on-DataFrame client layer, serving, binary/image datasources.
+
+Reference: core io layer (SURVEY.md §1 L4): io/http/HTTPTransformer.scala:93-147,
+SimpleHTTPTransformer.scala, HTTPSchema.scala, Parsers.scala, RESTHelpers.scala;
+serving sources/sinks (HTTPSourceV2.scala:485-713 WorkerServer, HTTPSinkV2.scala,
+ServingUDFs.scala); io/binary/BinaryFileFormat.scala and the patched image
+datasource; io/powerbi/PowerBIWriter.scala. The reference builds these on Spark
+streaming internals; here the client layer is an async pooled executor over
+table columns and serving is an embedded threaded HTTP server feeding
+micro-batches through a fitted pipeline.
+"""
+
+from .http import (CustomInputParser, CustomOutputParser, HTTPRequestData,
+                   HTTPResponseData, HTTPTransformer, JSONInputParser,
+                   JSONOutputParser, SimpleHTTPTransformer, StringOutputParser)
+from .serving import ServingServer, request_to_table, respond_with
+from .binary import read_binary_files, read_image_dir
+from .powerbi import PowerBIWriter
+
+__all__ = [
+    "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
+    "SimpleHTTPTransformer", "JSONInputParser", "CustomInputParser",
+    "JSONOutputParser", "StringOutputParser", "CustomOutputParser",
+    "ServingServer", "request_to_table", "respond_with",
+    "read_binary_files", "read_image_dir", "PowerBIWriter",
+]

@@ -1,0 +1,192 @@
+"""Serving — embedded HTTP server feeding micro-batches through a pipeline.
+
+Reference: the Spark Serving layer (SURVEY.md §3.5): custom streaming sources
+embedding web servers (HTTPSourceV2.scala:485-713 ``WorkerServer`` with request
+queue + reply-by-id sink, HTTPSource.scala head-node variant, ServingUDFs.scala
+``makeReplyUDF``). The reference queues requests into Spark micro-batches and
+replies through a sink keyed by request id; here a threaded HTTP server queues
+requests, a serving loop drains the queue into a ``Table`` micro-batch, runs
+the user pipeline (one jitted program for model transforms), and writes each
+row's reply back to its still-open connection — same architecture, no Spark.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.table import Table
+
+
+@dataclass
+class _PendingRequest:
+    """CachedRequest analog (HTTPSourceV2.scala:530-539)."""
+    id: str
+    method: str
+    path: str
+    headers: Dict[str, str]
+    body: bytes
+    reply_event: threading.Event = field(default_factory=threading.Event)
+    response: Optional[tuple] = None  # (status, headers, body)
+
+
+def request_to_table(requests: List[_PendingRequest]) -> Table:
+    """Micro-batch of queued requests → Table(id, value) — the serving source
+    schema (id + request struct)."""
+    ids = np.array([r.id for r in requests], dtype=object)
+    vals = np.empty(len(requests), dtype=object)
+    for i, r in enumerate(requests):
+        try:
+            vals[i] = _json.loads(r.body.decode()) if r.body else None
+        except Exception:
+            vals[i] = r.body
+    return Table({"id": ids, "value": vals})
+
+
+def respond_with(df: Table, id_col: str = "id", value_col: str = "reply",
+                 status_col: Optional[str] = None) -> Dict[str, tuple]:
+    """Table → {request id: (status, body)} — the reply-UDF analog
+    (ServingUDFs.scala makeReplyUDF)."""
+    out = {}
+    statuses = df[status_col] if status_col and status_col in df else None
+    for i in range(df.num_rows):
+        val = df[value_col][i]
+        if isinstance(val, np.ndarray):
+            val = val.tolist()
+        elif isinstance(val, np.generic):
+            val = val.item()
+        status = int(statuses[i]) if statuses is not None else 200
+        out[str(df[id_col][i])] = (status, _json.dumps(val).encode())
+    return out
+
+
+class ServingServer:
+    """spark.readStream.server()...writeStream.server() analog.
+
+    ``handler``: Table(id, value) -> Table(id, reply) — typically a fitted
+    PipelineModel wrapped to map columns. Batching: requests are collected for
+    up to ``maxBatchLatency`` seconds or ``maxBatchSize`` rows, whichever
+    first (micro-batch trigger analog), then run through the handler as ONE
+    batch — on TPU that is one jitted call, which is where the reference's
+    "sub-millisecond" story becomes a batched-throughput story.
+    """
+
+    def __init__(self, handler: Callable[[Table], Table],
+                 host: str = "127.0.0.1", port: int = 8898,
+                 api_path: str = "/", max_batch_size: int = 64,
+                 max_batch_latency: float = 0.005,
+                 reply_timeout: float = 30.0):
+        self.handler = handler
+        self.host, self.port = host, port
+        self.api_path = api_path
+        self.max_batch_size = max_batch_size
+        self.max_batch_latency = max_batch_latency
+        self.reply_timeout = reply_timeout
+        self._queue: "queue.Queue[_PendingRequest]" = queue.Queue()
+        self._stop = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._threads: List[threading.Thread] = []
+
+    # --- embedded server (WorkerServer analog) -------------------------
+    def _make_handler_class(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                req = _PendingRequest(
+                    id=uuid.uuid4().hex, method="POST", path=self.path,
+                    headers=dict(self.headers), body=body)
+                outer._queue.put(req)
+                if not req.reply_event.wait(outer.reply_timeout):
+                    self.send_response(504)
+                    self.end_headers()
+                    return
+                status, headers, payload = req.response
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        return Handler
+
+    def _serve_loop(self) -> None:
+        """Micro-batch trigger: drain queue → handler → reply by id."""
+        while not self._stop.is_set():
+            batch: List[_PendingRequest] = []
+            try:
+                batch.append(self._queue.get(timeout=0.05))
+            except queue.Empty:
+                continue
+            deadline = time.monotonic() + self.max_batch_latency
+            while (len(batch) < self.max_batch_size
+                   and time.monotonic() < deadline):
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    time.sleep(0.0005)
+            df = request_to_table(batch)
+            by_id = {r.id: r for r in batch}
+            try:
+                out = self.handler(df)
+                replies = respond_with(out) if isinstance(out, Table) else out
+            except Exception as e:  # noqa: BLE001
+                err = _json.dumps({"error": str(e)}).encode()
+                replies = {r.id: (500, err) for r in batch}
+            for rid, (status, payload) in replies.items():
+                req = by_id.get(rid)
+                if req is not None:
+                    req.response = (status, {}, payload)
+                    req.reply_event.set()
+            # requests the handler dropped get an error instead of a hang
+            for r in batch:
+                if r.response is None:
+                    r.response = (500, {}, b'{"error": "no reply produced"}')
+                    r.reply_event.set()
+
+    def start(self) -> "ServingServer":
+        class _Server(ThreadingHTTPServer):
+            # default backlog of 5 resets connections under concurrent load
+            request_queue_size = 256
+            daemon_threads = True
+
+        self._httpd = _Server((self.host, self.port),
+                              self._make_handler_class())
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        t1 = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t2 = threading.Thread(target=self._serve_loop, daemon=True)
+        t1.start()
+        t2.start()
+        self._threads = [t1, t2]
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}{self.api_path}"
+
+    def __enter__(self) -> "ServingServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -46,9 +46,12 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         return self.set("onnxModel", model)
 
     def _configured_model(self, base: ONNXModel, fn, input_name: str) -> ONNXModel:
-        key = (id(base), self.getHeadless(),
+        # key holds `base` itself (not id()) — keeping the reference alive
+        # prevents CPython id reuse from serving a stale sliced model
+        key = (base, self.getHeadless(),
                self.get("featureTensorName"), self.getOutputCol())
-        if self._cfg_cache is not None and self._cfg_cache[0] == key:
+        if (self._cfg_cache is not None and self._cfg_cache[0][0] is base
+                and self._cfg_cache[0][1:] == key[1:]):
             return self._cfg_cache[1]
         model = base.copy()
         if self.getHeadless():

@@ -34,6 +34,9 @@ class OnnxFunction:
         self.input_info = {vi.name: vi for vi in g.inputs}
         self.outputs = list(outputs) if outputs else [vi.name for vi in g.outputs]
         self._plan = self._make_plan(g, self.outputs)
+        # decode weights ONCE — Tensor.array() copies, and models carry
+        # hundreds of MB of initializers
+        self._weights = {k: t.array() for k, t in g.initializers.items()}
 
     @staticmethod
     def _make_plan(g: Graph, outputs: Sequence[str]) -> List[Node]:
@@ -45,32 +48,37 @@ class OnnxFunction:
                 producer[o] = n
         known = set(g.initializers) | {vi.name for vi in g.inputs}
         plan: List[Node] = []
-        seen = set()
-
-        def visit(name: str, stack: Tuple[str, ...]) -> None:
-            if name in known or name == "":
-                return
+        done = set()      # node ids fully emitted
+        in_stack = set()  # node ids on the current path (cycle check)
+        # iterative post-order DFS — exported transformer graphs routinely
+        # exceed Python's recursion limit in depth
+        work: List[Tuple[str, bool]] = [(o, False) for o in reversed(outputs)]
+        while work:
+            name, expanded = work.pop()
+            if name == "" or name in known:
+                continue
             n = producer.get(name)
             if n is None:
                 raise ValueError(f"tensor {name!r} has no producer and is not "
                                  f"a graph input/initializer")
-            if id(n) in seen:
-                return
-            if name in stack:
+            if expanded:
+                in_stack.discard(id(n))
+                if id(n) not in done:
+                    done.add(id(n))
+                    plan.append(n)
+                continue
+            if id(n) in done:
+                continue
+            if id(n) in in_stack:
                 raise ValueError(f"cycle through {name!r}")
-            for i in n.inputs:
-                visit(i, stack + (name,))
-            seen.add(id(n))
-            plan.append(n)
-
-        for o in outputs:
-            visit(o, ())
+            in_stack.add(id(n))
+            work.append((name, True))
+            for i in reversed(n.inputs):
+                work.append((i, False))
         return plan
 
     def __call__(self, feeds: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        g = self.model.graph
-        env: Dict[str, np.ndarray] = {k: t.array()
-                                      for k, t in g.initializers.items()}
+        env: Dict[str, np.ndarray] = dict(self._weights)
         for name in self.graph_inputs:
             if name not in feeds:
                 raise ValueError(
@@ -91,9 +99,10 @@ class OnnxFunction:
                     env[name] = val
         return {o: env[o] for o in self.outputs}
 
-    def as_jax(self):
-        """(fn, input_names): positional jit-friendly callable."""
-        names = list(self.graph_inputs)
+    def as_jax(self, names: Optional[List[str]] = None):
+        """(fn, input_names): positional jit-friendly callable. ``names``
+        overrides the positional input ordering (default: graph order)."""
+        names = list(names) if names is not None else list(self.graph_inputs)
 
         def fn(*arrays):
             return tuple(self({n: a for n, a in zip(names, arrays)}).values())

@@ -147,10 +147,7 @@ class ONNXModel(Transformer):
             self._jit_cache = {}
         key = tuple(names) + tuple(fn.outputs)
         if key not in self._jit_cache:
-            def run(*arrays):
-                return tuple(fn({m: a for m, a in zip(names, arrays)}).values())
-
-            self._jit_cache[key] = jax.jit(run)
+            self._jit_cache[key] = jax.jit(fn.as_jax(names)[0])
         return self._jit_cache[key]
 
     def _post_transforms(self, df: Table) -> Table:

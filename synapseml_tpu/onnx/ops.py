@@ -535,10 +535,17 @@ def _pad(node, x, *rest):
     if pads is None and rest:
         pads = [int(v) for v in _static(rest[0], "pads", node).ravel()]
     value = node.attr("value", 0.0)
-    if len(rest) > 1:
+    if len(rest) > 1 and rest[1] is not None:  # '' input name -> None (skipped)
         value = float(np.asarray(rest[1]).ravel()[0])
     half = len(pads) // 2
-    widths = [(pads[i], pads[i + half]) for i in range(half)]
+    if len(rest) > 2 and rest[2] is not None:  # opset-18 axes input
+        axes = [int(a) % x.ndim
+                for a in _static(rest[2], "axes", node).ravel()]
+        widths = [(0, 0)] * x.ndim
+        for j, a in enumerate(axes):
+            widths[a] = (pads[j], pads[j + half])
+    else:
+        widths = [(pads[i], pads[i + half]) for i in range(half)]
     mode = node.attr("mode", "constant")
     if mode == "constant":
         return jnp.pad(x, widths, constant_values=value)

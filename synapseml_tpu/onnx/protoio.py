@@ -48,6 +48,8 @@ def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
 
 
 def _write_varint(out: bytearray, value: int) -> None:
+    if value < 0:  # int64 negatives (e.g. -1 dynamic dims) are 64-bit 2's-compl
+        value &= (1 << 64) - 1
     while True:
         b = value & 0x7F
         value >>= 7

@@ -1,0 +1,38 @@
+"""AI-service REST transformers (host-side).
+
+Reference: module ``cognitive`` (~10.1k LoC, ~65 transformers; SURVEY.md §2.8).
+All build on the base machinery in base.py (ServiceParams, auth, retries,
+concurrency) over the io/http layer — no device work. Implemented families:
+OpenAI, language/text analytics, translate, vision, face, anomaly, speech,
+document intelligence, search, Bing.
+"""
+
+from .base import CognitiveServiceBase, HasServiceParams, HasSetLocation
+from .openai import (OpenAIChatCompletion, OpenAICompletion, OpenAIEmbedding,
+                     OpenAIPrompt)
+from .language import (NER, PII, AnalyzeHealthText, EntityLinking,
+                       KeyPhraseExtractor, LanguageDetector, TextSentiment)
+from .translate import (BreakSentence, Detect, DictionaryLookup, Translate,
+                        Transliterate)
+from .vision import (OCR, AnalyzeImage, DescribeImage, DetectFace,
+                     GenerateThumbnails, TagImage)
+from .anomaly import (DetectAnomalies, DetectLastAnomaly,
+                      DetectMultivariateAnomaly, SimpleDetectAnomalies)
+from .speech import AnalyzeDocument, SpeechToText, SpeechToTextSDK, TextToSpeech
+from .search import AzureSearchWriter, BingImageSearch
+
+__all__ = [
+    "CognitiveServiceBase", "HasServiceParams", "HasSetLocation",
+    "OpenAICompletion", "OpenAIChatCompletion", "OpenAIEmbedding",
+    "OpenAIPrompt",
+    "TextSentiment", "KeyPhraseExtractor", "NER", "PII", "EntityLinking",
+    "LanguageDetector", "AnalyzeHealthText",
+    "Translate", "Transliterate", "Detect", "BreakSentence",
+    "DictionaryLookup",
+    "AnalyzeImage", "DescribeImage", "TagImage", "OCR", "GenerateThumbnails",
+    "DetectFace",
+    "DetectLastAnomaly", "DetectAnomalies", "SimpleDetectAnomalies",
+    "DetectMultivariateAnomaly",
+    "SpeechToText", "SpeechToTextSDK", "TextToSpeech", "AnalyzeDocument",
+    "AzureSearchWriter", "BingImageSearch",
+]

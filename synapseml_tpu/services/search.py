@@ -1,0 +1,103 @@
+"""Azure AI Search writer + Bing search transformer.
+
+Reference: cognitive/.../services/search/AzureSearch.scala (~754 LoC,
+AzureSearchWriter indexes DataFrames in batches with mergeOrUpload actions)
+and services/bing/BingImageSearch.scala.
+"""
+
+from __future__ import annotations
+
+import json as _json
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.params import Param
+from ..core.table import Table
+from ..io.http import HTTPRequestData, send_with_retries
+from .base import CognitiveServiceBase
+
+
+class AzureSearchWriter:
+    """Batch-index a Table into an Azure AI Search index
+    (reference AzureSearchWriter.stream/write)."""
+
+    def __init__(self, service_name: str, index_name: str, key: str,
+                 action_col: str = "@search.action",
+                 default_action: str = "mergeOrUpload",
+                 batch_size: int = 100, api_version: str = "2023-11-01",
+                 url: Optional[str] = None, retries: int = 3):
+        self.url = (url or f"https://{service_name}.search.windows.net") \
+            + f"/indexes/{index_name}/docs/index?api-version={api_version}"
+        self.key = key
+        self.action_col = action_col
+        self.default_action = default_action
+        self.batch_size = batch_size
+        self.retries = retries
+
+    def write(self, df: Table) -> int:
+        rows = df.to_pandas().to_dict(orient="records")
+        written = 0
+        for start in range(0, len(rows), self.batch_size):
+            chunk = rows[start:start + self.batch_size]
+            for r in chunk:
+                r.setdefault(self.action_col, self.default_action)
+            req = HTTPRequestData.from_json_body(
+                self.url, {"value": chunk}, {"api-key": self.key})
+            resp = send_with_retries(req, retries=self.retries)
+            if not 200 <= resp.status_code < 300:
+                raise RuntimeError(f"index batch failed at {start}: "
+                                   f"{resp.status_code} {resp.reason}")
+            written += len(chunk)
+        return written
+
+
+class BingImageSearch(CognitiveServiceBase):
+    """Image search (reference BingImageSearch.scala); emits the raw value
+    list — ``downloadFromUrls`` is a helper on the result."""
+
+    qCol = Param("qCol", "column of queries", str, "q")
+    count = Param("count", "results per query", int, 10)
+    offset = Param("offset", "result offset", int, 0)
+    imageType = Param("imageType", "photo|clipart|...", str)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        if not self.isSet("url"):
+            self.set("url",
+                     "https://api.bing.microsoft.com/v7.0/images/search")
+
+    def _prepare_method(self):
+        return "GET"
+
+    def _prepare_url(self, df, i):
+        from urllib.parse import quote
+
+        q = quote(str(df[self.getQCol()][i]))
+        u = (f"{self.get('url')}?q={q}&count={self.getCount()}"
+             f"&offset={self.getOffset()}")
+        it = self.get("imageType")
+        return u + (f"&imageType={it}" if it else "")
+
+    def _prepare_body(self, df, i):
+        return b""  # GET
+
+    def _parse_response(self, parsed, df, i):
+        try:
+            return [v["contentUrl"] for v in parsed["value"]]
+        except (KeyError, TypeError):
+            return parsed
+
+    @staticmethod
+    def downloadFromUrls(urls: List[str], concurrency: int = 4,
+                         timeout: float = 30.0) -> List[Optional[bytes]]:
+        from concurrent.futures import ThreadPoolExecutor
+
+        def get(u):
+            r = send_with_retries(
+                HTTPRequestData(url=u, method="GET", headers={}),
+                timeout=timeout, retries=1)
+            return r.entity if 200 <= r.status_code < 300 else None
+
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            return list(pool.map(get, urls))

@@ -1,0 +1,98 @@
+"""Azure Translator transformers.
+
+Reference: cognitive/.../services/translate/ (~885 LoC: Translate,
+Transliterate, Detect, BreakSentence, DictionaryLookup). All POST arrays of
+``{Text: ...}`` to api.cognitive.microsofttranslator.com endpoints.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core.params import Param
+from .base import CognitiveServiceBase
+
+_BASE = "https://api.cognitive.microsofttranslator.com"
+
+
+class _TranslatorBase(CognitiveServiceBase):
+    textCol = Param("textCol", "column of input texts", str, "text")
+    apiVersion = Param("apiVersion", "API version", str, "3.0")
+    subscriptionRegion = Param("subscriptionRegion", "resource region", str)
+    _path = "translate"
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        if not self.isSet("url"):
+            self.set("url", _BASE)
+
+    def _query(self, df, i) -> str:
+        return f"?api-version={self.getApiVersion()}"
+
+    def _prepare_url(self, df, i):
+        return f"{self.get('url').rstrip('/')}/{self._path}{self._query(df, i)}"
+
+    def _prepare_headers(self, df, i):
+        h = super()._prepare_headers(df, i)
+        region = self._resolve("subscriptionRegion", df, i)
+        if region:
+            h["Ocp-Apim-Subscription-Region"] = str(region)
+        return h
+
+    def _prepare_body(self, df, i):
+        text = df[self.getTextCol()][i]
+        if text is None:
+            return None
+        texts = text if isinstance(text, (list, tuple)) else [text]
+        return [{"Text": str(t)} for t in texts]
+
+
+class Translate(_TranslatorBase):
+    toLanguage = Param("toLanguage", "target language(s)", is_complex=True)
+    fromLanguage = Param("fromLanguage", "source language", str)
+    _path = "translate"
+
+    def _query(self, df, i):
+        to = self._resolve("toLanguage", df, i)
+        if to is None:
+            raise ValueError("Translate: toLanguage is not set")
+        to_list = to if isinstance(to, (list, tuple)) else [to]
+        q = f"?api-version={self.getApiVersion()}"
+        for t in to_list:
+            q += f"&to={t}"
+        frm = self._resolve("fromLanguage", df, i)
+        if frm:
+            q += f"&from={frm}"
+        return q
+
+
+class Detect(_TranslatorBase):
+    _path = "detect"
+
+
+class BreakSentence(_TranslatorBase):
+    _path = "breaksentence"
+
+
+class Transliterate(_TranslatorBase):
+    language = Param("language", "source language", str)
+    fromScript = Param("fromScript", "source script", str)
+    toScript = Param("toScript", "target script", str)
+    _path = "transliterate"
+
+    def _query(self, df, i):
+        return (f"?api-version={self.getApiVersion()}"
+                f"&language={self._resolve('language', df, i)}"
+                f"&fromScript={self._resolve('fromScript', df, i)}"
+                f"&toScript={self._resolve('toScript', df, i)}")
+
+
+class DictionaryLookup(_TranslatorBase):
+    fromLanguage = Param("fromLanguage", "source language", str)
+    toLanguage = Param("toLanguage", "target language", is_complex=True)
+    _path = "dictionary/lookup"
+
+    def _query(self, df, i):
+        return (f"?api-version={self.getApiVersion()}"
+                f"&from={self._resolve('fromLanguage', df, i)}"
+                f"&to={self._resolve('toLanguage', df, i)}")

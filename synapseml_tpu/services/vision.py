@@ -1,0 +1,103 @@
+"""Azure AI Vision + Face transformers.
+
+Reference: cognitive/.../services/vision/ComputerVision.scala (~787 LoC:
+AnalyzeImage, DescribeImage, OCR, RecognizeText, TagImage, GenerateThumbnails)
+and services/face/Face.scala (DetectFace, ...). Images go either as a URL
+(``imageUrlCol``) or raw bytes (``imageBytesCol``, octet-stream body).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.params import Param
+from .base import HasSetLocation
+
+
+class _VisionBase(HasSetLocation):
+    imageUrlCol = Param("imageUrlCol", "column of image urls", str)
+    imageBytesCol = Param("imageBytesCol", "column of image bytes", str)
+    urlPath = "vision/v3.2/analyze"
+
+    def _prepare_headers(self, df, i):
+        h = super()._prepare_headers(df, i)
+        if self.isSet("imageBytesCol"):
+            h["Content-Type"] = "application/octet-stream"
+        return h
+
+    def _prepare_body(self, df, i):
+        if self.isSet("imageBytesCol"):
+            b = df[self.getImageBytesCol()][i]
+            return bytes(b) if b is not None else None
+        if self.isSet("imageUrlCol"):
+            u = df[self.getImageUrlCol()][i]
+            return {"url": str(u)} if u is not None else None
+        raise ValueError(f"{type(self).__name__}: set imageUrlCol or "
+                         "imageBytesCol")
+
+
+class AnalyzeImage(_VisionBase):
+    visualFeatures = Param("visualFeatures", "features to extract", list,
+                           ["Categories"])
+    details = Param("details", "detail domains", list)
+    descriptionExclude = Param("descriptionExclude", "models to exclude", list)
+
+    def _prepare_url(self, df, i):
+        q = "?visualFeatures=" + ",".join(self.getVisualFeatures())
+        d = self.get("details")
+        if d:
+            q += "&details=" + ",".join(d)
+        return super()._prepare_url(df, i) + q
+
+
+class DescribeImage(_VisionBase):
+    urlPath = "vision/v3.2/describe"
+    maxCandidates = Param("maxCandidates", "number of captions", int, 1)
+
+    def _prepare_url(self, df, i):
+        return (super()._prepare_url(df, i)
+                + f"?maxCandidates={self.getMaxCandidates()}")
+
+
+class TagImage(_VisionBase):
+    urlPath = "vision/v3.2/tag"
+
+
+class OCR(_VisionBase):
+    urlPath = "vision/v3.2/ocr"
+    detectOrientation = Param("detectOrientation", "detect text orientation",
+                              bool, True)
+
+    def _prepare_url(self, df, i):
+        return (super()._prepare_url(df, i)
+                + f"?detectOrientation={str(self.getDetectOrientation()).lower()}")
+
+
+class GenerateThumbnails(_VisionBase):
+    urlPath = "vision/v3.2/generateThumbnail"
+    width = Param("width", "thumbnail width", int, 64)
+    height = Param("height", "thumbnail height", int, 64)
+    smartCropping = Param("smartCropping", "smart-crop", bool, True)
+
+    def _prepare_url(self, df, i):
+        return (super()._prepare_url(df, i)
+                + f"?width={self.getWidth()}&height={self.getHeight()}"
+                  f"&smartCropping={str(self.getSmartCropping()).lower()}")
+
+    def _parse_response(self, parsed, df, i):
+        return parsed  # thumbnail bytes (non-JSON) come back as text fallback
+
+
+class DetectFace(_VisionBase):
+    urlPath = "face/v1.0/detect"
+    returnFaceAttributes = Param("returnFaceAttributes", "attributes to return",
+                                 list)
+    returnFaceLandmarks = Param("returnFaceLandmarks", "return landmarks",
+                                bool, False)
+
+    def _prepare_url(self, df, i):
+        q = f"?returnFaceLandmarks={str(self.getReturnFaceLandmarks()).lower()}"
+        attrs = self.get("returnFaceAttributes")
+        if attrs:
+            q += "&returnFaceAttributes=" + ",".join(attrs)
+        return super()._prepare_url(df, i) + q

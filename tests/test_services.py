@@ -1,0 +1,196 @@
+"""AI-service transformer tests against an in-process mock server that records
+requests and returns canned service responses. Reference analog: cognitive
+module test suites (SURVEY.md §2.8/§4)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.services import (NER, AzureSearchWriter, BingImageSearch,
+                                    DetectLastAnomaly, LanguageDetector,
+                                    OpenAIChatCompletion, OpenAICompletion,
+                                    OpenAIEmbedding, OpenAIPrompt,
+                                    TextSentiment, Translate)
+
+
+@pytest.fixture()
+def mock_service():
+    """Server that records (path, headers, body) and replies from a script."""
+    state = {"requests": [], "responses": {}}
+
+    class Handler(BaseHTTPRequestHandler):
+        def _handle(self):
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) if length else b""
+            try:
+                body = json.loads(raw) if raw else None
+            except Exception:
+                body = raw
+            state["requests"].append(
+                {"path": self.path,
+                 "headers": {k.lower(): v for k, v in self.headers.items()},
+                 "body": body, "method": self.command})
+            for prefix, resp in state["responses"].items():
+                if self.path.startswith(prefix):
+                    payload = json.dumps(resp).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+            self.send_response(404)
+            self.end_headers()
+
+        do_POST = do_GET = _handle
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    state["url"] = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield state
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestOpenAI:
+    def test_completion_request_and_parse(self, mock_service):
+        mock_service["responses"]["/openai"] = {
+            "choices": [{"text": " positive"}]}
+        t = OpenAICompletion(url=mock_service["url"], deploymentName="davinci",
+                             subscriptionKey="k", maxTokens=5, outputCol="out")
+        out = t.transform(Table({"prompt": np.array(["great movie!"])}))
+        req = mock_service["requests"][0]
+        assert "/openai/deployments/davinci/completions" in req["path"]
+        assert req["headers"].get("api-key") == "k"
+        assert req["body"]["prompt"] == "great movie!"
+        assert req["body"]["max_tokens"] == 5
+        assert out["out"][0]["choices"][0]["text"] == " positive"
+        assert out[t.get("errorCol")][0] is None
+
+    def test_chat_and_embedding(self, mock_service):
+        mock_service["responses"]["/openai"] = {
+            "choices": [{"message": {"role": "assistant", "content": "hi"}}],
+            "data": [{"embedding": [0.1, 0.2]}]}
+        msgs = np.empty(1, dtype=object)
+        msgs[0] = [{"role": "user", "content": "hello"}]
+        chat = OpenAIChatCompletion(url=mock_service["url"],
+                                    deploymentName="gpt", outputCol="out")
+        out = chat.transform(Table({"messages": msgs}))
+        assert out["out"][0]["choices"][0]["message"]["content"] == "hi"
+
+        emb = OpenAIEmbedding(url=mock_service["url"], deploymentName="ada",
+                              outputCol="vec")
+        out2 = emb.transform(Table({"text": np.array(["abc"])}))
+        np.testing.assert_allclose(out2["vec"][0], [0.1, 0.2], rtol=1e-6)
+
+    def test_prompt_templating_and_postprocess(self, mock_service):
+        mock_service["responses"]["/openai"] = {
+            "choices": [{"message": {"content": "cat, dog"}}]}
+        t = OpenAIPrompt(url=mock_service["url"], deploymentName="gpt",
+                         promptTemplate="List animals in {text}",
+                         postProcessing="csv", outputCol="out")
+        out = t.transform(Table({"text": np.array(["the farm"])}))
+        assert mock_service["requests"][0]["body"]["messages"][-1]["content"] \
+            == "List animals in the farm"
+        assert out["out"][0] == ["cat", "dog"]
+
+    def test_missing_deployment_rejected(self, mock_service):
+        t = OpenAICompletion(url=mock_service["url"])
+        with pytest.raises(ValueError, match="deploymentName"):
+            t.transform(Table({"prompt": np.array(["x"])}))
+
+
+class TestLanguage:
+    def test_sentiment_body_and_parse(self, mock_service):
+        mock_service["responses"]["/language"] = {
+            "results": {"documents": [{"id": "0", "sentiment": "positive"}]}}
+        t = TextSentiment(url=mock_service["url"] + "/language/:analyze-text",
+                          subscriptionKey="sk", outputCol="sent")
+        out = t.transform(Table({"text": np.array(["I love it"])}))
+        req = mock_service["requests"][0]
+        assert req["body"]["kind"] == "SentimentAnalysis"
+        assert req["body"]["analysisInput"]["documents"][0]["text"] == "I love it"
+        assert req["headers"]["ocp-apim-subscription-key"] == "sk"
+        assert out["sent"][0]["sentiment"] == "positive"
+
+    def test_ner_and_language_detection_kinds(self, mock_service):
+        mock_service["responses"]["/l"] = {"results": {"documents": [{}]}}
+        NER(url=mock_service["url"] + "/l", outputCol="o").transform(
+            Table({"text": np.array(["Bill Gates"])}))
+        LanguageDetector(url=mock_service["url"] + "/l", outputCol="o"
+                         ).transform(Table({"text": np.array(["bonjour"])}))
+        kinds = [r["body"]["kind"] for r in mock_service["requests"]]
+        assert kinds == ["EntityRecognition", "LanguageDetection"]
+
+
+class TestTranslate:
+    def test_translate_query_params(self, mock_service):
+        mock_service["responses"]["/translate"] = [
+            {"translations": [{"text": "Hallo", "to": "de"}]}]
+        t = Translate(url=mock_service["url"], toLanguage=["de", "fr"],
+                      subscriptionRegion="eastus", outputCol="tr")
+        out = t.transform(Table({"text": np.array(["Hello"])}))
+        req = mock_service["requests"][0]
+        assert "to=de" in req["path"] and "to=fr" in req["path"]
+        assert req["headers"]["ocp-apim-subscription-region"] == "eastus"
+        assert req["body"] == [{"Text": "Hello"}]
+        assert out["tr"][0][0]["translations"][0]["text"] == "Hallo"
+
+
+class TestAnomaly:
+    def test_detect_last(self, mock_service):
+        mock_service["responses"]["/anomalydetector"] = {
+            "isAnomaly": True, "expectedValue": 1.0}
+        series = np.empty(1, dtype=object)
+        series[0] = [{"timestamp": "2026-01-01T00:00:00Z", "value": float(v)}
+                     for v in [1, 1, 1, 9]]
+        t = DetectLastAnomaly(
+            url=mock_service["url"] + "/anomalydetector/v1.0/timeseries/last/detect",
+            granularity="daily", outputCol="anom")
+        out = t.transform(Table({"series": series}))
+        assert mock_service["requests"][0]["body"]["granularity"] == "daily"
+        assert out["anom"][0]["isAnomaly"] is True
+
+
+class TestSearchAndBing:
+    def test_azure_search_writer(self, mock_service):
+        mock_service["responses"]["/indexes"] = {"value": []}
+        w = AzureSearchWriter("svc", "idx", "key", batch_size=2,
+                              url=mock_service["url"])
+        n = w.write(Table({"id": np.array(["1", "2", "3"]),
+                           "t": np.array(["a", "b", "c"])}))
+        assert n == 3
+        first = mock_service["requests"][0]
+        assert first["headers"]["api-key"] == "key"
+        assert first["body"]["value"][0]["@search.action"] == "mergeOrUpload"
+
+    def test_bing_image_search(self, mock_service):
+        mock_service["responses"]["/v7"] = {
+            "value": [{"contentUrl": "http://x/1.jpg"}]}
+        t = BingImageSearch(url=mock_service["url"] + "/v7.0/images/search",
+                            subscriptionKey="bk", count=3, outputCol="urls")
+        out = t.transform(Table({"q": np.array(["cats"])}))
+        req = mock_service["requests"][0]
+        assert req["method"] == "GET"
+        assert "q=cats" in req["path"] and "count=3" in req["path"]
+        assert out["urls"][0] == ["http://x/1.jpg"]
+
+
+class TestServiceParamCols:
+    def test_vector_param_binding(self, mock_service):
+        mock_service["responses"]["/openai"] = {"choices": [{"text": "ok"}]}
+        t = OpenAICompletion(url=mock_service["url"], outputCol="out")
+        t.setDeploymentNameCol("dep")
+        df = Table({"prompt": np.array(["a", "b"]),
+                    "dep": np.array(["m1", "m2"])})
+        t.transform(df)
+        paths = [r["path"] for r in mock_service["requests"]]
+        assert "/openai/deployments/m1/completions" in paths[0]
+        assert "/openai/deployments/m2/completions" in paths[1]

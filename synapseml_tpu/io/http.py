@@ -17,6 +17,7 @@ import time
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -92,6 +93,14 @@ def send_with_retries(req: HTTPRequestData, timeout: float = 60.0,
     return last or HTTPResponseData(status_code=0, reason="no attempts")
 
 
+def dispatch_with_handler(req: HTTPRequestData, timeout: float, retries: int,
+                          backoff: float, handler=None) -> HTTPResponseData:
+    """Single dispatch point for handler-or-default sending (shared by
+    HTTPTransformer and the services layer)."""
+    send = lambda r: send_with_retries(r, timeout, retries, backoff)  # noqa: E731
+    return handler(req, send) if handler is not None else send(req)
+
+
 class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     """Column of HTTPRequestData → column of HTTPResponseData
     (reference HTTPTransformer.scala:93-147)."""
@@ -111,22 +120,38 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
         return self.set("handler", f)
 
     def _send_one(self, req: HTTPRequestData) -> HTTPResponseData:
-        send = lambda r: send_with_retries(  # noqa: E731
-            r, self.getTimeout(), self.getMaxRetries(), self.getBackoff())
-        h = self.get("handler")
-        return h(req, send) if h is not None else send(req)
+        return dispatch_with_handler(req, self.getTimeout(),
+                                     self.getMaxRetries(), self.getBackoff(),
+                                     self.get("handler"))
 
     def _transform(self, df: Table) -> Table:
+        import time as _time
+
         reqs: List[HTTPRequestData] = list(df[self.getInputCol()])
         workers = max(1, min(self.getConcurrency(),
                              df.concurrency_hint or self.getConcurrency()))
         if workers == 1:
             out = [self._send_one(r) for r in reqs]
         else:
-            with ThreadPoolExecutor(max_workers=workers) as pool:
+            # concurrentTimeout is a SHARED wall-clock deadline for the whole
+            # batch (reference awaitWithTimeout over the future batch)
+            budget = self.get("concurrentTimeout")
+            deadline = None if budget is None else _time.monotonic() + budget
+            pool = ThreadPoolExecutor(max_workers=workers)
+            try:
                 futures = [pool.submit(self._send_one, r) for r in reqs]
-                deadline = self.get("concurrentTimeout")
-                out = [f.result(timeout=deadline) for f in futures]
+                out = []
+                for f in futures:
+                    remaining = (None if deadline is None
+                                 else max(deadline - _time.monotonic(), 0.0))
+                    out.append(f.result(timeout=remaining))
+            except FuturesTimeout:
+                pool.shutdown(wait=False, cancel_futures=True)
+                raise TimeoutError(
+                    f"HTTPTransformer: batch exceeded concurrentTimeout="
+                    f"{budget}s")
+            finally:
+                pool.shutdown(wait=False, cancel_futures=True)
         col = np.empty(len(out), dtype=object)
         col[:] = out
         return df.with_column(self.getOutputCol(), col)
@@ -224,9 +249,8 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
             self.set("errorCol", self.uid + "_errors")
 
     def _transform(self, df: Table) -> Table:
-        in_parser = self.get("inputParser") or JSONInputParser(
-            url=self.get("url"), inputCol=self.getInputCol(),
-            outputCol="__request")
+        in_parser = self.get("inputParser") or JSONInputParser()
+        in_parser = in_parser.copy()  # never mutate the caller's parser
         in_parser.set("inputCol", self.getInputCol())
         in_parser.set("outputCol", "__request")
         if in_parser.hasParam("url") and self.isSet("url"):
@@ -238,7 +262,7 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
         if self.get("handler") is not None:
             http.setHandler(self.get("handler"))
 
-        out_parser = self.get("outputParser") or JSONOutputParser()
+        out_parser = (self.get("outputParser") or JSONOutputParser()).copy()
         out_parser.set("inputCol", "__response")
         out_parser.set("outputCol", self.getOutputCol())
 

@@ -35,8 +35,11 @@ class OnnxFunction:
         self.outputs = list(outputs) if outputs else [vi.name for vi in g.outputs]
         self._plan = self._make_plan(g, self.outputs)
         # decode weights ONCE — Tensor.array() copies, and models carry
-        # hundreds of MB of initializers
-        self._weights = {k: t.array() for k, t in g.initializers.items()}
+        # hundreds of MB of initializers; only tensors the sliced plan
+        # actually reads are decoded (dead-tail weights stay raw bytes)
+        used = {i for n in self._plan for i in n.inputs} | set(self.outputs)
+        self._weights = {k: t.array() for k, t in g.initializers.items()
+                         if k in used}
 
     @staticmethod
     def _make_plan(g: Graph, outputs: Sequence[str]) -> List[Node]:

@@ -57,13 +57,15 @@ class HasServiceParams(Transformer):
     def __getattr__(self, item):
         # setXCol sugar for every declared param (reference setVectorParam)
         if item.startswith("set") and item.endswith("Col") and len(item) > 6:
-            pname = item[3].lower() + item[4:-3]
-            if pname in type(self)._params:
-                def _set(col: str):
-                    self.set_vector(pname, col)
-                    return self
+            stem = item[3:-3]
+            # try lowered-first-letter ("maxTokens") then verbatim ("AADToken")
+            for pname in (stem[0].lower() + stem[1:], stem):
+                if pname in type(self)._params:
+                    def _set(col: str, _p=pname):
+                        self.set_vector(_p, col)
+                        return self
 
-                return _set
+                    return _set
         raise AttributeError(f"{type(self).__name__} has no attribute {item!r}")
 
 
@@ -123,10 +125,11 @@ class CognitiveServiceBase(HasServiceParams):
     def _send_one(self, req: Optional[HTTPRequestData]) -> Optional[HTTPResponseData]:
         if req is None:
             return None
-        send = lambda r: send_with_retries(  # noqa: E731
-            r, self.getTimeout(), self.getMaxRetries(), self.getBackoff())
-        h = self.get("handler")
-        return h(req, send) if h is not None else send(req)
+        from ..io.http import dispatch_with_handler
+
+        return dispatch_with_handler(req, self.getTimeout(),
+                                     self.getMaxRetries(), self.getBackoff(),
+                                     self.get("handler"))
 
     def _transform(self, df: Table) -> Table:
         n = df.num_rows

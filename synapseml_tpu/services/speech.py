@@ -70,13 +70,16 @@ class TextToSpeech(CognitiveServiceBase):
         return h
 
     def _prepare_body(self, df, i):
+        from xml.sax.saxutils import escape, quoteattr
+
         text = df[self.getTextCol()][i]
         if text is None:
             return None
         voice = self._resolve("voiceName", df, i, "en-US-JennyNeural")
         lang = self._resolve("language", df, i, "en-US")
-        ssml = (f"<speak version='1.0' xml:lang='{lang}'>"
-                f"<voice name='{voice}'>{text}</voice></speak>")
+        ssml = (f"<speak version='1.0' xml:lang={quoteattr(str(lang))}>"
+                f"<voice name={quoteattr(str(voice))}>"
+                f"{escape(str(text))}</voice></speak>")
         return ssml.encode()
 
     def _parse_response(self, parsed, df, i):
@@ -123,6 +126,8 @@ class AnalyzeDocument(CognitiveServiceBase):
 
         from ..io.http import HTTPRequestData
 
+        from ..io.http import HTTPResponseData
+
         first = super()._send_one(req)
         if first is None or first.status_code not in (200, 201, 202):
             return first
@@ -131,13 +136,19 @@ class AnalyzeDocument(CognitiveServiceBase):
             return first
         headers = {k: v for k, v in req.headers.items()
                    if k.lower() != "content-type"}
+        poll = None
         for _ in range(self.getMaxPollRetries()):
             poll = super()._send_one(HTTPRequestData(
                 url=loc, method="GET", headers=headers))
             if poll is None:
-                return poll
+                break
             info = poll.json() if poll.entity else {}
             if info.get("status") in ("succeeded", "failed"):
                 return poll
             _t.sleep(self.getPollInterval())
-        return first
+        # poll exhausted/errored: report a timeout, NOT the 202 submit ack
+        return HTTPResponseData(
+            status_code=504,
+            reason=f"operation at {loc} did not complete within "
+                   f"{self.getMaxPollRetries()} polls",
+            entity=(poll.entity if poll is not None else None))

@@ -81,10 +81,15 @@ class Transliterate(_TranslatorBase):
     _path = "transliterate"
 
     def _query(self, df, i):
+        vals = {n: self._resolve(n, df, i)
+                for n in ("language", "fromScript", "toScript")}
+        missing = [n for n, v in vals.items() if v is None]
+        if missing:
+            raise ValueError(f"Transliterate: {', '.join(missing)} not set")
         return (f"?api-version={self.getApiVersion()}"
-                f"&language={self._resolve('language', df, i)}"
-                f"&fromScript={self._resolve('fromScript', df, i)}"
-                f"&toScript={self._resolve('toScript', df, i)}")
+                f"&language={vals['language']}"
+                f"&fromScript={vals['fromScript']}"
+                f"&toScript={vals['toScript']}")
 
 
 class DictionaryLookup(_TranslatorBase):
@@ -93,6 +98,9 @@ class DictionaryLookup(_TranslatorBase):
     _path = "dictionary/lookup"
 
     def _query(self, df, i):
-        return (f"?api-version={self.getApiVersion()}"
-                f"&from={self._resolve('fromLanguage', df, i)}"
-                f"&to={self._resolve('toLanguage', df, i)}")
+        frm = self._resolve("fromLanguage", df, i)
+        to = self._resolve("toLanguage", df, i)
+        if frm is None or to is None:
+            raise ValueError(
+                "DictionaryLookup: fromLanguage and toLanguage must be set")
+        return f"?api-version={self.getApiVersion()}&from={frm}&to={to}"

@@ -1,0 +1,19 @@
+"""Test frameworks: stage fuzzing and tolerance-CSV benchmarks.
+
+Reference: core/src/test/scala/.../core/test/fuzzing/Fuzzing.scala (the
+Serialization/Experiment/GetterSetter fuzzing traits applied to EVERY pipeline
+stage, with a meta-test that fails on uncovered stages —
+src/test/.../FuzzingTest.scala) and core/test/benchmarks/Benchmarks.scala
+(named metric values compared to checked-in CSVs with per-row tolerance).
+SURVEY.md §4 items 2-3.
+"""
+
+from .fuzzing import (TestObject, discover_stage_classes,
+                      experiment_fuzz, getter_setter_fuzz,
+                      serialization_fuzz)
+from .benchmarks import Benchmarks
+
+__all__ = [
+    "TestObject", "discover_stage_classes", "experiment_fuzz",
+    "getter_setter_fuzz", "serialization_fuzz", "Benchmarks",
+]

@@ -1,0 +1,73 @@
+"""Tolerance-CSV accuracy regression harness.
+
+Reference: core/.../core/test/benchmarks/Benchmarks.scala:15-140 — tests add
+named metric values; ``compare`` checks them against a checked-in CSV with
+per-row tolerance and (re)generates the CSV when asked. Guards GBDT/VW
+numerical parity exactly the way the reference's
+``benchmarks_VerifyLightGBMClassifier*.csv`` files do.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Optional
+
+
+class Benchmarks:
+    def __init__(self, name: str,
+                 resource_dir: str = None):
+        self.name = name
+        self.resource_dir = resource_dir or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+            "tests", "resources", "benchmarks")
+        self._rows: List[Dict] = []
+
+    def add(self, metric_name: str, value: float,
+            tolerance: float = 0.1) -> None:
+        self._rows.append({"name": metric_name, "value": float(value),
+                           "tolerance": float(tolerance)})
+
+    addBenchmark = add
+
+    @property
+    def csv_path(self) -> str:
+        return os.path.join(self.resource_dir, f"benchmarks_{self.name}.csv")
+
+    def write(self) -> str:
+        os.makedirs(self.resource_dir, exist_ok=True)
+        with open(self.csv_path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=["name", "value", "tolerance"])
+            w.writeheader()
+            w.writerows(self._rows)
+        return self.csv_path
+
+    def compare(self, regenerate: bool = False) -> None:
+        """Assert every recorded metric is within tolerance of the checked-in
+        value (Benchmarks.scala verifyBenchmarks). ``regenerate=True`` (or env
+        UPDATE_BENCHMARKS=1) rewrites the CSV instead."""
+        if regenerate or os.environ.get("UPDATE_BENCHMARKS") == "1" \
+                or not os.path.exists(self.csv_path):
+            self.write()
+            return
+        with open(self.csv_path) as f:
+            expected = {r["name"]: r for r in csv.DictReader(f)}
+        errors = []
+        for row in self._rows:
+            exp = expected.get(row["name"])
+            if exp is None:
+                errors.append(f"{row['name']}: no checked-in value "
+                              f"(got {row['value']:.6f})")
+                continue
+            want = float(exp["value"])
+            tol = float(exp.get("tolerance", row["tolerance"]))
+            if abs(row["value"] - want) > tol:
+                errors.append(f"{row['name']}: {row['value']:.6f} vs "
+                              f"checked-in {want:.6f} (tol {tol})")
+        missing = set(expected) - {r["name"] for r in self._rows}
+        for m in sorted(missing):
+            errors.append(f"{m}: checked-in metric was not produced this run")
+        if errors:
+            raise AssertionError(
+                f"benchmark regression ({self.csv_path}):\n  "
+                + "\n  ".join(errors))

@@ -1,0 +1,124 @@
+"""Accuracy regression via tolerance CSVs.
+
+Reference: Benchmarks.scala + the checked-in CSVs like
+lightgbm/src/test/resources/benchmarks/benchmarks_VerifyLightGBMClassifier
+StreamBasic.csv (AUC per dataset per boosting type, tolerance 0.1;
+SURVEY.md §4.3/§6). Datasets here are deterministic synthetics (the reference
+uses checked-in CSV datasets); the guarded property is identical — silent
+accuracy drift in the GBDT/VW engines fails these tests.
+"""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.testing import Benchmarks
+from synapseml_tpu.train.metrics import auc_score
+
+
+def _binary_ds(n=800, f=10, seed=11):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logit = X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return Table({"features": X, "label": y})
+
+
+def _regression_ds(n=800, f=8, seed=12):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 2) + rng.normal(scale=0.2, size=n)
+    return Table({"features": X, "label": y.astype(np.float64)})
+
+
+class TestGBDTBenchmarks:
+    def test_classifier_auc_per_boosting_type(self):
+        from synapseml_tpu.models import LightGBMClassifier
+
+        bench = Benchmarks("VerifyLightGBMClassifierBasic")
+        df = _binary_ds()
+        for boosting in ("gbdt", "goss", "dart", "rf"):
+            kw = {"boostingType": boosting, "numIterations": 30}
+            if boosting == "rf":
+                kw.update(baggingFraction=0.8, baggingFreq=1,
+                          featureFraction=0.8)
+            model = LightGBMClassifier(**kw).fit(df)
+            prob = model.transform(df)["probability"][:, 1]
+            bench.add(f"synthBinary.{boosting}",
+                      auc_score(df["label"], prob), tolerance=0.05)
+        bench.compare()
+
+    def test_regressor_rmse(self):
+        from synapseml_tpu.models import LightGBMRegressor
+
+        bench = Benchmarks("VerifyLightGBMRegressor")
+        df = _regression_ds()
+        for boosting in ("gbdt", "goss"):
+            model = LightGBMRegressor(boostingType=boosting,
+                                      numIterations=30).fit(df)
+            pred = model.transform(df)["prediction"]
+            rmse = float(np.sqrt(np.mean((pred - df["label"]) ** 2)))
+            bench.add(f"synthRegression.{boosting}", rmse, tolerance=0.1)
+        bench.compare()
+
+    def test_ranker_ndcg(self):
+        from synapseml_tpu.models import LightGBMRanker
+        from synapseml_tpu.recommendation import RankingEvaluator
+
+        rng = np.random.default_rng(13)
+        n_groups, per = 40, 10
+        X = rng.normal(size=(n_groups * per, 6)).astype(np.float32)
+        rel = np.clip((X[:, 0] + rng.normal(scale=0.3, size=len(X))) * 1.5
+                      + 1.5, 0, 3).astype(np.float64).round()
+        groups = np.repeat(np.arange(n_groups), per)
+        df = Table({"features": X, "label": rel, "group": groups})
+        model = LightGBMRanker(numIterations=25, groupCol="group").fit(df)
+        scores = model.transform(df)["prediction"]
+        # ndcg@5 per group
+        ndcgs = []
+        for g in range(n_groups):
+            sel = groups == g
+            order = np.argsort(-scores[sel])
+            gains = rel[sel][order][:5]
+            ideal = np.sort(rel[sel])[::-1][:5]
+            dcg = float(((2 ** gains - 1) / np.log2(np.arange(2, 7))).sum())
+            idcg = float(((2 ** ideal - 1) / np.log2(np.arange(2, 7))).sum())
+            ndcgs.append(dcg / idcg if idcg > 0 else 0.0)
+        bench = Benchmarks("VerifyLightGBMRanker")
+        bench.add("synthRanking.ndcg@5", float(np.mean(ndcgs)),
+                  tolerance=0.05)
+        bench.compare()
+
+
+class TestVWBenchmarks:
+    def test_vw_classifier_auc(self):
+        from synapseml_tpu.vw import VowpalWabbitClassifier
+
+        bench = Benchmarks("VerifyVowpalWabbitClassifier")
+        df = _binary_ds()
+        model = VowpalWabbitClassifier(numPasses=8, learningRate=0.5).fit(df)
+        prob = model.transform(df)["probability"][:, 1]
+        bench.add("synthBinary.logistic", auc_score(df["label"], prob),
+                  tolerance=0.05)
+        bench.compare()
+
+
+class TestBenchmarkHarness:
+    def test_regression_detected(self, tmp_path):
+        b = Benchmarks("Harness", resource_dir=str(tmp_path))
+        b.add("m", 0.9, tolerance=0.01)
+        b.compare()  # first run writes the CSV
+        b2 = Benchmarks("Harness", resource_dir=str(tmp_path))
+        b2.add("m", 0.5, tolerance=0.01)
+        with pytest.raises(AssertionError, match="benchmark regression"):
+            b2.compare()
+
+    def test_missing_metric_detected(self, tmp_path):
+        b = Benchmarks("Harness2", resource_dir=str(tmp_path))
+        b.add("m1", 1.0)
+        b.add("m2", 2.0)
+        b.compare()
+        b2 = Benchmarks("Harness2", resource_dir=str(tmp_path))
+        b2.add("m1", 1.0)
+        with pytest.raises(AssertionError, match="not produced"):
+            b2.compare()

@@ -1,0 +1,628 @@
+"""Stage-coverage fuzzing meta-test.
+
+Reference: src/test/.../core/test/fuzzing/FuzzingTest.scala — reflects over
+every PipelineStage in the jar and FAILS if any stage lacks fuzzing coverage.
+Here: a registry of TestObjects covers each concrete stage; the meta-test
+discovers all stage classes and asserts coverage (experiment fuzzing counts
+the classes it touches, including fitted Model classes); serialization and
+getter/setter fuzzing run over the same registry (Fuzzing.scala traits).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.pipeline import (Estimator, Model, Pipeline,
+                                         PipelineModel, PipelineStage,
+                                         Transformer)
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.io.http import HTTPResponseData
+from synapseml_tpu.testing import (TestObject, discover_stage_classes,
+                                   experiment_fuzz, getter_setter_fuzz,
+                                   serialization_fuzz)
+
+RNG = np.random.default_rng(7)
+
+
+# --------------------------------------------------------------------------
+# shared tiny datasets
+
+def _tab(n=40, f=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    return Table({"features": X, "label": y,
+                  "a": X[:, 0].astype(np.float64),
+                  "b": X[:, 1].astype(np.float64),
+                  "text": np.array(["the quick brown fox"] * n, object),
+                  "group": np.arange(n) % 4})
+
+
+def _imgs(n=4, h=8, w=8):
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        col[i] = RNG.uniform(0, 255, size=(h, w, 3)).astype(np.float32)
+    return Table({"image": col,
+                  "label": (np.arange(n) % 2).astype(np.float64)})
+
+
+_SERVICE_JSON = {
+    "results": {"documents": [{"sentiment": "neutral"}]},
+    "choices": [{"text": "ok", "message": {"role": "assistant",
+                                           "content": "ok"}}],
+    "data": [{"embedding": [0.1, 0.2]}],
+    "value": [{"contentUrl": "http://x/1.jpg"}],
+    "isAnomaly": False,
+    "translations": [{"text": "ok"}],
+    "status": "succeeded",
+}
+
+
+def _stub_handler(req, send):
+    return HTTPResponseData(200, "OK", {},
+                            json.dumps(_SERVICE_JSON).encode())
+
+
+def _service_df():
+    series = np.empty(2, dtype=object)
+    msgs = np.empty(2, dtype=object)
+    mv = np.empty(2, dtype=object)
+    for i in range(2):
+        series[i] = [{"timestamp": f"2026-01-0{j+1}T00:00:00Z",
+                      "value": float(j)} for j in range(12)]
+        msgs[i] = [{"role": "user", "content": "hi"}]
+        mv[i] = [{"variable": "v", "timestamp": "2026-01-01T00:00:00Z",
+                  "value": 1.0}]
+    audio = np.empty(2, dtype=object)
+    imgb = np.empty(2, dtype=object)
+    for i in range(2):
+        audio[i] = b"RIFFfake"
+        imgb[i] = b"\x89PNGfake"
+    return Table({
+        "text": np.array(["hello world", "guten tag"], object),
+        "prompt": np.array(["say hi", "say bye"], object),
+        "messages": msgs, "series": series, "mvseries": mv,
+        "q": np.array(["cats", "dogs"], object),
+        "audio": audio, "imageBytes": imgb,
+        "imageUrl": np.array(["http://x/a.jpg", "http://x/b.jpg"], object),
+        "timestamp": np.array(["2026-01-01T00:00:00Z",
+                               "2026-01-02T00:00:00Z"], object),
+        "value": np.array([1.0, 2.0]),
+        "grp": np.array(["g", "g"], object),
+    })
+
+
+def _onnx_payload():
+    from synapseml_tpu.onnx import Graph, Model as OModel, Node, Tensor, ValueInfo
+
+    W = RNG.normal(size=(4, 3)).astype(np.float32)
+    g = Graph(nodes=[Node(op_type="MatMul", inputs=["x", "W"], outputs=["out"])],
+              initializers={"W": Tensor.from_array("W", W)},
+              inputs=[ValueInfo(name="x", elem_type=1, shape=["N", 4])],
+              outputs=[ValueInfo(name="out", elem_type=1, shape=["N", 3])])
+    return OModel(graph=g).encode()
+
+
+# --------------------------------------------------------------------------
+# the registry (TestObject per concrete stage / estimator family)
+
+def _registry():
+    from synapseml_tpu.automl import (FindBestModel, HyperparamBuilder,
+                                      TuneHyperparameters)
+    from synapseml_tpu.causal import (DiffInDiffEstimator, DoubleMLEstimator,
+                                      OrthoForestDMLEstimator,
+                                      ResidualTransformer,
+                                      SyntheticControlEstimator,
+                                      SyntheticDiffInDiffEstimator)
+    from synapseml_tpu.cyber import (AccessAnomaly, ComplementAccessTransformer,
+                                     IdIndexer, LinearScalarScaler,
+                                     MultiIndexer, StandardScalarScaler)
+    from synapseml_tpu.dl import DeepTextClassifier, DeepVisionClassifier
+    from synapseml_tpu.explainers import (ICETransformer, ImageLIME, ImageSHAP,
+                                          TabularLIME, TabularSHAP, TextLIME,
+                                          TextSHAP, VectorLIME, VectorSHAP)
+    from synapseml_tpu.featurize import (CleanMissingData, CountSelector,
+                                         DataConversion, Featurize,
+                                         IndexToValue, MultiNGram,
+                                         PageSplitter, TextFeaturizer,
+                                         ValueIndexer)
+    from synapseml_tpu.image import (ImageSetAugmenter, SuperpixelTransformer,
+                                     UnrollImage)
+    from synapseml_tpu.io.http import (CustomInputParser, CustomOutputParser,
+                                       HTTPRequestData, HTTPTransformer,
+                                       JSONInputParser, JSONOutputParser,
+                                       SimpleHTTPTransformer,
+                                       StringOutputParser)
+    from synapseml_tpu.isolationforest import IsolationForest
+    from synapseml_tpu.models import (LightGBMClassifier, LightGBMRanker,
+                                      LightGBMRegressor)
+    from synapseml_tpu.nn import KNN, ConditionalKNN
+    from synapseml_tpu.onnx import ImageFeaturizer, ONNXModel
+    from synapseml_tpu.recommendation import (RankingAdapter, RankingEvaluator,
+                                              RankingTrainValidationSplit,
+                                              RecommendationIndexer, SAR)
+    from synapseml_tpu import services as S
+    from synapseml_tpu.stages import (Cacher, ClassBalancer, DropColumns,
+                                      DynamicMiniBatchTransformer,
+                                      EnsembleByKey, Explode,
+                                      FixedMiniBatchTransformer, FlattenBatch,
+                                      Lambda, MultiColumnAdapter,
+                                      PartitionConsolidator, RenameColumn,
+                                      Repartition, SelectColumns,
+                                      StratifiedRepartition, SummarizeData,
+                                      TextPreprocessor, Timer,
+                                      TimeIntervalMiniBatchTransformer,
+                                      UDFTransformer, UnicodeNormalize)
+    from synapseml_tpu.train import (ComputeModelStatistics,
+                                     ComputePerInstanceStatistics,
+                                     TrainClassifier, TrainRegressor)
+    from synapseml_tpu.vw import (VowpalWabbitClassifier,
+                                  VowpalWabbitContextualBandit,
+                                  VowpalWabbitCSETransformer,
+                                  VowpalWabbitDSJsonTransformer,
+                                  VowpalWabbitFeaturizer, VowpalWabbitGeneric,
+                                  VowpalWabbitGenericProgressive,
+                                  VowpalWabbitInteractions,
+                                  VowpalWabbitRegressor)
+
+    tab = _tab()
+    imgs = _imgs()
+    svc = _service_df()
+
+    objs = []
+    add = objs.append
+
+    # --- models / gbdt -------------------------------------------------
+    add(TestObject(LightGBMClassifier(numIterations=5), tab))
+    add(TestObject(LightGBMRegressor(numIterations=5), tab))
+    rank_df = tab.with_column("label", (RNG.integers(0, 3, 40)).astype(np.float64))
+    add(TestObject(LightGBMRanker(numIterations=4, groupCol="group"), rank_df))
+
+    # --- vw ------------------------------------------------------------
+    vw_df = Table({"features": tab["features"],
+                   "label": tab["label"]})
+    add(TestObject(VowpalWabbitClassifier(numPasses=3), vw_df))
+    add(TestObject(VowpalWabbitRegressor(numPasses=3), vw_df))
+    add(TestObject(VowpalWabbitFeaturizer(inputCols=["a", "b"]), None, tab))
+    fz = VowpalWabbitFeaturizer(inputCols=["a"], outputCol="fa").transform(tab)
+    fz = VowpalWabbitFeaturizer(inputCols=["b"], outputCol="fb").transform(fz)
+    add(TestObject(VowpalWabbitInteractions(inputCols=["fa", "fb"]), None, fz))
+    lines = np.array(["1 | x:1 y:2", "-1 | x:0.5 y:1"] * 10, object)
+    add(TestObject(VowpalWabbitGeneric(
+        passThroughArgs="--loss_function logistic --passes 2"), Table({"value": lines})))
+    add(TestObject(VowpalWabbitGenericProgressive(
+        passThroughArgs="--loss_function logistic"), None, Table({"value": lines})))
+    from synapseml_tpu.vw.learner import make_sparse_batch
+    cb_rows = []
+    for i in range(30):
+        acts = [make_sparse_batch([[a + 1, 10 + a]], [[1.0, 0.5]])[0]
+                for a in range(3)]
+        cb_rows.append({"features": acts, "chosenAction": (i % 3) + 1,
+                        "label": float(i % 2), "probability": 1.0 / 3})
+    add(TestObject(VowpalWabbitContextualBandit(numPasses=2),
+                   Table.from_rows(cb_rows), skip_serialization=True))
+    ds_lines = np.array([json.dumps(
+        {"EventId": f"e{i}", "_label_cost": -1.0, "_label_probability": 0.5,
+         "_labelIndex": 0, "a": [1, 2], "p": [0.5, 0.5]}) for i in range(6)],
+        object)
+    add(TestObject(VowpalWabbitDSJsonTransformer(), None,
+                   Table({"value": ds_lines})))
+    parsed = VowpalWabbitDSJsonTransformer().transform(Table({"value": ds_lines}))
+    parsed["reward"] = -parsed["cost"]
+    parsed["probabilityPredicted"] = np.full(6, 0.5)
+    add(TestObject(VowpalWabbitCSETransformer(), None, parsed))
+
+    # --- dl ------------------------------------------------------------
+    add(TestObject(DeepVisionClassifier(backbone="tiny", batchSize=8,
+                                        maxEpochs=1), _imgs(8)))
+    add(TestObject(DeepTextClassifier(maxEpochs=1, batchSize=4, hiddenSize=16),
+                   Table({"text": np.array(["good", "bad"] * 8, object),
+                          "label": np.array([1.0, 0.0] * 8)})))
+
+    # --- onnx ----------------------------------------------------------
+    payload = _onnx_payload()
+    om = ONNXModel(miniBatchSize=8)
+    om.setModelPayload(payload)
+    om.setFeedDict({"x": "features"})
+    om.setFetchDict({"out": "out"})
+    add(TestObject(om, None, tab))
+    imf = ImageFeaturizer(inputCol="image", outputCol="feat", imageHeight=3,
+                          imageWidth=3, headless=False)
+    from synapseml_tpu.onnx import Graph, Model as OModel, Node, Tensor, ValueInfo
+    Wi = RNG.normal(scale=0.1, size=(27, 2)).astype(np.float32)
+    gi = Graph(nodes=[Node(op_type="Flatten", inputs=["img"], outputs=["f"],
+                           attrs={}),
+                      Node(op_type="MatMul", inputs=["f", "Wi"],
+                           outputs=["logits"])],
+               initializers={"Wi": Tensor.from_array("Wi", Wi)},
+               inputs=[ValueInfo(name="img", elem_type=1, shape=["N", 3, 3, 3])],
+               outputs=[ValueInfo(name="logits", elem_type=1, shape=["N", 2])])
+    imf.setModelPayload(OModel(graph=gi).encode())
+    add(TestObject(imf, None, imgs))
+
+    # --- nn ------------------------------------------------------------
+    knn_df = Table({"features": tab["features"], "values": np.arange(40)})
+    add(TestObject(KNN(k=2), knn_df))
+    ck_df = knn_df.with_column("labels", np.array(["u", "v"] * 20, object))
+    conds = np.empty(40, dtype=object)
+    for i in range(40):
+        conds[i] = ["u"]
+    add(TestObject(ConditionalKNN(k=2), ck_df,
+                   ck_df.with_column("conditioner", conds)))
+
+    # --- recommendation ------------------------------------------------
+    ratings = Table({"user": (np.arange(40) % 5).astype(np.int64),
+                     "item": (np.arange(40) % 8).astype(np.int64),
+                     "rating": np.ones(40, np.float32)})
+    add(TestObject(SAR(supportThreshold=1), ratings))
+    raw_r = Table({"u": np.array([f"u{i%3}" for i in range(12)], object),
+                   "i": np.array([f"i{i%4}" for i in range(12)], object),
+                   "rating": np.ones(12, np.float32)})
+    add(TestObject(RecommendationIndexer(
+        userInputCol="u", itemInputCol="i", userOutputCol="user",
+        itemOutputCol="item"), raw_r))
+    add(TestObject(RankingAdapter(recommender=SAR(supportThreshold=1), k=2),
+                   ratings, skip_serialization=True))
+    add(TestObject(RankingTrainValidationSplit(
+        estimator=SAR(supportThreshold=1),
+        evaluator=RankingEvaluator(k=2, metricName="recallAtK"),
+        estimatorParamMaps=[{}], trainRatio=0.7), ratings,
+        skip_serialization=True))
+
+    # --- isolation forest / cyber --------------------------------------
+    add(TestObject(IsolationForest(numEstimators=8, maxSamples=16.0), tab))
+    access = Table({"tenant": np.array(["t"] * 20, object),
+                    "user": np.array([f"u{i%4}" for i in range(20)], object),
+                    "res": np.array([f"r{i%3}" for i in range(20)], object),
+                    "likelihood": np.ones(20)})
+    add(TestObject(AccessAnomaly(maxIter=3, rankParam=3), access))
+    add(TestObject(ComplementAccessTransformer(
+        indexedColNamesArr=["user", "res"]), None, access))
+    add(TestObject(IdIndexer(inputCol="user", partitionKey="tenant",
+                             outputCol="uix"), access))
+    add(TestObject(MultiIndexer(indexers=[
+        IdIndexer(inputCol="user", partitionKey="tenant", outputCol="uix")]),
+        access, skip_serialization=True))
+    add(TestObject(StandardScalarScaler(inputCol="likelihood",
+                                        partitionKey="tenant",
+                                        outputCol="z"), access))
+    add(TestObject(LinearScalarScaler(inputCol="likelihood",
+                                      partitionKey="tenant",
+                                      outputCol="s"), access))
+
+    # --- causal ---------------------------------------------------------
+    dml_df = Table({"features": tab["features"],
+                    "treatment": (tab["a"] > 0).astype(np.float64),
+                    "outcome": tab["b"],
+                    "heterogeneityFeatures": tab["features"][:, :1]})
+    add(TestObject(DoubleMLEstimator(
+        treatmentModel=LightGBMRegressor(numIterations=3),
+        outcomeModel=LightGBMRegressor(numIterations=3), maxIter=1), dml_df,
+        skip_serialization=True))
+    add(TestObject(OrthoForestDMLEstimator(
+        treatmentModel=LightGBMRegressor(numIterations=3),
+        outcomeModel=LightGBMRegressor(numIterations=3), numTrees=3), dml_df,
+        skip_serialization=True))
+    panel_rows = []
+    for u in range(8):
+        for t in range(6):
+            panel_rows.append({"unit": u, "time": t,
+                               "outcome": float(u + t + (u < 2 and t >= 3)),
+                               "treatment": float(u < 2),
+                               "postTreatment": float(t >= 3)})
+    panel = Table.from_rows(panel_rows)
+    add(TestObject(DiffInDiffEstimator(), panel))
+    add(TestObject(SyntheticControlEstimator(maxIter=50), panel))
+    add(TestObject(SyntheticDiffInDiffEstimator(maxIter=50), panel))
+    add(TestObject(ResidualTransformer(observedCol="label",
+                                       predictedCol="a"), None, tab))
+
+    # --- explainers / image ---------------------------------------------
+    inner = LightGBMClassifier(numIterations=3).fit(tab)
+    add(TestObject(VectorLIME(model=inner, targetCol="probability",
+                              targetClasses=[1], numSamples=20), None, tab,
+                   skip_serialization=True))
+    add(TestObject(VectorSHAP(model=inner, targetCol="probability",
+                              targetClasses=[1], numSamples=20), None, tab,
+                   skip_serialization=True))
+    class _ColModel(Transformer):
+        def _transform(self, df):
+            score = (df["a"] > 0).astype(np.float64)
+            return df.with_column("probability",
+                                  np.stack([1 - score, score], axis=1))
+
+    add(TestObject(TabularLIME(model=_ColModel(), inputCols=["a", "b"],
+                               targetCol="probability", targetClasses=[1],
+                               numSamples=20, backgroundData=tab), None, tab,
+                   skip_serialization=True))
+    add(TestObject(TabularSHAP(model=_ColModel(), inputCols=["a", "b"],
+                               targetCol="probability", targetClasses=[1],
+                               numSamples=20, backgroundData=tab), None, tab,
+                   skip_serialization=True))
+
+    class _TextModel(Transformer):
+        def _transform(self, df):
+            score = np.array([float("good" in t) for t in df["text"]])
+            return df.with_column("probability",
+                                  np.stack([1 - score, score], axis=1))
+
+    text_df = Table({"text": np.array(["good day", "bad day"] * 4, object)})
+    add(TestObject(TextLIME(model=_TextModel(), targetClasses=[1],
+                            numSamples=20), None, text_df,
+                   skip_serialization=True))
+    add(TestObject(TextSHAP(model=_TextModel(), targetClasses=[1],
+                            numSamples=20), None, text_df,
+                   skip_serialization=True))
+
+    class _ImgModel(Transformer):
+        def _transform(self, df):
+            col = df["image"]
+            score = np.array([float(np.asarray(v).mean() > 100) for v in col])
+            return df.with_column("probability",
+                                  np.stack([1 - score, score], axis=1))
+
+    add(TestObject(ImageLIME(model=_ImgModel(), targetClasses=[1], cellSize=4.0,
+                             numSamples=10), None, imgs,
+                   skip_serialization=True))
+    add(TestObject(ImageSHAP(model=_ImgModel(), targetClasses=[1], cellSize=4.0,
+                             numSamples=10), None, imgs,
+                   skip_serialization=True))
+    add(TestObject(ICETransformer(model=inner, targetCol="prediction",
+                                  categoricalFeatures=["a"]), None, tab,
+                   skip_serialization=True))
+    add(TestObject(SuperpixelTransformer(inputCol="image", cellSize=4.0),
+                   None, imgs))
+    add(TestObject(UnrollImage(inputCol="image"), None, imgs))
+    add(TestObject(ImageSetAugmenter(inputCol="image"), None, imgs))
+
+    # --- featurize -------------------------------------------------------
+    miss = Table({"x": np.array([1.0, np.nan, 3.0, 4.0]),
+                  "y": np.array([1.0, 2.0, np.nan, 4.0])})
+    add(TestObject(CleanMissingData(inputCols=["x", "y"],
+                                    outputCols=["x2", "y2"]), miss))
+    add(TestObject(DataConversion(cols=["a"], convertTo="float"), None, tab))
+    add(TestObject(Featurize(inputCols=["a", "b", "text"],
+                             outputCol="feat2", numFeatures=64), tab))
+    add(TestObject(ValueIndexer(inputCol="text", outputCol="tix"), tab,
+                   also_covers=[IndexToValue]))
+    idx_model = ValueIndexer(inputCol="text", outputCol="tix").fit(tab)
+    add(TestObject(IndexToValue(inputCol="tix", outputCol="t2",
+                                levels=list(idx_model.get("levels"))), None,
+                   idx_model.transform(tab)))
+    add(TestObject(CountSelector(inputCol="features", outputCol="sel"), tab))
+    add(TestObject(TextFeaturizer(inputCol="text", outputCol="tf",
+                                  numFeatures=32), tab))
+    add(TestObject(MultiNGram(inputCol="text", outputCol="ngrams",
+                              lengths=[1, 2]), None, tab))
+    add(TestObject(PageSplitter(inputCol="text", outputCol="pages",
+                                maximumPageLength=10), None, tab))
+
+    # --- stages ----------------------------------------------------------
+    add(TestObject(UDFTransformer(inputCol="a", outputCol="a2")
+                   .setUDF(lambda col: col * 2), None, tab))
+    add(TestObject(Lambda().setTransform(lambda t: t), None, tab))
+    add(TestObject(Cacher(), None, tab))
+    add(TestObject(Timer(stage=DropColumns(cols=["text"])), tab,
+                   skip_serialization=True))
+    add(TestObject(DropColumns(cols=["text"]), None, tab))
+    add(TestObject(SelectColumns(cols=["a", "b"]), None, tab))
+    add(TestObject(RenameColumn(inputCol="a", outputCol="a_renamed"),
+                   None, tab))
+    add(TestObject(Repartition(n=2), None, tab))
+    explode_df = Table({"k": np.arange(3),
+                        "vals": np.array([[1, 2], [3], [4, 5, 6]], object)})
+    add(TestObject(Explode(inputCol="vals", outputCol="v"), None, explode_df))
+    add(TestObject(FixedMiniBatchTransformer(batchSize=8), None, tab))
+    add(TestObject(DynamicMiniBatchTransformer(), None, tab))
+    add(TestObject(TimeIntervalMiniBatchTransformer(maxBatchSize=8),
+                   None, tab))
+    batched = FixedMiniBatchTransformer(batchSize=8).transform(tab)
+    add(TestObject(FlattenBatch(), None, batched))
+    add(TestObject(ClassBalancer(inputCol="label"), tab))
+    add(TestObject(StratifiedRepartition(labelCol="label", mode="equal"),
+                   None, tab))
+    add(TestObject(EnsembleByKey(keys=["group"], cols=["a"]), None, tab))
+    add(TestObject(PartitionConsolidator(numPartitions=2, concurrency=2),
+                   None, tab))
+    add(TestObject(SummarizeData(), None, tab))
+    add(TestObject(TextPreprocessor(inputCol="text", outputCol="tp",
+                                    normFunc="lowercase"), None, tab))
+    add(TestObject(UnicodeNormalize(inputCol="text", outputCol="un",
+                                    form="NFKD"), None, tab))
+    add(TestObject(MultiColumnAdapter(baseStage=RenameColumn(),
+                                      inputCols=["a", "b"],
+                                      outputCols=["a3", "b3"]), tab,
+                   skip_serialization=True))
+
+    # --- train / automl --------------------------------------------------
+    add(TestObject(TrainClassifier(model=LightGBMClassifier(numIterations=3),
+                                   labelCol="label"), tab,
+                   skip_serialization=True))
+    add(TestObject(TrainRegressor(model=LightGBMRegressor(numIterations=3),
+                                  labelCol="b"), tab,
+                   skip_serialization=True))
+    pred_df = Table({"label": tab["label"],
+                     "prediction": tab["label"],
+                     "probability": np.stack([1 - tab["label"],
+                                              tab["label"]], axis=1)})
+    add(TestObject(ComputeModelStatistics(evaluationMetric="classification"),
+                   None, pred_df))
+    add(TestObject(ComputePerInstanceStatistics(), None, pred_df))
+    from synapseml_tpu.automl import DiscreteHyperParam
+    space = (HyperparamBuilder()
+             .addHyperparam("numIterations", DiscreteHyperParam([2, 3]))
+             .build())
+    add(TestObject(TuneHyperparameters(model=LightGBMClassifier(),
+                                       paramSpace=space, searchMode="grid",
+                                       numFolds=2, evaluationMetric="AUC"),
+                   tab, skip_serialization=True))
+    m1 = LightGBMClassifier(numIterations=2).fit(tab)
+    m2 = LightGBMClassifier(numIterations=3).fit(tab)
+    add(TestObject(FindBestModel(models=[m1, m2], evaluationMetric="AUC",
+                                 labelCol="label"), tab,
+                   skip_serialization=True))
+
+    # --- pipeline --------------------------------------------------------
+    add(TestObject(Pipeline(stages=[DropColumns(cols=["text"]),
+                                    LightGBMClassifier(numIterations=3)]),
+                   tab, also_covers=[PipelineModel]))
+
+    # --- io --------------------------------------------------------------
+    add(TestObject(HTTPTransformer(inputCol="req", outputCol="resp")
+                   .setHandler(_stub_handler), None,
+                   _req_df(), skip_serialization=True))
+    add(TestObject(SimpleHTTPTransformer(inputCol="value", outputCol="out",
+                                         url="http://stub.local/",
+                                         handler=_stub_handler), None,
+                   Table({"value": np.array([1, 2])}), skip_serialization=True))
+    add(TestObject(JSONInputParser(inputCol="value", outputCol="req",
+                                   url="http://stub.local/"), None,
+                   Table({"value": np.array([1, 2])})))
+    ci = CustomInputParser(inputCol="value", outputCol="req")
+    ci.setUDF(lambda v: HTTPRequestData(url="http://stub.local/"))
+    add(TestObject(ci, None, Table({"value": np.array([1])}),
+                   skip_serialization=True))
+    resp_df = Table({"resp": _resp_col()})
+    add(TestObject(JSONOutputParser(inputCol="resp", outputCol="out"),
+                   None, resp_df))
+    add(TestObject(StringOutputParser(inputCol="resp", outputCol="out"),
+                   None, resp_df))
+    co = CustomOutputParser(inputCol="resp", outputCol="out")
+    co.setUDF(lambda r: r.status_code)
+    add(TestObject(co, None, resp_df, skip_serialization=True))
+
+    # --- services (stub handler; request construction + parsing) --------
+    svc_objs = [
+        S.TextSentiment(url="http://stub.local/l"),
+        S.KeyPhraseExtractor(url="http://stub.local/l"),
+        S.NER(url="http://stub.local/l"),
+        S.PII(url="http://stub.local/l"),
+        S.EntityLinking(url="http://stub.local/l"),
+        S.LanguageDetector(url="http://stub.local/l"),
+        S.AnalyzeHealthText(url="http://stub.local/l"),
+        S.OpenAICompletion(url="http://stub.local", deploymentName="d"),
+        S.OpenAIChatCompletion(url="http://stub.local", deploymentName="d"),
+        S.OpenAIEmbedding(url="http://stub.local", deploymentName="d",
+                          textCol="text"),
+        S.OpenAIPrompt(url="http://stub.local", deploymentName="d",
+                       promptTemplate="echo {text}"),
+        S.Translate(url="http://stub.local", toLanguage=["de"]),
+        S.Detect(url="http://stub.local"),
+        S.BreakSentence(url="http://stub.local"),
+        S.Transliterate(url="http://stub.local", language="ja",
+                        fromScript="Jpan", toScript="Latn"),
+        S.DictionaryLookup(url="http://stub.local", fromLanguage="en",
+                           toLanguage="de"),
+        S.AnalyzeImage(url="http://stub.local/vision",
+                       imageUrlCol="imageUrl"),
+        S.DescribeImage(url="http://stub.local/vision",
+                        imageUrlCol="imageUrl"),
+        S.TagImage(url="http://stub.local/vision", imageUrlCol="imageUrl"),
+        S.OCR(url="http://stub.local/vision", imageUrlCol="imageUrl"),
+        S.GenerateThumbnails(url="http://stub.local/vision",
+                             imageUrlCol="imageUrl"),
+        S.DetectFace(url="http://stub.local/face", imageUrlCol="imageUrl"),
+        S.DetectLastAnomaly(url="http://stub.local/anomaly"),
+        S.DetectAnomalies(url="http://stub.local/anomaly"),
+        S.SimpleDetectAnomalies(url="http://stub.local/anomaly",
+                                groupbyCol="grp"),
+        S.DetectMultivariateAnomaly(url="http://stub.local/mv",
+                                    modelId="m1", seriesCol="mvseries"),
+        S.SpeechToText(url="http://stub.local/stt", audioDataCol="audio"),
+        S.SpeechToTextSDK(url="http://stub.local/stt", audioDataCol="audio"),
+        S.TextToSpeech(url="http://stub.local/tts"),
+        S.AnalyzeDocument(url="http://stub.local", imageBytesCol="imageBytes",
+                          maxPollRetries=1, pollInterval=0.01),
+        S.BingImageSearch(url="http://stub.local/bing"),
+    ]
+    for t in svc_objs:
+        t.set("handler", _stub_handler)
+        add(TestObject(t, None, svc, skip_serialization=True))
+    return objs
+
+
+def _req_df():
+    from synapseml_tpu.io.http import HTTPRequestData
+
+    col = np.empty(2, dtype=object)
+    for i in range(2):
+        col[i] = HTTPRequestData.from_json_body("http://stub.local/", {"v": i})
+    return Table({"req": col})
+
+
+def _resp_col():
+    col = np.empty(2, dtype=object)
+    for i in range(2):
+        col[i] = HTTPResponseData(200, "OK", {}, b'{"ok": true}')
+    return Table({"resp": col})["resp"]
+
+
+# classes legitimately without their own TestObject
+EXEMPT = {
+    "synapseml_tpu.core.pipeline.Estimator",      # abstract bases
+    "synapseml_tpu.core.pipeline.Transformer",
+    "synapseml_tpu.core.pipeline.Model",
+    "synapseml_tpu.explainers.base.LocalExplainerBase",
+    "synapseml_tpu.services.base.CognitiveServiceBase",
+    "synapseml_tpu.services.base.HasServiceParams",
+    "synapseml_tpu.services.base.HasSetLocation",
+}
+
+
+_OBJS = None
+
+
+def _objs():
+    global _OBJS
+    if _OBJS is None:
+        _OBJS = _registry()
+    return _OBJS
+
+
+class TestFuzzing:
+    def test_experiment_fuzzing_and_coverage(self):
+        """FuzzingTest.scala analog: every concrete stage class must be
+        exercised by some TestObject."""
+        touched = set()
+        failures = []
+        for obj in _objs():
+            try:
+                touched |= experiment_fuzz(obj)
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"{type(obj.stage).__name__}: {e}")
+        assert not failures, "experiment fuzzing failures:\n  " + \
+            "\n  ".join(failures)
+
+        discovered = discover_stage_classes()
+        missing = []
+        for cls in discovered:
+            fq = f"{cls.__module__}.{cls.__name__}"
+            if cls not in touched and fq not in EXEMPT:
+                missing.append(fq)
+        assert not missing, (
+            "stages without fuzzing coverage (add a TestObject to "
+            "tests/test_fuzzing.py _registry or an EXEMPT entry):\n  "
+            + "\n  ".join(sorted(missing)))
+
+    def test_serialization_fuzzing(self, tmp_path):
+        failures = []
+        for obj in _objs():
+            if obj.skip_serialization:
+                continue
+            try:
+                serialization_fuzz(obj, str(tmp_path))
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"{type(obj.stage).__name__}: {e}")
+        assert not failures, "serialization fuzzing failures:\n  " + \
+            "\n  ".join(failures)
+
+    def test_getter_setter_fuzzing(self):
+        failures = []
+        for obj in _objs():
+            try:
+                getter_setter_fuzz(obj)
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"{type(obj.stage).__name__}: {e}")
+        assert not failures, "getter/setter fuzzing failures:\n  " + \
+            "\n  ".join(failures)

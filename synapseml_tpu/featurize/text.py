@@ -37,6 +37,17 @@ def _ngrams(tokens: List[str], n: int) -> List[str]:
 
 def _hash_tf(terms: List[str], num_features: int, binary: bool) -> np.ndarray:
     v = np.zeros(num_features, np.float32)
+    if len(terms) >= 64:
+        from ..native import murmur3_32_batch
+
+        idx = murmur3_32_batch(terms, 0, vw_numeric_names=False, mask=0)
+        if idx is not None:
+            idx = idx % num_features
+            if binary:
+                v[np.unique(idx)] = 1.0
+            else:
+                np.add.at(v, idx, 1.0)
+            return v
     for t in terms:
         j = murmur3_32(t.encode("utf-8")) % num_features
         v[j] = 1.0 if binary else v[j] + 1.0
@@ -106,14 +117,33 @@ class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
 
     _terms = TextFeaturizer._terms
 
+    def _can_use_native_tf(self, docs) -> bool:
+        """The C tokenizer (split on non-alnum bytes, ascii lowercase) matches
+        the default Python pipeline only for plain-ASCII documents with the
+        stock settings — guard exactly to keep feature vectors identical."""
+        return (self.useTokenizer and self.tokenizerPattern == r"\W+"
+                and self.toLowercase and not self.useStopWordsRemover
+                and not self.useNGram
+                and self.numFeatures & (self.numFeatures - 1) == 0
+                and all(isinstance(t, str) and t.isascii() and "_" not in t
+                        for t in docs))
+
     def _transform(self, df: Table) -> Table:
         n = df.num_rows
-        X = np.zeros((n, self.numFeatures), np.float32)
-        for i in range(n):
-            X[i] = _hash_tf(self._terms(df[self.inputCol][i]), self.numFeatures,
-                            self.binary)
+        docs = [str(t) for t in df[self.inputCol]]
+        X = None
+        if n >= 64 and self._can_use_native_tf(docs):
+            from ..native import hash_tf as native_tf
+
+            X = native_tf(docs, self.numFeatures,
+                          min_len=self.minTokenLength, binary=self.binary)
+        if X is None:
+            X = np.zeros((n, self.numFeatures), np.float32)
+            for i in range(n):
+                X[i] = _hash_tf(self._terms(docs[i]), self.numFeatures,
+                                self.binary)
         if self.useIDF and self.idf_ is not None:
-            X *= self.idf_[None, :]
+            X = X * self.idf_[None, :]
         return df.with_column(self.outputCol, X)
 
     def _save_extra(self, path: str) -> None:

@@ -146,6 +146,10 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
                                  else max(deadline - _time.monotonic(), 0.0))
                     out.append(f.result(timeout=remaining))
             except FuturesTimeout:
+                # only a real deadline expiry is a batch timeout; a worker's
+                # own TimeoutError (same type on py>=3.11) must propagate
+                if deadline is None:
+                    raise
                 pool.shutdown(wait=False, cancel_futures=True)
                 raise TimeoutError(
                     f"HTTPTransformer: batch exceeded concurrentTimeout="

@@ -1,0 +1,143 @@
+"""Native host-helper library: build, load, and ctypes bindings.
+
+The NativeLoader analog (reference: core/.../core/env/NativeLoader.java
+extracts .so files from the jar and System.load()s them per executor;
+lightgbm/.../LightGBMUtils.scala:31-34). Here: the .so is compiled from
+src/synapseml_native.cpp on first use when a compiler is present (wheel builds
+ship it prebuilt), loaded via ctypes, and every binding has a pure-Python
+fallback — ``available()`` says which path is active.
+
+Bindings:
+  murmur3_32_batch(names, seed(s), vw_numeric_names, mask) -> uint32[n]
+  hash_tf(docs, num_features, seed, min_len, binary) -> float32[n, dim]
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libsynapseml_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_DIR, "src", "synapseml_native.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-o", _SO, src],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.sml_murmur3_32.restype = ctypes.c_uint32
+        lib.sml_murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                       ctypes.c_uint32]
+        lib.sml_hash_batch.restype = None
+        lib.sml_hash_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_void_p]
+        lib.sml_hash_batch_seeded.restype = None
+        lib.sml_hash_batch_seeded.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_void_p]
+        lib.sml_hash_tf.restype = None
+        lib.sml_hash_tf.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_int64, ctypes.c_int, ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _pack(strings: Sequence[str]):
+    """Concatenate utf-8 names + int64 offsets (n+1)."""
+    encoded = [s.encode("utf-8") for s in strings]
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in encoded], out=offsets[1:])
+    buf = b"".join(encoded)
+    return np.frombuffer(buf, dtype=np.uint8), offsets
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        from ..vw.hashing import murmur3_32 as py_hash
+
+        return py_hash(data, seed)
+    return int(lib.sml_murmur3_32(data, len(data), seed & 0xFFFFFFFF))
+
+
+def murmur3_32_batch(names: Sequence[str],
+                     seed: Union[int, np.ndarray] = 0,
+                     vw_numeric_names: bool = True,
+                     mask: int = 0) -> Optional[np.ndarray]:
+    """Hash a batch of names; ``seed`` may be a scalar or per-name uint32
+    array. Returns None when the native library is unavailable (callers keep
+    their Python path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf, offsets = _pack(names)
+    n = len(names)
+    out = np.empty(n, dtype=np.uint32)
+    buf_p = buf.ctypes.data_as(ctypes.c_void_p) if buf.size else None
+    if isinstance(seed, (int, np.integer)):
+        lib.sml_hash_batch(buf_p, offsets.ctypes.data_as(ctypes.c_void_p),
+                           n, int(seed) & 0xFFFFFFFF,
+                           int(vw_numeric_names), mask & 0xFFFFFFFF,
+                           out.ctypes.data_as(ctypes.c_void_p))
+    else:
+        seeds = np.ascontiguousarray(seed, dtype=np.uint32)
+        lib.sml_hash_batch_seeded(
+            buf_p, offsets.ctypes.data_as(ctypes.c_void_p), n,
+            seeds.ctypes.data_as(ctypes.c_void_p), int(vw_numeric_names),
+            mask & 0xFFFFFFFF, out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def hash_tf(docs: Sequence[str], num_features: int, seed: int = 0,
+            min_len: int = 1, binary: bool = False) -> Optional[np.ndarray]:
+    """Tokenize (non-alnum split, ascii lowercase) + hashing-TF each document
+    into a [n, num_features] dense matrix; num_features must be a power of 2.
+    Returns None when unavailable."""
+    lib = _load()
+    if lib is None or num_features & (num_features - 1):
+        return None
+    buf, offsets = _pack(docs)
+    out = np.zeros((len(docs), num_features), dtype=np.float32)
+    buf_p = buf.ctypes.data_as(ctypes.c_void_p) if buf.size else None
+    lib.sml_hash_tf(buf_p, offsets.ctypes.data_as(ctypes.c_void_p),
+                    len(docs), seed & 0xFFFFFFFF, (num_features - 1),
+                    min_len, int(binary),
+                    out.ctypes.data_as(ctypes.c_void_p))
+    return out

@@ -1,0 +1,175 @@
+// synapseml_tpu native host helpers.
+//
+// The reference ships its hot host-side primitives as C++ (LightGBM/VW/OpenCV
+// via JNI; .so bootstrap in core/.../core/env/NativeLoader.java). The TPU
+// rebuild keeps device compute in XLA, but the host-side feature-hashing path
+// (VW-compatible murmur3 over millions of strings — vw/.../
+// VowpalWabbitMurmurWithPrefix.scala is the reference's JVM copy of it) is
+// pure string churn, so it lives here. Exposed as a plain C ABI for ctypes.
+//
+// Build: `make` in synapseml_tpu/native (g++ -O3 -shared -fPIC); loaded by
+// synapseml_tpu/native/__init__.py with a transparent Python fallback.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+namespace {
+
+constexpr uint32_t C1 = 0xCC9E2D51u;
+constexpr uint32_t C2 = 0x1B873593u;
+
+inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return h;
+}
+
+uint32_t murmur3_32(const uint8_t* data, size_t len, uint32_t seed) {
+  uint32_t h = seed;
+  const size_t nblocks = len / 4;
+  for (size_t i = 0; i < nblocks; ++i) {
+    uint32_t k;
+    std::memcpy(&k, data + i * 4, 4);  // little-endian hosts only (x86/ARM)
+    k *= C1;
+    k = rotl32(k, 15);
+    k *= C2;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5 + 0xE6546B64u;
+  }
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k = 0;
+  switch (len & 3) {
+    case 3: k ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k ^= static_cast<uint32_t>(tail[1]) << 8;  [[fallthrough]];
+    case 1:
+      k ^= tail[0];
+      k *= C1;
+      k = rotl32(k, 15);
+      k *= C2;
+      h ^= k;
+  }
+  h ^= static_cast<uint32_t>(len);
+  return fmix32(h);
+}
+
+// VW semantics: names that parse as (optionally negative) integers index
+// directly as int(name) + seed instead of being hashed.
+bool parse_int_name(const uint8_t* s, size_t len, int64_t* out) {
+  if (len == 0) return false;
+  size_t i = 0;
+  bool neg = false;
+  if (s[0] == '-') {
+    if (len == 1) return false;
+    neg = true;
+    i = 1;
+  }
+  int64_t v = 0;
+  for (; i < len; ++i) {
+    if (s[i] < '0' || s[i] > '9') return false;
+    v = v * 10 + (s[i] - '0');
+    // cap mirrored in python _int_name — keep the two in lockstep
+    if (v > (int64_t{1} << 40)) return false;
+  }
+  *out = neg ? -v : v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Single-string hash (murmur3 x86_32).
+uint32_t sml_murmur3_32(const uint8_t* data, uint64_t len, uint32_t seed) {
+  return murmur3_32(data, static_cast<size_t>(len), seed);
+}
+
+// Batch feature hashing over a packed string buffer.
+//   buf:     concatenated utf-8 bytes of all names
+//   offsets: n+1 int64 offsets into buf (name i = buf[offsets[i]:offsets[i+1]])
+//   vw_numeric_names: when nonzero, integer-looking names index directly
+//                     (int(name) + seed) — VW's default string-hash behavior
+//   mask:    applied as index & mask when nonzero
+// Writes n uint32 hashes to out.
+void sml_hash_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                    uint32_t seed, int vw_numeric_names, uint32_t mask,
+                    uint32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* s = buf + offsets[i];
+    const size_t len = static_cast<size_t>(offsets[i + 1] - offsets[i]);
+    uint32_t h;
+    int64_t as_int;
+    if (vw_numeric_names && parse_int_name(s, len, &as_int)) {
+      h = static_cast<uint32_t>((as_int + static_cast<int64_t>(seed)));
+    } else {
+      h = murmur3_32(s, len, seed);
+    }
+    out[i] = mask ? (h & mask) : h;
+  }
+}
+
+// Batch hashing with a per-string seed array (namespace seeds).
+void sml_hash_batch_seeded(const uint8_t* buf, const int64_t* offsets,
+                           int64_t n, const uint32_t* seeds,
+                           int vw_numeric_names, uint32_t mask,
+                           uint32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* s = buf + offsets[i];
+    const size_t len = static_cast<size_t>(offsets[i + 1] - offsets[i]);
+    uint32_t h;
+    int64_t as_int;
+    if (vw_numeric_names && parse_int_name(s, len, &as_int)) {
+      h = static_cast<uint32_t>((as_int + static_cast<int64_t>(seeds[i])));
+    } else {
+      h = murmur3_32(s, len, seeds[i]);
+    }
+    out[i] = mask ? (h & mask) : h;
+  }
+}
+
+// Tokenize-and-hash: split each document on non-alphanumeric bytes,
+// lowercase ASCII, hash each token of length >= min_len into [0, mask],
+// accumulating term counts into out[doc * (mask+1) + idx]. The TextFeaturizer
+// hashing-TF hot path (featurize/text.py) without per-token Python objects.
+void sml_hash_tf(const uint8_t* buf, const int64_t* doc_offsets, int64_t n_docs,
+                 uint32_t seed, uint32_t mask, int64_t min_len, int binary,
+                 float* out) {
+  const int64_t dim = static_cast<int64_t>(mask) + 1;
+  uint8_t token[4096];
+  for (int64_t d = 0; d < n_docs; ++d) {
+    const uint8_t* s = buf + doc_offsets[d];
+    const int64_t len = doc_offsets[d + 1] - doc_offsets[d];
+    float* row = out + d * dim;
+    int64_t tlen = 0;
+    for (int64_t i = 0; i <= len; ++i) {
+      uint8_t c = (i < len) ? s[i] : 0;
+      bool alnum = (c >= '0' && c <= '9') || (c >= 'a' && c <= 'z') ||
+                   (c >= 'A' && c <= 'Z') || c >= 0x80;
+      if (alnum) {
+        if (c >= 'A' && c <= 'Z') c += 32;  // ascii lowercase
+        if (tlen < static_cast<int64_t>(sizeof(token))) token[tlen++] = c;
+      } else if (tlen > 0) {
+        if (tlen >= min_len) {
+          uint32_t idx = murmur3_32(token, static_cast<size_t>(tlen), seed)
+                         & mask;
+          if (binary) {
+            row[idx] = 1.0f;
+          } else {
+            row[idx] += 1.0f;
+          }
+        }
+        tlen = 0;
+      }
+    }
+  }
+}
+
+}  // extern "C"

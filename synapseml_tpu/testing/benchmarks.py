@@ -45,11 +45,15 @@ class Benchmarks:
     def compare(self, regenerate: bool = False) -> None:
         """Assert every recorded metric is within tolerance of the checked-in
         value (Benchmarks.scala verifyBenchmarks). ``regenerate=True`` (or env
-        UPDATE_BENCHMARKS=1) rewrites the CSV instead."""
-        if regenerate or os.environ.get("UPDATE_BENCHMARKS") == "1" \
-                or not os.path.exists(self.csv_path):
+        UPDATE_BENCHMARKS=1) rewrites the CSV instead. A missing CSV is an
+        ERROR (as in the reference) — a typo'd name must not disarm the guard."""
+        if regenerate or os.environ.get("UPDATE_BENCHMARKS") == "1":
             self.write()
             return
+        if not os.path.exists(self.csv_path):
+            raise AssertionError(
+                f"no checked-in benchmark CSV at {self.csv_path}; run with "
+                "UPDATE_BENCHMARKS=1 (or compare(regenerate=True)) to create it")
         with open(self.csv_path) as f:
             expected = {r["name"]: r for r in csv.DictReader(f)}
         errors = []

@@ -29,7 +29,7 @@ _M32 = 0xFFFFFFFF
 
 def murmur3_32(data: bytes, seed: int = 0) -> int:
     """MurmurHash3 x86_32 over ``data`` — the hash VW uses for all features."""
-    h = seed & _M32
+    h = int(seed) & _M32  # plain int — numpy scalars would wrap with warnings
     n = len(data)
     rounded = n & ~3
     for i in range(0, rounded, 4):
@@ -70,11 +70,24 @@ def namespace_hash(namespace: str, hash_seed: int = 0) -> int:
     return murmur3_32(namespace.encode("utf-8"), hash_seed)
 
 
+def _int_name(name: str):
+    """ASCII-digit integer name, |value| <= 2^40 — matching VW's C parser and
+    the native fast path exactly (unicode digits are NOT integers here)."""
+    if not name:
+        return None
+    body = name[1:] if name[0] == "-" else name
+    if not body or any(c < "0" or c > "9" for c in body):
+        return None
+    v = int(name)
+    return v if abs(v) <= (1 << 40) else None
+
+
 @lru_cache(maxsize=1 << 16)
 def hash_feature(name: str, ns_seed: int = 0) -> int:
     """Un-masked feature hash. Integer-looking names index directly (VW default)."""
-    if name and (name.isdigit() or (name[0] == "-" and name[1:].isdigit())):
-        return (int(name) + ns_seed) & _M32
+    v = _int_name(name)
+    if v is not None:
+        return (v + int(ns_seed)) & _M32
     return murmur3_32(name.encode("utf-8"), ns_seed)
 
 
@@ -84,7 +97,19 @@ def interaction_hash(h1: int, h2: int) -> int:
 
 
 def hash_strings(names, ns_seed: int = 0, num_bits: Optional[int] = None) -> np.ndarray:
-    """Vectorized (host loop) hashing of a sequence of feature names."""
+    """Vectorized hashing of a sequence of feature names — C++ fast path when
+    the native helper library is built (synapseml_tpu/native), Python loop
+    otherwise. Both follow the VW contract above bit-for-bit."""
+    if len(names) >= 64:  # packing overhead only pays off on real batches
+        from ..native import murmur3_32_batch
+
+        native = murmur3_32_batch([str(s) for s in names], ns_seed,
+                                  vw_numeric_names=True, mask=0)
+        if native is not None:
+            out = native.astype(np.int64)
+            if num_bits is not None:
+                out &= (1 << num_bits) - 1
+            return out
     out = np.fromiter((hash_feature(str(s), ns_seed) for s in names),
                       dtype=np.int64, count=len(names))
     if num_bits is not None:

@@ -107,7 +107,9 @@ class TestBenchmarkHarness:
     def test_regression_detected(self, tmp_path):
         b = Benchmarks("Harness", resource_dir=str(tmp_path))
         b.add("m", 0.9, tolerance=0.01)
-        b.compare()  # first run writes the CSV
+        with pytest.raises(AssertionError, match="no checked-in"):
+            b.compare()  # missing CSV is an error, not a silent pass
+        b.compare(regenerate=True)
         b2 = Benchmarks("Harness", resource_dir=str(tmp_path))
         b2.add("m", 0.5, tolerance=0.01)
         with pytest.raises(AssertionError, match="benchmark regression"):
@@ -117,7 +119,7 @@ class TestBenchmarkHarness:
         b = Benchmarks("Harness2", resource_dir=str(tmp_path))
         b.add("m1", 1.0)
         b.add("m2", 2.0)
-        b.compare()
+        b.compare(regenerate=True)
         b2 = Benchmarks("Harness2", resource_dir=str(tmp_path))
         b2.add("m1", 1.0)
         with pytest.raises(AssertionError, match="not produced"):

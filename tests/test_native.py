@@ -1,0 +1,81 @@
+"""Native helper library tests: build+load, hash parity with the pure-Python
+implementation (the contract VW interop depends on), and the TF fast path.
+Reference analog: VowpalWabbitMurmurWithPrefix parity tests (vw module)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import native
+from synapseml_tpu.vw.hashing import hash_feature, hash_strings, murmur3_32
+
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native library unavailable")
+
+
+class TestNativeHashing:
+    @needs_native
+    def test_single_hash_parity(self):
+        for s, seed in [(b"", 0), (b"a", 0), (b"abc", 7), (b"hello world", 42),
+                        ("émoji🙂".encode(), 3), (b"x" * 133, 99)]:
+            assert native.murmur3_32(s, seed) == murmur3_32(s, seed), (s, seed)
+
+    @needs_native
+    def test_batch_parity_with_python(self):
+        names = [f"feature_{i}" for i in range(200)] + ["17", "-3", "0"]
+        got = native.murmur3_32_batch(names, 123, vw_numeric_names=True)
+        want = np.array([hash_feature(n, 123) for n in names], np.uint32)
+        np.testing.assert_array_equal(got, want)
+
+    @needs_native
+    def test_seeded_batch(self):
+        names = ["a", "b", "c"]
+        seeds = np.array([1, 2, 3], np.uint32)
+        got = native.murmur3_32_batch(names, seeds, vw_numeric_names=False)
+        want = np.array([murmur3_32(n.encode(), s)
+                         for n, s in zip(names, seeds)], np.uint32)
+        np.testing.assert_array_equal(got, want)
+
+    @needs_native
+    def test_mask(self):
+        names = [f"n{i}" for i in range(100)]
+        got = native.murmur3_32_batch(names, 0, mask=(1 << 10) - 1)
+        assert got.max() < 1 << 10
+
+    def test_hash_strings_same_result_any_path(self):
+        # the public API must agree whether or not the fast path engaged
+        names = [f"tok{i}" for i in range(100)]
+        big = hash_strings(names, 5, num_bits=18)        # batch (native if built)
+        small = np.concatenate([hash_strings(names[i:i + 1], 5, num_bits=18)
+                                for i in range(100)])    # forced python path
+        np.testing.assert_array_equal(big, small)
+
+    @needs_native
+    def test_hash_tf_tokenizer(self):
+        docs = ["Hello, hello WORLD!", "the quick brown fox"]
+        out = native.hash_tf(docs, 256, min_len=1)
+        assert out.shape == (2, 256)
+        # 'hello' twice in doc 0
+        idx = murmur3_32(b"hello") & 255
+        assert out[0, idx] == 2.0
+        assert out.sum() == 3 + 4  # 3 tokens doc0, 4 tokens doc1
+
+    @needs_native
+    def test_hash_tf_rejects_non_pow2(self):
+        assert native.hash_tf(["x"], 100) is None
+
+
+class TestNativeSpeed:
+    @needs_native
+    def test_batch_faster_than_python(self):
+        import time
+
+        names = [f"some_feature_name_{i}" for i in range(50000)]
+        t0 = time.perf_counter()
+        native.murmur3_32_batch(names, 0)
+        t_native = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.fromiter((hash_feature(n, 0) for n in names), np.int64,
+                    count=len(names))
+        t_py = time.perf_counter() - t0
+        assert t_native < t_py, (t_native, t_py)

@@ -6,16 +6,26 @@ structural analysis of the reference (svotaw/SynapseML) this build follows.
 
 Layout (mirrors SURVEY.md §7 layer order):
   core/      — Params/metadata system, Estimator/Transformer/Pipeline protocol,
-               columnar Table, save/load, logging + phase instrumentation
-  parallel/  — device mesh construction, distributed bootstrap, collective helpers
-  ops/       — numeric kernels (histograms, quantile binning, hashing, image ops)
+               columnar Table, save/load, logging, fabric/AAD auth
+  parallel/  — device mesh construction, distributed bootstrap, collective
+               helpers, ring attention (sequence parallelism)
+  ops/       — numeric kernels (histograms, quantile binning, image ops)
   gbdt/      — histogram-GBDT engine (the LightGBM-capability centerpiece)
-  models/    — estimator surface (gbdt, linear/online, dl, onnx, knn, sar, ...)
+  models/    — GBDT estimator surface (Classifier/Regressor/Ranker)
+  vw/        — hashed-feature online learners + contextual bandits
+  dl/        — Flax vision/text estimators (+ HF checkpoint fine-tuning)
+  onnx/      — ONNX parser + graph→JAX importer + batch inference
   stages/    — generic pipeline stages (mini-batching, repartition, udf, ...)
   featurize/ — auto-featurization, indexers, text featurizers
-  explainers/— LIME / KernelSHAP / ICE
-  io/        — HTTP client layer + serving
+  explainers/— LIME / KernelSHAP / ICE;  image/ — superpixels, unroll
+  nn/        — KNN / ball index;  recommendation/ — SAR + ranking
+  causal/    — DoubleML / DiD / synthetic control;  cyber/ — access anomaly
+  isolationforest/ — XLA isolation forest
+  io/        — HTTP client layer, serving server, datasources
   services/  — REST AI-service transformers (host-side)
+  native/    — C++ host helpers (ctypes) with Python fallbacks
+  testing/   — fuzzing + tolerance-CSV benchmark frameworks
+  codegen    — generated .pyi stubs + API docs from Param metadata
 """
 
 __version__ = "0.1.0"

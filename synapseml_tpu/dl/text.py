@@ -130,13 +130,67 @@ class DeepTextClassifier(Estimator, HasLabelCol, HasPredictionCol):
         return m
 
     def _fit_hf(self, texts, y, classes):
-        """Fine-tune a local HuggingFace Flax checkpoint. Requires the checkpoint
-        directory (config + flax weights + tokenizer) to exist locally; weight
-        acquisition is an environment concern (the reference downloads from the
-        hub at fit time, DeepTextClassifier.py)."""
-        raise NotImplementedError(
-            "HuggingFace-checkpoint fine-tuning is not wired up yet; use the "
-            "native encoder (leave `checkpoint` unset)")
+        """Fine-tune a local HuggingFace Flax checkpoint (BERT-class) — the
+        reference's DeepTextClassifier path (deep-learning/.../
+        DeepTextClassifier.py fine-tunes HF checkpoints under Horovod). The
+        checkpoint dir must exist locally (config + flax weights + tokenizer);
+        weight acquisition is an environment concern — the reference downloads
+        from the hub at fit time, this environment has no egress."""
+        import optax
+
+        dtype = (jnp.bfloat16 if self.getPrecision() == "bfloat16"
+                 else jnp.float32)
+        tok, hf = _load_hf(self.get("checkpoint"), len(classes), dtype=dtype)
+        enc = tok(list(map(str, texts)), truncation=True,
+                  padding="max_length", max_length=self.getMaxTokenLen(),
+                  return_tensors="np")
+        ids = enc["input_ids"].astype(np.int32)
+        attn = enc["attention_mask"].astype(np.int32)
+        labels = np.asarray(y, np.int32)
+
+        lr = self.getLearningRate()
+        opt = {"adam": optax.adam, "adamw": optax.adamw, "sgd": optax.sgd,
+               "momentum": lambda r: optax.sgd(r, momentum=0.9)}[
+            self.getOptimizer()](lr)
+        params = hf.params
+        opt_state = opt.init(params)
+        rng = jax.random.PRNGKey(self.getSeed())
+
+        @jax.jit
+        def step(params, opt_state, ids_b, attn_b, y_b, key):
+            def loss_fn(p):
+                logits = hf(input_ids=ids_b, attention_mask=attn_b, params=p,
+                            dropout_rng=key, train=True).logits
+                onehot = jax.nn.one_hot(y_b, logits.shape[-1])
+                return -jnp.mean(jnp.sum(
+                    jax.nn.log_softmax(logits) * onehot, axis=-1))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        n = len(ids)
+        bs = min(self.getBatchSize(), n)  # small datasets train on all rows
+        order_rng = np.random.default_rng(self.getSeed())
+        loss = None
+        for epoch in range(self.getMaxEpochs()):
+            order = order_rng.permutation(n)
+            for s in range(0, n - bs + 1, bs):
+                sel = order[s:s + bs]
+                rng, key = jax.random.split(rng)
+                params, opt_state, loss = step(
+                    params, opt_state, ids[sel], attn[sel], labels[sel], key)
+            self._log_base("epoch", {"epoch": epoch,
+                                     "loss": float(loss) if loss is not None
+                                     else None})
+        hf.params = params
+
+        m = DeepTextModel(classes=classes, hfModel=hf, hfTokenizer=tok)
+        m.set("maxTokenLen", self.getMaxTokenLen())
+        for p in ("textCol", "predictionCol"):
+            if self.isSet(p):
+                m.set(p, self.get(p))
+        return m
 
 
 class DeepTextModel(Model, HasPredictionCol):
@@ -147,18 +201,38 @@ class DeepTextModel(Model, HasPredictionCol):
     numHeads = Param("numHeads", "Attention heads", int, 8)
     hiddenSize = Param("hiddenSize", "Hidden width", int, 256)
 
+    # class-level defaults: instances materialized by PipelineStage.load
+    # bypass __init__
+    trainer: Optional[FlaxTrainer] = None
+    classes: Optional[np.ndarray] = None
+    hf_model = None
+    hf_tokenizer = None
+
     def __init__(self, trainer: Optional[FlaxTrainer] = None,
-                 classes: Optional[np.ndarray] = None, **kwargs):
+                 classes: Optional[np.ndarray] = None, hfModel=None,
+                 hfTokenizer=None, **kwargs):
         super().__init__(**kwargs)
         self.trainer = trainer
         self.classes = classes
+        self.hf_model = hfModel
+        self.hf_tokenizer = hfTokenizer
 
     def _transform(self, df: Table) -> Table:
         from .trainer import softmax_np
 
-        ids = hash_tokenize(list(df[self.getTextCol()]), self.getVocabSize(),
-                            self.getMaxTokenLen())
-        logits = self.trainer.predict_logits(ids)
+        texts = list(df[self.getTextCol()])
+        if self.hf_model is not None:
+            enc = self.hf_tokenizer(
+                list(map(str, texts)), truncation=True, padding="max_length",
+                max_length=self.getMaxTokenLen(), return_tensors="np")
+            logits = np.asarray(self.hf_model(
+                input_ids=enc["input_ids"].astype(np.int32),
+                attention_mask=enc["attention_mask"].astype(np.int32),
+                train=False).logits)
+        else:
+            ids = hash_tokenize(texts, self.getVocabSize(),
+                                self.getMaxTokenLen())
+            logits = self.trainer.predict_logits(ids)
         pred = np.asarray(self.classes)[logits.argmax(-1)]
         out = df.with_column(self.getPredictionCol(), pred)
         return out.with_column("probability", softmax_np(logits))
@@ -168,9 +242,14 @@ class DeepTextModel(Model, HasPredictionCol):
 
         from flax.serialization import to_bytes
 
+        np.save(os.path.join(path, "classes.npy"), np.asarray(self.classes))
+        if self.hf_model is not None:
+            hf_dir = os.path.join(path, "hf_checkpoint")
+            self.hf_model.save_pretrained(hf_dir)
+            self.hf_tokenizer.save_pretrained(hf_dir)
+            return
         with open(os.path.join(path, "params.msgpack"), "wb") as f:
             f.write(to_bytes({"params": self.trainer.params}))
-        np.save(os.path.join(path, "classes.npy"), np.asarray(self.classes))
 
     def _load_extra(self, path: str) -> None:
         import os
@@ -178,6 +257,12 @@ class DeepTextModel(Model, HasPredictionCol):
         from flax.serialization import from_bytes
 
         self.classes = np.load(os.path.join(path, "classes.npy"), allow_pickle=True)
+        hf_dir = os.path.join(path, "hf_checkpoint")
+        if os.path.isdir(hf_dir):
+            self.hf_tokenizer, self.hf_model = _load_hf(hf_dir,
+                                                        len(self.classes))
+            self.trainer = None
+            return
         model = TransformerEncoder(
             vocab_size=self.getVocabSize(), num_layers=self.getNumLayers(),
             num_heads=self.getNumHeads(), hidden=self.getHiddenSize(),
@@ -188,3 +273,24 @@ class DeepTextModel(Model, HasPredictionCol):
             blob = from_bytes({"params": trainer.params}, f.read())
         trainer.load_params(blob["params"])
         self.trainer = trainer
+
+
+def _load_hf(checkpoint: str, num_labels: int, dtype=None):
+    """(tokenizer, FlaxAutoModelForSequenceClassification) from a LOCAL
+    checkpoint dir; raises a clear error when absent (zero-egress env)."""
+    import os
+
+    if not checkpoint or not os.path.isdir(checkpoint):
+        raise FileNotFoundError(
+            f"HuggingFace checkpoint dir {checkpoint!r} not found; this "
+            "environment cannot download from the hub — provide a local dir "
+            "with config.json, flax weights, and tokenizer files")
+    from transformers import (AutoTokenizer,
+                              FlaxAutoModelForSequenceClassification)
+
+    tok = AutoTokenizer.from_pretrained(checkpoint)
+    hf = FlaxAutoModelForSequenceClassification.from_pretrained(
+        checkpoint, num_labels=num_labels)
+    if dtype == jnp.bfloat16:
+        hf.params = hf.to_bf16(hf.params)
+    return tok, hf

@@ -144,16 +144,18 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
                 for f in futures:
                     remaining = (None if deadline is None
                                  else max(deadline - _time.monotonic(), 0.0))
-                    out.append(f.result(timeout=remaining))
-            except FuturesTimeout:
-                # only a real deadline expiry is a batch timeout; a worker's
-                # own TimeoutError (same type on py>=3.11) must propagate
-                if deadline is None:
-                    raise
-                pool.shutdown(wait=False, cancel_futures=True)
-                raise TimeoutError(
-                    f"HTTPTransformer: batch exceeded concurrentTimeout="
-                    f"{budget}s")
+                    try:
+                        out.append(f.result(timeout=remaining))
+                    except FuturesTimeout:
+                        # a done future raised the worker's own TimeoutError
+                        # (same builtin type on py>=3.11) — propagate it; an
+                        # undone future means the batch deadline expired
+                        if f.done():
+                            raise
+                        pool.shutdown(wait=False, cancel_futures=True)
+                        raise TimeoutError(
+                            "HTTPTransformer: batch exceeded "
+                            f"concurrentTimeout={budget}s") from None
             finally:
                 pool.shutdown(wait=False, cancel_futures=True)
         col = np.empty(len(out), dtype=object)

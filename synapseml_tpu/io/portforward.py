@@ -1,0 +1,51 @@
+"""SSH port forwarding helper.
+
+Reference: core/.../io/http/PortForwarding.scala — forwards a local port to a
+remote host over ssh (used to reach driver-side services from notebooks).
+Implemented over the system ``ssh`` binary (no paramiko in the image); each
+forward is a managed subprocess.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import Dict, Optional
+
+_forwards: Dict[int, subprocess.Popen] = {}
+
+
+def forward_port(remote_host: str, remote_port: int, local_port: int,
+                 ssh_user: Optional[str] = None,
+                 ssh_opts: Optional[list] = None) -> subprocess.Popen:
+    """Start ``ssh -N -L local:localhost:remote`` to ``remote_host``; returns
+    the process (also tracked for stop_forwarding)."""
+    if shutil.which("ssh") is None:
+        raise EnvironmentError("ssh binary not available for port forwarding")
+    if local_port in _forwards:
+        stop_forwarding(local_port)  # reusing a port replaces its forward
+    target = f"{ssh_user}@{remote_host}" if ssh_user else remote_host
+    cmd = ["ssh", "-N", "-o", "StrictHostKeyChecking=no",
+           "-L", f"{local_port}:localhost:{remote_port}", target]
+    if ssh_opts:
+        cmd = cmd[:1] + list(ssh_opts) + cmd[1:]
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    _forwards[local_port] = proc
+    return proc
+
+
+def stop_forwarding(local_port: Optional[int] = None) -> None:
+    """Stop one forward (or all when ``local_port`` is None)."""
+    ports = [local_port] if local_port is not None else list(_forwards)
+    for p in ports:
+        proc = _forwards.pop(p, None)
+        if proc is None:
+            continue
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        proc.wait()  # reap — no zombies

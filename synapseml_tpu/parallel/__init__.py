@@ -10,6 +10,11 @@ from .mesh import (  # noqa: F401
     shard_rows,
     process_topology,
 )
+from .ring_attention import (  # noqa: F401
+    attention_reference,
+    blockwise_attention,
+    ring_self_attention,
+)
 from .collectives import (  # noqa: F401
     allreduce_sum,
     allreduce_mean,

@@ -1,0 +1,158 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+The reference has NO long-context story (SURVEY.md §5.7: text DL truncates at
+max_token_len=128); this framework makes sequence parallelism first-class so
+the DL layer scales context length with chips. Design per Liu et al.
+(Ring Attention with Blockwise Transformers) + the blockwise-parallel
+formulation: Q stays resident per device; K/V blocks rotate around the ring
+(``ppermute`` over ICI) while each device accumulates its queries' attention
+with a numerically-stable online softmax (running max ``m``, normalizer ``l``,
+unnormalized output ``o``). Compute for step t overlaps the collective for
+step t+1 — XLA schedules the ppermute asynchronously on TPU.
+
+Shapes follow flax convention: [batch, seq, heads, head_dim]; the seq axis is
+sharded over the mesh's ``seq`` axis. Causal masking uses global positions
+derived from each block's ring offset, so device boundaries are invisible to
+the math.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import SEQ_AXIS
+
+
+def _block_attention(q, k, v, m, l, o, q_offset, k_offset, causal, scale):
+    """One blockwise online-softmax update.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m,l: [B, H, Sq]; o: [B, Sq, H, D].
+    Offsets are the blocks' global sequence starts (for causal masking).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Sq, Sk]
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_new = jnp.maximum(m, s.max(axis=-1))          # [B, H, Sq]
+    # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) -> use 0
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(jnp.where(jnp.isfinite(s), s - safe_m[..., None], -jnp.inf))
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+    l_new = l * correction + p.sum(axis=-1)
+    o_new = (o * correction.transpose(0, 2, 1)[..., None]
+             + jnp.einsum("bhqk,bkhd->bqhd", p, v))
+    return m_new, l_new, o_new
+
+
+def _finalize(m, l, o):
+    denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
+    return o / denom
+
+
+def attention_reference(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None) -> jnp.ndarray:
+    """Plain single-device attention (the correctness oracle for the ring)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        n_q, n_k = q.shape[1], k.shape[1]
+        mask = jnp.arange(n_q)[:, None] >= jnp.arange(n_k)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
+                        scale: Optional[float] = None,
+                        axis: str = SEQ_AXIS) -> jnp.ndarray:
+    """Exact self-attention with q/k/v sharded on ``axis`` over ``mesh``.
+
+    Each of the R ring ranks holds S/R of the sequence; the result equals
+    :func:`attention_reference` on the gathered sequence, bit-for-near-bit
+    (online softmax is associative). Peak memory per device is O(S/R · S/R)
+    per step instead of O(S²).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    ring = mesh.shape[axis]
+    # batch rides the data axis when the mesh has one (dp × sp composition) —
+    # each data-rank computes only its batch shard
+    from .mesh import DATA_AXIS
+
+    batch_axis = DATA_AXIS if (DATA_AXIS in mesh.shape and DATA_AXIS != axis
+                               and q.shape[0] % mesh.shape[DATA_AXIS] == 0) \
+        else None
+    spec = P(batch_axis, axis, None, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 3,
+             out_specs=spec, check_vma=False)
+    def _ring(q_blk, k_blk, v_blk):
+        rank = jax.lax.axis_index(axis)
+        s_local = q_blk.shape[1]
+        q_offset = rank * s_local
+        m0 = jnp.full(q_blk.shape[:1] + (q_blk.shape[2], s_local), -jnp.inf,
+                      dtype=jnp.float32)
+        l0 = jnp.zeros_like(m0)
+        o0 = jnp.zeros(q_blk.shape, dtype=jnp.float32)
+        perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+        def step(t, carry):
+            k_cur, v_cur, m, l, o = carry
+            # block currently held arrived from rank (rank - t) mod ring
+            k_offset = ((rank - t) % ring) * s_local
+            m, l, o = _block_attention(
+                q_blk.astype(jnp.float32), k_cur.astype(jnp.float32),
+                v_cur.astype(jnp.float32), m, l, o, q_offset, k_offset,
+                causal, scale)
+            # rotate K/V to the next rank (overlaps next step's compute)
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return k_nxt, v_nxt, m, l, o
+
+        _, _, m, l, o = jax.lax.fori_loop(
+            0, ring, step, (k_blk, v_blk, m0, l0, o0))
+        return _finalize(m, l, o).astype(q_blk.dtype)
+
+    return _ring(q, k, v)
+
+
+def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
+                        scale: Optional[float] = None) -> jnp.ndarray:
+    """Single-device blockwise attention (the memory-efficient kernel the ring
+    wraps): K/V consumed in ``block_size`` chunks with the same online
+    softmax — O(S·block) memory instead of O(S²). Used for long sequences on
+    one chip; the remat-style scan keeps XLA from materializing the full
+    score matrix."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    n_k = k.shape[1]
+    if n_k % block_size:
+        raise ValueError(f"sequence {n_k} not divisible by block {block_size}")
+    n_blocks = n_k // block_size
+    kb = k.reshape(k.shape[0], n_blocks, block_size, *k.shape[2:])
+    vb = v.reshape(v.shape[0], n_blocks, block_size, *v.shape[2:])
+
+    m0 = jnp.full((q.shape[0], q.shape[2], q.shape[1]), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros_like(m0)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+
+    def step(carry, blk):
+        m, l, o = carry
+        t, k_cur, v_cur = blk
+        m, l, o = _block_attention(q.astype(jnp.float32),
+                                   k_cur.astype(jnp.float32),
+                                   v_cur.astype(jnp.float32),
+                                   m, l, o, 0, t * block_size, causal, scale)
+        return (m, l, o), None
+
+    (m, l, o), _ = jax.lax.scan(
+        step, (m0, l0, o0),
+        (jnp.arange(n_blocks), kb.transpose(1, 0, 2, 3, 4),
+         vb.transpose(1, 0, 2, 3, 4)))
+    return _finalize(m, l, o).astype(q.dtype)

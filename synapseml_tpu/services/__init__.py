@@ -20,6 +20,11 @@ from .anomaly import (DetectAnomalies, DetectLastAnomaly,
                       DetectMultivariateAnomaly, SimpleDetectAnomalies)
 from .speech import AnalyzeDocument, SpeechToText, SpeechToTextSDK, TextToSpeech
 from .search import AzureSearchWriter, BingImageSearch
+from .geospatial import (AddressGeocoder, CheckPointInPolygon,
+                         ReverseAddressGeocoder)
+from .form import (AnalyzeBusinessCards, AnalyzeCustomModel,
+                   AnalyzeDocumentRead, AnalyzeIDDocuments, AnalyzeInvoices,
+                   AnalyzeLayout, AnalyzeReceipts)
 
 __all__ = [
     "CognitiveServiceBase", "HasServiceParams", "HasSetLocation",
@@ -35,4 +40,8 @@ __all__ = [
     "DetectMultivariateAnomaly",
     "SpeechToText", "SpeechToTextSDK", "TextToSpeech", "AnalyzeDocument",
     "AzureSearchWriter", "BingImageSearch",
+    "AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon",
+    "AnalyzeLayout", "AnalyzeReceipts", "AnalyzeBusinessCards",
+    "AnalyzeInvoices", "AnalyzeIDDocuments", "AnalyzeDocumentRead",
+    "AnalyzeCustomModel",
 ]

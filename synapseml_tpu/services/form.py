@@ -1,0 +1,54 @@
+"""Document Intelligence (Form Recognizer) prebuilt-model transformers.
+
+Reference: cognitive/.../services/form/FormRecognizer.scala (~849 LoC:
+AnalyzeLayout, AnalyzeReceipts, AnalyzeBusinessCards, AnalyzeInvoices,
+AnalyzeIDDocuments, AnalyzeCustomModel, plus management ops). All share the
+submit+poll LRO flow implemented in speech.AnalyzeDocument; these subclasses
+pin the prebuilt model ids.
+"""
+
+from __future__ import annotations
+
+from ..core.params import Param
+from .speech import AnalyzeDocument
+
+
+class AnalyzeLayout(AnalyzeDocument):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("modelId", "prebuilt-layout")
+        super().__init__(**kwargs)
+
+
+class AnalyzeReceipts(AnalyzeDocument):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("modelId", "prebuilt-receipt")
+        super().__init__(**kwargs)
+
+
+class AnalyzeBusinessCards(AnalyzeDocument):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("modelId", "prebuilt-businessCard")
+        super().__init__(**kwargs)
+
+
+class AnalyzeInvoices(AnalyzeDocument):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("modelId", "prebuilt-invoice")
+        super().__init__(**kwargs)
+
+
+class AnalyzeIDDocuments(AnalyzeDocument):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("modelId", "prebuilt-idDocument")
+        super().__init__(**kwargs)
+
+
+class AnalyzeDocumentRead(AnalyzeDocument):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("modelId", "prebuilt-read")
+        super().__init__(**kwargs)
+
+
+class AnalyzeCustomModel(AnalyzeDocument):
+    """Custom-trained model: set ``modelId`` to the trained model's id
+    (reference AnalyzeCustomModel)."""

@@ -1,0 +1,109 @@
+"""Extended DL tests: HF Flax checkpoint fine-tuning with a locally-built tiny
+BERT (the reference DeepTextClassifier path — DeepTextClassifier.py fine-tunes
+HF checkpoints), and mid-training checkpoint/resume (SURVEY §5.4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.pipeline import PipelineStage
+from synapseml_tpu.core.table import Table
+
+
+@pytest.fixture(scope="module")
+def tiny_bert(tmp_path_factory):
+    """Local BERT checkpoint: config + random flax weights + wordpiece
+    tokenizer — no network."""
+    d = str(tmp_path_factory.mktemp("tiny_bert"))
+    from transformers import (BertConfig, BertTokenizerFast,
+                              FlaxBertForSequenceClassification)
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "good", "bad", "movie", "great", "terrible", "a", "the"]
+    with open(os.path.join(d, "vocab.txt"), "w") as f:
+        f.write("\n".join(vocab))
+    tok = BertTokenizerFast(vocab_file=os.path.join(d, "vocab.txt"),
+                            do_lower_case=True)
+    cfg = BertConfig(vocab_size=len(vocab), hidden_size=32,
+                     num_hidden_layers=2, num_attention_heads=2,
+                     intermediate_size=64, max_position_embeddings=64,
+                     num_labels=2)
+    FlaxBertForSequenceClassification(cfg, seed=0).save_pretrained(d)
+    tok.save_pretrained(d)
+    return d
+
+
+class TestHFTextPath:
+    def test_finetune_and_roundtrip(self, tiny_bert, tmp_path):
+        from synapseml_tpu.dl import DeepTextClassifier
+
+        texts = ["good movie", "great movie", "bad movie",
+                 "terrible movie"] * 10
+        labels = np.array([1.0, 1.0, 0.0, 0.0] * 10)
+        df = Table({"text": np.array(texts, object), "label": labels})
+        clf = DeepTextClassifier(checkpoint=tiny_bert, maxEpochs=8,
+                                 batchSize=8, learningRate=5e-3,
+                                 maxTokenLen=16)
+        model = clf.fit(df)
+        out = model.transform(df)
+        assert (out["prediction"] == labels).mean() >= 0.9
+        p = str(tmp_path / "hf_model")
+        model.save(p)
+        loaded = PipelineStage.load(p)
+        out2 = loaded.transform(df)
+        np.testing.assert_array_equal(out2["prediction"], out["prediction"])
+
+    def test_missing_checkpoint_rejected(self):
+        from synapseml_tpu.dl import DeepTextClassifier
+
+        df = Table({"text": np.array(["x", "y"], object),
+                    "label": np.array([0.0, 1.0])})
+        with pytest.raises(FileNotFoundError, match="checkpoint dir"):
+            DeepTextClassifier(checkpoint="/nonexistent/ckpt").fit(df)
+
+
+class TestCheckpointResume:
+    def test_resume_from_epoch(self, tmp_path):
+        from synapseml_tpu.dl import FlaxTrainer, TrainConfig, make_backbone
+
+        rng = np.random.default_rng(0)
+        X = rng.uniform(size=(64, 8, 8, 3)).astype(np.float32)
+        y = rng.integers(0, 2, 64).astype(np.float32)
+        ckpt = str(tmp_path / "ckpts")
+
+        cfg = TrainConfig(batch_size=16, max_epochs=3, checkpoint_dir=ckpt,
+                          seed=1)
+        t1 = FlaxTrainer(make_backbone("tiny", 2), cfg)
+        t1.fit(X, y)
+        assert os.path.exists(os.path.join(ckpt, "latest"))
+        saved = sorted(f for f in os.listdir(ckpt) if f.endswith(".msgpack"))
+        assert len(saved) == 3
+
+        # resume: a fresh trainer with more epochs continues from epoch 3
+        cfg2 = TrainConfig(batch_size=16, max_epochs=5, checkpoint_dir=ckpt,
+                           seed=1)
+        t2 = FlaxTrainer(make_backbone("tiny", 2), cfg2)
+        t2.fit(X, y)
+        assert [h["epoch"] for h in t2.history] == [3, 4]
+
+        # resume disabled trains from scratch
+        cfg3 = TrainConfig(batch_size=16, max_epochs=1, checkpoint_dir=None,
+                           resume=False, seed=1)
+        t3 = FlaxTrainer(make_backbone("tiny", 2), cfg3)
+        t3.fit(X, y)
+        assert [h["epoch"] for h in t3.history] == [0]
+
+    def test_resnet50_builds_and_steps(self):
+        """BASELINE headline backbone compiles and takes a step on small
+        shapes (full-size throughput is the bench's job)."""
+        from synapseml_tpu.dl import FlaxTrainer, TrainConfig, make_backbone
+
+        rng = np.random.default_rng(0)
+        X = rng.uniform(size=(8, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 4, 8).astype(np.float32)
+        cfg = TrainConfig(batch_size=4, max_epochs=1, steps_per_epoch=1)
+        t = FlaxTrainer(make_backbone("resnet50", 4, small_images=True), cfg)
+        t.fit(X, y)
+        logits = t.predict_logits(X)
+        assert logits.shape == (8, 4) and np.isfinite(logits).all()

@@ -90,6 +90,9 @@ def _service_df():
                                "2026-01-02T00:00:00Z"], object),
         "value": np.array([1.0, 2.0]),
         "grp": np.array(["g", "g"], object),
+        "address": np.array(["1 Main St", "2 High St"], object),
+        "lat": np.array([47.6, 47.7]),
+        "lon": np.array([-122.3, -122.4]),
     })
 
 
@@ -535,6 +538,30 @@ def _registry():
         S.AnalyzeDocument(url="http://stub.local", imageBytesCol="imageBytes",
                           maxPollRetries=1, pollInterval=0.01),
         S.BingImageSearch(url="http://stub.local/bing"),
+        S.AddressGeocoder(url="http://stub.local/maps",
+                          subscriptionKey="k"),
+        S.ReverseAddressGeocoder(url="http://stub.local/maps",
+                                 subscriptionKey="k"),
+        S.CheckPointInPolygon(url="http://stub.local/maps",
+                              subscriptionKey="k", userDataIdentifier="udid"),
+        S.AnalyzeLayout(url="http://stub.local", imageBytesCol="imageBytes",
+                        maxPollRetries=1, pollInterval=0.01),
+        S.AnalyzeReceipts(url="http://stub.local", imageBytesCol="imageBytes",
+                          maxPollRetries=1, pollInterval=0.01),
+        S.AnalyzeBusinessCards(url="http://stub.local",
+                               imageBytesCol="imageBytes",
+                               maxPollRetries=1, pollInterval=0.01),
+        S.AnalyzeInvoices(url="http://stub.local", imageBytesCol="imageBytes",
+                          maxPollRetries=1, pollInterval=0.01),
+        S.AnalyzeIDDocuments(url="http://stub.local",
+                             imageBytesCol="imageBytes",
+                             maxPollRetries=1, pollInterval=0.01),
+        S.AnalyzeDocumentRead(url="http://stub.local",
+                              imageBytesCol="imageBytes",
+                              maxPollRetries=1, pollInterval=0.01),
+        S.AnalyzeCustomModel(url="http://stub.local", modelId="custom-1",
+                             imageBytesCol="imageBytes",
+                             maxPollRetries=1, pollInterval=0.01),
     ]
     for t in svc_objs:
         t.set("handler", _stub_handler)

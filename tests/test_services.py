@@ -194,3 +194,50 @@ class TestServiceParamCols:
         paths = [r["path"] for r in mock_service["requests"]]
         assert "/openai/deployments/m1/completions" in paths[0]
         assert "/openai/deployments/m2/completions" in paths[1]
+
+
+class TestGeospatial:
+    def test_geocoder_query(self, mock_service):
+        from synapseml_tpu.services import AddressGeocoder
+
+        mock_service["responses"]["/search"] = {"results": [{"position": {}}]}
+        t = AddressGeocoder(url=mock_service["url"], subscriptionKey="mk",
+                            outputCol="geo")
+        out = t.transform(Table({"address": np.array(["1 Main St"], object)}))
+        req = mock_service["requests"][0]
+        assert "query=1%20Main%20St" in req["path"]
+        assert "subscription-key=mk" in req["path"]
+        assert out["geo"][0] == [{"position": {}}]
+
+    def test_point_in_polygon_requires_udid(self, mock_service):
+        from synapseml_tpu.services import CheckPointInPolygon
+
+        t = CheckPointInPolygon(url=mock_service["url"])
+        with pytest.raises(ValueError, match="userDataIdentifier"):
+            t.transform(Table({"lat": np.array([1.0]),
+                               "lon": np.array([2.0])}))
+
+
+class TestFormPrebuilt:
+    def test_prebuilt_model_ids(self):
+        from synapseml_tpu.services import AnalyzeInvoices, AnalyzeReceipts
+
+        assert AnalyzeReceipts().getModelId() == "prebuilt-receipt"
+        assert AnalyzeInvoices().getModelId() == "prebuilt-invoice"
+
+
+class TestFabric:
+    def test_platform_and_token_chain(self, monkeypatch):
+        from synapseml_tpu.core import fabric
+
+        monkeypatch.delenv("SYNAPSEML_TPU_AAD_TOKEN", raising=False)
+        assert fabric.current_platform() in ("synapse", "fabric",
+                                             "databricks", "other")
+        assert fabric.get_access_token() is None
+        monkeypatch.setenv("SYNAPSEML_TPU_AAD_TOKEN", "tok123")
+        assert fabric.get_access_token() == "tok123"
+        fabric.register_token_provider(lambda aud: "prov-" + aud)
+        try:
+            assert fabric.get_access_token("cognitive") == "prov-cognitive"
+        finally:
+            fabric._providers.clear()

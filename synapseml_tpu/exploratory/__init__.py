@@ -1,0 +1,12 @@
+"""Data-balance analysis (Responsible AI exploratory measures).
+
+Reference: core/src/main/scala/com/microsoft/azure/synapse/ml/exploratory/
+(FeatureBalanceMeasure.scala, DistributionBalanceMeasure.scala,
+AggregateBalanceMeasure.scala, ~770 LoC; SURVEY.md §2.7).
+"""
+
+from .balance import (AggregateBalanceMeasure, DistributionBalanceMeasure,
+                      FeatureBalanceMeasure)
+
+__all__ = ["FeatureBalanceMeasure", "DistributionBalanceMeasure",
+           "AggregateBalanceMeasure"]

@@ -74,6 +74,11 @@ class BoosterConfig:
     seed: int = 0
     boost_from_average: bool = True
     bin_sample_count: int = 200_000
+    # distributed tree learner: "serial"/"data" aggregate all features'
+    # histograms; "voting" selects top-2k features per tree by shard votes
+    # (PV-Tree; LightGBM voting_parallel + topK — LightGBMParams.scala:25-27)
+    tree_learner: str = "serial"
+    top_k: int = 20
     # lambdarank
     lambdarank_truncation_level: int = 30
     max_position: int = 30
@@ -455,9 +460,26 @@ def train_booster(
         new_weight = 1.0
         if dart_mode and kdrop:
             new_weight = 1.0 / (kdrop + 1.0)
+        # voting-parallel: pick top-2k features per tree by shard votes, grow
+        # on the sliced columns so in-loop histogram allreduce is O(top_k)
+        voting = (cfg.tree_learner == "voting" and mesh is not None
+                  and nfeat > 2 * cfg.top_k)
         for cls in range(k):
-            tree, node = grow_tree(binned, g[:, cls], h[:, cls], in_bag,
-                                   feat_mask, is_cat, mono, grower_cfg)
+            if voting:
+                from .voting import remap_tree_features, voting_select
+
+                sel_idx = voting_select(
+                    binned, g[:, cls] * in_bag, h[:, cls] * in_bag, in_bag,
+                    mesh, cfg.top_k, cfg.max_bin, cfg.lambda_l2,
+                    max(cfg.min_data_in_leaf, 1), feature_active=feat_mask)
+                sel_j = jnp.asarray(sel_idx)
+                tree, node = grow_tree(
+                    binned[:, sel_j], g[:, cls], h[:, cls], in_bag,
+                    feat_mask[sel_j], is_cat[sel_j], mono[sel_j], grower_cfg)
+                tree = remap_tree_features(tree, sel_idx)
+            else:
+                tree, node = grow_tree(binned, g[:, cls], h[:, cls], in_bag,
+                                       feat_mask, is_cat, mono, grower_cfg)
             contrib = _leaf_gather(tree.leaf_value, node)          # (N,)
             if dart_mode:
                 tree_contribs.append((cls, np.asarray(contrib, np.float32)))

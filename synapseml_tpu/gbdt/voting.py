@@ -1,0 +1,95 @@
+"""Voting-parallel feature selection (PV-Tree).
+
+Reference: LightGBM's ``voting_parallel`` tree learner, surfaced through
+``parallelism``/``topK`` (lightgbm/.../params/LightGBMParams.scala:25-27,
+LightGBMConstants.scala:22-24 DefaultTopK=20, LightGBMBase.scala:252). In
+data-parallel mode every split synchronizes histograms for ALL features;
+voting-parallel cuts that to O(top_k): each worker votes its local top-k
+features by split gain, the global top-2k by votes (gain-sum tie-break) are
+selected, and only those features' histograms are aggregated.
+
+TPU adaptation: selection runs once per tree at the root (one shard_map with a
+``psum`` of per-feature gains + votes — cheap, (F,)-sized); the tree then grows
+on the SLICED (N, 2k) bin matrix, so every per-leaf histogram allreduce inside
+the growth loop moves 2k features instead of F. Split feature indices are
+remapped to the full feature space afterwards.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+
+
+def _per_feature_root_gain(binned, g, h, in_bag, num_bins: int,
+                           lambda_l2: float, min_data: int):
+    """(F,) best numeric-split gain per feature over the root node, from this
+    shard's rows only. Counts use ``in_bag`` so padding/bagged-out rows do not
+    inflate the min_data validity filter."""
+    n, f = binned.shape
+    # histogram per feature: scatter (grad, hess, in_bag) into (F*B, 3)
+    flat = binned.astype(jnp.int32) + jnp.arange(f)[None, :] * num_bins
+    contrib = jnp.stack([g, h, in_bag], axis=1)              # (N, 3)
+    tot = jnp.zeros((f * num_bins, 3), jnp.float32)
+    tot = tot.at[flat].add(contrib[:, None, :])              # (N,F) idx rows
+    hist = tot.reshape(f, num_bins, 3)
+    cum = jnp.cumsum(hist, axis=1)                          # (F, B, 3)
+    G, H = cum[:, -1, 0:1], cum[:, -1, 1:2]
+    GL, HL, CL = cum[..., 0], cum[..., 1], cum[..., 2]
+    GR, HR, CR = G - GL, H - HL, cum[:, -1, 2:3] - CL
+    lam = jnp.float32(lambda_l2)
+    gain = (GL ** 2 / (HL + lam) + GR ** 2 / (HR + lam)
+            - G ** 2 / (H + lam))
+    valid = (CL >= min_data) & (CR >= min_data)
+    return jnp.max(jnp.where(valid, gain, -jnp.inf), axis=1)  # (F,)
+
+
+def voting_select(binned, g, h, in_bag, mesh, top_k: int, num_bins: int,
+                  lambda_l2: float = 0.0, min_data: int = 1,
+                  feature_active=None) -> np.ndarray:
+    """Global top-2k feature indices by per-shard votes (gain-sum tie-break).
+    Returns a sorted int array of 2k (or fewer) feature indices, replicated.
+    ``feature_active`` (F,) bool restricts voting to the feature_fraction
+    sample so selection never wastes slots on masked-out features."""
+    f = binned.shape[1]
+    k = min(top_k, f)
+    out_k = min(2 * k, f)
+    active = (jnp.ones((f,), bool) if feature_active is None
+              else jnp.asarray(feature_active))
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                       P(DATA_AXIS), P()),
+             out_specs=P(), check_vma=False)
+    def _select(b_shard, g_shard, h_shard, bag_shard, act):
+        local_gain = _per_feature_root_gain(b_shard, g_shard, h_shard,
+                                            bag_shard, num_bins, lambda_l2,
+                                            min_data)
+        local_gain = jnp.where(act, local_gain, -jnp.inf)
+        # local top-k vote (PV-Tree step 1)
+        _, top_idx = jax.lax.top_k(local_gain, k)
+        votes = jnp.zeros((f,), jnp.float32).at[top_idx].add(1.0)
+        votes = jax.lax.psum(votes, DATA_AXIS)
+        gain_sum = jax.lax.psum(jnp.where(jnp.isfinite(local_gain),
+                                          local_gain, 0.0), DATA_AXIS)
+        # global selection: votes dominate, gain-sum breaks ties (step 2)
+        norm_gain = gain_sum / (jnp.max(jnp.abs(gain_sum)) + 1e-12)
+        score = votes * 2.0 + norm_gain
+        score = jnp.where(act, score, -jnp.inf)
+        _, sel = jax.lax.top_k(score, out_k)
+        return jnp.sort(sel)
+
+    return np.asarray(_select(binned, g, h, in_bag, active))
+
+
+def remap_tree_features(tree, sel_idx: np.ndarray):
+    """Split features of a tree grown on sliced columns → full feature space."""
+    sel = jnp.asarray(sel_idx, jnp.int32)
+    return tree._replace(split_feature=sel[tree.split_feature])

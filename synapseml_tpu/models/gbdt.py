@@ -99,6 +99,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
     maxStreamingOMPThreads = Param("maxStreamingOMPThreads", "no-op on TPU", int, 16)
     microBatchSize = Param("microBatchSize", "no-op on TPU", int, 100)
     topK = Param("topK", "Voting-parallel top-K (distributed histogram vote)", int, 20)
+    parallelism = Param("parallelism", "data_parallel or voting_parallel "
+                        "(LightGBMParams.scala:25-29)", str, "data_parallel")
     isProvideTrainingMetric = Param("isProvideTrainingMetric", "Log training metrics", bool, False)
     deterministic = Param("deterministic", "Deterministic training", bool, False)
     isEnableSparse = Param("isEnableSparse", "Enable sparse optimization", bool, True)
@@ -140,6 +142,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             bin_sample_count=self.getBinSampleCount(),
             cat_smooth=self.getCatSmooth(),
             max_cat_threshold=self.getMaxCatThreshold(),
+            tree_learner=("voting" if self.getParallelism() == "voting_parallel"
+                          else "data"),
+            top_k=self.getTopK(),
         )
         for k, v in overrides.items():
             setattr(cfg, k, v)

@@ -29,6 +29,18 @@ class _AzureMapsBase(CognitiveServiceBase):
         return f"&subscription-key={key}" if key else ""
 
 
+def _coords_present(df, stage, i) -> bool:
+    """Null lat/lon rows are skipped (null output), matching the base
+    protocol's _prepare_body-returns-None convention."""
+    import numpy as np
+
+    lat = df[stage.getLatitudeCol()][i]
+    lon = df[stage.getLongitudeCol()][i]
+    def ok(v):
+        return v is not None and not (isinstance(v, float) and np.isnan(v))
+    return ok(lat) and ok(lon)
+
+
 class AddressGeocoder(_AzureMapsBase):
     """Address → coordinates (reference Geocoders.scala AddressGeocoder)."""
 
@@ -73,7 +85,7 @@ class ReverseAddressGeocoder(_AzureMapsBase):
                 + self._key_query(df, i))
 
     def _prepare_body(self, df, i):
-        return b""
+        return b"" if _coords_present(df, self, i) else None
 
     def _parse_response(self, parsed, df, i):
         try:
@@ -105,7 +117,9 @@ class CheckPointInPolygon(_AzureMapsBase):
                 f"&lat={lat}&lon={lon}" + self._key_query(df, i))
 
     def _prepare_body(self, df, i):
-        return b""
+        if not self._resolve("userDataIdentifier", df, i):
+            raise ValueError("CheckPointInPolygon: userDataIdentifier not set")
+        return b"" if _coords_present(df, self, i) else None
 
     def _parse_response(self, parsed, df, i):
         try:

@@ -1,0 +1,84 @@
+"""Data-balance measure tests (reference: exploratory module suites —
+known-value checks on small synthetic cohorts)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.exploratory import (AggregateBalanceMeasure,
+                                       DistributionBalanceMeasure,
+                                       FeatureBalanceMeasure)
+
+
+def _cohort():
+    # gender: 6 M (4 positive), 4 F (1 positive) — a visible parity gap
+    gender = np.array(["M"] * 6 + ["F"] * 4, object)
+    label = np.array([1, 1, 1, 1, 0, 0, 1, 0, 0, 0], np.float64)
+    return Table({"gender": gender, "label": label})
+
+
+class TestFeatureBalance:
+    def test_dp_gap(self):
+        out = FeatureBalanceMeasure(sensitiveCols=["gender"],
+                                    labelCol="label").transform(_cohort())
+        assert out.num_rows == 1
+        row = {c: out[c][0] for c in out.columns}
+        assert {"FeatureName", "ClassA", "ClassB", "dp"} <= set(out.columns)
+        # dp(M) = P(pos|M) = 4/6; dp(F) = 1/4 -> gap depends on pair order
+        got = abs(row["dp"])
+        assert got == pytest.approx(abs(4 / 6 - 1 / 4), abs=1e-9)
+
+    def test_balanced_feature_has_zero_gaps(self):
+        df = Table({"g": np.array(["A", "A", "B", "B"], object),
+                    "label": np.array([1.0, 0.0, 1.0, 0.0])})
+        out = FeatureBalanceMeasure(sensitiveCols=["g"],
+                                    labelCol="label").transform(df)
+        assert abs(out["dp"][0]) < 1e-9
+        assert abs(out["ji"][0]) < 1e-9
+
+
+class TestDistributionBalance:
+    def test_uniform_reference(self):
+        df = Table({"g": np.array(["A"] * 8 + ["B"] * 2, object)})
+        out = DistributionBalanceMeasure(sensitiveCols=["g"]).transform(df)
+        row = {c: out[c][0] for c in out.columns}
+        # observed [.8, .2] vs uniform [.5, .5]
+        assert row["total_variation_dist"] == pytest.approx(0.3)
+        assert row["inf_norm_dist"] == pytest.approx(0.3)
+        assert row["kl_divergence"] > 0
+        assert 0 <= row["chi_sq_p_value"] <= 1
+
+    def test_perfectly_uniform_is_zero(self):
+        df = Table({"g": np.array(["A", "B", "C", "A", "B", "C"], object)})
+        out = DistributionBalanceMeasure(sensitiveCols=["g"]).transform(df)
+        assert out["kl_divergence"][0] == pytest.approx(0.0, abs=1e-9)
+        assert out["js_dist"][0] == pytest.approx(0.0, abs=1e-6)
+
+    def test_custom_reference(self):
+        df = Table({"g": np.array(["A"] * 8 + ["B"] * 2, object)})
+        out = DistributionBalanceMeasure(
+            sensitiveCols=["g"],
+            referenceDistribution=[{"A": 0.8, "B": 0.2}]).transform(df)
+        assert out["kl_divergence"][0] == pytest.approx(0.0, abs=1e-9)
+
+    def test_chi2_sf_sanity(self):
+        from synapseml_tpu.exploratory.balance import _chi2_sf
+
+        # chi2 sf(3.84, 1) ~ 0.05; sf(0, k) = 1
+        assert _chi2_sf(3.841, 1) == pytest.approx(0.05, abs=0.002)
+        assert _chi2_sf(0.0, 3) == 1.0
+
+
+class TestAggregateBalance:
+    def test_uniform_is_perfectly_equal(self):
+        df = Table({"g": np.array(["A", "B", "C", "D"] * 5, object)})
+        out = AggregateBalanceMeasure(sensitiveCols=["g"]).transform(df)
+        assert out["atkinson_index"][0] == pytest.approx(0.0, abs=1e-9)
+        assert out["theil_t_index"][0] == pytest.approx(0.0, abs=1e-9)
+        assert out["theil_l_index"][0] == pytest.approx(0.0, abs=1e-9)
+
+    def test_skewed_is_unequal(self):
+        df = Table({"g": np.array(["A"] * 19 + ["B"], object)})
+        out = AggregateBalanceMeasure(sensitiveCols=["g"]).transform(df)
+        assert out["atkinson_index"][0] > 0.1
+        assert out["theil_t_index"][0] > 0.1

@@ -466,6 +466,20 @@ def _registry():
                                  labelCol="label"), tab,
                    skip_serialization=True))
 
+    # --- exploratory -----------------------------------------------------
+    from synapseml_tpu.exploratory import (AggregateBalanceMeasure,
+                                           DistributionBalanceMeasure,
+                                           FeatureBalanceMeasure)
+    cohort = Table({"gender": np.array(["M"] * 6 + ["F"] * 4, object),
+                    "label": np.array([1, 1, 1, 1, 0, 0, 1, 0, 0, 0],
+                                      np.float64)})
+    add(TestObject(FeatureBalanceMeasure(sensitiveCols=["gender"],
+                                         labelCol="label"), None, cohort))
+    add(TestObject(DistributionBalanceMeasure(sensitiveCols=["gender"]),
+                   None, cohort))
+    add(TestObject(AggregateBalanceMeasure(sensitiveCols=["gender"]),
+                   None, cohort))
+
     # --- pipeline --------------------------------------------------------
     add(TestObject(Pipeline(stages=[DropColumns(cols=["text"]),
                                     LightGBMClassifier(numIterations=3)]),

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# CI entrypoint (the reference's pipeline.yaml Style + UnitTests analog):
+#   lint (syntax/compile check) -> native build -> unit tests on a virtual
+#   8-device CPU mesh (the local[*] analog, SURVEY.md §4).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== lint: compileall =="
+python -m compileall -q synapseml_tpu tests bench.py __graft_entry__.py
+
+echo "== native build =="
+make -C synapseml_tpu/native
+
+echo "== unit tests (8-device CPU mesh) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -x -q
+
+echo "CI OK"

@@ -1,0 +1,29 @@
+"""Build hook: compile the native host library into the wheel.
+
+The reference packages its native engines inside the jar and extracts them at
+runtime (core/.../core/env/NativeLoader.java); here the C++ host helpers
+(synapseml_tpu/native/src/synapseml_native.cpp — batch murmur3 feature
+hashing) are compiled at build time and shipped as package data. The runtime
+loader (synapseml_tpu/native/__init__.py) falls back to pure Python when no
+compiler or .so is available, so the wheel works everywhere."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        native_dir = Path(__file__).parent / "synapseml_tpu" / "native"
+        try:
+            subprocess.run(["make", "-C", str(native_dir)], check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"warning: native build skipped ({e}); "
+                  "pure-Python fallback will be used", file=sys.stderr)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

@@ -83,7 +83,9 @@ def _did_params(stage) -> dict:
 
 
 class _SyntheticParams(_DiDParams):
-    lambda_ = Param("lambda_", "L2 regularization for the weight solve",
+    lambda_ = Param("lambda_", "L2 regularization for the weight solve, "
+                    "applied as given (un-scaled) like the reference's "
+                    "fitUnitWeights; SDID's rule-of-thumb passes zeta^2*T_pre",
                     float, 0.0)
     maxIter = Param("maxIter", "mirror-descent iterations", int, 200)
     numIterNoChange = Param("numIterNoChange", "early-stop patience", int, 25)
@@ -152,8 +154,11 @@ class SyntheticDiffInDiffEstimator(Estimator, _SyntheticParams):
         A_u = ctrl[:, pre].T
         b_u = Y[treated][:, pre].mean(axis=0)
         zeta = self._zeta(Y, post, treated)
+        # regularization = zeta^2 * T_pre, passed unscaled to the solver
+        # (SyntheticEstimator.scala:111-115 fitUnitWeights)
         w_u, _ = constrained_least_squares(
-            A_u, b_u, zeta, fit_intercept=True, max_iter=self.getMaxIter(),
+            A_u, b_u, zeta ** 2 * float(pre.sum()), fit_intercept=True,
+            max_iter=self.getMaxIter(),
             num_iter_no_change=self.getNumIterNoChange(),
             tol=self.getEpsilon())
         # time weights: control pre periods -> control post mean
@@ -183,7 +188,8 @@ class SyntheticDiffInDiffEstimator(Estimator, _SyntheticParams):
         # the sd of first differences of CONTROL units' pre-period outcomes
         diffs = np.diff(Y[~treated][:, ~post], axis=1)
         n_tr_post = float(treated.sum() * post.sum())
-        return float(n_tr_post ** 0.25 * diffs.std())
+        # sample std (ddof=1) to match the reference's stddev_samp
+        return float(n_tr_post ** 0.25 * diffs.std(ddof=1))
 
 
 def _weighted_did(Y, treated, post, unit_w, time_w):
@@ -192,8 +198,10 @@ def _weighted_did(Y, treated, post, unit_w, time_w):
     t_ind = np.repeat(treated.astype(np.float64), T)
     p_ind = np.tile(post.astype(np.float64), U)
     y = Y.ravel()
-    w = np.repeat(unit_w, T) * np.tile(time_w, U)
+    # epsilon added to every weight so all panel cells stay in the regression
+    # (reference SyntheticDiffInDiffEstimator keeps all rows via coalesce + eps,
+    # which matches its degrees of freedom / standard errors)
+    w = np.repeat(unit_w, T) * np.tile(time_w, U) + 1e-10
     X = np.stack([t_ind * p_ind, t_ind, p_ind], axis=1)
-    keep = w > 0
-    beta, se = linear_regression_with_se(X[keep], y[keep], weights=w[keep])
+    beta, se = linear_regression_with_se(X, y, weights=w)
     return float(beta[0]), float(se[0])

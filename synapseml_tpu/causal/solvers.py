@@ -46,8 +46,10 @@ def constrained_least_squares(A: np.ndarray, b: np.ndarray,
                               max_iter: int = 200,
                               num_iter_no_change: Optional[int] = None,
                               tol: float = 1e-8) -> Tuple[np.ndarray, float]:
-    """``min_w ‖A w − b‖² + λn‖w‖²  s.t. w in simplex`` via exponentiated
+    """``min_w ‖A w − b‖² + λ‖w‖²  s.t. w in simplex`` via exponentiated
     gradient (mirror descent with entropy mirror map). Returns (w, intercept).
+    ``lambda_`` is applied as given — callers pre-scale (SDID passes
+    zeta² · T_pre, matching the reference's fitUnitWeights).
 
     Reference: causal/opt/ConstrainedLeastSquare.scala (step-size line search +
     numIterNoChange early stop) built on MirrorDescent.scala. The jitted
@@ -63,7 +65,10 @@ def constrained_least_squares(A: np.ndarray, b: np.ndarray,
     patience = max_iter if num_iter_no_change is None else int(num_iter_no_change)
 
     def _solve(Aj, bj):
-        lam = jnp.float32(lambda_ * n)
+        # lambda_ is applied as-is (callers pre-scale, e.g. SDID passes
+        # zeta^2 * T_pre — reference SyntheticEstimator.scala:111-115 passes the
+        # scaled value unchanged into the solver)
+        lam = jnp.float32(lambda_)
 
         def loss_and_intercept(w):
             r = Aj @ w - bj

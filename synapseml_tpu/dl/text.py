@@ -157,13 +157,14 @@ class DeepTextClassifier(Estimator, HasLabelCol, HasPredictionCol):
         rng = jax.random.PRNGKey(self.getSeed())
 
         @jax.jit
-        def step(params, opt_state, ids_b, attn_b, y_b, key):
+        def step(params, opt_state, ids_b, attn_b, y_b, w_b, key):
             def loss_fn(p):
                 logits = hf(input_ids=ids_b, attention_mask=attn_b, params=p,
                             dropout_rng=key, train=True).logits
                 onehot = jax.nn.one_hot(y_b, logits.shape[-1])
-                return -jnp.mean(jnp.sum(
-                    jax.nn.log_softmax(logits) * onehot, axis=-1))
+                nll = -jnp.sum(jax.nn.log_softmax(logits) * onehot, axis=-1)
+                # w_b masks out pad rows of a trailing partial batch
+                return jnp.sum(nll * w_b) / jnp.maximum(jnp.sum(w_b), 1.0)
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = opt.update(grads, opt_state, params)
@@ -173,13 +174,22 @@ class DeepTextClassifier(Estimator, HasLabelCol, HasPredictionCol):
         bs = min(self.getBatchSize(), n)  # small datasets train on all rows
         order_rng = np.random.default_rng(self.getSeed())
         loss = None
+        ones = np.ones(bs, np.float32)
         for epoch in range(self.getMaxEpochs()):
             order = order_rng.permutation(n)
-            for s in range(0, n - bs + 1, bs):
+            for s in range(0, n, bs):
                 sel = order[s:s + bs]
+                w_b = ones
+                if len(sel) < bs:
+                    # pad the trailing partial batch (keeps one jit shape) and
+                    # zero-weight the pad rows so every row trains each epoch
+                    w_b = np.zeros(bs, np.float32)
+                    w_b[: len(sel)] = 1.0
+                    sel = np.concatenate([sel, order[: bs - len(sel)]])
                 rng, key = jax.random.split(rng)
                 params, opt_state, loss = step(
-                    params, opt_state, ids[sel], attn[sel], labels[sel], key)
+                    params, opt_state, ids[sel], attn[sel], labels[sel], w_b,
+                    key)
             self._log_base("epoch", {"epoch": epoch,
                                      "loss": float(loss) if loss is not None
                                      else None})

@@ -1,28 +1,40 @@
-"""Benchmark: GBDT training throughput on one chip.
+"""Benchmarks: the reference's headline workloads on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line for the primary metric (GBDT training throughput —
+the driver contract: {"metric", "value", "unit", "vs_baseline"}), with the
+other headline workloads (BASELINE.md: ResNet-50 fine-tune imgs/sec/chip,
+ONNX ResNet-50 batch inference, serving latency) embedded under "extras" in
+the same line. `python bench.py --all` (or BENCH_ALL=1) runs every workload;
+the default runs GBDT plus whatever fits in a soft time budget.
 
-Config mirrors the HIGGS-style headline workload (BASELINE.md: "LightGBM HIGGS
-rows/sec/chip"): dense float features, binary objective, 31 leaves, 255 bins.
-Throughput metric = training row-iterations/sec = rows × boosting iterations /
-wall time (excludes binning + compile; steady-state training loop only), the
-same accounting LightGBM uses for its parallel-experiment speedups.
-
-``vs_baseline``: the reference publishes no absolute numbers
-(BASELINE.json published: {}), so the denominator is a documented estimate of
-single-node multicore LightGBM C++ on this config (~4e6 row-iters/sec on a
-modern 16-core host for 1M×28 HIGGS-like data) — beating 1.0 means beating the
-reference's engine on its own headline metric per chip.
+Baselines (the reference publishes no absolute numbers — BASELINE.json
+published: {}; these are documented estimates of the systems the reference
+actually runs on):
+  * GBDT: single-node multicore LightGBM C++ on HIGGS-shape data
+    (~4e6 row-iterations/s on a modern 16-core host; LightGBM's own
+    parallel-learning experiments' accounting).
+  * ResNet-50 fine-tune: ~400 imgs/sec — published V100-class single-GPU
+    mixed-precision training throughput (the reference's DeepVisionClassifier
+    runs Horovod on such GPUs).
+  * ONNX ResNet-50 batch inference: ~1000 imgs/sec — V100-class
+    onnxruntime-gpu throughput (ONNXModel.scala's backend).
+  * Serving: the reference claims "sub-millisecond" (README.md) — baseline
+    p50 = 1 ms.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
-BASELINE_ROW_ITERS_PER_SEC = 4.0e6
+BASELINE_GBDT_ROW_ITERS = 4.0e6
+BASELINE_RESNET_IMGS_SEC = 400.0
+BASELINE_ONNX_IMGS_SEC = 1000.0
+BASELINE_SERVING_P50_MS = 1.0
 
 N_ROWS = 500_000
 N_FEATURES = 28
@@ -30,7 +42,11 @@ WARMUP_ITERS = 3
 TIMED_ITERS = 25
 
 
-def main():
+def bench_gbdt():
+    """Training row-iterations/sec = rows x boosting iterations / wall time
+    (steady-state loop, binning + compile excluded) — the same accounting
+    LightGBM uses for its parallel experiments. HIGGS-style config: dense
+    floats, binary objective, 31 leaves, 255 bins."""
     import jax
 
     from synapseml_tpu.gbdt import BoosterConfig, train_booster
@@ -49,13 +65,161 @@ def main():
     jax.block_until_ready(booster.trees[-1].leaf_value)
     dt = time.perf_counter() - t0
 
-    row_iters_per_sec = N_ROWS * TIMED_ITERS / dt
-    print(json.dumps({
-        "metric": "gbdt_train_row_iters_per_sec_per_chip",
-        "value": round(row_iters_per_sec, 1),
-        "unit": "row-iterations/sec/chip",
-        "vs_baseline": round(row_iters_per_sec / BASELINE_ROW_ITERS_PER_SEC, 3),
-    }))
+    v = N_ROWS * TIMED_ITERS / dt
+    return {"metric": "gbdt_train_row_iters_per_sec_per_chip",
+            "value": round(v, 1), "unit": "row-iterations/sec/chip",
+            "vs_baseline": round(v / BASELINE_GBDT_ROW_ITERS, 3)}
+
+
+def bench_resnet50_train(batch=32, image=224, warmup=2, steps=8):
+    """ResNet-50 fine-tune imgs/sec/chip (DeepVisionClassifier.py:31-268
+    parity workload: CIFAR-class labels, 224x224 inputs, bf16 compute)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from synapseml_tpu.dl.backbones import make_backbone
+
+    model = make_backbone("resnet50", 10, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.uniform(size=(batch, image, image, 3)),
+                       jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, size=batch))
+    variables = model.init(jax.random.PRNGKey(0), imgs[:1], train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = optax.sgd(1e-2, momentum=0.9)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, batch_stats, opt_state, x, y):
+        def loss_fn(p, bs):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": bs}, x, train=True,
+                mutable=["batch_stats"])
+            oh = jax.nn.one_hot(y, 10)
+            loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(
+                logits.astype(jnp.float32)) * oh, -1))
+            return loss, mutated["batch_stats"]
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch_stats)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_bs, opt_state, loss
+
+    for _ in range(warmup):
+        params, batch_stats, opt_state, loss = step(params, batch_stats,
+                                                    opt_state, imgs, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = step(params, batch_stats,
+                                                    opt_state, imgs, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    v = batch * steps / dt
+    return {"metric": "resnet50_finetune_imgs_per_sec_per_chip",
+            "value": round(v, 1), "unit": "imgs/sec/chip",
+            "vs_baseline": round(v / BASELINE_RESNET_IMGS_SEC, 3)}
+
+
+def bench_onnx_inference(batch=64, image=224, warmup=2, steps=8):
+    """ONNX ResNet-50 batch inference imgs/sec/chip through the importer
+    (ONNXModel.scala:145-423 workload; model generated by onnx/modelgen —
+    genuine ResNet-50 graph, 175 nodes)."""
+    import jax
+
+    from synapseml_tpu.onnx.importer import OnnxFunction
+    from synapseml_tpu.onnx.modelgen import make_resnet
+
+    m = make_resnet(50, num_classes=1000, image_size=image)
+    fn = OnnxFunction(m)
+    jfn = jax.jit(fn.as_jax(["data"])[0])
+    x = np.random.default_rng(0).normal(size=(batch, 3, image, image)
+                                        ).astype(np.float32)
+    for _ in range(warmup):
+        out = jfn(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = jfn(x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    v = batch * steps / dt
+    return {"metric": "onnx_resnet50_inference_imgs_per_sec_per_chip",
+            "value": round(v, 1), "unit": "imgs/sec/chip",
+            "vs_baseline": round(v / BASELINE_ONNX_IMGS_SEC, 3)}
+
+
+def bench_serving(n_requests=200):
+    """End-to-end serving latency (accept → queue → jitted pipeline → reply;
+    io/serving.py) vs the reference's "sub-millisecond" Spark Serving claim."""
+    import json as _json
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.io.serving import ServingServer
+
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(8,)), jnp.float32)
+
+    @jax.jit
+    def pipeline(x):
+        return jnp.tanh(x @ w)
+
+    def handler(df: Table) -> Table:
+        x = jnp.asarray([v["x"] for v in df["value"]], jnp.float32)
+        out = np.asarray(pipeline(x))
+        return Table({"id": df["id"], "reply": out.astype(np.float64)})
+
+    server = ServingServer(handler, host="127.0.0.1", port=0,
+                           max_batch_size=32, max_batch_latency=0.001)
+    server.start()
+    try:
+        url = server.url
+        payload = _json.dumps({"x": [0.1] * 8}).encode()
+
+        def one():
+            req = urllib.request.Request(url, data=payload,
+                                         headers={"Content-Type":
+                                                  "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as r:
+                r.read()
+
+        for _ in range(20):
+            one()                      # warm the jit + connection path
+        lat = []
+        for _ in range(n_requests):
+            t0 = time.perf_counter()
+            one()
+            lat.append((time.perf_counter() - t0) * 1e3)
+        lat = np.sort(np.asarray(lat))
+        p50 = float(lat[len(lat) // 2])
+        p99 = float(lat[int(len(lat) * 0.99)])
+        return {"metric": "serving_latency_p50_ms", "value": round(p50, 3),
+                "unit": "ms (p99=%.3f)" % p99,
+                "vs_baseline": round(BASELINE_SERVING_P50_MS / max(p50, 1e-9), 3)}
+    finally:
+        server.stop()
+
+
+def main():
+    run_all = "--all" in sys.argv or os.environ.get("BENCH_ALL") == "1"
+    primary = bench_gbdt()
+    extras = []
+    budget_s = 1e9 if run_all else float(os.environ.get("BENCH_BUDGET_S", 900))
+    t_start = time.perf_counter()
+    for fn in (bench_resnet50_train, bench_onnx_inference, bench_serving):
+        if time.perf_counter() - t_start > budget_s:
+            break
+        try:
+            extras.append(fn())
+        except Exception as e:  # extras must never break the primary line
+            extras.append({"metric": fn.__name__, "error": str(e)[:200]})
+    out = dict(primary)
+    out["extras"] = extras
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,30 +1,42 @@
-"""Leaf-wise histogram tree grower — one jitted XLA program per tree.
+"""Leaf-wise histogram tree grower — partitioned rows + MXU histogram kernel.
 
 TPU-native redesign of the LightGBM serial/data-parallel tree learner the
 reference drives through LGBM_BoosterUpdateOneIter (reference call stack:
 booster/LightGBMBooster.scala:355-392 → C++ ConstructHistograms / FindBestSplit /
-Split loop; SURVEY.md §3.1 "the hot loop"). Design choices for XLA (SURVEY §7
-"hard parts" — dynamic shapes):
+Split loop; SURVEY.md §3.1 "the hot loop"). v2 design, shaped by TPU costs:
 
-  * The ENTIRE leaf-wise growth loop is a single ``lax.fori_loop`` with static
-    shapes: exactly ``num_leaves - 1`` iterations; once no leaf has a valid
-    split, remaining iterations no-op.
-  * Per iteration, histograms for ALL active leaves are rebuilt with one
-    scatter-add keyed by (leaf, feature, bin) (ops/histogram.py). A masked
-    single-leaf pass would read the same (N, F) bytes, so recompute-all costs
-    the same HBM traffic as LightGBM's smaller-child trick while keeping every
-    shape static — and GSPMD turns the same scatter into partial histograms +
-    one psum when rows are sharded over the ``data`` mesh axis.
+  * **Row partitioning** (LightGBM's DataPartition): rows live in a position
+    array kept sorted by leaf, each leaf owning a contiguous range. A split
+    stably partitions only its leaf's range (bucketed static sizes via
+    ``lax.switch`` — XLA needs static shapes, so ranges are processed at the
+    smallest power-of-two bucket that covers them, masked to the real range).
+  * **Histogram subtraction** (LightGBM's parent-minus-sibling): per split,
+    only the SMALLER child's histogram is built (ops/hist_kernel.py — two-level
+    one-hot matmuls on the MXU); the sibling is parent − child from the
+    per-leaf histogram cache. Total histogrammed rows per tree drop from
+    O(num_leaves·N) to O(N·log(num_leaves)/2).
+  * The ENTIRE growth loop is one ``lax.fori_loop`` with static shapes —
+    exactly ``num_leaves - 1`` iterations; when no leaf has a valid split the
+    remaining iterations no-op.
   * Leaf numbering matches LightGBM's Tree::Split: splitting leaf ``l`` at step
-    ``i`` creates internal node ``i``; the left child keeps leaf id ``l`` and the
-    right child becomes the new leaf ``i + 1``. Child pointers use the
-    ``~leaf_index`` convention, so the arrays serialize directly into the
-    LightGBM model-string format (gbdt/model_io.py).
-  * Categorical splits: bins sorted by grad/(hess + cat_smooth) per (leaf,
-    feature), prefix-scan over the sorted order, chosen prefix encoded as a
-    bitset — the LightGBM many-vs-many category algorithm, vectorized.
-  * Monotone constraints ("basic" mode): candidate child outputs compared
-    according to the per-feature constraint sign; violating splits are masked.
+    ``i`` creates internal node ``i``; the left child keeps leaf id ``l`` and
+    the right child becomes leaf ``i + 1``. Child pointers use ``~leaf_index``,
+    so the arrays serialize directly into the LightGBM model-string format
+    (gbdt/model_io.py).
+  * Categorical splits: bins sorted by grad/(hess + cat_smooth), prefix scan,
+    chosen prefix encoded as a bitset — LightGBM's many-vs-many algorithm.
+  * Monotone constraints ("basic" mode): violating splits masked.
+  * **Learned missing direction**: features with NaN carry a dedicated NaN bin
+    (ops/quantize.py); every candidate threshold is scored with the NaN bin's
+    totals routed left AND right, and the winning direction is recorded as the
+    per-split ``default_left`` bit (LightGBM missing_type=NaN semantics).
+
+Distributed data-parallel: run under ``shard_map`` with rows sharded on the
+data axis and ``axis_name`` set — each device partitions its own rows, builds
+local child histograms, and ONE ``lax.psum`` of the (F, B, 3) histogram per
+split replaces LightGBM's socket-ring reduce-scatter (NetworkManager.scala).
+Split decisions are taken from the summed histogram, so they are bitwise
+identical on every device (uniform control flow by construction).
 """
 
 from __future__ import annotations
@@ -35,10 +47,12 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
-from ..ops.histogram import leaf_histograms
+from ..ops.hist_kernel import child_histogram, features_padded, pad_bins
 
 BITS = 32  # bitset word width for categorical splits
+_CHUNK = 2048  # kernel row chunk; row counts padded to a multiple of this
 
 
 class GrowerConfig(NamedTuple):
@@ -56,7 +70,7 @@ class GrowerConfig(NamedTuple):
     max_delta_step: float = 0.0
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
-    has_categorical: bool = False  # static: traces out the categorical path entirely
+    has_categorical: bool = False  # static: traces out the categorical path
 
 
 class TreeArrays(NamedTuple):
@@ -67,6 +81,7 @@ class TreeArrays(NamedTuple):
     split_bin: jnp.ndarray       # (L-1,) i32 — bin-space threshold (left if bin <= t)
     split_gain: jnp.ndarray      # (L-1,) f32
     split_type: jnp.ndarray      # (L-1,) i32 — 0 numeric, 1 categorical
+    default_left: jnp.ndarray    # (L-1,) bool — learned NaN direction
     cat_bitset: jnp.ndarray      # (L-1, ceil(B/32)) u32 — membership → left
     left_child: jnp.ndarray      # (L-1,) i32 — >=0 internal node, ~leaf otherwise
     right_child: jnp.ndarray     # (L-1,) i32
@@ -95,7 +110,421 @@ def _leaf_output(g, h, cfg: GrowerConfig):
     return out
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+def _bucket_sizes(np_rows: int) -> list:
+    """Static power-of-two bucket sizes (multiples of _CHUNK) covering any
+    range length up to the padded row count."""
+    sizes = []
+    s = min(2 * _CHUNK, np_rows)
+    while s < np_rows:
+        sizes.append(s)
+        s *= 2
+    sizes.append(np_rows)
+    return sizes
+
+
+def _maybe_psum(x, axis_name):
+    return lax.psum(x, axis_name) if axis_name is not None else x
+
+
+# ---------------------------------------------------------------------------
+# Split finding over one leaf's histogram
+# ---------------------------------------------------------------------------
+
+def _best_for_leaf(hist, feature_active, is_categorical, monotone, nan_bins,
+                   cfg: GrowerConfig, l1, l2):
+    """hist (FP, B, 3) → (gain, feat, bin, default_left, count_left, order).
+
+    ``order`` is the categorical bin ordering (FP, B) used to rebuild the
+    winning bitset (None when the config has no categorical features).
+    """
+    FP, B, _ = hist.shape
+    totals = hist[0].sum(axis=0)                       # (3,) — feature 0 spans the leaf
+    G, H, C = totals[0], totals[1], totals[2]
+    parent_obj = _leaf_objective(G, H, l1, l2)
+
+    def scan_gains(cum, extraG=0.0, extraH=0.0, extraC=0.0):
+        GL = cum[..., 0] + extraG
+        HL = cum[..., 1] + extraH
+        CL = cum[..., 2] + extraC
+        GR, HR, CR = G - GL, H - HL, C - CL
+        gain = (_leaf_objective(GL, HL, l1, l2) + _leaf_objective(GR, HR, l1, l2)
+                - parent_obj)
+        valid = ((CL >= cfg.min_data_in_leaf) & (CR >= cfg.min_data_in_leaf)
+                 & (HL >= cfg.min_sum_hessian_in_leaf)
+                 & (HR >= cfg.min_sum_hessian_in_leaf))
+        mc = monotone[:, None]
+        vl = -GL / (HL + l2)
+        vr = -GR / (HR + l2)
+        mono_ok = jnp.where(mc == 0, True,
+                            jnp.where(mc > 0, vl <= vr, vl >= vr))
+        return jnp.where(valid & mono_ok, gain, -jnp.inf), CL
+
+    cum = jnp.cumsum(hist, axis=1)                     # (FP, B, 3)
+    # NaN-bin totals per feature (zero when the feature has no NaN bin)
+    nb = jnp.clip(nan_bins, 0, B - 1)
+    nan_tot = jnp.take_along_axis(hist, nb[:, None, None].repeat(3, axis=2),
+                                  axis=1)[:, 0, :]     # (FP, 3)
+    has_nan = (nan_bins < B)[:, None]
+    nan_tot = jnp.where(has_nan, nan_tot, 0.0)
+
+    # default-right: NaN bin sits at num_bins-1, so cum[t] for any divider
+    # t < nan_bin excludes it naturally (thresholds at/after it yield CR=0 →
+    # invalid); default-left adds the NaN totals to the left side.
+    gain_r, CL_r = scan_gains(cum)
+    gain_l, CL_l = scan_gains(cum, nan_tot[:, None, 0], nan_tot[:, None, 1],
+                              nan_tot[:, None, 2])
+    use_left = has_nan & (gain_l > gain_r)
+    gain_num = jnp.where(use_left, gain_l, gain_r)
+    CL_num = jnp.where(use_left, CL_l, CL_r)
+
+    order = None
+    if cfg.has_categorical:
+        cnt = hist[..., 2]
+        key = jnp.where(cnt > 0, hist[..., 0] / (hist[..., 1] + cfg.cat_smooth),
+                        jnp.inf)
+        order = jnp.argsort(key, axis=1)               # (FP, B)
+        hist_sorted = jnp.take_along_axis(hist, order[..., None], axis=1)
+        cum_cat = jnp.cumsum(hist_sorted, axis=1)
+        gain_cat, CL_cat = scan_gains(cum_cat)
+        kk = jnp.arange(B)[None, :]
+        nonempty = (cnt > 0).sum(axis=1)[:, None]
+        valid_k = (kk < cfg.max_cat_threshold) & (kk < nonempty)
+        gain_cat = jnp.where(valid_k, gain_cat, -jnp.inf)
+        gain = jnp.where(is_categorical[:, None], gain_cat, gain_num)
+        CLsel = jnp.where(is_categorical[:, None], CL_cat, CL_num)
+        use_left = use_left & ~is_categorical[:, None]
+    else:
+        gain = gain_num
+        CLsel = CL_num
+    gain = jnp.where(feature_active[:, None], gain, -jnp.inf)
+
+    flat = gain.reshape(FP * B)
+    best = jnp.argmax(flat)
+    best_gain = flat[best]
+    bfeat = (best // B).astype(jnp.int32)
+    bbin = (best % B).astype(jnp.int32)
+    bdl = use_left.reshape(FP * B)[best]
+    bcl = CLsel.reshape(FP * B)[best]
+    return best_gain, bfeat, bbin, bdl, bcl, order
+
+
+# ---------------------------------------------------------------------------
+# Tree growth
+# ---------------------------------------------------------------------------
+
+class _GrowState(NamedTuple):
+    pos: jnp.ndarray             # (Np,) i32: sorted position -> original row
+    gs: jnp.ndarray              # (Np,) f32 grad, sorted
+    hs: jnp.ndarray              # (Np,) f32 hess, sorted
+    ms: jnp.ndarray              # (Np,) f32 in-bag mask, sorted
+    bT: jnp.ndarray              # (FP, Np) i32 bins, sorted
+    leaf_start: jnp.ndarray      # (L,) i32
+    leaf_len: jnp.ndarray        # (L,) i32
+    hist: jnp.ndarray            # (L, FP, B, 3) f32 cache
+    bgain: jnp.ndarray           # (L,) f32 best gain per leaf
+    bfeat: jnp.ndarray           # (L,) i32
+    bbin: jnp.ndarray            # (L,) i32
+    bdl: jnp.ndarray             # (L,) bool
+    bcl: jnp.ndarray             # (L,) f32 global count-left of best split
+    depth: jnp.ndarray           # (L,) i32
+    leaf_parent: jnp.ndarray     # (L,) i32
+    leaf_is_right: jnp.ndarray   # (L,) bool
+    split_feature: jnp.ndarray
+    split_bin: jnp.ndarray
+    split_gain: jnp.ndarray
+    split_type: jnp.ndarray
+    default_left: jnp.ndarray
+    cat_bitset: jnp.ndarray
+    left_child: jnp.ndarray
+    right_child: jnp.ndarray
+    internal_value: jnp.ndarray
+    internal_count: jnp.ndarray
+    num_splits: jnp.ndarray
+
+
+def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
+                    monotone, nan_bins, cfg: GrowerConfig,
+                    axis_name: Optional[str]):
+    n, f = binned.shape
+    L = cfg.num_leaves
+    B = pad_bins(cfg.num_bins)
+    FP = features_padded(f)
+    Np = -(-n // _CHUNK) * _CHUNK
+    bw = (B + BITS - 1) // BITS
+    l1 = jnp.float32(cfg.lambda_l1)
+    l2 = jnp.float32(cfg.lambda_l2)
+    sizes = _bucket_sizes(Np)
+    sizes_arr = jnp.asarray(sizes, jnp.int32)
+
+    in_bag = jnp.asarray(in_bag, jnp.float32)
+    g0 = jnp.asarray(grad, jnp.float32) * in_bag
+    h0 = jnp.asarray(hess, jnp.float32) * in_bag
+
+    # pad row axis to Np (mask 0) and features to FP (inactive), transpose
+    pad_r = Np - n
+    bT0 = jnp.zeros((FP, Np), jnp.int32)
+    bT0 = bT0.at[:f, :n].set(binned.astype(jnp.int32).T)
+    gs0 = jnp.pad(g0, (0, pad_r))
+    hs0 = jnp.pad(h0, (0, pad_r))
+    ms0 = jnp.pad(in_bag, (0, pad_r))
+
+    featp = jnp.zeros(FP, bool).at[:f].set(feature_active)
+    catp = jnp.zeros(FP, bool).at[:f].set(is_categorical)
+    monop = jnp.zeros(FP, jnp.int32).at[:f].set(monotone)
+    nanp = jnp.full(FP, 0x7FFF, jnp.int32).at[:f].set(nan_bins)
+
+    def build_hist(bT, gs, hs, ms, child_start, child_len):
+        """Histogram of sorted rows [child_start, child_start+child_len) via
+        the bucketed kernel; psum across the data axis if present."""
+        def make_branch(size):
+            def br(args):
+                bT_, gs_, hs_, ms_, cstart, clen = args
+                cs = jnp.minimum(cstart, Np - size)
+                idx = cs + jnp.arange(size, dtype=jnp.int32)
+                mask = ((idx >= cstart) & (idx < cstart + clen)).astype(jnp.float32)
+                gsl = lax.dynamic_slice(gs_, (cs,), (size,)) * mask
+                hsl = lax.dynamic_slice(hs_, (cs,), (size,)) * mask
+                msl = lax.dynamic_slice(ms_, (cs,), (size,)) * mask
+                bsl = lax.dynamic_slice(bT_, (0, cs), (FP, size))
+                return child_histogram(bsl, gsl, hsl, msl, B)
+            return br
+
+        bidx = jnp.searchsorted(sizes_arr, child_len, side="left")
+        hist = lax.switch(jnp.minimum(bidx, len(sizes) - 1),
+                          [make_branch(s) for s in sizes],
+                          (bT, gs, hs, ms, child_start, child_len))
+        return _maybe_psum(hist, axis_name)
+
+    def best_of(hist_leaf):
+        return _best_for_leaf(hist_leaf, featp, catp, monop, nanp, cfg, l1, l2)
+
+    # ---- root ------------------------------------------------------------
+    hist_root = build_hist(bT0, gs0, hs0, ms0, jnp.int32(0), jnp.int32(Np))
+    rg, rf, rb, rdl, rcl, _ = best_of(hist_root)
+
+    z1 = lambda dt, fill=0: jnp.full((max(L - 1, 1),), fill, dt)
+    init = _GrowState(
+        pos=jnp.arange(Np, dtype=jnp.int32),
+        gs=gs0, hs=hs0, ms=ms0, bT=bT0,
+        leaf_start=jnp.zeros(L, jnp.int32),
+        leaf_len=jnp.zeros(L, jnp.int32).at[0].set(Np),
+        hist=jnp.zeros((L, FP, B, 3), jnp.float32).at[0].set(hist_root),
+        bgain=jnp.full(L, -jnp.inf, jnp.float32).at[0].set(rg),
+        bfeat=jnp.zeros(L, jnp.int32).at[0].set(rf),
+        bbin=jnp.zeros(L, jnp.int32).at[0].set(rb),
+        bdl=jnp.zeros(L, bool).at[0].set(rdl),
+        bcl=jnp.zeros(L, jnp.float32).at[0].set(rcl),
+        depth=jnp.zeros(L, jnp.int32),
+        leaf_parent=jnp.full(L, -1, jnp.int32),
+        leaf_is_right=jnp.zeros(L, bool),
+        split_feature=z1(jnp.int32),
+        split_bin=z1(jnp.int32, B - 1),
+        split_gain=z1(jnp.float32),
+        split_type=z1(jnp.int32),
+        default_left=jnp.zeros((max(L - 1, 1),), bool),
+        cat_bitset=jnp.zeros((max(L - 1, 1), bw), jnp.uint32),
+        left_child=z1(jnp.int32, ~0),
+        right_child=z1(jnp.int32, ~0),
+        internal_value=z1(jnp.float32),
+        internal_count=z1(jnp.int32),
+        num_splits=jnp.zeros((), jnp.int32),
+    )
+
+    def partition(pos, gs, hs, ms, bT, start, length, fsel, bsel, dl, bitset,
+                  cat_split, nanbin_f):
+        """Stably partition the leaf's range by the split; returns updated
+        sorted arrays and the LOCAL left-child row count."""
+        def make_branch(size):
+            def br(args):
+                pos_, gs_, hs_, ms_, bT_ = args
+                cs = jnp.minimum(start, Np - size)
+                idx = cs + jnp.arange(size, dtype=jnp.int32)
+                binrow = lax.dynamic_slice(bT_, (fsel, cs), (1, size))[0]
+                gr = binrow > bsel
+                gr = jnp.where(binrow == nanbin_f, ~dl, gr)
+                if cfg.has_categorical:
+                    w = bitset[jnp.clip(binrow >> 5, 0, bw - 1)]
+                    member = ((w >> (binrow & 31).astype(jnp.uint32)) & 1
+                              ).astype(bool)
+                    gr = jnp.where(cat_split, ~member, gr)
+                key = jnp.where(idx < start, -1,
+                                jnp.where(idx >= start + length, 2,
+                                          gr.astype(jnp.int32)))
+                src = jnp.argsort(key, stable=True).astype(jnp.int32)
+                nl_loc = jnp.sum(key == 0).astype(jnp.int32)
+
+                def perm1(a):
+                    sl = lax.dynamic_slice(a, (cs,), (size,))
+                    return lax.dynamic_update_slice(a, sl[src], (cs,))
+
+                blk = lax.dynamic_slice(bT_, (0, cs), (FP, size))
+                bT2 = lax.dynamic_update_slice(bT_, blk[:, src], (0, cs))
+                return perm1(pos_), perm1(gs_), perm1(hs_), perm1(ms_), bT2, nl_loc
+            return br
+
+        bidx = jnp.searchsorted(sizes_arr, length, side="left")
+        return lax.switch(jnp.minimum(bidx, len(sizes) - 1),
+                          [make_branch(s) for s in sizes],
+                          (pos, gs, hs, ms, bT))
+
+    def body(i, s: _GrowState):
+        leaf_ids = jnp.arange(L)
+        active = leaf_ids <= s.num_splits
+        if cfg.max_depth > 0:
+            active &= s.depth < cfg.max_depth
+        masked_gain = jnp.where(active, s.bgain, -jnp.inf)
+        l = jnp.argmax(masked_gain).astype(jnp.int32)
+        do = masked_gain[l] > cfg.min_gain_to_split
+
+        def step(s: _GrowState) -> _GrowState:
+            gain_l = s.bgain[l]
+            fsel = s.bfeat[l]
+            bsel = s.bbin[l]
+            dl = s.bdl[l]
+            start = s.leaf_start[l]
+            length = s.leaf_len[l]
+            hist_parent = s.hist[l]                     # (FP, B, 3)
+            totals = hist_parent[0].sum(axis=0)
+            G_l, H_l, C_l = totals[0], totals[1], totals[2]
+
+            # categorical bitset of the winning split, rebuilt from the cache
+            if cfg.has_categorical:
+                histf = hist_parent[fsel]               # (B, 3)
+                keyc = jnp.where(histf[:, 2] > 0,
+                                 histf[:, 0] / (histf[:, 1] + cfg.cat_smooth),
+                                 jnp.inf)
+                order_f = jnp.argsort(keyc)
+                take = jnp.arange(B) <= bsel
+                bwords = (order_f >> 5).astype(jnp.int32)
+                bvals = jnp.uint32(1) << (order_f & 31).astype(jnp.uint32)
+                bitset = jnp.zeros((bw,), jnp.uint32).at[bwords].add(
+                    jnp.where(take, bvals, jnp.uint32(0)))
+                cat_split = catp[fsel]
+            else:
+                bitset = jnp.zeros((bw,), jnp.uint32)
+                cat_split = jnp.zeros((), bool)
+
+            pos2, gs2, hs2, ms2, bT2, nl_loc = partition(
+                s.pos, s.gs, s.hs, s.ms, s.bT, start, length, fsel, bsel, dl,
+                bitset, cat_split, nanp[fsel])
+
+            # global child counts decide which side is built (uniform across
+            # devices — bcl comes from the summed histogram)
+            cl_glob = s.bcl[l]
+            left_small = cl_glob * 2.0 <= C_l
+            child_start = jnp.where(left_small, start, start + nl_loc)
+            child_len = jnp.where(left_small, nl_loc, length - nl_loc)
+            hist_small = build_hist(bT2, gs2, hs2, ms2, child_start, child_len)
+            hist_left = jnp.where(left_small, hist_small,
+                                  hist_parent - hist_small)
+            hist_right = hist_parent - hist_left
+
+            # re-evaluate best splits for the two children
+            bg2, bf2, bb2, bdl2, bcl2, _ = jax.vmap(best_of)(
+                jnp.stack([hist_left, hist_right]))
+
+            new_right = s.num_splits + 1                # leaf id of right child
+            i_node = s.num_splits                       # internal node id
+
+            def setw(arr, idx, val):
+                return arr.at[idx].set(val)
+
+            parent_out = _leaf_output(G_l, H_l, cfg) * cfg.learning_rate
+            p = s.leaf_parent[l]
+            p_idx = jnp.maximum(p, 0)
+            lc = s.left_child.at[p_idx].set(
+                jnp.where((p >= 0) & ~s.leaf_is_right[l], i_node,
+                          s.left_child[p_idx]))
+            rc = s.right_child.at[p_idx].set(
+                jnp.where((p >= 0) & s.leaf_is_right[l], i_node,
+                          s.right_child[p_idx]))
+            lc = lc.at[i_node].set(~l)
+            rc = rc.at[i_node].set(~new_right)
+
+            return s._replace(
+                pos=pos2, gs=gs2, hs=hs2, ms=ms2, bT=bT2,
+                leaf_start=s.leaf_start.at[l].set(start)
+                                       .at[new_right].set(start + nl_loc),
+                leaf_len=s.leaf_len.at[l].set(nl_loc)
+                                    .at[new_right].set(length - nl_loc),
+                hist=s.hist.at[l].set(hist_left).at[new_right].set(hist_right),
+                bgain=s.bgain.at[l].set(bg2[0]).at[new_right].set(bg2[1]),
+                bfeat=s.bfeat.at[l].set(bf2[0]).at[new_right].set(bf2[1]),
+                bbin=s.bbin.at[l].set(bb2[0]).at[new_right].set(bb2[1]),
+                bdl=s.bdl.at[l].set(bdl2[0]).at[new_right].set(bdl2[1]),
+                bcl=s.bcl.at[l].set(bcl2[0]).at[new_right].set(bcl2[1]),
+                depth=s.depth.at[l].add(1)
+                            .at[new_right].set(s.depth[l] + 1),
+                leaf_parent=s.leaf_parent.at[l].set(i_node)
+                                        .at[new_right].set(i_node),
+                leaf_is_right=s.leaf_is_right.at[l].set(False)
+                                             .at[new_right].set(True),
+                split_feature=setw(s.split_feature, i_node, fsel),
+                split_bin=setw(s.split_bin, i_node, bsel),
+                split_gain=setw(s.split_gain, i_node, gain_l),
+                split_type=setw(s.split_type, i_node,
+                                cat_split.astype(jnp.int32)),
+                default_left=setw(s.default_left, i_node, dl),
+                cat_bitset=s.cat_bitset.at[i_node].set(bitset),
+                left_child=lc,
+                right_child=rc,
+                internal_value=setw(s.internal_value, i_node, parent_out),
+                internal_count=setw(s.internal_count, i_node,
+                                    C_l.astype(jnp.int32)),
+                num_splits=s.num_splits + 1,
+            )
+
+        return lax.cond(do, step, lambda s: s, s)
+
+    s = lax.fori_loop(0, L - 1, body, init) if L > 1 else init
+
+    # ---- leaf stats from the per-leaf histogram cache ---------------------
+    # (per-leaf f32 accumulation — a global prefix-sum difference would
+    # catastrophically cancel for small leaves on large N; the cache is
+    # already psum'd across devices)
+    leaf_tot = s.hist[:, 0].sum(axis=1)                  # (L, 3)
+    sumG, sumH, sumC = leaf_tot[:, 0], leaf_tot[:, 1], leaf_tot[:, 2]
+    leaf_value = _leaf_output(sumG, sumH, cfg) * cfg.learning_rate
+    exists = jnp.arange(L) <= s.num_splits
+    leaf_value = jnp.where(exists, leaf_value, 0.0)
+
+    # ---- per-row final leaf (original order) ------------------------------
+    # scatter leaf ids at range starts, fill forward via cumulative max of
+    # (position * L + id), then undo the sort with one scatter through pos.
+    # Zero-length local ranges are excluded: they share a start position with
+    # their sibling and the scatter collision would mislabel the sibling's rows
+    own_rows = exists & (s.leaf_len > 0)
+    markers = jnp.full(Np, -1, jnp.int32).at[
+        jnp.where(own_rows, s.leaf_start, Np)].set(
+            jnp.arange(L, dtype=jnp.int32), mode="drop")
+    # fill-forward by cummax over marker POSITIONS (no Np*L encoding — that
+    # would overflow int32 at HIGGS-scale Np), then gather the marker ids
+    last_pos = lax.associative_scan(
+        jnp.maximum,
+        jnp.where(markers >= 0, jnp.arange(Np, dtype=jnp.int32), -1))
+    node_sorted = markers[jnp.maximum(last_pos, 0)]
+    node_of_row = jnp.zeros(Np, jnp.int32).at[s.pos].set(node_sorted)[:n]
+
+    tree = TreeArrays(
+        split_feature=s.split_feature,
+        split_bin=s.split_bin,
+        split_gain=s.split_gain,
+        split_type=s.split_type,
+        default_left=s.default_left,
+        cat_bitset=s.cat_bitset,
+        left_child=s.left_child,
+        right_child=s.right_child,
+        internal_value=s.internal_value,
+        internal_count=s.internal_count,
+        leaf_value=leaf_value,
+        leaf_weight=sumH,
+        leaf_count=sumC.astype(jnp.int32),
+        num_splits=s.num_splits,
+    )
+    return tree, node_of_row
+
+
+@partial(jax.jit, static_argnames=("cfg", "axis_name"))
 def grow_tree(
     binned: jnp.ndarray,         # (N, F) uint8/uint16 bin ids
     grad: jnp.ndarray,           # (N,) f32 — pre-weighted (instance weight / GOSS amp)
@@ -105,203 +534,16 @@ def grow_tree(
     is_categorical: jnp.ndarray, # (F,) bool
     monotone: jnp.ndarray,       # (F,) i32 in {-1, 0, +1}
     cfg: GrowerConfig,
+    nan_bins: Optional[jnp.ndarray] = None,  # (F,) i32 NaN bin per feature
+    axis_name: Optional[str] = None,         # shard_map data axis for psum
 ) -> tuple:
-    """Grow one tree; returns (TreeArrays, node_of_row) where node_of_row is each
-    row's final leaf index (used for the O(1) training-score update)."""
+    """Grow one tree; returns (TreeArrays, node_of_row) where node_of_row is
+    each row's final leaf index (used for the O(1) training-score update)."""
     n, f = binned.shape
-    L, B = cfg.num_leaves, cfg.num_bins
-    bw = (B + BITS - 1) // BITS
-    g = jnp.asarray(grad, jnp.float32) * in_bag
-    h = jnp.asarray(hess, jnp.float32) * in_bag
-
-    l1 = jnp.float32(cfg.lambda_l1)
-    l2 = jnp.float32(cfg.lambda_l2)
-
-    def best_splits(hist):
-        """Per-leaf best split over all (feature, bin)/(feature, prefix).
-        hist: (L, F, B, 3) → gain (L,), feat (L,), bin (L,), plus totals."""
-        totals = hist[:, 0, :, :].sum(axis=1)                    # (L, 3) — feature 0 partitions the leaf
-        G, H, C = totals[:, 0], totals[:, 1], totals[:, 2]
-        parent_obj = _leaf_objective(G, H, l1, l2)                # (L,)
-
-        def scan_gains(cum):
-            GL, HL, CL = cum[..., 0], cum[..., 1], cum[..., 2]
-            GR = G[:, None, None] - GL
-            HR = H[:, None, None] - HL
-            CR = C[:, None, None] - CL
-            gain = (_leaf_objective(GL, HL, l1, l2) + _leaf_objective(GR, HR, l1, l2)
-                    - parent_obj[:, None, None])
-            valid = ((CL >= cfg.min_data_in_leaf) & (CR >= cfg.min_data_in_leaf)
-                     & (HL >= cfg.min_sum_hessian_in_leaf)
-                     & (HR >= cfg.min_sum_hessian_in_leaf))
-            return gain, valid, (GL, HL, GR, HR)
-
-        # numeric: natural bin order
-        cum_num = jnp.cumsum(hist, axis=2)
-        gain_num, valid_num, (GL, HL, GR, HR) = scan_gains(cum_num)
-        mc = monotone[None, :, None]
-        vl = -GL / (HL + l2)
-        vr = -GR / (HR + l2)
-        mono_ok = jnp.where(mc == 0, True,
-                            jnp.where(mc > 0, vl <= vr, vl >= vr))
-        gain_num = jnp.where(valid_num & mono_ok, gain_num, -jnp.inf)
-
-        if cfg.has_categorical:
-            # categorical: sort bins by G/(H + cat_smooth), empty bins last
-            cnt = hist[..., 2]
-            key = jnp.where(cnt > 0, hist[..., 0] / (hist[..., 1] + cfg.cat_smooth), jnp.inf)
-            order = jnp.argsort(key, axis=2)                     # (L, F, B)
-            hist_sorted = jnp.take_along_axis(hist, order[..., None], axis=2)
-            cum_cat = jnp.cumsum(hist_sorted, axis=2)
-            gain_cat, valid_cat, _ = scan_gains(cum_cat)
-            k = jnp.arange(B)[None, None, :]
-            nonempty = (cnt > 0).sum(axis=2)[:, :, None]
-            valid_k = (k < cfg.max_cat_threshold) & (k < nonempty)
-            gain_cat = jnp.where(valid_cat & valid_k, gain_cat, -jnp.inf)
-            gain = jnp.where(is_categorical[None, :, None], gain_cat, gain_num)
-        else:
-            order = None
-            gain = gain_num
-        gain = jnp.where(feature_active[None, :, None], gain, -jnp.inf)
-
-        flat = gain.reshape(L, f * B)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        return best_gain, (best // B).astype(jnp.int32), (best % B).astype(jnp.int32), order, totals
-
-    neg1 = -jnp.ones((), jnp.int32)
-
-    class S(NamedTuple):
-        node_of_row: jnp.ndarray
-        depth: jnp.ndarray
-        leaf_parent: jnp.ndarray
-        leaf_is_right: jnp.ndarray
-        split_feature: jnp.ndarray
-        split_bin: jnp.ndarray
-        split_gain: jnp.ndarray
-        split_type: jnp.ndarray
-        cat_bitset: jnp.ndarray
-        left_child: jnp.ndarray
-        right_child: jnp.ndarray
-        internal_value: jnp.ndarray
-        internal_count: jnp.ndarray
-        num_splits: jnp.ndarray
-
-    init = S(
-        node_of_row=jnp.zeros((n,), jnp.int32),
-        depth=jnp.zeros((L,), jnp.int32),
-        leaf_parent=jnp.full((L,), -1, jnp.int32),
-        leaf_is_right=jnp.zeros((L,), bool),
-        split_feature=jnp.zeros((max(L - 1, 1),), jnp.int32),
-        split_bin=jnp.full((max(L - 1, 1),), B - 1, jnp.int32),
-        split_gain=jnp.zeros((max(L - 1, 1),), jnp.float32),
-        split_type=jnp.zeros((max(L - 1, 1),), jnp.int32),
-        cat_bitset=jnp.zeros((max(L - 1, 1), bw), jnp.uint32),
-        left_child=jnp.full((max(L - 1, 1),), ~0, jnp.int32),
-        right_child=jnp.full((max(L - 1, 1),), ~0, jnp.int32),
-        internal_value=jnp.zeros((max(L - 1, 1),), jnp.float32),
-        internal_count=jnp.zeros((max(L - 1, 1),), jnp.int32),
-        num_splits=jnp.zeros((), jnp.int32),
-    )
-
-    def body(i, s: S):
-        hist = leaf_histograms(binned, jnp.where(in_bag > 0, s.node_of_row, -1),
-                               g, h, L, B)
-        best_gain, best_feat, best_bin, order, totals = best_splits(hist)
-
-        leaf_ids = jnp.arange(L)
-        active = leaf_ids <= i
-        if cfg.max_depth > 0:
-            active &= s.depth < cfg.max_depth
-        # a leaf is only splittable if it was actually created (i.e. <= num_splits)
-        active &= leaf_ids <= s.num_splits
-        masked_gain = jnp.where(active, best_gain, -jnp.inf)
-        l = jnp.argmax(masked_gain).astype(jnp.int32)
-        gain_l = masked_gain[l]
-        do = gain_l > cfg.min_gain_to_split
-        fsel = best_feat[l]
-        bsel = best_bin[l]
-        rows_bin = binned[:, fsel].astype(jnp.int32)
-        if cfg.has_categorical:
-            is_cat = is_categorical[fsel]
-            # categorical bitset: first (bsel+1) bins in sorted order go left
-            order_lf = order[l, fsel]                            # (B,)
-            take = jnp.arange(B) <= bsel
-            bit_words = (order_lf >> 5).astype(jnp.int32)
-            bit_vals = (jnp.uint32(1) << (order_lf & 31).astype(jnp.uint32))
-            bitset = jnp.zeros((bw,), jnp.uint32).at[bit_words].add(
-                jnp.where(take, bit_vals, jnp.uint32(0)))
-            member = ((bitset[rows_bin >> 5] >> (rows_bin & 31).astype(jnp.uint32)) & 1).astype(bool)
-            go_right = jnp.where(is_cat, ~member, rows_bin > bsel)
-        else:
-            is_cat = jnp.zeros((), bool)
-            bitset = jnp.zeros((bw,), jnp.uint32)
-            go_right = rows_bin > bsel
-        new_node = jnp.where(do & (s.node_of_row == l) & go_right, i + 1, s.node_of_row)
-
-        # tree bookkeeping for internal node i
-        G_l, H_l, C_l = totals[l, 0], totals[l, 1], totals[l, 2]
-        parent_out = _leaf_output(G_l, H_l, cfg) * cfg.learning_rate
-
-        def setw(arr, idx, val):
-            return arr.at[idx].set(jnp.where(do, val, arr[idx]))
-
-        p = s.leaf_parent[l]
-        p_idx = jnp.maximum(p, 0)
-        lc = s.left_child.at[p_idx].set(
-            jnp.where(do & (p >= 0) & ~s.leaf_is_right[l], i, s.left_child[p_idx]))
-        rc = s.right_child.at[p_idx].set(
-            jnp.where(do & (p >= 0) & s.leaf_is_right[l], i, s.right_child[p_idx]))
-        lc = lc.at[i].set(jnp.where(do, ~l, lc[i]))
-        rc = rc.at[i].set(jnp.where(do, ~(i + 1), rc[i]))
-
-        return S(
-            node_of_row=new_node,
-            depth=s.depth.at[l].add(jnp.where(do, 1, 0))
-                        .at[i + 1].set(jnp.where(do, s.depth[l] + 1, s.depth[i + 1])),
-            leaf_parent=s.leaf_parent.at[l].set(jnp.where(do, i, s.leaf_parent[l]))
-                                  .at[i + 1].set(jnp.where(do, i, s.leaf_parent[i + 1])),
-            leaf_is_right=s.leaf_is_right.at[l].set(jnp.where(do, False, s.leaf_is_right[l]))
-                                     .at[i + 1].set(jnp.where(do, True, s.leaf_is_right[i + 1])),
-            split_feature=setw(s.split_feature, i, fsel),
-            split_bin=setw(s.split_bin, i, bsel),
-            split_gain=setw(s.split_gain, i, gain_l),
-            split_type=setw(s.split_type, i, is_cat.astype(jnp.int32)),
-            cat_bitset=s.cat_bitset.at[i].set(jnp.where(do, bitset, s.cat_bitset[i])),
-            left_child=lc,
-            right_child=rc,
-            internal_value=setw(s.internal_value, i, parent_out),
-            internal_count=setw(s.internal_count, i, C_l.astype(jnp.int32)),
-            num_splits=s.num_splits + jnp.where(do, 1, 0),
-        )
-
-    s = jax.lax.fori_loop(0, L - 1, body, init) if L > 1 else init
-
-    # final leaf stats from the terminal assignment
-    vals = jnp.stack([g, h, in_bag], -1)
-    leaf_tot = jnp.zeros((L, 3), jnp.float32).at[
-        jnp.where(in_bag > 0, s.node_of_row, L)].add(vals, mode="drop")
-    leaf_value = _leaf_output(leaf_tot[:, 0], leaf_tot[:, 1], cfg) * cfg.learning_rate
-    # leaves that never came into existence emit 0 (they are unreachable anyway)
-    exists = jnp.arange(L) <= s.num_splits
-    leaf_value = jnp.where(exists, leaf_value, 0.0)
-
-    tree = TreeArrays(
-        split_feature=s.split_feature,
-        split_bin=s.split_bin,
-        split_gain=s.split_gain,
-        split_type=s.split_type,
-        cat_bitset=s.cat_bitset,
-        left_child=s.left_child,
-        right_child=s.right_child,
-        internal_value=s.internal_value,
-        internal_count=s.internal_count,
-        leaf_value=leaf_value,
-        leaf_weight=leaf_tot[:, 1],
-        leaf_count=leaf_tot[:, 2].astype(jnp.int32),
-        num_splits=s.num_splits,
-    )
-    return tree, s.node_of_row
+    if nan_bins is None:
+        nan_bins = jnp.full(f, 0x7FFF, jnp.int32)
+    return _grow_tree_impl(binned, grad, hess, in_bag, feature_active,
+                           is_categorical, monotone, nan_bins, cfg, axis_name)
 
 
 # ---------------------------------------------------------------------------
@@ -319,6 +561,7 @@ class Forest(NamedTuple):
     threshold: jnp.ndarray       # (T, L-1) f32
     split_bin: jnp.ndarray       # (T, L-1) i32
     split_type: jnp.ndarray      # (T, L-1) i32
+    default_left: jnp.ndarray    # (T, L-1) bool
     cat_bitset: jnp.ndarray      # (T, L-1, BW) u32
     left_child: jnp.ndarray      # (T, L-1)
     right_child: jnp.ndarray     # (T, L-1)
@@ -333,27 +576,36 @@ class Forest(NamedTuple):
         return self.leaf_value.shape[1]
 
 
-def _descend(X, sf, thr, sbin, stype, bits, lc, rc, binned: bool, depth: int):
+def _descend(X, sf, thr, sbin, stype, dleft, bits, lc, rc, binned: bool,
+             depth: int, nan_bins=None):
     """Vectorized pointer-chase for one tree; returns leaf index per row."""
     n = X.shape[0]
     node = jnp.zeros((n,), jnp.int32)
 
     def step(_, node):
-        f = sf[jnp.maximum(node, 0)]
+        nd = jnp.maximum(node, 0)
+        f = sf[nd]
         x = jnp.take_along_axis(X, f[:, None].astype(jnp.int32), axis=1)[:, 0]
+        dl = dleft[nd]
         if binned:
-            num_right = x.astype(jnp.int32) > sbin[jnp.maximum(node, 0)]
-            c = x.astype(jnp.int32)
+            xb = x.astype(jnp.int32)
+            num_right = xb > sbin[nd]
+            if nan_bins is not None:
+                is_missing = xb == nan_bins[f.astype(jnp.int32)]
+                num_right = jnp.where(is_missing, ~dl, num_right)
+            c = xb
         else:
-            t = thr[jnp.maximum(node, 0)]
-            num_right = ~(x <= t)          # NaN → right
-            c = jnp.clip(jnp.nan_to_num(x, nan=-1.0), -1, bits.shape[1] * BITS - 1).astype(jnp.int32)
+            t = thr[nd]
+            is_missing = jnp.isnan(x)
+            num_right = jnp.where(is_missing, ~dl, ~(x <= t))
+            c = jnp.clip(jnp.nan_to_num(x, nan=-1.0), -1,
+                         bits.shape[1] * BITS - 1).astype(jnp.int32)
         cw = jnp.maximum(c, 0)
-        word = bits[jnp.maximum(node, 0), cw >> 5]
+        word = bits[nd, cw >> 5]
         member = ((word >> (cw & 31).astype(jnp.uint32)) & 1).astype(bool) & (c >= 0)
-        is_cat = stype[jnp.maximum(node, 0)] == 1
+        is_cat = stype[nd] == 1
         go_right = jnp.where(is_cat, ~member, num_right)
-        nxt = jnp.where(go_right, rc[jnp.maximum(node, 0)], lc[jnp.maximum(node, 0)])
+        nxt = jnp.where(go_right, rc[nd], lc[nd])
         return jnp.where(node < 0, node, nxt)
 
     node = jax.lax.fori_loop(0, depth, step, node)
@@ -362,24 +614,28 @@ def _descend(X, sf, thr, sbin, stype, bits, lc, rc, binned: bool, depth: int):
 
 @partial(jax.jit, static_argnames=("binned", "output"))
 def forest_predict(forest: Forest, X: jnp.ndarray, binned: bool = False,
-                   output: str = "sum") -> jnp.ndarray:
+                   output: str = "sum", nan_bins=None) -> jnp.ndarray:
     """Sum of tree outputs (raw score) per row. ``output='leaf'`` returns the
     (N, T) leaf indices (predictLeaf parity — LightGBMBooster.scala:408-419);
-    ``output='per_tree'`` returns (N, T) leaf values (for DART drop handling)."""
+    ``output='per_tree'`` returns (N, T) leaf values (for DART drop handling).
+    ``nan_bins`` (F,) routes missing-bin values by each split's default_left
+    when traversing binned data."""
     X = jnp.asarray(X, jnp.float32 if not binned else X.dtype)
     L = forest.leaf_value.shape[1]
     depth = max(L - 1, 1)
 
     def one_tree(carry, t):
-        sf, thr, sbin, stype, bits, lc, rc, lv = t
-        leaf = _descend(X, sf, thr, sbin, stype, bits, lc, rc, binned, depth)
+        sf, thr, sbin, stype, dl, bits, lc, rc, lv = t
+        leaf = _descend(X, sf, thr, sbin, stype, dl, bits, lc, rc, binned,
+                        depth, nan_bins)
         val = lv[leaf]
         return carry, (leaf, val)
 
     _, (leaves, vals) = jax.lax.scan(
         one_tree, 0,
-        (forest.split_feature, forest.threshold, forest.split_bin, forest.split_type,
-         forest.cat_bitset, forest.left_child, forest.right_child, forest.leaf_value))
+        (forest.split_feature, forest.threshold, forest.split_bin,
+         forest.split_type, forest.default_left, forest.cat_bitset,
+         forest.left_child, forest.right_child, forest.leaf_value))
     if output == "leaf":
         return leaves.T          # (N, T)
     if output == "per_tree":
@@ -398,6 +654,7 @@ def stack_trees(trees: list, thresholds: list) -> Forest:
         threshold=jnp.stack([np.asarray(t, np.float32) for t in thresholds]),
         split_bin=cat("split_bin"),
         split_type=cat("split_type"),
+        default_left=cat("default_left"),
         cat_bitset=cat("cat_bitset"),
         left_child=cat("left_child"),
         right_child=cat("right_child"),

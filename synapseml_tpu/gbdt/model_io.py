@@ -9,8 +9,9 @@ lets this framework load models trained elsewhere.
 Format notes (LightGBM `tree` v3 text format):
   * child pointers: >= 0 → internal node index, negative → ~leaf_index
   * decision_type bitfield: bit0 categorical, bit1 default_left, bits2-3
-    missing_type (0 none, 1 zero, 2 nan). We emit 8 (= nan missing, no
-    default-left) for numeric and 1|8 for categorical splits.
+    missing_type (0 none, 1 zero, 2 nan). Splits on features with missing
+    values emit missing_type=nan plus the LEARNED default_left bit
+    (grower.py); features seen without NaN emit missing_type=none.
   * categorical thresholds: `threshold` holds an index into cat_boundaries;
     cat_threshold stores uint32 bitset words.
 """
@@ -24,8 +25,9 @@ import numpy as np
 from ..ops.quantize import BinMapper
 from .grower import BITS, TreeArrays
 
-_NUMERIC_DT = 8       # missing_type = nan
-_CATEGORICAL_DT = 9   # categorical | nan missing
+_DT_CAT = 1
+_DT_DEFAULT_LEFT = 2
+_DT_MISSING_NAN = 8
 
 
 def _fmt(arr, fmt="{:g}") -> str:
@@ -62,7 +64,7 @@ def booster_to_string(booster) -> str:
             base_shift = float(booster.base_score[ti])
         tree_blocks.append(_tree_to_string(ti, tree, booster._thresholds(ti),
                                            booster.tree_weights[ti], cfg.learning_rate,
-                                           base_shift))
+                                           base_shift, mapper.nan_mask))
     sizes = [len(b) + 1 for b in tree_blocks]
     lines.append("tree_sizes=" + " ".join(str(s) for s in sizes))
     lines.append("")
@@ -103,11 +105,13 @@ def _feature_info(mapper: BinMapper, j: int) -> str:
 
 
 def _tree_to_string(index: int, tree: TreeArrays, thresholds: np.ndarray,
-                    weight: float, shrinkage: float, base_shift: float = 0.0) -> str:
+                    weight: float, shrinkage: float, base_shift: float = 0.0,
+                    nan_mask=None) -> str:
     ns = int(tree.num_splits)
     nleaves = ns + 1
     sf = np.asarray(tree.split_feature)[:ns]
     stype = np.asarray(tree.split_type)[:ns]
+    dleft = np.asarray(tree.default_left)[:ns]
     thr = np.asarray(thresholds)[:ns].astype(np.float64)
     lc = np.asarray(tree.left_child)[:ns]
     rc = np.asarray(tree.right_child)[:ns]
@@ -126,7 +130,11 @@ def _tree_to_string(index: int, tree: TreeArrays, thresholds: np.ndarray,
 
     lc, rc = fix_child(lc), fix_child(rc)
 
-    dt = np.where(stype == 1, _CATEGORICAL_DT, _NUMERIC_DT)
+    feat_has_nan = (nan_mask[sf] if nan_mask is not None and len(sf)
+                    else np.zeros(len(sf), bool))
+    dt = (np.where(stype == 1, _DT_CAT, 0)
+          + np.where(dleft, _DT_DEFAULT_LEFT, 0)
+          + np.where(feat_has_nan | (stype == 1), _DT_MISSING_NAN, 0))
 
     lines = [f"Tree={index}", f"num_leaves={max(nleaves, 1)}"]
     cat_lines = []
@@ -257,6 +265,7 @@ def booster_from_string(s: str):
         iv = arr("internal_value", np.float32, max(L - 1, 1))
         icn = arr("internal_count", np.int32, max(L - 1, 1))
         stype = (dt & 1).astype(np.int32)
+        dleft = ((dt >> 1) & 1).astype(bool)
 
         bitset = np.zeros((max(L - 1, 1), bw), np.uint32)
         if int(fields.get("num_cat", 0)) > 0:
@@ -272,7 +281,8 @@ def booster_from_string(s: str):
 
         trees.append(TreeArrays(
             split_feature=sf, split_bin=np.zeros_like(sf), split_gain=gain,
-            split_type=stype, cat_bitset=bitset, left_child=lc, right_child=rc,
+            split_type=stype, default_left=dleft, cat_bitset=bitset,
+            left_child=lc, right_child=rc,
             internal_value=iv, internal_count=icn, leaf_value=lv, leaf_weight=lw,
             leaf_count=lcn, num_splits=np.int32(ns)))
 

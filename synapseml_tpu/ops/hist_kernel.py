@@ -1,0 +1,125 @@
+"""Pallas TPU histogram kernel — the GBDT hot loop on the MXU.
+
+The reference's hot loop is LightGBM C++ ``ConstructHistograms`` driven through
+``LGBM_BoosterUpdateOneIter`` (booster/LightGBMBooster.scala:355-392): for every
+row and feature, add (grad, hess, 1) into the (feature, bin) histogram slot.
+TPUs have no fast scatter, so this kernel reformulates histogramming as a
+**two-level one-hot matmul on the MXU**:
+
+    bin = hi * 8 + lo                     (hi in [0, B/8), lo in [0, 8))
+    LHS[hi, row]        = 1{bin_hi(row) == hi}          (B/8, C)  bf16
+    RHS[row, ch*8 + lo] = 1{bin_lo(row) == lo} * val_ch (C, 24)   bf16
+    out[hi, ch*8+lo]   += LHS @ RHS                     (B/8, 24) f32 accum
+
+Each (row, feature) costs one 128x128 MXU output tile per C-row chunk — the
+cheapest possible one-hot-matmul decomposition (a single-level one-hot needs
+two tiles: M = B = 256). The one-hot factors are generated in VMEM registers
+and never touch HBM; gradients are rounded to bf16 (exact 0/1 LHS, f32
+accumulation), which matches the precision story of LightGBM's GPU float
+histograms.
+
+Numerically the result equals a scatter-add with bf16-rounded grad/hess. The
+XLA fallback (`_hist_xla`) — used on CPU (tests' virtual mesh) and any
+non-TPU backend — applies the same bf16 rounding so both paths agree bit-wise
+in the accumulated sums up to f32 reduction order.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+FEATURE_BLOCK = 8     # features per kernel step (i32 sublane tile)
+LANE = 128
+
+
+def pad_bins(max_bin: int) -> int:
+    """Kernel bin-space size: power of two >= max_bin, at least 256 (so hi fits
+    the MXU sublane dim and lo is exactly 3 bits)."""
+    b = 256
+    while b < max_bin:
+        b *= 2
+    return b
+
+
+def features_padded(f: int) -> int:
+    return -(-f // FEATURE_BLOCK) * FEATURE_BLOCK
+
+
+def _kernel(bin_ref, g_ref, h_ref, m_ref, out_ref, *, C: int, K1: int):
+    """Grid (feature_blocks, row_chunks). bin_ref (FEATURE_BLOCK, C) i32,
+    g/h/m (C,) f32, out (FEATURE_BLOCK, K1, 24) f32 accumulated over chunks."""
+    from jax.experimental import pallas as pl  # deferred: CPU never imports
+
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    colv = lax.broadcasted_iota(jnp.int32, (C, 24), 1)
+    ch = colv >> 3             # value channel: 0 grad, 1 hess, 2 count
+    lo_col = colv & 7
+    val = jnp.where(ch == 0, g_ref[:][:, None],
+                    jnp.where(ch == 1, h_ref[:][:, None], m_ref[:][:, None]))
+    iota_hi = lax.broadcasted_iota(jnp.int32, (K1, C), 0)
+
+    def fbody(f, _):
+        bins = bin_ref[pl.ds(f, 1), :][0]
+        lhs = (iota_hi == (bins >> 3)[None, :]).astype(jnp.bfloat16)
+        rhs = jnp.where(lo_col == (bins & 7)[:, None], val, 0.0
+                        ).astype(jnp.bfloat16)
+        acc = jnp.dot(lhs, rhs, preferred_element_type=jnp.float32)
+        out_ref[pl.ds(f, 1)] += acc[None]
+        return 0
+
+    lax.fori_loop(0, FEATURE_BLOCK, fbody, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins_padded", "chunk"))
+def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = 2048):
+    from jax.experimental import pallas as pl
+
+    FP, n = bT.shape
+    C = min(chunk, n)
+    assert n % C == 0 and FP % FEATURE_BLOCK == 0
+    K1 = num_bins_padded // 8
+    out = pl.pallas_call(
+        functools.partial(_kernel, C=C, K1=K1),
+        grid=(FP // FEATURE_BLOCK, n // C),
+        in_specs=[
+            pl.BlockSpec((FEATURE_BLOCK, C), lambda f, c: (f, c)),
+            pl.BlockSpec((C,), lambda f, c: (c,)),
+            pl.BlockSpec((C,), lambda f, c: (c,)),
+            pl.BlockSpec((C,), lambda f, c: (c,)),
+        ],
+        out_specs=pl.BlockSpec((FEATURE_BLOCK, K1, 24), lambda f, c: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((FP, K1, 24), jnp.float32),
+    )(bT, g, h, m)
+    # columns are (ch, lo): (FP, K1, 3, 8) -> (FP, K1, 8, 3) -> (FP, B, 3)
+    return out.reshape(FP, K1, 3, 8).transpose(0, 1, 3, 2).reshape(
+        FP, num_bins_padded, 3)
+
+
+def _hist_xla(bT, g, h, m, num_bins_padded: int):
+    """Scatter-add fallback with the same bf16 value rounding as the kernel."""
+    FP, n = bT.shape
+    vals = jnp.stack([g, h, m], -1).astype(jnp.bfloat16).astype(jnp.float32)
+    hist = jnp.zeros((FP, num_bins_padded, 3), jnp.float32)
+    fidx = jnp.arange(FP, dtype=jnp.int32)[:, None]
+    return hist.at[fidx, bT.astype(jnp.int32), :].add(
+        vals[None, :, :], mode="drop")
+
+
+def child_histogram(bT, g, h, m, num_bins_padded: int):
+    """(FP, size) i32 bins + per-row grad/hess/weight-mask →
+    (FP, num_bins_padded, 3) f32 histogram of [sum_grad, sum_hess, sum_mask].
+
+    Rows with m == 0 (outside the leaf range / bagged out / padding) contribute
+    nothing PROVIDED g and h are also zeroed for those rows (callers mask all
+    three). Uses the Pallas MXU kernel on TPU, XLA scatter elsewhere.
+    """
+    if jax.default_backend() == "tpu":
+        return _hist_pallas(bT, g, h, m, num_bins_padded)
+    return _hist_xla(bT, g, h, m, num_bins_padded)

@@ -9,11 +9,14 @@ matrix is produced TPU-resident.
 
 Bin semantics (matching LightGBM's BinMapper):
   * boundaries[f] is a sorted vector of bin upper bounds (length <= max_bin - 1);
-    bin(x) = first i with x <= boundaries[f][i]; x beyond all bounds → last bin.
-  * NaN → last bin (missing handled as "always right of any split"; LightGBM's
-    learned default_left is not implemented — documented deviation).
+    bin(x) = first i with x <= boundaries[f][i]; x beyond all bounds → last
+    real-value bin.
+  * Features containing NaN get a DEDICATED missing bin at index
+    ``num_bins[f] - 1`` (missing_type=NaN); the split finder then learns the
+    missing direction per split (``default_left``), matching LightGBM's
+    BinMapper + Tree::default_left semantics (SURVEY §7 hard-part 1).
   * categorical features use the category's integer value as its bin, capped by
-    max_bin; rare categories overflow into bin 0.
+    max_bin; rare categories overflow into bin 0; NaN categories → bin 0.
 """
 
 from __future__ import annotations
@@ -34,6 +37,7 @@ class BinMapper(NamedTuple):
     num_bins: np.ndarray        # (F,) int32 — actual bin count per feature
     is_categorical: np.ndarray  # (F,) bool
     max_bin: int
+    has_nan: np.ndarray = None  # (F,) bool — feature has a dedicated NaN bin
 
     @property
     def num_features(self) -> int:
@@ -42,6 +46,20 @@ class BinMapper(NamedTuple):
     @property
     def total_bins(self) -> int:
         return self.max_bin
+
+    @property
+    def nan_mask(self) -> np.ndarray:
+        if self.has_nan is None:
+            return np.zeros(self.num_features, bool)
+        return self.has_nan
+
+    @property
+    def nan_bins(self) -> np.ndarray:
+        """(F,) int32: the NaN bin index per feature (num_bins-1 when the
+        feature has missing values, else an out-of-range sentinel so equality
+        against it never fires)."""
+        nb = np.asarray(self.num_bins, np.int32) - 1
+        return np.where(self.nan_mask, nb, np.int32(0x7FFF))
 
 
 def compute_bin_mapper(
@@ -59,6 +77,8 @@ def compute_bin_mapper(
     cat = np.zeros(f, dtype=bool)
     if categorical_features:
         cat[list(categorical_features)] = True
+    # missing-ness decided on the FULL matrix (binning must route every NaN)
+    has_nan = np.isnan(X).any(axis=0) & ~cat
 
     if n > sample_count:
         rng = np.random.default_rng(seed)
@@ -69,24 +89,29 @@ def compute_bin_mapper(
     for j in range(f):
         col = X[:, j]
         col = col[~np.isnan(col)]
+        # features with NaN reserve one bin; real values get one fewer
+        real_cap = max_bin - 1 if has_nan[j] else max_bin
         if cat[j]:
             # categories are small non-negative ints; identity binning capped at max_bin
             hi = int(col.max()) if col.size else 0
-            nbins[j] = min(hi + 1, max_bin - 1) + 1  # +1 for the NaN/overflow bin
+            nbins[j] = min(hi + 1, max_bin - 1) + 1  # +1 for the overflow bin
             continue
         uniq = np.unique(col)
         if uniq.size <= 1:
-            nbins[j] = 2
+            nbins[j] = 2 + int(has_nan[j])
             continue
-        if uniq.size <= max_bin - 1:
+        if uniq.size <= real_cap - 1:
             # few distinct values: boundary at midpoints → exact value bins
             b = (uniq[:-1] + uniq[1:]) * 0.5
         else:
-            qs = np.linspace(0.0, 1.0, max_bin)[1:-1]
+            qs = np.linspace(0.0, 1.0, real_cap)[1:-1]
             b = np.unique(np.quantile(col, qs).astype(np.float32))
         bounds[j, : b.size] = b
-        nbins[j] = b.size + 2  # values beyond last bound + NaN share the last bin
-    return BinMapper(boundaries=bounds, num_bins=nbins, is_categorical=cat, max_bin=max_bin)
+        # bins: b.size+1 real-value bins (+1 overflow shares the last), plus a
+        # dedicated NaN bin when the feature has missing values
+        nbins[j] = b.size + 2 + int(has_nan[j])
+    return BinMapper(boundaries=bounds, num_bins=nbins, is_categorical=cat,
+                     max_bin=max_bin, has_nan=has_nan)
 
 
 @partial(jax.jit, static_argnames=("out_dtype",))
@@ -99,17 +124,25 @@ def _apply_bins_numeric(X: jnp.ndarray, boundaries: jnp.ndarray, out_dtype=jnp.u
 
 
 def apply_bins(mapper: BinMapper, X) -> jnp.ndarray:
-    """(N, F) raw floats → (N, F) bin ids. NaN and +inf overflow land in the last
-    usable bin (searchsorted over +inf-padded bounds returns the pad start; NaN
-    compares false with every bound and also returns the end)."""
+    """(N, F) raw floats → (N, F) bin ids. Non-NaN overflow clamps into the
+    last REAL-value bin; NaN goes to the feature's dedicated NaN bin when it
+    has one (else the last bin, the legacy always-right behavior)."""
     dtype = jnp.uint8 if mapper.max_bin <= 256 else jnp.uint16
     X = jnp.asarray(X, jnp.float32)
     binned = _apply_bins_numeric(X, jnp.asarray(mapper.boundaries), dtype)
-    # clamp into each feature's actual bin range (NaN/overflow → num_bins-1)
-    limit = jnp.asarray(mapper.num_bins - 1, binned.dtype)
-    binned = jnp.minimum(binned, limit[None, :])
+    nan_mask = jnp.asarray(mapper.nan_mask)
+    isnan = jnp.isnan(X)
+    # clamp real values into the feature's real-value bin range
+    real_limit = jnp.asarray(
+        mapper.num_bins - 1 - mapper.nan_mask.astype(np.int32), np.int32)
+    binned = jnp.minimum(binned.astype(jnp.int32), real_limit[None, :])
+    # NaN → dedicated NaN bin (num_bins-1) for has_nan features
+    nanbin = jnp.asarray(mapper.num_bins - 1, np.int32)
+    binned = jnp.where(isnan & nan_mask[None, :], nanbin[None, :], binned)
+    binned = binned.astype(dtype)
     if mapper.is_categorical.any():
         cats = jnp.asarray(mapper.is_categorical)
+        limit = jnp.asarray(mapper.num_bins - 1, binned.dtype)
         ident = jnp.clip(jnp.nan_to_num(X, nan=0.0), 0, mapper.max_bin - 1).astype(binned.dtype)
         ident = jnp.minimum(ident, limit[None, :])
         binned = jnp.where(cats[None, :], ident, binned)
@@ -118,9 +151,12 @@ def apply_bins(mapper: BinMapper, X) -> jnp.ndarray:
 
 def bin_threshold_to_value(mapper: BinMapper, feature: int, bin_id: int) -> float:
     """Real-valued split threshold for a numeric split at ``bin_id`` (the stored
-    LightGBM model threshold, i.e. the bin's upper boundary)."""
+    LightGBM model threshold, i.e. the bin's upper boundary). A threshold at or
+    beyond the last real-value bin means "every non-missing value goes left"
+    (only reachable for features with a NaN bin, where the right child holds
+    the missing rows) — its upper bound is +inf, matching LightGBM's
+    GetUpperBoundValue of the top bin."""
     b = mapper.boundaries[feature]
     if bin_id < len(b) and np.isfinite(b[bin_id]):
         return float(b[bin_id])
-    finite = b[np.isfinite(b)]
-    return float(finite[-1]) if finite.size else 0.0
+    return float(np.inf)

@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional
 from ..core.params import Param
 from ..core.table import Table
 from ..io.http import HTTPRequestData
-from .base import HasSetLocation
+from .base import HasAsyncReply, HasSetLocation
 
 
 class _AnomalyBase(HasSetLocation):
@@ -78,18 +78,28 @@ class SimpleDetectAnomalies(DetectAnomalies):
         return super()._transform(work)
 
 
-class DetectMultivariateAnomaly(_AnomalyBase):
+class DetectMultivariateAnomaly(HasAsyncReply, _AnomalyBase):
     """Multivariate anomaly detection with the reference's train → poll →
     infer lifecycle (SimpleDetectMultivariateAnomaly). ``train`` submits the
     model and polls until ready; ``_prepare_body`` runs inference."""
+
+    @staticmethod
+    def _status_of(info: dict) -> str:
+        # model status lives under modelInfo.status
+        return str((info.get("modelInfo") or {}).get("status",
+                                                     info.get("status", "")))
+
+    def _send_raw(self, req):
+        """One request without the LRO interception (train() drives its own
+        modelId-aware poll loop)."""
+        from .base import CognitiveServiceBase
+
+        return CognitiveServiceBase._send_one(self, req)
 
     modelId = Param("modelId", "trained model id", str)
     startTime = Param("startTime", "series start (ISO)", str)
     endTime = Param("endTime", "series end (ISO)", str)
     dataSource = Param("dataSource", "blob url of training data", str)
-    pollInterval = Param("pollInterval", "seconds between status polls",
-                         float, 5.0)
-    maxPollRetries = Param("maxPollRetries", "max status polls", int, 120)
     urlPath = "anomalydetector/v1.1/multivariate/models"
 
     def train(self) -> str:
@@ -100,7 +110,7 @@ class DetectMultivariateAnomaly(_AnomalyBase):
         body = {"dataSource": self.get("dataSource"),
                 "startTime": self.get("startTime"),
                 "endTime": self.get("endTime")}
-        resp = self._send_one(HTTPRequestData.from_json_body(
+        resp = self._send_raw(HTTPRequestData.from_json_body(
             base, body, self._prepare_headers(None, None)))
         if resp is None or not 200 <= resp.status_code < 300:
             raise RuntimeError(f"train submit failed: "
@@ -110,7 +120,7 @@ class DetectMultivariateAnomaly(_AnomalyBase):
         self.set("modelId", model_id)
         status_url = loc or f"{base}/{model_id}"
         for _ in range(self.getMaxPollRetries()):
-            s = self._send_one(HTTPRequestData(
+            s = self._send_raw(HTTPRequestData(
                 url=status_url, method="GET",
                 headers=self._prepare_headers(None, None)))
             info = s.json() if s and s.entity else {}
@@ -131,3 +141,60 @@ class DetectMultivariateAnomaly(_AnomalyBase):
     def _prepare_body(self, df, i):
         series = df[self.getSeriesCol()][i]
         return {"variables": series} if series is not None else None
+
+
+class DetectLastMultivariateAnomaly(DetectMultivariateAnomaly):
+    """Synchronous last-point multivariate detection (reference
+    DetectLastMultivariateAnomaly — POST {modelId}:detect-last)."""
+
+
+class SimpleFitMultivariateAnomaly(DetectMultivariateAnomaly):
+    """Estimator facade over the train → poll lifecycle (reference
+    SimpleFitMultivariateAnomaly): ``fit`` submits training, polls to READY
+    and returns a SimpleDetectMultivariateAnomaly bound to the model id."""
+
+    def fit(self, df: Optional[Table] = None) -> "SimpleDetectMultivariateAnomaly":
+        model_id = self.train()
+        m = SimpleDetectMultivariateAnomaly()
+        for p in ("url", "subscriptionKey", "seriesCol", "pollInterval",
+                  "maxPollRetries", "handler"):
+            if self.isSet(p):
+                m.set(p, self.get(p))
+        m.set("modelId", model_id)
+        return m
+
+    def _fit(self, df):
+        return self.fit(df)
+
+
+class SimpleDetectMultivariateAnomaly(DetectMultivariateAnomaly):
+    """Batch multivariate inference with the async result poll (reference
+    SimpleDetectMultivariateAnomaly: POST {modelId}:detect-batch → resultId →
+    poll results/{resultId})."""
+
+    topContributorCount = Param("topContributorCount",
+                                "contributors per anomaly", int, 10)
+
+    def _prepare_url(self, df, i):
+        mid = self._resolve("modelId", df, i)
+        if not mid:
+            raise ValueError("modelId not set — fit first")
+        return f"{self.get('url').rstrip('/')}/{mid}:detect-batch"
+
+    def _prepare_body(self, df, i):
+        series = df[self.getSeriesCol()][i]
+        if series is None:
+            return None
+        body = {"variables": series,
+                "topContributorCount": self.getTopContributorCount()}
+        for k in ("startTime", "endTime"):
+            v = self._resolve(k, df, i)
+            if v is not None:
+                body[k] = v
+        return body
+
+    @staticmethod
+    def _status_of(info: dict) -> str:
+        # batch-detect results report under summary.status
+        return str((info.get("summary") or {}).get("status",
+                                                   info.get("status", "")))

@@ -173,6 +173,57 @@ class CognitiveServiceBase(HasServiceParams):
         return res.with_column(self.get("errorCol"), err)
 
 
+class HasAsyncReply(CognitiveServiceBase):
+    """Shared long-running-operation flow (reference HasAsyncReply:360-416):
+    submit → Location/Operation-Location → poll until a terminal status →
+    synthetic 504 when polls are exhausted. Subclasses set ``_status_of`` if
+    the terminal status lives somewhere other than top-level "status"."""
+
+    pollInterval = Param("pollInterval", "seconds between polls", float, 1.0)
+    maxPollRetries = Param("maxPollRetries", "max polls", int, 60)
+
+    _done_states = ("succeeded", "failed", "READY", "FAILED")
+
+    @staticmethod
+    def _status_of(info: dict) -> str:
+        return str(info.get("status", ""))
+
+    def _send_one(self, req):
+        import time as _t
+
+        first = super()._send_one(req)
+        if first is None or first.status_code not in (200, 201, 202):
+            return first
+        loc = None
+        for k, v in (first.headers or {}).items():
+            if k.lower() in ("operation-location", "location"):
+                loc = v
+                break
+        if not loc:
+            return first
+        headers = {k: v for k, v in req.headers.items()
+                   if k.lower() != "content-type"}
+        poll_req = HTTPRequestData(url=loc, method="GET", headers=headers)
+        poll = None
+        for _ in range(self.getMaxPollRetries()):
+            poll = super()._send_one(poll_req)
+            if poll is None:
+                break
+            try:
+                info = poll.json() if poll.entity else {}
+            except Exception:
+                info = {}
+            if self._status_of(info or {}) in self._done_states:
+                return poll
+            _t.sleep(self.getPollInterval())
+        # poll exhausted/errored: report a timeout, NOT the 202 submit ack
+        return HTTPResponseData(
+            status_code=504,
+            reason=f"operation at {loc} did not complete within "
+                   f"{self.getMaxPollRetries()} polls",
+            entity=(poll.entity if poll is not None else None))
+
+
 class HasSetLocation(CognitiveServiceBase):
     """setLocation builds the azure domain url (reference HasSetLocation:418-432)."""
 

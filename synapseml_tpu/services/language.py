@@ -12,7 +12,7 @@ from typing import Any, List, Optional
 
 from ..core.params import Param
 from ..core.table import Table
-from .base import HasSetLocation
+from .base import HasAsyncReply, HasSetLocation
 
 
 class _TextAnalyticsBase(HasSetLocation):
@@ -85,3 +85,59 @@ class LanguageDetector(_TextAnalyticsBase):
 
 class AnalyzeHealthText(_TextAnalyticsBase):
     kind = "Healthcare"
+
+
+class EntityDetector(_TextAnalyticsBase):
+    """Linked-entity detection (reference text/TextAnalytics.scala
+    EntityDetector — the v3 'entities/linking' task)."""
+
+    kind = "EntityLinking"
+
+
+class AnalyzeText(_TextAnalyticsBase):
+    """Unified analyze-text transformer: the task kind is a parameter instead
+    of a subclass (reference language/AnalyzeText.scala)."""
+
+    kind = "SentimentAnalysis"
+    kindParam = Param("kind", "EntityLinking|EntityRecognition|KeyPhrase"
+                      "Extraction|LanguageDetection|PiiEntityRecognition|"
+                      "SentimentAnalysis", str, "SentimentAnalysis")
+
+    def _prepare_body(self, df, i):
+        self.kind = self._resolve("kind", df, i, "SentimentAnalysis")
+        if self.kind == "LanguageDetection":
+            text = df[self.getTextCol()][i]
+            if text is None:
+                return None
+            return {"kind": self.kind,
+                    "analysisInput": {"documents": [{"id": "0",
+                                                     "text": str(text)}]},
+                    "parameters": {}}
+        return super()._prepare_body(df, i)
+
+
+class TextAnalyze(HasAsyncReply, _TextAnalyticsBase):
+    """Multi-task batch analysis (reference text/TextAnalyze.scala — the
+    /analyze-text/jobs endpoint running several task kinds over one batch;
+    the 202 + operation-location reply is polled via HasAsyncReply)."""
+
+    tasks = Param("tasks", "map task kind -> parameters", is_complex=True)
+    urlPath = "language/analyze-text/jobs"
+
+    def _prepare_url(self, df, i):
+        return (HasSetLocation._prepare_url(self, df, i)
+                + f"?api-version={self.getApiVersion()}")
+
+    def _prepare_body(self, df, i):
+        text = df[self.getTextCol()][i]
+        if text is None:
+            return None
+        lang = self._resolve("language", df, i, "en")
+        tasks = self.get("tasks") or {"SentimentAnalysis": {}}
+        return {"analysisInput": {"documents": [
+                    {"id": "0", "text": str(text), "language": lang}]},
+                "tasks": [{"kind": k, "parameters": v or {}}
+                          for k, v in tasks.items()]}
+
+    def _parse_response(self, parsed, df, i):
+        return parsed

@@ -101,3 +101,88 @@ class BingImageSearch(CognitiveServiceBase):
 
         with ThreadPoolExecutor(max_workers=concurrency) as pool:
             return list(pool.map(get, urls))
+
+
+class AddDocuments(CognitiveServiceBase):
+    """Push rows into an Azure Search index (reference search/AzureSearch.scala
+    AddDocuments transformer — POST indexes/{index}/docs/index with a batch of
+    @search.action documents). The standalone writer counterpart is
+    AzureSearchWriter above."""
+
+    serviceName = Param("serviceName", "search service name", str)
+    indexName = Param("indexName", "target index", str)
+    actionCol = Param("actionCol", "per-row @search.action column", str,
+                      "@search.action")
+    batchSize = Param("batchSize", "rows per indexing batch", int, 100)
+    apiVersion = Param("apiVersion", "API version", str, "2023-11-01")
+
+    def _prepare_url(self, df, i):
+        if self.get("url"):
+            return self.get("url")
+        return (f"https://{self.get('serviceName')}.search.windows.net/"
+                f"indexes/{self.get('indexName')}/docs/index"
+                f"?api-version={self.getApiVersion()}")
+
+    def _prepare_headers(self, df, i):
+        h = super()._prepare_headers(df, i)
+        key = self._resolve("subscriptionKey", df, i)
+        if key:
+            h["api-key"] = str(key)
+        return h
+
+    def _doc(self, df, i):
+        action_col = self.get("actionCol")
+        skip = {self.get("outputCol"), self.get("errorCol"), action_col}
+        doc = {c: _to_plain(df[c][i]) for c in df.columns if c not in skip}
+        doc["@search.action"] = (df[action_col][i]
+                                 if action_col in df.columns else "upload")
+        return doc
+
+    def _prepare_body(self, df, i):
+        # batching handled in _transform; single-row fallback
+        return {"value": [self._doc(df, i)]}
+
+    def _transform(self, df):
+        import json as _json
+
+        import numpy as np
+
+        from ..io.http import HTTPRequestData
+
+        n = df.num_rows
+        bs = max(1, self.getBatchSize())
+        out = np.empty(n, dtype=object)
+        err = np.empty(n, dtype=object)
+        for s in range(0, n, bs):
+            rows = range(s, min(s + bs, n))
+            body = {"value": [self._doc(df, i) for i in rows]}
+            req = HTTPRequestData(
+                url=self._prepare_url(df, s), method="POST",
+                headers=self._prepare_headers(df, s),
+                entity=_json.dumps(body).encode())
+            r = self._send_one(req)
+            if r is not None and 200 <= r.status_code < 300:
+                try:
+                    results = r.json().get("value", [])
+                except Exception:
+                    results = []
+                for j, i in enumerate(rows):
+                    out[i] = results[j] if j < len(results) else None
+                    err[i] = None
+            else:
+                for i in rows:
+                    out[i] = None
+                    err[i] = {"statusCode": getattr(r, "status_code", None),
+                              "reason": getattr(r, "reason", "send failed")}
+        res = df.with_column(self.get("outputCol"), out)
+        return res.with_column(self.get("errorCol"), err)
+
+
+def _to_plain(v):
+    import numpy as np
+
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v

@@ -104,3 +104,70 @@ class DictionaryLookup(_TranslatorBase):
             raise ValueError(
                 "DictionaryLookup: fromLanguage and toLanguage must be set")
         return f"?api-version={self.getApiVersion()}&from={frm}&to={to}"
+
+
+class DictionaryExamples(_TranslatorBase):
+    """Dictionary usage examples (reference translate/Translator.scala
+    DictionaryExamples): POST [{Text, Translation}] pairs."""
+
+    fromLanguage = Param("fromLanguage", "source language", str, "en")
+    toLanguage = Param("toLanguage", "target language", str)
+    translationCol = Param("translationCol", "column of normalized "
+                           "translations (paired with textCol)", str)
+    _path = "dictionary/examples"
+
+    def _query(self, df, i):
+        to = self._resolve("toLanguage", df, i)
+        if to is None:
+            raise ValueError("DictionaryExamples: toLanguage is not set")
+        return (f"?api-version={self.getApiVersion()}"
+                f"&from={self._resolve('fromLanguage', df, i, 'en')}&to={to}")
+
+    def _prepare_body(self, df, i):
+        text = df[self.getTextCol()][i]
+        if text is None:
+            return None
+        trans = (df[self.get("translationCol")][i]
+                 if self.isSet("translationCol") else text)
+        texts = text if isinstance(text, (list, tuple)) else [text]
+        transl = trans if isinstance(trans, (list, tuple)) else [trans]
+        return [{"Text": str(t), "Translation": str(tr)}
+                for t, tr in zip(texts, transl)]
+
+
+class DocumentTranslator(CognitiveServiceBase):
+    """Asynchronous blob-to-blob document translation (reference
+    translate/DocumentTranslator.scala): POST /batches with
+    source/target container urls; output = operation status url."""
+
+    serviceName = Param("serviceName", "translator resource name", str)
+    sourceUrl = Param("sourceUrl", "source container SAS url", str)
+    targetUrl = Param("targetUrl", "target container SAS url", str)
+    targetLanguage = Param("targetLanguage", "target language", str, "fr")
+    filterPrefix = Param("filterPrefix", "blob name prefix filter", str)
+    storageType = Param("storageType", "Folder|File", str, "Folder")
+
+    def _prepare_url(self, df, i):
+        if self.get("url"):
+            return self.get("url")
+        name = self.get("serviceName")
+        if not name:
+            raise ValueError("DocumentTranslator: set serviceName or url")
+        return (f"https://{name}.cognitiveservices.azure.com/"
+                "translator/text/batch/v1.0/batches")
+
+    def _prepare_body(self, df, i):
+        src = self._resolve("sourceUrl", df, i)
+        tgt = self._resolve("targetUrl", df, i)
+        if src is None or tgt is None:
+            return None
+        source = {"sourceUrl": str(src), "storageSource": "AzureBlob"}
+        pre = self._resolve("filterPrefix", df, i)
+        if pre:
+            source["filter"] = {"prefix": str(pre)}
+        return {"inputs": [{
+            "source": source,
+            "storageType": self._resolve("storageType", df, i, "Folder"),
+            "targets": [{"targetUrl": str(tgt), "storageSource": "AzureBlob",
+                         "language": self._resolve("targetLanguage", df, i,
+                                                   "fr")}]}]}

@@ -1,0 +1,99 @@
+"""Learned missing-direction (default_left) tests — LightGBM missing_type=NaN
+semantics (VERDICT missing #7; reference BinMapper + Tree::default_left)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.gbdt import BoosterConfig, train_booster
+from synapseml_tpu.gbdt.boosting import Booster
+from synapseml_tpu.ops.quantize import apply_bins, compute_bin_mapper
+
+
+def _nan_data(nan_left: bool, n=4000, seed=0):
+    """Feature 0 separates labels; NaN rows' labels match the left (x<0) or
+    right (x>0) group so the learned default direction is forced."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    y = (x > 0).astype(np.float32)
+    nan_idx = rng.choice(n, size=n // 5, replace=False)
+    x2 = x.copy()
+    x2[nan_idx] = np.nan
+    # NaN rows keep the label of the group they should route with
+    y[nan_idx] = 0.0 if nan_left else 1.0
+    noise = rng.normal(size=(n, 2)).astype(np.float32)
+    X = np.column_stack([x2, noise])
+    return X, y, nan_idx
+
+
+def test_mapper_reserves_nan_bin():
+    X = np.array([[0.0], [1.0], [np.nan], [2.0]], np.float32)
+    m = compute_bin_mapper(X, max_bin=16)
+    assert m.has_nan[0]
+    binned = np.asarray(apply_bins(m, X)).ravel()
+    nan_bin = int(m.num_bins[0]) - 1
+    assert binned[2] == nan_bin
+    # real values stay strictly below the NaN bin
+    assert binned[0] < nan_bin and binned[3] < nan_bin
+    assert m.nan_bins[0] == nan_bin
+
+
+def test_no_nan_feature_has_sentinel():
+    X = np.linspace(0, 1, 100)[:, None].astype(np.float32)
+    m = compute_bin_mapper(X, max_bin=16)
+    assert not m.has_nan[0]
+    assert m.nan_bins[0] > 255  # sentinel: equality against bins never fires
+
+
+@pytest.mark.parametrize("nan_left", [True, False])
+def test_default_direction_learned(nan_left):
+    X, y, nan_idx = _nan_data(nan_left)
+    cfg = BoosterConfig(objective="binary", num_iterations=10, num_leaves=7,
+                        min_data_in_leaf=5)
+    bst = train_booster(X, y, cfg)
+    # at least one split on feature 0 must carry the expected direction
+    dirs = []
+    for t in bst.trees:
+        ns = int(t.num_splits)
+        sf = np.asarray(t.split_feature)[:ns]
+        dl = np.asarray(t.default_left)[:ns]
+        dirs.extend(dl[sf == 0].tolist())
+    assert len(dirs) > 0
+    assert any(d == nan_left for d in dirs)
+    # NaN rows must be classified with their group
+    pred = bst.predict(X)
+    acc_nan = ((pred[nan_idx] > 0.5) == (y[nan_idx] > 0.5)).mean()
+    assert acc_nan > 0.9
+
+
+def test_nan_routing_raw_vs_binned_consistent():
+    X, y, _ = _nan_data(True)
+    cfg = BoosterConfig(objective="binary", num_iterations=5, num_leaves=7,
+                        min_data_in_leaf=5)
+    bst = train_booster(X, y, cfg)
+    raw = bst.raw_score(X)                       # raw-X traversal (NaN → dl)
+    binned = apply_bins(bst.mapper, X)
+    from synapseml_tpu.gbdt.grower import forest_predict
+    import jax.numpy as jnp
+    raw_b = np.asarray(forest_predict(
+        bst.forest(), binned, binned=True,
+        nan_bins=jnp.asarray(bst.mapper.nan_bins))) + bst.base_score[0]
+    np.testing.assert_allclose(raw, raw_b, rtol=1e-4, atol=1e-4)
+
+
+def test_default_left_survives_model_string():
+    X, y, _ = _nan_data(True)
+    cfg = BoosterConfig(objective="binary", num_iterations=3, num_leaves=7,
+                        min_data_in_leaf=5)
+    bst = train_booster(X, y, cfg)
+    s = bst.model_string()
+    # decision_type must carry the default_left bit (2) and missing nan (8)
+    assert "decision_type=" in s
+    loaded = Booster.from_model_string(s)
+    for t_orig, t_load in zip(bst.trees, loaded.trees):
+        ns = int(t_orig.num_splits)
+        np.testing.assert_array_equal(
+            np.asarray(t_orig.default_left)[:ns],
+            np.asarray(t_load.default_left)[:ns])
+    # loaded model routes NaN the same way
+    np.testing.assert_allclose(bst.raw_score(X), loaded.raw_score(X),
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,230 @@
+"""LightGBM model-string conformance beyond self-round-trip (VERDICT missing
+#4 / next-round #4; reference saveNativeModel LightGBMBooster.scala:458-516).
+
+Two directions:
+  1. A GOLDEN native model string, hand-written to the LightGBM v3 text spec
+     (field set and semantics per the native loader), must load and produce
+     hand-computed predictions — including default_left missing handling and
+     categorical bitset routing.
+  2. Our writer's output must satisfy a STRICT format audit: every field the
+     native loader requires, consistent counts, valid child pointers, correct
+     tree_sizes byte accounting.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.gbdt import BoosterConfig, train_booster
+from synapseml_tpu.gbdt.boosting import Booster
+
+# -- golden model: written by hand to the LightGBM v3 spec -------------------
+# Tree 0 (numeric):  node0 splits f0 at 0.5 with default_left (dt=2|8=10);
+#   left -> leaf0 (+0.10); right -> node1 splits f1 at 3.5 (dt=8);
+#   node1 left -> leaf1 (-0.20); right -> leaf2 (+0.30).
+# Tree 1 (categorical): node0 on f2, categories {1,3} go left (bitset word
+#   0b1010 = 10), dt=9 (cat|nan-missing); left -> leaf0 (-0.05);
+#   right -> leaf1 (+0.05).
+_TREE0 = """Tree=0
+num_leaves=3
+num_cat=0
+split_feature=0 1
+split_gain=10 5
+threshold=0.5 3.5
+decision_type=10 8
+left_child=-1 -2
+right_child=1 -3
+leaf_value=0.10 -0.20 0.30
+leaf_weight=10 20 30
+leaf_count=10 20 30
+internal_value=0 0.05
+internal_weight=60 50
+internal_count=60 50
+is_linear=0
+shrinkage=0.1
+"""
+
+_TREE1 = """Tree=1
+num_leaves=2
+split_feature=2
+split_gain=2
+threshold=0
+decision_type=9
+left_child=-1
+right_child=-2
+leaf_value=-0.05 0.05
+leaf_weight=30 30
+leaf_count=30 30
+internal_value=0
+internal_weight=60
+internal_count=60
+num_cat=1
+cat_boundaries=0 1
+cat_threshold=10
+is_linear=0
+shrinkage=0.1
+"""
+
+
+def _golden_string():
+    header = "\n".join([
+        "tree",
+        "version=v3",
+        "num_class=1",
+        "num_tree_per_iteration=1",
+        "label_index=0",
+        "max_feature_idx=2",
+        "objective=binary sigmoid:1",
+        "feature_names=f0 f1 f2",
+        "feature_infos=[-1:1] [0:10] 0:1:2:3",
+        f"tree_sizes={len(_TREE0)} {len(_TREE1)}",
+        "",
+    ])
+    return (header + "\n" + _TREE0 + "\n" + _TREE1
+            + "\nend of trees\n\nfeature_importances:\nf0=1\nf1=1\nf2=1\n"
+            "\nparameters:\n[boosting: gbdt]\nend of parameters\n"
+            "\npandas_categorical:null\n")
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + math.exp(-x))
+
+
+class TestGoldenNativeModel:
+    def _load(self):
+        return Booster.from_model_string(_golden_string())
+
+    def test_structure(self):
+        bst = self._load()
+        assert bst.num_trees == 2
+        assert int(bst.trees[0].num_splits) == 2
+        assert int(bst.trees[1].num_splits) == 1
+        assert bool(bst.trees[0].default_left[0])       # dt=10 -> default left
+        assert not bool(bst.trees[0].default_left[1])   # dt=8
+        assert int(bst.trees[1].split_type[0]) == 1     # categorical
+
+    @pytest.mark.parametrize("x,expect_raw", [
+        ([0.3, 0.0, 0.0], 0.10 + 0.05),     # f0<=0.5 left; f2=0 not in {1,3}
+        ([0.8, 2.0, 1.0], -0.20 - 0.05),    # right,f1<=3.5; f2=1 in set->left
+        ([0.8, 5.0, 3.0], 0.30 - 0.05),     # right,right; f2=3 in set
+        ([np.nan, 5.0, 2.0], 0.10 + 0.05),  # NaN default-LEFT; f2=2 not in set
+        ([0.3, 0.0, np.nan], 0.10 + 0.05),  # NaN category -> not member -> right
+    ])
+    def test_handcomputed_predictions(self, x, expect_raw):
+        bst = self._load()
+        raw = bst.raw_score(np.asarray([x], np.float32))
+        np.testing.assert_allclose(raw[0], expect_raw, atol=1e-6)
+        p = bst.predict(np.asarray([x], np.float32))
+        np.testing.assert_allclose(p[0], _sigmoid(expect_raw), atol=1e-6)
+
+
+# -- strict audit of our writer ---------------------------------------------
+
+_REQUIRED_HEADER = ["version=", "num_class=", "num_tree_per_iteration=",
+                    "label_index=", "max_feature_idx=", "objective=",
+                    "feature_names=", "feature_infos=", "tree_sizes="]
+_REQUIRED_TREE = ["num_leaves=", "num_cat=", "split_feature=", "split_gain=",
+                  "threshold=", "decision_type=", "left_child=", "right_child=",
+                  "leaf_value=", "leaf_weight=", "leaf_count=",
+                  "internal_value=", "internal_weight=", "internal_count=",
+                  "shrinkage="]
+
+
+class TestWriterFormatAudit:
+    def _model(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(800, 4)).astype(np.float32)
+        X[rng.random(800) < 0.2, 0] = np.nan            # exercise missing_type
+        X[:, 3] = rng.integers(0, 5, size=800)          # categorical
+        y = (np.nan_to_num(X[:, 0]) + X[:, 1] > 0).astype(np.float32)
+        cfg = BoosterConfig(objective="binary", num_iterations=4, num_leaves=8,
+                            min_data_in_leaf=10)
+        return train_booster(X, y, cfg, categorical_features=[3])
+
+    def test_field_complete_and_consistent(self):
+        bst = self._model()
+        s = bst.model_string()
+        assert s.startswith("tree\n")
+        header = s.split("\nTree=")[0]
+        for fld in _REQUIRED_HEADER:
+            assert fld in header, f"missing header field {fld}"
+        blocks = s.split("\nTree=")[1:]
+        assert len(blocks) == bst.num_trees
+        for b in blocks:
+            body = "Tree=" + b.split("\nend of trees")[0]
+            fields = dict(line.split("=", 1) for line in body.splitlines()
+                          if "=" in line)
+            nl = int(fields["num_leaves"])
+            ns = nl - 1
+            for fld in _REQUIRED_TREE:
+                assert fld[:-1] in fields, f"missing tree field {fld}"
+            if ns == 0:
+                continue
+            assert len(fields["split_feature"].split()) == ns
+            assert len(fields["threshold"].split()) == ns
+            assert len(fields["decision_type"].split()) == ns
+            assert len(fields["leaf_value"].split()) == nl
+            lc = [int(v) for v in fields["left_child"].split()]
+            rc = [int(v) for v in fields["right_child"].split()]
+            # child pointers: internal in [0, ns), leaves are ~leaf in [-nl, 0)
+            for c in lc + rc:
+                assert (0 <= c < ns) or (-nl <= c < 0), f"bad child ptr {c}"
+            # every leaf and every internal node except root referenced once
+            refs = lc + rc
+            assert sorted(r for r in refs if r < 0) == sorted(
+                -(i + 1) for i in range(nl))
+            assert sorted(r for r in refs if r >= 0) == list(range(1, ns))
+            # thresholds must be finite
+            assert np.isfinite(np.array(fields["threshold"].split(),
+                                        np.float64)).all()
+            # decision_type: cat bit consistent with num_cat
+            dts = np.array(fields["decision_type"].split(), np.int64)
+            assert (dts & 1).sum() == int(fields["num_cat"])
+
+    def test_tree_sizes_byte_accounting(self):
+        bst = self._model()
+        s = bst.model_string()
+        header, _, _ = s.partition("\nTree=")
+        sizes = [int(v) for v in
+                 [l for l in header.splitlines()
+                  if l.startswith("tree_sizes=")][0].split("=")[1].split()]
+        # reconstruct the blocks exactly as emitted and compare byte lengths
+        rest = s[len(header) + 1:]
+        body = rest.split("\nend of trees")[0]
+        blocks = body.split("\n\n")
+        assert len(blocks) == len(sizes)
+        for blk, expect in zip(blocks, sizes):
+            # sizes count each block's bytes incl. its trailing newline plus
+            # the blank separator line
+            assert len(blk.rstrip("\n")) + 2 == expect, \
+                "tree_sizes must count block bytes"
+
+    def test_missing_type_bits(self):
+        bst = self._model()
+        s = bst.model_string()
+        has_nan = bst.mapper.nan_mask
+        for b in s.split("\nTree=")[1:]:
+            body = b.split("\nend of trees")[0]
+            fields = dict(line.split("=", 1) for line in body.splitlines()
+                          if "=" in line)
+            if "split_feature" not in fields or not fields.get("split_feature"):
+                continue
+            sf = np.array(fields["split_feature"].split(), np.int64)
+            dts = np.array(fields["decision_type"].split(), np.int64)
+            for f, dt in zip(sf, dts):
+                missing_type = (dt >> 2) & 3
+                if dt & 1:
+                    continue                      # categorical
+                expect = 2 if has_nan[f] else 0   # 2 = NaN missing
+                assert missing_type == expect, (f, dt)
+
+    def test_loaded_predictions_match(self):
+        bst = self._model()
+        rng = np.random.default_rng(9)
+        Xt = rng.normal(size=(100, 4)).astype(np.float32)
+        Xt[:, 3] = rng.integers(0, 5, size=100)
+        Xt[rng.random(100) < 0.3, 0] = np.nan
+        loaded = Booster.from_model_string(bst.model_string())
+        np.testing.assert_allclose(bst.raw_score(Xt), loaded.raw_score(Xt),
+                                   rtol=1e-4, atol=1e-4)

@@ -1,0 +1,125 @@
+"""Real-architecture ONNX validation (VERDICT next-round #5): a ResNet-50
+(175 nodes: conv/batchnorm/pool/gemm/residual adds) and a transformer encoder
+(50 nodes: matmul/layernorm/softmax attention) written through our protobuf
+writer, imported, sliced at intermediate outputs, and run batched through
+ONNXModel.transform — the ONNXModel.scala:145-423 parity surface."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.onnx.importer import OnnxFunction, import_model
+from synapseml_tpu.onnx.model import ONNXModel
+from synapseml_tpu.onnx.modelgen import make_resnet, make_transformer_encoder
+from synapseml_tpu.onnx.protoio import Model
+
+
+@pytest.fixture(scope="module")
+def resnet_bytes():
+    return make_resnet(50, num_classes=10, image_size=32).encode()
+
+
+@pytest.fixture(scope="module")
+def transformer_bytes():
+    return make_transformer_encoder().encode()
+
+
+def test_resnet50_is_a_real_model(resnet_bytes):
+    m = Model.parse(resnet_bytes)
+    ops = [n.op_type for n in m.graph.nodes]
+    assert len(ops) >= 50
+    for required in ("Conv", "BatchNormalization", "MaxPool",
+                     "GlobalAveragePool", "Gemm", "Add", "Relu"):
+        assert required in ops
+    # 53 convolutions = 1 stem + 3*(3+4+6+3) bottleneck + 4 projections
+    assert ops.count("Conv") == 53
+
+
+def test_resnet50_forward_and_determinism(resnet_bytes):
+    fn = OnnxFunction(Model.parse(resnet_bytes))
+    x = np.random.default_rng(0).normal(size=(4, 3, 32, 32)).astype(np.float32)
+    out1 = fn({"data": x})["logits"]
+    out2 = fn({"data": x})["logits"]
+    assert out1.shape == (4, 10)
+    np.testing.assert_array_equal(out1, out2)
+    # batch consistency: row-wise == batched
+    row = fn({"data": x[:1]})["logits"]
+    np.testing.assert_allclose(row[0], out1[0], rtol=1e-4, atol=1e-4)
+
+
+def test_resnet50_slice_at_intermediate_output(resnet_bytes):
+    """ONNXModel.scala:203-227 model-slicing parity: fetch an internal
+    activation; the plan must prune all nodes not needed for it."""
+    m = Model.parse(resnet_bytes)
+    full = OnnxFunction(m)
+    sliced = OnnxFunction(m, outputs=["stage1_block0_out", "features"])
+    assert len(sliced._plan) < len(full._plan)
+    x = np.random.default_rng(1).normal(size=(2, 3, 32, 32)).astype(np.float32)
+    outs = sliced({"data": x})
+    assert outs["stage1_block0_out"].shape[1] == 512   # 128 * 4 bottleneck
+    assert outs["features"].shape == (2, 2048)
+    # intermediate must match the value computed inside the full run
+    full_outs = OnnxFunction(m, outputs=["features", "logits"])({"data": x})
+    np.testing.assert_allclose(outs["features"], full_outs["features"],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resnet50_batched_transform_with_postops(resnet_bytes):
+    rng = np.random.default_rng(2)
+    n = 10
+    imgs = rng.normal(size=(n, 3, 32, 32)).astype(np.float32)
+    df = Table({"image": list(imgs)})
+    stage = (ONNXModel()
+             .setModelPayload(resnet_bytes)
+             .setFeedDict({"data": "image"})
+             .setFetchDict({"raw": "logits"})
+             .setSoftMaxDict({"raw": "probs"})
+             .setArgMaxDict({"raw": "pred"})
+             .setMiniBatchSize(4))
+    out = stage.transform(df)
+    probs = np.stack(list(out["probs"]))
+    preds = np.asarray(list(out["pred"]))
+    assert probs.shape == (n, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+    assert (preds == probs.argmax(axis=1)).all()
+
+
+def test_transformer_attention_ops_and_slice(transformer_bytes):
+    m = Model.parse(transformer_bytes)
+    ops = [n.op_type for n in m.graph.nodes]
+    assert len(ops) >= 50
+    for required in ("MatMul", "LayerNormalization", "Softmax", "Transpose",
+                     "Gelu", "ReduceMean", "Gemm"):
+        assert required in ops
+    fn = OnnxFunction(m, outputs=["layer0_out", "logits"])
+    x = np.random.default_rng(3).normal(size=(3, 32, 64)).astype(np.float32)
+    outs = fn({"embeddings": x})
+    assert outs["layer0_out"].shape == (3, 32, 64)
+    assert outs["logits"].shape == (3, 2)
+    assert np.isfinite(outs["logits"]).all()
+
+
+def test_transformer_batched_transform(transformer_bytes):
+    rng = np.random.default_rng(4)
+    n = 6
+    embs = rng.normal(size=(n, 32, 64)).astype(np.float32)
+    df = Table({"emb": list(embs)})
+    stage = (ONNXModel()
+             .setModelPayload(transformer_bytes)
+             .setFeedDict({"embeddings": "emb"})
+             .setFetchDict({"logits": "logits"})
+             .setMiniBatchSize(3))
+    out = stage.transform(df)
+    logits = np.stack(list(out["logits"]))
+    assert logits.shape == (n, 2)
+    # equals direct forward
+    direct = OnnxFunction(Model.parse(transformer_bytes))({"embeddings": embs})
+    np.testing.assert_allclose(logits, direct["logits"], rtol=1e-4, atol=1e-4)
+
+
+def test_file_roundtrip(tmp_path, resnet_bytes):
+    p = tmp_path / "resnet50.onnx"
+    p.write_bytes(resnet_bytes)
+    fn = import_model(p.read_bytes())
+    x = np.zeros((1, 3, 32, 32), np.float32)
+    assert fn({"data": x})["logits"].shape == (1, 10)

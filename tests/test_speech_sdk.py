@@ -1,0 +1,159 @@
+"""Websocket SpeechToTextSDK protocol tests against an in-process fake
+Speech service (VERDICT missing #5; reference speech/SpeechToTextSDK.scala).
+The fake server implements the server side of RFC 6455 plus the Speech USP
+framing, so the full client path — handshake, speech.config, chunked audio,
+phrase events, turn.end — is exercised without a network."""
+
+import json
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.io.websocket import (OP_BINARY, OP_TEXT, WebSocketClient,
+                                        decode_frame, encode_frame,
+                                        server_handshake)
+from synapseml_tpu.services.speech import (ConversationTranscription,
+                                           SpeechToTextSDK, usp_audio_message,
+                                           usp_parse_text, usp_text_message)
+
+
+class FakeSpeechServer:
+    """Accepts one websocket session and speaks the Speech USP protocol."""
+
+    def __init__(self, hypotheses=("hel", "hello")):
+        self.hypotheses = hypotheses
+        self.received_audio = b""
+        self.config = None
+        self.request_headers = None
+        self.sock, self.client_sock = socket.socketpair()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _send_text(self, text):
+        self.sock.sendall(encode_frame(OP_TEXT, text.encode(), mask=False))
+
+    def _run(self):
+        try:
+            self.request_headers = server_handshake(self.sock)
+            ended = False
+            while not ended:
+                opcode, fin, payload = decode_frame(self.sock)
+                if opcode == OP_TEXT:
+                    hdrs, body = usp_parse_text(payload)
+                    if hdrs.get("path") == "speech.config":
+                        self.config = body
+                elif opcode == OP_BINARY:
+                    hlen = int.from_bytes(payload[:2], "big")
+                    audio = payload[2 + hlen:]
+                    if not audio:
+                        ended = True
+                    else:
+                        self.received_audio += audio
+            rid = "rid"
+            self._send_text(usp_text_message("speech.startDetected", rid, {}))
+            for h in self.hypotheses:
+                self._send_text(usp_text_message("speech.hypothesis", rid,
+                                                 {"Text": h}))
+            self._send_text(usp_text_message(
+                "speech.phrase", rid,
+                {"RecognitionStatus": "Success", "DisplayText": "hello world",
+                 "Offset": 0, "Duration": 12345}))
+            self._send_text(usp_text_message("speech.endDetected", rid, {}))
+            self._send_text(usp_text_message("turn.end", rid, {}))
+        except Exception:
+            pass
+
+
+def _stage(server, **kwargs):
+    return (SpeechToTextSDK(**kwargs)
+            .set("url", "wss://fake.local")
+            .set("subscriptionKey", "k")
+            .set("wsTransport", lambda url, headers: server.client_sock)
+            .set("outputCol", "events").set("errorCol", "errs"))
+
+
+def test_full_protocol_roundtrip():
+    server = FakeSpeechServer()
+    audio = bytes(np.arange(40000, dtype=np.uint8))
+    df = Table({"audio": np.array([audio], dtype=object)})
+    out = _stage(server).transform(df)
+    server.thread.join(timeout=5)
+    assert out["errs"][0] is None, out["errs"][0]
+    events = out["events"][0]
+    # final phrase captured, hypotheses excluded by default
+    assert [e["_path"] for e in events] == ["speech.phrase"]
+    assert events[0]["DisplayText"] == "hello world"
+    # every audio byte arrived across chunked binary messages
+    assert server.received_audio == audio
+    # speech.config was sent and auth headers reached the handshake
+    assert server.config and "context" in server.config
+    assert server.request_headers.get("ocp-apim-subscription-key") == "k"
+    assert "x-connectionid" in server.request_headers
+
+
+def test_intermediate_hypotheses_streamed():
+    server = FakeSpeechServer()
+    df = Table({"audio": np.array([b"\x00" * 100], dtype=object)})
+    out = _stage(server).set("streamIntermediateResults", True).transform(df)
+    events = out["events"][0]
+    paths = [e["_path"] for e in events]
+    assert paths == ["speech.hypothesis", "speech.hypothesis", "speech.phrase"]
+    assert events[0]["Text"] == "hel"
+
+
+def test_conversation_transcription_shares_protocol():
+    server = FakeSpeechServer()
+    df = Table({"audio": np.array([b"\x01" * 64], dtype=object)})
+    stage = (ConversationTranscription()
+             .set("url", "wss://fake.local")
+             .set("wsTransport", lambda url, headers: server.client_sock)
+             .set("outputCol", "events").set("errorCol", "errs"))
+    out = stage.transform(df)
+    assert out["errs"][0] is None
+    assert out["events"][0][0]["DisplayText"] == "hello world"
+
+
+def test_ws_url_shape():
+    s = SpeechToTextSDK().setLocation("eastus")
+    url = s._ws_url(None, None)
+    assert url.startswith("wss://eastus.stt.speech.microsoft.com/speech/"
+                          "recognition/conversation/cognitiveservices/v1")
+    assert "language=en-US" in url and "format=simple" in url
+
+
+def test_usp_framing_helpers():
+    msg = usp_text_message("speech.config", "abc", {"x": 1})
+    hdrs, body = usp_parse_text(msg.encode())
+    assert hdrs["path"] == "speech.config"
+    assert hdrs["x-requestid"] == "abc"
+    assert body == {"x": 1}
+    framed = usp_audio_message("abc", b"\xde\xad")
+    hlen = int.from_bytes(framed[:2], "big")
+    assert framed[2 + hlen:] == b"\xde\xad"
+    assert b"Path: audio" in framed[2:2 + hlen]
+
+
+def test_websocket_frames_roundtrip():
+    a, b = socket.socketpair()
+    payload = b"x" * 70000          # forces the 64-bit length path
+    a.sendall(encode_frame(OP_BINARY, payload, mask=True))
+    opcode, fin, got = decode_frame(b)
+    assert opcode == OP_BINARY and fin and got == payload
+    a.close(), b.close()
+
+
+def test_handshake_rejection_raises():
+    a, b = socket.socketpair()
+
+    def bad_server():
+        b.recv(65536)
+        b.sendall(b"HTTP/1.1 403 Forbidden\r\n\r\n")
+
+    t = threading.Thread(target=bad_server, daemon=True)
+    t.start()
+    ws = WebSocketClient("ws://x.local/", sock=a)
+    with pytest.raises(Exception, match="handshake"):
+        ws.connect()

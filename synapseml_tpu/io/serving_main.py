@@ -1,0 +1,76 @@
+"""Serving gateway CLI: load a saved pipeline/model and serve it over HTTP.
+
+``python -m synapseml_tpu.io.serving_main --model /path/to/saved_stage
+[--host 0.0.0.0] [--port 8898] [--input-col input] [--output-col output]``
+
+The deployment-unit analog of the reference's Spark Serving query + helm
+chart (tools/helm; HTTPSourceV2.scala WorkerServer): requests POST a JSON
+object of column values, micro-batched into ONE jitted transform per batch,
+and each request receives its row's output column back.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+import numpy as np
+
+
+def build_handler(stage, output_col: str):
+    from ..core.table import Table
+
+    def handler(df: Table) -> Table:
+        n = df.num_rows
+        cols: dict = {}
+        for i, v in enumerate(df["value"]):
+            if not isinstance(v, dict):
+                raise ValueError("request body must be a JSON object of "
+                                 "column values")
+            for k, val in v.items():
+                cols.setdefault(k, [None] * n)[i] = val
+        batch = Table({k: np.asarray(v, dtype=object)
+                       for k, v in cols.items()})
+        out = stage.transform(batch)
+        col = output_col if output_col in out.columns else out.columns[-1]
+        return Table({"id": df["id"], "reply": out[col]})
+
+    return handler
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True,
+                    help="path of a saved PipelineStage (stage.save dir)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8898)
+    ap.add_argument("--output-col", default="prediction")
+    ap.add_argument("--max-batch-size", type=int, default=64)
+    ap.add_argument("--max-batch-latency", type=float, default=0.005)
+    args = ap.parse_args(argv)
+
+    from ..core.pipeline import PipelineStage
+    from .serving import ServingServer
+
+    stage = PipelineStage.load(args.model)
+    server = ServingServer(build_handler(stage, args.output_col),
+                           host=args.host, port=args.port,
+                           max_batch_size=args.max_batch_size,
+                           max_batch_latency=args.max_batch_latency)
+    server.start()
+    print(f"serving {type(stage).__name__} at {server.url}", flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

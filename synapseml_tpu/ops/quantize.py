@@ -154,9 +154,10 @@ def bin_threshold_to_value(mapper: BinMapper, feature: int, bin_id: int) -> floa
     LightGBM model threshold, i.e. the bin's upper boundary). A threshold at or
     beyond the last real-value bin means "every non-missing value goes left"
     (only reachable for features with a NaN bin, where the right child holds
-    the missing rows) — its upper bound is +inf, matching LightGBM's
-    GetUpperBoundValue of the top bin."""
+    the missing rows). Serialized as a large FINITE double (1e308) so model
+    strings stay parseable everywhere (LightGBM also emits finite doubles
+    for top-bin thresholds) while x <= threshold holds for every real x."""
     b = mapper.boundaries[feature]
     if bin_id < len(b) and np.isfinite(b[bin_id]):
         return float(b[bin_id])
-    return float(np.inf)
+    return 1e308

@@ -1,47 +1,68 @@
-"""AI-service REST transformers (host-side).
+"""AI-service transformers (host-side).
 
 Reference: module ``cognitive`` (~10.1k LoC, ~65 transformers; SURVEY.md §2.8).
 All build on the base machinery in base.py (ServiceParams, auth, retries,
-concurrency) over the io/http layer — no device work. Implemented families:
-OpenAI, language/text analytics, translate, vision, face, anomaly, speech,
-document intelligence, search, Bing.
+concurrency, shared LRO polling) over the io/http layer — no device work.
+Implemented families: OpenAI, language/text analytics, translate (incl.
+document translation), vision + face ops, anomaly (incl. the multivariate
+fit lifecycle), speech (REST + streaming websocket SDK), document
+intelligence (incl. custom-model management and ontology learning), search,
+Bing, geospatial.
 """
 
-from .base import CognitiveServiceBase, HasServiceParams, HasSetLocation
+from .base import (CognitiveServiceBase, HasAsyncReply, HasServiceParams,
+                   HasSetLocation)
 from .openai import (OpenAIChatCompletion, OpenAICompletion, OpenAIEmbedding,
                      OpenAIPrompt)
-from .language import (NER, PII, AnalyzeHealthText, EntityLinking,
-                       KeyPhraseExtractor, LanguageDetector, TextSentiment)
-from .translate import (BreakSentence, Detect, DictionaryLookup, Translate,
+from .language import (NER, PII, AnalyzeHealthText, AnalyzeText,
+                       EntityDetector, EntityLinking, KeyPhraseExtractor,
+                       LanguageDetector, TextAnalyze, TextSentiment)
+from .translate import (BreakSentence, Detect, DictionaryExamples,
+                        DictionaryLookup, DocumentTranslator, Translate,
                         Transliterate)
 from .vision import (OCR, AnalyzeImage, DescribeImage, DetectFace,
-                     GenerateThumbnails, TagImage)
+                     FindSimilarFace, GenerateThumbnails, GroupFaces,
+                     IdentifyFaces, ReadImage,
+                     RecognizeDomainSpecificContent, RecognizeText, TagImage,
+                     VerifyFaces)
 from .anomaly import (DetectAnomalies, DetectLastAnomaly,
-                      DetectMultivariateAnomaly, SimpleDetectAnomalies)
-from .speech import AnalyzeDocument, SpeechToText, SpeechToTextSDK, TextToSpeech
-from .search import AzureSearchWriter, BingImageSearch
+                      DetectLastMultivariateAnomaly, DetectMultivariateAnomaly,
+                      SimpleDetectAnomalies, SimpleDetectMultivariateAnomaly,
+                      SimpleFitMultivariateAnomaly)
+from .speech import (AnalyzeDocument, ConversationTranscription,
+                     SpeakerEmotionInference, SpeechToText, SpeechToTextSDK,
+                     TextToSpeech)
+from .search import AddDocuments, AzureSearchWriter, BingImageSearch
 from .geospatial import (AddressGeocoder, CheckPointInPolygon,
                          ReverseAddressGeocoder)
 from .form import (AnalyzeBusinessCards, AnalyzeCustomModel,
                    AnalyzeDocumentRead, AnalyzeIDDocuments, AnalyzeInvoices,
-                   AnalyzeLayout, AnalyzeReceipts)
+                   AnalyzeLayout, AnalyzeReceipts, FormOntologyLearner,
+                   FormOntologyTransformer, GetCustomModel, ListCustomModels)
 
 __all__ = [
-    "CognitiveServiceBase", "HasServiceParams", "HasSetLocation",
+    "CognitiveServiceBase", "HasAsyncReply", "HasServiceParams",
+    "HasSetLocation",
     "OpenAICompletion", "OpenAIChatCompletion", "OpenAIEmbedding",
     "OpenAIPrompt",
     "TextSentiment", "KeyPhraseExtractor", "NER", "PII", "EntityLinking",
-    "LanguageDetector", "AnalyzeHealthText",
+    "EntityDetector", "LanguageDetector", "AnalyzeHealthText", "AnalyzeText",
+    "TextAnalyze",
     "Translate", "Transliterate", "Detect", "BreakSentence",
-    "DictionaryLookup",
+    "DictionaryLookup", "DictionaryExamples", "DocumentTranslator",
     "AnalyzeImage", "DescribeImage", "TagImage", "OCR", "GenerateThumbnails",
-    "DetectFace",
+    "ReadImage", "RecognizeText", "RecognizeDomainSpecificContent",
+    "DetectFace", "FindSimilarFace", "GroupFaces", "IdentifyFaces",
+    "VerifyFaces",
     "DetectLastAnomaly", "DetectAnomalies", "SimpleDetectAnomalies",
-    "DetectMultivariateAnomaly",
-    "SpeechToText", "SpeechToTextSDK", "TextToSpeech", "AnalyzeDocument",
-    "AzureSearchWriter", "BingImageSearch",
+    "DetectMultivariateAnomaly", "DetectLastMultivariateAnomaly",
+    "SimpleFitMultivariateAnomaly", "SimpleDetectMultivariateAnomaly",
+    "SpeechToText", "SpeechToTextSDK", "ConversationTranscription",
+    "SpeakerEmotionInference", "TextToSpeech", "AnalyzeDocument",
+    "AzureSearchWriter", "AddDocuments", "BingImageSearch",
     "AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon",
     "AnalyzeLayout", "AnalyzeReceipts", "AnalyzeBusinessCards",
     "AnalyzeInvoices", "AnalyzeIDDocuments", "AnalyzeDocumentRead",
-    "AnalyzeCustomModel",
+    "AnalyzeCustomModel", "GetCustomModel", "ListCustomModels",
+    "FormOntologyLearner", "FormOntologyTransformer",
 ]

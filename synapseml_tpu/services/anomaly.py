@@ -14,6 +14,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..core.params import Param
+from ..core.pipeline import Estimator
 from ..core.table import Table
 from ..io.http import HTTPRequestData
 from .base import HasAsyncReply, HasSetLocation
@@ -83,6 +84,11 @@ class DetectMultivariateAnomaly(HasAsyncReply, _AnomalyBase):
     infer lifecycle (SimpleDetectMultivariateAnomaly). ``train`` submits the
     model and polls until ready; ``_prepare_body`` runs inference."""
 
+    # model training takes minutes: widen the shared LRO defaults
+    pollInterval = Param("pollInterval", "seconds between status polls",
+                         float, 5.0)
+    maxPollRetries = Param("maxPollRetries", "max status polls", int, 120)
+
     @staticmethod
     def _status_of(info: dict) -> str:
         # model status lives under modelInfo.status
@@ -148,12 +154,19 @@ class DetectLastMultivariateAnomaly(DetectMultivariateAnomaly):
     DetectLastMultivariateAnomaly — POST {modelId}:detect-last)."""
 
 
-class SimpleFitMultivariateAnomaly(DetectMultivariateAnomaly):
+class SimpleFitMultivariateAnomaly(Estimator, DetectMultivariateAnomaly):
     """Estimator facade over the train → poll lifecycle (reference
     SimpleFitMultivariateAnomaly): ``fit`` submits training, polls to READY
-    and returns a SimpleDetectMultivariateAnomaly bound to the model id."""
+    and returns a SimpleDetectMultivariateAnomaly bound to the model id.
+    Training reads from the ``dataSource`` blob, so ``fit()`` may be called
+    without a dataframe."""
 
-    def fit(self, df: Optional[Table] = None) -> "SimpleDetectMultivariateAnomaly":
+    def fit(self, df=None, params=None):
+        if df is None:
+            return self._fit(None)
+        return super().fit(df, params)
+
+    def _fit(self, df: Optional[Table] = None) -> "SimpleDetectMultivariateAnomaly":
         model_id = self.train()
         m = SimpleDetectMultivariateAnomaly()
         for p in ("url", "subscriptionKey", "seriesCol", "pollInterval",
@@ -162,9 +175,6 @@ class SimpleFitMultivariateAnomaly(DetectMultivariateAnomaly):
                 m.set(p, self.get(p))
         m.set("modelId", model_id)
         return m
-
-    def _fit(self, df):
-        return self.fit(df)
 
 
 class SimpleDetectMultivariateAnomaly(DetectMultivariateAnomaly):

@@ -194,11 +194,15 @@ class HasAsyncReply(CognitiveServiceBase):
         first = super()._send_one(req)
         if first is None or first.status_code not in (200, 201, 202):
             return first
+        # Operation-Location always marks an LRO; a plain Location only does
+        # on 201/202 (a 200 with Location is a complete response — return it)
         loc = None
         for k, v in (first.headers or {}).items():
-            if k.lower() in ("operation-location", "location"):
+            if k.lower() == "operation-location":
                 loc = v
                 break
+            if k.lower() == "location" and first.status_code in (201, 202):
+                loc = v
         if not loc:
             return first
         headers = {k: v for k, v in req.headers.items()

@@ -10,6 +10,7 @@ pin the prebuilt model ids.
 from __future__ import annotations
 
 from ..core.params import Param
+from ..core.pipeline import Estimator, Transformer
 from .speech import AnalyzeDocument
 
 
@@ -88,7 +89,7 @@ class ListCustomModels(GetCustomModel):
                 f"?api-version={self.getApiVersion()}")
 
 
-class FormOntologyLearner(AnalyzeDocument):
+class FormOntologyLearner(Estimator):
     """Estimator over AnalyzeDocument outputs: learns the union schema
     ("ontology") of extracted document fields, producing a
     FormOntologyTransformer that projects each document's fields onto the
@@ -96,10 +97,10 @@ class FormOntologyLearner(AnalyzeDocument):
 
     inputCol = Param("inputCol", "column of analyzeResult outputs", str)
 
-    def fit(self, df):
+    def _fit(self, df):
         from collections import OrderedDict
 
-        col = self.get("inputCol") or self.get("outputCol")
+        col = self.get("inputCol")
         fields: "OrderedDict[str, str]" = OrderedDict()
         for v in df[col]:
             for doc in ((v or {}).get("analyzeResult", v or {}) or
@@ -110,11 +111,8 @@ class FormOntologyLearner(AnalyzeDocument):
         t.set("inputCol", col)
         return t
 
-    def _fit(self, df):  # Estimator protocol alias
-        return self.fit(df)
 
-
-class FormOntologyTransformer(AnalyzeDocument):
+class FormOntologyTransformer(Transformer):
     """Projects analyzeResult documents onto the learned ontology columns
     (reference FormOntologyTransformer)."""
 
@@ -124,7 +122,7 @@ class FormOntologyTransformer(AnalyzeDocument):
     def _transform(self, df):
         import numpy as np
 
-        col = self.get("inputCol") or self.get("outputCol")
+        col = self.get("inputCol")
         onto = self.get("ontology") or {}
         out = df.copy()
         cols = {name: np.empty(df.num_rows, dtype=object) for name in onto}

@@ -179,10 +179,15 @@ class AddDocuments(CognitiveServiceBase):
 
 
 def _to_plain(v):
+    import base64
+
     import numpy as np
 
     if isinstance(v, np.generic):
         return v.item()
     if isinstance(v, np.ndarray):
         return v.tolist()
+    if isinstance(v, (bytes, bytearray)):
+        # Azure Search binary fields are base64 (Edm.Binary)
+        return base64.b64encode(bytes(v)).decode()
     return v

@@ -267,7 +267,12 @@ def train_booster(
     callbacks: Optional[List[Callable]] = None,
     mapper: Optional[BinMapper] = None,       # pre-computed reference dataset analog
     mesh=None,                                # jax.sharding.Mesh: shard rows over DATA_AXIS
+    measures=None,                            # InstrumentationMeasures (§5.1)
 ) -> Booster:
+    from ..core.logging import InstrumentationMeasures
+
+    if measures is None:
+        measures = InstrumentationMeasures()
     cfg = config
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.float32)
@@ -281,8 +286,11 @@ def train_booster(
     rng = np.random.default_rng(cfg.seed)
 
     if mapper is None:
-        mapper = compute_bin_mapper(X, cfg.max_bin, cfg.bin_sample_count,
-                                    categorical_features, cfg.seed)
+        # sampling + bin-boundary phase (reference: samplingParameters /
+        # columnStatistics spans in LightGBMPerformance.scala)
+        with measures.span("referenceDataset"):
+            mapper = compute_bin_mapper(X, cfg.max_bin, cfg.bin_sample_count,
+                                        categorical_features, cfg.seed)
 
     # Multi-chip: pad rows to the data-axis size and shard. The padding rows get
     # in_bag = 0, so they contribute nothing to histograms or leaf stats; GSPMD
@@ -302,7 +310,8 @@ def train_booster(
                 init_score = np.concatenate(
                     [np.asarray(init_score), np.zeros(rem, np.float32)])
     n = X.shape[0]
-    binned = apply_bins(mapper, X)
+    with measures.span("dataPreparation"):
+        binned = apply_bins(mapper, X)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..parallel.mesh import DATA_AXIS as _DA
@@ -543,6 +552,7 @@ def train_booster(
         carry = (score, in_bag_cur, score_v0)
         mvals_list = []
         done = 0
+        t_train = __import__("time").perf_counter()
         while done < T:
             c = min(chunk, T - done)
             carry, (stacked_trees, mv) = run_scan(*carry, done, c)
@@ -562,6 +572,10 @@ def train_booster(
                             cfg.early_stopping_round:
                         break
         score = carry[0]
+        measures.spans["trainingIterations"] = (
+            measures.spans.get("trainingIterations", 0.0)
+            + __import__("time").perf_counter() - t_train)
+        measures.count("iterations", done)
 
         best_iter = -1
         if has_valid:

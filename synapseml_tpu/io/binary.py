@@ -70,3 +70,21 @@ def read_image_dir(path: str, pattern: Optional[str] = None,
     for i, im in enumerate(imgs):
         col[i] = im
     return Table({"path": np.asarray(kept, dtype=object), "image": col})
+
+
+def load_numeric_csv(path: str, has_header: bool = True) -> "np.ndarray":
+    """Dense float32 ingest for training matrices: C++ fast path
+    (native.read_numeric_csv) with a numpy fallback. Empty/unparseable
+    fields become NaN (routed by the GBDT engine's learned default_left)."""
+    from ..native import read_numeric_csv
+
+    out = read_numeric_csv(path, has_header)
+    if out is not None:
+        return out
+    # fallback matches the native reader's delimiter handling (comma or tab)
+    with open(path) as f:
+        first = f.readline()
+    delim = "\t" if ("\t" in first and "," not in first) else ","
+    return np.genfromtxt(path, delimiter=delim,
+                         skip_header=1 if has_header else 0,
+                         dtype=np.float32)

@@ -1,7 +1,7 @@
 """Serving gateway CLI: load a saved pipeline/model and serve it over HTTP.
 
 ``python -m synapseml_tpu.io.serving_main --model /path/to/saved_stage
-[--host 0.0.0.0] [--port 8898] [--input-col input] [--output-col output]``
+[--host 0.0.0.0] [--port 8898] [--output-col prediction]``
 
 The deployment-unit analog of the reference's Spark Serving query + helm
 chart (tools/helm; HTTPSourceV2.scala WorkerServer): requests POST a JSON

@@ -293,7 +293,11 @@ class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPr
         return model
 
     def _run_batches(self, X, y, w, init, cfg, valid):
-        """numBatches warm-started sequential fits (LightGBMBase.scala:39-64)."""
+        """numBatches warm-started sequential fits (LightGBMBase.scala:39-64),
+        instrumented with phase spans (LightGBMPerformance analog, §5.1)."""
+        from ..core.logging import InstrumentationMeasures
+
+        measures = InstrumentationMeasures()
         cats = self._categorical_indexes(self.get("slotNames"))
         init_model = None
         if self.get("modelString"):
@@ -309,11 +313,15 @@ class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPr
                                     sample_weight=None if w is None else w[part],
                                     init_score=None if init is None else init[part],
                                     categorical_features=cats, valid=valid,
-                                    feature_names=self.get("slotNames"), init_model=bst)
-            return bst
-        return train_booster(X, y, cfg, sample_weight=w, init_score=init,
-                             categorical_features=cats, valid=valid,
-                             feature_names=self.get("slotNames"), init_model=init_model)
+                                    feature_names=self.get("slotNames"), init_model=bst,
+                                    measures=measures)
+        else:
+            bst = train_booster(X, y, cfg, sample_weight=w, init_score=init,
+                                categorical_features=cats, valid=valid,
+                                feature_names=self.get("slotNames"),
+                                init_model=init_model, measures=measures)
+        self._log_base("trainingMeasures", measures.report())
+        return bst
 
     def _copy_model_params(self, model):
         for p in ("featuresCol", "predictionCol", "probabilityCol", "rawPredictionCol",

@@ -42,6 +42,34 @@ def _build() -> bool:
         return False
 
 
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.sml_murmur3_32.restype = ctypes.c_uint32
+    lib.sml_murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                   ctypes.c_uint32]
+    lib.sml_hash_batch.restype = None
+    lib.sml_hash_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+        ctypes.c_int, ctypes.c_uint32, ctypes.c_void_p]
+    lib.sml_hash_batch_seeded.restype = None
+    lib.sml_hash_batch_seeded.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_uint32, ctypes.c_void_p]
+    lib.sml_hash_tf.restype = None
+    lib.sml_hash_tf.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_int64, ctypes.c_int, ctypes.c_void_p]
+    if hasattr(lib, "csv_dims"):
+        lib.csv_dims.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                 ctypes.POINTER(ctypes.c_int64),
+                                 ctypes.POINTER(ctypes.c_int64)]
+        lib.csv_dims.restype = ctypes.c_int
+        lib.csv_read_f32.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_int64, ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_float)]
+        lib.csv_read_f32.restype = ctypes.c_int64
+    return lib
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None or _tried:
@@ -56,22 +84,13 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
-        lib.sml_murmur3_32.restype = ctypes.c_uint32
-        lib.sml_murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
-                                       ctypes.c_uint32]
-        lib.sml_hash_batch.restype = None
-        lib.sml_hash_batch.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
-            ctypes.c_int, ctypes.c_uint32, ctypes.c_void_p]
-        lib.sml_hash_batch_seeded.restype = None
-        lib.sml_hash_batch_seeded.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
-            ctypes.c_int, ctypes.c_uint32, ctypes.c_void_p]
-        lib.sml_hash_tf.restype = None
-        lib.sml_hash_tf.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
-            ctypes.c_uint32, ctypes.c_int64, ctypes.c_int, ctypes.c_void_p]
-        _lib = lib
+        if not hasattr(lib, "csv_dims") and _build():
+            # stale .so predating the CSV reader: rebuilt above; reload
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                pass  # keep the old lib — CSV falls back to numpy
+        _lib = _bind(lib)
         return _lib
 
 
@@ -141,3 +160,27 @@ def hash_tf(docs: Sequence[str], num_features: int, seed: int = 0,
                     min_len, int(binary),
                     out.ctypes.data_as(ctypes.c_void_p))
     return out
+
+
+def read_numeric_csv(path: str, has_header: bool = True):
+    """Dense float32 matrix from a numeric CSV via the C++ reader (empty /
+    non-numeric fields -> NaN, LightGBM's missing convention); None when the
+    native library is unavailable (callers fall back to numpy). The native
+    data-plane analog of the reference's chunked dataset aggregation
+    (dataset/DatasetAggregator.scala:117-589)."""
+    lib = _load()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.csv_dims(path.encode(), int(has_header),
+                      ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0 or rows.value <= 0 or cols.value <= 0:
+        return None
+    out = np.empty((rows.value, cols.value), np.float32)
+    got = lib.csv_read_f32(path.encode(), int(has_header), rows.value,
+                           cols.value,
+                           out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    if got < 0:
+        return None
+    return out[:got]

@@ -13,6 +13,8 @@
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
+#include <cstdio>
+#include <cmath>
 
 namespace {
 
@@ -170,6 +172,94 @@ void sml_hash_tf(const uint8_t* buf, const int64_t* doc_offsets, int64_t n_docs,
       }
     }
   }
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Fast numeric-CSV reader — the data-plane native path (the reference's
+// dataset marshaling layer, dataset/DatasetAggregator.scala:117-589, is C++
+// chunked-array aggregation behind SWIG; here the hot ingest loop is native
+// and the Python Table wraps the filled float32 buffer zero-copy).
+// Parses comma/tab-separated floats with optional header; empty fields and
+// unparseable tokens become NaN (LightGBM's missing convention).
+extern "C" {
+
+// First pass: count rows (excluding header) and columns. Returns 0 on
+// success, nonzero on IO error.
+int csv_dims(const char* path, int has_header, int64_t* out_rows,
+             int64_t* out_cols) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return 1;
+  int64_t rows = 0, cols = 0;
+  int64_t line_cols = 1;
+  int c, prev = '\n';
+  int first_line = 1;
+  while ((c = fgetc(f)) != EOF) {
+    if (c == ',' || c == '\t') {
+      if (first_line) line_cols++;
+    } else if (c == '\n') {
+      if (prev != '\n') {  // skip blank lines
+        if (first_line) { cols = line_cols; first_line = 0; }
+        rows++;
+      }
+      line_cols = 1;
+    }
+    prev = c;
+  }
+  if (prev != '\n' && prev != EOF) rows++;  // trailing line without newline
+  if (first_line && rows > 0) cols = line_cols;
+  fclose(f);
+  if (has_header && rows > 0) rows--;
+  *out_rows = rows;
+  *out_cols = cols;
+  return 0;
+}
+
+// Second pass: fill the caller-allocated row-major float32 buffer.
+// Returns number of rows actually parsed (or -1 on IO error).
+int64_t csv_read_f32(const char* path, int has_header, int64_t rows,
+                     int64_t cols, float* out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  // buffered line reader
+  const size_t BUF = 1 << 20;
+  char* buf = static_cast<char*>(malloc(BUF));
+  if (!buf) { fclose(f); return -1; }
+  int64_t r = 0;
+  int skipped_header = has_header ? 0 : 1;
+  while (r < rows && fgets(buf, BUF, f)) {
+    size_t len = strlen(buf);
+    if (len + 1 >= BUF && buf[len - 1] != '\n') {
+      // physical line exceeds the buffer: refuse to mis-parse — signal error
+      free(buf);
+      fclose(f);
+      return -2;
+    }
+    // skip blank lines
+    char* p = buf;
+    while (*p == ' ' || *p == '\r') p++;
+    if (*p == '\n' || *p == '\0') continue;
+    if (!skipped_header) { skipped_header = 1; continue; }
+    for (int64_t j = 0; j < cols; j++) {
+      while (*p == ' ') p++;
+      char* end = p;
+      if (*p == '\0' || *p == '\n' || *p == '\r' || *p == ',' || *p == '\t') {
+        out[r * cols + j] = NAN;  // empty field
+      } else {
+        float v = strtof(p, &end);
+        out[r * cols + j] = (end == p) ? NAN : v;
+        p = end;
+      }
+      // advance past the delimiter (or to line end)
+      while (*p != '\0' && *p != ',' && *p != '\t' && *p != '\n') p++;
+      if (*p == ',' || *p == '\t') p++;
+    }
+    r++;
+  }
+  free(buf);
+  fclose(f);
+  return r;
 }
 
 }  // extern "C"

@@ -79,3 +79,70 @@ class TestNativeSpeed:
                     count=len(names))
         t_py = time.perf_counter() - t0
         assert t_native < t_py, (t_native, t_py)
+
+
+class TestNativeCSV:
+    def _write_csv(self, tmp_path, text):
+        p = tmp_path / "data.csv"
+        p.write_text(text)
+        return str(p)
+
+    def test_numeric_csv_parity_with_numpy(self, tmp_path):
+        import numpy as np
+
+        from synapseml_tpu.io.binary import load_numeric_csv
+        from synapseml_tpu.native import available, read_numeric_csv
+
+        rng = np.random.default_rng(0)
+        M = rng.normal(size=(200, 6)).astype(np.float32)
+        lines = ["c0,c1,c2,c3,c4,c5"]
+        for row in M:
+            lines.append(",".join(f"{v:.6g}" for v in row))
+        p = self._write_csv(tmp_path, "\n".join(lines) + "\n")
+        got = load_numeric_csv(p)
+        assert got.shape == M.shape
+        np.testing.assert_allclose(got, M, rtol=1e-5)
+        if available():
+            native = read_numeric_csv(p)
+            np.testing.assert_allclose(native, M, rtol=1e-5)
+
+    def test_missing_and_bad_fields_become_nan(self, tmp_path):
+        import numpy as np
+
+        from synapseml_tpu.io.binary import load_numeric_csv
+
+        p = self._write_csv(tmp_path, "a,b,c\n1,,3\n,abc,6\n7,8,9\n")
+        got = load_numeric_csv(p)
+        assert got.shape == (3, 3)
+        assert np.isnan(got[0, 1]) and np.isnan(got[1, 0])
+        assert np.isnan(got[1, 1])
+        np.testing.assert_allclose(got[2], [7, 8, 9])
+
+    def test_no_header_and_trailing_newline_variants(self, tmp_path):
+        import numpy as np
+
+        from synapseml_tpu.io.binary import load_numeric_csv
+
+        p = self._write_csv(tmp_path, "1,2\n3,4")      # no trailing newline
+        got = load_numeric_csv(p, has_header=False)
+        np.testing.assert_allclose(got, [[1, 2], [3, 4]])
+
+    def test_feeds_training_end_to_end(self, tmp_path):
+        import numpy as np
+
+        from synapseml_tpu.gbdt import BoosterConfig, train_booster
+        from synapseml_tpu.io.binary import load_numeric_csv
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(400, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        lines = ["f0,f1,f2,f3,label"]
+        for row, lab in zip(X, y):
+            lines.append(",".join(f"{v:.6g}" for v in row) + f",{lab:g}")
+        p = self._write_csv(tmp_path, "\n".join(lines) + "\n")
+        M = load_numeric_csv(p)
+        bst = train_booster(M[:, :4], M[:, 4],
+                            BoosterConfig(objective="binary",
+                                          num_iterations=5))
+        acc = ((bst.predict(M[:, :4]) > 0.5) == (M[:, 4] > 0.5)).mean()
+        assert acc > 0.9

@@ -61,3 +61,40 @@ class TestVotingTraining:
         est = LightGBMClassifier(parallelism="voting_parallel", topK=8)
         cfg = est._base_config()
         assert cfg.tree_learner == "voting" and cfg.top_k == 8
+
+
+class TestVotingCommVolume:
+    def test_per_split_histogram_bytes_reduced(self):
+        """The REASON voting-parallel exists (PV-Tree; LightGBMParams.scala:
+        25-27): each split's cross-chip histogram reduction shrinks from all
+        F features to the 2k voted ones. Measured from the actual shapes the
+        grower psums — (features_padded(f), pad_bins(B), 3) f32 — so a change
+        that silently grows the voting path's comm volume fails here."""
+        from synapseml_tpu.gbdt.voting import voting_select
+        from synapseml_tpu.ops.hist_kernel import features_padded, pad_bins
+
+        F, top_k, max_bin = 128, 8, 63
+        X, y = _wide_data(n=512, f=F)
+        mesh = make_mesh({"data": 8})
+        from synapseml_tpu.ops.quantize import apply_bins, compute_bin_mapper
+        import jax.numpy as jnp
+
+        mapper = compute_bin_mapper(X, max_bin)
+        binned = apply_bins(mapper, X)
+        g = jnp.asarray(0.5 - y)
+        h = jnp.full(len(y), 0.25)
+        bag = jnp.ones(len(y))
+        sel = voting_select(binned, g, h, bag, mesh, top_k, max_bin, 0.0, 1,
+                            feature_active=jnp.ones(F, bool))
+        assert len(sel) == 2 * top_k
+
+        def hist_bytes(nfeat):
+            return features_padded(nfeat) * pad_bins(max_bin) * 3 * 4
+
+        full = hist_bytes(F)
+        vote = hist_bytes(len(sel))
+        # voting's one-time vote exchange: per-feature root gains + top-k ids
+        vote_overhead = F * 4 + top_k * 4
+        assert vote + vote_overhead < full / 4, (vote, full)
+        # ... an 8x reduction for F=128, top_k=8
+        assert full // vote == features_padded(F) // features_padded(2 * top_k)

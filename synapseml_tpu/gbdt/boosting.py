@@ -552,7 +552,8 @@ def train_booster(
         carry = (score, in_bag_cur, score_v0)
         mvals_list = []
         done = 0
-        t_train = __import__("time").perf_counter()
+        train_span = measures.span("trainingIterations")
+        train_span.__enter__()
         while done < T:
             c = min(chunk, T - done)
             carry, (stacked_trees, mv) = run_scan(*carry, done, c)
@@ -572,9 +573,7 @@ def train_booster(
                             cfg.early_stopping_round:
                         break
         score = carry[0]
-        measures.spans["trainingIterations"] = (
-            measures.spans.get("trainingIterations", 0.0)
-            + __import__("time").perf_counter() - t_train)
+        train_span.__exit__(None, None, None)
         measures.count("iterations", done)
 
         best_iter = -1

@@ -85,6 +85,7 @@ def load_numeric_csv(path: str, has_header: bool = True) -> "np.ndarray":
     with open(path) as f:
         first = f.readline()
     delim = "\t" if ("\t" in first and "," not in first) else ","
-    return np.genfromtxt(path, delimiter=delim,
-                         skip_header=1 if has_header else 0,
-                         dtype=np.float32)
+    out = np.genfromtxt(path, delimiter=delim,
+                        skip_header=1 if has_header else 0,
+                        dtype=np.float32)
+    return np.atleast_2d(out)   # match the native reader's (rows, cols)

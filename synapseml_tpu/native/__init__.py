@@ -169,8 +169,8 @@ def read_numeric_csv(path: str, has_header: bool = True):
     data-plane analog of the reference's chunked dataset aggregation
     (dataset/DatasetAggregator.scala:117-589)."""
     lib = _load()
-    if lib is None:
-        return None
+    if lib is None or not hasattr(lib, "csv_dims"):
+        return None     # no native lib, or a stale .so without the symbols
     rows = ctypes.c_int64()
     cols = ctypes.c_int64()
     rc = lib.csv_dims(path.encode(), int(has_header),

@@ -10,6 +10,7 @@ from .mesh import (  # noqa: F401
     shard_rows,
     process_topology,
 )
+from .ulysses import ulysses_self_attention  # noqa: F401
 from .ring_attention import (  # noqa: F401
     attention_reference,
     blockwise_attention,

@@ -1,0 +1,80 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism — all-to-all head scatter.
+
+The second first-class long-context strategy next to ring attention
+(parallel/ring_attention.py). Where ring attention keeps heads whole and
+rotates K/V blocks around the ICI ring, Ulysses re-shards between the two
+natural layouts with a single ``all_to_all`` each way:
+
+    sequence-sharded [B, S/p, H,  D]   (how transformer blocks hold tokens)
+      → head-sharded [B, S,   H/p, D]  (full sequence per device → EXACT
+                                        attention, no online softmax)
+      → back to sequence-sharded for the MLP that follows.
+
+Comm volume per layer is 2 all-to-alls of the activation (vs ring's p-1
+ppermutes of K/V); Ulysses wins when heads >= devices and the attention
+kernel benefits from seeing the whole sequence (e.g. one flash/blockwise call
+on the MXU), ring wins when S/p is still long or heads < devices. Both ride
+ICI over the same ``seq`` mesh axis so they are interchangeable in a model.
+
+The reference has NO sequence parallelism at all (SURVEY.md §5.7); this is
+parity-plus, designed in from the start per the distributed-first mandate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS, SEQ_AXIS
+
+
+def _attend(q, k, v, causal: bool, scale):
+    """Exact attention on full sequences: [B, S, H, D] per device."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qp = jnp.arange(q.shape[1])
+        mask = qp[:, None] >= qp[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ulysses_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
+                           scale=None):
+    """Self-attention over sequence-sharded inputs via all-to-all re-sharding.
+
+    q/k/v: [B, S, H, D] GLOBAL shapes, sharded [data, seq, None, None] on
+    ``mesh``. The number of heads H must be divisible by the seq-axis size.
+    Returns the attention output with the same sharding as the inputs.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    sp = mesh.shape[SEQ_AXIS]
+    if q.shape[2] % sp:
+        raise ValueError(f"heads ({q.shape[2]}) must divide by the seq-axis "
+                         f"size ({sp}) for Ulysses attention")
+
+    def _ulysses(q_blk, k_blk, v_blk):
+        # per-device blocks: [B_l, S/p, H, D]
+        def seq_to_heads(x):
+            # scatter heads, gather sequence: [B, S/p, H, D] -> [B, S, H/p, D]
+            x = jax.lax.all_to_all(x, SEQ_AXIS, split_axis=2, concat_axis=1,
+                                   tiled=True)
+            return x
+
+        def heads_to_seq(x):
+            # inverse all-to-all: [B, S, H/p, D] -> [B, S/p, H, D]
+            return jax.lax.all_to_all(x, SEQ_AXIS, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        qh, kh, vh = seq_to_heads(q_blk), seq_to_heads(k_blk), seq_to_heads(v_blk)
+        out = _attend(qh, kh, vh, causal, scale)
+        return heads_to_seq(out)
+
+    spec = P(DATA_AXIS, SEQ_AXIS, None, None)
+    from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(_ulysses, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_rep=False)
+    return fn(q, k, v)

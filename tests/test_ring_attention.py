@@ -64,3 +64,68 @@ class TestBlockwiseAttention:
         q, k, v = _qkv(s=30)
         with pytest.raises(ValueError, match="not divisible"):
             blockwise_attention(q, k, v, block_size=16)
+
+
+class TestUlysses:
+    def test_matches_reference_bidirectional(self):
+        import numpy as np
+
+        from synapseml_tpu.parallel import make_mesh
+        from synapseml_tpu.parallel.ring_attention import attention_reference
+        from synapseml_tpu.parallel.ulysses import ulysses_self_attention
+
+        rng = np.random.default_rng(0)
+        mesh = make_mesh({"data": 2, "seq": 4})
+        B, S, H, D = 2, 32, 8, 16
+        q, k, v = (rng.normal(size=(B, S, H, D)).astype(np.float32)
+                   for _ in range(3))
+        out = ulysses_self_attention(q, k, v, mesh)
+        ref = attention_reference(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4)
+
+    def test_matches_reference_causal(self):
+        import numpy as np
+
+        from synapseml_tpu.parallel import make_mesh
+        from synapseml_tpu.parallel.ring_attention import attention_reference
+        from synapseml_tpu.parallel.ulysses import ulysses_self_attention
+
+        rng = np.random.default_rng(1)
+        mesh = make_mesh({"data": 1, "seq": 8})
+        B, S, H, D = 1, 64, 8, 8
+        q, k, v = (rng.normal(size=(B, S, H, D)).astype(np.float32)
+                   for _ in range(3))
+        out = ulysses_self_attention(q, k, v, mesh, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4)
+
+    def test_matches_ring_attention(self):
+        """The two sequence-parallel strategies are interchangeable: same
+        math, different comm pattern."""
+        import numpy as np
+
+        from synapseml_tpu.parallel import make_mesh, ring_self_attention
+        from synapseml_tpu.parallel.ulysses import ulysses_self_attention
+
+        rng = np.random.default_rng(2)
+        mesh = make_mesh({"data": 2, "seq": 4})
+        B, S, H, D = 2, 32, 4, 8
+        q, k, v = (rng.normal(size=(B, S, H, D)).astype(np.float32)
+                   for _ in range(3))
+        u = ulysses_self_attention(q, k, v, mesh, causal=True)
+        r = ring_self_attention(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(u), np.asarray(r), atol=2e-4)
+
+    def test_head_divisibility_error(self):
+        import numpy as np
+        import pytest
+
+        from synapseml_tpu.parallel import make_mesh
+        from synapseml_tpu.parallel.ulysses import ulysses_self_attention
+
+        mesh = make_mesh({"data": 1, "seq": 8})
+        x = np.zeros((1, 16, 6, 4), np.float32)   # 6 heads, 8-way seq
+        with pytest.raises(ValueError, match="heads"):
+            ulysses_self_attention(x, x, x, mesh)

@@ -49,6 +49,12 @@ class TrainConfig:
     checkpoint_dir: Optional[str] = None
     save_every_epochs: int = 1
     resume: bool = True  # pick up from the latest checkpoint when present
+    # parameter placement over the mesh: "replicated" (plain data-parallel)
+    # or "fsdp" (ZeRO-3-style — each param's largest divisible axis is
+    # sharded over the data axis; XLA all-gathers at use and reduce-scatters
+    # gradients, from shardings alone). The reference's Horovod stack has no
+    # sharded-parameter mode at all (SURVEY §2.2 "NOT PRESENT").
+    param_sharding: str = "replicated"  # replicated | fsdp
 
 
 def _make_tx(cfg: TrainConfig, total_steps: int, trainable_mask=None):
@@ -142,6 +148,26 @@ class FlaxTrainer:
         spec = P(DATA_AXIS, *([None] * (np.ndim(arr) - 1)))
         return jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
 
+    def _fsdp_sharding(self, x):
+        """NamedSharding putting the param's largest data-axis-divisible
+        dimension on DATA_AXIS (replicated when none divides)."""
+        ndata = self.mesh.shape[DATA_AXIS]
+        shape = getattr(x, "shape", ())
+        best = None
+        for i in sorted(range(len(shape)), key=lambda j: -shape[j]):
+            if shape[i] >= ndata and shape[i] % ndata == 0:
+                best = i
+                break
+        if best is None:
+            return NamedSharding(self.mesh, P())
+        spec = [None] * len(shape)
+        spec[best] = DATA_AXIS
+        return NamedSharding(self.mesh, P(*spec))
+
+    def _apply_fsdp(self, tree):
+        return jax.tree.map(
+            lambda x: jax.device_put(x, self._fsdp_sharding(x)), tree)
+
     # --- train ----------------------------------------------------------
     def fit(self, X, y, valid: Optional[tuple] = None,
             log_fn: Optional[Callable] = None):
@@ -155,7 +181,14 @@ class FlaxTrainer:
         total_steps = steps_per_epoch * cfg.max_epochs
         mask = freeze_mask(self.params, cfg.freeze_regex)
         tx = _make_tx(cfg, total_steps, mask)
+        if cfg.param_sharding == "fsdp":
+            if self.mesh is None:
+                raise ValueError("param_sharding='fsdp' requires a mesh")
+            self.params = self._apply_fsdp(self.params)
         opt_state = tx.init(self.params)
+        if cfg.param_sharding == "fsdp":
+            # optimizer moments inherit each param's sharding
+            opt_state = self._apply_fsdp(opt_state)
 
         compute_dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
         has_bn = bool(self.batch_stats)
@@ -208,6 +241,10 @@ class FlaxTrainer:
             if restored is not None:
                 params, batch_stats, opt_state, start_epoch = restored
                 step_idx = start_epoch * steps_per_epoch
+                if cfg.param_sharding == "fsdp":
+                    # restored leaves are host numpy: re-apply the shardings
+                    params = self._apply_fsdp(params)
+                    opt_state = self._apply_fsdp(opt_state)
         for epoch in range(start_epoch, cfg.max_epochs):
             losses = []
             for xb, yb in self._batches(X, y, rng):

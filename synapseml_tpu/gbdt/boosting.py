@@ -552,28 +552,26 @@ def train_booster(
         carry = (score, in_bag_cur, score_v0)
         mvals_list = []
         done = 0
-        train_span = measures.span("trainingIterations")
-        train_span.__enter__()
-        while done < T:
-            c = min(chunk, T - done)
-            carry, (stacked_trees, mv) = run_scan(*carry, done, c)
-            stacked_trees = jax.device_get(stacked_trees)
-            for ti in range(c):
-                for cls in range(k):
-                    trees.append(jax.tree.map(lambda a: a[ti, cls],
-                                              stacked_trees))
-                    tree_weights.append(1.0)
-            done += c
-            if has_valid:
-                mvals_list.append(np.asarray(mv))
-                if cfg.early_stopping_round > 0:
-                    series = np.concatenate(mvals_list)
-                    series = series if higher_better else -series
-                    if done - 1 - int(np.argmax(series)) >= \
-                            cfg.early_stopping_round:
-                        break
+        with measures.span("trainingIterations"):
+            while done < T:
+                c = min(chunk, T - done)
+                carry, (stacked_trees, mv) = run_scan(*carry, done, c)
+                stacked_trees = jax.device_get(stacked_trees)
+                for ti in range(c):
+                    for cls in range(k):
+                        trees.append(jax.tree.map(lambda a: a[ti, cls],
+                                                  stacked_trees))
+                        tree_weights.append(1.0)
+                done += c
+                if has_valid:
+                    mvals_list.append(np.asarray(mv))
+                    if cfg.early_stopping_round > 0:
+                        series = np.concatenate(mvals_list)
+                        series = series if higher_better else -series
+                        if done - 1 - int(np.argmax(series)) >= \
+                                cfg.early_stopping_round:
+                            break
         score = carry[0]
-        train_span.__exit__(None, None, None)
         measures.count("iterations", done)
 
         best_iter = -1

@@ -88,4 +88,8 @@ def load_numeric_csv(path: str, has_header: bool = True) -> "np.ndarray":
     out = np.genfromtxt(path, delimiter=delim,
                         skip_header=1 if has_header else 0,
                         dtype=np.float32)
-    return np.atleast_2d(out)   # match the native reader's (rows, cols)
+    if out.ndim == 1:
+        # genfromtxt flattens both 1-row and 1-column files; the first line
+        # disambiguates: no delimiter there means a single-column file
+        out = out.reshape(-1, 1) if delim not in first else out[None, :]
+    return out

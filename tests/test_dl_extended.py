@@ -107,3 +107,51 @@ class TestCheckpointResume:
         t.fit(X, y)
         logits = t.predict_logits(X)
         assert logits.shape == (8, 4) and np.isfinite(logits).all()
+
+
+class TestFSDP:
+    def test_fsdp_matches_replicated_and_shards_params(self):
+        import jax
+        import numpy as np
+
+        from synapseml_tpu.dl import FlaxTrainer, TrainConfig, make_backbone
+        from synapseml_tpu.parallel import make_mesh
+        from synapseml_tpu.parallel.mesh import DATA_AXIS
+
+        rng = np.random.default_rng(0)
+        X = rng.uniform(size=(64, 8, 8, 3)).astype(np.float32)
+        y = rng.integers(0, 2, size=64).astype(np.float32)
+        mesh = make_mesh({"data": 8})
+
+        outs = {}
+        for mode in ("replicated", "fsdp"):
+            cfg = TrainConfig(batch_size=16, max_epochs=2, seed=3,
+                              param_sharding=mode)
+            tr = FlaxTrainer(make_backbone("tiny", 2), cfg, mesh=mesh)
+            tr.fit(X, y)
+            outs[mode] = (tr.history[-1]["loss"], tr.predict_logits(X[:8]))
+            if mode == "fsdp":
+                # at least one parameter must actually be sharded on data
+                sharded = []
+                jax.tree.map(
+                    lambda p: sharded.append(
+                        hasattr(p, "sharding")
+                        and DATA_AXIS in tuple(getattr(p.sharding, "spec", ()))),
+                    tr.params)
+                assert any(sharded), "no parameter was sharded"
+        np.testing.assert_allclose(outs["replicated"][0], outs["fsdp"][0],
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(outs["replicated"][1], outs["fsdp"][1],
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_fsdp_without_mesh_raises(self):
+        import numpy as np
+        import pytest
+
+        from synapseml_tpu.dl import FlaxTrainer, TrainConfig, make_backbone
+
+        cfg = TrainConfig(batch_size=4, max_epochs=1, param_sharding="fsdp")
+        tr = FlaxTrainer(make_backbone("tiny", 2), cfg)
+        with pytest.raises(ValueError, match="mesh"):
+            tr.fit(np.zeros((8, 8, 8, 3), np.float32),
+                   np.zeros(8, np.float32))

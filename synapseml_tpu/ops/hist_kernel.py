@@ -77,8 +77,10 @@ def _kernel(bin_ref, g_ref, h_ref, m_ref, out_ref, *, C: int, K1: int):
     lax.fori_loop(0, FEATURE_BLOCK, fbody, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins_padded", "chunk"))
-def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = 2048):
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins_padded", "chunk", "interpret"))
+def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = 2048,
+                 interpret: bool = False):
     from jax.experimental import pallas as pl
 
     FP, n = bT.shape
@@ -96,6 +98,7 @@ def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = 2048):
         ],
         out_specs=pl.BlockSpec((FEATURE_BLOCK, K1, 24), lambda f, c: (f, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((FP, K1, 24), jnp.float32),
+        interpret=interpret,
     )(bT, g, h, m)
     # columns are (ch, lo): (FP, K1, 3, 8) -> (FP, K1, 8, 3) -> (FP, B, 3)
     return out.reshape(FP, K1, 3, 8).transpose(0, 1, 3, 2).reshape(

@@ -38,7 +38,6 @@ BASELINE_SERVING_P50_MS = 1.0
 
 N_ROWS = 500_000
 N_FEATURES = 28
-WARMUP_ITERS = 3
 TIMED_ITERS = 25
 
 
@@ -56,7 +55,11 @@ def bench_gbdt():
     margin = X[:, 0] * X[:, 1] + 0.5 * X[:, 2] + 0.2 * rng.normal(size=N_ROWS)
     y = (margin > 0).astype(np.float32)
 
-    cfg_warm = BoosterConfig(objective="binary", num_iterations=WARMUP_ITERS)
+    # warmup with the IDENTICAL iteration count: the fused-scan executable is
+    # cached across calls (boosting._FUSED_RUNNERS) keyed on config+shapes,
+    # and the scan length is a jit specialization axis — warming with a
+    # different count would leave the timed run paying the XLA compile
+    cfg_warm = BoosterConfig(objective="binary", num_iterations=TIMED_ITERS)
     train_booster(X, y, cfg_warm)  # compile + cache
 
     cfg = BoosterConfig(objective="binary", num_iterations=TIMED_ITERS, seed=1)

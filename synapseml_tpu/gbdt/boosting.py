@@ -239,6 +239,182 @@ def _leaf_gather(leaf_value, node_of_row):
     return leaf_value[node_of_row]
 
 
+# ---------------------------------------------------------------------------
+# Shared per-iteration sampling (device-side; used by the fused scan and the
+# host loop so both paths sample identically from fold_in(seed, it))
+# ---------------------------------------------------------------------------
+
+def _sample_rows_impl(cfg, n, key0, valid_mask, it, g, h, in_bag_cur):
+    goss_mode = cfg.boosting_type == "goss"
+    do_bag = ((cfg.boosting_type == "rf" or cfg.bagging_freq > 0)
+              and cfg.bagging_fraction < 1.0)
+    if goss_mode:
+        gnorm = jnp.abs(g).sum(axis=1)
+        top_n = int(cfg.top_rate * n)
+        rand_n = int(cfg.other_rate * n)
+        amp = (1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)
+        order = jnp.argsort(-gnorm)
+        ranks = jnp.zeros(n, jnp.int32).at[order].set(
+            jnp.arange(n, dtype=jnp.int32))
+        u = jax.random.uniform(jax.random.fold_in(key0, it), (n,))
+        rest = ranks >= top_n
+        pick = rest & (u < (rand_n / max(n - top_n, 1)))
+        wmask = (jnp.where(ranks < top_n, 1.0,
+                           jnp.where(pick, amp, 0.0)) * valid_mask)
+        return (wmask > 0).astype(jnp.float32), g * wmask[:, None], \
+            h * wmask[:, None], in_bag_cur
+    if do_bag:
+        u = jax.random.uniform(
+            jax.random.fold_in(key0, 20_000_000 + it), (n,))
+        fresh = ((u < cfg.bagging_fraction).astype(jnp.float32) * valid_mask)
+        bag = jnp.where(it % max(cfg.bagging_freq, 1) == 0, fresh, in_bag_cur)
+        return bag, g, h, bag
+    return valid_mask, g, h, in_bag_cur
+
+
+def _sample_features_impl(cfg, nfeat, key0, it):
+    if cfg.feature_fraction >= 1.0:
+        return jnp.ones(nfeat, bool)
+    nf_keep = max(1, int(math.ceil(cfg.feature_fraction * nfeat)))
+    perm = jax.random.permutation(
+        jax.random.fold_in(key0, 10_000_000 + it), nfeat)
+    return jnp.zeros(nfeat, bool).at[perm[:nf_keep]].set(True)
+
+
+def _make_grow_fn(grower_cfg, mesh):
+    """The per-tree grower, shard_map'd over the data axis when distributed
+    (one histogram psum per split — the socket-ring allreduce analog)."""
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.collectives import shard_apply
+        from ..parallel.mesh import DATA_AXIS as _DA
+
+        def _grow_sharded(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb):
+            return grow_tree(binned_s, g_s, h_s, bag_s, fa, ic, mo,
+                             grower_cfg, nan_bins=nb, axis_name=_DA)
+
+        return shard_apply(
+            mesh, _grow_sharded,
+            in_specs=(P(_DA, None), P(_DA), P(_DA), P(_DA),
+                      P(None), P(None), P(None), P(None)),
+            out_specs=(P(), P(_DA)))
+
+    def grow_fn(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb):
+        return grow_tree(binned_s, g_s, h_s, bag_s, fa, ic, mo,
+                         grower_cfg, nan_bins=nb)
+
+    return grow_fn
+
+
+# ---------------------------------------------------------------------------
+# Fused-scan runner cache: the jitted whole-training program is cached ACROSS
+# train_booster calls (keyed by the static config + shapes), so a warmup call
+# with identical config compiles the exact executable the timed/production
+# call reuses. Without this, every fit would recompile the scan — minutes
+# through a remote-compile tunnel.
+# ---------------------------------------------------------------------------
+
+_FUSED_RUNNERS: dict = {}
+
+
+def _fused_static_key(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
+    mono = tuple(cfg.monotone_constraints or ())
+    return (cfg.objective, cfg.boosting_type, cfg.learning_rate, cfg.num_class,
+            cfg.sigmoid, cfg.alpha, cfg.fair_c, cfg.poisson_max_delta_step,
+            cfg.tweedie_variance_power, cfg.top_rate, cfg.other_rate,
+            cfg.bagging_fraction, cfg.bagging_freq, cfg.feature_fraction,
+            cfg.lambdarank_truncation_level, mono, grower_cfg,
+            n, nfeat, k, nv, metric_name, mesh)
+
+
+def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
+    """Jitted fn(binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins,
+    base_k, gidx, binned_v, yv_j, gidx_v, score0, bag0, sv0, start,
+    count[static]) → (carry, (stacked_trees, mvals)). ``nv`` is the
+    validation row count (0 = no validation)."""
+    key = _fused_static_key(cfg, grower_cfg, n, nfeat, k, nv, metric_name,
+                            mesh)
+    if key in _FUSED_RUNNERS:
+        return _FUSED_RUNNERS[key]
+
+    has_valid = nv > 0
+    rf_mode = cfg.boosting_type == "rf"
+    is_ranking = cfg.objective == "lambdarank"
+    grow_fn = _make_grow_fn(grower_cfg, mesh)
+    if not is_ranking:
+        obj = get_objective(cfg.objective, num_class=max(k, 1),
+                            sigmoid=cfg.sigmoid, alpha=cfg.alpha,
+                            fair_c=cfg.fair_c,
+                            poisson_max_delta_step=cfg.poisson_max_delta_step,
+                            tweedie_variance_power=cfg.tweedie_variance_power)
+
+    def body_for(args):
+        (binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins, base_k,
+         gidx, binned_v, yv_j, gidx_v) = args
+        if is_ranking:
+            obj_l = lambdarank_objective(gidx, cfg.sigmoid,
+                                         cfg.lambdarank_truncation_level)
+            gh_fn, transform = obj_l.grad_hess, (lambda sc: sc)
+        else:
+            gh_fn, transform = obj.grad_hess, obj.transform
+
+        def body(carry, it):
+            score_c, in_bag_c, score_v_c = carry
+            g, h = gh_fn(score_c[:, 0] if k == 1 else score_c, yj, wj)
+            g = jnp.reshape(g, (n, k))
+            h = jnp.reshape(h, (n, k))
+            in_bag, g, h, in_bag_c = _sample_rows_impl(
+                cfg, n, key0, valid_mask, it, g, h, in_bag_c)
+            feat_mask = _sample_features_impl(cfg, nfeat, key0, it)
+            cls_trees = []
+            for cls in range(k):
+                tree, node = grow_fn(binned, g[:, cls], h[:, cls], in_bag,
+                                     feat_mask, is_cat, mono, nan_bins)
+                cls_trees.append(tree)
+                if not rf_mode:
+                    score_c = score_c.at[:, cls].add(
+                        _leaf_gather(tree.leaf_value, node))
+                if has_valid:
+                    leaf_v = _tree_assign_binned(tree, binned_v, nan_bins)
+                    score_v_c = score_v_c.at[:, cls].add(
+                        jnp.asarray(tree.leaf_value)[leaf_v])
+            stacked = jax.tree.map(lambda *x: jnp.stack(x), *cls_trees)
+            if has_valid:
+                # rf averages the trees grown so far
+                raw_v = (score_v_c if not rf_mode else
+                         base_k[None, :]
+                         + (score_v_c - base_k[None, :])
+                         / (it + 1).astype(jnp.float32))
+                pred_v = transform(raw_v[:, 0] if k == 1 else raw_v)
+                if metric_name.startswith("ndcg"):
+                    at = (int(metric_name.split("@")[1])
+                          if "@" in metric_name else 5)
+                    mval = ndcg_at_k(yv_j, raw_v[:, 0], gidx_v, at)
+                else:
+                    mval = METRICS[metric_name](yv_j, pred_v)
+            else:
+                mval = jnp.float32(0)
+            return (score_c, in_bag_c, score_v_c), (stacked, mval)
+
+        return body
+
+    @functools.partial(jax.jit, static_argnames=("count",))
+    def run_scan(binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins,
+                 base_k, gidx, binned_v, yv_j, gidx_v, score0, bag0, sv0,
+                 start, count):
+        body = body_for((binned, yj, wj, valid_mask, key0, is_cat, mono,
+                         nan_bins, base_k, gidx, binned_v, yv_j, gidx_v))
+        return lax.scan(body, (score0, bag0, sv0),
+                        start + jnp.arange(count, dtype=jnp.int32))
+
+    if len(_FUSED_RUNNERS) > 16:
+        # LRU-ish: evict the oldest entry, keep hot executables (a full clear
+        # would force minute-scale remote recompiles under config churn)
+        _FUSED_RUNNERS.pop(next(iter(_FUSED_RUNNERS)))
+    _FUSED_RUNNERS[key] = run_scan
+    return run_scan
+
+
 def _tree_assign_binned(tree: TreeArrays, binned, nan_bins=None) -> jnp.ndarray:
     """Leaf assignment of (already-binned) rows for one tree — used for
     validation-score streaming updates."""
@@ -321,11 +497,13 @@ def train_booster(
 
     # objective
     k = cfg.num_class if cfg.objective in ("multiclass", "softmax", "multiclassova") else 1
+    gidx_arr = jnp.zeros(n, jnp.int32)     # lambdarank group index (else dummy)
     if cfg.objective == "lambdarank":
         if group_sizes is None:
             raise ValueError("lambdarank requires group_sizes")
         gidx = make_grouped(y, group_sizes)
-        obj = lambdarank_objective(jnp.asarray(gidx), cfg.sigmoid,
+        gidx_arr = jnp.asarray(gidx)
+        obj = lambdarank_objective(gidx_arr, cfg.sigmoid,
                                    cfg.lambdarank_truncation_level)
     else:
         obj = get_objective(cfg.objective, num_class=k, sigmoid=cfg.sigmoid,
@@ -394,29 +572,7 @@ def train_booster(
         mono[: len(mc)] = mc
     mono = jnp.asarray(mono)
 
-    # Multi-chip: one shard_map'd grower call per tree — every device
-    # partitions its own row shard and a single psum of the (F, B, 3) child
-    # histogram per split is the entire cross-chip protocol (the LightGBM
-    # socket-ring reduce-scatter analog, NetworkManager.scala:195-218).
-    if mesh is not None:
-        from jax.sharding import PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
-        from ..parallel.mesh import DATA_AXIS as _DA
-
-        def _grow_sharded(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb):
-            return grow_tree(binned_s, g_s, h_s, bag_s, fa, ic, mo,
-                             grower_cfg, nan_bins=nb, axis_name=_DA)
-
-        grow_fn = shard_map(
-            _grow_sharded, mesh=mesh,
-            in_specs=(P(_DA, None), P(_DA), P(_DA), P(_DA),
-                      P(None), P(None), P(None), P(None)),
-            out_specs=(P(), P(_DA)),
-            check_rep=False)
-    else:
-        def grow_fn(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb):
-            return grow_tree(binned_s, g_s, h_s, bag_s, fa, ic, mo,
-                             grower_cfg, nan_bins=nb)
+    grow_fn = _make_grow_fn(grower_cfg, mesh)
 
     # validation state
     has_valid = valid is not None
@@ -463,85 +619,36 @@ def train_booster(
     # feature_fraction), all keyed off fold_in(seed, it) so both paths sample
     # identically
     key0 = jax.random.PRNGKey(cfg.seed)
-    do_bag = ((rf_mode or cfg.bagging_freq > 0)
-              and cfg.bagging_fraction < 1.0)
-    bag_freq = max(cfg.bagging_freq, 1)
-    do_ff = cfg.feature_fraction < 1.0
-    nf_keep = max(1, int(math.ceil(cfg.feature_fraction * nfeat)))
 
     def sample_rows(it, g, h, in_bag_cur):
-        if goss_mode:
-            gnorm = jnp.abs(g).sum(axis=1)
-            top_n = int(cfg.top_rate * n)
-            rand_n = int(cfg.other_rate * n)
-            amp = (1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)
-            order = jnp.argsort(-gnorm)
-            ranks = jnp.zeros(n, jnp.int32).at[order].set(
-                jnp.arange(n, dtype=jnp.int32))
-            u = jax.random.uniform(jax.random.fold_in(key0, it), (n,))
-            rest = ranks >= top_n
-            pick = rest & (u < (rand_n / max(n - top_n, 1)))
-            wmask = (jnp.where(ranks < top_n, 1.0,
-                               jnp.where(pick, amp, 0.0)) * valid_mask)
-            return (wmask > 0).astype(jnp.float32), g * wmask[:, None], \
-                h * wmask[:, None], in_bag_cur
-        if do_bag:
-            u = jax.random.uniform(
-                jax.random.fold_in(key0, 20_000_000 + it), (n,))
-            fresh = ((u < cfg.bagging_fraction).astype(jnp.float32)
-                     * valid_mask)
-            bag = jnp.where(it % bag_freq == 0, fresh, in_bag_cur)
-            return bag, g, h, bag
-        return valid_mask, g, h, in_bag_cur
+        return _sample_rows_impl(cfg, n, key0, valid_mask, it, g, h,
+                                 in_bag_cur)
 
     def sample_features(it):
-        if not do_ff:
-            return jnp.ones(nfeat, bool)
-        perm = jax.random.permutation(
-            jax.random.fold_in(key0, 10_000_000 + it), nfeat)
-        return jnp.zeros(nfeat, bool).at[perm[:nf_keep]].set(True)
+        return _sample_features_impl(cfg, nfeat, key0, it)
 
     if fused:
         T = cfg.num_iterations
-
-        def body(carry, it):
-            score_c, in_bag_c, score_v_c = carry
-            g, h = gh_fn(score_c[:, 0] if k == 1 else score_c, yj, wj)
-            g = jnp.reshape(g, (n, k))
-            h = jnp.reshape(h, (n, k))
-            in_bag, g, h, in_bag_c = sample_rows(it, g, h, in_bag_c)
-            feat_mask = sample_features(it)
-            cls_trees = []
-            for cls in range(k):
-                tree, node = grow_fn(binned, g[:, cls], h[:, cls], in_bag,
-                                     feat_mask, is_cat, mono, nan_bins)
-                cls_trees.append(tree)
-                if not rf_mode:
-                    score_c = score_c.at[:, cls].add(
-                        _leaf_gather(tree.leaf_value, node))
-                if has_valid:
-                    leaf_v = _tree_assign_binned(tree, binned_v, nan_bins)
-                    score_v_c = score_v_c.at[:, cls].add(
-                        jnp.asarray(tree.leaf_value)[leaf_v])
-            stacked = jax.tree.map(lambda *x: jnp.stack(x), *cls_trees)
-            if has_valid:
-                # rf averages the trees grown so far
-                raw_v = (score_v_c if not rf_mode else
-                         jnp.asarray(base[None, :k], jnp.float32)
-                         + (score_v_c - jnp.asarray(base[None, :k], jnp.float32))
-                         / (it + 1).astype(jnp.float32))
-                pred_v = obj.transform(raw_v[:, 0] if k == 1 else raw_v)
-                mval = _eval_metric(metric_name, yv, pred_v, raw_v, valid, k)
+        nv = Xv.shape[0] if has_valid else 0
+        run_scan = _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv,
+                                     metric_name if has_valid else "", mesh)
+        base_k = jnp.asarray(base[:k], jnp.float32)
+        if has_valid:
+            yv_j = jnp.asarray(yv)
+            if metric_name.startswith("ndcg"):
+                if len(valid) < 4:
+                    raise ValueError("ranking validation requires "
+                                     "valid=(Xv, yv, wv_or_None, group_sizes_v)")
+                gidx_v = jnp.asarray(make_grouped(yv, valid[3]))
             else:
-                mval = jnp.float32(0)
-            return (score_c, in_bag_c, score_v_c), (stacked, mval)
+                gidx_v = jnp.zeros(nv, jnp.int32)
+            bv_arg = binned_v
+        else:
+            yv_j = jnp.zeros(1, jnp.float32)
+            gidx_v = jnp.zeros(1, jnp.int32)
+            bv_arg = jnp.zeros((1, nfeat), binned.dtype)
 
         score_v0 = score_v if has_valid else jnp.zeros((1, k))
-
-        @functools.partial(jax.jit, static_argnames=("count",))
-        def run_scan(score0, bag0, sv0, start, count):
-            return lax.scan(body, (score0, bag0, sv0),
-                            start + jnp.arange(count, dtype=jnp.int32))
 
         # With early stopping the scan runs in chunks with a host-side stop
         # check between them, so a run that converges at iteration 40 does
@@ -555,7 +662,9 @@ def train_booster(
         with measures.span("trainingIterations"):
             while done < T:
                 c = min(chunk, T - done)
-                carry, (stacked_trees, mv) = run_scan(*carry, done, c)
+                carry, (stacked_trees, mv) = run_scan(
+                    binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins,
+                    base_k, gidx_arr, bv_arg, yv_j, gidx_v, *carry, done, c)
                 stacked_trees = jax.device_get(stacked_trees)
                 for ti in range(c):
                     for cls in range(k):

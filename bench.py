@@ -207,8 +207,36 @@ def bench_serving(n_requests=200):
         server.stop()
 
 
+def _init_device_with_watchdog(timeout_s: float):
+    """jax backend init can hang indefinitely when the TPU terminal is down
+    (observed: axon init stuck for hours). A watchdog emits the contract's
+    JSON line with an error field and force-exits instead of hanging into
+    the caller's timeout."""
+    import threading
+
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(timeout_s):
+            print(json.dumps({
+                "metric": "gbdt_train_row_iters_per_sec_per_chip",
+                "value": 0.0, "unit": "row-iterations/sec/chip",
+                "vs_baseline": 0.0,
+                "error": f"device backend init exceeded {timeout_s:.0f}s "
+                         "(TPU terminal unavailable)"}), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    import jax
+
+    jax.devices()
+    done.set()
+
+
 def main():
     run_all = "--all" in sys.argv or os.environ.get("BENCH_ALL") == "1"
+    _init_device_with_watchdog(float(os.environ.get("BENCH_INIT_TIMEOUT_S",
+                                                    900)))
     primary = bench_gbdt()
     extras = []
     budget_s = 1e9 if run_all else float(os.environ.get("BENCH_BUDGET_S", 900))

@@ -1,0 +1,80 @@
+"""TPU perf-tuning harness for the v2 GBDT engine.
+
+Phases timed separately so the bottleneck is visible:
+  1. kernel-only: child_histogram at several sizes (marginal ns/row)
+  2. grow_tree single tree (all 30 splits fused)
+  3. train_booster fused scan (5 iters)
+  4. full bench config (25 iters)
+"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax, jax.numpy as jnp
+
+N, F = 500_000, 28
+rng = np.random.default_rng(0)
+X = rng.normal(size=(N, F)).astype(np.float32)
+margin = X[:, 0] * X[:, 1] + 0.5 * X[:, 2] + 0.2 * rng.normal(size=N)
+y = (margin > 0).astype(np.float32)
+
+from synapseml_tpu.ops.quantize import compute_bin_mapper, apply_bins
+from synapseml_tpu.ops.hist_kernel import _hist_pallas, features_padded
+from synapseml_tpu.gbdt.grower import GrowerConfig, grow_tree
+from synapseml_tpu.gbdt import BoosterConfig, train_booster
+
+print("device:", jax.devices()[0], flush=True)
+
+mapper = compute_bin_mapper(X, 255, 200_000)
+binned = apply_bins(mapper, X)
+jax.block_until_ready(binned)
+
+# --- phase 1: kernel only ---------------------------------------------------
+FP = features_padded(F)
+Np = 499712
+bT = jnp.zeros((FP, Np), jnp.int32).at[:F].set(
+    jnp.asarray(binned[:Np]).astype(jnp.int32).T)
+g = jnp.asarray(rng.normal(size=Np).astype(np.float32))
+h = jnp.ones(Np, jnp.float32) * 0.25
+m = jnp.ones(Np, jnp.float32)
+
+def timeit(fn, reps=10, warmup=2):
+    for _ in range(warmup):
+        out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+for size in (499712, 249856, 63488, 8192):
+    t = timeit(lambda s=size: _hist_pallas(bT[:, :s], g[:s], h[:s], m[:s], 256))
+    print(f"kernel {size:7d} rows: {t*1e3:8.2f} ms  ({t/size*1e9:6.2f} ns/row)",
+          flush=True)
+
+# --- phase 2: one tree ------------------------------------------------------
+cfg = GrowerConfig(num_leaves=31, num_bins=255)
+gg = jnp.asarray((0.5 - y).astype(np.float32))
+hh = jnp.full(N, 0.25)
+ones = jnp.ones(N, jnp.float32)
+fa = jnp.ones(F, bool)
+ic = jnp.zeros(F, bool)
+mono = jnp.zeros(F, jnp.int32)
+nb = jnp.asarray(mapper.nan_bins, jnp.int32)
+
+t = timeit(lambda: grow_tree(binned, gg, hh, ones, fa, ic, mono, cfg,
+                             nan_bins=nb)[0].leaf_value, reps=5)
+print(f"grow_tree (31 leaves): {t*1e3:8.2f} ms/tree "
+      f"-> {N/t/1e6:6.2f}M row-iters/s", flush=True)
+
+# --- phase 3+4: fused training ----------------------------------------------
+for iters in (5, 25):
+    bc = BoosterConfig(objective="binary", num_iterations=iters, seed=1)
+    train_booster(X[:4096], y[:4096], bc)  # small-warm (compile at bucket sizes?)
+    t0 = time.perf_counter()
+    b = train_booster(X, y, bc)
+    jax.block_until_ready(b.trees[-1].leaf_value)
+    dt = time.perf_counter() - t0
+    print(f"train {iters:2d} iters: {dt:7.2f} s -> "
+          f"{N*iters/dt/1e6:6.2f}M row-iters/s  vs_baseline="
+          f"{N*iters/dt/4e6:.3f}", flush=True)

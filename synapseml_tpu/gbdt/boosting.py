@@ -149,8 +149,15 @@ class Booster:
         tree = self.trees[index]
         sf = np.asarray(tree.split_feature)
         sb = np.asarray(tree.split_bin)
-        return np.array([bin_threshold_to_value(self.mapper, int(f), int(b))
-                         for f, b in zip(sf, sb)], np.float32)
+        vals = np.array([bin_threshold_to_value(self.mapper, int(f), int(b))
+                         for f, b in zip(sf, sb)], np.float64)
+        # top-bin sentinel is 1e308 (finite in f64 model strings); map it to an
+        # INTENTIONAL f32 inf (not a clamp to f32max: +inf feature values must
+        # still satisfy x <= threshold and go left, matching the binned path
+        # where apply_bins clamps inf into the last real-value bin)
+        f32max = np.float64(np.finfo(np.float32).max)
+        return np.where(vals >= f32max, np.inf,
+                        np.clip(vals, -f32max, f32max)).astype(np.float32)
 
     def forest(self) -> Forest:
         if self._forest_cache is None or self._forest_cache.num_trees != len(self.trees):
@@ -446,10 +453,54 @@ def train_booster(
     measures=None,                            # InstrumentationMeasures (§5.1)
 ) -> Booster:
     from ..core.logging import InstrumentationMeasures
+    from .dataset import Dataset
 
     if measures is None:
         measures = InstrumentationMeasures()
     cfg = config
+    # LightGBM Dataset analog: pre-binned device-resident data skips the
+    # quantization pass and the raw-float host→device transfer entirely
+    dataset = X if isinstance(X, Dataset) else None
+    prebinned = None
+    if dataset is not None:
+        if dataset.mapper.max_bin != cfg.max_bin and mapper is None:
+            raise ValueError(
+                f"Dataset was binned with max_bin={dataset.mapper.max_bin} but "
+                f"config.max_bin={cfg.max_bin}; rebuild the Dataset with the "
+                "matching max_bin (bin ids outside the grower's range would "
+                "silently drop from histograms)")
+        if y is None:
+            y = dataset.label
+        if y is None:
+            raise ValueError("no label: pass y explicitly or build the "
+                             "Dataset with label=...")
+        if sample_weight is None:
+            sample_weight = dataset.weight
+        if init_score is None:
+            init_score = dataset.init_score
+        if group_sizes is None:
+            group_sizes = dataset.group_sizes
+        if categorical_features is None:
+            categorical_features = dataset.categorical_features
+        if mapper is not None and mapper is not dataset.mapper:
+            # explicit conflicting mapper (reference-dataset warm-start style):
+            # the pre-binned ids were assigned under dataset.mapper's
+            # boundaries, so fall back to re-binning the raw rows under the
+            # user's mapper rather than decoding splits against the wrong one
+            pass
+        else:
+            mapper = dataset.mapper
+            if mesh is None and init_model is None:
+                # fast path: reuse the device-resident binned matrix (the mesh
+                # / warm-start paths need raw rows for padding / rescoring)
+                prebinned = dataset.binned
+        if dataset.X is not None:
+            X = dataset.X
+        elif prebinned is not None:
+            X = np.zeros(dataset.shape, np.float32)  # placeholder, unused
+        else:
+            raise ValueError("Dataset was built with keep_raw=False; this "
+                             "training path (mesh / warm start) needs raw rows")
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.float32)
     if X.ndim != 2 or X.shape[0] == 0:
@@ -487,7 +538,7 @@ def train_booster(
                     [np.asarray(init_score), np.zeros(rem, np.float32)])
     n = X.shape[0]
     with measures.span("dataPreparation"):
-        binned = apply_bins(mapper, X)
+        binned = prebinned if prebinned is not None else apply_bins(mapper, X)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..parallel.mesh import DATA_AXIS as _DA

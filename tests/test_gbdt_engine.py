@@ -171,3 +171,22 @@ def test_warm_start_continues(binary_data):
     from sklearn.metrics import log_loss
 
     assert log_loss(yte, b2.predict(Xte)) < log_loss(yte, b1.predict(Xte))
+
+
+def test_dataset_prebinned_matches_raw(binary_data):
+    """Dataset (LightGBM-Dataset analog: bin once, device-resident) must give
+    the identical model to the raw-matrix path."""
+    from synapseml_tpu.gbdt import Dataset
+
+    X, _, y, _ = binary_data
+    cfg = BoosterConfig(objective="binary", num_iterations=5, num_leaves=15)
+    b_raw = train_booster(X, y, cfg)
+    ds = Dataset(X, y).block_until_ready()
+    b_ds = train_booster(ds, None, cfg)
+    np.testing.assert_allclose(b_raw.predict(X[:100]), b_ds.predict(X[:100]),
+                               rtol=1e-6)
+    # labels/weights ride along; reuse across configs skips re-binning
+    cfg2 = BoosterConfig(objective="binary", num_iterations=3, num_leaves=7,
+                         seed=3)
+    b2 = train_booster(ds, None, cfg2)
+    assert len(b2.trees) == 3

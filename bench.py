@@ -166,7 +166,6 @@ def bench_serving(n_requests=200):
     """End-to-end serving latency (accept → queue → jitted pipeline → reply;
     io/serving.py) vs the reference's "sub-millisecond" Spark Serving claim."""
     import json as _json
-    import urllib.request
 
     import jax
     import jax.numpy as jnp
@@ -180,34 +179,44 @@ def bench_serving(n_requests=200):
     # executors). With a remote accelerator behind the axon tunnel every
     # request would otherwise pay the ~15-20 ms tunnel RTT, measuring the
     # tunnel rather than the serving layer.
-    cpu = jax.devices("cpu")[0]
-    w = jax.device_put(
-        jnp.asarray(np.random.default_rng(0).normal(size=(8,)), jnp.float32),
-        cpu)
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu = None   # platform pinned without a cpu backend: use the default
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(8,)), jnp.float32)
+    if cpu is not None:
+        w = jax.device_put(w, cpu)
 
     @jax.jit
     def pipeline(x):
         return jnp.tanh(x @ w)
 
     def handler(df: Table) -> Table:
-        x = jax.device_put(
-            np.asarray([v["x"] for v in df["value"]], np.float32), cpu)
+        x = np.asarray([v["x"] for v in df["value"]], np.float32)
+        if cpu is not None:
+            x = jax.device_put(x, cpu)
         out = np.asarray(pipeline(x))
         return Table({"id": df["id"], "reply": out.astype(np.float64)})
 
+    # latency-optimized serving config: no artificial batch-formation wait
+    # (batches still form under concurrent backlog); keep-alive client
+    # connection as any production caller would hold
     server = ServingServer(handler, host="127.0.0.1", port=0,
-                           max_batch_size=32, max_batch_latency=0.001)
+                           max_batch_size=32, max_batch_latency=0.0)
     server.start()
     try:
-        url = server.url
+        import http.client
+
         payload = _json.dumps({"x": [0.1] * 8}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
 
         def one():
-            req = urllib.request.Request(url, data=payload,
-                                         headers={"Content-Type":
-                                                  "application/json"})
-            with urllib.request.urlopen(req, timeout=5) as r:
-                r.read()
+            conn.request("POST", server.api_path, body=payload,
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            body = r.read()
+            if r.status != 200:   # http.client does not raise on 5xx
+                raise RuntimeError(f"serving error {r.status}: {body[:120]!r}")
 
         for _ in range(20):
             one()                      # warm the jit + connection path
@@ -216,6 +225,7 @@ def bench_serving(n_requests=200):
             t0 = time.perf_counter()
             one()
             lat.append((time.perf_counter() - t0) * 1e3)
+        conn.close()
         lat = np.sort(np.asarray(lat))
         p50 = float(lat[len(lat) // 2])
         p99 = float(lat[int(len(lat) * 0.99)])

@@ -81,6 +81,9 @@ class BoosterConfig:
     # (PV-Tree; LightGBM voting_parallel + topK — LightGBMParams.scala:25-27)
     tree_learner: str = "serial"
     top_k: int = 20
+    # row-partition primitive inside the grower ("sort" | "scan"); see
+    # GrowerConfig.partition_impl
+    partition_impl: str = "sort"
     # lambdarank
     lambdarank_truncation_level: int = 30
     max_position: int = 30
@@ -101,6 +104,7 @@ class BoosterConfig:
             max_delta_step=self.max_delta_step,
             cat_smooth=self.cat_smooth,
             max_cat_threshold=self.max_cat_threshold,
+            partition_impl=self.partition_impl,
         )
 
 
@@ -463,12 +467,6 @@ def train_booster(
     dataset = X if isinstance(X, Dataset) else None
     prebinned = None
     if dataset is not None:
-        if dataset.mapper.max_bin != cfg.max_bin and mapper is None:
-            raise ValueError(
-                f"Dataset was binned with max_bin={dataset.mapper.max_bin} but "
-                f"config.max_bin={cfg.max_bin}; rebuild the Dataset with the "
-                "matching max_bin (bin ids outside the grower's range would "
-                "silently drop from histograms)")
         if y is None:
             y = dataset.label
         if y is None:
@@ -490,6 +488,14 @@ def train_booster(
             pass
         else:
             mapper = dataset.mapper
+            if dataset.mapper.max_bin != cfg.max_bin:
+                # guard regardless of how the mapper was supplied: bin ids
+                # outside the grower's num_bins range silently drop from
+                # histograms
+                raise ValueError(
+                    f"Dataset was binned with max_bin={dataset.mapper.max_bin} "
+                    f"but config.max_bin={cfg.max_bin}; rebuild the Dataset "
+                    "with the matching max_bin")
             if mesh is None and init_model is None:
                 # fast path: reuse the device-resident binned matrix (the mesh
                 # / warm-start paths need raw rows for padding / rescoring)

@@ -71,6 +71,11 @@ class GrowerConfig(NamedTuple):
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
     has_categorical: bool = False  # static: traces out the categorical path
+    # row-partition primitive: "sort" = stable argsort of the 4-way key
+    # (XLA bitonic sort, O(n log^2 n) compare-exchange stages); "scan" =
+    # cumsum + vectorized binary search for the inverse permutation
+    # (O(n log n) gathers — wins when sort stages dominate the split step)
+    partition_impl: str = "sort"
 
 
 class TreeArrays(NamedTuple):
@@ -124,6 +129,34 @@ def _bucket_sizes(np_rows: int) -> list:
 
 def _maybe_psum(x, axis_name):
     return lax.psum(x, axis_name) if axis_name is not None else x
+
+
+def _stable_partition_src(key: jnp.ndarray, impl: str) -> jnp.ndarray:
+    """Source indices of the stable partition of ``key`` (values in
+    {-1, 0, 1, 2}) — identical to ``jnp.argsort(key, stable=True)``.
+
+    ``impl='scan'`` computes the inverse permutation directly: per-category
+    cumulative counts give each output slot's rank within its category, and a
+    vectorized binary search finds the rank-th member — O(n log n) gathers
+    instead of the bitonic sort's O(n log^2 n) compare-exchange stages.
+    """
+    if impl == "sort":
+        return jnp.argsort(key, stable=True).astype(jnp.int32)
+    if impl != "scan":
+        raise ValueError(f"partition_impl must be 'sort' or 'scan', got {impl!r}")
+    n = key.shape[0]
+    j = jnp.arange(n, dtype=jnp.int32)
+    cums = [jnp.cumsum(key == v, dtype=jnp.int32) for v in (-1, 0, 1, 2)]
+    offs = jnp.cumsum(jnp.asarray([0] + [c[-1] for c in cums[:3]]))
+    src = jnp.zeros(n, jnp.int32)
+    pick = jnp.full(n, 3, jnp.int32)
+    for ci in (2, 1, 0):
+        pick = jnp.where(j < offs[ci + 1], ci, pick)
+    for ci, c in enumerate(cums):
+        rank = j - offs[ci] + 1
+        s = jnp.searchsorted(c, rank, side="left").astype(jnp.int32)
+        src = jnp.where(pick == ci, s, src)
+    return src
 
 
 # ---------------------------------------------------------------------------
@@ -350,7 +383,7 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
                 key = jnp.where(idx < start, -1,
                                 jnp.where(idx >= start + length, 2,
                                           gr.astype(jnp.int32)))
-                src = jnp.argsort(key, stable=True).astype(jnp.int32)
+                src = _stable_partition_src(key, cfg.partition_impl)
                 nl_loc = jnp.sum(key == 0).astype(jnp.int32)
 
                 def perm1(a):

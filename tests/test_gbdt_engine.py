@@ -190,3 +190,19 @@ def test_dataset_prebinned_matches_raw(binary_data):
                          seed=3)
     b2 = train_booster(ds, None, cfg2)
     assert len(b2.trees) == 3
+
+
+def test_partition_impl_scan_matches_sort(binary_data):
+    """The scan-based stable partition must grow bitwise-identical trees to
+    the argsort-based one (same src permutation by construction)."""
+    X, _, y, _ = binary_data
+    cfg_s = BoosterConfig(objective="binary", num_iterations=4, num_leaves=15)
+    cfg_c = BoosterConfig(objective="binary", num_iterations=4, num_leaves=15,
+                          partition_impl="scan")
+    b_s = train_booster(X, y, cfg_s)
+    b_c = train_booster(X, y, cfg_c)
+    for ts, tc in zip(b_s.trees, b_c.trees):
+        np.testing.assert_array_equal(np.asarray(ts.split_feature),
+                                      np.asarray(tc.split_feature))
+        np.testing.assert_allclose(np.asarray(ts.leaf_value),
+                                   np.asarray(tc.leaf_value), rtol=1e-6)

@@ -2,9 +2,15 @@
 
 Phases timed separately so the bottleneck is visible:
   1. kernel-only: child_histogram at several sizes (marginal ns/row)
-  2. grow_tree single tree (all 30 splits fused)
-  3. train_booster fused scan (5 iters)
-  4. full bench config (25 iters)
+  2. partition primitives: stable argsort vs cumsum/searchsorted inverse
+     (the per-split row-partition candidates)
+  3. masked full-N histogram (the no-partition alternative design)
+  4. grow_tree single tree, amortized over reps
+  5. train_booster fused scan, Dataset-staged, marginal per-tree cost
+     (5 vs 25 iters isolates steady-state from fixed overhead)
+
+Run: python tools/perf_tune.py [--profile /tmp/jaxtrace]
+  --profile wraps phase 4 in jax.profiler.trace for op-level breakdown.
 """
 import os, sys, time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -20,7 +26,7 @@ y = (margin > 0).astype(np.float32)
 from synapseml_tpu.ops.quantize import compute_bin_mapper, apply_bins
 from synapseml_tpu.ops.hist_kernel import _hist_pallas, features_padded
 from synapseml_tpu.gbdt.grower import GrowerConfig, grow_tree
-from synapseml_tpu.gbdt import BoosterConfig, train_booster
+from synapseml_tpu.gbdt import BoosterConfig, Dataset, train_booster
 
 print("device:", jax.devices()[0], flush=True)
 
@@ -28,14 +34,6 @@ mapper = compute_bin_mapper(X, 255, 200_000)
 binned = apply_bins(mapper, X)
 jax.block_until_ready(binned)
 
-# --- phase 1: kernel only ---------------------------------------------------
-FP = features_padded(F)
-Np = 499712
-bT = jnp.zeros((FP, Np), jnp.int32).at[:F].set(
-    jnp.asarray(binned[:Np]).astype(jnp.int32).T)
-g = jnp.asarray(rng.normal(size=Np).astype(np.float32))
-h = jnp.ones(Np, jnp.float32) * 0.25
-m = jnp.ones(Np, jnp.float32)
 
 def timeit(fn, reps=10, warmup=2):
     for _ in range(warmup):
@@ -47,12 +45,67 @@ def timeit(fn, reps=10, warmup=2):
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / reps
 
+
+# --- phase 1: kernel only ---------------------------------------------------
+FP = features_padded(F)
+Np = 499712
+bT = jnp.zeros((FP, Np), jnp.int32).at[:F].set(
+    jnp.asarray(binned[:Np]).astype(jnp.int32).T)
+g = jnp.asarray(rng.normal(size=Np).astype(np.float32))
+h = jnp.ones(Np, jnp.float32) * 0.25
+m = jnp.ones(Np, jnp.float32)
+
 for size in (499712, 249856, 63488, 8192):
     t = timeit(lambda s=size: _hist_pallas(bT[:, :s], g[:s], h[:s], m[:s], 256))
     print(f"kernel {size:7d} rows: {t*1e3:8.2f} ms  ({t/size*1e9:6.2f} ns/row)",
           flush=True)
 
-# --- phase 2: one tree ------------------------------------------------------
+# --- phase 2: partition primitives ------------------------------------------
+# the PRODUCTION 4-way key ({-1 before-range, 0 left, 1 right, 2 after-range})
+# through the production helper, both impls — this is the real per-split cost
+from synapseml_tpu.gbdt.grower import _stable_partition_src
+
+bc = jnp.asarray(binned[:Np, 0]).astype(jnp.int32)
+idx4 = jnp.arange(Np, dtype=jnp.int32)
+key4 = jnp.where(idx4 < Np // 8, -1,
+                 jnp.where(idx4 >= Np - Np // 8, 2,
+                           (bc > 100).astype(jnp.int32)))
+
+from functools import partial as _partial
+
+for impl in ("sort", "scan"):
+    f = jax.jit(_partial(_stable_partition_src, impl=impl))
+    t = timeit(lambda f=f: f(key4))
+    print(f"partition impl={impl:5s} {Np} rows (4-way key): {t*1e3:8.2f} ms",
+          flush=True)
+
+# gather-apply cost (move bT + 3 row vectors through the permutation)
+perm = jax.jit(_partial(_stable_partition_src, impl="sort"))(key4)
+
+
+@jax.jit
+def apply_perm(bT, g, h, m, perm):
+    return bT[:, perm], g[perm], h[perm], m[perm]
+
+
+t = timeit(lambda: apply_perm(bT, g, h, m, perm)[1])
+print(f"partition apply-gather (FP={FP} cols): {t*1e3:8.2f} ms", flush=True)
+
+# --- phase 3: masked full-N histogram (no-partition design) ------------------
+node = (jnp.asarray(binned[:Np, 1]).astype(jnp.int32) > 100).astype(jnp.int32)
+
+
+@jax.jit
+def masked_hist(bT, g, h, m, node):
+    sel = (node == 1).astype(jnp.float32)
+    return _hist_pallas(bT, g * sel, h * sel, m * sel, 256)
+
+
+t = timeit(lambda: masked_hist(bT, g, h, m, node))
+print(f"masked full-N histogram: {t*1e3:8.2f} ms "
+      f"(x30 splits = {t*30*1e3:.1f} ms/tree)", flush=True)
+
+# --- phase 4: one tree, amortized -------------------------------------------
 cfg = GrowerConfig(num_leaves=31, num_bins=255)
 gg = jnp.asarray((0.5 - y).astype(np.float32))
 hh = jnp.full(N, 0.25)
@@ -62,19 +115,41 @@ ic = jnp.zeros(F, bool)
 mono = jnp.zeros(F, jnp.int32)
 nb = jnp.asarray(mapper.nan_bins, jnp.int32)
 
-t = timeit(lambda: grow_tree(binned, gg, hh, ones, fa, ic, mono, cfg,
-                             nan_bins=nb)[0].leaf_value, reps=5)
+profile_dir = None
+if "--profile" in sys.argv:
+    i = sys.argv.index("--profile")
+    profile_dir = sys.argv[i + 1] if len(sys.argv) > i + 1 else "/tmp/jaxtrace"
+
+
+def one_tree():
+    return grow_tree(binned, gg, hh, ones, fa, ic, mono, cfg, nan_bins=nb)[0]
+
+
+t = timeit(lambda: one_tree().leaf_value, reps=5)
 print(f"grow_tree (31 leaves): {t*1e3:8.2f} ms/tree "
       f"-> {N/t/1e6:6.2f}M row-iters/s", flush=True)
 
-# --- phase 3+4: fused training ----------------------------------------------
+if profile_dir:
+    with jax.profiler.trace(profile_dir):
+        for _ in range(3):
+            out = one_tree()
+        jax.block_until_ready(out.leaf_value)
+    print(f"profile written to {profile_dir}", flush=True)
+
+# --- phase 5: fused training, Dataset-staged --------------------------------
+ds = Dataset(X, y, mapper=mapper).block_until_ready()
+results = {}
 for iters in (5, 25):
     bc = BoosterConfig(objective="binary", num_iterations=iters, seed=1)
-    train_booster(X[:4096], y[:4096], bc)  # small-warm (compile at bucket sizes?)
+    train_booster(ds, None, bc)           # compile at the REAL shapes + cache
     t0 = time.perf_counter()
-    b = train_booster(X, y, bc)
+    b = train_booster(ds, None, bc)
     jax.block_until_ready(b.trees[-1].leaf_value)
     dt = time.perf_counter() - t0
-    print(f"train {iters:2d} iters: {dt:7.2f} s -> "
+    results[iters] = dt
+    print(f"train {iters:2d} iters (staged): {dt:7.2f} s -> "
           f"{N*iters/dt/1e6:6.2f}M row-iters/s  vs_baseline="
           f"{N*iters/dt/4e6:.3f}", flush=True)
+marg = (results[25] - results[5]) / 20
+print(f"marginal per-tree cost: {marg*1e3:.1f} ms -> steady-state "
+      f"{N/marg/1e6:.2f}M row-iters/s ({N/marg/4e6:.2f}x baseline)", flush=True)

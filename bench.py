@@ -35,6 +35,11 @@ BASELINE_GBDT_ROW_ITERS = 4.0e6
 BASELINE_RESNET_IMGS_SEC = 400.0
 BASELINE_ONNX_IMGS_SEC = 1000.0
 BASELINE_SERVING_P50_MS = 1.0
+# BERT-base seq-128 fine-tune: ~100 ex/s is V100-class mixed-precision
+# training throughput (the reference's DeepTextClassifier hardware);
+# onnxruntime-gpu BERT-base batch inference on the same class: ~400 seq/s
+BASELINE_BERT_TRAIN_EX_SEC = 100.0
+BASELINE_ONNX_BERT_SEQ_SEC = 400.0
 
 N_ROWS = 500_000
 N_FEATURES = 28
@@ -129,6 +134,86 @@ def bench_resnet50_train(batch=32, image=224, warmup=2, steps=8):
     return {"metric": "resnet50_finetune_imgs_per_sec_per_chip",
             "value": round(v, 1), "unit": "imgs/sec/chip",
             "vs_baseline": round(v / BASELINE_RESNET_IMGS_SEC, 3)}
+
+
+def bench_bert_finetune(batch=32, seq=128, warmup=2, steps=8):
+    """BERT-base SST-2-shape fine-tune examples/sec/chip (DeepTextClassifier
+    parity workload — BASELINE.md: BERT-base on SST-2). Random-init weights
+    from config (zero-egress environment); identical compute to a checkpoint
+    fine-tune step: full forward/backward + adamw update in bf16."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from transformers import BertConfig, FlaxBertForSequenceClassification
+
+    model = FlaxBertForSequenceClassification(
+        BertConfig(num_labels=2), seed=0, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(100, 30000, size=(batch, seq)), jnp.int32)
+    attn = jnp.ones((batch, seq), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 2, size=batch), jnp.int32)
+    tx = optax.adamw(2e-5)
+    params = model.params
+    opt_state = tx.init(params)
+    dropout_rng = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def step(params, opt_state, key):
+        def loss_fn(p):
+            logits = model(input_ids=ids, attention_mask=attn, params=p,
+                           dropout_rng=key, train=True).logits
+            oh = jax.nn.one_hot(labels, 2)
+            return -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits.astype(jnp.float32)) * oh, -1))
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(warmup):
+        key, dropout_rng = jax.random.split(dropout_rng)
+        params, opt_state, loss = step(params, opt_state, key)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        key, dropout_rng = jax.random.split(dropout_rng)
+        params, opt_state, loss = step(params, opt_state, key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    v = batch * steps / dt
+    return {"metric": "bert_base_finetune_ex_per_sec_per_chip",
+            "value": round(v, 1), "unit": f"examples/sec/chip (seq={seq})",
+            "vs_baseline": round(v / BASELINE_BERT_TRAIN_EX_SEC, 3)}
+
+
+def bench_onnx_bert(batch=32, seq=128, warmup=2, steps=8):
+    """ONNX BERT-base-shape encoder batch inference seq/sec/chip through the
+    importer (ONNXModel.scala:145-423 workload; BASELINE.md: ONNX BERT-base).
+    Generated 12-layer/768-hidden/12-head encoder — the same op mix
+    (MatMul/Transpose/Softmax/LayerNorm/Gelu) as an exported BERT-base."""
+    import jax
+
+    from synapseml_tpu.onnx.importer import OnnxFunction
+    from synapseml_tpu.onnx.modelgen import make_transformer_encoder
+
+    m = make_transformer_encoder(num_layers=12, d_model=768, num_heads=12,
+                                 seq_len=seq, d_ff=3072, num_classes=2)
+    fn = OnnxFunction(m)
+    jfn = jax.jit(fn.as_jax(["embeddings"])[0])
+    x = jax.device_put(np.random.default_rng(0).normal(
+        size=(batch, seq, 768)).astype(np.float32))
+    for _ in range(warmup):
+        out = jfn(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = jfn(x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    v = batch * steps / dt
+    return {"metric": "onnx_bert_base_inference_seq_per_sec_per_chip",
+            "value": round(v, 1), "unit": f"sequences/sec/chip (seq={seq})",
+            "vs_baseline": round(v / BASELINE_ONNX_BERT_SEQ_SEC, 3)}
 
 
 def bench_onnx_inference(batch=64, image=224, warmup=2, steps=8):
@@ -270,7 +355,8 @@ def main():
     extras = []
     budget_s = 1e9 if run_all else float(os.environ.get("BENCH_BUDGET_S", 900))
     t_start = time.perf_counter()
-    for fn in (bench_resnet50_train, bench_onnx_inference, bench_serving):
+    for fn in (bench_resnet50_train, bench_bert_finetune,
+               bench_onnx_inference, bench_onnx_bert, bench_serving):
         if time.perf_counter() - t_start > budget_s:
             break
         try:

@@ -11,6 +11,9 @@ python -m compileall -q synapseml_tpu tests bench.py __graft_entry__.py
 echo "== native build =="
 make -C synapseml_tpu/native
 
+echo "== docs site (tools/docgen, website analog) =="
+python tools/docgen/docgen.py > /dev/null
+
 echo "== unit tests (8-device CPU mesh) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -m pytest tests/ -x -q

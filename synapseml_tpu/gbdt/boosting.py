@@ -84,6 +84,8 @@ class BoosterConfig:
     # row-partition primitive inside the grower ("sort" | "scan"); see
     # GrowerConfig.partition_impl
     partition_impl: str = "sort"
+    # grower row layout ("partition" | "masked"); see GrowerConfig.row_layout
+    row_layout: str = "partition"
     # lambdarank
     lambdarank_truncation_level: int = 30
     max_position: int = 30
@@ -105,6 +107,7 @@ class BoosterConfig:
             cat_smooth=self.cat_smooth,
             max_cat_threshold=self.max_cat_threshold,
             partition_impl=self.partition_impl,
+            row_layout=self.row_layout,
         )
 
 
@@ -488,14 +491,6 @@ def train_booster(
             pass
         else:
             mapper = dataset.mapper
-            if dataset.mapper.max_bin != cfg.max_bin:
-                # guard regardless of how the mapper was supplied: bin ids
-                # outside the grower's num_bins range silently drop from
-                # histograms
-                raise ValueError(
-                    f"Dataset was binned with max_bin={dataset.mapper.max_bin} "
-                    f"but config.max_bin={cfg.max_bin}; rebuild the Dataset "
-                    "with the matching max_bin")
             if mesh is None and init_model is None:
                 # fast path: reuse the device-resident binned matrix (the mesh
                 # / warm-start paths need raw rows for padding / rescoring)
@@ -524,6 +519,14 @@ def train_booster(
         with measures.span("referenceDataset"):
             mapper = compute_bin_mapper(X, cfg.max_bin, cfg.bin_sample_count,
                                         categorical_features, cfg.seed)
+    if mapper.max_bin != cfg.max_bin:
+        # every mapper source (Dataset, explicit mapper=, warm start) funnels
+        # through here: bin ids outside the grower's num_bins range would
+        # silently drop from histograms, so a mismatch is an error
+        raise ValueError(
+            f"bin mapper has max_bin={mapper.max_bin} but config.max_bin="
+            f"{cfg.max_bin}; rebuild the Dataset/mapper with the matching "
+            "max_bin")
 
     # Multi-chip: pad rows to the data-axis size and shard. The padding rows get
     # in_bag = 0, so they contribute nothing to histograms or leaf stats; GSPMD

@@ -76,6 +76,13 @@ class GrowerConfig(NamedTuple):
     # cumsum + vectorized binary search for the inverse permutation
     # (O(n log n) gathers — wins when sort stages dominate the split step)
     partition_impl: str = "sort"
+    # row layout strategy: "partition" keeps rows physically sorted by leaf
+    # (smaller-child histograms scan only the child's contiguous range);
+    # "masked" never moves rows — each split histograms the full row set with
+    # the child mask folded into the kernel's value factor. Masked trades
+    # ~12x more rows through the MXU kernel for ZERO sort/permute work per
+    # split; which wins is a measured property of the chip (tools/perf_tune.py)
+    row_layout: str = "partition"
 
 
 class TreeArrays(NamedTuple):
@@ -242,8 +249,168 @@ def _best_for_leaf(hist, feature_active, is_categorical, monotone, nan_bins,
 
 
 # ---------------------------------------------------------------------------
-# Tree growth
+# Tree growth — helpers shared by the "partition" and "masked" row layouts
 # ---------------------------------------------------------------------------
+
+def _pad_grow_inputs(binned, grad, hess, in_bag, feature_active,
+                     is_categorical, monotone, nan_bins, FP, Np):
+    """Pad rows to Np (zero mass) / features to FP (inactive), transpose bins."""
+    n, f = binned.shape
+    in_bag = jnp.asarray(in_bag, jnp.float32)
+    g0 = jnp.asarray(grad, jnp.float32) * in_bag
+    h0 = jnp.asarray(hess, jnp.float32) * in_bag
+    pad_r = Np - n
+    bT0 = jnp.zeros((FP, Np), jnp.int32)
+    bT0 = bT0.at[:f, :n].set(binned.astype(jnp.int32).T)
+    gs0 = jnp.pad(g0, (0, pad_r))
+    hs0 = jnp.pad(h0, (0, pad_r))
+    ms0 = jnp.pad(in_bag, (0, pad_r))
+    featp = jnp.zeros(FP, bool).at[:f].set(feature_active)
+    catp = jnp.zeros(FP, bool).at[:f].set(is_categorical)
+    monop = jnp.zeros(FP, jnp.int32).at[:f].set(monotone)
+    nanp = jnp.full(FP, 0x7FFF, jnp.int32).at[:f].set(nan_bins)
+    return bT0, gs0, hs0, ms0, featp, catp, monop, nanp
+
+
+def _winning_cat_bitset(hist_parent, fsel, bsel, catp, cfg: GrowerConfig,
+                        B: int, bw: int):
+    """(bitset, cat_split) of the chosen split, rebuilt from the hist cache
+    (LightGBM's many-vs-many prefix re-derived from the sorted-bin order)."""
+    if not cfg.has_categorical:
+        return jnp.zeros((bw,), jnp.uint32), jnp.zeros((), bool)
+    histf = hist_parent[fsel]                          # (B, 3)
+    keyc = jnp.where(histf[:, 2] > 0,
+                     histf[:, 0] / (histf[:, 1] + cfg.cat_smooth), jnp.inf)
+    order_f = jnp.argsort(keyc)
+    take = jnp.arange(B) <= bsel
+    bwords = (order_f >> 5).astype(jnp.int32)
+    bvals = jnp.uint32(1) << (order_f & 31).astype(jnp.uint32)
+    bitset = jnp.zeros((bw,), jnp.uint32).at[bwords].add(
+        jnp.where(take, bvals, jnp.uint32(0)))
+    return bitset, catp[fsel]
+
+
+def _route_right(binrow, bsel, dl, nanbin_f, bitset, cat_split,
+                 cfg: GrowerConfig, bw: int):
+    """Per-row go-right decision of one split over bin values ``binrow``
+    (numeric threshold, learned NaN direction, categorical bitset)."""
+    gr = binrow > bsel
+    gr = jnp.where(binrow == nanbin_f, ~dl, gr)
+    if cfg.has_categorical:
+        w = bitset[jnp.clip(binrow >> 5, 0, bw - 1)]
+        member = ((w >> (binrow & 31).astype(jnp.uint32)) & 1).astype(bool)
+        gr = jnp.where(cat_split, ~member, gr)
+    return gr
+
+
+def _init_split_state(L: int, B: int, bw: int, hist_root, rg, rf, rb, rdl,
+                      rcl, FP: int):
+    """Initial per-leaf split state + tree-structure arrays (shared fields of
+    both layout states): root occupies leaf 0."""
+    z1 = lambda dt, fill=0: jnp.full((max(L - 1, 1),), fill, dt)
+    return dict(
+        hist=jnp.zeros((L, FP, B, 3), jnp.float32).at[0].set(hist_root),
+        bgain=jnp.full(L, -jnp.inf, jnp.float32).at[0].set(rg),
+        bfeat=jnp.zeros(L, jnp.int32).at[0].set(rf),
+        bbin=jnp.zeros(L, jnp.int32).at[0].set(rb),
+        bdl=jnp.zeros(L, bool).at[0].set(rdl),
+        bcl=jnp.zeros(L, jnp.float32).at[0].set(rcl),
+        depth=jnp.zeros(L, jnp.int32),
+        leaf_parent=jnp.full(L, -1, jnp.int32),
+        leaf_is_right=jnp.zeros(L, bool),
+        split_feature=z1(jnp.int32),
+        split_bin=z1(jnp.int32, B - 1),
+        split_gain=z1(jnp.float32),
+        split_type=z1(jnp.int32),
+        default_left=jnp.zeros((max(L - 1, 1),), bool),
+        cat_bitset=jnp.zeros((max(L - 1, 1), bw), jnp.uint32),
+        left_child=z1(jnp.int32, ~0),
+        right_child=z1(jnp.int32, ~0),
+        internal_value=z1(jnp.float32),
+        internal_count=z1(jnp.int32),
+        num_splits=jnp.zeros((), jnp.int32),
+    )
+
+
+def _select_split_leaf(s, cfg: GrowerConfig, L: int):
+    """(leaf index, do-split flag) for this growth step."""
+    active = jnp.arange(L) <= s.num_splits
+    if cfg.max_depth > 0:
+        active &= s.depth < cfg.max_depth
+    masked_gain = jnp.where(active, s.bgain, -jnp.inf)
+    l = jnp.argmax(masked_gain).astype(jnp.int32)
+    return l, masked_gain[l] > cfg.min_gain_to_split
+
+
+def _common_split_updates(s, cfg: GrowerConfig, l, fsel, bsel, gain_l, dl,
+                          bitset, cat_split, hist_left, hist_right,
+                          bg2, bf2, bb2, bdl2, bcl2, G_l, H_l, C_l):
+    """``_replace`` kwargs shared by both layouts for one split of leaf ``l``:
+    hist cache, per-leaf best-split state, and tree-structure bookkeeping
+    (leaf numbering per LightGBM Tree::Split — left keeps ``l``, right becomes
+    ``num_splits + 1``, child pointers ``~leaf``)."""
+    new_right = s.num_splits + 1
+    i_node = s.num_splits
+    parent_out = _leaf_output(G_l, H_l, cfg) * cfg.learning_rate
+    p = s.leaf_parent[l]
+    p_idx = jnp.maximum(p, 0)
+    lc = s.left_child.at[p_idx].set(
+        jnp.where((p >= 0) & ~s.leaf_is_right[l], i_node, s.left_child[p_idx]))
+    rc = s.right_child.at[p_idx].set(
+        jnp.where((p >= 0) & s.leaf_is_right[l], i_node, s.right_child[p_idx]))
+    lc = lc.at[i_node].set(~l)
+    rc = rc.at[i_node].set(~new_right)
+    return dict(
+        hist=s.hist.at[l].set(hist_left).at[new_right].set(hist_right),
+        bgain=s.bgain.at[l].set(bg2[0]).at[new_right].set(bg2[1]),
+        bfeat=s.bfeat.at[l].set(bf2[0]).at[new_right].set(bf2[1]),
+        bbin=s.bbin.at[l].set(bb2[0]).at[new_right].set(bb2[1]),
+        bdl=s.bdl.at[l].set(bdl2[0]).at[new_right].set(bdl2[1]),
+        bcl=s.bcl.at[l].set(bcl2[0]).at[new_right].set(bcl2[1]),
+        depth=s.depth.at[l].add(1).at[new_right].set(s.depth[l] + 1),
+        leaf_parent=s.leaf_parent.at[l].set(i_node).at[new_right].set(i_node),
+        leaf_is_right=s.leaf_is_right.at[l].set(False)
+                                     .at[new_right].set(True),
+        split_feature=s.split_feature.at[i_node].set(fsel),
+        split_bin=s.split_bin.at[i_node].set(bsel),
+        split_gain=s.split_gain.at[i_node].set(gain_l),
+        split_type=s.split_type.at[i_node].set(cat_split.astype(jnp.int32)),
+        default_left=s.default_left.at[i_node].set(dl),
+        cat_bitset=s.cat_bitset.at[i_node].set(bitset),
+        left_child=lc,
+        right_child=rc,
+        internal_value=s.internal_value.at[i_node].set(parent_out),
+        internal_count=s.internal_count.at[i_node].set(C_l.astype(jnp.int32)),
+        num_splits=s.num_splits + 1,
+    )
+
+
+def _finalize_tree(s, cfg: GrowerConfig, L: int) -> TreeArrays:
+    """Leaf stats from the per-leaf histogram cache (per-leaf f32 accumulation
+    — a global prefix-sum difference would catastrophically cancel for small
+    leaves on large N; the cache is already psum'd across devices)."""
+    leaf_tot = s.hist[:, 0].sum(axis=1)                  # (L, 3)
+    sumG, sumH, sumC = leaf_tot[:, 0], leaf_tot[:, 1], leaf_tot[:, 2]
+    leaf_value = _leaf_output(sumG, sumH, cfg) * cfg.learning_rate
+    exists = jnp.arange(L) <= s.num_splits
+    leaf_value = jnp.where(exists, leaf_value, 0.0)
+    return TreeArrays(
+        split_feature=s.split_feature,
+        split_bin=s.split_bin,
+        split_gain=s.split_gain,
+        split_type=s.split_type,
+        default_left=s.default_left,
+        cat_bitset=s.cat_bitset,
+        left_child=s.left_child,
+        right_child=s.right_child,
+        internal_value=s.internal_value,
+        internal_count=s.internal_count,
+        leaf_value=leaf_value,
+        leaf_weight=sumH,
+        leaf_count=sumC.astype(jnp.int32),
+        num_splits=s.num_splits,
+    )
+
 
 class _GrowState(NamedTuple):
     pos: jnp.ndarray             # (Np,) i32: sorted position -> original row
@@ -289,22 +456,9 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
     sizes = _bucket_sizes(Np)
     sizes_arr = jnp.asarray(sizes, jnp.int32)
 
-    in_bag = jnp.asarray(in_bag, jnp.float32)
-    g0 = jnp.asarray(grad, jnp.float32) * in_bag
-    h0 = jnp.asarray(hess, jnp.float32) * in_bag
-
-    # pad row axis to Np (mask 0) and features to FP (inactive), transpose
-    pad_r = Np - n
-    bT0 = jnp.zeros((FP, Np), jnp.int32)
-    bT0 = bT0.at[:f, :n].set(binned.astype(jnp.int32).T)
-    gs0 = jnp.pad(g0, (0, pad_r))
-    hs0 = jnp.pad(h0, (0, pad_r))
-    ms0 = jnp.pad(in_bag, (0, pad_r))
-
-    featp = jnp.zeros(FP, bool).at[:f].set(feature_active)
-    catp = jnp.zeros(FP, bool).at[:f].set(is_categorical)
-    monop = jnp.zeros(FP, jnp.int32).at[:f].set(monotone)
-    nanp = jnp.full(FP, 0x7FFF, jnp.int32).at[:f].set(nan_bins)
+    bT0, gs0, hs0, ms0, featp, catp, monop, nanp = _pad_grow_inputs(
+        binned, grad, hess, in_bag, feature_active, is_categorical, monotone,
+        nan_bins, FP, Np)
 
     def build_hist(bT, gs, hs, ms, child_start, child_len):
         """Histogram of sorted rows [child_start, child_start+child_len) via
@@ -335,32 +489,12 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
     hist_root = build_hist(bT0, gs0, hs0, ms0, jnp.int32(0), jnp.int32(Np))
     rg, rf, rb, rdl, rcl, _ = best_of(hist_root)
 
-    z1 = lambda dt, fill=0: jnp.full((max(L - 1, 1),), fill, dt)
     init = _GrowState(
         pos=jnp.arange(Np, dtype=jnp.int32),
         gs=gs0, hs=hs0, ms=ms0, bT=bT0,
         leaf_start=jnp.zeros(L, jnp.int32),
         leaf_len=jnp.zeros(L, jnp.int32).at[0].set(Np),
-        hist=jnp.zeros((L, FP, B, 3), jnp.float32).at[0].set(hist_root),
-        bgain=jnp.full(L, -jnp.inf, jnp.float32).at[0].set(rg),
-        bfeat=jnp.zeros(L, jnp.int32).at[0].set(rf),
-        bbin=jnp.zeros(L, jnp.int32).at[0].set(rb),
-        bdl=jnp.zeros(L, bool).at[0].set(rdl),
-        bcl=jnp.zeros(L, jnp.float32).at[0].set(rcl),
-        depth=jnp.zeros(L, jnp.int32),
-        leaf_parent=jnp.full(L, -1, jnp.int32),
-        leaf_is_right=jnp.zeros(L, bool),
-        split_feature=z1(jnp.int32),
-        split_bin=z1(jnp.int32, B - 1),
-        split_gain=z1(jnp.float32),
-        split_type=z1(jnp.int32),
-        default_left=jnp.zeros((max(L - 1, 1),), bool),
-        cat_bitset=jnp.zeros((max(L - 1, 1), bw), jnp.uint32),
-        left_child=z1(jnp.int32, ~0),
-        right_child=z1(jnp.int32, ~0),
-        internal_value=z1(jnp.float32),
-        internal_count=z1(jnp.int32),
-        num_splits=jnp.zeros((), jnp.int32),
+        **_init_split_state(L, B, bw, hist_root, rg, rf, rb, rdl, rcl, FP),
     )
 
     def partition(pos, gs, hs, ms, bT, start, length, fsel, bsel, dl, bitset,
@@ -373,13 +507,8 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
                 cs = jnp.minimum(start, Np - size)
                 idx = cs + jnp.arange(size, dtype=jnp.int32)
                 binrow = lax.dynamic_slice(bT_, (fsel, cs), (1, size))[0]
-                gr = binrow > bsel
-                gr = jnp.where(binrow == nanbin_f, ~dl, gr)
-                if cfg.has_categorical:
-                    w = bitset[jnp.clip(binrow >> 5, 0, bw - 1)]
-                    member = ((w >> (binrow & 31).astype(jnp.uint32)) & 1
-                              ).astype(bool)
-                    gr = jnp.where(cat_split, ~member, gr)
+                gr = _route_right(binrow, bsel, dl, nanbin_f, bitset,
+                                  cat_split, cfg, bw)
                 key = jnp.where(idx < start, -1,
                                 jnp.where(idx >= start + length, 2,
                                           gr.astype(jnp.int32)))
@@ -401,41 +530,17 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
                           (pos, gs, hs, ms, bT))
 
     def body(i, s: _GrowState):
-        leaf_ids = jnp.arange(L)
-        active = leaf_ids <= s.num_splits
-        if cfg.max_depth > 0:
-            active &= s.depth < cfg.max_depth
-        masked_gain = jnp.where(active, s.bgain, -jnp.inf)
-        l = jnp.argmax(masked_gain).astype(jnp.int32)
-        do = masked_gain[l] > cfg.min_gain_to_split
+        l, do = _select_split_leaf(s, cfg, L)
 
         def step(s: _GrowState) -> _GrowState:
-            gain_l = s.bgain[l]
-            fsel = s.bfeat[l]
-            bsel = s.bbin[l]
-            dl = s.bdl[l]
+            gain_l, fsel, bsel, dl = s.bgain[l], s.bfeat[l], s.bbin[l], s.bdl[l]
             start = s.leaf_start[l]
             length = s.leaf_len[l]
             hist_parent = s.hist[l]                     # (FP, B, 3)
             totals = hist_parent[0].sum(axis=0)
             G_l, H_l, C_l = totals[0], totals[1], totals[2]
-
-            # categorical bitset of the winning split, rebuilt from the cache
-            if cfg.has_categorical:
-                histf = hist_parent[fsel]               # (B, 3)
-                keyc = jnp.where(histf[:, 2] > 0,
-                                 histf[:, 0] / (histf[:, 1] + cfg.cat_smooth),
-                                 jnp.inf)
-                order_f = jnp.argsort(keyc)
-                take = jnp.arange(B) <= bsel
-                bwords = (order_f >> 5).astype(jnp.int32)
-                bvals = jnp.uint32(1) << (order_f & 31).astype(jnp.uint32)
-                bitset = jnp.zeros((bw,), jnp.uint32).at[bwords].add(
-                    jnp.where(take, bvals, jnp.uint32(0)))
-                cat_split = catp[fsel]
-            else:
-                bitset = jnp.zeros((bw,), jnp.uint32)
-                cat_split = jnp.zeros((), bool)
+            bitset, cat_split = _winning_cat_bitset(hist_parent, fsel, bsel,
+                                                    catp, cfg, B, bw)
 
             pos2, gs2, hs2, ms2, bT2, nl_loc = partition(
                 s.pos, s.gs, s.hs, s.ms, s.bT, start, length, fsel, bsel, dl,
@@ -457,75 +562,29 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
                 jnp.stack([hist_left, hist_right]))
 
             new_right = s.num_splits + 1                # leaf id of right child
-            i_node = s.num_splits                       # internal node id
-
-            def setw(arr, idx, val):
-                return arr.at[idx].set(val)
-
-            parent_out = _leaf_output(G_l, H_l, cfg) * cfg.learning_rate
-            p = s.leaf_parent[l]
-            p_idx = jnp.maximum(p, 0)
-            lc = s.left_child.at[p_idx].set(
-                jnp.where((p >= 0) & ~s.leaf_is_right[l], i_node,
-                          s.left_child[p_idx]))
-            rc = s.right_child.at[p_idx].set(
-                jnp.where((p >= 0) & s.leaf_is_right[l], i_node,
-                          s.right_child[p_idx]))
-            lc = lc.at[i_node].set(~l)
-            rc = rc.at[i_node].set(~new_right)
-
             return s._replace(
                 pos=pos2, gs=gs2, hs=hs2, ms=ms2, bT=bT2,
                 leaf_start=s.leaf_start.at[l].set(start)
                                        .at[new_right].set(start + nl_loc),
                 leaf_len=s.leaf_len.at[l].set(nl_loc)
                                     .at[new_right].set(length - nl_loc),
-                hist=s.hist.at[l].set(hist_left).at[new_right].set(hist_right),
-                bgain=s.bgain.at[l].set(bg2[0]).at[new_right].set(bg2[1]),
-                bfeat=s.bfeat.at[l].set(bf2[0]).at[new_right].set(bf2[1]),
-                bbin=s.bbin.at[l].set(bb2[0]).at[new_right].set(bb2[1]),
-                bdl=s.bdl.at[l].set(bdl2[0]).at[new_right].set(bdl2[1]),
-                bcl=s.bcl.at[l].set(bcl2[0]).at[new_right].set(bcl2[1]),
-                depth=s.depth.at[l].add(1)
-                            .at[new_right].set(s.depth[l] + 1),
-                leaf_parent=s.leaf_parent.at[l].set(i_node)
-                                        .at[new_right].set(i_node),
-                leaf_is_right=s.leaf_is_right.at[l].set(False)
-                                             .at[new_right].set(True),
-                split_feature=setw(s.split_feature, i_node, fsel),
-                split_bin=setw(s.split_bin, i_node, bsel),
-                split_gain=setw(s.split_gain, i_node, gain_l),
-                split_type=setw(s.split_type, i_node,
-                                cat_split.astype(jnp.int32)),
-                default_left=setw(s.default_left, i_node, dl),
-                cat_bitset=s.cat_bitset.at[i_node].set(bitset),
-                left_child=lc,
-                right_child=rc,
-                internal_value=setw(s.internal_value, i_node, parent_out),
-                internal_count=setw(s.internal_count, i_node,
-                                    C_l.astype(jnp.int32)),
-                num_splits=s.num_splits + 1,
+                **_common_split_updates(s, cfg, l, fsel, bsel, gain_l, dl,
+                                        bitset, cat_split, hist_left,
+                                        hist_right, bg2, bf2, bb2, bdl2, bcl2,
+                                        G_l, H_l, C_l),
             )
 
         return lax.cond(do, step, lambda s: s, s)
 
     s = lax.fori_loop(0, L - 1, body, init) if L > 1 else init
-
-    # ---- leaf stats from the per-leaf histogram cache ---------------------
-    # (per-leaf f32 accumulation — a global prefix-sum difference would
-    # catastrophically cancel for small leaves on large N; the cache is
-    # already psum'd across devices)
-    leaf_tot = s.hist[:, 0].sum(axis=1)                  # (L, 3)
-    sumG, sumH, sumC = leaf_tot[:, 0], leaf_tot[:, 1], leaf_tot[:, 2]
-    leaf_value = _leaf_output(sumG, sumH, cfg) * cfg.learning_rate
-    exists = jnp.arange(L) <= s.num_splits
-    leaf_value = jnp.where(exists, leaf_value, 0.0)
+    tree = _finalize_tree(s, cfg, L)
 
     # ---- per-row final leaf (original order) ------------------------------
     # scatter leaf ids at range starts, fill forward via cumulative max of
     # (position * L + id), then undo the sort with one scatter through pos.
     # Zero-length local ranges are excluded: they share a start position with
     # their sibling and the scatter collision would mislabel the sibling's rows
+    exists = jnp.arange(L) <= s.num_splits
     own_rows = exists & (s.leaf_len > 0)
     markers = jnp.full(Np, -1, jnp.int32).at[
         jnp.where(own_rows, s.leaf_start, Np)].set(
@@ -537,24 +596,115 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
         jnp.where(markers >= 0, jnp.arange(Np, dtype=jnp.int32), -1))
     node_sorted = markers[jnp.maximum(last_pos, 0)]
     node_of_row = jnp.zeros(Np, jnp.int32).at[s.pos].set(node_sorted)[:n]
-
-    tree = TreeArrays(
-        split_feature=s.split_feature,
-        split_bin=s.split_bin,
-        split_gain=s.split_gain,
-        split_type=s.split_type,
-        default_left=s.default_left,
-        cat_bitset=s.cat_bitset,
-        left_child=s.left_child,
-        right_child=s.right_child,
-        internal_value=s.internal_value,
-        internal_count=s.internal_count,
-        leaf_value=leaf_value,
-        leaf_weight=sumH,
-        leaf_count=sumC.astype(jnp.int32),
-        num_splits=s.num_splits,
-    )
     return tree, node_of_row
+
+
+class _MaskedState(NamedTuple):
+    node: jnp.ndarray            # (Np,) i32 current leaf id per row
+    hist: jnp.ndarray            # (L, FP, B, 3) f32 cache — shared-field block
+    bgain: jnp.ndarray           # (see _init_split_state)
+    bfeat: jnp.ndarray
+    bbin: jnp.ndarray
+    bdl: jnp.ndarray
+    bcl: jnp.ndarray
+    depth: jnp.ndarray
+    leaf_parent: jnp.ndarray
+    leaf_is_right: jnp.ndarray
+    split_feature: jnp.ndarray
+    split_bin: jnp.ndarray
+    split_gain: jnp.ndarray
+    split_type: jnp.ndarray
+    default_left: jnp.ndarray
+    cat_bitset: jnp.ndarray
+    left_child: jnp.ndarray
+    right_child: jnp.ndarray
+    internal_value: jnp.ndarray
+    internal_count: jnp.ndarray
+    num_splits: jnp.ndarray
+
+
+def _grow_tree_impl_masked(binned, grad, hess, in_bag, feature_active,
+                           is_categorical, monotone, nan_bins,
+                           cfg: GrowerConfig, axis_name: Optional[str]):
+    """Masked-row grower: rows never move. Each split routes leaf ``l``'s rows
+    by updating a per-row ``node`` array and histograms the smaller child with
+    the child-membership mask multiplied into the kernel's (g, h, count)
+    factors over the FULL row set. Removes every per-split sort/permute at the
+    cost of a full-N kernel pass per split — the winning trade when the MXU
+    histogram's per-row cost is far below the partition's sort cost
+    (measured: tools/perf_tune.py phases 2-3). Produces bitwise-identical
+    trees to the partitioned grower (tests/test_gbdt_engine.py)."""
+    n, f = binned.shape
+    L = cfg.num_leaves
+    B = pad_bins(cfg.num_bins)
+    FP = features_padded(f)
+    Np = -(-n // _CHUNK) * _CHUNK
+    bw = (B + BITS - 1) // BITS
+    l1 = jnp.float32(cfg.lambda_l1)
+    l2 = jnp.float32(cfg.lambda_l2)
+
+    bT0, gs0, hs0, ms0, featp, catp, monop, nanp = _pad_grow_inputs(
+        binned, grad, hess, in_bag, feature_active, is_categorical, monotone,
+        nan_bins, FP, Np)
+
+    def build_hist_masked(sel):
+        hist = child_histogram(bT0, gs0 * sel, hs0 * sel, ms0 * sel, B)
+        return _maybe_psum(hist, axis_name)
+
+    def best_of(hist_leaf):
+        return _best_for_leaf(hist_leaf, featp, catp, monop, nanp, cfg, l1, l2)
+
+    hist_root = build_hist_masked(jnp.ones(Np, jnp.float32))
+    rg, rf, rb, rdl, rcl, _ = best_of(hist_root)
+
+    init = _MaskedState(
+        node=jnp.zeros(Np, jnp.int32),
+        **_init_split_state(L, B, bw, hist_root, rg, rf, rb, rdl, rcl, FP),
+    )
+
+    def body(i, s: _MaskedState):
+        l, do = _select_split_leaf(s, cfg, L)
+
+        def step(s: _MaskedState) -> _MaskedState:
+            gain_l, fsel, bsel, dl = s.bgain[l], s.bfeat[l], s.bbin[l], s.bdl[l]
+            hist_parent = s.hist[l]
+            totals = hist_parent[0].sum(axis=0)
+            G_l, H_l, C_l = totals[0], totals[1], totals[2]
+            bitset, cat_split = _winning_cat_bitset(hist_parent, fsel, bsel,
+                                                    catp, cfg, B, bw)
+
+            # route leaf l's rows: right-goers move to leaf id num_splits+1
+            binrow = lax.dynamic_slice(bT0, (fsel, 0), (1, Np))[0]
+            gr = _route_right(binrow, bsel, dl, nanp[fsel], bitset, cat_split,
+                              cfg, bw)
+            new_right = s.num_splits + 1
+            node2 = jnp.where((s.node == l) & gr, new_right, s.node)
+
+            # build the globally-smaller child; sibling by subtraction
+            cl_glob = s.bcl[l]
+            left_small = cl_glob * 2.0 <= C_l
+            child_id = jnp.where(left_small, l, new_right)
+            sel = (node2 == child_id).astype(jnp.float32)
+            hist_small = build_hist_masked(sel)
+            hist_left = jnp.where(left_small, hist_small,
+                                  hist_parent - hist_small)
+            hist_right = hist_parent - hist_left
+
+            bg2, bf2, bb2, bdl2, bcl2, _ = jax.vmap(best_of)(
+                jnp.stack([hist_left, hist_right]))
+
+            return s._replace(
+                node=node2,
+                **_common_split_updates(s, cfg, l, fsel, bsel, gain_l, dl,
+                                        bitset, cat_split, hist_left,
+                                        hist_right, bg2, bf2, bb2, bdl2, bcl2,
+                                        G_l, H_l, C_l),
+            )
+
+        return lax.cond(do, step, lambda s: s, s)
+
+    s = lax.fori_loop(0, L - 1, body, init) if L > 1 else init
+    return _finalize_tree(s, cfg, L), s.node[:n]
 
 
 @partial(jax.jit, static_argnames=("cfg", "axis_name"))
@@ -575,6 +725,13 @@ def grow_tree(
     n, f = binned.shape
     if nan_bins is None:
         nan_bins = jnp.full(f, 0x7FFF, jnp.int32)
+    if cfg.row_layout == "masked":
+        return _grow_tree_impl_masked(binned, grad, hess, in_bag,
+                                      feature_active, is_categorical, monotone,
+                                      nan_bins, cfg, axis_name)
+    if cfg.row_layout != "partition":
+        raise ValueError(
+            f"row_layout must be 'partition' or 'masked', got {cfg.row_layout!r}")
     return _grow_tree_impl(binned, grad, hess, in_bag, feature_active,
                            is_categorical, monotone, nan_bins, cfg, axis_name)
 

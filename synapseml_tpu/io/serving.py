@@ -113,6 +113,16 @@ class ServingServer:
             timeout = 30
 
             def do_POST(self):  # noqa: N802
+                if "chunked" in self.headers.get("Transfer-Encoding",
+                                                 "").lower():
+                    # chunked bodies are not parsed; reading 0 bytes would
+                    # desync the keep-alive stream (the chunk data would be
+                    # parsed as the next request), so reject and close
+                    self.send_response(411)  # Length Required
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    self.close_connection = True
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
                 req = _PendingRequest(

@@ -1,0 +1,33 @@
+"""Docs-site generator (tools/docgen — the reference's docgen + website
+analog, SURVEY §2.9)."""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_docgen_builds_site():
+    with tempfile.TemporaryDirectory() as d:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "docgen", "docgen.py"),
+             "--out", d], capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        pages = [f for f in os.listdir(d) if f.endswith(".html")]
+        assert "index.html" in pages and "api.html" in pages
+        api = open(os.path.join(d, "api.html"), encoding="utf-8").read()
+        assert "<nav>" in api and "<table>" in api     # params tables render
+        assert "numIterations" in api                  # real param surfaced
+
+
+def test_md_renderer_subset():
+    sys.path.insert(0, os.path.join(REPO, "tools", "docgen"))
+    from docgen import md_to_html
+
+    h = md_to_html("# T\n\npara `c` **b**\n\n- a\n- b\n\n```py\nx=1\n```\n\n"
+                   "| h |\n|---|\n| v |\n")
+    for frag in ("<h1>T</h1>", "<code>c</code>", "<strong>b</strong>",
+                 "<li>a</li>", "<pre><code", "<th>h</th>", "<td>v</td>"):
+        assert frag in h, (frag, h)

@@ -206,3 +206,44 @@ def test_partition_impl_scan_matches_sort(binary_data):
                                       np.asarray(tc.split_feature))
         np.testing.assert_allclose(np.asarray(ts.leaf_value),
                                    np.asarray(tc.leaf_value), rtol=1e-6)
+
+
+def test_row_layout_masked_matches_partition(binary_data):
+    """The masked-row grower (no row movement, full-N masked histograms) must
+    grow identical trees to the partitioned grower, including NaN routing."""
+    X, _, y, _ = binary_data
+    X = np.array(X)
+    X[::7, 3] = np.nan                 # exercise learned missing direction
+    for extra in ({"num_leaves": 15},
+                  {"num_leaves": 31, "min_data_in_leaf": 5}):
+        cfg_p = BoosterConfig(objective="binary", num_iterations=4, **extra)
+        cfg_m = BoosterConfig(objective="binary", num_iterations=4,
+                              row_layout="masked", **extra)
+        b_p = train_booster(X, y, cfg_p)
+        b_m = train_booster(X, y, cfg_m)
+        for tp, tm in zip(b_p.trees, b_m.trees):
+            np.testing.assert_array_equal(np.asarray(tp.split_feature),
+                                          np.asarray(tm.split_feature))
+            np.testing.assert_array_equal(np.asarray(tp.split_bin),
+                                          np.asarray(tm.split_bin))
+            np.testing.assert_array_equal(np.asarray(tp.default_left),
+                                          np.asarray(tm.default_left))
+            np.testing.assert_allclose(np.asarray(tp.leaf_value),
+                                       np.asarray(tm.leaf_value), rtol=1e-5,
+                                       atol=1e-7)
+        np.testing.assert_allclose(b_p.predict(X[:100]), b_m.predict(X[:100]),
+                                   rtol=1e-5)
+
+
+def test_row_layout_masked_categorical():
+    rng = np.random.default_rng(3)
+    n = 2000
+    cats = rng.integers(0, 10, size=n)
+    y = np.isin(cats, [2, 5, 7]).astype(np.float32)
+    X = np.stack([cats.astype(np.float32),
+                  rng.normal(size=n).astype(np.float32)], 1)
+    cfg = BoosterConfig(objective="binary", num_iterations=8,
+                        row_layout="masked")
+    bst = train_booster(X, y, cfg, categorical_features=[0])
+    p = bst.predict(X)
+    assert ((p > 0.5) == (y > 0.5)).mean() > 0.99

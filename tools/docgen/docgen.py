@@ -1,0 +1,173 @@
+"""Static docs-site generator — the tools/docgen + website/ analog.
+
+The reference converts its docs tree into a Docusaurus website
+(tools/docgen notebook->md converter + website/ build, SURVEY §2.9). This
+repo is Python-native, so the site builds straight from the markdown docs
+(docs/*.md, README.md) with a stdlib-only markdown renderer — no Node, no
+external deps, one command:
+
+    python tools/docgen/docgen.py [--out docs/site]
+
+Produces docs/site/index.html + one page per doc with a shared nav bar.
+`ci.sh docs` runs this. The API reference page itself is generated from the
+live Param metadata by `python -m synapseml_tpu.codegen` (docs/api.md), so
+the chain codegen -> markdown -> website mirrors the reference's
+Scala-Params -> docgen -> Docusaurus pipeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_STYLE = """
+body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif; margin: 0;
+       color: #1a1a1a; line-height: 1.55; }
+nav { background: #15304b; padding: 0.6rem 1.2rem; position: sticky; top: 0; }
+nav a { color: #cfe3f7; text-decoration: none; margin-right: 1.1rem;
+        font-size: 0.95rem; }
+nav a.active, nav a:hover { color: #ffffff; }
+main { max-width: 60rem; margin: 0 auto; padding: 1rem 1.5rem 4rem; }
+pre { background: #f4f6f8; border: 1px solid #e1e4e8; border-radius: 6px;
+      padding: 0.8rem; overflow-x: auto; font-size: 0.85rem; }
+code { background: #f4f6f8; border-radius: 3px; padding: 0.1em 0.3em;
+       font-size: 0.9em; }
+pre code { background: none; border: none; padding: 0; }
+table { border-collapse: collapse; margin: 0.8rem 0; font-size: 0.9rem; }
+th, td { border: 1px solid #d7dbe0; padding: 0.35rem 0.6rem; text-align: left; }
+th { background: #f0f3f6; }
+h1, h2, h3 { line-height: 1.25; }
+h2 { border-bottom: 1px solid #e1e4e8; padding-bottom: 0.25rem; }
+blockquote { border-left: 4px solid #cfd8e3; margin: 0.8rem 0;
+             padding: 0.1rem 1rem; color: #4a5563; }
+"""
+
+
+def _inline(text: str) -> str:
+    text = html.escape(text, quote=False)
+    text = re.sub(r"`([^`]+)`", r"<code>\1</code>", text)
+    text = re.sub(r"\*\*([^*]+)\*\*", r"<strong>\1</strong>", text)
+    text = re.sub(r"(?<!\*)\*([^*\s][^*]*)\*(?!\*)", r"<em>\1</em>", text)
+    text = re.sub(r"\[([^\]]+)\]\(([^)\s]+)\)",
+                  lambda m: f'<a href="{m.group(2)}">{m.group(1)}</a>', text)
+    return text
+
+
+def md_to_html(md: str) -> str:
+    """Small CommonMark-subset renderer: headings, fenced code, tables,
+    lists (one nesting level), blockquotes, paragraphs."""
+    out: list = []
+    lines = md.splitlines()
+    i = 0
+    in_list = None          # None | "ul" | "ol"
+    para: list = []
+
+    def flush_para():
+        if para:
+            out.append("<p>" + _inline(" ".join(para)) + "</p>")
+            para.clear()
+
+    def close_list():
+        nonlocal in_list
+        if in_list:
+            out.append(f"</{in_list}>")
+            in_list = None
+
+    while i < len(lines):
+        line = lines[i]
+        stripped = line.strip()
+        if stripped.startswith("```"):
+            flush_para(); close_list()
+            lang = stripped[3:].strip()
+            block = []
+            i += 1
+            while i < len(lines) and not lines[i].strip().startswith("```"):
+                block.append(lines[i]); i += 1
+            cls = f' class="language-{lang}"' if lang else ""
+            out.append(f"<pre><code{cls}>" + html.escape("\n".join(block))
+                       + "</code></pre>")
+        elif stripped.startswith("#"):
+            flush_para(); close_list()
+            level = len(stripped) - len(stripped.lstrip("#"))
+            out.append(f"<h{level}>{_inline(stripped[level:].strip())}</h{level}>")
+        elif stripped.startswith("|") and i + 1 < len(lines) \
+                and re.match(r"^\s*\|[\s:|-]+\|\s*$", lines[i + 1] or ""):
+            flush_para(); close_list()
+            header = [c.strip() for c in stripped.strip("|").split("|")]
+            out.append("<table><thead><tr>"
+                       + "".join(f"<th>{_inline(c)}</th>" for c in header)
+                       + "</tr></thead><tbody>")
+            i += 2
+            while i < len(lines) and lines[i].strip().startswith("|"):
+                cells = [c.strip() for c in lines[i].strip().strip("|").split("|")]
+                out.append("<tr>" + "".join(f"<td>{_inline(c)}</td>"
+                                            for c in cells) + "</tr>")
+                i += 1
+            out.append("</tbody></table>")
+            continue
+        elif re.match(r"^\s*([-*]|\d+\.)\s+", line):
+            flush_para()
+            kind = "ol" if re.match(r"^\s*\d+\.", line) else "ul"
+            if in_list != kind:
+                close_list()
+                out.append(f"<{kind}>")
+                in_list = kind
+            item = re.sub(r"^\s*([-*]|\d+\.)\s+", "", line)
+            out.append(f"<li>{_inline(item)}</li>")
+        elif stripped.startswith(">"):
+            flush_para(); close_list()
+            out.append(f"<blockquote>{_inline(stripped.lstrip('> '))}</blockquote>")
+        elif not stripped:
+            flush_para(); close_list()
+        else:
+            para.append(stripped)
+        i += 1
+    flush_para(); close_list()
+    return "\n".join(out)
+
+
+def build_site(out_dir: str) -> list:
+    pages = [("index", os.path.join(REPO, "README.md"), "Overview")]
+    docs_dir = os.path.join(REPO, "docs")
+    for name in sorted(os.listdir(docs_dir)):
+        if name.endswith(".md"):
+            slug = os.path.splitext(name)[0]
+            title = slug.replace("_", " ").title().replace("Api", "API")
+            pages.append((slug, os.path.join(docs_dir, name), title))
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for slug, path, title in pages:
+        with open(path, encoding="utf-8") as f:
+            body = md_to_html(f.read())
+        nav = "".join(
+            f'<a href="{s}.html"{" class=\"active\"" if s == slug else ""}>'
+            f"{t}</a>" for s, t, in [(s, t) for s, _, t in pages])
+        page = (f"<!doctype html><html><head><meta charset='utf-8'>"
+                f"<title>{html.escape(title)} — synapseml_tpu</title>"
+                f"<style>{_STYLE}</style></head><body>"
+                f"<nav>{nav}</nav><main>{body}</main></body></html>")
+        dest = os.path.join(out_dir, f"{slug}.html")
+        with open(dest, "w", encoding="utf-8") as f:
+            f.write(page)
+        written.append(dest)
+    return written
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "docs", "site"))
+    args = ap.parse_args()
+    written = build_site(args.out)
+    for w in written:
+        print(w)
+    print(f"{len(written)} pages -> {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

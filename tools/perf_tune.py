@@ -136,20 +136,26 @@ if profile_dir:
         jax.block_until_ready(out.leaf_value)
     print(f"profile written to {profile_dir}", flush=True)
 
-# --- phase 5: fused training, Dataset-staged --------------------------------
+# --- phase 5: fused training, Dataset-staged, layout/partition A/B -----------
 ds = Dataset(X, y, mapper=mapper).block_until_ready()
-results = {}
-for iters in (5, 25):
-    bc = BoosterConfig(objective="binary", num_iterations=iters, seed=1)
-    train_booster(ds, None, bc)           # compile at the REAL shapes + cache
-    t0 = time.perf_counter()
-    b = train_booster(ds, None, bc)
-    jax.block_until_ready(b.trees[-1].leaf_value)
-    dt = time.perf_counter() - t0
-    results[iters] = dt
-    print(f"train {iters:2d} iters (staged): {dt:7.2f} s -> "
-          f"{N*iters/dt/1e6:6.2f}M row-iters/s  vs_baseline="
-          f"{N*iters/dt/4e6:.3f}", flush=True)
-marg = (results[25] - results[5]) / 20
-print(f"marginal per-tree cost: {marg*1e3:.1f} ms -> steady-state "
-      f"{N/marg/1e6:.2f}M row-iters/s ({N/marg/4e6:.2f}x baseline)", flush=True)
+variants = [("partition/sort", {}),
+            ("partition/scan", {"partition_impl": "scan"}),
+            ("masked", {"row_layout": "masked"})]
+for name, kw in variants:
+    results = {}
+    for iters in (5, 25):
+        bc = BoosterConfig(objective="binary", num_iterations=iters, seed=1,
+                           **kw)
+        train_booster(ds, None, bc)       # compile at the REAL shapes + cache
+        t0 = time.perf_counter()
+        b = train_booster(ds, None, bc)
+        jax.block_until_ready(b.trees[-1].leaf_value)
+        dt = time.perf_counter() - t0
+        results[iters] = dt
+        print(f"[{name:14s}] train {iters:2d} iters: {dt:7.2f} s -> "
+              f"{N*iters/dt/1e6:6.2f}M row-iters/s  vs_baseline="
+              f"{N*iters/dt/4e6:.3f}", flush=True)
+    marg = (results[25] - results[5]) / 20
+    print(f"[{name:14s}] marginal/tree: {marg*1e3:.1f} ms -> steady-state "
+          f"{N/marg/1e6:.2f}M row-iters/s ({N/marg/4e6:.2f}x baseline)",
+          flush=True)

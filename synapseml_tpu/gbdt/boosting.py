@@ -26,6 +26,7 @@ import numpy as np
 from jax import lax
 
 from ..ops.quantize import BinMapper, apply_bins, bin_threshold_to_value, compute_bin_mapper
+from .dataset import Dataset, _is_sparse
 from .grower import Forest, GrowerConfig, TreeArrays, forest_predict, grow_tree, stack_trees
 from .objectives import (METRICS, HIGHER_IS_BETTER, Objective, get_objective,
                          lambdarank_objective, make_grouped, ndcg_at_k)
@@ -182,6 +183,7 @@ class Booster:
     # --- inference ------------------------------------------------------
     def raw_score(self, X, binned: bool = False) -> np.ndarray:
         """(N,) or (N, K) raw margin."""
+        X = _densify(X)
         nb = jnp.asarray(self.mapper.nan_bins) if binned else None
         per_tree = forest_predict(self.forest(), jnp.asarray(X), binned=binned,
                                   output="per_tree", nan_bins=nb)  # (N, T)
@@ -198,7 +200,8 @@ class Booster:
 
     def predict_leaf(self, X) -> np.ndarray:
         """(N, T) leaf indices (predictLeaf parity, LightGBMBooster.scala:408)."""
-        return np.asarray(forest_predict(self.forest(), jnp.asarray(X), output="leaf"))
+        return np.asarray(forest_predict(self.forest(), jnp.asarray(_densify(X)),
+                                         output="leaf"))
 
     def feature_importances(self, importance_type: str = "split") -> np.ndarray:
         """split count or total gain per feature (getFeatureImportances parity,
@@ -215,7 +218,7 @@ class Booster:
 
     def feature_shap(self, X) -> np.ndarray:
         from .shap import forest_shap
-        return forest_shap(self, np.asarray(X, np.float32))
+        return forest_shap(self, np.asarray(_densify(X), np.float32))
 
     def _objective_for_transform(self) -> Objective:
         cfg = self.config
@@ -247,6 +250,14 @@ class Booster:
 # ---------------------------------------------------------------------------
 # Training
 # ---------------------------------------------------------------------------
+
+def _densify(X):
+    """scipy sparse -> dense float32 (predict/valid inputs accept CSR the same
+    as training); pass-through for anything else."""
+    if _is_sparse(X):
+        return np.asarray(X.tocsr().todense(), np.float32)
+    return X
+
 
 @jax.jit
 def _leaf_gather(leaf_value, node_of_row):
@@ -460,11 +471,22 @@ def train_booster(
     measures=None,                            # InstrumentationMeasures (§5.1)
 ) -> Booster:
     from ..core.logging import InstrumentationMeasures
-    from .dataset import Dataset
 
     if measures is None:
         measures = InstrumentationMeasures()
     cfg = config
+    if _is_sparse(X):
+        if mesh is not None or init_model is not None:
+            # these paths need raw dense rows anyway (padding / rescoring) and
+            # would discard a pre-binned matrix — densify once, skip the wrap
+            X = _densify(X)
+        else:
+            # scipy CSR/CSC rows: bin chunk-wise through the sparse Dataset
+            # path (the reference's isSparse election, BulkPartitionTask CSR)
+            X = Dataset(X, mapper=mapper, max_bin=cfg.max_bin,
+                        bin_sample_count=cfg.bin_sample_count,
+                        categorical_features=categorical_features,
+                        seed=cfg.seed)
     # LightGBM Dataset analog: pre-binned device-resident data skips the
     # quantization pass and the raw-float host→device transfer entirely
     dataset = X if isinstance(X, Dataset) else None
@@ -495,13 +517,17 @@ def train_booster(
                 # fast path: reuse the device-resident binned matrix (the mesh
                 # / warm-start paths need raw rows for padding / rescoring)
                 prebinned = dataset.binned
-        if dataset.X is not None:
-            X = dataset.X
-        elif prebinned is not None:
-            X = np.zeros(dataset.shape, np.float32)  # placeholder, unused
+        if prebinned is not None:
+            # shape-only placeholder when no dense raw rows are held (sparse
+            # or keep_raw=False): broadcast view, zero memory, never read
+            X = (dataset.X if dataset.X is not None
+                 else np.broadcast_to(np.float32(0.0), dataset.shape))
         else:
-            raise ValueError("Dataset was built with keep_raw=False; this "
-                             "training path (mesh / warm start) needs raw rows")
+            X = dataset.raw_dense()
+            if X is None:
+                raise ValueError("Dataset was built with keep_raw=False; this "
+                                 "training path (mesh / warm start) needs raw "
+                                 "rows")
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.float32)
     if X.ndim != 2 or X.shape[0] == 0:
@@ -637,7 +663,8 @@ def train_booster(
     # validation state
     has_valid = valid is not None
     if has_valid:
-        Xv, yv = np.asarray(valid[0], np.float32), np.asarray(valid[1], np.float32)
+        Xv = np.asarray(_densify(valid[0]), np.float32)
+        yv = np.asarray(valid[1], np.float32)
         binned_v = apply_bins(mapper, Xv)
         score_v = jnp.zeros((Xv.shape[0], k)) + jnp.asarray(base[None, :k], jnp.float32)
         if init_model is not None:

@@ -68,17 +68,25 @@ def compute_bin_mapper(
     sample_count: int = 200_000,
     categorical_features: Optional[Sequence[int]] = None,
     seed: int = 0,
+    has_nan: Optional[np.ndarray] = None,
 ) -> BinMapper:
     """Driver-side boundary computation from a sample (the analog of
     LightGBMBase.getSampledRows + LGBM_DatasetCreateFromSampledColumn;
-    binSampleCount param default 200000 — params/LightGBMParams.scala)."""
+    binSampleCount param default 200000 — params/LightGBMParams.scala).
+
+    ``has_nan`` overrides per-feature missing-ness when the caller has
+    computed it on MORE data than ``X`` (e.g. the sparse path samples rows for
+    boundaries but elects NaN bins from the full matrix)."""
     X = np.asarray(X, dtype=np.float32)
     n, f = X.shape
     cat = np.zeros(f, dtype=bool)
     if categorical_features:
         cat[list(categorical_features)] = True
     # missing-ness decided on the FULL matrix (binning must route every NaN)
-    has_nan = np.isnan(X).any(axis=0) & ~cat
+    if has_nan is None:
+        has_nan = np.isnan(X).any(axis=0) & ~cat
+    else:
+        has_nan = np.asarray(has_nan, bool) & ~cat
 
     if n > sample_count:
         rng = np.random.default_rng(seed)

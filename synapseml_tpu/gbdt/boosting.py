@@ -103,6 +103,7 @@ class BoosterConfig:
             min_data_in_leaf=self.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
             min_gain_to_split=self.min_gain_to_split,
+            feature_fraction_bynode=self.feature_fraction_bynode,
             learning_rate=lr,
             max_delta_step=self.max_delta_step,
             cat_smooth=self.cat_smooth,
@@ -306,6 +307,13 @@ def _sample_features_impl(cfg, nfeat, key0, it):
     return jnp.zeros(nfeat, bool).at[perm[:nf_keep]].set(True)
 
 
+def _node_key_data(key0, it, cls):
+    """Per-tree raw key for feature_fraction_bynode: shared derivation so the
+    fused scan and the host loop sample identical per-node feature subsets."""
+    return jax.random.key_data(
+        jax.random.fold_in(jax.random.fold_in(key0, 30_000_000 + cls), it))
+
+
 def _make_grow_fn(grower_cfg, mesh):
     """The per-tree grower, shard_map'd over the data axis when distributed
     (one histogram psum per split — the socket-ring allreduce analog)."""
@@ -314,19 +322,20 @@ def _make_grow_fn(grower_cfg, mesh):
         from ..parallel.collectives import shard_apply
         from ..parallel.mesh import DATA_AXIS as _DA
 
-        def _grow_sharded(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb):
+        def _grow_sharded(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb, nk):
             return grow_tree(binned_s, g_s, h_s, bag_s, fa, ic, mo,
-                             grower_cfg, nan_bins=nb, axis_name=_DA)
+                             grower_cfg, nan_bins=nb, axis_name=_DA,
+                             node_key=nk)
 
         return shard_apply(
             mesh, _grow_sharded,
             in_specs=(P(_DA, None), P(_DA), P(_DA), P(_DA),
-                      P(None), P(None), P(None), P(None)),
+                      P(None), P(None), P(None), P(None), P(None)),
             out_specs=(P(), P(_DA)))
 
-    def grow_fn(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb):
+    def grow_fn(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb, nk):
         return grow_tree(binned_s, g_s, h_s, bag_s, fa, ic, mo,
-                         grower_cfg, nan_bins=nb)
+                         grower_cfg, nan_bins=nb, node_key=nk)
 
     return grow_fn
 
@@ -394,7 +403,8 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
             cls_trees = []
             for cls in range(k):
                 tree, node = grow_fn(binned, g[:, cls], h[:, cls], in_bag,
-                                     feat_mask, is_cat, mono, nan_bins)
+                                     feat_mask, is_cat, mono, nan_bins,
+                                     _node_key_data(key0, it, cls))
                 cls_trees.append(tree)
                 if not rf_mode:
                     score_c = score_c.at[:, cls].add(
@@ -659,6 +669,19 @@ def train_booster(
     mono = jnp.asarray(mono)
 
     grow_fn = _make_grow_fn(grower_cfg, mesh)
+    # Voting slices the columns to the 2*top_k vote winners, so the per-node
+    # keep count must still be a fraction of the FULL feature count (LightGBM
+    # semantics), capped by the sliced width — rescale the fraction for the
+    # sliced grower rather than letting ceil(frac * 2k) silently shrink it
+    grow_fn_voting = grow_fn
+    if (cfg.tree_learner == "voting" and mesh is not None
+            and nfeat > 2 * cfg.top_k
+            and grower_cfg.feature_fraction_bynode < 1.0):
+        sliced = 2 * cfg.top_k
+        keep_full = math.ceil(grower_cfg.feature_fraction_bynode * nfeat)
+        vfrac = min(1.0, keep_full / sliced)
+        grow_fn_voting = _make_grow_fn(
+            grower_cfg._replace(feature_fraction_bynode=vfrac), mesh)
 
     # validation state
     has_valid = valid is not None
@@ -842,14 +865,15 @@ def train_booster(
                     mesh, cfg.top_k, cfg.max_bin, cfg.lambda_l2,
                     max(cfg.min_data_in_leaf, 1), feature_active=feat_mask)
                 sel_j = jnp.asarray(sel_idx)
-                tree, node = grow_fn(
+                tree, node = grow_fn_voting(
                     binned[:, sel_j], g[:, cls], h[:, cls], in_bag,
                     feat_mask[sel_j], is_cat[sel_j], mono[sel_j],
-                    nan_bins[sel_j])
+                    nan_bins[sel_j], _node_key_data(key0, it, cls))
                 tree = remap_tree_features(tree, sel_idx)
             else:
                 tree, node = grow_fn(binned, g[:, cls], h[:, cls], in_bag,
-                                     feat_mask, is_cat, mono, nan_bins)
+                                     feat_mask, is_cat, mono, nan_bins,
+                                     _node_key_data(key0, it, cls))
             contrib = _leaf_gather(tree.leaf_value, node)          # (N,)
             if dart_mode:
                 tree_contribs.append((cls, contrib))               # device-side

@@ -41,6 +41,7 @@ identical on every device (uniform control flow by construction).
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import NamedTuple, Optional
 
@@ -70,6 +71,7 @@ class GrowerConfig(NamedTuple):
     max_delta_step: float = 0.0
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
+    feature_fraction_bynode: float = 1.0  # per-NODE feature sampling
     has_categorical: bool = False  # static: traces out the categorical path
     # row-partition primitive: "sort" = stable argsort of the 4-way key
     # (XLA bitonic sort, O(n log^2 n) compare-exchange stages); "scan" =
@@ -246,6 +248,31 @@ def _best_for_leaf(hist, feature_active, is_categorical, monotone, nan_bins,
     bdl = use_left.reshape(FP * B)[best]
     bcl = CLsel.reshape(FP * B)[best]
     return best_gain, bfeat, bbin, bdl, bcl, order
+
+
+def _node_mask_fn(cfg: GrowerConfig, featp, f: int, node_key):
+    """feature_fraction_bynode sampler: node id -> (FP,) bool feature mask.
+
+    LightGBM samples a fresh feature subset for every NODE's split search
+    (feature_fraction_bynode, distinct from the per-tree feature_fraction);
+    here each node id folds into the tree's key and keeps exactly
+    ceil(frac * F) real features."""
+    if cfg.feature_fraction_bynode >= 1.0:
+        return lambda nid: featp
+    if node_key is None:
+        raise ValueError("feature_fraction_bynode < 1 requires node_key")
+    FP = featp.shape[0]
+    keep = max(1, int(math.ceil(cfg.feature_fraction_bynode * f)))
+    base = jax.random.wrap_key_data(node_key)
+
+    def mask(nid):
+        u = jax.random.uniform(jax.random.fold_in(base, nid), (FP,))
+        u = jnp.where(featp, u, jnp.inf)
+        ranks = jnp.zeros(FP, jnp.int32).at[jnp.argsort(u)].set(
+            jnp.arange(FP, dtype=jnp.int32))
+        return featp & (ranks < keep)
+
+    return mask
 
 
 # ---------------------------------------------------------------------------
@@ -444,7 +471,7 @@ class _GrowState(NamedTuple):
 
 def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
                     monotone, nan_bins, cfg: GrowerConfig,
-                    axis_name: Optional[str]):
+                    axis_name: Optional[str], node_key=None):
     n, f = binned.shape
     L = cfg.num_leaves
     B = pad_bins(cfg.num_bins)
@@ -482,12 +509,14 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
                           (bT, gs, hs, ms, child_start, child_len))
         return _maybe_psum(hist, axis_name)
 
-    def best_of(hist_leaf):
-        return _best_for_leaf(hist_leaf, featp, catp, monop, nanp, cfg, l1, l2)
+    nmask = _node_mask_fn(cfg, featp, f, node_key)
+
+    def best_of(hist_leaf, fmask):
+        return _best_for_leaf(hist_leaf, fmask, catp, monop, nanp, cfg, l1, l2)
 
     # ---- root ------------------------------------------------------------
     hist_root = build_hist(bT0, gs0, hs0, ms0, jnp.int32(0), jnp.int32(Np))
-    rg, rf, rb, rdl, rcl, _ = best_of(hist_root)
+    rg, rf, rb, rdl, rcl, _ = best_of(hist_root, nmask(jnp.int32(2 * (L - 1))))
 
     init = _GrowState(
         pos=jnp.arange(Np, dtype=jnp.int32),
@@ -558,8 +587,11 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
             hist_right = hist_parent - hist_left
 
             # re-evaluate best splits for the two children
+            i_node_id = s.num_splits
+            masks2 = jnp.stack([nmask(i_node_id * 2),
+                                nmask(i_node_id * 2 + 1)])
             bg2, bf2, bb2, bdl2, bcl2, _ = jax.vmap(best_of)(
-                jnp.stack([hist_left, hist_right]))
+                jnp.stack([hist_left, hist_right]), masks2)
 
             new_right = s.num_splits + 1                # leaf id of right child
             return s._replace(
@@ -625,7 +657,8 @@ class _MaskedState(NamedTuple):
 
 def _grow_tree_impl_masked(binned, grad, hess, in_bag, feature_active,
                            is_categorical, monotone, nan_bins,
-                           cfg: GrowerConfig, axis_name: Optional[str]):
+                           cfg: GrowerConfig, axis_name: Optional[str],
+                           node_key=None):
     """Masked-row grower: rows never move. Each split routes leaf ``l``'s rows
     by updating a per-row ``node`` array and histograms the smaller child with
     the child-membership mask multiplied into the kernel's (g, h, count)
@@ -651,11 +684,13 @@ def _grow_tree_impl_masked(binned, grad, hess, in_bag, feature_active,
         hist = child_histogram(bT0, gs0 * sel, hs0 * sel, ms0 * sel, B)
         return _maybe_psum(hist, axis_name)
 
-    def best_of(hist_leaf):
-        return _best_for_leaf(hist_leaf, featp, catp, monop, nanp, cfg, l1, l2)
+    nmask = _node_mask_fn(cfg, featp, f, node_key)
+
+    def best_of(hist_leaf, fmask):
+        return _best_for_leaf(hist_leaf, fmask, catp, monop, nanp, cfg, l1, l2)
 
     hist_root = build_hist_masked(jnp.ones(Np, jnp.float32))
-    rg, rf, rb, rdl, rcl, _ = best_of(hist_root)
+    rg, rf, rb, rdl, rcl, _ = best_of(hist_root, nmask(jnp.int32(2 * (L - 1))))
 
     init = _MaskedState(
         node=jnp.zeros(Np, jnp.int32),
@@ -690,8 +725,11 @@ def _grow_tree_impl_masked(binned, grad, hess, in_bag, feature_active,
                                   hist_parent - hist_small)
             hist_right = hist_parent - hist_left
 
+            i_node_id = s.num_splits
+            masks2 = jnp.stack([nmask(i_node_id * 2),
+                                nmask(i_node_id * 2 + 1)])
             bg2, bf2, bb2, bdl2, bcl2, _ = jax.vmap(best_of)(
-                jnp.stack([hist_left, hist_right]))
+                jnp.stack([hist_left, hist_right]), masks2)
 
             return s._replace(
                 node=node2,
@@ -719,6 +757,7 @@ def grow_tree(
     cfg: GrowerConfig,
     nan_bins: Optional[jnp.ndarray] = None,  # (F,) i32 NaN bin per feature
     axis_name: Optional[str] = None,         # shard_map data axis for psum
+    node_key=None,                           # raw key data (feature_fraction_bynode)
 ) -> tuple:
     """Grow one tree; returns (TreeArrays, node_of_row) where node_of_row is
     each row's final leaf index (used for the O(1) training-score update)."""
@@ -728,12 +767,13 @@ def grow_tree(
     if cfg.row_layout == "masked":
         return _grow_tree_impl_masked(binned, grad, hess, in_bag,
                                       feature_active, is_categorical, monotone,
-                                      nan_bins, cfg, axis_name)
+                                      nan_bins, cfg, axis_name, node_key)
     if cfg.row_layout != "partition":
         raise ValueError(
             f"row_layout must be 'partition' or 'masked', got {cfg.row_layout!r}")
     return _grow_tree_impl(binned, grad, hess, in_bag, feature_active,
-                           is_categorical, monotone, nan_bins, cfg, axis_name)
+                           is_categorical, monotone, nan_bins, cfg, axis_name,
+                           node_key)
 
 
 # ---------------------------------------------------------------------------

@@ -270,10 +270,12 @@ def _leaf_gather(leaf_value, node_of_row):
 # host loop so both paths sample identically from fold_in(seed, it))
 # ---------------------------------------------------------------------------
 
-def _sample_rows_impl(cfg, n, key0, valid_mask, it, g, h, in_bag_cur):
+def _sample_rows_impl(cfg, n, key0, valid_mask, it, g, h, in_bag_cur, yj=None):
     goss_mode = cfg.boosting_type == "goss"
+    stratified = (cfg.pos_bagging_fraction < 1.0
+                  or cfg.neg_bagging_fraction < 1.0)
     do_bag = ((cfg.boosting_type == "rf" or cfg.bagging_freq > 0)
-              and cfg.bagging_fraction < 1.0)
+              and (cfg.bagging_fraction < 1.0 or stratified))
     if goss_mode:
         gnorm = jnp.abs(g).sum(axis=1)
         top_n = int(cfg.top_rate * n)
@@ -292,7 +294,14 @@ def _sample_rows_impl(cfg, n, key0, valid_mask, it, g, h, in_bag_cur):
     if do_bag:
         u = jax.random.uniform(
             jax.random.fold_in(key0, 20_000_000 + it), (n,))
-        fresh = ((u < cfg.bagging_fraction).astype(jnp.float32) * valid_mask)
+        if stratified and yj is not None:
+            # posBaggingFraction / negBaggingFraction (binary objectives):
+            # per-class keep probability, refreshed every bagging_freq rounds
+            frac = jnp.where(yj > 0, cfg.pos_bagging_fraction,
+                             cfg.neg_bagging_fraction)
+        else:
+            frac = cfg.bagging_fraction
+        fresh = ((u < frac).astype(jnp.float32) * valid_mask)
         bag = jnp.where(it % max(cfg.bagging_freq, 1) == 0, fresh, in_bag_cur)
         return bag, g, h, bag
     return valid_mask, g, h, in_bag_cur
@@ -357,6 +366,7 @@ def _fused_static_key(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
             cfg.sigmoid, cfg.alpha, cfg.fair_c, cfg.poisson_max_delta_step,
             cfg.tweedie_variance_power, cfg.top_rate, cfg.other_rate,
             cfg.bagging_fraction, cfg.bagging_freq, cfg.feature_fraction,
+            cfg.pos_bagging_fraction, cfg.neg_bagging_fraction,
             cfg.lambdarank_truncation_level, mono, grower_cfg,
             n, nfeat, k, nv, metric_name, mesh)
 
@@ -398,7 +408,7 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
             g = jnp.reshape(g, (n, k))
             h = jnp.reshape(h, (n, k))
             in_bag, g, h, in_bag_c = _sample_rows_impl(
-                cfg, n, key0, valid_mask, it, g, h, in_bag_c)
+                cfg, n, key0, valid_mask, it, g, h, in_bag_c, yj)
             feat_mask = _sample_features_impl(cfg, nfeat, key0, it)
             cls_trees = []
             for cls in range(k):
@@ -607,6 +617,11 @@ def train_booster(
                             poisson_max_delta_step=cfg.poisson_max_delta_step,
                             tweedie_variance_power=cfg.tweedie_variance_power)
 
+    if ((cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
+            and cfg.objective not in ("binary",)):
+        # native LightGBM rejects stratified bagging for non-binary objectives
+        raise ValueError("pos_bagging_fraction / neg_bagging_fraction require "
+                         f"objective='binary' (got {cfg.objective!r})")
     if cfg.boosting_type == "rf" and not (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
                                           or cfg.feature_fraction < 1.0):
         # native LightGBM rejects the same degenerate config (identical trees)
@@ -669,19 +684,6 @@ def train_booster(
     mono = jnp.asarray(mono)
 
     grow_fn = _make_grow_fn(grower_cfg, mesh)
-    # Voting slices the columns to the 2*top_k vote winners, so the per-node
-    # keep count must still be a fraction of the FULL feature count (LightGBM
-    # semantics), capped by the sliced width — rescale the fraction for the
-    # sliced grower rather than letting ceil(frac * 2k) silently shrink it
-    grow_fn_voting = grow_fn
-    if (cfg.tree_learner == "voting" and mesh is not None
-            and nfeat > 2 * cfg.top_k
-            and grower_cfg.feature_fraction_bynode < 1.0):
-        sliced = 2 * cfg.top_k
-        keep_full = math.ceil(grower_cfg.feature_fraction_bynode * nfeat)
-        vfrac = min(1.0, keep_full / sliced)
-        grow_fn_voting = _make_grow_fn(
-            grower_cfg._replace(feature_fraction_bynode=vfrac), mesh)
 
     # validation state
     has_valid = valid is not None
@@ -732,7 +734,7 @@ def train_booster(
 
     def sample_rows(it, g, h, in_bag_cur):
         return _sample_rows_impl(cfg, n, key0, valid_mask, it, g, h,
-                                 in_bag_cur)
+                                 in_bag_cur, yj)
 
     def sample_features(it):
         return _sample_features_impl(cfg, nfeat, key0, it)
@@ -823,7 +825,15 @@ def train_booster(
         if dart_mode and trees:
             nt = len(trees)
             if rng.random() >= cfg.skip_drop:
-                p = cfg.drop_rate
+                if cfg.uniform_drop:
+                    p = np.full(nt, cfg.drop_rate)
+                else:
+                    # weighted drop (LightGBM default): drop probability
+                    # proportional to each tree's current weight, normalized
+                    # so the expected drop count stays drop_rate * nt
+                    w = np.asarray(tree_weights[:nt], np.float64)
+                    p = np.minimum(cfg.drop_rate * w * nt / max(w.sum(), 1e-12),
+                                   1.0)
                 drop = np.nonzero(rng.random(nt) < p)[0][: cfg.max_drop]
             else:
                 drop = np.array([], np.int64)
@@ -865,7 +875,9 @@ def train_booster(
                     mesh, cfg.top_k, cfg.max_bin, cfg.lambda_l2,
                     max(cfg.min_data_in_leaf, 1), feature_active=feat_mask)
                 sel_j = jnp.asarray(sel_idx)
-                tree, node = grow_fn_voting(
+                # bynode sampling applies WITHIN the vote winners (the
+                # searchable subset — LightGBM ColSampler semantics)
+                tree, node = grow_fn(
                     binned[:, sel_j], g[:, cls], h[:, cls], in_bag,
                     feat_mask[sel_j], is_cat[sel_j], mono[sel_j],
                     nan_bins[sel_j], _node_key_data(key0, it, cls))

@@ -262,7 +262,12 @@ def _node_mask_fn(cfg: GrowerConfig, featp, f: int, node_key):
     if node_key is None:
         raise ValueError("feature_fraction_bynode < 1 requires node_key")
     FP = featp.shape[0]
-    keep = max(1, int(math.ceil(cfg.feature_fraction_bynode * f)))
+    # LightGBM ColSampler::GetByNode: the per-node count is a fraction of the
+    # CURRENTLY searchable set (the per-tree feature_fraction subset, or the
+    # voting winners) — computed dynamically since that mask is traced
+    keep = jnp.maximum(
+        1, jnp.ceil(cfg.feature_fraction_bynode
+                    * jnp.sum(featp).astype(jnp.float32))).astype(jnp.int32)
     base = jax.random.wrap_key_data(node_key)
 
     def mask(nid):

@@ -695,6 +695,10 @@ def train_booster(
         if init_model is not None:
             score_v = jnp.asarray(init_model.raw_score(Xv).reshape(Xv.shape[0], k), jnp.float32)
         metric_name = cfg.metric or _default_metric(cfg.objective)
+        if metric_name == "ndcg" or (cfg.metric is None
+                                     and metric_name.startswith("ndcg")):
+            # maxPosition (LightGBMRankerParams) sets the NDCG eval position
+            metric_name = f"ndcg@{cfg.max_position}"
         best_metric, best_iter = None, -1
         higher_better = metric_name.split("@")[0] in HIGHER_IS_BETTER
         # dart/rf: per-tree validation contributions (weights change later)

@@ -584,3 +584,291 @@ def _resize(node, x, *rest):
     method = {"nearest": "nearest", "linear": "linear", "cubic": "cubic"}[
         node.attr("mode", "nearest")]
     return jax.image.resize(x, sizes, method=method)
+
+
+# --- extended coverage: UNet / EfficientNet / detection-class graphs --------
+
+@op("Reciprocal")
+def _reciprocal(node, x):
+    return 1.0 / x
+
+
+@op("Floor")
+def _floor(node, x):
+    return _jnp().floor(x)
+
+
+@op("Ceil")
+def _ceil(node, x):
+    return _jnp().ceil(x)
+
+
+@op("Round")
+def _round(node, x):
+    return _jnp().round(x)
+
+
+@op("Sin")
+def _sin(node, x):
+    return _jnp().sin(x)
+
+
+@op("Cos")
+def _cos(node, x):
+    return _jnp().cos(x)
+
+
+@op("Mod")
+def _mod(node, a, b):
+    if node.attr("fmod", 0):
+        return _jnp().fmod(a, b)
+    return _jnp().mod(a, b)
+
+
+@op("And")
+def _and(node, a, b):
+    return a & b
+
+
+@op("Or")
+def _or(node, a, b):
+    return a | b
+
+
+@op("Xor")
+def _xor(node, a, b):
+    return a ^ b
+
+
+@op("PRelu")
+def _prelu(node, x, slope):
+    return _jnp().where(x >= 0, x, slope * x)
+
+
+@op("Elu")
+def _elu(node, x):
+    alpha = node.attr("alpha", 1.0)
+    jnp = _jnp()
+    return jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+@op("Selu")
+def _selu(node, x):
+    alpha = node.attr("alpha", 1.67326319217681884765625)
+    gamma = node.attr("gamma", 1.05070102214813232421875)
+    jnp = _jnp()
+    return gamma * jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+@op("HardSigmoid")
+def _hardsigmoid(node, x):
+    alpha = node.attr("alpha", 0.2)
+    beta = node.attr("beta", 0.5)
+    return _jnp().clip(alpha * x + beta, 0.0, 1.0)
+
+
+@op("HardSwish")
+def _hardswish(node, x):
+    # onnx HardSwish: x * HardSigmoid(x; 1/6, 0.5)
+    return x * _jnp().clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@op("Softplus")
+def _softplus(node, x):
+    import jax
+
+    return jax.nn.softplus(x)
+
+
+@op("ReduceMin")
+def _reduce_min(node, x, *rest):
+    keep = bool(node.attr("keepdims", 1))
+    return _jnp().min(x, axis=_axes(node, rest, x.ndim), keepdims=keep)
+
+
+@op("ReduceProd")
+def _reduce_prod(node, x, *rest):
+    keep = bool(node.attr("keepdims", 1))
+    return _jnp().prod(x, axis=_axes(node, rest, x.ndim), keepdims=keep)
+
+
+@op("ReduceL2")
+def _reduce_l2(node, x, *rest):
+    keep = bool(node.attr("keepdims", 1))
+    jnp = _jnp()
+    return jnp.sqrt(jnp.sum(x * x, axis=_axes(node, rest, x.ndim),
+                            keepdims=keep))
+
+
+@op("ArgMin")
+def _argmin(node, x):
+    axis = node.attr("axis", 0)
+    keep = bool(node.attr("keepdims", 1))
+    out = _jnp().argmin(x, axis=axis)
+    return _jnp().expand_dims(out, axis) if keep else out
+
+
+@op("CumSum")
+def _cumsum(node, x, axis):
+    ax = int(np.asarray(_static(axis, "axis", node)).ravel()[0])
+    jnp = _jnp()
+    if node.attr("reverse", 0):
+        x = jnp.flip(x, ax)
+    out = jnp.cumsum(x, axis=ax)
+    if node.attr("exclusive", 0):
+        out = jnp.roll(out, 1, ax)
+        idx = [slice(None)] * out.ndim
+        idx[ax] = 0
+        out = out.at[tuple(idx)].set(0)
+    if node.attr("reverse", 0):
+        out = jnp.flip(out, ax)
+    return out
+
+
+@op("OneHot")
+def _onehot(node, indices, depth, values):
+    jnp = _jnp()
+    d = int(np.asarray(_static(depth, "depth", node)).ravel()[0])
+    axis = node.attr("axis", -1)
+    off, on = values[0], values[1]
+    raw = jnp.asarray(indices).astype(jnp.int32)
+    idx = jnp.where(raw < 0, raw + d, raw)     # negatives wrap once (spec)
+    in_range = (idx >= 0) & (idx < d)
+    oh = jax_nn_one_hot(jnp.where(in_range, idx, 0), d, axis)
+    # out-of-range indices produce an all-off row (spec), not a wrapped hot
+    oh = oh * jnp.expand_dims(in_range, axis if axis >= 0 else oh.ndim + axis
+                              ).astype(oh.dtype)
+    return oh * (on - off) + off
+
+
+def jax_nn_one_hot(idx, depth, axis):
+    import jax
+
+    oh = jax.nn.one_hot(idx, depth)                    # appended last axis
+    if axis != -1 and axis != oh.ndim - 1:
+        oh = _jnp().moveaxis(oh, -1, axis if axis >= 0 else axis + oh.ndim)
+    return oh
+
+
+@op("TopK")
+def _topk(node, x, k):
+    import jax
+
+    jnp = _jnp()
+    kk = int(np.asarray(_static(k, "k", node)).ravel()[0])
+    axis = node.attr("axis", -1)
+    largest = bool(node.attr("largest", 1))
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(xm if largest else -xm, kk)
+    if not largest:
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx.astype(jnp.int64), -1, axis))
+
+
+@op("Trilu")
+def _trilu(node, x, k=None):
+    jnp = _jnp()
+    kk = int(np.asarray(_static(k, "k", node)).ravel()[0]) if k is not None else 0
+    if node.attr("upper", 1):
+        return jnp.triu(x, kk)
+    return jnp.tril(x, kk)
+
+
+@op("DepthToSpace")
+def _depth_to_space(node, x):
+    b = node.attr("blocksize")
+    n, c, h, w = x.shape
+    jnp = _jnp()
+    if node.attr("mode", "DCR") == "DCR":
+        t = x.reshape(n, b, b, c // (b * b), h, w)
+        t = t.transpose(0, 3, 4, 1, 5, 2)
+    else:  # CRD
+        t = x.reshape(n, c // (b * b), b, b, h, w)
+        t = t.transpose(0, 1, 4, 2, 5, 3)
+    return t.reshape(n, c // (b * b), h * b, w * b)
+
+
+@op("SpaceToDepth")
+def _space_to_depth(node, x):
+    b = node.attr("blocksize")
+    n, c, h, w = x.shape
+    t = x.reshape(n, c, h // b, b, w // b, b)
+    t = t.transpose(0, 3, 5, 1, 2, 4)
+    return t.reshape(n, c * b * b, h // b, w // b)
+
+
+@op("InstanceNormalization")
+def _instance_norm(node, x, scale, bias):
+    jnp = _jnp()
+    eps = node.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean) / jnp.sqrt(var + eps) * scale.reshape(shape) \
+        + bias.reshape(shape)
+
+
+@op("GroupNormalization")
+def _group_norm(node, x, scale, bias):
+    jnp = _jnp()
+    eps = node.attr("epsilon", 1e-5)
+    g = node.attr("num_groups")
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    t = x.reshape((n, g, c // g) + spatial)
+    axes = tuple(range(2, t.ndim))
+    mean = t.mean(axis=axes, keepdims=True)
+    var = ((t - mean) ** 2).mean(axis=axes, keepdims=True)
+    t = (t - mean) / jnp.sqrt(var + eps)
+    t = t.reshape((n, c) + spatial)
+    if scale.shape[0] == g and g != c:
+        # opset 18-20: per-GROUP scale/bias, broadcast over the group's channels
+        scale = jnp.repeat(scale, c // g)
+        bias = jnp.repeat(bias, c // g)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return t * scale.reshape(shape) + bias.reshape(shape)
+
+
+@op("ConvTranspose")
+def _conv_transpose(node, x, w, b=None):
+    import jax
+
+    jnp = _jnp()
+    spatial = x.ndim - 2
+    strides = node.attr("strides", [1] * spatial)
+    dil = node.attr("dilations", [1] * spatial)
+    groups = node.attr("group", 1)
+    pads = node.attr("pads", [0] * (2 * spatial))
+    out_pad = node.attr("output_padding", [0] * spatial)
+    if groups != 1:
+        raise ValueError("ConvTranspose: group > 1 not supported")
+    if node.attr("auto_pad", "NOTSET") not in ("NOTSET", "VALID"):
+        raise ValueError("ConvTranspose: auto_pad SAME_* not supported "
+                         "(export with explicit pads)")
+    if node.attr("output_shape") is not None:
+        raise ValueError("ConvTranspose: output_shape attribute not supported "
+                         "(use pads/output_padding)")
+    # onnx W is (Cin, Cout/groups, *k); gradient-style transposed conv:
+    # lhs_dilation = strides, effective padding = k - 1 - pad
+    k = w.shape[2:]
+    half = len(pads) // 2
+    padding = []
+    for i in range(spatial):
+        eff = (k[i] - 1) * dil[i]
+        padding.append((eff - pads[i], eff - pads[i + half] + out_pad[i]))
+    wt = jnp.swapaxes(w, 0, 1)                     # (Cout, Cin, *k)
+    wt = jnp.flip(wt, axis=tuple(range(2, wt.ndim)))
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, wt.shape,
+        ("NCHW", "OIHW", "NCHW") if spatial == 2 else
+        ("NCW", "OIW", "NCW") if spatial == 1 else
+        ("NCDHW", "OIDHW", "NCDHW"))
+    out = jax.lax.conv_general_dilated(
+        x, wt, window_strides=[1] * spatial, padding=padding,
+        lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+        preferred_element_type=jnp.float32)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * spatial)
+    return out

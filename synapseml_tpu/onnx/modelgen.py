@@ -208,3 +208,52 @@ def make_transformer_encoder(num_layers: int = 2, d_model: int = 64,
                   outputs=[_vi("logits", ["N", num_classes])],
                   name="tiny_transformer_encoder")
     return Model(graph=graph, opset=13)
+
+
+def make_unet(base: int = 8, depth: int = 3, image_size: int = 32,
+              in_ch: int = 3, out_ch: int = 1, seed: int = 2) -> Model:
+    """Genuine UNet encoder-decoder (Conv + GroupNorm + skip Concats,
+    ConvTranspose upsampling, Sigmoid head) — exercises the extended op set
+    the way segmentation/diffusion exports do."""
+    g = _G(seed)
+
+    def block(x, cin, cout):
+        x = g.conv(x, cin, cout, 3)
+        gs = g.const(np.ones(cout, np.float32))
+        gb = g.const(np.zeros(cout, np.float32))
+        x = g.add("GroupNormalization", [x, gs, gb],
+                  {"num_groups": max(1, cout // 4), "epsilon": 1e-5})
+        return g.add("HardSwish", [x])
+
+    x = "image"
+    skips = []
+    ch = in_ch
+    # encoder
+    for d in range(depth):
+        cout = base * (2 ** d)
+        x = block(x, ch, cout)
+        skips.append((x, cout))
+        x = g.add("MaxPool", [x], {"kernel_shape": [2, 2],
+                                   "strides": [2, 2]})
+        ch = cout
+    # bottleneck
+    x = block(x, ch, ch * 2)
+    ch = ch * 2
+    # decoder
+    for d in reversed(range(depth)):
+        cskip = base * (2 ** d)
+        wt = g.weight((ch, cskip, 2, 2))
+        x = g.add("ConvTranspose", [x, wt],
+                  {"strides": [2, 2], "kernel_shape": [2, 2]})
+        skip, _ = skips[d]
+        x = g.add("Concat", [x, skip], {"axis": 1})
+        x = block(x, cskip * 2, cskip)
+        ch = cskip
+    w_head = g.weight((out_ch, ch, 1, 1))
+    x = g.add("Conv", [x, w_head], {"kernel_shape": [1, 1]})
+    g.add("Sigmoid", [x], out="mask")
+    graph = Graph(nodes=g.nodes, initializers=g.inits,
+                  inputs=[_vi("image", ["N", in_ch, image_size, image_size])],
+                  outputs=[_vi("mask", ["N", out_ch, image_size, image_size])],
+                  name="tiny_unet")
+    return Model(graph=graph, opset=21)

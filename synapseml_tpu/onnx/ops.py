@@ -702,6 +702,8 @@ def _reduce_l2(node, x, *rest):
 
 @op("ArgMin")
 def _argmin(node, x):
+    if node.attr("select_last_index", 0):
+        raise ValueError("ArgMin: select_last_index not supported")
     axis = node.attr("axis", 0)
     keep = bool(node.attr("keepdims", 1))
     out = _jnp().argmin(x, axis=axis)
@@ -734,14 +736,15 @@ def _onehot(node, indices, depth, values):
     raw = jnp.asarray(indices).astype(jnp.int32)
     idx = jnp.where(raw < 0, raw + d, raw)     # negatives wrap once (spec)
     in_range = (idx >= 0) & (idx < d)
-    oh = jax_nn_one_hot(jnp.where(in_range, idx, 0), d, axis)
+    oh = _one_hot_at_axis(jnp.where(in_range, idx, 0), d, axis)
     # out-of-range indices produce an all-off row (spec), not a wrapped hot
     oh = oh * jnp.expand_dims(in_range, axis if axis >= 0 else oh.ndim + axis
                               ).astype(oh.dtype)
-    return oh * (on - off) + off
+    # output dtype follows the values tensor (spec)
+    return (oh * (on - off) + off).astype(np.asarray(values).dtype)
 
 
-def jax_nn_one_hot(idx, depth, axis):
+def _one_hot_at_axis(idx, depth, axis):
     import jax
 
     oh = jax.nn.one_hot(idx, depth)                    # appended last axis

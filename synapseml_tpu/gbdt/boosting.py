@@ -252,6 +252,18 @@ class Booster:
 # Training
 # ---------------------------------------------------------------------------
 
+def _to_global(mesh, spec, local_np):
+    """Assemble a global row-sharded array from THIS process's row shard
+    (multi-host SPMD: every host feeds its slice — the reference instead
+    pushes partition rows into per-worker native datasets)."""
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh, spec)
+    local_np = np.asarray(local_np)
+    gshape = (local_np.shape[0] * jax.process_count(),) + local_np.shape[1:]
+    return jax.make_array_from_process_local_data(sh, local_np, gshape)
+
+
 def _densify(X):
     """scipy sparse -> dense float32 (predict/valid inputs accept CSR the same
     as training); pass-through for anything else."""
@@ -395,6 +407,8 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
     def body_for(args):
         (binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins, base_k,
          gidx, binned_v, yv_j, gidx_v) = args
+        if not jnp.issubdtype(key0.dtype, jax.dtypes.prng_key):
+            key0 = jax.random.wrap_key_data(key0)   # multi-process raw key
         if is_ranking:
             obj_l = lambdarank_objective(gidx, cfg.sigmoid,
                                          cfg.lambdarank_truncation_level)
@@ -559,13 +573,15 @@ def train_booster(
          else np.asarray(sample_weight, np.float32))
     rng = np.random.default_rng(cfg.seed)
 
-    if mapper is None:
+    multiproc = mesh is not None and jax.process_count() > 1
+    if mapper is None and not multiproc:
         # sampling + bin-boundary phase (reference: samplingParameters /
-        # columnStatistics spans in LightGBMPerformance.scala)
+        # columnStatistics spans in LightGBMPerformance.scala); the multiproc
+        # path instead samples across ALL processes below
         with measures.span("referenceDataset"):
             mapper = compute_bin_mapper(X, cfg.max_bin, cfg.bin_sample_count,
                                         categorical_features, cfg.seed)
-    if mapper.max_bin != cfg.max_bin:
+    if mapper is not None and mapper.max_bin != cfg.max_bin:
         # every mapper source (Dataset, explicit mapper=, warm start) funnels
         # through here: bin ids outside the grower's num_bins range would
         # silently drop from histograms, so a mismatch is an error
@@ -573,6 +589,59 @@ def train_booster(
             f"bin mapper has max_bin={mapper.max_bin} but config.max_bin="
             f"{cfg.max_bin}; rebuild the Dataset/mapper with the matching "
             "max_bin")
+
+    # Multi-PROCESS (multi-host) mode: X/y are THIS process's row shard of one
+    # global mesh; bin boundaries broadcast from process 0 so every host bins
+    # identically, and all row arrays are assembled into global sharded arrays
+    # (the reference's distributed mode instead rendezvouses a socket ring).
+    if multiproc:
+        unsupported = [name for name, v in [
+            ("fobj", fobj), ("callbacks", callbacks or None),
+            ("init_model", init_model), ("valid", valid),
+            ("init_score", init_score), ("group_sizes", group_sizes)]
+            if v is not None]
+        if unsupported or cfg.boosting_type == "dart" \
+                or cfg.tree_learner == "voting":
+            raise NotImplementedError(
+                "multi-process training currently supports the fused path "
+                f"only (gbdt/goss/rf, serial learner); got {unsupported or cfg}")
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([n_orig])))
+        if len(set(int(c) for c in counts.ravel())) != 1:
+            raise ValueError("every process must supply the same local row "
+                             f"count; got {counts.ravel().tolist()}")
+        if mapper is None:
+            # bin boundaries from a sample gathered across ALL processes (the
+            # reference samples across all partitions on the driver,
+            # LightGBMBase.getSampledRows); deterministic on the gathered
+            # union, so every process computes the identical mapper
+            per = max(1, min(n_orig,
+                             -(-cfg.bin_sample_count // jax.process_count())))
+            sub = np.random.default_rng(cfg.seed).choice(
+                n_orig, size=per, replace=False)
+            gathered = np.asarray(multihost_utils.process_allgather(
+                np.ascontiguousarray(X[np.sort(sub)])))
+            X_samp = gathered.reshape(-1, X.shape[1])
+            # NaN election over the FULL global matrix, not just the sample
+            local_nan = np.ascontiguousarray(np.isnan(X).any(axis=0)[None])
+            has_nan_g = np.asarray(multihost_utils.process_allgather(
+                local_nan)).reshape(-1, X.shape[1]).any(axis=0)
+            mapper = compute_bin_mapper(
+                X_samp, cfg.max_bin, cfg.bin_sample_count,
+                categorical_features, cfg.seed, has_nan=has_nan_g)
+        else:
+            bnd, nb_, cat_, hn_ = multihost_utils.broadcast_one_to_all(
+                (mapper.boundaries, np.asarray(mapper.num_bins),
+                 np.asarray(mapper.is_categorical),
+                 np.asarray(mapper.nan_mask)))
+            mapper = BinMapper(boundaries=np.asarray(bnd),
+                               num_bins=np.asarray(nb_),
+                               is_categorical=np.asarray(cat_),
+                               max_bin=mapper.max_bin,
+                               has_nan=np.asarray(hn_))
+
 
     # Multi-chip: pad rows to the data-axis size and shard. The padding rows get
     # in_bag = 0, so they contribute nothing to histograms or leaf stats; GSPMD
@@ -582,6 +651,14 @@ def train_booster(
     if mesh is not None:
         from ..parallel.mesh import DATA_AXIS as _DA
         ndata = mesh.shape[_DA]
+        if multiproc:
+            # local rows pad to the per-process shard multiple; every process
+            # must contribute equally-sized shards
+            nproc = jax.process_count()
+            if ndata % nproc:
+                raise ValueError(f"data axis ({ndata}) must divide evenly "
+                                 f"across {nproc} processes")
+            ndata = ndata // nproc
         rem = (-n_orig) % ndata
         if rem:
             X = np.concatenate([X, np.repeat(X[-1:], rem, axis=0)])
@@ -599,11 +676,17 @@ def train_booster(
         from ..parallel.mesh import DATA_AXIS as _DA
         row2 = NamedSharding(mesh, P(_DA, None))
         row1 = NamedSharding(mesh, P(_DA))
-        binned = jax.device_put(binned, row2)
+        if multiproc:
+            binned = _to_global(mesh, P(_DA, None), np.asarray(binned))
+            n = n * jax.process_count()       # n is GLOBAL from here on
+        else:
+            binned = jax.device_put(binned, row2)
 
     # objective
     k = cfg.num_class if cfg.objective in ("multiclass", "softmax", "multiclassova") else 1
-    gidx_arr = jnp.zeros(n, jnp.int32)     # lambdarank group index (else dummy)
+    # lambdarank group index; 1-length dummy otherwise (it would replicate at
+    # GLOBAL length onto every device in multi-process mode)
+    gidx_arr = (np.zeros(1, np.int32) if multiproc else jnp.zeros(1, jnp.int32))
     if cfg.objective == "lambdarank":
         if group_sizes is None:
             raise ValueError("lambdarank requires group_sizes")
@@ -628,21 +711,39 @@ def train_booster(
         raise ValueError("boosting_type='rf' requires bagging (bagging_freq > 0 and "
                          "bagging_fraction < 1) and/or feature_fraction < 1")
 
-    yj, wj = jnp.asarray(y), jnp.asarray(w)
-    valid_mask = jnp.asarray(valid_mask_np)
-    base = (np.atleast_1d(np.asarray(obj.init_score(yj, wj), np.float64))
-            if cfg.boost_from_average else np.zeros(max(k, 1)))
-    # the fixed margin every iteration starts from: base score + user init_score
-    init_margin = jnp.zeros((n, k)) + jnp.asarray(base[None, :k], jnp.float32)
-    if init_score is not None:
-        init_margin = init_margin + jnp.asarray(
-            np.asarray(init_score).reshape(n, -1), jnp.float32)
-    score = init_margin
-    if mesh is not None:
-        score = jax.device_put(score, row2)
-        yj = jax.device_put(yj, row1)
-        wj = jax.device_put(wj, row1)
-        valid_mask = jax.device_put(valid_mask, row1)
+    if multiproc:
+        from jax.sharding import PartitionSpec as P
+
+        yj = _to_global(mesh, P(_DA), y)
+        wj = _to_global(mesh, P(_DA), w)
+        valid_mask = _to_global(mesh, P(_DA), valid_mask_np)
+        if cfg.boost_from_average:
+            # base score from GLOBAL label stats: jit over the sharded labels
+            # inserts the cross-process reductions
+            base_g = jax.jit(obj.init_score,
+                             out_shardings=NamedSharding(mesh, P()))(yj, wj)
+            base = np.atleast_1d(np.asarray(jax.device_get(base_g), np.float64))
+        else:
+            base = np.zeros(max(k, 1))
+        local_margin = (np.zeros((len(y), k), np.float32)
+                        + base[None, :k].astype(np.float32))
+        score = _to_global(mesh, P(_DA, None), local_margin)
+    else:
+        yj, wj = jnp.asarray(y), jnp.asarray(w)
+        valid_mask = jnp.asarray(valid_mask_np)
+        base = (np.atleast_1d(np.asarray(obj.init_score(yj, wj), np.float64))
+                if cfg.boost_from_average else np.zeros(max(k, 1)))
+        # the fixed margin every iteration starts from: base score + init_score
+        init_margin = jnp.zeros((n, k)) + jnp.asarray(base[None, :k], jnp.float32)
+        if init_score is not None:
+            init_margin = init_margin + jnp.asarray(
+                np.asarray(init_score).reshape(n, -1), jnp.float32)
+        score = init_margin
+        if mesh is not None:
+            score = jax.device_put(score, row2)
+            yj = jax.device_put(yj, row1)
+            wj = jax.device_put(wj, row1)
+            valid_mask = jax.device_put(valid_mask, row1)
 
     trees: List[TreeArrays] = []
     tree_weights: List[float] = []
@@ -675,13 +776,14 @@ def train_booster(
                 tree_contribs.append((ti % prior_k, per_tree[:, ti].astype(np.float32)))
 
     grower_cfg = cfg.grower(has_categorical=bool(mapper.is_categorical.any()))
-    is_cat = jnp.asarray(mapper.is_categorical)
-    nan_bins = jnp.asarray(mapper.nan_bins, jnp.int32)
+    _wrap = np.asarray if multiproc else jnp.asarray
+    is_cat = _wrap(mapper.is_categorical)
+    nan_bins = _wrap(np.asarray(mapper.nan_bins, np.int32))
     mono = np.zeros(nfeat, np.int32)
     if cfg.monotone_constraints is not None:
         mc = np.asarray(cfg.monotone_constraints, np.int32)
         mono[: len(mc)] = mc
-    mono = jnp.asarray(mono)
+    mono = _wrap(mono)
 
     grow_fn = _make_grow_fn(grower_cfg, mesh)
 
@@ -716,7 +818,14 @@ def train_booster(
     gh_fn = fobj if fobj is not None else obj.grad_hess
     rf_mode, dart_mode, goss_mode = (cfg.boosting_type == "rf", cfg.boosting_type == "dart",
                                      cfg.boosting_type == "goss")
-    in_bag_cur = jnp.ones(n, jnp.float32)
+    if multiproc:
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.mesh import DATA_AXIS as _DA2
+
+        in_bag_cur = _to_global(
+            mesh, P(_DA2), np.ones(n // jax.process_count(), np.float32))
+    else:
+        in_bag_cur = jnp.ones(n, jnp.float32)
 
     # ------------------------------------------------------------------
     # Fused fast path: the WHOLE boosting loop is one lax.scan under one
@@ -735,6 +844,10 @@ def train_booster(
     # feature_fraction), all keyed off fold_in(seed, it) so both paths sample
     # identically
     key0 = jax.random.PRNGKey(cfg.seed)
+    if multiproc:
+        # raw key data (identical host value on every process -> replicated);
+        # run_scan re-wraps it into a typed key
+        key0 = np.asarray(jax.random.key_data(key0))
 
     def sample_rows(it, g, h, in_bag_cur):
         return _sample_rows_impl(cfg, n, key0, valid_mask, it, g, h,
@@ -748,7 +861,7 @@ def train_booster(
         nv = Xv.shape[0] if has_valid else 0
         run_scan = _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv,
                                      metric_name if has_valid else "", mesh)
-        base_k = jnp.asarray(base[:k], jnp.float32)
+        base_k = _wrap(np.asarray(base[:k], np.float32))
         if has_valid:
             yv_j = jnp.asarray(yv)
             if metric_name.startswith("ndcg"):
@@ -760,11 +873,14 @@ def train_booster(
                 gidx_v = jnp.zeros(nv, jnp.int32)
             bv_arg = binned_v
         else:
-            yv_j = jnp.zeros(1, jnp.float32)
-            gidx_v = jnp.zeros(1, jnp.int32)
-            bv_arg = jnp.zeros((1, nfeat), binned.dtype)
+            zeros = np.zeros if multiproc else jnp.zeros
+            yv_j = zeros(1, np.float32)
+            gidx_v = zeros(1, np.int32)
+            bv_arg = zeros((1, nfeat), binned.dtype)
 
-        score_v0 = score_v if has_valid else jnp.zeros((1, k))
+        score_v0 = (score_v if has_valid
+                    else (np.zeros((1, k), np.float32) if multiproc
+                          else jnp.zeros((1, k))))
 
         # With early stopping the scan runs in chunks with a host-side stop
         # check between them, so a run that converges at iteration 40 does

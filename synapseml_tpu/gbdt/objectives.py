@@ -95,12 +95,23 @@ def regression_objective() -> Objective:
                      lambda y, w: jnp.average(y, weights=w), lambda sc: sc)
 
 
+def _weighted_quantile(y, w, alpha):
+    """Smallest y with cumulative weight >= alpha * total — rows with w == 0
+    (bagged-out / mesh padding) are excluded exactly, which matters because
+    the mesh path pads labels with zeros before init_score sees them."""
+    order = jnp.argsort(y)
+    ys = y[order]
+    cw = jnp.cumsum(w[order])
+    idx = jnp.searchsorted(cw, alpha * cw[-1], side="left")
+    return ys[jnp.clip(idx, 0, y.shape[0] - 1)]
+
+
 def regression_l1_objective() -> Objective:
     def gh(score, y, w):
         return jnp.sign(score - y) * w, w  # LightGBM uses hessian=weight for L1
 
     def init(y, w):
-        return jnp.median(y)  # weighted median approximated by median
+        return _weighted_quantile(y, w, 0.5)
 
     return Objective("regression_l1", 1, gh, init, lambda sc: sc)
 
@@ -142,7 +153,7 @@ def quantile_objective(alpha: float = 0.5) -> Objective:
         return g * w, w
 
     def init(y, w):
-        return jnp.quantile(y, alpha)
+        return _weighted_quantile(y, w, alpha)
 
     return Objective("quantile", 1, gh, init, lambda sc: sc)
 
@@ -153,7 +164,7 @@ def mape_objective() -> Objective:
         return jnp.sign(score - y) * scale * w, scale * w
 
     def init(y, w):
-        return jnp.median(y)
+        return _weighted_quantile(y, w, 0.5)
 
     return Objective("mape", 1, gh, init, lambda sc: sc)
 

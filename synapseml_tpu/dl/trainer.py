@@ -146,6 +146,12 @@ class FlaxTrainer:
         if self.mesh is None:
             return jnp.asarray(arr)
         spec = P(DATA_AXIS, *([None] * (np.ndim(arr) - 1)))
+        if jax.process_count() > 1:
+            # multi-host: ``arr`` is THIS process's slice of the global batch
+            # (the Horovod per-worker shard analog); assemble the global array
+            from ..parallel.mesh import to_global_rows
+
+            return to_global_rows(self.mesh, spec, arr)
         return jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
 
     def _fsdp_sharding(self, x):
@@ -181,6 +187,25 @@ class FlaxTrainer:
         total_steps = steps_per_epoch * cfg.max_epochs
         mask = freeze_mask(self.params, cfg.freeze_regex)
         tx = _make_tx(cfg, total_steps, mask)
+        multiproc = self.mesh is not None and jax.process_count() > 1
+        if multiproc:
+            from jax.experimental import multihost_utils
+
+            counts = np.asarray(multihost_utils.process_allgather(
+                np.asarray([len(X)])))
+            if len(set(int(c) for c in counts.ravel())) != 1:
+                # unequal shards would desynchronize the per-step collectives
+                # and hang, not raise
+                raise ValueError("every process must supply the same local "
+                                 f"row count; got {counts.ravel().tolist()}")
+            if cfg.param_sharding == "fsdp":
+                raise NotImplementedError(
+                    "multi-process training supports param_sharding="
+                    "'replicated' (pure data parallel) for now")
+            # identical host-side params on every process: jit replicates them
+            # onto the global mesh (committed single-device arrays would clash)
+            self.params = jax.tree.map(np.asarray, self.params)
+            self.batch_stats = jax.tree.map(np.asarray, self.batch_stats)
         if cfg.param_sharding == "fsdp":
             if self.mesh is None:
                 raise ValueError("param_sharding='fsdp' requires a mesh")

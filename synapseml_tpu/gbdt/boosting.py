@@ -252,18 +252,6 @@ class Booster:
 # Training
 # ---------------------------------------------------------------------------
 
-def _to_global(mesh, spec, local_np):
-    """Assemble a global row-sharded array from THIS process's row shard
-    (multi-host SPMD: every host feeds its slice — the reference instead
-    pushes partition rows into per-worker native datasets)."""
-    from jax.sharding import NamedSharding
-
-    sh = NamedSharding(mesh, spec)
-    local_np = np.asarray(local_np)
-    gshape = (local_np.shape[0] * jax.process_count(),) + local_np.shape[1:]
-    return jax.make_array_from_process_local_data(sh, local_np, gshape)
-
-
 def _densify(X):
     """scipy sparse -> dense float32 (predict/valid inputs accept CSR the same
     as training); pass-through for anything else."""
@@ -636,6 +624,17 @@ def train_booster(
                 (mapper.boundaries, np.asarray(mapper.num_bins),
                  np.asarray(mapper.is_categorical),
                  np.asarray(mapper.nan_mask)))
+            # NaNs on ANY process must have a dedicated bin in the broadcast
+            # mapper — a local mapper that never saw them would silently route
+            # those NaNs into the last real-value bin
+            any_nan = np.asarray(multihost_utils.process_allgather(
+                np.ascontiguousarray(np.isnan(X).any(axis=0)[None]))
+                ).reshape(-1, X.shape[1]).any(axis=0)
+            if (any_nan & ~np.asarray(hn_)).any():
+                raise ValueError(
+                    "explicit mapper lacks NaN bins for features with missing "
+                    "values on some process; pass mapper=None so boundaries "
+                    "are sampled across all processes")
             mapper = BinMapper(boundaries=np.asarray(bnd),
                                num_bins=np.asarray(nb_),
                                is_categorical=np.asarray(cat_),
@@ -677,7 +676,8 @@ def train_booster(
         row2 = NamedSharding(mesh, P(_DA, None))
         row1 = NamedSharding(mesh, P(_DA))
         if multiproc:
-            binned = _to_global(mesh, P(_DA, None), np.asarray(binned))
+            from ..parallel.mesh import to_global_rows
+            binned = to_global_rows(mesh, P(_DA, None), np.asarray(binned))
             n = n * jax.process_count()       # n is GLOBAL from here on
         else:
             binned = jax.device_put(binned, row2)
@@ -714,9 +714,11 @@ def train_booster(
     if multiproc:
         from jax.sharding import PartitionSpec as P
 
-        yj = _to_global(mesh, P(_DA), y)
-        wj = _to_global(mesh, P(_DA), w)
-        valid_mask = _to_global(mesh, P(_DA), valid_mask_np)
+        from ..parallel.mesh import to_global_rows
+
+        yj = to_global_rows(mesh, P(_DA), y)
+        wj = to_global_rows(mesh, P(_DA), w)
+        valid_mask = to_global_rows(mesh, P(_DA), valid_mask_np)
         if cfg.boost_from_average:
             # base score from GLOBAL label stats: jit over the sharded labels
             # inserts the cross-process reductions
@@ -727,7 +729,7 @@ def train_booster(
             base = np.zeros(max(k, 1))
         local_margin = (np.zeros((len(y), k), np.float32)
                         + base[None, :k].astype(np.float32))
-        score = _to_global(mesh, P(_DA, None), local_margin)
+        score = to_global_rows(mesh, P(_DA, None), local_margin)
     else:
         yj, wj = jnp.asarray(y), jnp.asarray(w)
         valid_mask = jnp.asarray(valid_mask_np)
@@ -822,7 +824,9 @@ def train_booster(
         from jax.sharding import PartitionSpec as P
         from ..parallel.mesh import DATA_AXIS as _DA2
 
-        in_bag_cur = _to_global(
+        from ..parallel.mesh import to_global_rows as _tgr
+
+        in_bag_cur = _tgr(
             mesh, P(_DA2), np.ones(n // jax.process_count(), np.float32))
     else:
         in_bag_cur = jnp.ones(n, jnp.float32)

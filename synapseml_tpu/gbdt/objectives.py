@@ -96,14 +96,26 @@ def regression_objective() -> Objective:
 
 
 def _weighted_quantile(y, w, alpha):
-    """Smallest y with cumulative weight >= alpha * total — rows with w == 0
-    (bagged-out / mesh padding) are excluded exactly, which matters because
-    the mesh path pads labels with zeros before init_score sees them."""
-    order = jnp.argsort(y)
-    ys = y[order]
-    cw = jnp.cumsum(w[order])
-    idx = jnp.searchsorted(cw, alpha * cw[-1], side="left")
-    return ys[jnp.clip(idx, 0, y.shape[0] - 1)]
+    """Interpolating weighted quantile. Exactly matches ``jnp.quantile``'s
+    linear interpolation when weights are uniform, and rows with w == 0
+    (bagged-out / mesh padding) are excluded exactly — the mesh path pads
+    labels with zeros before init_score sees them. LightGBM's
+    WeightedPercentileFun interpolates the same way."""
+    pos = w > 0
+    m = jnp.maximum(pos.sum(), 1)
+    yy = jnp.where(pos, y, jnp.inf)          # zero-weight rows sort last
+    order = jnp.argsort(yy)
+    ys = yy[order]
+    ws = w[order]
+    before = jnp.cumsum(ws) - ws             # weight strictly before each row
+    total = jnp.sum(ws)
+    r = alpha * (total - total / m)          # uniform w: alpha * (n - 1)
+    j = jnp.clip(jnp.searchsorted(before, r, side="right") - 1,
+                 0, y.shape[0] - 1)
+    jn = jnp.clip(j + 1, 0, y.shape[0] - 1)
+    frac = jnp.clip((r - before[j]) / jnp.maximum(ws[j], 1e-38), 0.0, 1.0)
+    nxt = jnp.where(frac > 0, ys[jn], ys[j])  # never touch the inf tail
+    return ys[j] + frac * (nxt - ys[j])
 
 
 def regression_l1_objective() -> Objective:

@@ -77,6 +77,17 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def to_global_rows(mesh: Mesh, spec, local_np):
+    """Assemble a global row-sharded array from THIS process's equal row
+    shard (multi-host SPMD ingestion: every host feeds its slice)."""
+    import jax as _jax
+
+    local_np = np.asarray(local_np)
+    gshape = (local_np.shape[0] * _jax.process_count(),) + local_np.shape[1:]
+    return _jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local_np, gshape)
+
+
 def shard_rows(mesh: Mesh, *arrays):
     """Place host arrays onto the mesh with rows split over ``data``. Pads rows
     to a multiple of the data-axis size (padding repeats the last row; callers

@@ -50,24 +50,40 @@ print("PSUM_OK", flush=True)
 """
 
 
-@pytest.mark.timeout(300)
-def test_two_process_bootstrap_and_collective(tmp_path):
+
+
+def _free_port():
     import socket
 
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    script = _WORKER % {"repo": REPO, "port": port}
-    f = tmp_path / "worker.py"
-    f.write_text(script)
-    procs = [subprocess.Popen([sys.executable, str(f), str(i)],
+        return s.getsockname()[1]
+
+
+def _spawn_workers(script_path, n=2, timeout=240):
+    """Run n worker processes; ALWAYS reap them (kill on timeout) so a hung
+    jax.distributed bootstrap can't leak processes into the rest of the run."""
+    procs = [subprocess.Popen([sys.executable, str(script_path), str(i)],
                               stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                               text=True)
-             for i in range(2)]
+             for i in range(n)]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return procs, outs
+
+
+def test_two_process_bootstrap_and_collective(tmp_path):
+    f = tmp_path / "worker.py"
+    f.write_text(_WORKER % {"repo": REPO, "port": _free_port()})
+    procs, outs = _spawn_workers(f)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert "PSUM_OK" in out, out[-2000:]
@@ -113,20 +129,10 @@ print("TRAIN_OK", flush=True)
 """
 
 
-@pytest.mark.timeout(300)
 def test_two_process_gbdt_training(tmp_path):
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     f = tmp_path / "train_worker.py"
-    f.write_text(_TRAIN_WORKER % {"repo": REPO, "port": port})
-    procs = [subprocess.Popen([sys.executable, str(f), str(i)],
-                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                              text=True)
-             for i in range(2)]
-    outs = [p.communicate(timeout=280)[0] for p in procs]
+    f.write_text(_TRAIN_WORKER % {"repo": REPO, "port": _free_port()})
+    procs, outs = _spawn_workers(f, timeout=280)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert "TRAIN_OK" in out, out[-3000:]
@@ -157,3 +163,48 @@ def test_two_process_gbdt_training(tmp_path):
     # so binning (and therefore the trees) match the local fit exactly
     np.testing.assert_allclose(np.asarray(got), local.predict(X_full[:16]),
                                atol=1e-5)
+
+
+_DL_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+import numpy as np
+
+from synapseml_tpu.parallel import make_mesh
+from synapseml_tpu.parallel.mesh import initialize_distributed
+
+pid = int(sys.argv[1])
+initialize_distributed(coordinator_address="127.0.0.1:%(port)d",
+                       num_processes=2, process_id=pid)
+
+from synapseml_tpu.dl import FlaxTrainer, TrainConfig, make_backbone
+
+rng = np.random.default_rng(0)
+X_full = rng.uniform(size=(64, 8, 8, 3)).astype(np.float32)
+y_full = rng.integers(0, 2, size=64).astype(np.float32)
+lo, hi = (0, 32) if pid == 0 else (32, 64)
+
+mesh = make_mesh({"data": 4}, devices=jax.devices())
+cfg = TrainConfig(batch_size=8, max_epochs=2, seed=0)   # LOCAL batch of 8
+tr = FlaxTrainer(make_backbone("tiny", 2), cfg, mesh=mesh)
+tr.fit(X_full[lo:hi], y_full[lo:hi])
+logits = np.asarray(tr.predict_logits(X_full[:8]))
+print("LOGITS", " ".join(f"{v:.6f}" for v in logits.ravel()), flush=True)
+print("DL_OK", flush=True)
+"""
+
+
+def test_two_process_dl_training(tmp_path):
+    f = tmp_path / "dl_worker.py"
+    f.write_text(_DL_WORKER % {"repo": REPO, "port": _free_port()})
+    procs, outs = _spawn_workers(f, timeout=280)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert "DL_OK" in out, out[-3000:]
+    # gradients were psum'd across processes -> identical trained weights
+    l0 = [l for l in outs[0].splitlines() if l.startswith("LOGITS")]
+    l1 = [l for l in outs[1].splitlines() if l.startswith("LOGITS")]
+    assert l0 == l1 and l0, (l0, l1)

@@ -228,18 +228,22 @@ pid = int(sys.argv[1])
 initialize_distributed(coordinator_address="127.0.0.1:%(port)d",
                        num_processes=2, process_id=pid)
 
-# dp=2 x sp=2 mesh across 2 processes: the SEQUENCE ring's ppermute hops
-# cross the process boundary (the DCN analog of multi-host long context)
-mesh = make_mesh({"data": 2, "seq": 2}, devices=jax.devices())
+# SEQ-major mesh: the seq axis spans the two PROCESSES (device ids 0,1 =
+# process 0 form seq-row 0), so every ring ppermute / Ulysses all-to-all hop
+# crosses the process boundary — the DCN analog of multi-host long context.
+# The data axis stays intra-process.
+mesh = make_mesh({"seq": 2, "data": 2}, devices=jax.devices())
 rng = np.random.default_rng(0)
 B, S, H, D = 2, 32, 2, 8
 q, k, v = (rng.normal(size=(B, S, H, D)).astype(np.float32) for _ in range(3))
 
 def to_global(full):
-    # each process feeds its addressable portion: the batch row it owns
+    # each process feeds its addressable portion: ALL batch rows of the
+    # SEQUENCE half its seq-row owns
     sh = NamedSharding(mesh, P("data", "seq", None, None))
     return jax.make_array_from_process_local_data(
-        sh, np.ascontiguousarray(full[pid * (B // 2):(pid + 1) * (B // 2)]),
+        sh,
+        np.ascontiguousarray(full[:, pid * (S // 2):(pid + 1) * (S // 2)]),
         full.shape)
 
 qg, kg, vg = to_global(q), to_global(k), to_global(v)

@@ -189,15 +189,13 @@ class FlaxTrainer:
         tx = _make_tx(cfg, total_steps, mask)
         multiproc = self.mesh is not None and jax.process_count() > 1
         if multiproc:
-            from jax.experimental import multihost_utils
+            from ..parallel.mesh import (assert_equal_across_processes,
+                                         local_mesh_devices)
 
-            counts = np.asarray(multihost_utils.process_allgather(
-                np.asarray([len(X)])))
-            if len(set(int(c) for c in counts.ravel())) != 1:
-                # unequal shards would desynchronize the per-step collectives
-                # and hang, not raise
-                raise ValueError("every process must supply the same local "
-                                 f"row count; got {counts.ravel().tolist()}")
+            local_mesh_devices(self.mesh)   # mesh must span every process
+            # unequal shards would desynchronize per-step collectives and
+            # hang, not raise
+            assert_equal_across_processes((len(X),), "local row count")
             if cfg.param_sharding == "fsdp":
                 raise NotImplementedError(
                     "multi-process training supports param_sharding="

@@ -595,11 +595,12 @@ def train_booster(
                 f"only (gbdt/goss/rf, serial learner); got {unsupported or cfg}")
         from jax.experimental import multihost_utils
 
-        counts = np.asarray(multihost_utils.process_allgather(
-            np.asarray([n_orig])))
-        if len(set(int(c) for c in counts.ravel())) != 1:
-            raise ValueError("every process must supply the same local row "
-                             f"count; got {counts.ravel().tolist()}")
+        from ..parallel.mesh import (assert_equal_across_processes,
+                                     local_mesh_devices)
+
+        local_mesh_devices(mesh)        # mesh must span every process evenly
+        assert_equal_across_processes((n_orig, nfeat),
+                                      "local row count / feature count")
         if mapper is None:
             # bin boundaries from a sample gathered across ALL processes (the
             # reference samples across all partitions on the driver,

@@ -77,6 +77,38 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def assert_equal_across_processes(values, what="local shape"):
+    """Raise (rather than hang a collective) when per-process inputs differ.
+    ``values``: ints that must match on every process."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    arr = np.ascontiguousarray(np.asarray(list(values), np.int64)[None])
+    g = np.asarray(multihost_utils.process_allgather(arr)).reshape(
+        jax.process_count(), -1)
+    if not (g == g[0]).all():
+        raise ValueError(
+            f"every process must supply the same {what}; got {g.tolist()}")
+
+
+def local_mesh_devices(mesh: Mesh) -> int:
+    """Devices-per-process for a multi-process mesh; validates the mesh spans
+    every process's devices evenly (anything else would mis-shape the
+    process-local shards with an opaque placement error)."""
+    nproc = jax.process_count()
+    ndev = mesh.devices.size
+    if ndev % nproc:
+        raise ValueError(f"mesh has {ndev} devices across {nproc} processes; "
+                         "device count must divide evenly")
+    procs = {d.process_index for d in mesh.devices.ravel()}
+    if nproc > 1 and len(procs) != nproc:
+        raise ValueError(f"mesh spans processes {sorted(procs)} but "
+                         f"{nproc} processes are running; every process must "
+                         "contribute devices")
+    return ndev // nproc
+
+
 def to_global_rows(mesh: Mesh, spec, local_np):
     """Assemble a global row-sharded array from THIS process's equal row
     shard (multi-host SPMD ingestion: every host feeds its slice)."""

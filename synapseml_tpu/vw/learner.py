@@ -274,13 +274,25 @@ def train_vw(idx: np.ndarray, val: np.ndarray, y: np.ndarray,
             if collect_progressive and p == 0:
                 progressive = np.asarray(preds).reshape(-1)[:n]
     else:
-        ndev = mesh.devices.size
+        from ..parallel.mesh import (assert_equal_across_processes,
+                                     local_mesh_devices)
+
+        multiproc = jax.process_count() > 1
+        local_dev = local_mesh_devices(mesh)
+        if multiproc:
+            # feature width is data-derived (parse_lines pads to the local
+            # max), so it must match too or shard_map programs desynchronize
+            assert_equal_across_processes(
+                (n, idx.shape[1]), "local row count / padded feature width")
+            # identical host-side state on every process -> jit replicates it
+            state = jax.tree.map(np.asarray, state)
         # equal local row counts per device, then equal local batch counts
-        per = -(-n // ndev)
+        # (multiproc: n and the padding are per-PROCESS over its local devices)
+        per = -(-n // local_dev)
         per = -(-per // cfg.batch_size) * cfg.batch_size
 
         def shard_pad(a, fill=0):
-            pad = per * ndev - a.shape[0]
+            pad = per * local_dev - a.shape[0]
             width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
             return np.pad(a, width, constant_values=fill) if pad else a
 
@@ -290,13 +302,23 @@ def train_vw(idx: np.ndarray, val: np.ndarray, y: np.ndarray,
         sw_s = shard_pad(sw)
         nb_local = per // cfg.batch_size
         p_dim = idx.shape[1]
-        batches = (idx_s.reshape(ndev * nb_local, cfg.batch_size, p_dim),
-                   val_s.reshape(ndev * nb_local, cfg.batch_size, p_dim),
-                   y_s.reshape(ndev * nb_local, cfg.batch_size),
-                   sw_s.reshape(ndev * nb_local, cfg.batch_size))
+        batches = (idx_s.reshape(local_dev * nb_local, cfg.batch_size, p_dim),
+                   val_s.reshape(local_dev * nb_local, cfg.batch_size, p_dim),
+                   y_s.reshape(local_dev * nb_local, cfg.batch_size),
+                   sw_s.reshape(local_dev * nb_local, cfg.batch_size))
+        if multiproc:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import to_global_rows
+
+            batches = tuple(
+                to_global_rows(mesh, P(DATA_AXIS, *([None] * (b.ndim - 1))), b)
+                for b in batches)
+        else:
+            batches = jax.tree.map(jnp.asarray, batches)
         run = _run_pass_sharded(mesh, cfg)
         for _ in range(cfg.num_passes):
-            state = run(state, jax.tree.map(jnp.asarray, batches))
+            state = run(state, batches)
     return state, progressive
 
 

@@ -269,3 +269,56 @@ def test_two_process_sequence_parallel(tmp_path):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         for tag in ("RING_OK", "ULYSSES_OK", "SP_OK"):
             assert tag in out, out[-3000:]
+
+
+_VW_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+import numpy as np
+
+from synapseml_tpu.parallel import make_mesh
+from synapseml_tpu.parallel.mesh import initialize_distributed
+
+pid = int(sys.argv[1])
+initialize_distributed(coordinator_address="127.0.0.1:%(port)d",
+                       num_processes=2, process_id=pid)
+
+from synapseml_tpu.vw.learner import VWConfig, train_vw, vw_predict
+
+rng = np.random.default_rng(0)
+n, p, bits = 512, 4, 12
+idx_full = rng.integers(0, 2 ** bits, size=(n, p)).astype(np.int32)
+val_full = np.ones((n, p), np.float32)
+wtrue = rng.normal(size=2 ** bits).astype(np.float32)
+y_full = np.asarray([wtrue[r].sum() for r in idx_full], np.float32)
+
+lo, hi = (0, 256) if pid == 0 else (256, 512)
+mesh = make_mesh({"data": 4}, devices=jax.devices())
+cfg = VWConfig(num_bits=bits, num_passes=10, batch_size=32, sync_splits=2,
+               learning_rate=0.5)
+state, _ = train_vw(idx_full[lo:hi], val_full[lo:hi], y_full[lo:hi], cfg,
+                    mesh=mesh)
+w = np.asarray(jax.device_get(state.weights))
+print("WNORM %%.6f" %% float(np.linalg.norm(w)), flush=True)
+state_host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), state)
+pred = vw_predict(state_host, idx_full[:16], val_full[:16])
+err = float(np.mean((pred - y_full[:16]) ** 2) / np.mean(y_full[:16] ** 2))
+print("RELERR %%.4f" %% err, flush=True)
+assert err < 0.15, err
+print("VW_OK", flush=True)
+"""
+
+
+def test_two_process_vw_training(tmp_path):
+    f = tmp_path / "vw_worker.py"
+    f.write_text(_VW_WORKER % {"repo": REPO, "port": _free_port()})
+    procs, outs = _spawn_workers(f, timeout=280)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert "VW_OK" in out, out[-3000:]
+    w0 = [l for l in outs[0].splitlines() if l.startswith("WNORM")]
+    w1 = [l for l in outs[1].splitlines() if l.startswith("WNORM")]
+    assert w0 == w1 and w0, (w0, w1)   # pmean-averaged weights identical

@@ -31,6 +31,8 @@ import time
 
 import numpy as np
 
+
+
 BASELINE_GBDT_ROW_ITERS = 4.0e6
 BASELINE_RESNET_IMGS_SEC = 400.0
 BASELINE_ONNX_IMGS_SEC = 1000.0
@@ -349,8 +351,13 @@ def _init_device_with_watchdog(timeout_s: float):
 
 def main():
     run_all = "--all" in sys.argv or os.environ.get("BENCH_ALL") == "1"
+    # watchdog FIRST: the initial jax import/device init is exactly what
+    # hangs when the TPU terminal is down
     _init_device_with_watchdog(float(os.environ.get("BENCH_INIT_TIMEOUT_S",
                                                     900)))
+    from synapseml_tpu.core.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     primary = bench_gbdt()
     extras = []
     budget_s = 1e9 if run_all else float(os.environ.get("BENCH_BUDGET_S", 900))

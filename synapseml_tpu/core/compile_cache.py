@@ -1,0 +1,25 @@
+"""Persistent XLA executable cache (jax compilation cache) enablement.
+
+One shared entry point for bench.py and the test harness: this jax build
+ignores the JAX_COMPILATION_CACHE_DIR env var, so the config API is used.
+Large compiles (the fused training scan is ~40s through a remote-compile
+tunnel) are paid once per configuration, not once per process.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(cache_dir: str | None = None,
+                         min_compile_secs: float = 1.0) -> str:
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+    return cache_dir

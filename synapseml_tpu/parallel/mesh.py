@@ -101,11 +101,16 @@ def local_mesh_devices(mesh: Mesh) -> int:
     if ndev % nproc:
         raise ValueError(f"mesh has {ndev} devices across {nproc} processes; "
                          "device count must divide evenly")
-    procs = {d.process_index for d in mesh.devices.ravel()}
-    if nproc > 1 and len(procs) != nproc:
-        raise ValueError(f"mesh spans processes {sorted(procs)} but "
-                         f"{nproc} processes are running; every process must "
-                         "contribute devices")
+    if nproc > 1:
+        from collections import Counter
+
+        per_proc = Counter(d.process_index for d in mesh.devices.ravel())
+        want = ndev // nproc
+        bad = {p: c for p, c in per_proc.items() if c != want}
+        if len(per_proc) != nproc or bad:
+            raise ValueError(
+                f"mesh must take exactly {want} devices from each of the "
+                f"{nproc} processes; got per-process counts {dict(per_proc)}")
     return ndev // nproc
 
 

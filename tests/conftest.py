@@ -19,6 +19,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from synapseml_tpu.core.compile_cache import enable_compile_cache  # noqa: E402
+
+# persistent executable cache: repeat suite runs skip XLA recompiles
+enable_compile_cache()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

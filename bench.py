@@ -24,6 +24,7 @@ actually runs on):
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -218,17 +219,19 @@ def bench_onnx_bert(batch=32, seq=128, warmup=2, steps=8):
             "vs_baseline": round(v / BASELINE_ONNX_BERT_SEQ_SEC, 3)}
 
 
-def bench_onnx_inference(batch=64, image=224, warmup=2, steps=8):
+def bench_onnx_inference(batch=64, image=224, warmup=2, steps=8,
+                         precision="float32"):
     """ONNX ResNet-50 batch inference imgs/sec/chip through the importer
     (ONNXModel.scala:145-423 workload; model generated by onnx/modelgen —
-    genuine ResNet-50 graph, 175 nodes)."""
+    genuine ResNet-50 graph, 175 nodes). ``precision='bfloat16'`` runs the
+    TPU mixed-precision path (floatPrecision param on ONNXModel)."""
     import jax
 
     from synapseml_tpu.onnx.importer import OnnxFunction
     from synapseml_tpu.onnx.modelgen import make_resnet
 
     m = make_resnet(50, num_classes=1000, image_size=image)
-    fn = OnnxFunction(m)
+    fn = OnnxFunction(m, precision=precision)
     jfn = jax.jit(fn.as_jax(["data"])[0])
     # device-resident input: the metric is inference compute, not host->device
     # transfer (38 MB/step through the axon tunnel would dominate otherwise —
@@ -244,7 +247,8 @@ def bench_onnx_inference(batch=64, image=224, warmup=2, steps=8):
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     v = batch * steps / dt
-    return {"metric": "onnx_resnet50_inference_imgs_per_sec_per_chip",
+    tag = "_bf16" if precision == "bfloat16" else ""
+    return {"metric": f"onnx_resnet50_inference{tag}_imgs_per_sec_per_chip",
             "value": round(v, 1), "unit": "imgs/sec/chip",
             "vs_baseline": round(v / BASELINE_ONNX_IMGS_SEC, 3)}
 
@@ -362,8 +366,12 @@ def main():
     extras = []
     budget_s = 1e9 if run_all else float(os.environ.get("BENCH_BUDGET_S", 900))
     t_start = time.perf_counter()
+    bench_onnx_bf16 = functools.partial(bench_onnx_inference,
+                                        precision="bfloat16")
+    bench_onnx_bf16.__name__ = "bench_onnx_inference_bf16"
     for fn in (bench_resnet50_train, bench_bert_finetune,
-               bench_onnx_inference, bench_onnx_bert, bench_serving):
+               bench_onnx_inference, bench_onnx_bf16, bench_onnx_bert,
+               bench_serving):
         if time.perf_counter() - t_start > budget_s:
             break
         try:

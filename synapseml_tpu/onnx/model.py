@@ -39,6 +39,10 @@ class ONNXModel(Transformer):
                        "TPU via jax here)", str)
     optimizationLevel = Param("optimizationLevel", "kept for API parity; XLA "
                               "always optimizes", str, "ALL_OPT")
+    floatPrecision = Param("floatPrecision", "float32 | bfloat16 — bfloat16 "
+                           "runs matmuls/convs as bf16 MXU operands with f32 "
+                           "accumulation (TPU mixed-precision inference)",
+                           str, "float32")
 
     # class-level defaults so instances materialized by save/load or copy
     # (which bypass __init__) still lazy-init their caches
@@ -73,6 +77,12 @@ class ONNXModel(Transformer):
 
     # --- introspection ---------------------------------------------------
     def _onnx_fn(self) -> OnnxFunction:
+        # rebuild when floatPrecision changed through ANY setter route (the
+        # cached function bakes the precision into its weights)
+        if (self._fn_cache is not None
+                and self._fn_cache.precision != self.getFloatPrecision()):
+            self._fn_cache = None
+            self._jit_cache = None
         if self._fn_cache is None:
             payload = self.get("modelPayload")
             if payload is None:
@@ -80,7 +90,8 @@ class ONNXModel(Transformer):
             model = fold_constants(ProtoModel.parse(bytes(payload)))
             fetch = self.get("fetchDict") or {}
             outputs = sorted(fetch.values()) if fetch else None
-            self._fn_cache = OnnxFunction(model, outputs)
+            self._fn_cache = OnnxFunction(model, outputs,
+                                          precision=self.getFloatPrecision())
         return self._fn_cache
 
     def modelInput(self) -> Dict[str, dict]:

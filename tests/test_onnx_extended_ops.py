@@ -235,3 +235,71 @@ def test_unet_end_to_end():
     out2 = np.asarray(OnnxFunction(Model.parse(make_unet().encode()))
                       .as_jax(["image"])[0](x)[0])
     np.testing.assert_allclose(out, out2, rtol=1e-6)
+
+
+def test_bfloat16_precision_mode():
+    """precision='bfloat16' (TPU mixed-precision inference) must track the
+    f32 result closely on a real conv net and halve weight storage."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.onnx.modelgen import make_unet
+
+    m = Model.parse(make_unet().encode())
+    x = np.random.default_rng(1).normal(size=(2, 3, 32, 32)).astype(np.float32)
+    f32 = np.asarray(OnnxFunction(m).as_jax(["image"])[0](x)[0])
+    fn16 = OnnxFunction(m, precision="bfloat16")
+    assert any(getattr(v, "dtype", None) == jnp.bfloat16
+               for v in fn16._weights.values())
+    b16 = np.asarray(fn16.as_jax(["image"])[0](x)[0])
+    assert b16.dtype == np.float32            # outputs upcast back
+    # sigmoid-mask outputs: bf16 carries ~3 decimal digits
+    np.testing.assert_allclose(b16, f32, atol=0.03)
+    with pytest.raises(ValueError):
+        OnnxFunction(m, precision="float16")
+
+
+def test_onnxmodel_float_precision_param():
+    from synapseml_tpu.onnx.model import ONNXModel
+    from synapseml_tpu.onnx.modelgen import make_transformer_encoder
+
+    m = make_transformer_encoder(num_layers=1, d_model=32, num_heads=2,
+                                 seq_len=8, d_ff=64)
+    x = np.random.default_rng(2).normal(size=(4, 8, 32)).astype(np.float32)
+    from synapseml_tpu.core.table import Table
+
+    t = Table({"embeddings": list(x)})
+    base = ONNXModel(modelPayload=m.encode(),
+                     feedDict={"embeddings": "embeddings"},
+                     fetchDict={"out": "logits"})
+    got32 = np.stack(list(base.transform(t)["out"]))
+    b16 = ONNXModel(modelPayload=m.encode(),
+                    feedDict={"embeddings": "embeddings"},
+                    fetchDict={"out": "logits"},
+                    floatPrecision="bfloat16")
+    got16 = np.stack(list(b16.transform(t)["out"]))
+    np.testing.assert_allclose(got16, got32, atol=0.1, rtol=0.1)
+
+
+def test_float_precision_setter_rebuilds():
+    """Changing floatPrecision after a transform must rebuild the cached
+    function (the cache bakes precision into the weights)."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.onnx.model import ONNXModel
+    from synapseml_tpu.onnx.modelgen import make_transformer_encoder
+
+    m = make_transformer_encoder(num_layers=1, d_model=32, num_heads=2,
+                                 seq_len=8, d_ff=64)
+    x = np.random.default_rng(3).normal(size=(2, 8, 32)).astype(np.float32)
+    t = Table({"embeddings": list(x)})
+    mod = ONNXModel(modelPayload=m.encode(),
+                    feedDict={"embeddings": "embeddings"},
+                    fetchDict={"out": "logits"})
+    mod.transform(t)
+    assert mod._fn_cache.precision == "float32"
+    mod.set("floatPrecision", "bfloat16")
+    mod.transform(t)
+    assert mod._fn_cache.precision == "bfloat16"
+    assert any(getattr(v, "dtype", None) == jnp.bfloat16
+               for v in mod._fn_cache._weights.values())

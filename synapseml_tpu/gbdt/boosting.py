@@ -27,7 +27,8 @@ from jax import lax
 
 from ..ops.quantize import BinMapper, apply_bins, bin_threshold_to_value, compute_bin_mapper
 from .dataset import Dataset, _is_sparse
-from .grower import Forest, GrowerConfig, TreeArrays, forest_predict, grow_tree, stack_trees
+from .grower import (Forest, GrowerConfig, TreeArrays, forest_max_depth,
+                     forest_predict, grow_tree, stack_trees)
 from .objectives import (METRICS, HIGHER_IS_BETTER, Objective, get_objective,
                          lambdarank_objective, make_grouped, ndcg_at_k)
 
@@ -134,6 +135,7 @@ class Booster:
         # Loaded native models carry raw thresholds directly (no mapper).
         self.thresholds = thresholds
         self._forest_cache: Optional[Forest] = None
+        self._depth_cache: Optional[int] = None
 
     # --- structure ------------------------------------------------------
     @property
@@ -179,6 +181,7 @@ class Booster:
                         for t, w in zip(trees, weights)]
             self._forest_cache = stack_trees(
                 weighted, [self._thresholds(i) for i in range(len(trees))])
+            self._depth_cache = forest_max_depth(trees)
         return self._forest_cache
 
     # --- inference ------------------------------------------------------
@@ -186,8 +189,10 @@ class Booster:
         """(N,) or (N, K) raw margin."""
         X = _densify(X)
         nb = jnp.asarray(self.mapper.nan_bins) if binned else None
-        per_tree = forest_predict(self.forest(), jnp.asarray(X), binned=binned,
-                                  output="per_tree", nan_bins=nb)  # (N, T)
+        forest = self.forest()
+        per_tree = forest_predict(forest, jnp.asarray(X), binned=binned,
+                                  output="per_tree", nan_bins=nb,
+                                  depth=self._depth_cache)  # (N, T)
         k = self.models_per_iter
         n, t = per_tree.shape
         out = per_tree.reshape(n, t // k, k).sum(axis=1) + self.base_score[None, :k]
@@ -201,8 +206,10 @@ class Booster:
 
     def predict_leaf(self, X) -> np.ndarray:
         """(N, T) leaf indices (predictLeaf parity, LightGBMBooster.scala:408)."""
-        return np.asarray(forest_predict(self.forest(), jnp.asarray(_densify(X)),
-                                         output="leaf"))
+        forest = self.forest()
+        return np.asarray(forest_predict(forest, jnp.asarray(_densify(X)),
+                                         output="leaf",
+                                         depth=self._depth_cache))
 
     def feature_importances(self, importance_type: str = "split") -> np.ndarray:
         """split count or total gain per feature (getFeatureImportances parity,
@@ -773,8 +780,9 @@ def train_booster(
             unweighted = Booster(init_model.mapper, init_model.config,
                                  init_model.trees, [1.0] * len(init_model.trees),
                                  np.zeros_like(init_model.base_score))
-            per_tree = np.asarray(_fp(unweighted.forest(), jnp.asarray(X),
-                                      output="per_tree"))     # (N, T)
+            uf = unweighted.forest()
+            per_tree = np.asarray(_fp(uf, jnp.asarray(X), output="per_tree",
+                                      depth=unweighted._depth_cache))  # (N, T)
             for ti in range(per_tree.shape[1]):
                 tree_contribs.append((ti % prior_k, per_tree[:, ti].astype(np.float32)))
 
@@ -812,8 +820,9 @@ def train_booster(
             unw = Booster(init_model.mapper, init_model.config, init_model.trees,
                           [1.0] * len(init_model.trees),
                           np.zeros_like(init_model.base_score))
-            pt_v = forest_predict(unw.forest(), jnp.asarray(Xv),
-                                  output="per_tree")        # (Nv, T)
+            uf_v = unw.forest()
+            pt_v = forest_predict(uf_v, jnp.asarray(Xv), output="per_tree",
+                                  depth=unw._depth_cache)   # (Nv, T)
             pk = init_model.models_per_iter
             for ti in range(pt_v.shape[1]):
                 valid_contribs.append((ti % pk, pt_v[:, ti]))

@@ -847,17 +847,20 @@ def _descend(X, sf, thr, sbin, stype, dleft, bits, lc, rc, binned: bool,
     return ~node  # leaf index
 
 
-@partial(jax.jit, static_argnames=("binned", "output"))
+@partial(jax.jit, static_argnames=("binned", "output", "depth"))
 def forest_predict(forest: Forest, X: jnp.ndarray, binned: bool = False,
-                   output: str = "sum", nan_bins=None) -> jnp.ndarray:
+                   output: str = "sum", nan_bins=None,
+                   depth: Optional[int] = None) -> jnp.ndarray:
     """Sum of tree outputs (raw score) per row. ``output='leaf'`` returns the
     (N, T) leaf indices (predictLeaf parity — LightGBMBooster.scala:408-419);
     ``output='per_tree'`` returns (N, T) leaf values (for DART drop handling).
     ``nan_bins`` (F,) routes missing-bin values by each split's default_left
-    when traversing binned data."""
+    when traversing binned data. ``depth`` bounds the pointer-chase steps —
+    pass the forest's true max depth (see ``forest_max_depth``) to skip the
+    dead iterations of the worst-case ``num_leaves - 1`` walk."""
     X = jnp.asarray(X, jnp.float32 if not binned else X.dtype)
     L = forest.leaf_value.shape[1]
-    depth = max(L - 1, 1)
+    depth = max(depth if depth is not None else L - 1, 1)
 
     def one_tree(carry, t):
         sf, thr, sbin, stype, dl, bits, lc, rc, lv = t
@@ -876,6 +879,31 @@ def forest_predict(forest: Forest, X: jnp.ndarray, binned: bool = False,
     if output == "per_tree":
         return vals.T            # (N, T)
     return vals.sum(axis=0)      # (N,)
+
+
+def forest_max_depth(trees: list) -> int:
+    """Max internal-node depth across trees (host-side): the exact number of
+    pointer-chase steps any row needs. Children are created after their
+    parent, so a single forward pass suffices."""
+    maxd = 1
+    for t in trees:
+        ns = int(t.num_splits)
+        if ns <= 0:
+            continue
+        lc = np.asarray(t.left_child)[:ns]
+        rc = np.asarray(t.right_child)[:ns]
+        # BFS from the root: exact for ANY node ordering (loaded third-party
+        # model strings need not create children after parents)
+        depth = np.ones(ns, np.int64)
+        stack = [0]
+        while stack:
+            i = stack.pop()
+            for c in (lc[i], rc[i]):
+                if 0 <= c < ns:
+                    depth[c] = depth[i] + 1
+                    stack.append(int(c))
+        maxd = max(maxd, int(depth.max()))
+    return maxd
 
 
 def stack_trees(trees: list, thresholds: list) -> Forest:

@@ -396,3 +396,24 @@ def test_fused_cache_key_covers_stratified_bagging():
         train_booster(X, np.abs(X[:, 0]),
                       BoosterConfig(objective="regression", num_iterations=2,
                                     bagging_freq=1, pos_bagging_fraction=0.5))
+
+
+def test_depth_bounded_inference_matches_full_walk(binary_data):
+    """Predictions with the true-max-depth pointer chase must equal the
+    worst-case num_leaves-1 walk."""
+    from synapseml_tpu.gbdt.grower import forest_max_depth, forest_predict
+
+    Xtr, Xte, ytr, _ = binary_data
+    bst = train_booster(Xtr, ytr, BoosterConfig(objective="binary",
+                                                num_iterations=8))
+    d = forest_max_depth(bst.trees)
+    assert 1 <= d <= bst.config.num_leaves - 1
+    forest = bst.forest()
+    full = forest_predict(forest, jnp.asarray(Xte[:100]), output="sum")
+    fast = forest_predict(forest, jnp.asarray(Xte[:100]), output="sum",
+                          depth=d)
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(full), rtol=1e-6)
+    # the booster's own predict path uses the cached depth
+    assert bst._depth_cache == d
+    p = bst.predict(Xte[:50])
+    assert np.isfinite(p).all()

@@ -240,6 +240,13 @@ class Booster:
                              tweedie_variance_power=cfg.tweedie_variance_power)
 
     # --- persistence ----------------------------------------------------
+    def dump_model(self, num_iteration: int = -1) -> str:
+        """LightGBM-format JSON dump (dumpModel parity,
+        LightGBMBooster.scala:458-516)."""
+        from .model_io import booster_dump_json
+
+        return booster_dump_json(self, num_iteration)
+
     def model_string(self) -> str:
         from .model_io import booster_to_string
         return booster_to_string(self)

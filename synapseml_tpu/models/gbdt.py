@@ -218,6 +218,10 @@ class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
             with open(p) as fh:
                 self.booster = Booster.from_model_string(fh.read())
 
+    def dumpModel(self, num_iteration: int = -1) -> str:
+        """JSON model dump (LightGBMModelMethods/Booster dumpModel parity)."""
+        return self.booster.dump_model(num_iteration)
+
     def saveNativeModel(self, path: str, overwrite: bool = True) -> None:
         """LightGBMModelMethods.saveNativeModel parity."""
         import os

@@ -34,6 +34,26 @@ def _fmt(arr, fmt="{:g}") -> str:
     return " ".join(fmt.format(x) for x in arr)
 
 
+def _tree_dump_seq(booster, num_iteration: int = -1):
+    """Shared per-tree serialization inputs for the text and JSON dumps:
+    yields (index, tree, thresholds, weight, base_shift). LightGBM stores no
+    base score, so boost_from_average folds into the first tree of each class
+    (every tree when the output is averaged — the mean shifts by base)."""
+    k = booster.models_per_iter
+    trees = booster.trees
+    if num_iteration and num_iteration > 0:
+        trees = trees[: num_iteration * k]
+    for ti, tree in enumerate(trees):
+        if booster.average_output:
+            base_shift = float(booster.base_score[ti % k])
+        elif ti < k:
+            base_shift = float(booster.base_score[ti])
+        else:
+            base_shift = 0.0
+        yield ti, tree, booster._thresholds(ti), booster.tree_weights[ti], \
+            base_shift
+
+
 def booster_to_string(booster) -> str:
     cfg = booster.config
     mapper: BinMapper = booster.mapper
@@ -52,19 +72,10 @@ def booster_to_string(booster) -> str:
     ]
     lines = [l for l in lines if l != ""]
 
-    tree_blocks = []
-    for ti, tree in enumerate(booster.trees):
-        # LightGBM stores no base score: boost_from_average is folded into leaf
-        # values. Fold into the first tree of each class (every tree when the
-        # output is averaged, so the mean shifts by base).
-        base_shift = 0.0
-        if booster.average_output:
-            base_shift = float(booster.base_score[ti % k])
-        elif ti < k:
-            base_shift = float(booster.base_score[ti])
-        tree_blocks.append(_tree_to_string(ti, tree, booster._thresholds(ti),
-                                           booster.tree_weights[ti], cfg.learning_rate,
-                                           base_shift, mapper.nan_mask))
+    tree_blocks = [
+        _tree_to_string(ti, tree, thr, w, cfg.learning_rate, base_shift,
+                        mapper.nan_mask)
+        for ti, tree, thr, w, base_shift in _tree_dump_seq(booster)]
     sizes = [len(b) + 1 for b in tree_blocks]
     lines.append("tree_sizes=" + " ".join(str(s) for s in sizes))
     lines.append("")
@@ -404,24 +415,10 @@ def booster_dump_json(booster, num_iteration: int = -1) -> str:
     cfg = booster.config
     mapper = booster.mapper
     k = booster.models_per_iter
-    trees = booster.trees
-    if num_iteration and num_iteration > 0:
-        trees = trees[: num_iteration * k]
-    weights = list(booster.tree_weights)[: len(trees)]
     nan_mask = np.asarray(mapper.nan_mask) if mapper is not None else None
-    tree_info = []
-    for i, (t, w) in enumerate(zip(trees, weights)):
-        # base fold mirrors booster_to_string: first tree per class, or every
-        # tree when the output is averaged
-        if booster.average_output:
-            base_shift = float(booster.base_score[i % k])
-        elif i < k:
-            base_shift = float(booster.base_score[i])
-        else:
-            base_shift = 0.0
-        tree_info.append(_tree_to_json(i, t, booster._thresholds(i), w,
-                                       cfg.learning_rate, base_shift,
-                                       nan_mask))
+    tree_info = [
+        _tree_to_json(i, t, thr, w, cfg.learning_rate, base_shift, nan_mask)
+        for i, t, thr, w, base_shift in _tree_dump_seq(booster, num_iteration)]
     doc = {
         "name": "tree",
         "version": "v3",

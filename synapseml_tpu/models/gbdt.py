@@ -230,6 +230,23 @@ class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
             raise FileExistsError(path)
         self.booster.save_native(path)
 
+    def getBoosterBestIteration(self) -> int:
+        """Best iteration from early stopping (-1 without validation) —
+        LightGBMModelMethods.getBoosterBestIteration parity."""
+        return int(self.booster.best_iteration)
+
+    def getBoosterNumTotalIterations(self) -> int:
+        return self.booster.num_trees // self.booster.models_per_iter
+
+    def getBoosterNumTotalModel(self) -> int:
+        return self.booster.num_trees
+
+    def getBoosterNumFeatures(self) -> int:
+        return self.booster.mapper.num_features
+
+    def getBoosterNumClasses(self) -> int:
+        return self.booster.num_class
+
     def getNativeModel(self) -> str:
         return self.booster.model_string()
 

@@ -193,3 +193,18 @@ def test_rf_without_bagging_rejected(binary_data):
     Xtr, _, ytr, _ = binary_data
     with pytest.raises(ValueError, match="rf"):
         LightGBMClassifier(boostingType="rf", numIterations=5).fit(_as_table(Xtr, ytr))
+
+
+def test_booster_introspection_getters(binary_data):
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.models import LightGBMClassifier
+
+    Xtr, _, ytr, _ = binary_data
+    t = Table({"features": list(Xtr.astype(np.float32)), "label": ytr})
+    model = LightGBMClassifier(numIterations=5).fit(t)
+    assert model.getBoosterNumTotalIterations() == 5
+    assert model.getBoosterNumTotalModel() == 5
+    assert model.getBoosterNumFeatures() == Xtr.shape[1]
+    # native LightGBM reports num_class=1 for binary objectives
+    assert model.getBoosterNumClasses() == 1
+    assert model.getBoosterBestIteration() == -1

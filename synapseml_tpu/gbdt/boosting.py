@@ -185,8 +185,11 @@ class Booster:
         return self._forest_cache
 
     # --- inference ------------------------------------------------------
-    def raw_score(self, X, binned: bool = False) -> np.ndarray:
-        """(N,) or (N, K) raw margin."""
+    def raw_score(self, X, binned: bool = False,
+                  num_iteration: int = -1) -> np.ndarray:
+        """(N,) or (N, K) raw margin. ``num_iteration`` > 0 scores with only
+        the first ``num_iteration`` boosting rounds (LightGBM predict's
+        num_iteration / post-early-stopping scoring)."""
         X = _densify(X)
         nb = jnp.asarray(self.mapper.nan_bins) if binned else None
         forest = self.forest()
@@ -195,12 +198,20 @@ class Booster:
                                   depth=self._depth_cache)  # (N, T)
         k = self.models_per_iter
         n, t = per_tree.shape
-        out = per_tree.reshape(n, t // k, k).sum(axis=1) + self.base_score[None, :k]
+        per_iter = per_tree.reshape(n, t // k, k)
+        if num_iteration and num_iteration > 0:
+            per_iter = per_iter[:, :num_iteration]
+            if self.average_output:
+                # rf leaves were pre-divided by the FULL tree count; rescale
+                # so a prefix average stays an average
+                per_iter = per_iter * ((t // k) / min(num_iteration, t // k))
+        out = per_iter.sum(axis=1) + self.base_score[None, :k]
         return np.asarray(out[:, 0] if k == 1 else out)
 
-    def predict(self, X, binned: bool = False) -> np.ndarray:
+    def predict(self, X, binned: bool = False,
+                num_iteration: int = -1) -> np.ndarray:
         """Probability / response-space prediction."""
-        raw = self.raw_score(X, binned=binned)
+        raw = self.raw_score(X, binned=binned, num_iteration=num_iteration)
         obj = self._objective_for_transform()
         return np.asarray(obj.transform(jnp.asarray(raw)))
 

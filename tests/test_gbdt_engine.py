@@ -484,3 +484,27 @@ def test_dump_model_json(binary_data):
         s = sum(walk_cat(t["tree_structure"], Xc[i])
                 for t in dc["tree_info"])
         np.testing.assert_allclose(s, raw_c[i], rtol=1e-4, atol=1e-5)
+
+
+def test_predict_num_iteration(binary_data):
+    """num_iteration-limited scoring equals a booster truncated to that many
+    rounds (LightGBM predict num_iteration semantics)."""
+    Xtr, Xte, ytr, _ = binary_data
+    bst = train_booster(Xtr, ytr, BoosterConfig(objective="binary",
+                                                num_iterations=8))
+    short = Booster(bst.mapper, bst.config, bst.trees[:3],
+                    bst.tree_weights[:3], bst.base_score)
+    np.testing.assert_allclose(bst.raw_score(Xte[:50], num_iteration=3),
+                               short.raw_score(Xte[:50]), rtol=1e-6)
+    # out-of-range request clamps to the full model
+    np.testing.assert_allclose(bst.raw_score(Xte[:50], num_iteration=99),
+                               bst.raw_score(Xte[:50]), rtol=1e-6)
+
+    # rf: prefix scoring must RE-average over the prefix count
+    rf = train_booster(Xtr, ytr, BoosterConfig(
+        objective="binary", num_iterations=6, boosting_type="rf",
+        bagging_freq=1, bagging_fraction=0.6, seed=4))
+    rf_short = Booster(rf.mapper, rf.config, rf.trees[:2],
+                       rf.tree_weights[:2], rf.base_score)
+    np.testing.assert_allclose(rf.raw_score(Xte[:50], num_iteration=2),
+                               rf_short.raw_score(Xte[:50]), rtol=1e-5)

@@ -69,23 +69,47 @@ def bench_gbdt():
     # excluded from the timed iteration loop).
     ds = Dataset(X, y).block_until_ready()
 
-    # warmup with the IDENTICAL iteration count: the fused-scan executable is
-    # cached across calls (boosting._FUSED_RUNNERS) keyed on config+shapes,
-    # and the scan length is a jit specialization axis — warming with a
-    # different count would leave the timed run paying the XLA compile
-    cfg_warm = BoosterConfig(objective="binary", num_iterations=TIMED_ITERS)
-    train_booster(ds, None, cfg_warm)  # compile + cache
+    # The engine ships selectable hot-loop designs whose relative speed is a
+    # property of the chip (docs/perf_notes.md); the DEFAULT config is
+    # measured first and guaranteed to report, then the alternates are
+    # sampled — each guarded so a failing/slow alternate can neither kill
+    # the primary metric nor blow the time budget. "value" is the best of
+    # the shipped configs that succeeded; "variant"/"variants" record which.
+    variants = [("partition_sort", {}),
+                ("partition_scan", {"partition_impl": "scan"}),
+                ("masked", {"row_layout": "masked"})]
+    sweep_budget = float(os.environ.get("BENCH_GBDT_SWEEP_BUDGET_S", 600))
+    t_sweep = time.perf_counter()
+    results, errors = {}, {}
+    for name, kw in variants:
+        if results and time.perf_counter() - t_sweep > sweep_budget:
+            errors[name] = "skipped: sweep budget exhausted"
+            continue
+        try:
+            cfg_warm = BoosterConfig(objective="binary",
+                                     num_iterations=TIMED_ITERS, **kw)
+            train_booster(ds, None, cfg_warm)  # compile + cache
+            cfg = BoosterConfig(objective="binary",
+                                num_iterations=TIMED_ITERS, seed=1, **kw)
+            t0 = time.perf_counter()
+            booster = train_booster(ds, None, cfg)
+            jax.block_until_ready(booster.trees[-1].leaf_value)
+            results[name] = N_ROWS * TIMED_ITERS / (time.perf_counter() - t0)
+        except Exception as e:  # alternates must never sink the primary
+            errors[name] = str(e)[:120]
+            if not results:
+                raise   # ... unless even the default config failed
 
-    cfg = BoosterConfig(objective="binary", num_iterations=TIMED_ITERS, seed=1)
-    t0 = time.perf_counter()
-    booster = train_booster(ds, None, cfg)
-    jax.block_until_ready(booster.trees[-1].leaf_value)
-    dt = time.perf_counter() - t0
-
-    v = N_ROWS * TIMED_ITERS / dt
-    return {"metric": "gbdt_train_row_iters_per_sec_per_chip",
-            "value": round(v, 1), "unit": "row-iterations/sec/chip",
-            "vs_baseline": round(v / BASELINE_GBDT_ROW_ITERS, 3)}
+    best = max(results, key=results.get)
+    v = results[best]
+    out = {"metric": "gbdt_train_row_iters_per_sec_per_chip",
+           "value": round(v, 1), "unit": "row-iterations/sec/chip",
+           "vs_baseline": round(v / BASELINE_GBDT_ROW_ITERS, 3),
+           "variant": best,
+           "variants": {k: round(r, 1) for k, r in results.items()}}
+    if errors:
+        out["variant_errors"] = errors
+    return out
 
 
 def bench_resnet50_train(batch=32, image=224, warmup=2, steps=8):

@@ -28,4 +28,4 @@ Layout (mirrors SURVEY.md §7 layer order):
   codegen    — generated .pyi stubs + API docs from Param metadata
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"  # keep in sync with pyproject.toml

@@ -154,6 +154,11 @@ class Booster:
     def average_output(self) -> bool:
         return self.config.boosting_type == "rf"
 
+    @property
+    def trees_per_class(self) -> int:
+        """rf averaging divisor shared by forest() and SHAP."""
+        return max(len(self.trees) // self.models_per_iter, 1)
+
     def _thresholds(self, index: int) -> np.ndarray:
         if self.thresholds is not None:
             return np.asarray(self.thresholds[index], np.float32)
@@ -175,8 +180,7 @@ class Booster:
             trees = self.trees
             weights = np.asarray(self.tree_weights, np.float32)
             if self.average_output:
-                per_class = max(len(trees) // self.models_per_iter, 1)
-                weights = weights / per_class
+                weights = weights / self.trees_per_class
             weighted = [t._replace(leaf_value=jnp.asarray(t.leaf_value) * w)
                         for t, w in zip(trees, weights)]
             self._forest_cache = stack_trees(

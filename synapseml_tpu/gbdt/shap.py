@@ -5,8 +5,9 @@ LGBM_BoosterPredictForMat, booster/LightGBMBooster.scala:424-432 and
 LightGBMModelMethods.scala getFeatureShaps). Implements the polynomial-time
 TreeSHAP recursion (Lundberg & Lee, "Consistent Individualized Feature
 Attribution for Tree Ensembles") host-side in numpy; trees are small so the
-recursion cost is negligible next to device work. Returns (N, F+1): per-feature
-contributions plus the expected value in the last column — LightGBM's
+recursion cost is negligible next to device work. Returns (N, F+1) —
+per-feature contributions plus the expected value in the last column — or
+(N, K*(F+1)) per-class blocks for multiclass: LightGBM's
 predict(pred_contrib=True) layout.
 """
 
@@ -117,22 +118,25 @@ def _shap_recurse(tree, x, phi, node, depth, path: _Path, pz, po, pi):
 
 
 def forest_shap(booster, X: np.ndarray) -> np.ndarray:
+    """(N, F+1) contributions, or (N, K*(F+1)) for multiclass — per-class
+    blocks of [per-feature..., expected_value], LightGBM's
+    predict(pred_contrib=True) layout."""
     n, nfeat = X.shape
-    out = np.zeros((n, nfeat + 1), np.float64)
-    if booster.models_per_iter > 1:
-        raise NotImplementedError("multiclass SHAP: compute per class via booster slices")
-    out[:, -1] += booster.base_score[0]
+    k = booster.models_per_iter
+    out = np.zeros((n, k, nfeat + 1), np.float64)
+    out[:, :, -1] += booster.base_score[None, :k]
 
     weights = np.asarray(booster.tree_weights, np.float64)
     if booster.average_output:
-        weights = weights / max(len(booster.trees), 1)
+        weights = weights / booster.trees_per_class
 
     for ti, t in enumerate(booster.trees):
+        cls = ti % k
         ns = int(t.num_splits)
         nleaves = ns + 1
         lv = np.asarray(t.leaf_value, np.float64)[:nleaves] * weights[ti]
         if ns == 0:
-            out[:, -1] += lv[0]
+            out[:, cls, -1] += lv[0]
             continue
         leaf_cover = np.maximum(np.asarray(t.leaf_count, np.float64)[:nleaves], 1.0)
         tree = {
@@ -147,10 +151,10 @@ def forest_shap(booster, X: np.ndarray) -> np.ndarray:
             "bits": np.asarray(t.cat_bitset)[:ns],
         }
         ev = float((lv * leaf_cover).sum() / leaf_cover.sum())
-        out[:, -1] += ev
+        out[:, cls, -1] += ev
         cap = ns + 3
         for r in range(n):
             phi = np.zeros(nfeat + 1)
             _shap_recurse(tree, X[r].astype(np.float64), phi, 0, 0, _Path(cap), 1.0, 1.0, -1)
-            out[r, :nfeat] += phi[:nfeat]
-    return out
+            out[r, cls, :nfeat] += phi[:nfeat]
+    return out[:, 0, :] if k == 1 else out.reshape(n, k * (nfeat + 1))

@@ -508,3 +508,21 @@ def test_predict_num_iteration(binary_data):
                        rf.tree_weights[:2], rf.base_score)
     np.testing.assert_allclose(rf.raw_score(Xte[:50], num_iteration=2),
                                rf_short.raw_score(Xte[:50]), rtol=1e-5)
+
+
+def test_multiclass_shap_additivity():
+    """Multiclass pred_contrib: per-class blocks of (F+1) whose sums equal
+    the per-class raw scores (LightGBM layout)."""
+    rng = np.random.default_rng(13)
+    n, f, k = 600, 5, 3
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.normal(size=n) > 0).astype(np.float32) \
+        + (X[:, 1] > 0.5)
+    bst = train_booster(X, y.astype(np.float32),
+                        BoosterConfig(objective="multiclass", num_class=k,
+                                      num_iterations=4))
+    sh = bst.feature_shap(X[:25])
+    assert sh.shape == (25, k * (f + 1))
+    raw = bst.raw_score(X[:25])                    # (N, K)
+    blocks = sh.reshape(25, k, f + 1)
+    np.testing.assert_allclose(blocks.sum(axis=2), raw, atol=1e-4)

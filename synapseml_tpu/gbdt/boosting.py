@@ -78,6 +78,15 @@ class BoosterConfig:
     seed: int = 0
     boost_from_average: bool = True
     bin_sample_count: int = 200_000
+    min_data_in_bin: int = 3              # merge under-filled bins (minDataPerBin)
+    max_bin_by_feature: Optional[Sequence[int]] = None
+    cat_l2: float = 10.0                  # categorical split L2 (catl2)
+    # derived sampling seeds (LightGBM exposes independent seeds; 0 = derive
+    # purely from `seed`)
+    drop_seed: int = 0
+    feature_fraction_seed: int = 0
+    extra_seed: int = 0
+    start_iteration: int = 0              # prediction start (predict window)
     # distributed tree learner: "serial"/"data" aggregate all features'
     # histograms; "voting" selects top-2k features per tree by shard votes
     # (PV-Tree; LightGBM voting_parallel + topK — LightGBMParams.scala:25-27)
@@ -108,6 +117,7 @@ class BoosterConfig:
             learning_rate=lr,
             max_delta_step=self.max_delta_step,
             cat_smooth=self.cat_smooth,
+            cat_l2=self.cat_l2,
             max_cat_threshold=self.max_cat_threshold,
             partition_impl=self.partition_impl,
             row_layout=self.row_layout,
@@ -189,11 +199,13 @@ class Booster:
         return self._forest_cache
 
     # --- inference ------------------------------------------------------
-    def raw_score(self, X, binned: bool = False,
-                  num_iteration: int = -1) -> np.ndarray:
+    def raw_score(self, X, binned: bool = False, num_iteration: int = -1,
+                  start_iteration: Optional[int] = None) -> np.ndarray:
         """(N,) or (N, K) raw margin. ``num_iteration`` > 0 scores with only
-        the first ``num_iteration`` boosting rounds (LightGBM predict's
-        num_iteration / post-early-stopping scoring)."""
+        that many boosting rounds; ``start_iteration`` (default: the config's
+        predict-time window) skips leading rounds. Training-side margin
+        rebuilds pass start_iteration=0 explicitly — the window is a
+        prediction feature and must not leak into warm starts."""
         X = _densify(X)
         nb = jnp.asarray(self.mapper.nan_bins) if binned else None
         forest = self.forest()
@@ -203,12 +215,17 @@ class Booster:
         k = self.models_per_iter
         n, t = per_tree.shape
         per_iter = per_tree.reshape(n, t // k, k)
+        if start_iteration is None:
+            start_iteration = max(
+                int(getattr(self.config, "start_iteration", 0)), 0)
+        if start_iteration:
+            per_iter = per_iter[:, start_iteration:]
         if num_iteration and num_iteration > 0:
             per_iter = per_iter[:, :num_iteration]
-            if self.average_output:
-                # rf leaves were pre-divided by the FULL tree count; rescale
-                # so a prefix average stays an average
-                per_iter = per_iter * ((t // k) / min(num_iteration, t // k))
+        if self.average_output and per_iter.shape[1] != t // k:
+            # rf leaves were pre-divided by the FULL tree count; rescale so
+            # the windowed average stays an average of the summed trees
+            per_iter = per_iter * ((t // k) / max(per_iter.shape[1], 1))
         out = per_iter.sum(axis=1) + self.base_score[None, :k]
         return np.asarray(out[:, 0] if k == 1 else out)
 
@@ -222,9 +239,11 @@ class Booster:
     def predict_leaf(self, X) -> np.ndarray:
         """(N, T) leaf indices (predictLeaf parity, LightGBMBooster.scala:408)."""
         forest = self.forest()
-        return np.asarray(forest_predict(forest, jnp.asarray(_densify(X)),
-                                         output="leaf",
-                                         depth=self._depth_cache))
+        leaves = np.asarray(forest_predict(forest, jnp.asarray(_densify(X)),
+                                           output="leaf",
+                                           depth=self._depth_cache))
+        start = max(int(getattr(self.config, "start_iteration", 0)), 0)
+        return leaves[:, start * self.models_per_iter:] if start else leaves
 
     def feature_importances(self, importance_type: str = "split") -> np.ndarray:
         """split count or total gain per feature (getFeatureImportances parity,
@@ -313,7 +332,9 @@ def _sample_rows_impl(cfg, n, key0, valid_mask, it, g, h, in_bag_cur, yj=None):
         order = jnp.argsort(-gnorm)
         ranks = jnp.zeros(n, jnp.int32).at[order].set(
             jnp.arange(n, dtype=jnp.int32))
-        u = jax.random.uniform(jax.random.fold_in(key0, it), (n,))
+        kg = (jax.random.fold_in(key0, cfg.extra_seed) if cfg.extra_seed
+              else key0)   # default 0 keeps the established stream
+        u = jax.random.uniform(jax.random.fold_in(kg, it), (n,))
         rest = ranks >= top_n
         pick = rest & (u < (rand_n / max(n - top_n, 1)))
         wmask = (jnp.where(ranks < top_n, 1.0,
@@ -341,7 +362,8 @@ def _sample_features_impl(cfg, nfeat, key0, it):
         return jnp.ones(nfeat, bool)
     nf_keep = max(1, int(math.ceil(cfg.feature_fraction * nfeat)))
     perm = jax.random.permutation(
-        jax.random.fold_in(key0, 10_000_000 + it), nfeat)
+        jax.random.fold_in(key0,
+                           10_000_000 + it + cfg.feature_fraction_seed), nfeat)
     return jnp.zeros(nfeat, bool).at[perm[:nf_keep]].set(True)
 
 
@@ -596,8 +618,10 @@ def train_booster(
         # columnStatistics spans in LightGBMPerformance.scala); the multiproc
         # path instead samples across ALL processes below
         with measures.span("referenceDataset"):
-            mapper = compute_bin_mapper(X, cfg.max_bin, cfg.bin_sample_count,
-                                        categorical_features, cfg.seed)
+            mapper = compute_bin_mapper(
+                X, cfg.max_bin, cfg.bin_sample_count, categorical_features,
+                cfg.seed, min_data_in_bin=cfg.min_data_in_bin,
+                max_bin_by_feature=cfg.max_bin_by_feature)
     if mapper is not None and mapper.max_bin != cfg.max_bin:
         # every mapper source (Dataset, explicit mapper=, warm start) funnels
         # through here: bin ids outside the grower's num_bins range would
@@ -648,7 +672,9 @@ def train_booster(
                 local_nan)).reshape(-1, X.shape[1]).any(axis=0)
             mapper = compute_bin_mapper(
                 X_samp, cfg.max_bin, cfg.bin_sample_count,
-                categorical_features, cfg.seed, has_nan=has_nan_g)
+                categorical_features, cfg.seed, has_nan=has_nan_g,
+                min_data_in_bin=cfg.min_data_in_bin,
+                max_bin_by_feature=cfg.max_bin_by_feature)
         else:
             bnd, nb_, cat_, hn_ = multihost_utils.broadcast_one_to_all(
                 (mapper.boundaries, np.asarray(mapper.num_bins),
@@ -786,7 +812,9 @@ def train_booster(
         tree_weights = list(init_model.tree_weights)
         base = init_model.base_score
         prior_k = init_model.models_per_iter
-        score = jnp.asarray(init_model.raw_score(X).reshape(n, k), jnp.float32)
+        score = jnp.asarray(
+            init_model.raw_score(X, start_iteration=0).reshape(n, k),
+            jnp.float32)
         init_margin = jnp.zeros((n, k)) + jnp.asarray(
             init_model.base_score[None, :k], jnp.float32)
         if init_score is not None:
@@ -828,7 +856,9 @@ def train_booster(
         binned_v = apply_bins(mapper, Xv)
         score_v = jnp.zeros((Xv.shape[0], k)) + jnp.asarray(base[None, :k], jnp.float32)
         if init_model is not None:
-            score_v = jnp.asarray(init_model.raw_score(Xv).reshape(Xv.shape[0], k), jnp.float32)
+            score_v = jnp.asarray(
+                init_model.raw_score(Xv, start_iteration=0).reshape(
+                    Xv.shape[0], k), jnp.float32)
         metric_name = cfg.metric or _default_metric(cfg.objective)
         if metric_name == "ndcg" or (cfg.metric is None
                                      and metric_name.startswith("ndcg")):
@@ -980,7 +1010,9 @@ def train_booster(
         # ---- dart: drop trees and de-weight the score -------------------
         if dart_mode and trees:
             nt = len(trees)
-            if rng.random() >= cfg.skip_drop:
+            drop_rng = (np.random.default_rng(cfg.drop_seed + it)
+                        if cfg.drop_seed else rng)
+            if drop_rng.random() >= cfg.skip_drop:
                 if cfg.uniform_drop:
                     p = np.full(nt, cfg.drop_rate)
                 else:
@@ -990,7 +1022,7 @@ def train_booster(
                     w = np.asarray(tree_weights[:nt], np.float64)
                     p = np.minimum(cfg.drop_rate * w * nt / max(w.sum(), 1e-12),
                                    1.0)
-                drop = np.nonzero(rng.random(nt) < p)[0][: cfg.max_drop]
+                drop = np.nonzero(drop_rng.random(nt) < p)[0][: cfg.max_drop]
             else:
                 drop = np.array([], np.int64)
             kdrop = len(drop)

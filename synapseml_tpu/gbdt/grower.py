@@ -70,6 +70,7 @@ class GrowerConfig(NamedTuple):
     learning_rate: float = 0.1
     max_delta_step: float = 0.0
     cat_smooth: float = 10.0
+    cat_l2: float = 10.0         # extra L2 applied to categorical split gains
     max_cat_threshold: int = 32
     feature_fraction_bynode: float = 1.0  # per-NODE feature sampling
     has_categorical: bool = False  # static: traces out the categorical path
@@ -184,13 +185,18 @@ def _best_for_leaf(hist, feature_active, is_categorical, monotone, nan_bins,
     G, H, C = totals[0], totals[1], totals[2]
     parent_obj = _leaf_objective(G, H, l1, l2)
 
-    def scan_gains(cum, extraG=0.0, extraH=0.0, extraC=0.0):
+    def scan_gains(cum, extraG=0.0, extraH=0.0, extraC=0.0, l2_gain=None):
+        l2g = l2 if l2_gain is None else l2_gain
+        # the parent term uses the SAME regularization as the children
+        # (LightGBM's categorical gain_shift also carries lambda_l2 + cat_l2)
+        parent = (parent_obj if l2_gain is None
+                  else _leaf_objective(G, H, l1, l2g))
         GL = cum[..., 0] + extraG
         HL = cum[..., 1] + extraH
         CL = cum[..., 2] + extraC
         GR, HR, CR = G - GL, H - HL, C - CL
-        gain = (_leaf_objective(GL, HL, l1, l2) + _leaf_objective(GR, HR, l1, l2)
-                - parent_obj)
+        gain = (_leaf_objective(GL, HL, l1, l2g)
+                + _leaf_objective(GR, HR, l1, l2g) - parent)
         valid = ((CL >= cfg.min_data_in_leaf) & (CR >= cfg.min_data_in_leaf)
                  & (HL >= cfg.min_sum_hessian_in_leaf)
                  & (HR >= cfg.min_sum_hessian_in_leaf))
@@ -227,7 +233,9 @@ def _best_for_leaf(hist, feature_active, is_categorical, monotone, nan_bins,
         order = jnp.argsort(key, axis=1)               # (FP, B)
         hist_sorted = jnp.take_along_axis(hist, order[..., None], axis=1)
         cum_cat = jnp.cumsum(hist_sorted, axis=1)
-        gain_cat, CL_cat = scan_gains(cum_cat)
+        # LightGBM applies an EXTRA L2 (cat_l2) to categorical split gains
+        gain_cat, CL_cat = scan_gains(cum_cat,
+                                      l2_gain=l2 + jnp.float32(cfg.cat_l2))
         kk = jnp.arange(B)[None, :]
         nonempty = (cnt > 0).sum(axis=1)[:, None]
         valid_k = (kk < cfg.max_cat_threshold) & (kk < nonempty)

@@ -130,7 +130,10 @@ def forest_shap(booster, X: np.ndarray) -> np.ndarray:
     if booster.average_output:
         weights = weights / booster.trees_per_class
 
+    start = max(int(getattr(booster.config, "start_iteration", 0)), 0) * k
     for ti, t in enumerate(booster.trees):
+        if ti < start:
+            continue        # pred_contrib honors the prediction window
         cls = ti % k
         ns = int(t.num_splits)
         nleaves = ns + 1

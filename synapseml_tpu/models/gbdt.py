@@ -104,6 +104,20 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
     isProvideTrainingMetric = Param("isProvideTrainingMetric", "Log training metrics", bool, False)
     deterministic = Param("deterministic", "Deterministic training", bool, False)
     isEnableSparse = Param("isEnableSparse", "Enable sparse optimization", bool, True)
+    minDataPerBin = Param("minDataPerBin", "Minimum sample rows per bin "
+                          "(under-filled bins merge)", int, 3)
+    maxBinByFeature = Param("maxBinByFeature", "Per-feature max bin counts",
+                            list, None)
+    catl2 = Param("catl2", "Extra L2 applied to categorical split gains",
+                  float, 10.0)
+    dropSeed = Param("dropSeed", "DART drop-selection seed (0 = derive from "
+                     "seed)", int, 0)
+    featureFractionSeed = Param("featureFractionSeed", "Feature-sampling seed "
+                                "(0 = derive from seed)", int, 0)
+    extraSeed = Param("extraSeed", "Extra sampling seed (0 = derive from "
+                      "seed)", int, 0)
+    startIteration = Param("startIteration", "First boosting round used at "
+                           "prediction time", int, 0)
     useMissing = Param("useMissing", "Handle missing values specially", bool, True)
     zeroAsMissing = Param("zeroAsMissing", "Treat zero as missing", bool, False)
 
@@ -141,6 +155,13 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             boost_from_average=self.getBoostFromAverage(),
             bin_sample_count=self.getBinSampleCount(),
             cat_smooth=self.getCatSmooth(),
+            cat_l2=self.getCatl2(),
+            min_data_in_bin=self.getMinDataPerBin(),
+            max_bin_by_feature=self.get("maxBinByFeature"),
+            drop_seed=self.getDropSeed(),
+            feature_fraction_seed=self.getFeatureFractionSeed(),
+            extra_seed=self.getExtraSeed(),
+            start_iteration=self.getStartIteration(),
             max_cat_threshold=self.getMaxCatThreshold(),
             tree_learner=("voting" if self.getParallelism() == "voting_parallel"
                           else "data"),

@@ -69,6 +69,8 @@ def compute_bin_mapper(
     categorical_features: Optional[Sequence[int]] = None,
     seed: int = 0,
     has_nan: Optional[np.ndarray] = None,
+    min_data_in_bin: int = 3,
+    max_bin_by_feature: Optional[Sequence[int]] = None,
 ) -> BinMapper:
     """Driver-side boundary computation from a sample (the analog of
     LightGBMBase.getSampledRows + LGBM_DatasetCreateFromSampledColumn;
@@ -94,15 +96,19 @@ def compute_bin_mapper(
 
     bounds = np.full((f, max_bin - 1), np.inf, dtype=np.float32)
     nbins = np.zeros(f, dtype=np.int32)
+    caps = np.full(f, max_bin, np.int64)
+    if max_bin_by_feature is not None:
+        mb = np.asarray(max_bin_by_feature, np.int64)
+        caps[: len(mb)] = np.clip(mb[:f], 2, max_bin)
     for j in range(f):
         col = X[:, j]
         col = col[~np.isnan(col)]
         # features with NaN reserve one bin; real values get one fewer
-        real_cap = max_bin - 1 if has_nan[j] else max_bin
+        real_cap = int(caps[j]) - 1 if has_nan[j] else int(caps[j])
         if cat[j]:
             # categories are small non-negative ints; identity binning capped at max_bin
             hi = int(col.max()) if col.size else 0
-            nbins[j] = min(hi + 1, max_bin - 1) + 1  # +1 for the overflow bin
+            nbins[j] = min(hi + 1, int(caps[j]) - 1) + 1  # +1 overflow bin
             continue
         uniq = np.unique(col)
         if uniq.size <= 1:
@@ -114,6 +120,25 @@ def compute_bin_mapper(
         else:
             qs = np.linspace(0.0, 1.0, real_cap)[1:-1]
             b = np.unique(np.quantile(col, qs).astype(np.float32))
+        if min_data_in_bin > 1 and b.size:
+            # merge bins whose SAMPLE occupancy is below min_data_in_bin
+            # (LightGBM minDataPerBin): drop a boundary when the bin it
+            # closes is under-filled
+            # right-closed counting (x <= boundary belongs to the LEFT bin),
+            # matching apply_bins' searchsorted side='left' semantics
+            counts = np.bincount(np.searchsorted(b, col, side="left"),
+                                 minlength=b.size + 1)
+            keep = []
+            acc = 0
+            for bi in range(b.size):
+                acc += counts[bi]
+                if acc >= min_data_in_bin:
+                    keep.append(bi)
+                    acc = 0
+            # the trailing (overflow) bin may be under-filled: merge backward
+            if keep and counts[b.size] + acc < min_data_in_bin:
+                keep.pop()
+            b = b[keep]
         bounds[j, : b.size] = b
         # bins: b.size+1 real-value bins (+1 overflow shares the last), plus a
         # dedicated NaN bin when the feature has missing values

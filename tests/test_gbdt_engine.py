@@ -62,8 +62,10 @@ def test_grow_tree_perfect_split():
     n = 200
     X = np.linspace(0, 1, n)[:, None].astype(np.float32)
     y = (X[:, 0] > 0.5).astype(np.float32)
-    # max_bin > #distinct values → midpoint boundaries → the exact 0.5 split exists
-    m = compute_bin_mapper(X, max_bin=255)
+    # max_bin > #distinct values → midpoint boundaries → the exact 0.5 split
+    # exists (min_data_in_bin=1: the default 3 merges single-sample bins,
+    # matching native LightGBM's minDataPerBin default)
+    m = compute_bin_mapper(X, max_bin=255, min_data_in_bin=1)
     binned = apply_bins(m, X)
     g = jnp.asarray(0.5 - y)   # logistic grad at score 0
     h = jnp.full(n, 0.25)
@@ -526,3 +528,77 @@ def test_multiclass_shap_additivity():
     raw = bst.raw_score(X[:25])                    # (N, K)
     blocks = sh.reshape(25, k, f + 1)
     np.testing.assert_allclose(blocks.sum(axis=2), raw, atol=1e-4)
+
+
+def test_new_native_params():
+    """minDataPerBin / maxBinByFeature / cat_l2 / seeds / start_iteration."""
+    rng = np.random.default_rng(14)
+    X = rng.normal(size=(2000, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    # maxBinByFeature caps a single feature's bins
+    m = compute_bin_mapper(X, max_bin=63, max_bin_by_feature=[8, 63, 63, 63])
+    assert m.num_bins[0] <= 8 and m.num_bins[1] > 8
+
+    # min_data_in_bin merges under-filled bins
+    sparse_vals = np.concatenate([np.zeros(1990), np.arange(10)]).astype(
+        np.float32)[:, None]
+    m1 = compute_bin_mapper(sparse_vals, max_bin=255, min_data_in_bin=1)
+    m3 = compute_bin_mapper(sparse_vals, max_bin=255, min_data_in_bin=5)
+    assert m3.num_bins[0] < m1.num_bins[0]
+
+    # cat_l2 regularizes categorical gains (huge value suppresses cat splits)
+    cats = rng.integers(0, 6, size=2000).astype(np.float32)
+    Xc = np.stack([cats, X[:, 1]], 1)
+    yc = np.isin(cats, [1, 4]).astype(np.float32)
+    b_lo = train_booster(Xc, yc, BoosterConfig(objective="binary",
+                                               num_iterations=1, cat_l2=0.0),
+                         categorical_features=[0])
+    b_hi = train_booster(Xc, yc, BoosterConfig(objective="binary",
+                                               num_iterations=1, cat_l2=1e9),
+                         categorical_features=[0])
+    assert int(np.asarray(b_lo.trees[0].split_type)[0]) == 1
+    assert int(np.asarray(b_hi.trees[0].split_type)[0]) == 0
+
+    # independent seeds change the sampled feature masks
+    import jax
+
+    from synapseml_tpu.gbdt.boosting import _sample_features_impl
+    base = BoosterConfig(objective="binary", feature_fraction=0.5, seed=7)
+    alt = BoosterConfig(objective="binary", feature_fraction=0.5, seed=7,
+                        feature_fraction_seed=99)
+    key = jax.random.PRNGKey(7)
+    masks_a = [np.asarray(_sample_features_impl(base, 24, key, it))
+               for it in range(4)]
+    masks_b = [np.asarray(_sample_features_impl(alt, 24, key, it))
+               for it in range(4)]
+    assert any(not np.array_equal(a, b) for a, b in zip(masks_a, masks_b))
+
+    # start_iteration drops the leading rounds at predict time
+    bst = train_booster(X, y, BoosterConfig(objective="binary",
+                                            num_iterations=6))
+    import dataclasses
+    bst.config = dataclasses.replace(bst.config, start_iteration=2)
+    tail = Booster(bst.mapper,
+                   dataclasses.replace(bst.config, start_iteration=0),
+                   bst.trees[2:], bst.tree_weights[2:], bst.base_score)
+    np.testing.assert_allclose(bst.raw_score(X[:50]),
+                               tail.raw_score(X[:50]), rtol=1e-6)
+    # SHAP honors the window (additivity against the windowed prediction)
+    sh = bst.feature_shap(X[:10])
+    np.testing.assert_allclose(sh.sum(axis=1), bst.raw_score(X[:10]),
+                               atol=1e-4)
+    # ...but warm starts must NOT inherit the window: continued training sees
+    # the full margin
+    b2 = train_booster(X, y, BoosterConfig(objective="binary",
+                                           num_iterations=2),
+                       init_model=bst)
+    full = Booster(bst.mapper,
+                   dataclasses.replace(bst.config, start_iteration=0),
+                   bst.trees, bst.tree_weights, bst.base_score)
+    b2_ref = train_booster(X, y, BoosterConfig(objective="binary",
+                                               num_iterations=2),
+                           init_model=full)
+    np.testing.assert_allclose(
+        np.asarray(b2.trees[-1].leaf_value),
+        np.asarray(b2_ref.trees[-1].leaf_value), rtol=1e-6)

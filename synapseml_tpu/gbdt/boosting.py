@@ -361,9 +361,10 @@ def _sample_features_impl(cfg, nfeat, key0, it):
     if cfg.feature_fraction >= 1.0:
         return jnp.ones(nfeat, bool)
     nf_keep = max(1, int(math.ceil(cfg.feature_fraction * nfeat)))
+    kf = (jax.random.fold_in(key0, cfg.feature_fraction_seed)
+          if cfg.feature_fraction_seed else key0)  # 0 keeps the default stream
     perm = jax.random.permutation(
-        jax.random.fold_in(key0,
-                           10_000_000 + it + cfg.feature_fraction_seed), nfeat)
+        jax.random.fold_in(kf, 10_000_000 + it), nfeat)
     return jnp.zeros(nfeat, bool).at[perm[:nf_keep]].set(True)
 
 
@@ -559,7 +560,8 @@ def train_booster(
             X = Dataset(X, mapper=mapper, max_bin=cfg.max_bin,
                         bin_sample_count=cfg.bin_sample_count,
                         categorical_features=categorical_features,
-                        seed=cfg.seed)
+                        seed=cfg.seed, min_data_in_bin=cfg.min_data_in_bin,
+                        max_bin_by_feature=cfg.max_bin_by_feature)
     # LightGBM Dataset analog: pre-binned device-resident data skips the
     # quantization pass and the raw-float host→device transfer entirely
     dataset = X if isinstance(X, Dataset) else None
@@ -578,6 +580,17 @@ def train_booster(
             group_sizes = dataset.group_sizes
         if categorical_features is None:
             categorical_features = dataset.categorical_features
+        ds_binning = (getattr(dataset, "min_data_in_bin", 3),
+                      tuple(dataset.max_bin_by_feature)
+                      if getattr(dataset, "max_bin_by_feature", None) else None)
+        cfg_binning = (cfg.min_data_in_bin,
+                       tuple(cfg.max_bin_by_feature)
+                       if cfg.max_bin_by_feature else None)
+        if ds_binning != cfg_binning and mapper is None:
+            raise ValueError(
+                f"Dataset was binned with (min_data_in_bin, max_bin_by_feature)"
+                f"={ds_binning} but the config asks for {cfg_binning}; rebuild "
+                "the Dataset with matching binning params")
         if mapper is not None and mapper is not dataset.mapper:
             # explicit conflicting mapper (reference-dataset warm-start style):
             # the pre-binned ids were assigned under dataset.mapper's
@@ -1010,7 +1023,8 @@ def train_booster(
         # ---- dart: drop trees and de-weight the score -------------------
         if dart_mode and trees:
             nt = len(trees)
-            drop_rng = (np.random.default_rng(cfg.drop_seed + it)
+            # sequence seeding gives independent streams per (drop_seed, it)
+            drop_rng = (np.random.default_rng([cfg.drop_seed, it])
                         if cfg.drop_seed else rng)
             if drop_rng.random() >= cfg.skip_drop:
                 if cfg.uniform_drop:

@@ -27,7 +27,8 @@ def _is_sparse(X) -> bool:
 
 def bin_sparse(X_csr, mapper: BinMapper, max_bin: int,
                bin_sample_count: int, categorical_features, seed: int,
-               chunk_rows: int = 65_536):
+               chunk_rows: int = 65_536, min_data_in_bin: int = 3,
+               max_bin_by_feature=None):
     """Bin a scipy CSR matrix chunk-wise (the reference's sparse dataset path
     — BulkPartitionTask CSR push + isSparse election — re-shaped for TPU:
     sparse rows stream through host densification into the device-resident
@@ -51,7 +52,9 @@ def bin_sparse(X_csr, mapper: BinMapper, max_bin: int,
             has_nan[np.unique(X_csr.indices[nan_mask])] = True
         mapper = compute_bin_mapper(sample, max_bin, bin_sample_count,
                                     categorical_features, seed,
-                                    has_nan=has_nan)
+                                    has_nan=has_nan,
+                                    min_data_in_bin=min_data_in_bin,
+                                    max_bin_by_feature=max_bin_by_feature)
     chunks = []
     for lo in range(0, n, chunk_rows):
         dense = np.asarray(X_csr[lo:lo + chunk_rows].todense(), np.float32)
@@ -81,7 +84,11 @@ class Dataset:
         seed: int = 0,
         mapper: Optional[BinMapper] = None,
         keep_raw: bool = True,
+        min_data_in_bin: int = 3,
+        max_bin_by_feature=None,
     ):
+        self.min_data_in_bin = min_data_in_bin
+        self.max_bin_by_feature = max_bin_by_feature
         if _is_sparse(X):
             X = X.tocsr()                 # one conversion shared by all uses
             self.num_rows, self.num_features = X.shape
@@ -89,7 +96,8 @@ class Dataset:
                 raise ValueError("Dataset requires a non-empty matrix")
             self.mapper, self.binned = bin_sparse(
                 X, mapper, max_bin, bin_sample_count, categorical_features,
-                seed)
+                seed, min_data_in_bin=min_data_in_bin,
+                max_bin_by_feature=max_bin_by_feature)
             # raw sparse rows kept as-is (cheap); densified lazily by the few
             # paths that need raw floats (warm start / mesh padding)
             self._sparse = X if keep_raw else None
@@ -102,7 +110,9 @@ class Dataset:
                     f"Dataset requires a non-empty 2-D matrix, got {X.shape}")
             self.num_rows, self.num_features = X.shape
             self.mapper = mapper if mapper is not None else compute_bin_mapper(
-                X, max_bin, bin_sample_count, categorical_features, seed)
+                X, max_bin, bin_sample_count, categorical_features, seed,
+                min_data_in_bin=min_data_in_bin,
+                max_bin_by_feature=max_bin_by_feature)
             self.binned = apply_bins(self.mapper, X)  # device (N, F) uint8/16
             # raw floats kept host-side for paths that need them (warm start /
             # mesh row padding); drop with keep_raw=False to halve host memory

@@ -126,11 +126,13 @@ def forest_shap(booster, X: np.ndarray) -> np.ndarray:
     out = np.zeros((n, k, nfeat + 1), np.float64)
     out[:, :, -1] += booster.base_score[None, :k]
 
+    start = max(int(getattr(booster.config, "start_iteration", 0)), 0) * k
     weights = np.asarray(booster.tree_weights, np.float64)
     if booster.average_output:
-        weights = weights / booster.trees_per_class
+        # the served prediction averages over the WINDOWED trees (raw_score's
+        # rescale), so contributions must use the same divisor
+        weights = weights / max((len(booster.trees) - start) // k, 1)
 
-    start = max(int(getattr(booster.config, "start_iteration", 0)), 0) * k
     for ti, t in enumerate(booster.trees):
         if ti < start:
             continue        # pred_contrib honors the prediction window

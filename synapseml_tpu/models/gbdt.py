@@ -118,6 +118,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
                       "seed)", int, 0)
     startIteration = Param("startIteration", "First boosting round used at "
                            "prediction time", int, 0)
+    fobj = Param("fobj", "Custom objective: fn(score, label, weight) -> "
+                 "(grad, hess) arrays (the reference's FObjTrait/FObjParam)",
+                 is_complex=True)
     useMissing = Param("useMissing", "Handle missing values specially", bool, True)
     zeroAsMissing = Param("zeroAsMissing", "Treat zero as missing", bool, False)
 
@@ -356,12 +359,13 @@ class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPr
                                     init_score=None if init is None else init[part],
                                     categorical_features=cats, valid=valid,
                                     feature_names=self.get("slotNames"), init_model=bst,
-                                    measures=measures)
+                                    fobj=self.get("fobj"), measures=measures)
         else:
             bst = train_booster(X, y, cfg, sample_weight=w, init_score=init,
                                 categorical_features=cats, valid=valid,
                                 feature_names=self.get("slotNames"),
-                                init_model=init_model, measures=measures)
+                                init_model=init_model, fobj=self.get("fobj"),
+                                measures=measures)
         self._log_base("trainingMeasures", measures.report())
         return bst
 
@@ -486,7 +490,8 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
         cats = self._categorical_indexes(self.get("slotNames"))
         booster = train_booster(X, y, cfg, sample_weight=w, init_score=init,
                                 categorical_features=cats, group_sizes=sizes,
-                                valid=valid, feature_names=self.get("slotNames"))
+                                valid=valid, feature_names=self.get("slotNames"),
+                                fobj=self.get("fobj"))
         model = LightGBMRankerModel(booster)
         self._copy_model_params(model)
         return model

@@ -208,3 +208,25 @@ def test_booster_introspection_getters(binary_data):
     # native LightGBM reports num_class=1 for binary objectives
     assert model.getBoosterNumClasses() == 1
     assert model.getBoosterBestIteration() == -1
+
+
+def test_custom_fobj_param(binary_data):
+    """fobj (FObjParam parity): a custom objective drives training through
+    the estimator surface."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.models import LightGBMClassifier
+
+    Xtr, _, ytr, _ = binary_data
+    t = Table({"features": list(Xtr.astype(np.float32)), "label": ytr})
+
+    def logistic_fobj(score, y, w):
+        p = jax.nn.sigmoid(score)
+        return (p - y) * w, jnp.maximum(p * (1 - p), 1e-6) * w
+
+    m = LightGBMClassifier(numIterations=8, objective="binary",
+                           fobj=logistic_fobj).fit(t)
+    acc = (np.asarray(m.transform(t)["prediction"]) == ytr).mean()
+    assert acc > 0.9, acc

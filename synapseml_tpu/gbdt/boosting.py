@@ -72,6 +72,9 @@ class BoosterConfig:
     max_delta_step: float = 0.0
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
+    xgboost_dart_mode: bool = False
     monotone_constraints: Optional[Sequence[int]] = None
     early_stopping_round: int = 0
     metric: Optional[str] = None
@@ -119,6 +122,8 @@ class BoosterConfig:
             cat_smooth=self.cat_smooth,
             cat_l2=self.cat_l2,
             max_cat_threshold=self.max_cat_threshold,
+            max_cat_to_onehot=self.max_cat_to_onehot,
+            min_data_per_group=self.min_data_per_group,
             partition_impl=self.partition_impl,
             row_layout=self.row_layout,
         )
@@ -166,7 +171,8 @@ class Booster:
 
     @property
     def trees_per_class(self) -> int:
-        """rf averaging divisor shared by forest() and SHAP."""
+        """Full-model rf averaging divisor (forest()); SHAP uses the
+        start_iteration-windowed count to match raw_score's rescale."""
         return max(len(self.trees) // self.models_per_iter, 1)
 
     def _thresholds(self, index: int) -> np.ndarray:
@@ -586,7 +592,10 @@ def train_booster(
         cfg_binning = (cfg.min_data_in_bin,
                        tuple(cfg.max_bin_by_feature)
                        if cfg.max_bin_by_feature else None)
-        if ds_binning != cfg_binning and mapper is None:
+        if (ds_binning != cfg_binning and mapper is None
+                and not getattr(dataset, "_user_mapper", False)):
+            # (an explicit user mapper defines the binning outright — the
+            # Dataset's unused binning knobs cannot conflict with anything)
             raise ValueError(
                 f"Dataset was binned with (min_data_in_bin, max_bin_by_feature)"
                 f"={ds_binning} but the config asks for {cfg_binning}; rebuild "
@@ -1063,7 +1072,10 @@ def train_booster(
         # ---- grow K trees ----------------------------------------------
         new_weight = 1.0
         if dart_mode and kdrop:
-            new_weight = 1.0 / (kdrop + 1.0)
+            if cfg.xgboost_dart_mode:
+                new_weight = cfg.learning_rate / (kdrop + cfg.learning_rate)
+            else:
+                new_weight = 1.0 / (kdrop + 1.0)
         # voting-parallel: pick top-2k features per tree by shard votes, grow
         # on the sliced columns so in-loop histogram allreduce is O(top_k)
         voting = (cfg.tree_learner == "voting" and mesh is not None
@@ -1096,7 +1108,9 @@ def train_booster(
                     # score from the fixed init margin + all weighted per-tree
                     # contributions — one stacked matvec on device instead of a
                     # host numpy loop (VERDICT weak #7)
-                    factor = kdrop / (kdrop + 1.0)
+                    factor = (kdrop / (kdrop + cfg.learning_rate)
+                              if cfg.xgboost_dart_mode
+                              else kdrop / (kdrop + 1.0))
                     for j in drop:
                         tree_weights[j] *= factor
                     stack = jnp.stack([v for _, v in tree_contribs])  # (T, N)

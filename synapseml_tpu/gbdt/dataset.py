@@ -89,6 +89,9 @@ class Dataset:
     ):
         self.min_data_in_bin = min_data_in_bin
         self.max_bin_by_feature = max_bin_by_feature
+        # binning came entirely from a user mapper: the binning knobs above
+        # were never used, so config mismatches against them are meaningless
+        self._user_mapper = mapper is not None
         if _is_sparse(X):
             X = X.tocsr()                 # one conversion shared by all uses
             self.num_rows, self.num_features = X.shape

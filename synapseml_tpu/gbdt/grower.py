@@ -72,6 +72,8 @@ class GrowerConfig(NamedTuple):
     cat_smooth: float = 10.0
     cat_l2: float = 10.0         # extra L2 applied to categorical split gains
     max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4   # <= this many categories: one-vs-rest splits
+    min_data_per_group: int = 100  # thin categorical groups excluded
     feature_fraction_bynode: float = 1.0  # per-NODE feature sampling
     has_categorical: bool = False  # static: traces out the categorical path
     # row-partition primitive: "sort" = stable argsort of the 4-way key
@@ -228,17 +230,27 @@ def _best_for_leaf(hist, feature_active, is_categorical, monotone, nan_bins,
     order = None
     if cfg.has_categorical:
         cnt = hist[..., 2]
-        key = jnp.where(cnt > 0, hist[..., 0] / (hist[..., 1] + cfg.cat_smooth),
+        # thin groups (minDataPerGroup) never lead a split: pushed to the end
+        # of the ordering and masked out of every candidate position
+        usable = cnt >= cfg.min_data_per_group
+        key = jnp.where(usable & (cnt > 0),
+                        hist[..., 0] / (hist[..., 1] + cfg.cat_smooth),
                         jnp.inf)
         order = jnp.argsort(key, axis=1)               # (FP, B)
         hist_sorted = jnp.take_along_axis(hist, order[..., None], axis=1)
         cum_cat = jnp.cumsum(hist_sorted, axis=1)
         # LightGBM applies an EXTRA L2 (cat_l2) to categorical split gains
-        gain_cat, CL_cat = scan_gains(cum_cat,
-                                      l2_gain=l2 + jnp.float32(cfg.cat_l2))
+        l2c = l2 + jnp.float32(cfg.cat_l2)
+        gain_sorted, CL_sorted = scan_gains(cum_cat, l2_gain=l2c)
+        # one-vs-rest (maxCatToOnehot): candidate = a SINGLE sorted category
+        # left; scan_gains on the unsummed sorted histogram gives exactly that
+        gain_one, CL_one = scan_gains(hist_sorted, l2_gain=l2c)
         kk = jnp.arange(B)[None, :]
-        nonempty = (cnt > 0).sum(axis=1)[:, None]
-        valid_k = (kk < cfg.max_cat_threshold) & (kk < nonempty)
+        n_usable = (usable & (cnt > 0)).sum(axis=1)[:, None]
+        onehot = n_usable <= cfg.max_cat_to_onehot
+        gain_cat = jnp.where(onehot, gain_one, gain_sorted)
+        CL_cat = jnp.where(onehot, CL_one, CL_sorted)
+        valid_k = (kk < cfg.max_cat_threshold) & (kk < n_usable)
         gain_cat = jnp.where(valid_k, gain_cat, -jnp.inf)
         gain = jnp.where(is_categorical[:, None], gain_cat, gain_num)
         CLsel = jnp.where(is_categorical[:, None], CL_cat, CL_num)
@@ -319,10 +331,15 @@ def _winning_cat_bitset(hist_parent, fsel, bsel, catp, cfg: GrowerConfig,
     if not cfg.has_categorical:
         return jnp.zeros((bw,), jnp.uint32), jnp.zeros((), bool)
     histf = hist_parent[fsel]                          # (B, 3)
-    keyc = jnp.where(histf[:, 2] > 0,
+    usable = histf[:, 2] >= cfg.min_data_per_group
+    keyc = jnp.where(usable & (histf[:, 2] > 0),
                      histf[:, 0] / (histf[:, 1] + cfg.cat_smooth), jnp.inf)
     order_f = jnp.argsort(keyc)
-    take = jnp.arange(B) <= bsel
+    n_usable = (usable & (histf[:, 2] > 0)).sum()
+    onehot = n_usable <= cfg.max_cat_to_onehot
+    idx = jnp.arange(B)
+    # one-vs-rest winners take ONLY the chosen sorted position left
+    take = jnp.where(onehot, idx == bsel, idx <= bsel)
     bwords = (order_f >> 5).astype(jnp.int32)
     bvals = jnp.uint32(1) << (order_f & 31).astype(jnp.uint32)
     bitset = jnp.zeros((bw,), jnp.uint32).at[bwords].add(

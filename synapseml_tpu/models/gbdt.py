@@ -61,7 +61,6 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
     maxDrop = Param("maxDrop", "DART max trees dropped per iteration", int, 50)
     skipDrop = Param("skipDrop", "DART probability of skipping dropout", float, 0.5)
     uniformDrop = Param("uniformDrop", "DART uniform drop", bool, False)
-    xgboostDartMode = Param("xgboostDartMode", "DART xgboost mode", bool, False)
     topRate = Param("topRate", "GOSS large-gradient keep fraction", float, 0.2)
     otherRate = Param("otherRate", "GOSS small-gradient sample fraction", float, 0.1)
     monotoneConstraints = Param("monotoneConstraints", "Per-feature -1/0/+1 constraints", list)
@@ -118,6 +117,13 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
                       "seed)", int, 0)
     startIteration = Param("startIteration", "First boosting round used at "
                            "prediction time", int, 0)
+    maxCatToOnehot = Param("maxCatToOnehot", "One-vs-rest categorical splits "
+                           "at or below this many categories", int, 4)
+    minDataPerGroup = Param("minDataPerGroup", "Minimum rows per categorical "
+                            "group considered for splitting", int, 100)
+    xGBoostDartMode = Param("xGBoostDartMode", "XGBoost-style DART "
+                            "normalization (learning-rate weighted)", bool,
+                            False)
     fobj = Param("fobj", "Custom objective: fn(score, label, weight) -> "
                  "(grad, hess) arrays (the reference's FObjTrait/FObjParam)",
                  is_complex=True)
@@ -166,6 +172,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             extra_seed=self.getExtraSeed(),
             start_iteration=self.getStartIteration(),
             max_cat_threshold=self.getMaxCatThreshold(),
+            max_cat_to_onehot=self.getMaxCatToOnehot(),
+            min_data_per_group=self.getMinDataPerGroup(),
+            xgboost_dart_mode=self.getXGBoostDartMode(),
             tree_learner=("voting" if self.getParallelism() == "voting_parallel"
                           else "data"),
             top_k=self.getTopK(),

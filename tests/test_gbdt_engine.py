@@ -602,3 +602,60 @@ def test_new_native_params():
     np.testing.assert_allclose(
         np.asarray(b2.trees[-1].leaf_value),
         np.asarray(b2_ref.trees[-1].leaf_value), rtol=1e-6)
+
+
+def test_categorical_onehot_and_group_params():
+    """maxCatToOnehot (one-vs-rest for small cardinality) and
+    minDataPerGroup (thin groups excluded) semantics."""
+    rng = np.random.default_rng(15)
+    n = 3000
+    cats = rng.integers(0, 3, size=n)          # 3 categories <= onehot cap 4
+    y = (cats == 1).astype(np.float32)
+    X = np.stack([cats.astype(np.float32),
+                  rng.normal(size=n).astype(np.float32)], 1)
+    bst = train_booster(X, y, BoosterConfig(objective="binary",
+                                            num_iterations=10,
+                                            min_data_per_group=1),
+                        categorical_features=[0])
+    t0 = bst.trees[0]
+    assert int(np.asarray(t0.split_type)[0]) == 1
+    # one-vs-rest: exactly ONE category in the left bitset
+    bits = np.asarray(t0.cat_bitset)[0]
+    popcount = sum(bin(int(w)).count("1") for w in bits)
+    assert popcount == 1
+    assert ((bst.predict(X) > 0.5) == (y > 0.5)).mean() > 0.99
+
+    # minDataPerGroup: a tiny perfectly-separating category is ignored when
+    # the threshold exceeds its size
+    cats2 = np.where(np.arange(n) < 20, 7, rng.integers(0, 3, size=n))
+    y2 = (cats2 == 7).astype(np.float32)
+    X2 = np.stack([cats2.astype(np.float32),
+                   rng.normal(size=n).astype(np.float32)], 1)
+    b_lo = train_booster(X2, y2, BoosterConfig(objective="binary",
+                                               num_iterations=1,
+                                               min_data_per_group=1,
+                                               min_data_in_leaf=5),
+                         categorical_features=[0])
+    b_hi = train_booster(X2, y2, BoosterConfig(objective="binary",
+                                               num_iterations=1,
+                                               min_data_per_group=100,
+                                               min_data_in_leaf=5),
+                         categorical_features=[0])
+    # low threshold isolates category 7 immediately; high threshold cannot
+    bits_lo = np.asarray(b_lo.trees[0].cat_bitset)[0]
+    assert (bits_lo[7 >> 5] >> (7 & 31)) & 1
+    bits_hi = np.asarray(b_hi.trees[0].cat_bitset)[0]
+    assert not ((bits_hi[7 >> 5] >> (7 & 31)) & 1)
+
+
+def test_xgboost_dart_mode_runs():
+    rng = np.random.default_rng(16)
+    X = rng.normal(size=(1000, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    b = train_booster(X, y, BoosterConfig(objective="binary",
+                                          num_iterations=6,
+                                          boosting_type="dart",
+                                          drop_rate=0.5, skip_drop=0.0,
+                                          xgboost_dart_mode=True, seed=3))
+    assert b.num_trees == 6
+    assert ((b.predict(X) > 0.5) == (y > 0.5)).mean() > 0.9

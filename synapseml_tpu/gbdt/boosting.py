@@ -389,20 +389,20 @@ def _make_grow_fn(grower_cfg, mesh):
         from ..parallel.collectives import shard_apply
         from ..parallel.mesh import DATA_AXIS as _DA
 
-        def _grow_sharded(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb, nk):
+        def _grow_sharded(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb, nk, cb):
             return grow_tree(binned_s, g_s, h_s, bag_s, fa, ic, mo,
                              grower_cfg, nan_bins=nb, axis_name=_DA,
-                             node_key=nk)
+                             node_key=nk, cat_nbins=cb)
 
         return shard_apply(
             mesh, _grow_sharded,
             in_specs=(P(_DA, None), P(_DA), P(_DA), P(_DA),
-                      P(None), P(None), P(None), P(None), P(None)),
+                      P(None), P(None), P(None), P(None), P(None), P(None)),
             out_specs=(P(), P(_DA)))
 
-    def grow_fn(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb, nk):
+    def grow_fn(binned_s, g_s, h_s, bag_s, fa, ic, mo, nb, nk, cb):
         return grow_tree(binned_s, g_s, h_s, bag_s, fa, ic, mo,
-                         grower_cfg, nan_bins=nb, node_key=nk)
+                         grower_cfg, nan_bins=nb, node_key=nk, cat_nbins=cb)
 
     return grow_fn
 
@@ -451,8 +451,8 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
                             tweedie_variance_power=cfg.tweedie_variance_power)
 
     def body_for(args):
-        (binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins, base_k,
-         gidx, binned_v, yv_j, gidx_v) = args
+        (binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins, cat_nbins,
+         base_k, gidx, binned_v, yv_j, gidx_v) = args
         if not jnp.issubdtype(key0.dtype, jax.dtypes.prng_key):
             key0 = jax.random.wrap_key_data(key0)   # multi-process raw key
         if is_ranking:
@@ -474,7 +474,7 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
             for cls in range(k):
                 tree, node = grow_fn(binned, g[:, cls], h[:, cls], in_bag,
                                      feat_mask, is_cat, mono, nan_bins,
-                                     _node_key_data(key0, it, cls))
+                                     _node_key_data(key0, it, cls), cat_nbins)
                 cls_trees.append(tree)
                 if not rf_mode:
                     score_c = score_c.at[:, cls].add(
@@ -505,10 +505,11 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
 
     @functools.partial(jax.jit, static_argnames=("count",))
     def run_scan(binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins,
-                 base_k, gidx, binned_v, yv_j, gidx_v, score0, bag0, sv0,
-                 start, count):
+                 cat_nbins, base_k, gidx, binned_v, yv_j, gidx_v, score0,
+                 bag0, sv0, start, count):
         body = body_for((binned, yj, wj, valid_mask, key0, is_cat, mono,
-                         nan_bins, base_k, gidx, binned_v, yv_j, gidx_v))
+                         nan_bins, cat_nbins, base_k, gidx, binned_v, yv_j,
+                         gidx_v))
         return lax.scan(body, (score0, bag0, sv0),
                         start + jnp.arange(count, dtype=jnp.int32))
 
@@ -862,6 +863,14 @@ def train_booster(
     _wrap = np.asarray if multiproc else jnp.asarray
     is_cat = _wrap(mapper.is_categorical)
     nan_bins = _wrap(np.asarray(mapper.nan_bins, np.int32))
+    # static per-feature DISTINCT category counts drive the one-vs-rest
+    # decision (sparse id encodings make num_bins an overcount; fall back to
+    # it for mappers predating cat_counts)
+    _cc = (np.asarray(mapper.cat_counts, np.int32)
+           if getattr(mapper, "cat_counts", None) is not None
+           else np.asarray(mapper.num_bins, np.int32) - 1)
+    cat_nbins = _wrap(np.where(np.asarray(mapper.is_categorical), _cc,
+                               np.int32(0x7FFF)))
     mono = np.zeros(nfeat, np.int32)
     if cfg.monotone_constraints is not None:
         mc = np.asarray(cfg.monotone_constraints, np.int32)
@@ -984,7 +993,8 @@ def train_booster(
                 c = min(chunk, T - done)
                 carry, (stacked_trees, mv) = run_scan(
                     binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins,
-                    base_k, gidx_arr, bv_arg, yv_j, gidx_v, *carry, done, c)
+                    cat_nbins, base_k, gidx_arr, bv_arg, yv_j, gidx_v, *carry,
+                    done, c)
                 stacked_trees = jax.device_get(stacked_trees)
                 for ti in range(c):
                     for cls in range(k):
@@ -1073,7 +1083,10 @@ def train_booster(
         new_weight = 1.0
         if dart_mode and kdrop:
             if cfg.xgboost_dart_mode:
-                new_weight = cfg.learning_rate / (kdrop + cfg.learning_rate)
+                # leaf values already carry the learning rate (grower), so
+                # the extra multiplier is 1/(k+lr): effective lr/(k+lr), the
+                # DART-paper / LightGBM xgboost-mode weight
+                new_weight = 1.0 / (kdrop + cfg.learning_rate)
             else:
                 new_weight = 1.0 / (kdrop + 1.0)
         # voting-parallel: pick top-2k features per tree by shard votes, grow
@@ -1094,12 +1107,13 @@ def train_booster(
                 tree, node = grow_fn(
                     binned[:, sel_j], g[:, cls], h[:, cls], in_bag,
                     feat_mask[sel_j], is_cat[sel_j], mono[sel_j],
-                    nan_bins[sel_j], _node_key_data(key0, it, cls))
+                    nan_bins[sel_j], _node_key_data(key0, it, cls),
+                    cat_nbins[sel_j])
                 tree = remap_tree_features(tree, sel_idx)
             else:
                 tree, node = grow_fn(binned, g[:, cls], h[:, cls], in_bag,
                                      feat_mask, is_cat, mono, nan_bins,
-                                     _node_key_data(key0, it, cls))
+                                     _node_key_data(key0, it, cls), cat_nbins)
             contrib = _leaf_gather(tree.leaf_value, node)          # (N,)
             if dart_mode:
                 tree_contribs.append((cls, contrib))               # device-side
@@ -1114,7 +1128,13 @@ def train_booster(
                     for j in drop:
                         tree_weights[j] *= factor
                     stack = jnp.stack([v for _, v in tree_contribs])  # (T, N)
-                    wts = jnp.asarray(tree_weights, jnp.float32)
+                    # THIS iteration's k trees are appended below, after the
+                    # rebuild: extend explicitly or the newest contributions
+                    # gather stale (clamped) weights
+                    wts_now = (tree_weights
+                               + [new_weight] * (len(tree_contribs)
+                                                 - len(tree_weights)))
+                    wts = jnp.asarray(wts_now, jnp.float32)
                     cls_ids = np.asarray([c for c, _ in tree_contribs])
                     total = jnp.zeros((n, k))
                     for cj in range(k):

@@ -176,7 +176,7 @@ def _stable_partition_src(key: jnp.ndarray, impl: str) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 def _best_for_leaf(hist, feature_active, is_categorical, monotone, nan_bins,
-                   cfg: GrowerConfig, l1, l2):
+                   cfg: GrowerConfig, l1, l2, cat_nbins=None):
     """hist (FP, B, 3) → (gain, feat, bin, default_left, count_left, order).
 
     ``order`` is the categorical bin ordering (FP, B) used to rebuild the
@@ -229,28 +229,30 @@ def _best_for_leaf(hist, feature_active, is_categorical, monotone, nan_bins,
 
     order = None
     if cfg.has_categorical:
-        cnt = hist[..., 2]
         # thin groups (minDataPerGroup) never lead a split: pushed to the end
         # of the ordering and masked out of every candidate position
-        usable = cnt >= cfg.min_data_per_group
-        key = jnp.where(usable & (cnt > 0),
-                        hist[..., 0] / (hist[..., 1] + cfg.cat_smooth),
-                        jnp.inf)
-        order = jnp.argsort(key, axis=1)               # (FP, B)
+        order, n_usable = _cat_order_usable(hist, cfg)
+        n_usable = n_usable[:, None]
         hist_sorted = jnp.take_along_axis(hist, order[..., None], axis=1)
         cum_cat = jnp.cumsum(hist_sorted, axis=1)
         # LightGBM applies an EXTRA L2 (cat_l2) to categorical split gains
         l2c = l2 + jnp.float32(cfg.cat_l2)
         gain_sorted, CL_sorted = scan_gains(cum_cat, l2_gain=l2c)
         # one-vs-rest (maxCatToOnehot): candidate = a SINGLE sorted category
-        # left; scan_gains on the unsummed sorted histogram gives exactly that
+        # left; scan_gains on the unsummed sorted histogram gives exactly
+        # that. The mode is decided by the feature's STATIC category count
+        # (LightGBM's use_onehot), not the per-leaf occupancy
         gain_one, CL_one = scan_gains(hist_sorted, l2_gain=l2c)
         kk = jnp.arange(B)[None, :]
-        n_usable = (usable & (cnt > 0)).sum(axis=1)[:, None]
-        onehot = n_usable <= cfg.max_cat_to_onehot
+        if cat_nbins is None:
+            cat_nbins = jnp.full(hist.shape[0], B, jnp.int32)
+        onehot = (cat_nbins <= cfg.max_cat_to_onehot)[:, None]
         gain_cat = jnp.where(onehot, gain_one, gain_sorted)
         CL_cat = jnp.where(onehot, CL_one, CL_sorted)
-        valid_k = (kk < cfg.max_cat_threshold) & (kk < n_usable)
+        # max_cat_threshold caps only the many-vs-many prefix size; one-hot
+        # mode scans every usable category (LightGBM semantics)
+        valid_k = jnp.where(onehot, kk < n_usable,
+                            (kk < cfg.max_cat_threshold) & (kk < n_usable))
         gain_cat = jnp.where(valid_k, gain_cat, -jnp.inf)
         gain = jnp.where(is_categorical[:, None], gain_cat, gain_num)
         CLsel = jnp.where(is_categorical[:, None], CL_cat, CL_num)
@@ -268,6 +270,20 @@ def _best_for_leaf(hist, feature_active, is_categorical, monotone, nan_bins,
     bdl = use_left.reshape(FP * B)[best]
     bcl = CLsel.reshape(FP * B)[best]
     return best_gain, bfeat, bbin, bdl, bcl, order
+
+
+def _cat_order_usable(hist_b3, cfg: GrowerConfig):
+    """Categorical ordering state from a (..., B, 3) histogram: (order over
+    bins by grad/(hess+smooth) with thin groups last, usable count). ONE
+    definition shared by the split search and the winning-bitset rebuild —
+    they must agree bit for bit."""
+    cnt = hist_b3[..., 2]
+    usable = (cnt >= cfg.min_data_per_group) & (cnt > 0)
+    key = jnp.where(usable,
+                    hist_b3[..., 0] / (hist_b3[..., 1] + cfg.cat_smooth),
+                    jnp.inf)
+    order = jnp.argsort(key, axis=-1)
+    return order, usable.sum(axis=-1)
 
 
 def _node_mask_fn(cfg: GrowerConfig, featp, f: int, node_key):
@@ -324,19 +340,27 @@ def _pad_grow_inputs(binned, grad, hess, in_bag, feature_active,
     return bT0, gs0, hs0, ms0, featp, catp, monop, nanp
 
 
+def _pad_cat_nbins(cat_nbins, f: int, FP: int, B: int):
+    """(F,) per-feature category counts → (FP,) padded; None → B (the
+    one-hot mode then never triggers, preserving legacy direct-call use)."""
+    if cat_nbins is None:
+        return jnp.full(FP, B, jnp.int32)
+    return jnp.full(FP, B, jnp.int32).at[:f].set(
+        jnp.asarray(cat_nbins, jnp.int32))
+
+
 def _winning_cat_bitset(hist_parent, fsel, bsel, catp, cfg: GrowerConfig,
-                        B: int, bw: int):
+                        B: int, bw: int, cat_nbins=None):
     """(bitset, cat_split) of the chosen split, rebuilt from the hist cache
-    (LightGBM's many-vs-many prefix re-derived from the sorted-bin order)."""
+    (LightGBM's many-vs-many prefix re-derived from the sorted-bin order —
+    the ordering/one-hot decisions share one implementation with the split
+    search, _cat_order_usable)."""
     if not cfg.has_categorical:
         return jnp.zeros((bw,), jnp.uint32), jnp.zeros((), bool)
     histf = hist_parent[fsel]                          # (B, 3)
-    usable = histf[:, 2] >= cfg.min_data_per_group
-    keyc = jnp.where(usable & (histf[:, 2] > 0),
-                     histf[:, 0] / (histf[:, 1] + cfg.cat_smooth), jnp.inf)
-    order_f = jnp.argsort(keyc)
-    n_usable = (usable & (histf[:, 2] > 0)).sum()
-    onehot = n_usable <= cfg.max_cat_to_onehot
+    order_f, _ = _cat_order_usable(histf, cfg)
+    nb_f = (jnp.int32(B) if cat_nbins is None else cat_nbins[fsel])
+    onehot = nb_f <= cfg.max_cat_to_onehot
     idx = jnp.arange(B)
     # one-vs-rest winners take ONLY the chosen sorted position left
     take = jnp.where(onehot, idx == bsel, idx <= bsel)
@@ -501,7 +525,7 @@ class _GrowState(NamedTuple):
 
 def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
                     monotone, nan_bins, cfg: GrowerConfig,
-                    axis_name: Optional[str], node_key=None):
+                    axis_name: Optional[str], node_key=None, cat_nbins=None):
     n, f = binned.shape
     L = cfg.num_leaves
     B = pad_bins(cfg.num_bins)
@@ -540,9 +564,11 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
         return _maybe_psum(hist, axis_name)
 
     nmask = _node_mask_fn(cfg, featp, f, node_key)
+    catb = _pad_cat_nbins(cat_nbins, f, FP, B)
 
     def best_of(hist_leaf, fmask):
-        return _best_for_leaf(hist_leaf, fmask, catp, monop, nanp, cfg, l1, l2)
+        return _best_for_leaf(hist_leaf, fmask, catp, monop, nanp, cfg, l1,
+                              l2, catb)
 
     # ---- root ------------------------------------------------------------
     hist_root = build_hist(bT0, gs0, hs0, ms0, jnp.int32(0), jnp.int32(Np))
@@ -599,7 +625,7 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
             totals = hist_parent[0].sum(axis=0)
             G_l, H_l, C_l = totals[0], totals[1], totals[2]
             bitset, cat_split = _winning_cat_bitset(hist_parent, fsel, bsel,
-                                                    catp, cfg, B, bw)
+                                                    catp, cfg, B, bw, catb)
 
             pos2, gs2, hs2, ms2, bT2, nl_loc = partition(
                 s.pos, s.gs, s.hs, s.ms, s.bT, start, length, fsel, bsel, dl,
@@ -688,7 +714,7 @@ class _MaskedState(NamedTuple):
 def _grow_tree_impl_masked(binned, grad, hess, in_bag, feature_active,
                            is_categorical, monotone, nan_bins,
                            cfg: GrowerConfig, axis_name: Optional[str],
-                           node_key=None):
+                           node_key=None, cat_nbins=None):
     """Masked-row grower: rows never move. Each split routes leaf ``l``'s rows
     by updating a per-row ``node`` array and histograms the smaller child with
     the child-membership mask multiplied into the kernel's (g, h, count)
@@ -715,9 +741,11 @@ def _grow_tree_impl_masked(binned, grad, hess, in_bag, feature_active,
         return _maybe_psum(hist, axis_name)
 
     nmask = _node_mask_fn(cfg, featp, f, node_key)
+    catb = _pad_cat_nbins(cat_nbins, f, FP, B)
 
     def best_of(hist_leaf, fmask):
-        return _best_for_leaf(hist_leaf, fmask, catp, monop, nanp, cfg, l1, l2)
+        return _best_for_leaf(hist_leaf, fmask, catp, monop, nanp, cfg, l1,
+                              l2, catb)
 
     hist_root = build_hist_masked(jnp.ones(Np, jnp.float32))
     rg, rf, rb, rdl, rcl, _ = best_of(hist_root, nmask(jnp.int32(2 * (L - 1))))
@@ -736,7 +764,7 @@ def _grow_tree_impl_masked(binned, grad, hess, in_bag, feature_active,
             totals = hist_parent[0].sum(axis=0)
             G_l, H_l, C_l = totals[0], totals[1], totals[2]
             bitset, cat_split = _winning_cat_bitset(hist_parent, fsel, bsel,
-                                                    catp, cfg, B, bw)
+                                                    catp, cfg, B, bw, catb)
 
             # route leaf l's rows: right-goers move to leaf id num_splits+1
             binrow = lax.dynamic_slice(bT0, (fsel, 0), (1, Np))[0]
@@ -788,6 +816,7 @@ def grow_tree(
     nan_bins: Optional[jnp.ndarray] = None,  # (F,) i32 NaN bin per feature
     axis_name: Optional[str] = None,         # shard_map data axis for psum
     node_key=None,                           # raw key data (feature_fraction_bynode)
+    cat_nbins=None,                          # (F,) static per-feature category counts
 ) -> tuple:
     """Grow one tree; returns (TreeArrays, node_of_row) where node_of_row is
     each row's final leaf index (used for the O(1) training-score update)."""
@@ -797,13 +826,14 @@ def grow_tree(
     if cfg.row_layout == "masked":
         return _grow_tree_impl_masked(binned, grad, hess, in_bag,
                                       feature_active, is_categorical, monotone,
-                                      nan_bins, cfg, axis_name, node_key)
+                                      nan_bins, cfg, axis_name, node_key,
+                                      cat_nbins)
     if cfg.row_layout != "partition":
         raise ValueError(
             f"row_layout must be 'partition' or 'masked', got {cfg.row_layout!r}")
     return _grow_tree_impl(binned, grad, hess, in_bag, feature_active,
                            is_categorical, monotone, nan_bins, cfg, axis_name,
-                           node_key)
+                           node_key, cat_nbins)
 
 
 # ---------------------------------------------------------------------------

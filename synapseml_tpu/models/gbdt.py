@@ -127,8 +127,19 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
     fobj = Param("fobj", "Custom objective: fn(score, label, weight) -> "
                  "(grad, hess) arrays (the reference's FObjTrait/FObjParam)",
                  is_complex=True)
+    referenceDataset = Param("referenceDataset", "Precomputed BinMapper (or "
+                             "gbdt.Dataset) reused for binning — the "
+                             "reference-dataset broadcast analog",
+                             is_complex=True)
     useMissing = Param("useMissing", "Handle missing values specially", bool, True)
     zeroAsMissing = Param("zeroAsMissing", "Treat zero as missing", bool, False)
+
+    def _reference_mapper(self):
+        """referenceDataset param → BinMapper (accepts a Dataset too)."""
+        ref = self.get("referenceDataset")
+        if ref is None:
+            return None
+        return getattr(ref, "mapper", ref)
 
     def _base_config(self, **overrides) -> BoosterConfig:
         mc = self.get("monotoneConstraints")
@@ -308,6 +319,9 @@ class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPr
 
     objective = Param("objective", "binary or multiclass", str, "binary")
     isUnbalance = Param("isUnbalance", "Adjust for unbalanced binary labels", bool, False)
+    maxNumClasses = Param("maxNumClasses", "Upper bound on auto-detected "
+                          "label classes (guards runaway continuous labels)",
+                          int, 100)
     scalePosWeight = Param("scalePosWeight", "Positive-class weight multiplier", float, 1.0)
     thresholds = Param("thresholds", "Per-class prediction thresholds", list)
 
@@ -320,6 +334,12 @@ class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPr
         num_class = len(classes)
         if num_class < 2:
             raise ValueError(f"need at least 2 label classes, got {classes}")
+        if num_class > self.getMaxNumClasses():
+            raise ValueError(
+                f"detected {num_class} label classes, above maxNumClasses="
+                f"{self.getMaxNumClasses()} — a continuous label column was "
+                "likely passed to the classifier (raise maxNumClasses if "
+                "this cardinality is intended)")
         y = y_idx.astype(np.float32)
         objective = self.getObjective()
         if objective == "binary" and num_class > 2:
@@ -368,12 +388,15 @@ class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPr
                                     init_score=None if init is None else init[part],
                                     categorical_features=cats, valid=valid,
                                     feature_names=self.get("slotNames"), init_model=bst,
-                                    fobj=self.get("fobj"), measures=measures)
+                                    fobj=self.get("fobj"),
+                                    mapper=self._reference_mapper(),
+                                    measures=measures)
         else:
             bst = train_booster(X, y, cfg, sample_weight=w, init_score=init,
                                 categorical_features=cats, valid=valid,
                                 feature_names=self.get("slotNames"),
                                 init_model=init_model, fobj=self.get("fobj"),
+                                mapper=self._reference_mapper(),
                                 measures=measures)
         self._log_base("trainingMeasures", measures.report())
         return bst
@@ -500,7 +523,8 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
         booster = train_booster(X, y, cfg, sample_weight=w, init_score=init,
                                 categorical_features=cats, group_sizes=sizes,
                                 valid=valid, feature_names=self.get("slotNames"),
-                                fobj=self.get("fobj"))
+                                fobj=self.get("fobj"),
+                                mapper=self._reference_mapper())
         model = LightGBMRankerModel(booster)
         self._copy_model_params(model)
         return model

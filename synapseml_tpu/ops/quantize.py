@@ -38,6 +38,8 @@ class BinMapper(NamedTuple):
     is_categorical: np.ndarray  # (F,) bool
     max_bin: int
     has_nan: np.ndarray = None  # (F,) bool — feature has a dedicated NaN bin
+    cat_counts: np.ndarray = None  # (F,) int32 — DISTINCT categories observed
+                                   # (sparse id encodings differ from num_bins)
 
     @property
     def num_features(self) -> int:
@@ -96,6 +98,7 @@ def compute_bin_mapper(
 
     bounds = np.full((f, max_bin - 1), np.inf, dtype=np.float32)
     nbins = np.zeros(f, dtype=np.int32)
+    cat_counts = np.zeros(f, dtype=np.int32)
     caps = np.full(f, max_bin, np.int64)
     if max_bin_by_feature is not None:
         mb = np.asarray(max_bin_by_feature, np.int64)
@@ -109,6 +112,7 @@ def compute_bin_mapper(
             # categories are small non-negative ints; identity binning capped at max_bin
             hi = int(col.max()) if col.size else 0
             nbins[j] = min(hi + 1, int(caps[j]) - 1) + 1  # +1 overflow bin
+            cat_counts[j] = len(np.unique(col)) if col.size else 0
             continue
         uniq = np.unique(col)
         if uniq.size <= 1:
@@ -144,7 +148,7 @@ def compute_bin_mapper(
         # dedicated NaN bin when the feature has missing values
         nbins[j] = b.size + 2 + int(has_nan[j])
     return BinMapper(boundaries=bounds, num_bins=nbins, is_categorical=cat,
-                     max_bin=max_bin, has_nan=has_nan)
+                     max_bin=max_bin, has_nan=has_nan, cat_counts=cat_counts)
 
 
 @partial(jax.jit, static_argnames=("out_dtype",))

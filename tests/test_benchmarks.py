@@ -85,8 +85,11 @@ class TestGBDTBenchmarks:
         tr = df.slice(0, int(n * 0.6))
         te = df.slice(int(n * 0.6), n)
         for boosting in ("gbdt", "goss", "dart", "rf"):
+            # minDataPerGroup sized to the 460-row train split (~77 rows per
+            # category): the native default of 100 would disable categorical
+            # splits entirely, and this benchmark exists to guard them
             kw = {"boostingType": boosting, "numIterations": 40,
-                  "categoricalSlotIndexes": [7]}
+                  "categoricalSlotIndexes": [7], "minDataPerGroup": 25}
             if boosting == "rf":
                 kw.update(baggingFraction=0.8, baggingFreq=1,
                           featureFraction=0.8)

@@ -230,3 +230,22 @@ def test_custom_fobj_param(binary_data):
                            fobj=logistic_fobj).fit(t)
     acc = (np.asarray(m.transform(t)["prediction"]) == ytr).mean()
     assert acc > 0.9, acc
+
+
+def test_max_num_classes_and_reference_dataset(binary_data):
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.models import LightGBMClassifier
+    from synapseml_tpu.ops.quantize import compute_bin_mapper
+
+    Xtr, _, ytr, _ = binary_data
+    t_cont = Table({"features": list(Xtr.astype(np.float32)),
+                    "label": Xtr[:, 0].astype(np.float32)})  # continuous!
+    with pytest.raises(ValueError, match="maxNumClasses"):
+        LightGBMClassifier(numIterations=2).fit(t_cont)
+
+    # referenceDataset: training binning reuses the supplied mapper
+    mapper = compute_bin_mapper(Xtr.astype(np.float32), 255, 200_000)
+    t = Table({"features": list(Xtr.astype(np.float32)), "label": ytr})
+    m = LightGBMClassifier(numIterations=3,
+                           referenceDataset=mapper).fit(t)
+    assert m.booster.mapper is mapper

@@ -127,6 +127,14 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
     fobj = Param("fobj", "Custom objective: fn(score, label, weight) -> "
                  "(grad, hess) arrays (the reference's FObjTrait/FObjParam)",
                  is_complex=True)
+    samplingSubsetSize = Param("samplingSubsetSize", "Boundary-sample size "
+                               "when subset sampling; 0 defers to "
+                               "binSampleCount", int, 0)
+    repartitionByGroupingColumn = Param("repartitionByGroupingColumn",
+                                        "Kept for API parity: rows are "
+                                        "group-contiguous by construction "
+                                        "here (no partitions to repartition)",
+                                        bool, True)
     referenceDataset = Param("referenceDataset", "Precomputed BinMapper (or "
                              "gbdt.Dataset) reused for binning — the "
                              "reference-dataset broadcast analog",
@@ -173,7 +181,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             monotone_constraints=mc,
             seed=self.getSeed(),
             boost_from_average=self.getBoostFromAverage(),
-            bin_sample_count=self.getBinSampleCount(),
+            bin_sample_count=(self.getSamplingSubsetSize()
+                              or self.getBinSampleCount()),
             cat_smooth=self.getCatSmooth(),
             cat_l2=self.getCatl2(),
             min_data_in_bin=self.getMinDataPerBin(),

@@ -75,9 +75,19 @@ def bench_gbdt():
     # sampled — each guarded so a failing/slow alternate can neither kill
     # the primary metric nor blow the time budget. "value" is the best of
     # the shipped configs that succeeded; "variant"/"variants" record which.
-    variants = [("partition_sort", {}),
-                ("partition_scan", {"partition_impl": "scan"}),
-                ("masked", {"row_layout": "masked"})]
+    all_variants = {
+        "partition_sort": {"partition_impl": "sort", "row_layout": "partition"},
+        "partition_scan": {"partition_impl": "scan", "row_layout": "partition"},
+        "masked": {"partition_impl": "sort", "row_layout": "masked"},
+    }
+    _d = BoosterConfig()
+    default_name = next(
+        (nm for nm, kw in all_variants.items()
+         if all(getattr(_d, k) == v for k, v in kw.items())),
+        "partition_sort")
+    # default config FIRST (guaranteed to report), alternates sampled after
+    variants = [(default_name, all_variants[default_name])] + [
+        (nm, kw) for nm, kw in all_variants.items() if nm != default_name]
     sweep_budget = float(os.environ.get("BENCH_GBDT_SWEEP_BUDGET_S", 600))
     t_sweep = time.perf_counter()
     results, errors = {}, {}
@@ -107,6 +117,13 @@ def bench_gbdt():
            "vs_baseline": round(v / BASELINE_GBDT_ROW_ITERS, 3),
            "variant": best,
            "variants": {k: round(r, 1) for k, r in results.items()}}
+    # the DEFAULT config's number is reported alongside the best: best-of-N
+    # is a capability claim, but a regressing default must stay visible
+    out["default_variant"] = default_name
+    if default_name in results:
+        out["value_default"] = round(results[default_name], 1)
+        out["vs_baseline_default"] = round(
+            results[default_name] / BASELINE_GBDT_ROW_ITERS, 3)
     if errors:
         out["variant_errors"] = errors
     return out
@@ -388,28 +405,218 @@ def bench_serving(n_requests=200):
         server.stop()
 
 
+MEASUREMENTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "docs", "measurements.json")
+
+
+def record_measurement(entry: dict, path: str = None):
+    """Append a successful measurement to the committed on-chip measurement
+    log (docs/measurements.json) with a capture timestamp and platform tag —
+    so numbers taken during brief TPU-terminal windows survive as artifacts
+    instead of living only in markdown (VERDICT r2 'what's missing' #4)."""
+    import datetime
+
+    path = path or MEASUREMENTS_PATH
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "unknown"
+    rec = dict(entry)
+    rec["captured_at"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    rec["platform"] = platform
+    try:
+        log = []
+        if os.path.exists(path):
+            with open(path) as f:
+                log = json.load(f)
+        log.append(rec)
+        with open(path, "w") as f:
+            json.dump(log, f, indent=1)
+    except Exception as e:  # recording must never sink a measurement
+        print(f"# measurement log write failed: {e}", file=sys.stderr)
+
+
+def _probe_device_once(timeout_s: float) -> bool:
+    """One SHORT device-init probe in a THROWAWAY subprocess: when the axon
+    tunnel is half-open, the hung connection attempt never recovers inside
+    the hung process — but a fresh process may connect fine. Returns True
+    when the child saw a device inside the window."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import os, faulthandler\n"
+             "faulthandler.dump_traceback_later("
+             f"{max(timeout_s - 5, 5):.0f}, exit=True)\n"
+             "import jax\n"
+             # this jax build's axon hook ignores the JAX_PLATFORMS env var:
+             # honor a requested platform via the config API (else the child
+             # probes the default backend — the TPU — which is the point)
+             "p = os.environ.get('JAX_PLATFORMS')\n"
+             "if p: jax.config.update('jax_platforms', p.split(',')[0])\n"
+             "print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True)
+        return r.returncode == 0 and bool(r.stdout.strip())
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def bench_sparse_ingest(rows=1_000_000, cols=200, density=0.01):
+    """Sparse CSR → device-resident binned Dataset ingest throughput
+    (VERDICT r2 #7: the dense-detour path wiped out CSR's memory advantage;
+    the device scatter path ships O(nnz) bytes). Baseline: LightGBM's own
+    CSR dataset construction is IO-bound on the same accounting — report
+    rows/s with the dense-equivalent rows/s alongside."""
+    import jax
+    import scipy.sparse as sp
+
+    from synapseml_tpu.gbdt import Dataset
+
+    rng = np.random.default_rng(0)
+    nnz = int(rows * cols * density)
+    r = rng.integers(0, rows, size=nnz)
+    c = rng.integers(0, cols, size=nnz)
+    v = rng.normal(size=nnz).astype(np.float32)
+    X = sp.csr_matrix((v, (r, c)), shape=(rows, cols))
+    y = rng.integers(0, 2, size=rows).astype(np.float32)
+    t0 = time.perf_counter()
+    ds = Dataset(X, y, keep_raw=False).block_until_ready()
+    dt = time.perf_counter() - t0
+    del ds
+    rps = rows / dt
+    return {"metric": "sparse_ingest_rows_per_sec",
+            "value": round(rps, 1),
+            "unit": f"rows/sec ({cols} cols, {density:.0%} density, "
+                    f"nnz={X.nnz})",
+            # vs the 4e6-row-iters GBDT accounting this is a staging metric;
+            # report the ratio to a 1M-rows/s dense-staging reference
+            "vs_baseline": round(rps / 1.0e6, 3)}
+
+
+def bench_serving_distributed(n_requests=200):
+    """Multi-worker serving path: 2 per-process-style workers + gateway
+    (io/distributed_serving.py; DistributedHTTPSource.scala:203-312 analog).
+    Measures the end-to-end client → gateway → worker → reply latency — the
+    forwarding hop the reference stubs (InternalHandler NotImplementedError)
+    priced against the head-node number from bench_serving."""
+    import json as _json
+
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.io import ServingGateway, ServingServer
+
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu = None
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(8,)), jnp.float32)
+    if cpu is not None:
+        w = jax.device_put(w, cpu)
+
+    @jax.jit
+    def pipeline(x):
+        return jnp.tanh(x @ w)
+
+    def handler(df: Table) -> Table:
+        x = np.asarray([v["x"] for v in df["value"]], np.float32)
+        if cpu is not None:
+            x = jax.device_put(x, cpu)
+        out = np.asarray(pipeline(x))
+        return Table({"id": df["id"], "reply": out.astype(np.float64)})
+
+    workers = [ServingServer(handler, host="127.0.0.1", port=0,
+                             max_batch_size=32,
+                             max_batch_latency=0.0).start()
+               for _ in range(2)]
+    gw = ServingGateway([s.url for s in workers], port=0,
+                        mode="least_loaded").start()
+    try:
+        import http.client
+
+        payload = _json.dumps({"x": [0.1] * 8}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=5)
+
+        def one():
+            conn.request("POST", gw.api_path, body=payload,
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            body = r.read()
+            if r.status != 200:
+                raise RuntimeError(f"gateway error {r.status}: {body[:120]!r}")
+
+        for _ in range(20):
+            one()
+        lat = []
+        for _ in range(n_requests):
+            t0 = time.perf_counter()
+            one()
+            lat.append((time.perf_counter() - t0) * 1e3)
+        conn.close()
+        lat = np.sort(np.asarray(lat))
+        p50 = float(lat[len(lat) // 2])
+        p99 = float(lat[int(len(lat) * 0.99)])
+        forwarded = gw.stats["forwarded"]
+        return {"metric": "serving_distributed_latency_p50_ms",
+                "value": round(p50, 3),
+                "unit": "ms (p99=%.3f; 2 workers; %d forwards)" % (
+                    p99, forwarded),
+                "vs_baseline": round(BASELINE_SERVING_P50_MS / max(p50, 1e-9),
+                                     3)}
+    finally:
+        gw.stop()
+        for s in workers:
+            s.stop()
+
+
 def _init_device_with_watchdog(timeout_s: float):
-    """jax backend init can hang indefinitely when the TPU terminal is down
-    (observed: axon init stuck for hours). A watchdog emits the contract's
-    JSON line with an error field and force-exits instead of hanging into
-    the caller's timeout."""
+    """Bounded device init that survives a flaky TPU terminal: short
+    subprocess probes retry until one connects (a fresh process can succeed
+    where a hung one can't), then the real in-process init runs under a
+    watchdog that emits the contract's JSON error line and force-exits
+    instead of hanging into the driver's timeout."""
     import threading
+    import time as _time
+
+    probe_s = float(os.environ.get("BENCH_INIT_PROBE_S", 120))
+    deadline = _time.monotonic() + timeout_s
+
+    def fail(why: str):
+        print(json.dumps({
+            "metric": "gbdt_train_row_iters_per_sec_per_chip",
+            "value": 0.0, "unit": "row-iterations/sec/chip",
+            "vs_baseline": 0.0, "error": why}), flush=True)
+        os._exit(3)
+
+    attempt = 0
+    while True:
+        attempt += 1
+        left = deadline - _time.monotonic()
+        if left <= 10:
+            fail(f"device backend init exceeded {timeout_s:.0f}s after "
+                 f"{attempt - 1} probes (TPU terminal unavailable)")
+        if _probe_device_once(min(probe_s, left)):
+            break
 
     done = threading.Event()
 
     def watchdog():
-        if not done.wait(timeout_s):
-            print(json.dumps({
-                "metric": "gbdt_train_row_iters_per_sec_per_chip",
-                "value": 0.0, "unit": "row-iterations/sec/chip",
-                "vs_baseline": 0.0,
-                "error": f"device backend init exceeded {timeout_s:.0f}s "
-                         "(TPU terminal unavailable)"}), flush=True)
-            os._exit(3)
+        left = max(deadline - _time.monotonic(), 30)
+        if not done.wait(left):
+            fail("in-process device init hung after a successful probe "
+                 f"({attempt} probes, {timeout_s:.0f}s budget)")
 
     threading.Thread(target=watchdog, daemon=True).start()
     import jax
 
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:  # the env var alone is ignored by this build's axon hook
+        jax.config.update("jax_platforms", plat.split(",")[0])
     jax.devices()
     done.set()
 
@@ -424,6 +631,7 @@ def main():
 
     enable_compile_cache()
     primary = bench_gbdt()
+    record_measurement(primary)
     extras = []
     budget_s = 1e9 if run_all else float(os.environ.get("BENCH_BUDGET_S", 900))
     t_start = time.perf_counter()
@@ -432,11 +640,14 @@ def main():
     bench_onnx_bf16.__name__ = "bench_onnx_inference_bf16"
     for fn in (bench_resnet50_train, bench_bert_finetune,
                bench_onnx_inference, bench_onnx_bf16, bench_onnx_bert,
-               bench_serving):
+               bench_serving, bench_serving_distributed,
+               bench_sparse_ingest):
         if time.perf_counter() - t_start > budget_s:
             break
         try:
-            extras.append(fn())
+            r = fn()
+            record_measurement(r)
+            extras.append(r)
         except Exception as e:  # extras must never break the primary line
             extras.append({"metric": fn.__name__, "error": str(e)[:200]})
     out = dict(primary)

@@ -14,6 +14,26 @@ make -C synapseml_tpu/native
 echo "== docs site (tools/docgen, website analog) =="
 python tools/docgen/docgen.py > /dev/null
 
+echo "== helm chart render check (tools/helm analog) =="
+python tools/helm/render.py > /dev/null
+python tools/helm/render.py --set workers.replicas=4 --release ci-check > /dev/null
+
+echo "== wheel publish dry-run =="
+rm -rf build/ci_wheel && pip wheel --no-deps --no-build-isolation -q \
+    -w build/ci_wheel . 2> /dev/null || python setup.py -q bdist_wheel -d build/ci_wheel
+python - << 'EOF'
+# twine-check analog: the wheel must carry METADATA, the package, and the
+# native library; a publish would ship exactly this file
+import glob, sys, zipfile
+whl = glob.glob("build/ci_wheel/*.whl")
+assert whl, "no wheel produced"
+names = zipfile.ZipFile(whl[0]).namelist()
+assert any(n.endswith("METADATA") for n in names), "wheel missing METADATA"
+assert any(n.startswith("synapseml_tpu/") for n in names), "package missing"
+assert any(n.endswith(".so") for n in names), "native lib missing from wheel"
+print(f"wheel ok: {whl[0]} ({len(names)} files)")
+EOF
+
 echo "== unit tests (8-device CPU mesh) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -m pytest tests/ -x -q

@@ -156,7 +156,11 @@ class Params(metaclass=_ParamsMeta):
             return self._paramMap[name]
         p = self._params.get(name)
         if p is not None and p.default is not None:
-            return p.default
+            d = p.default
+            # mutable defaults are shared class-level objects: hand out a
+            # copy so user mutation can't silently rewrite every instance
+            return (list(d) if isinstance(d, list)
+                    else dict(d) if isinstance(d, dict) else d)
         if p is None:
             raise KeyError(f"{type(self).__name__} has no param {name!r}")
         return p.default if default is None else default

@@ -103,6 +103,12 @@ class BoosterConfig:
     # lambdarank
     lambdarank_truncation_level: int = 30
     max_position: int = 30
+    # NDCG eval positions (LightGBMRankerParams evalAt, default 1-5 at the
+    # estimator layer): when set, the FIRST position drives validation/early
+    # stopping, matching the reference (maxPosition truncates the lambdarank
+    # objective via lambdarank_truncation_level, not the eval metric). Empty
+    # = legacy engine-level behavior: evaluate at max_position.
+    eval_at: tuple = ()
 
     def grower(self, has_categorical: bool = False) -> GrowerConfig:
         lr = 1.0 if self.boosting_type == "rf" else self.learning_rate
@@ -138,7 +144,8 @@ class Booster:
                  trees: List[TreeArrays], tree_weights: List[float],
                  base_score: np.ndarray, feature_names: Optional[List[str]] = None,
                  best_iteration: int = -1,
-                 thresholds: Optional[List[np.ndarray]] = None):
+                 thresholds: Optional[List[np.ndarray]] = None,
+                 missing_types: Optional[List[np.ndarray]] = None):
         self.mapper = mapper
         self.config = config
         self.trees = trees
@@ -149,6 +156,10 @@ class Booster:
         # real-valued thresholds per tree; None → resolve from the bin mapper.
         # Loaded native models carry raw thresholds directly (no mapper).
         self.thresholds = thresholds
+        # per-split LightGBM missing-type codes (0 none / 1 zero / 2 nan);
+        # loaded native models parse them from decision_type, trained models
+        # derive them from the mapper's NaN mask (_missing_types)
+        self.missing_types = missing_types
         self._forest_cache: Optional[Forest] = None
         self._depth_cache: Optional[int] = None
 
@@ -176,8 +187,13 @@ class Booster:
         return max(len(self.trees) // self.models_per_iter, 1)
 
     def _thresholds(self, index: int) -> np.ndarray:
+        # per-entry None = resolve from the mapper (warm starts merge loaded
+        # trees' parsed thresholds with None slots for newly grown trees)
         if self.thresholds is not None:
-            return np.asarray(self.thresholds[index], np.float32)
+            t = (self.thresholds[index]
+                 if index < len(self.thresholds) else None)
+            if t is not None:
+                return np.asarray(t, np.float32)
         tree = self.trees[index]
         sf = np.asarray(tree.split_feature)
         sb = np.asarray(tree.split_bin)
@@ -191,6 +207,21 @@ class Booster:
         return np.where(vals >= f32max, np.inf,
                         np.clip(vals, -f32max, f32max)).astype(np.float32)
 
+    def _missing_types(self, index: int) -> np.ndarray:
+        """(L-1,) missing-type codes for one tree: parsed values for loaded
+        models, else nan (2) for features with a NaN bin / 0 otherwise —
+        exactly what the model-string writer emits in decision_type."""
+        if self.missing_types is not None:
+            m = (self.missing_types[index]
+                 if index < len(self.missing_types) else None)
+            if m is not None:
+                return np.asarray(m, np.int32)
+        tree = self.trees[index]
+        sf = np.asarray(tree.split_feature).astype(np.int64)
+        has_nan = np.asarray(self.mapper.nan_mask)
+        sf_safe = np.clip(sf, 0, len(has_nan) - 1)
+        return np.where(has_nan[sf_safe], 2, 0).astype(np.int32)
+
     def forest(self) -> Forest:
         if self._forest_cache is None or self._forest_cache.num_trees != len(self.trees):
             trees = self.trees
@@ -200,7 +231,8 @@ class Booster:
             weighted = [t._replace(leaf_value=jnp.asarray(t.leaf_value) * w)
                         for t, w in zip(trees, weights)]
             self._forest_cache = stack_trees(
-                weighted, [self._thresholds(i) for i in range(len(trees))])
+                weighted, [self._thresholds(i) for i in range(len(trees))],
+                [self._missing_types(i) for i in range(len(trees))])
             self._depth_cache = forest_max_depth(trees)
         return self._forest_cache
 
@@ -426,6 +458,10 @@ def _fused_static_key(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
             cfg.bagging_fraction, cfg.bagging_freq, cfg.feature_fraction,
             cfg.pos_bagging_fraction, cfg.neg_bagging_fraction,
             cfg.lambdarank_truncation_level, mono, grower_cfg,
+            # seeds are folded into the traced program as Python ints
+            # (_sample_rows_impl/_sample_features_impl): two configs that
+            # differ only here must NOT share an executable
+            cfg.extra_seed, cfg.feature_fraction_seed,
             n, nfeat, k, nv, metric_name, mesh)
 
 
@@ -693,11 +729,24 @@ def train_booster(
             local_nan = np.ascontiguousarray(np.isnan(X).any(axis=0)[None])
             has_nan_g = np.asarray(multihost_utils.process_allgather(
                 local_nan)).reshape(-1, X.shape[1]).any(axis=0)
+            # categorical bin occupancy over the FULL global matrix: local
+            # presence bitmaps OR-reduced across processes (maxCatToOnehot
+            # must not depend on which rows the boundary sample drew)
+            cat_presence_g = None
+            if categorical_features:
+                from ..ops.quantize import cat_presence_bitmap
+
+                pres_l = np.zeros((X.shape[1], cfg.max_bin), np.uint8)
+                for cj in categorical_features:
+                    pres_l[cj] = cat_presence_bitmap(X[:, cj], cfg.max_bin)
+                cat_presence_g = np.asarray(multihost_utils.process_allgather(
+                    pres_l[None])).reshape(-1, X.shape[1], cfg.max_bin).any(0)
             mapper = compute_bin_mapper(
                 X_samp, cfg.max_bin, cfg.bin_sample_count,
                 categorical_features, cfg.seed, has_nan=has_nan_g,
                 min_data_in_bin=cfg.min_data_in_bin,
-                max_bin_by_feature=cfg.max_bin_by_feature)
+                max_bin_by_feature=cfg.max_bin_by_feature,
+                cat_presence=cat_presence_g)
         else:
             bnd, nb_, cat_, hn_ = multihost_utils.broadcast_one_to_all(
                 (mapper.boundaries, np.asarray(mapper.num_bins),
@@ -830,11 +879,21 @@ def train_booster(
     tree_weights: List[float] = []
     # dart only: per-tree train contribution, stored as (class, (N,) values)
     tree_contribs: List[tuple] = []
+    # warm start: the continued model bins against a NEW mapper, so the init
+    # trees' real-valued thresholds / missing codes must be resolved against
+    # the INIT model's own mapper (or its parsed values) and carried verbatim;
+    # newly grown trees get None slots (= resolve from the training mapper)
+    init_thresholds: Optional[List] = None
+    init_mtypes: Optional[List] = None
     if init_model is not None:
         trees = list(init_model.trees)
         tree_weights = list(init_model.tree_weights)
         base = init_model.base_score
         prior_k = init_model.models_per_iter
+        init_thresholds = [init_model._thresholds(i)
+                           for i in range(len(trees))]
+        init_mtypes = [init_model._missing_types(i)
+                       for i in range(len(trees))]
         score = jnp.asarray(
             init_model.raw_score(X, start_iteration=0).reshape(n, k),
             jnp.float32)
@@ -850,14 +909,20 @@ def train_booster(
             # weights divided back out
             from .grower import forest_predict as _fp
 
+            # thresholds/missing_types must ride along: a from_model_string
+            # init_model has a synthetic all-inf mapper, so dropping its
+            # parsed thresholds would send every row left
             unweighted = Booster(init_model.mapper, init_model.config,
                                  init_model.trees, [1.0] * len(init_model.trees),
-                                 np.zeros_like(init_model.base_score))
+                                 np.zeros_like(init_model.base_score),
+                                 thresholds=init_model.thresholds,
+                                 missing_types=init_model.missing_types)
             uf = unweighted.forest()
             per_tree = np.asarray(_fp(uf, jnp.asarray(X), output="per_tree",
                                       depth=unweighted._depth_cache))  # (N, T)
             for ti in range(per_tree.shape[1]):
                 tree_contribs.append((ti % prior_k, per_tree[:, ti].astype(np.float32)))
+    n_init_trees = len(trees)
 
     grower_cfg = cfg.grower(has_categorical=bool(mapper.is_categorical.any()))
     _wrap = np.asarray if multiproc else jnp.asarray
@@ -893,8 +958,12 @@ def train_booster(
         metric_name = cfg.metric or _default_metric(cfg.objective)
         if metric_name == "ndcg" or (cfg.metric is None
                                      and metric_name.startswith("ndcg")):
-            # maxPosition (LightGBMRankerParams) sets the NDCG eval position
-            metric_name = f"ndcg@{cfg.max_position}"
+            # evalAt (LightGBMRankerParams, default 1-5) sets the NDCG eval
+            # positions; early stopping tracks the FIRST position, matching
+            # the reference. Engine-level configs that never set eval_at keep
+            # the max_position behavior.
+            first_at = (cfg.eval_at[0] if cfg.eval_at else cfg.max_position)
+            metric_name = f"ndcg@{int(first_at)}"
         best_metric, best_iter = None, -1
         higher_better = metric_name.split("@")[0] in HIGHER_IS_BETTER
         # dart/rf: per-tree validation contributions (weights change later)
@@ -902,7 +971,9 @@ def train_booster(
         if init_model is not None and cfg.boosting_type in ("dart", "rf"):
             unw = Booster(init_model.mapper, init_model.config, init_model.trees,
                           [1.0] * len(init_model.trees),
-                          np.zeros_like(init_model.base_score))
+                          np.zeros_like(init_model.base_score),
+                          thresholds=init_model.thresholds,
+                          missing_types=init_model.missing_types)
             uf_v = unw.forest()
             pt_v = forest_predict(uf_v, jnp.asarray(Xv), output="per_tree",
                                   depth=unw._depth_cache)   # (Nv, T)
@@ -1190,7 +1261,8 @@ def train_booster(
             if improved:
                 best_metric, best_iter = mval, it
             if cfg.early_stopping_round > 0 and it - best_iter >= cfg.early_stopping_round:
-                cut = (best_iter + 1) * k
+                # best_iter counts NEW iterations: keep every warm-start tree
+                cut = n_init_trees + (best_iter + 1) * k
                 trees = trees[:cut]
                 tree_weights = tree_weights[:cut]
                 break
@@ -1202,8 +1274,18 @@ def train_booster(
     # single batched device→host transfer of the whole forest (the per-tree
     # pulls were VERDICT weak #7)
     trees = jax.device_get(trees)
+    merged_thr = merged_mt = None
+    if init_thresholds is not None:
+        # warm-start trees keep their origin-resolved thresholds/missing
+        # codes; new trees (None slots) resolve from this training's mapper
+        merged_thr = (init_thresholds
+                      + [None] * (len(trees) - len(init_thresholds)))[
+                          : len(trees)]
+        merged_mt = (init_mtypes
+                     + [None] * (len(trees) - len(init_mtypes)))[: len(trees)]
     return Booster(mapper, cfg, trees, tree_weights, base, feature_names,
-                   best_iteration=(best_iter if has_valid else -1))
+                   best_iteration=(best_iter if has_valid else -1),
+                   thresholds=merged_thr, missing_types=merged_mt)
 
 
 def _default_metric(objective: str) -> str:

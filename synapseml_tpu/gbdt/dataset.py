@@ -50,15 +50,44 @@ def bin_sparse(X_csr, mapper: BinMapper, max_bin: int,
         has_nan = np.zeros(f, bool)
         if nan_mask.any():
             has_nan[np.unique(X_csr.indices[nan_mask])] = True
+        # categorical bin occupancy likewise from the FULL matrix (explicit
+        # CSC entries per column + the implicit-zero bin), so the
+        # maxCatToOnehot decision can't flip with the sampling seed
+        cat_presence = None
+        if categorical_features:
+            from ..ops.quantize import cat_presence_bitmap
+
+            csc = X_csr.tocsc()
+            cat_presence = np.zeros((f, max_bin), bool)
+            for j in categorical_features:
+                vals = csc.data[csc.indptr[j]: csc.indptr[j + 1]]
+                cat_presence[j] = cat_presence_bitmap(vals, max_bin)
+                if vals.size < n:          # at least one implicit zero
+                    cat_presence[j, 0] = True
         mapper = compute_bin_mapper(sample, max_bin, bin_sample_count,
                                     categorical_features, seed,
                                     has_nan=has_nan,
                                     min_data_in_bin=min_data_in_bin,
-                                    max_bin_by_feature=max_bin_by_feature)
+                                    max_bin_by_feature=max_bin_by_feature,
+                                    cat_presence=cat_presence)
+    # Device-side sparse binning (VERDICT r2 #7): each chunk's binned matrix
+    # starts as a broadcast of the per-feature zero-bin, then ONLY the nnz
+    # entries' bins scatter in — O(F + nnz) work and O(nnz) host→device
+    # bytes per chunk instead of the dense detour's O(rows·F), preserving
+    # CSR's memory advantage through ingest. Chunk-local row ids come from
+    # indptr diffs (cheap host O(nnz)).
+    from ..ops.quantize import bin_csr_chunk
+
     chunks = []
+    indptr = X_csr.indptr
     for lo in range(0, n, chunk_rows):
-        dense = np.asarray(X_csr[lo:lo + chunk_rows].todense(), np.float32)
-        chunks.append(apply_bins(mapper, dense))
+        hi = min(lo + chunk_rows, n)
+        s, e = int(indptr[lo]), int(indptr[hi])
+        counts = np.diff(indptr[lo:hi + 1]).astype(np.int64)
+        rows_local = np.repeat(np.arange(hi - lo, dtype=np.int32),
+                               counts)
+        chunks.append(bin_csr_chunk(mapper, X_csr.data[s:e], rows_local,
+                                    X_csr.indices[s:e], hi - lo))
     return mapper, jnp.concatenate(chunks, axis=0)
 
 

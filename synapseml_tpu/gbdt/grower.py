@@ -50,10 +50,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.hist_kernel import child_histogram, features_padded, pad_bins
+from ..ops.hist_kernel import (DEFAULT_CHUNK, child_histogram,
+                               features_padded, pad_bins)
 
 BITS = 32  # bitset word width for categorical splits
-_CHUNK = 2048  # kernel row chunk; row counts padded to a multiple of this
+# kernel row chunk; row counts pad to a multiple of this so the Pallas grid
+# divides evenly (follows the SYNAPSEML_TPU_HIST_CHUNK tuning knob)
+_CHUNK = DEFAULT_CHUNK
 
 
 class GrowerConfig(NamedTuple):
@@ -856,6 +859,10 @@ class Forest(NamedTuple):
     left_child: jnp.ndarray      # (T, L-1)
     right_child: jnp.ndarray     # (T, L-1)
     leaf_value: jnp.ndarray      # (T, L)
+    # per-split missing handling (LightGBM decision_type bits 2-3):
+    # 0 none, 1 zero (|x|<=1e-35 routes default), 2 nan. Raw-value traversal
+    # only; binned traversal routes via nan_bins.
+    missing_type: jnp.ndarray = None  # (T, L-1) i32
 
     @property
     def num_trees(self) -> int:
@@ -867,7 +874,7 @@ class Forest(NamedTuple):
 
 
 def _descend(X, sf, thr, sbin, stype, dleft, bits, lc, rc, binned: bool,
-             depth: int, nan_bins=None):
+             depth: int, nan_bins=None, mtypes=None):
     """Vectorized pointer-chase for one tree; returns leaf index per row."""
     n = X.shape[0]
     node = jnp.zeros((n,), jnp.int32)
@@ -885,10 +892,29 @@ def _descend(X, sf, thr, sbin, stype, dleft, bits, lc, rc, binned: bool,
                 num_right = jnp.where(is_missing, ~dl, num_right)
             c = xb
         else:
+            # LightGBM Tree::NumericalDecision: NaN coerces to 0.0 unless
+            # missing_type is nan; zero missing routes |x| <= 1e-35 to the
+            # default side (kZeroThreshold)
             t = thr[nd]
-            is_missing = jnp.isnan(x)
-            num_right = jnp.where(is_missing, ~dl, ~(x <= t))
-            c = jnp.clip(jnp.nan_to_num(x, nan=-1.0), -1,
+            isnan_x = jnp.isnan(x)
+            if mtypes is None:
+                is_missing = isnan_x
+                x0 = x
+            else:
+                mt = mtypes[nd]
+                x0 = jnp.where(isnan_x & (mt != 2), 0.0, x)
+                is_missing = jnp.where(mt == 1, jnp.abs(x0) <= 1e-35,
+                                       (mt == 2) & isnan_x)
+            num_right = jnp.where(is_missing, ~dl, ~(x0 <= t))
+            # categorical NaN: member test on category 0 unless missing_type
+            # is nan, where NaN is never a member (LightGBM
+            # Tree::CategoricalDecision coerces int_fval to 0 for non-nan
+            # missing types)
+            if mtypes is None:
+                cat_nan = -1.0
+            else:
+                cat_nan = jnp.where(mtypes[nd] == 2, -1.0, 0.0)
+            c = jnp.clip(jnp.where(isnan_x, cat_nan, x), -1,
                          bits.shape[1] * BITS - 1).astype(jnp.int32)
         cw = jnp.maximum(c, 0)
         word = bits[nd, cw >> 5]
@@ -917,18 +943,24 @@ def forest_predict(forest: Forest, X: jnp.ndarray, binned: bool = False,
     L = forest.leaf_value.shape[1]
     depth = max(depth if depth is not None else L - 1, 1)
 
+    mts = forest.missing_type
+
     def one_tree(carry, t):
-        sf, thr, sbin, stype, dl, bits, lc, rc, lv = t
+        if mts is None:
+            (sf, thr, sbin, stype, dl, bits, lc, rc, lv), mt = t, None
+        else:
+            sf, thr, sbin, stype, dl, bits, lc, rc, lv, mt = t
         leaf = _descend(X, sf, thr, sbin, stype, dl, bits, lc, rc, binned,
-                        depth, nan_bins)
+                        depth, nan_bins, mt)
         val = lv[leaf]
         return carry, (leaf, val)
 
-    _, (leaves, vals) = jax.lax.scan(
-        one_tree, 0,
-        (forest.split_feature, forest.threshold, forest.split_bin,
-         forest.split_type, forest.default_left, forest.cat_bitset,
-         forest.left_child, forest.right_child, forest.leaf_value))
+    xs = (forest.split_feature, forest.threshold, forest.split_bin,
+          forest.split_type, forest.default_left, forest.cat_bitset,
+          forest.left_child, forest.right_child, forest.leaf_value)
+    if mts is not None:
+        xs = xs + (mts,)
+    _, (leaves, vals) = jax.lax.scan(one_tree, 0, xs)
     if output == "leaf":
         return leaves.T          # (N, T)
     if output == "per_tree":
@@ -961,9 +993,11 @@ def forest_max_depth(trees: list) -> int:
     return maxd
 
 
-def stack_trees(trees: list, thresholds: list) -> Forest:
+def stack_trees(trees: list, thresholds: list,
+                missing_types: Optional[list] = None) -> Forest:
     """Host-side: stack per-tree TreeArrays (+ real-valued thresholds resolved
-    from the BinMapper) into a Forest."""
+    from the BinMapper) into a Forest. ``missing_types`` is a per-tree list of
+    (L-1,) arrays of LightGBM missing-type codes (0 none / 1 zero / 2 nan)."""
     def cat(field):
         return jnp.stack([np.asarray(getattr(t, field)) for t in trees])
 
@@ -977,4 +1011,6 @@ def stack_trees(trees: list, thresholds: list) -> Forest:
         left_child=cat("left_child"),
         right_child=cat("right_child"),
         leaf_value=cat("leaf_value"),
+        missing_type=(None if missing_types is None else jnp.stack(
+            [np.asarray(m, np.int32) for m in missing_types])),
     )

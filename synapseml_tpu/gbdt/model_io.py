@@ -250,6 +250,7 @@ def booster_from_string(s: str):
             bounds = np.array(fields["cat_boundaries"].split(), dtype=np.int64)
             if len(bounds) > 1:
                 bw = max(bw, int(np.diff(bounds).max()))
+    mtypes_all = []
     for fields in parsed:
         nleaves = int(fields.get("num_leaves", 1))
         ns = nleaves - 1
@@ -277,6 +278,8 @@ def booster_from_string(s: str):
         icn = arr("internal_count", np.int32, max(L - 1, 1))
         stype = (dt & 1).astype(np.int32)
         dleft = ((dt >> 1) & 1).astype(bool)
+        # 0 none / 1 zero / 2 nan — drives the raw-traversal missing routing
+        mtypes_all.append(((dt >> 2) & 3).astype(np.int32))
 
         bitset = np.zeros((max(L - 1, 1), bw), np.uint32)
         if int(fields.get("num_cat", 0)) > 0:
@@ -306,7 +309,7 @@ def booster_from_string(s: str):
     return Booster(mapper, cfg, trees, [1.0] * len(trees),
                    np.zeros(max(num_class, 1)),
                    feature_names if feature_names else None,
-                   thresholds=thresholds)
+                   thresholds=thresholds, missing_types=mtypes_all)
 
 
 def _collect_thr(parsed, L):

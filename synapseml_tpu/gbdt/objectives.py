@@ -114,7 +114,11 @@ def _weighted_quantile(y, w, alpha):
                  0, y.shape[0] - 1)
     jn = jnp.clip(j + 1, 0, y.shape[0] - 1)
     frac = jnp.clip((r - before[j]) / jnp.maximum(ws[j], 1e-38), 0.0, 1.0)
-    nxt = jnp.where(frac > 0, ys[jn], ys[j])  # never touch the inf tail
+    # interpolate toward ys[jn] only when it is a real row: when the quantile
+    # lands inside the LAST positive-weight row's span (frac > 0 with jn on
+    # the zero-weight inf tail), the partner must collapse to ys[j] or the
+    # init score becomes inf and poisons training
+    nxt = jnp.where(jnp.isfinite(ys[jn]) & (frac > 0), ys[jn], ys[j])
     return ys[j] + frac * (nxt - ys[j])
 
 

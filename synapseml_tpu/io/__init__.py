@@ -13,6 +13,7 @@ micro-batches through a fitted pipeline.
 from .http import (CustomInputParser, CustomOutputParser, HTTPRequestData,
                    HTTPResponseData, HTTPTransformer, JSONInputParser,
                    JSONOutputParser, SimpleHTTPTransformer, StringOutputParser)
+from .distributed_serving import DistributedServingServer, ServingGateway
 from .serving import ServingServer, request_to_table, respond_with
 from .binary import read_binary_files, read_image_dir
 from .powerbi import PowerBIWriter
@@ -21,6 +22,7 @@ __all__ = [
     "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
     "SimpleHTTPTransformer", "JSONInputParser", "CustomInputParser",
     "JSONOutputParser", "StringOutputParser", "CustomOutputParser",
-    "ServingServer", "request_to_table", "respond_with",
+    "ServingServer", "ServingGateway", "DistributedServingServer",
+    "request_to_table", "respond_with",
     "read_binary_files", "read_image_dir", "PowerBIWriter",
 ]

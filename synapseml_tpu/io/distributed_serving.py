@@ -1,0 +1,358 @@
+"""Distributed serving — one embedded server per mesh process, plus a
+load-balancing gateway with cross-process request forwarding.
+
+Reference: DistributedHTTPSource (core/.../streaming/DistributedHTTPSource.scala:
+203-312) runs a ``JVMSharedServer`` inside EVERY executor JVM and a
+``WorkerServer`` per partition (continuous/HTTPSourceV2.scala:485-713) with a
+request queue, a reply-by-id routing table, and crashed-partition request
+rehydration. Notably the reference's own cross-machine forwarding
+(``InternalHandler``, ``replyTo`` for a non-local machine) is
+``NotImplementedError`` — traffic distribution is left to an external load
+balancer. Here the same worker-per-process architecture is kept (each process
+on the mesh embeds a :class:`~synapseml_tpu.io.serving.ServingServer` running
+the SAME jitted pipeline on its local shard of capacity), and the internal
+routing layer is actually implemented: a :class:`ServingGateway` pools
+keep-alive connections to every worker, picks the least-loaded one per
+request, relays the reply to the caller (reply-by-id across processes), and
+retries on a sibling when a worker dies mid-request (the rehydration analog).
+
+TPU framing: serving is host-side IO; each process owns one chip (or a
+local-device slice), so "the process holding capacity" = the worker whose
+in-flight count is lowest. The pipeline inside each worker is a jitted XLA
+program; micro-batching happens inside ServingServer exactly as in the
+single-node mode.
+"""
+
+from __future__ import annotations
+
+import http.client
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..core.table import Table
+from .serving import ServingServer
+
+
+class _WorkerLink:
+    """Connection pool + in-flight accounting for one downstream worker."""
+
+    def __init__(self, host: str, port: int, timeout: float):
+        self.host, self.port = host, port
+        self.timeout = timeout
+        self.inflight = 0
+        self.failures = 0          # consecutive failures (circuit-breaker-ish)
+        self.down_until = 0.0      # monotonic time until which we skip it
+        self._pool: "queue.LifoQueue[http.client.HTTPConnection]" = \
+            queue.LifoQueue()
+        self._lock = threading.Lock()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _get_conn(self) -> Optional[http.client.HTTPConnection]:
+        """Pooled connection or None (callers then dial fresh)."""
+        try:
+            return self._pool.get_nowait()
+        except queue.Empty:
+            return None
+
+    def forward(self, method: str, path: str, body: bytes,
+                headers: Dict[str, str]) -> tuple:
+        """One forwarded request; returns (status, body). Raises on transport
+        failure (caller retries on a sibling). A failure on a POOLED
+        keep-alive connection retries once on a FRESH one first: workers
+        close idle connections after ~30s (serving.py Handler.timeout), and
+        that stale-socket error must not read as a dead worker — it would
+        cool down every healthy worker after any idle period."""
+        conn = self._get_conn()
+        if conn is not None:
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                r = conn.getresponse()
+                payload = r.read()
+                self._pool.put(conn)
+                return r.status, payload
+            except Exception:
+                conn.close()       # stale keep-alive conn: fall through
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            r = conn.getresponse()
+            payload = r.read()
+            self._pool.put(conn)
+            return r.status, payload
+        except Exception:
+            conn.close()           # broken conn must not re-pool
+            raise
+
+    def mark_ok(self) -> None:
+        with self._lock:
+            self.failures = 0
+            self.down_until = 0.0
+
+    def mark_failed(self, cooldown: float) -> None:
+        with self._lock:
+            self.failures += 1
+            # exponential-ish backoff, capped: 1 failure = one cooldown,
+            # repeated failures keep it out longer
+            self.down_until = time.monotonic() + cooldown * min(
+                self.failures, 8)
+
+
+class ServingGateway:
+    """Public endpoint forwarding to per-process workers (the implemented
+    version of the reference's stubbed InternalHandler shuffle routing).
+
+    ``mode``: ``least_loaded`` (default — route to the worker with the fewest
+    in-flight forwards) or ``round_robin``. A worker that fails a forward is
+    cooled down and the request retries on a sibling; only when every worker
+    fails does the client see a 502 (single-request semantics preserved:
+    at-most-once per worker, the reply returns to the original caller's
+    still-open connection — reply-by-id across processes)."""
+
+    def __init__(self, worker_urls: Sequence[str], host: str = "127.0.0.1",
+                 port: int = 0, api_path: str = "/",
+                 mode: str = "least_loaded", forward_timeout: float = 30.0,
+                 cooldown: float = 1.0, max_retries: Optional[int] = None):
+        if mode not in ("least_loaded", "round_robin"):
+            raise ValueError(f"unknown load-balancing mode {mode!r}")
+        self.links: List[_WorkerLink] = []
+        for u in worker_urls:
+            hostport = u.split("//", 1)[-1].split("/", 1)[0]
+            h, _, p = hostport.partition(":")
+            self.links.append(_WorkerLink(h, int(p or 80), forward_timeout))
+        if not self.links:
+            raise ValueError("gateway needs at least one worker url")
+        self.host, self.port = host, port
+        self.api_path = api_path
+        self.mode = mode
+        self.cooldown = cooldown
+        self.max_retries = (len(self.links) if max_retries is None
+                            else max_retries)
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._httpd = None
+        self.stats = {"forwarded": 0, "retried": 0, "failed": 0}
+
+    # --- worker selection ----------------------------------------------
+    def _pick(self, exclude: set) -> Optional[_WorkerLink]:
+        now = time.monotonic()
+        with self._lock:
+            up = [l for l in self.links
+                  if id(l) not in exclude and l.down_until <= now]
+            if not up:  # every candidate cooling down: try them anyway
+                up = [l for l in self.links if id(l) not in exclude]
+            if not up:
+                return None
+            if self.mode == "round_robin":
+                self._rr += 1
+                return up[self._rr % len(up)]
+            return min(up, key=lambda l: l.inflight)
+
+    def _forward(self, method: str, path: str, body: bytes,
+                 headers: Dict[str, str]) -> tuple:
+        tried: set = set()
+        last_err = None
+        for _ in range(self.max_retries):
+            link = self._pick(tried)
+            if link is None:
+                break
+            tried.add(id(link))
+            with self._lock:
+                link.inflight += 1
+            try:
+                status, payload = link.forward(method, path, body, headers)
+                link.mark_ok()
+                with self._lock:
+                    self.stats["forwarded"] += 1
+                return status, payload
+            except Exception as e:  # transport failure -> retry on sibling
+                last_err = e
+                link.mark_failed(self.cooldown)
+                with self._lock:
+                    self.stats["retried"] += 1
+            finally:
+                with self._lock:
+                    link.inflight -= 1
+        with self._lock:
+            self.stats["failed"] += 1
+        return 502, (b'{"error": "no serving worker reachable: %s"}'
+                     % str(last_err).encode()[:200])
+
+    # --- embedded public server ----------------------------------------
+    def start(self) -> "ServingGateway":
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+            timeout = 30
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                fwd_headers = {"Content-Type": self.headers.get(
+                    "Content-Type", "application/json"),
+                    "Content-Length": str(len(body))}
+                status, payload = outer._forward("POST", outer.api_path,
+                                                 body, fwd_headers)
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802  — health/stats endpoint
+                import json as _json
+
+                now = time.monotonic()
+                body = _json.dumps({
+                    "workers": [{"url": l.url, "inflight": l.inflight,
+                                 "down": l.down_until > now}
+                                for l in outer.links],
+                    **outer.stats}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 256
+            daemon_threads = True
+
+        self._httpd = _Server((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}{self.api_path}"
+
+    def __enter__(self) -> "ServingGateway":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class DistributedServingServer:
+    """Mesh-wide serving: every process starts a worker ServingServer running
+    ``handler`` on its local capacity; worker addresses are exchanged over the
+    distributed backend (the DCN rendezvous the reference does through Spark's
+    driver); process 0 additionally exposes the public gateway.
+
+    Single-process fallback: with no distributed backend this degrades to one
+    worker + gateway on the same host (still exercising the forwarding hop).
+    """
+
+    def __init__(self, handler: Callable[[Table], Table],
+                 host: Optional[str] = None, gateway_port: int = 0,
+                 worker_port: int = 0, mode: str = "least_loaded",
+                 max_batch_size: int = 64, max_batch_latency: float = 0.0,
+                 advertise_host: Optional[str] = None):
+        self.handler = handler
+        # None = auto: loopback single-process; all interfaces when the
+        # advertised address must be reachable from OTHER hosts
+        self.host = host
+        # multi-host: the address OTHER processes reach this worker at
+        # (default: auto-detected routable interface address)
+        self.advertise_host = advertise_host
+        self.gateway_port = gateway_port
+        self.worker_port = worker_port
+        self.mode = mode
+        self.max_batch_size = max_batch_size
+        self.max_batch_latency = max_batch_latency
+        self.worker: Optional[ServingServer] = None
+        self.gateway: Optional[ServingGateway] = None
+
+    @staticmethod
+    def _local_ip() -> str:
+        """Routable local address: the UDP-connect trick reads the kernel's
+        chosen source interface without sending a packet —
+        gethostbyname(gethostname()) resolves to 127.0.x.1 on common
+        /etc/hosts configs, which would advertise an unreachable worker."""
+        import socket
+
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))   # no packets are sent
+            return s.getsockname()[0]
+        except OSError:
+            return socket.gethostbyname(socket.gethostname())
+        finally:
+            s.close()
+
+    def _gather_worker_addrs(self, port: int) -> List[str]:
+        """All-gather (ip, port) across processes. Ports ride a tiny int
+        array through the collective layer — the only cross-process exchange
+        serving needs (requests themselves flow over plain HTTP)."""
+        import jax
+
+        if jax.process_count() == 1:
+            return [f"http://{self.host or '127.0.0.1'}:{port}"]
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        import socket
+
+        ip = self.advertise_host or self._local_ip()
+        # IP ships as 4 octets (NOT one packed u32: jax's x64-disabled
+        # default would downcast the int64 array to int32 and overflow)
+        octets = [int(b) for b in socket.inet_aton(ip)]
+        local = np.asarray([octets + [port]], np.int32)
+        allv = np.asarray(multihost_utils.process_allgather(local))
+        allv = allv.reshape(-1, 5)
+        return [f"http://{a}.{b}.{c}.{d}:{int(p)}"
+                for a, b, c, d, p in allv]
+
+    def start(self) -> "DistributedServingServer":
+        import jax
+
+        multi = jax.process_count() > 1
+        bind = self.host or ("0.0.0.0" if multi else "127.0.0.1")
+        self.worker = ServingServer(
+            self.handler, host=bind, port=self.worker_port,
+            max_batch_size=self.max_batch_size,
+            max_batch_latency=self.max_batch_latency).start()
+        urls = self._gather_worker_addrs(self.worker.port)
+        if jax.process_index() == 0:
+            self.gateway = ServingGateway(
+                urls, host=bind, port=self.gateway_port,
+                mode=self.mode).start()
+        return self
+
+    def stop(self) -> None:
+        if self.gateway is not None:
+            self.gateway.stop()
+        if self.worker is not None:
+            self.worker.stop()
+
+    @property
+    def url(self) -> str:
+        """Public endpoint (gateway on process 0, else the local worker)."""
+        if self.gateway is not None:
+            return self.gateway.url
+        return self.worker.url
+
+    def __enter__(self) -> "DistributedServingServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -41,25 +41,44 @@ def build_handler(stage, output_col: str):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--model", required=True,
-                    help="path of a saved PipelineStage (stage.save dir)")
+    ap.add_argument("--model",
+                    help="path of a saved PipelineStage (stage.save dir); "
+                         "required unless running as --gateway-workers")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8898)
     ap.add_argument("--output-col", default="prediction")
     ap.add_argument("--max-batch-size", type=int, default=64)
     ap.add_argument("--max-batch-latency", type=float, default=0.005)
+    ap.add_argument("--gateway-workers", default=None,
+                    help="comma-separated worker URLs: run as a forwarding "
+                         "gateway (io/distributed_serving.py) instead of a "
+                         "model worker; --model is ignored")
+    ap.add_argument("--lb-mode", default="least_loaded",
+                    choices=["least_loaded", "round_robin"])
     args = ap.parse_args(argv)
 
-    from ..core.pipeline import PipelineStage
-    from .serving import ServingServer
+    if args.gateway_workers:
+        from .distributed_serving import ServingGateway
 
-    stage = PipelineStage.load(args.model)
-    server = ServingServer(build_handler(stage, args.output_col),
-                           host=args.host, port=args.port,
-                           max_batch_size=args.max_batch_size,
-                           max_batch_latency=args.max_batch_latency)
-    server.start()
-    print(f"serving {type(stage).__name__} at {server.url}", flush=True)
+        server = ServingGateway(args.gateway_workers.split(","),
+                                host=args.host, port=args.port,
+                                mode=args.lb_mode)
+        server.start()
+        print(f"gateway → {len(server.links)} workers at {server.url}",
+              flush=True)
+    else:
+        if not args.model:
+            ap.error("--model is required (unless --gateway-workers)")
+        from ..core.pipeline import PipelineStage
+        from .serving import ServingServer
+
+        stage = PipelineStage.load(args.model)
+        server = ServingServer(build_handler(stage, args.output_col),
+                               host=args.host, port=args.port,
+                               max_batch_size=args.max_batch_size,
+                               max_batch_latency=args.max_batch_latency)
+        server.start()
+        print(f"serving {type(stage).__name__} at {server.url}", flush=True)
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     try:

@@ -521,7 +521,8 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
         groups = np.asarray(train_df[gcol])
         _, sizes = np.unique(groups, return_counts=True)
         cfg = self._base_config(objective="lambdarank",
-                                lambdarank_truncation_level=self.getMaxPosition())
+                                lambdarank_truncation_level=self.getMaxPosition(),
+                                eval_at=tuple(self.getEvalAt()))
         valid = None
         if valid_df is not None and valid_df.num_rows:
             valid_df = valid_df.sort_by(gcol)

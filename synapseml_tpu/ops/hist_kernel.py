@@ -27,6 +27,7 @@ in the accumulated sums up to f32 reduction order.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,9 @@ from jax import lax
 
 FEATURE_BLOCK = 8     # features per kernel step (i32 sublane tile)
 LANE = 128
+# on-chip tuning knobs (tools/perf_tune.py phase 1b sweeps these; the winner
+# ships as the env default so the sweep result survives without code edits)
+DEFAULT_CHUNK = int(os.environ.get("SYNAPSEML_TPU_HIST_CHUNK", 2048))
 
 
 def pad_bins(max_bin: int) -> int:
@@ -49,9 +53,10 @@ def features_padded(f: int) -> int:
     return -(-f // FEATURE_BLOCK) * FEATURE_BLOCK
 
 
-def _kernel(bin_ref, g_ref, h_ref, m_ref, out_ref, *, C: int, K1: int):
-    """Grid (feature_blocks, row_chunks). bin_ref (FEATURE_BLOCK, C) i32,
-    g/h/m (C,) f32, out (FEATURE_BLOCK, K1, 24) f32 accumulated over chunks."""
+def _kernel(bin_ref, g_ref, h_ref, m_ref, out_ref, *, C: int, K1: int,
+            FB: int):
+    """Grid (feature_blocks, row_chunks). bin_ref (FB, C) i32,
+    g/h/m (C,) f32, out (FB, K1, 24) f32 accumulated over chunks."""
     from jax.experimental import pallas as pl  # deferred: CPU never imports
 
     @pl.when(pl.program_id(1) == 0)
@@ -74,29 +79,31 @@ def _kernel(bin_ref, g_ref, h_ref, m_ref, out_ref, *, C: int, K1: int):
         out_ref[pl.ds(f, 1)] += acc[None]
         return 0
 
-    lax.fori_loop(0, FEATURE_BLOCK, fbody, 0)
+    lax.fori_loop(0, FB, fbody, 0)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_bins_padded", "chunk", "interpret"))
-def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = 2048,
-                 interpret: bool = False):
+                   static_argnames=("num_bins_padded", "chunk", "interpret",
+                                    "feature_block"))
+def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = None,
+                 interpret: bool = False, feature_block: int = None):
     from jax.experimental import pallas as pl
 
     FP, n = bT.shape
-    C = min(chunk, n)
-    assert n % C == 0 and FP % FEATURE_BLOCK == 0
+    C = min(chunk or DEFAULT_CHUNK, n)
+    FB = feature_block or FEATURE_BLOCK
+    assert n % C == 0 and FP % FB == 0
     K1 = num_bins_padded // 8
     out = pl.pallas_call(
-        functools.partial(_kernel, C=C, K1=K1),
-        grid=(FP // FEATURE_BLOCK, n // C),
+        functools.partial(_kernel, C=C, K1=K1, FB=FB),
+        grid=(FP // FB, n // C),
         in_specs=[
-            pl.BlockSpec((FEATURE_BLOCK, C), lambda f, c: (f, c)),
+            pl.BlockSpec((FB, C), lambda f, c: (f, c)),
             pl.BlockSpec((C,), lambda f, c: (c,)),
             pl.BlockSpec((C,), lambda f, c: (c,)),
             pl.BlockSpec((C,), lambda f, c: (c,)),
         ],
-        out_specs=pl.BlockSpec((FEATURE_BLOCK, K1, 24), lambda f, c: (f, 0, 0)),
+        out_specs=pl.BlockSpec((FB, K1, 24), lambda f, c: (f, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((FP, K1, 24), jnp.float32),
         interpret=interpret,
     )(bT, g, h, m)

@@ -64,6 +64,19 @@ class BinMapper(NamedTuple):
         return np.where(self.nan_mask, nb, np.int32(0x7FFF))
 
 
+def cat_presence_bitmap(col: np.ndarray, cap: int) -> np.ndarray:
+    """(cap,) bool: which identity bins a categorical column occupies.
+    Values clip into [0, cap-1] exactly as identity binning does, so the
+    popcount equals the number of distinct OBSERVED bins — the quantity the
+    maxCatToOnehot one-vs-rest decision needs (LightGBM decides from
+    full-data bin counts). O(n) bincount, no sort."""
+    v = col[~np.isnan(col)]
+    if not v.size:
+        return np.zeros(cap, bool)
+    iv = np.clip(v.astype(np.int64), 0, cap - 1)
+    return np.bincount(iv, minlength=cap).astype(bool)
+
+
 def compute_bin_mapper(
     X: np.ndarray,
     max_bin: int = 255,
@@ -73,6 +86,7 @@ def compute_bin_mapper(
     has_nan: Optional[np.ndarray] = None,
     min_data_in_bin: int = 3,
     max_bin_by_feature: Optional[Sequence[int]] = None,
+    cat_presence: Optional[np.ndarray] = None,
 ) -> BinMapper:
     """Driver-side boundary computation from a sample (the analog of
     LightGBMBase.getSampledRows + LGBM_DatasetCreateFromSampledColumn;
@@ -80,7 +94,11 @@ def compute_bin_mapper(
 
     ``has_nan`` overrides per-feature missing-ness when the caller has
     computed it on MORE data than ``X`` (e.g. the sparse path samples rows for
-    boundaries but elects NaN bins from the full matrix)."""
+    boundaries but elects NaN bins from the full matrix). ``cat_presence``
+    ((F, max_bin) bool) similarly overrides categorical bin occupancy when the
+    caller saw more data than ``X`` — the sparse and multi-process paths pass
+    full-data bitmaps so the maxCatToOnehot decision never depends on the
+    sampling seed."""
     X = np.asarray(X, dtype=np.float32)
     n, f = X.shape
     cat = np.zeros(f, dtype=bool)
@@ -92,6 +110,7 @@ def compute_bin_mapper(
     else:
         has_nan = np.asarray(has_nan, bool) & ~cat
 
+    X_full = X
     if n > sample_count:
         rng = np.random.default_rng(seed)
         X = X[rng.choice(n, size=sample_count, replace=False)]
@@ -104,16 +123,27 @@ def compute_bin_mapper(
         mb = np.asarray(max_bin_by_feature, np.int64)
         caps[: len(mb)] = np.clip(mb[:f], 2, max_bin)
     for j in range(f):
+        if cat[j]:
+            # categories are small non-negative ints; identity binning capped
+            # at max_bin. Bin occupancy comes from the FULL column (O(n)
+            # bincount — no sort, no sampled-col copy): cat_counts drives the
+            # maxCatToOnehot one-vs-rest decision, which LightGBM makes from
+            # full-data bin counts — a subsample would flip split modes
+            # nondeterministically with bin_sample_count for rare categories.
+            # Callers whose X is itself a sample (sparse / multi-process
+            # paths) pass the full-data bitmap via ``cat_presence``.
+            pres = (np.asarray(cat_presence[j], bool)
+                    if cat_presence is not None
+                    else cat_presence_bitmap(X_full[:, j], max_bin))
+            nz = np.flatnonzero(pres)
+            hi = int(nz[-1]) if nz.size else 0
+            nbins[j] = min(hi + 1, int(caps[j]) - 1) + 1  # +1 overflow bin
+            cat_counts[j] = int(pres.sum())
+            continue
         col = X[:, j]
         col = col[~np.isnan(col)]
         # features with NaN reserve one bin; real values get one fewer
         real_cap = int(caps[j]) - 1 if has_nan[j] else int(caps[j])
-        if cat[j]:
-            # categories are small non-negative ints; identity binning capped at max_bin
-            hi = int(col.max()) if col.size else 0
-            nbins[j] = min(hi + 1, int(caps[j]) - 1) + 1  # +1 overflow bin
-            cat_counts[j] = len(np.unique(col)) if col.size else 0
-            continue
         uniq = np.unique(col)
         if uniq.size <= 1:
             nbins[j] = 2 + int(has_nan[j])
@@ -184,6 +214,57 @@ def apply_bins(mapper: BinMapper, X) -> jnp.ndarray:
         ident = jnp.minimum(ident, limit[None, :])
         binned = jnp.where(cats[None, :], ident, binned)
     return binned
+
+
+@partial(jax.jit, static_argnames=("n_rows", "out_dtype"))
+def _bin_csr_entries(data, rows, cols, zero_bins, boundaries, real_limit,
+                     nan_mask, nan_bin, is_cat, max_bin, n_rows,
+                     out_dtype=jnp.uint8):
+    """Device-side CSR chunk binning: O(F) broadcast of each feature's
+    zero-bin + O(nnz) per-entry searchsorted and scatter — implicit zeros
+    never materialize (the dense detour binned rows x F values regardless of
+    density). Semantics identical to :func:`apply_bins` per entry."""
+    f = boundaries.shape[0]
+    # per-entry numeric bin against the entry's feature boundaries
+    b = jax.vmap(lambda v, c: jnp.searchsorted(boundaries[c], v,
+                                               side="left"))(data, cols)
+    b = jnp.minimum(b.astype(jnp.int32), real_limit[cols])
+    isnan = jnp.isnan(data)
+    b = jnp.where(isnan & nan_mask[cols], nan_bin[cols], b)
+    # categorical identity binning (clip into [0, num_bins-1])
+    cat_limit = real_limit + nan_mask.astype(jnp.int32)  # = num_bins - 1
+    identb = jnp.minimum(
+        jnp.clip(jnp.nan_to_num(data, nan=0.0), 0,
+                 max_bin - 1).astype(jnp.int32), cat_limit[cols])
+    b = jnp.where(is_cat[cols], identb, b)
+    out = jnp.broadcast_to(zero_bins[None, :].astype(out_dtype), (n_rows, f))
+    return out.at[rows, cols].set(b.astype(out_dtype))
+
+
+def bin_csr_chunk(mapper: BinMapper, data, rows, cols, n_rows) -> jnp.ndarray:
+    """Bin one CSR chunk on device (see ``_bin_csr_entries``); ``rows`` are
+    chunk-local row ids for the nnz entries. nnz pads to power-of-2 buckets
+    (pad rows point out of bounds → dropped by the scatter) so varying chunk
+    occupancy reuses a handful of compiled programs instead of one per nnz."""
+    nnz = len(data)
+    cap = max(1024, 1 << max(nnz - 1, 1).bit_length())
+    pad = cap - nnz
+    data = np.pad(np.asarray(data, np.float32), (0, pad))
+    rows = np.pad(np.asarray(rows, np.int32), (0, pad),
+                  constant_values=n_rows)          # OOB scatter index: no-op
+    cols = np.pad(np.asarray(cols, np.int32), (0, pad))
+    dtype = jnp.uint8 if mapper.max_bin <= 256 else jnp.uint16
+    zero = apply_bins(mapper, np.zeros((1, mapper.num_features), np.float32))
+    real_limit = jnp.asarray(
+        mapper.num_bins - 1 - mapper.nan_mask.astype(np.int32), jnp.int32)
+    return _bin_csr_entries(
+        jnp.asarray(data, jnp.float32), jnp.asarray(rows, jnp.int32),
+        jnp.asarray(cols, jnp.int32), zero[0],
+        jnp.asarray(mapper.boundaries), real_limit,
+        jnp.asarray(mapper.nan_mask),
+        jnp.asarray(np.asarray(mapper.num_bins, np.int32) - 1),
+        jnp.asarray(mapper.is_categorical), mapper.max_bin, n_rows,
+        out_dtype=dtype)
 
 
 def bin_threshold_to_value(mapper: BinMapper, feature: int, bin_id: int) -> float:

@@ -659,3 +659,103 @@ def test_xgboost_dart_mode_runs():
                                           xgboost_dart_mode=True, seed=3))
     assert b.num_trees == 6
     assert ((b.predict(X) > 0.5) == (y > 0.5)).mean() > 0.9
+
+
+def test_weighted_quantile_zero_weight_tail_finite():
+    """ADVICE r2: zero-weight rows sort last as an inf sentinel; when the
+    quantile lands strictly inside the last positive row's span, the
+    interpolation partner must NOT read the inf tail."""
+    from synapseml_tpu.gbdt.objectives import _weighted_quantile
+
+    y = jnp.asarray([1.0, 2.0, 7.0])
+    w = jnp.asarray([1.0, 9.0, 0.0])       # third row bagged-out / padding
+    q = float(_weighted_quantile(y, w, 0.5))
+    assert np.isfinite(q), q
+    # quantile of {1 (w=1), 2 (w=9)} at 0.5 interpolates inside row 2's span
+    assert 1.0 <= q <= 2.0, q
+    # init_score path end-to-end: an l1 fit with a zero-weight row stays finite
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    yy = X[:, 0].astype(np.float32)
+    sw = np.ones(64, np.float32)
+    sw[-1] = 0.0
+    b = train_booster(X, yy, BoosterConfig(objective="regression_l1",
+                                           num_iterations=3),
+                      sample_weight=sw)
+    assert np.isfinite(b.predict(X)).all()
+
+
+def test_fused_cache_key_covers_sampling_seeds():
+    """ADVICE r2: extra_seed / feature_fraction_seed are traced-in Python
+    ints — two fits differing only in them must not share an executable
+    (i.e. must produce different sampling streams, hence different trees)."""
+    from synapseml_tpu.gbdt.boosting import _fused_static_key
+
+    base = dict(objective="binary", num_iterations=3, boosting_type="goss",
+                feature_fraction=0.5, seed=7)
+    c1 = BoosterConfig(**base)
+    c2 = BoosterConfig(**base, extra_seed=99)
+    c3 = BoosterConfig(**base, feature_fraction_seed=42)
+    g = c1.grower(False)
+    ks = {_fused_static_key(c, g, 512, 4, 1, 0, "auc", None)
+          for c in (c1, c2, c3)}
+    assert len(ks) == 3
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(512, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.normal(size=512) > 0).astype(np.float32)
+    t1 = train_booster(X, y, c1).trees
+    t3 = train_booster(X, y, c3).trees
+    diff = any(not np.array_equal(np.asarray(a.split_feature),
+                                  np.asarray(b.split_feature))
+               for a, b in zip(t1, t3))
+    assert diff, "feature_fraction_seed had no effect (stale fused cache?)"
+
+
+def test_cat_counts_from_full_column():
+    """ADVICE r2: cat_counts (maxCatToOnehot decision) counts distinct
+    categories on the FULL column, not the bin-boundary subsample."""
+    rng = np.random.default_rng(3)
+    n = 5000
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    # category column: values 0..2 everywhere except ONE row with value 7
+    c = rng.integers(0, 3, size=n).astype(np.float32)
+    c[1234] = 7.0
+    X[:, 1] = c
+    m = compute_bin_mapper(X, sample_count=100, categorical_features=[1],
+                           seed=0)
+    assert int(m.cat_counts[1]) == 4
+
+
+def test_cat_presence_sparse_and_override():
+    """Sparse path: cat bin occupancy from the FULL CSR matrix (implicit
+    zeros + explicit entries), not the boundary sample."""
+    import scipy.sparse as sp
+
+    from synapseml_tpu.gbdt.dataset import bin_sparse
+
+    rng = np.random.default_rng(9)
+    n = 4000
+    dense = np.zeros((n, 3), np.float32)
+    dense[:, 0] = rng.normal(size=n)
+    # cat col: mostly implicit zeros, a few 1s/2s, ONE row of category 6
+    idx = rng.choice(n, size=60, replace=False)
+    dense[idx, 1] = rng.integers(1, 3, size=60).astype(np.float32)
+    dense[idx[0], 1] = 6.0
+    dense[:, 2] = rng.normal(size=n)
+    mapper, binned = bin_sparse(sp.csr_matrix(dense), None, 255,
+                                bin_sample_count=200,
+                                categorical_features=[1], seed=0)
+    # distinct bins: {0, 1 or 2 (at least one), 6} — exact count from FULL data
+    expect = len(np.unique(dense[:, 1]))
+    assert int(mapper.cat_counts[1]) == expect, (mapper.cat_counts[1], expect)
+
+
+def test_param_list_default_not_shared():
+    """get() must hand out a COPY of mutable class-level defaults."""
+    from synapseml_tpu.models.gbdt import LightGBMRanker
+
+    r1 = LightGBMRanker()
+    lst = r1.getEvalAt()
+    lst.append(99)
+    assert r1.getEvalAt() == [1, 2, 3, 4, 5]
+    assert LightGBMRanker().getEvalAt() == [1, 2, 3, 4, 5]

@@ -228,3 +228,268 @@ class TestWriterFormatAudit:
         loaded = Booster.from_model_string(bst.model_string())
         np.testing.assert_allclose(bst.raw_score(Xt), loaded.raw_score(Xt),
                                    rtol=1e-4, atol=1e-4)
+
+
+# -- extended golden corpus (VERDICT r2 next-round #5): every objective/
+# -- decision_type family the writer can emit, with hand-computed predictions
+
+def _mk_model_string(header_lines, tree_blocks, tail_feats):
+    sizes = [len(b) + 1 for b in tree_blocks]
+    header = "\n".join(header_lines
+                       + [f"tree_sizes={' '.join(str(s) for s in sizes)}", ""])
+    return (header + "\n" + "\n".join(tree_blocks)
+            + "\nend of trees\n\nfeature_importances:\n" + tail_feats
+            + "\nparameters:\n[boosting: gbdt]\nend of parameters\n"
+            "\npandas_categorical:null\n")
+
+
+def _stump(idx, feat, thr, dt, left_val, right_val, shrinkage=0.1):
+    return f"""Tree={idx}
+num_leaves=2
+num_cat=0
+split_feature={feat}
+split_gain=1
+threshold={thr}
+decision_type={dt}
+left_child=-1
+right_child=-2
+leaf_value={left_val} {right_val}
+leaf_weight=10 10
+leaf_count=10 10
+internal_value=0
+internal_weight=20
+internal_count=20
+is_linear=0
+shrinkage={shrinkage}
+"""
+
+
+class TestGoldenMulticlass:
+    """3-class softmax model: one stump per class, one iteration."""
+
+    def _load(self):
+        trees = [_stump(c, 0, 0.5, 2, 0.1 * (c + 1), -0.1 * (c + 1))
+                 for c in range(3)]
+        s = _mk_model_string([
+            "tree", "version=v3", "num_class=3", "num_tree_per_iteration=3",
+            "label_index=0", "max_feature_idx=1",
+            "objective=multiclass num_class:3", "feature_names=f0 f1",
+            "feature_infos=[-1:1] [-1:1]"], trees, "f0=3\nf1=0\n")
+        return Booster.from_model_string(s)
+
+    def test_softmax_predictions(self):
+        bst = self._load()
+        x = np.asarray([[0.2, 0.0], [0.9, 0.0]], np.float32)
+        raw = bst.raw_score(x)
+        assert raw.shape == (2, 3)
+        np.testing.assert_allclose(raw[0], [0.1, 0.2, 0.3], atol=1e-6)
+        np.testing.assert_allclose(raw[1], [-0.1, -0.2, -0.3], atol=1e-6)
+        p = bst.predict(x)
+        e = np.exp(raw[0] - raw[0].max())
+        np.testing.assert_allclose(p[0], e / e.sum(), atol=1e-6)
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-6)
+
+
+class TestGoldenMissingTypeZero:
+    """missing_type=zero: 0.0 AND NaN route to the default side
+    (LightGBM NumericalDecision: NaN coerces to 0.0 when missing!=nan,
+    then |x| <= kZeroThreshold routes default)."""
+
+    def _load(self, default_left):
+        dt = 4 | (2 if default_left else 0)   # bits2-3=01 zero, bit1 dleft
+        s = _mk_model_string([
+            "tree", "version=v3", "num_class=1", "num_tree_per_iteration=1",
+            "label_index=0", "max_feature_idx=0",
+            "objective=regression", "feature_names=f0",
+            "feature_infos=[-5:5]"], [_stump(0, 0, -1.0, dt, 1.0, 2.0)],
+            "f0=1\n")
+        return Booster.from_model_string(s)
+
+    @pytest.mark.parametrize("x,dleft,expect", [
+        (-2.0, True, 1.0),    # real value <= -1 -> left
+        (0.5, True, 2.0),     # real value > -1 -> right
+        (0.0, True, 1.0),     # zero is missing -> default LEFT
+        (np.nan, True, 1.0),  # NaN coerces to 0 -> missing -> default LEFT
+        (0.0, False, 2.0),    # default right
+        (np.nan, False, 2.0),
+        (1e-36, True, 1.0),   # inside kZeroThreshold -> missing
+    ])
+    def test_zero_routing(self, x, dleft, expect):
+        bst = self._load(dleft)
+        raw = bst.raw_score(np.asarray([[x]], np.float32))
+        np.testing.assert_allclose(raw[0], expect, atol=1e-6)
+
+    def test_missing_none_coerces_nan_to_zero(self):
+        # missing_type=none: NaN becomes 0.0 and takes the COMPARISON path
+        s = _mk_model_string([
+            "tree", "version=v3", "num_class=1", "num_tree_per_iteration=1",
+            "label_index=0", "max_feature_idx=0",
+            "objective=regression", "feature_names=f0",
+            "feature_infos=[-5:5]"], [_stump(0, 0, -1.0, 0, 1.0, 2.0)],
+            "f0=1\n")
+        bst = Booster.from_model_string(s)
+        # NaN -> 0.0; 0.0 <= -1.0 false -> right (NOT default_left routing)
+        raw = bst.raw_score(np.asarray([[np.nan]], np.float32))
+        np.testing.assert_allclose(raw[0], 2.0, atol=1e-6)
+
+
+class TestGoldenDartWeighted:
+    """dart model strings store FINAL leaf values (normalization applied at
+    train time); the loader must sum them verbatim, not re-scale by
+    shrinkage."""
+
+    def _load(self):
+        trees = [_stump(0, 0, 0.5, 2, 0.4, -0.4, shrinkage=1),
+                 _stump(1, 0, 0.5, 2, 0.15, -0.15, shrinkage=0.05)]
+        s = _mk_model_string([
+            "tree", "version=v3", "num_class=1", "num_tree_per_iteration=1",
+            "label_index=0", "max_feature_idx=0",
+            "objective=binary sigmoid:1", "feature_names=f0",
+            "feature_infos=[-1:1]"], trees, "f0=2\n")
+        return Booster.from_model_string(s)
+
+    def test_sum_verbatim(self):
+        bst = self._load()
+        raw = bst.raw_score(np.asarray([[0.0], [1.0]], np.float32))
+        np.testing.assert_allclose(raw, [0.55, -0.55], atol=1e-6)
+        p = bst.predict(np.asarray([[0.0]], np.float32))
+        np.testing.assert_allclose(p[0], _sigmoid(0.55), atol=1e-6)
+
+
+class TestGoldenRanking:
+    """lambdarank: prediction IS the raw score (no link function)."""
+
+    def _load(self):
+        s = _mk_model_string([
+            "tree", "version=v3", "num_class=1", "num_tree_per_iteration=1",
+            "label_index=0", "max_feature_idx=0",
+            "objective=lambdarank", "feature_names=f0",
+            "feature_infos=[-1:1]"], [_stump(0, 0, 0.0, 2, -1.5, 2.5)],
+            "f0=1\n")
+        return Booster.from_model_string(s)
+
+    def test_raw_identity(self):
+        bst = self._load()
+        x = np.asarray([[-0.5], [0.5]], np.float32)
+        np.testing.assert_allclose(bst.predict(x), [-1.5, 2.5], atol=1e-6)
+        np.testing.assert_allclose(bst.raw_score(x), bst.predict(x), atol=1e-6)
+
+
+class TestWriterMissingTypesRoundTrip:
+    """Our writer's decision_type missing bits survive a round-trip and the
+    loaded model reproduces the trained model on data WITH NaN and zeros."""
+
+    def test_roundtrip_with_nan_and_zero(self):
+        rng = np.random.default_rng(21)
+        X = rng.normal(size=(600, 3)).astype(np.float32)
+        X[rng.random(600) < 0.25, 1] = np.nan
+        X[rng.random(600) < 0.25, 2] = 0.0
+        y = (np.nan_to_num(X[:, 1]) + X[:, 0] > 0).astype(np.float32)
+        bst = train_booster(X, y, BoosterConfig(objective="binary",
+                                                num_iterations=5,
+                                                num_leaves=8))
+        loaded = Booster.from_model_string(bst.model_string())
+        Xt = rng.normal(size=(200, 3)).astype(np.float32)
+        Xt[rng.random(200) < 0.3, 1] = np.nan
+        Xt[rng.random(200) < 0.3, 2] = 0.0
+        np.testing.assert_allclose(bst.raw_score(Xt), loaded.raw_score(Xt),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestLoadedModelWarmStart:
+    """Continuing training from a from_model_string booster must preserve the
+    loaded trees' parsed thresholds (the synthetic mapper is all-inf) and
+    missing codes — review finding r3."""
+
+    def _data(self, seed=31):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(800, 4)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+        return X, y
+
+    @pytest.mark.parametrize("boosting", ["gbdt", "dart"])
+    def test_continue_from_string_matches_continue_from_model(self, boosting):
+        X, y = self._data()
+        cfg1 = BoosterConfig(objective="binary", num_iterations=4,
+                             num_leaves=8)
+        m1 = train_booster(X, y, cfg1)
+        loaded = Booster.from_model_string(m1.model_string())
+        cfg2 = BoosterConfig(objective="binary", num_iterations=3,
+                             num_leaves=8, boosting_type=boosting,
+                             drop_rate=0.3, skip_drop=0.0, seed=5)
+        b_mem = train_booster(X, y, cfg2, init_model=m1)
+        b_str = train_booster(X, y, cfg2, init_model=loaded)
+        Xt, _ = self._data(seed=77)
+        if boosting == "gbdt":
+            # gbdt continuation is threshold-precision-stable: both paths
+            # must produce (near-)identical models
+            np.testing.assert_allclose(b_mem.raw_score(Xt),
+                                       b_str.raw_score(Xt),
+                                       rtol=1e-3, atol=1e-3)
+        # THE guarded failure mode: all-inf synthetic-mapper thresholds send
+        # every row left. The prior-tree window of the string-continued model
+        # must match the in-memory-continued one (dart re-weights dropped
+        # prior trees during continuation, identically for both under the
+        # same seed; %g threshold rounding only shifts boundary rows)
+        np.testing.assert_allclose(
+            b_mem.raw_score(Xt, num_iteration=4, start_iteration=0),
+            b_str.raw_score(Xt, num_iteration=4, start_iteration=0),
+            rtol=2e-2, atol=2e-2)
+        acc = ((b_str.predict(Xt) > 0.5) == (Xt[:, 0] + 0.5 * Xt[:, 1] > 0))
+        assert acc.mean() > 0.9, acc.mean()
+
+    def test_early_stop_cut_keeps_warm_start_trees(self):
+        X, y = self._data()
+        m1 = train_booster(X, y, BoosterConfig(objective="binary",
+                                               num_iterations=5, num_leaves=8))
+        cfg = BoosterConfig(objective="binary", num_iterations=40,
+                            num_leaves=8, early_stopping_round=2)
+        b = train_booster(X, y, cfg, init_model=m1, valid=(X, y))
+        assert b.num_trees >= m1.num_trees, (b.num_trees, m1.num_trees)
+
+
+class TestGoldenCategoricalMissing:
+    """Categorical NaN routing per missing_type: NaN tests membership as
+    category 0 unless missing_type=nan (LightGBM CategoricalDecision)."""
+
+    def _load(self, dt):
+        tree = f"""Tree=0
+num_leaves=2
+split_feature=0
+split_gain=1
+threshold=0
+decision_type={dt}
+left_child=-1
+right_child=-2
+leaf_value=1.0 2.0
+leaf_weight=10 10
+leaf_count=10 10
+internal_value=0
+internal_weight=20
+internal_count=20
+num_cat=1
+cat_boundaries=0 1
+cat_threshold=5
+is_linear=0
+shrinkage=1
+"""
+        sizes = len(tree) + 1
+        s = ("tree\nversion=v3\nnum_class=1\nnum_tree_per_iteration=1\n"
+             "label_index=0\nmax_feature_idx=0\nobjective=regression\n"
+             "feature_names=f0\nfeature_infos=0:1:2\n"
+             f"tree_sizes={sizes}\n\n{tree}\nend of trees\n\n"
+             "feature_importances:\nf0=1\n\nparameters:\n"
+             "[boosting: gbdt]\nend of parameters\n\npandas_categorical:null\n")
+        return Booster.from_model_string(s)
+
+    def test_nan_category_none_missing_goes_left(self):
+        # bitset 5 = {0, 2} contains category 0; missing_type=none (dt=1)
+        bst = self._load(dt=1)
+        raw = bst.raw_score(np.asarray([[np.nan]], np.float32))
+        np.testing.assert_allclose(raw[0], 1.0, atol=1e-6)  # member -> left
+
+    def test_nan_category_nan_missing_goes_right(self):
+        # missing_type=nan (dt=1|8=9): NaN is never a member -> right
+        bst = self._load(dt=9)
+        raw = bst.raw_score(np.asarray([[np.nan]], np.float32))
+        np.testing.assert_allclose(raw[0], 2.0, atol=1e-6)

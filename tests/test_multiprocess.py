@@ -322,3 +322,63 @@ def test_two_process_vw_training(tmp_path):
     w0 = [l for l in outs[0].splitlines() if l.startswith("WNORM")]
     w1 = [l for l in outs[1].splitlines() if l.startswith("WNORM")]
     assert w0 == w1 and w0, (w0, w1)   # pmean-averaged weights identical
+
+
+_SERVING_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+import json, urllib.request
+import numpy as np
+
+from jax.experimental import multihost_utils
+from synapseml_tpu.parallel.mesh import initialize_distributed
+from synapseml_tpu.core.table import Table
+from synapseml_tpu.io import DistributedServingServer
+
+pid = int(sys.argv[1])
+initialize_distributed(coordinator_address="127.0.0.1:%(port)d",
+                       num_processes=2, process_id=pid)
+
+def handler(df: Table) -> Table:
+    vals = np.array([{"y": float(v["x"]) * 3.0, "pid": pid}
+                     for v in df["value"]], dtype=object)
+    return Table({"id": df["id"], "reply": vals})
+
+srv = DistributedServingServer(handler, mode="round_robin").start()
+if pid == 0:
+    assert srv.gateway is not None
+    assert len(srv.gateway.links) == 2, [l.url for l in srv.gateway.links]
+    seen = set()
+    for i in range(16):
+        req = urllib.request.Request(
+            srv.url, data=json.dumps({"x": i}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=15) as r:
+            out = json.loads(r.read())
+        assert out["y"] == i * 3.0, out
+        seen.add(out["pid"])
+    # requests were served by BOTH processes (cross-process forwarding +
+    # reply-by-id back through the gateway)
+    assert seen == {0, 1}, seen
+    print("DSERV_OK", flush=True)
+else:
+    assert srv.gateway is None
+multihost_utils.sync_global_devices("serving_done")
+srv.stop()
+print("DSERV_DONE", flush=True)
+"""
+
+
+def test_two_process_distributed_serving(tmp_path):
+    """Multi-worker serving gateway (DistributedHTTPSource analog): one
+    embedded server per process, gateway on process 0 forwarding to both."""
+    f = tmp_path / "serving_worker.py"
+    f.write_text(_SERVING_WORKER % {"repo": REPO, "port": _free_port()})
+    procs, outs = _spawn_workers(f, timeout=280)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert "DSERV_DONE" in out, out[-3000:]
+    assert "DSERV_OK" in outs[0], outs[0][-3000:]

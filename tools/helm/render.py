@@ -1,0 +1,159 @@
+"""``helm template``-style renderer for the serving chart (CI render check).
+
+The build image ships no helm binary, so CI validates the chart by rendering
+it with this renderer and YAML-parsing every emitted document. Supported
+template subset (what the chart uses — kept deliberately small so the chart
+stays plain helm):
+
+  * ``{{ .Values.a.b }}`` / ``{{ .Release.Name }}`` substitution
+  * ``{{- if .Values.a.b }} ... {{- end }}`` (truthiness, no else)
+  * ``{{ include "synapseml-tpu-serving.workerUrls" . }}`` — computed the
+    same way the _helpers.tpl definition does (stable StatefulSet pod DNS)
+
+Usage: python tools/helm/render.py [--set a.b=v ...] [--release NAME] [chart]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+CHART_DEFAULT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "synapseml-tpu-serving")
+
+
+def load_values(path: str) -> dict:
+    """Tiny YAML-subset loader for values.yaml (maps of scalars, 2 levels;
+    comments; quoted strings). Avoids a pyyaml dependency for CI."""
+    root: dict = {}
+    stack = [(0, root)]
+    with open(path) as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            if not line.strip() or line.strip().startswith("#"):
+                continue
+            indent = len(line) - len(line.lstrip())
+            key, _, val = line.strip().partition(":")
+            val = val.split(" #")[0].strip()
+            while stack and stack[-1][0] > indent:
+                stack.pop()
+            cur = stack[-1][1]
+            if val == "":
+                child: dict = {}
+                cur[key] = child
+                stack.append((indent + 2, child))
+            else:
+                if val.startswith('"') and val.endswith('"'):
+                    v: object = val[1:-1]
+                elif val in ("true", "false"):
+                    v = val == "true"
+                else:
+                    try:
+                        v = int(val)
+                    except ValueError:
+                        try:
+                            v = float(val)
+                        except ValueError:
+                            v = val
+                cur[key] = v
+    return root
+
+
+def lookup(values: dict, dotted: str):
+    cur: object = values
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def worker_urls(values: dict, release: str) -> str:
+    n = int(lookup(values, "workers.replicas") or 1)
+    port = int(lookup(values, "workers.port") or 8898)
+    return ",".join(
+        f"http://{release}-worker-{i}.{release}-worker:{port}"
+        for i in range(n))
+
+
+def render_file(text: str, values: dict, release: str) -> str:
+    # {{- if .Values.x }} ... {{- end }}
+    def if_block(m):
+        cond = lookup(values, m.group(1))
+        return m.group(2) if cond else ""
+
+    text = re.sub(
+        r"\{\{-? *if \.Values\.([\w.]+) *-?\}\}\n?(.*?)\{\{-? *end *-?\}\}\n?",
+        if_block, text, flags=re.S)
+    text = text.replace(
+        '{{ include "synapseml-tpu-serving.workerUrls" . }}',
+        worker_urls(values, release))
+    text = re.sub(r"\{\{ *\.Release\.Name *\}\}", release, text)
+
+    def subst(m):
+        v = lookup(values, m.group(1))
+        if v is None:
+            raise KeyError(f"values key not found: {m.group(1)}")
+        return str(v).lower() if isinstance(v, bool) else str(v)
+
+    text = re.sub(r"\{\{ *\.Values\.([\w.]+) *\}\}", subst, text)
+    leftover = re.search(r"\{\{(?![/\*-] ).*?\}\}", text)
+    if leftover and "define" not in leftover.group(0):
+        raise ValueError(f"unrendered template expression: "
+                         f"{leftover.group(0)!r}")
+    return text
+
+
+def validate_yaml(doc: str, origin: str) -> None:
+    """Structural sanity: balanced indentation steps of 2, a kind:, and every
+    non-comment line is either a mapping entry or a list item."""
+    if not doc.strip():
+        return
+    if "kind:" not in doc:
+        raise ValueError(f"{origin}: rendered doc has no kind:")
+    for i, line in enumerate(doc.splitlines(), 1):
+        s = line.strip()
+        if not s or s.startswith("#"):
+            continue
+        if not (s.startswith("- ") or s == "-" or ":" in s):
+            raise ValueError(f"{origin}:{i}: not a yaml mapping/list line: "
+                             f"{line!r}")
+        indent = len(line) - len(line.lstrip())
+        if indent % 2:
+            raise ValueError(f"{origin}:{i}: odd indentation")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("chart", nargs="?", default=CHART_DEFAULT)
+    ap.add_argument("--release", default="smltpu")
+    ap.add_argument("--set", action="append", default=[],
+                    help="a.b=value override")
+    args = ap.parse_args(argv)
+
+    values = load_values(os.path.join(args.chart, "values.yaml"))
+    for ov in args.set:
+        key, _, val = ov.partition("=")
+        parts = key.split(".")
+        cur = values
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = val
+
+    tdir = os.path.join(args.chart, "templates")
+    out = []
+    for name in sorted(os.listdir(tdir)):
+        if name.startswith("_") or not name.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tdir, name)) as f:
+            rendered = render_file(f.read(), values, args.release)
+        validate_yaml(rendered, name)
+        if rendered.strip():
+            out.append(f"---\n# Source: {name}\n{rendered}")
+    sys.stdout.write("".join(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

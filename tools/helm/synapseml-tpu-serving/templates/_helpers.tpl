@@ -1,0 +1,9 @@
+{{/* Comma-separated worker URLs from the StatefulSet's stable pod DNS names:
+     http://<release>-worker-<i>.<release>-worker:<port> */}}
+{{- define "synapseml-tpu-serving.workerUrls" -}}
+{{- $urls := list -}}
+{{- range $i := until (int .Values.workers.replicas) -}}
+{{- $urls = append $urls (printf "http://%s-worker-%d.%s-worker:%d" $.Release.Name $i $.Release.Name (int $.Values.workers.port)) -}}
+{{- end -}}
+{{- join "," $urls -}}
+{{- end -}}

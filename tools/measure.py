@@ -1,0 +1,139 @@
+"""On-chip measurement watcher: waits out axon TPU-terminal outages and lands
+every successful measurement in a committed artifact.
+
+The TPU terminal in this environment flaps (observed down for hours;
+VERDICT r2: the round-2 bench died on a 900s init hang while real mid-round
+measurements lived only in markdown). This watcher:
+
+  1. probes device init in SHORT throwaway subprocesses (a fresh process can
+     connect when a hung one never will — bench._probe_device_once);
+  2. the moment a probe succeeds, runs the full bench suite
+     (``python bench.py --all``), whose workloads each append to
+     ``docs/measurements.json`` with capture timestamps as they succeed —
+     a partial run that loses the terminal mid-way still keeps its numbers;
+  3. optionally runs the GBDT perf-tune A/B (``tools/perf_tune.py``),
+     tee-ing the phase breakdown to ``docs/perf_tune_onchip.log``.
+
+Usage:
+  python tools/measure.py --once          # single probe+measure attempt
+  python tools/measure.py --watch         # loop until a bench run succeeds
+  python tools/measure.py --watch --forever   # keep measuring every cycle
+  python tools/measure.py --tune          # include the perf_tune A/B pass
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _probe_device_once  # noqa: E402
+
+
+def _ts() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def run_bench(timeout_s: float) -> bool:
+    """Full bench suite; each workload self-records to measurements.json."""
+    print(f"[{_ts()}] device up — running bench.py --all", flush=True)
+    try:
+        r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                            "--all"],
+                           cwd=REPO, timeout=timeout_s, capture_output=True,
+                           text=True)
+        print(r.stdout[-2000:], flush=True)
+        if r.returncode != 0:
+            print(f"[{_ts()}] bench rc={r.returncode}: {r.stderr[-500:]}",
+                  flush=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        print(f"[{_ts()}] bench timed out after {timeout_s:.0f}s "
+              "(partial measurements, if any, are already recorded)",
+              flush=True)
+        return False
+
+
+def run_tune(timeout_s: float) -> None:
+    """GBDT hot-loop A/B; tee phase breakdown into a committed log."""
+    log = os.path.join(REPO, "docs", "perf_tune_onchip.log")
+    print(f"[{_ts()}] running perf_tune → {log}", flush=True)
+    try:
+        r = subprocess.run([sys.executable,
+                            os.path.join(REPO, "tools", "perf_tune.py")],
+                           cwd=REPO, timeout=timeout_s, capture_output=True,
+                           text=True)
+        with open(log, "a") as f:
+            f.write(f"\n===== perf_tune @ {_ts()} rc={r.returncode} =====\n")
+            f.write(r.stdout)
+            if r.returncode != 0:
+                f.write(f"\n--- stderr ---\n{r.stderr[-2000:]}\n")
+        print(r.stdout[-1500:], flush=True)
+    except subprocess.TimeoutExpired:
+        with open(log, "a") as f:
+            f.write(f"\n===== perf_tune @ {_ts()} TIMED OUT "
+                    f"({timeout_s:.0f}s) =====\n")
+
+
+def run_scale_proof(timeout_s: float, rows: int) -> None:
+    """HIGGS-scale north-star run (tools/scale_proof.py); self-records to
+    docs/scale_proof.json."""
+    print(f"[{_ts()}] running scale_proof ({rows} rows)", flush=True)
+    try:
+        r = subprocess.run([sys.executable,
+                            os.path.join(REPO, "tools", "scale_proof.py"),
+                            "--rows", str(rows)],
+                           cwd=REPO, timeout=timeout_s, capture_output=True,
+                           text=True)
+        print(r.stdout[-1500:], flush=True)
+        if r.returncode != 0:
+            print(f"[{_ts()}] scale_proof rc={r.returncode}: "
+                  f"{r.stderr[-800:]}", flush=True)
+    except subprocess.TimeoutExpired:
+        print(f"[{_ts()}] scale_proof timed out ({timeout_s:.0f}s)",
+              flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--once", action="store_true")
+    ap.add_argument("--watch", action="store_true")
+    ap.add_argument("--forever", action="store_true",
+                    help="with --watch: keep measuring every cycle instead "
+                         "of stopping after the first success")
+    ap.add_argument("--tune", action="store_true")
+    ap.add_argument("--scale", action="store_true",
+                    help="also run the HIGGS-11M scale proof after bench")
+    ap.add_argument("--scale-rows", type=int, default=11_000_000)
+    ap.add_argument("--probe-s", type=float, default=120.0)
+    ap.add_argument("--interval-s", type=float, default=300.0)
+    ap.add_argument("--bench-timeout-s", type=float, default=3600.0)
+    args = ap.parse_args()
+    if not (args.once or args.watch):
+        args.once = True
+
+    while True:
+        if _probe_device_once(args.probe_s):
+            if args.tune:
+                run_tune(args.bench_timeout_s)
+            ok = run_bench(args.bench_timeout_s)
+            if ok and args.scale:
+                run_scale_proof(args.bench_timeout_s, args.scale_rows)
+            if args.once or (ok and not args.forever):
+                return 0 if ok else 1
+        else:
+            print(f"[{_ts()}] device probe failed ({args.probe_s:.0f}s)",
+                  flush=True)
+            if args.once:
+                return 2
+        time.sleep(args.interval_s)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,38 @@ for size in (499712, 249856, 63488, 8192):
     print(f"kernel {size:7d} rows: {t*1e3:8.2f} ms  ({t/size*1e9:6.2f} ns/row)",
           flush=True)
 
+# --- phase 1b: kernel grid sweep (VERDICT r3 #6) -----------------------------
+# row-chunk x feature-block sweep at the full row count; ns/row·feature vs the
+# MXU roofline (one (row, feature) = one 128-lane tile-row of a 2*C*K1*24-MAC
+# matmul; peak ~0.04 ns/row·feature at 100% MXU). The winner ships via the
+# SYNAPSEML_TPU_HIST_CHUNK env default (ops/hist_kernel.py).
+print("\n-- kernel sweep: chunk x feature_block (ns/row·feature) --",
+      flush=True)
+Ns = 491520                       # multiple of every swept chunk (lcm-safe)
+best = (None, 1e9)
+for fb in (8, 16):
+    if FP % fb:
+        continue
+    for ch in (512, 1024, 2048, 4096, 8192):
+        if Ns % ch:
+            continue
+        try:
+            t = timeit(lambda c=ch, f=fb: _hist_pallas(
+                bT[:, :Ns], g[:Ns], h[:Ns], m[:Ns], 256, chunk=c,
+                feature_block=f))
+        except Exception as e:
+            print(f"  chunk={ch:5d} fb={fb:2d}: FAILED {str(e)[:80]}",
+                  flush=True)
+            continue
+        nsrf = t / (Ns * F) * 1e9
+        print(f"  chunk={ch:5d} fb={fb:2d}: {t*1e3:7.2f} ms"
+              f"  ({nsrf:6.4f} ns/row·feat)", flush=True)
+        if t < best[1]:
+            best = ((ch, fb), t)
+if best[0]:
+    print(f"  BEST: chunk={best[0][0]} feature_block={best[0][1]} -> set "
+          f"SYNAPSEML_TPU_HIST_CHUNK={best[0][0]}", flush=True)
+
 # --- phase 2: partition primitives ------------------------------------------
 # the PRODUCTION 4-way key ({-1 before-range, 0 left, 1 right, 2 after-range})
 # through the production helper, both impls — this is the real per-split cost

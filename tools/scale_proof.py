@@ -1,0 +1,243 @@
+"""North-star scale proof: HIGGS-11M single-chip GBDT training
+(VERDICT r2 next-round #3; BASELINE.md north star).
+
+End-to-end ``LightGBMClassifier``-level run at HIGGS scale (11M x 28 dense
+float32 — the real dataset is unreachable in the zero-egress environment,
+so the matrix is synthesized with HIGGS's shape and a learnable nonlinear
+margin), recording into ``docs/scale_proof.json``:
+
+  * rows/s for Dataset staging (binning) and for training
+    (row-iterations/s, LightGBM's parallel-experiments accounting)
+  * transform (inference) rows/s
+  * AUC (sanity: must beat 0.7 on the synthetic margin — the quality gate;
+    the reference's own CSV benchmarks carry +-0.1 tolerances)
+  * HBM footprint (live device bytes after staging / after training)
+  * per-phase breakdown (InstrumentationMeasures — LightGBMPerformance.scala
+    analog) + MFU: achieved flop/s over the chip's peak, with histogram
+    flops counted as the one-hot matmul's 2*rows*bins*3 MACs per feature
+
+Companion (``--ranker``): MSLR-WEB10K-shape LambdaRank on the 8-device CPU
+mesh — 10k queries x ~120 docs, 136 features — recording NDCG@{1,3,5,10}
+(distributed-correctness companion; runs without the chip).
+
+Usage:
+  python tools/scale_proof.py [--rows 11000000] [--out docs/scale_proof.json]
+  python tools/scale_proof.py --ranker          # CPU-mesh ranker NDCG
+  python tools/scale_proof.py --rows 200000 --platform cpu   # smoke
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def _ts() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def _device_mem_stats():
+    import jax
+
+    try:
+        s = jax.devices()[0].memory_stats() or {}
+        return {"bytes_in_use": int(s.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(s.get("peak_bytes_in_use", 0)),
+                "bytes_limit": int(s.get("bytes_limit", 0))}
+    except Exception:
+        return {}
+
+
+def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 0,
+                chunk: int = 1_000_000):
+    """HIGGS-shape dense floats with a learnable nonlinear margin; chunked
+    generation keeps host RSS bounded at 11M rows (the matrix itself is
+    ~1.2 GB f32)."""
+    rng = np.random.default_rng(seed)
+    X = np.empty((n_rows, n_feat), np.float32)
+    y = np.empty(n_rows, np.float32)
+    for lo in range(0, n_rows, chunk):
+        hi = min(lo + chunk, n_rows)
+        xb = rng.normal(size=(hi - lo, n_feat)).astype(np.float32)
+        margin = (xb[:, 0] * xb[:, 1] + 0.5 * xb[:, 2] - 0.3 * xb[:, 3] ** 2
+                  + 0.2 * rng.normal(size=hi - lo))
+        X[lo:hi] = xb
+        y[lo:hi] = margin > 0
+    return X, y
+
+
+def auc_score(y, p, sample: int = 2_000_000, seed: int = 1) -> float:
+    if len(y) > sample:
+        idx = np.random.default_rng(seed).choice(len(y), sample,
+                                                 replace=False)
+        y, p = y[idx], p[idx]
+    order = np.argsort(p)
+    ranks = np.empty(len(p), np.float64)
+    ranks[order] = np.arange(1, len(p) + 1)
+    npos = float((y > 0).sum())
+    nneg = float(len(y) - npos)
+    return float((ranks[y > 0].sum() - npos * (npos + 1) / 2)
+                 / max(npos * nneg, 1.0))
+
+
+def run_higgs(n_rows: int, num_iterations: int, out_path: str) -> dict:
+    import jax
+
+    from synapseml_tpu.core.compile_cache import enable_compile_cache
+    from synapseml_tpu.core.logging import InstrumentationMeasures
+    from synapseml_tpu.gbdt import BoosterConfig, Dataset, train_booster
+
+    enable_compile_cache()
+    platform = jax.devices()[0].platform
+    rec: dict = {"workload": "higgs_scale_proof", "captured_at": _ts(),
+                 "platform": platform, "rows": n_rows, "features": 28,
+                 "num_iterations": num_iterations, "num_leaves": 31,
+                 "max_bin": 255}
+
+    t0 = time.perf_counter()
+    X, y = synth_higgs(n_rows)
+    rec["synth_s"] = round(time.perf_counter() - t0, 2)
+
+    # --- Dataset staging (binning; LightGBM Dataset-construction phase) ----
+    t0 = time.perf_counter()
+    ds = Dataset(X, y, keep_raw=False).block_until_ready()
+    stage_s = time.perf_counter() - t0
+    rec["staging_s"] = round(stage_s, 2)
+    rec["staging_rows_per_s"] = round(n_rows / stage_s, 1)
+    rec["hbm_after_staging"] = _device_mem_stats()
+
+    # --- training ----------------------------------------------------------
+    measures = InstrumentationMeasures()
+    cfg = BoosterConfig(objective="binary", num_iterations=num_iterations)
+    t0 = time.perf_counter()
+    booster = train_booster(ds, None, cfg, measures=measures)
+    jax.block_until_ready(booster.trees[-1].leaf_value)
+    train_s = time.perf_counter() - t0
+    rec["train_s"] = round(train_s, 2)
+    row_iters = n_rows * num_iterations / train_s
+    rec["train_row_iters_per_s"] = round(row_iters, 1)
+    rec["phases"] = {k: round(v, 3) if isinstance(v, float) else v
+                     for k, v in measures.report().items()}
+    rec["hbm_after_training"] = _device_mem_stats()
+
+    # MFU: histogram MACs dominate — per tree level the masked/partition
+    # kernel touches each (row, feature) once into 256 bins x 3 accumulators
+    # via one-hot matmul: 2 * rows * 256 * 3 flops per feature-row pass, x
+    # ~2 passes per tree (smaller-child subtraction halves the work of the
+    # naive leaves x rows sweep); report the HISTOGRAM flops actually issued
+    # as a lower bound of achieved compute.
+    hist_flops_per_tree = 2 * n_rows * 28 * 256 * 3 * 2
+    achieved = hist_flops_per_tree * num_iterations / train_s
+    peak = {"tpu": 197e12, "cpu": 1e12}.get(platform, 100e12)  # bf16 peak
+    rec["hist_flops_per_s"] = f"{achieved:.3e}"
+    rec["mfu_histogram_lower_bound"] = round(achieved / peak, 4)
+
+    # --- transform (inference) --------------------------------------------
+    n_inf = min(n_rows, 2_000_000)
+    t0 = time.perf_counter()
+    pred = booster.predict(X[:n_inf])
+    inf_s = time.perf_counter() - t0
+    rec["transform_rows_per_s"] = round(n_inf / inf_s, 1)
+
+    rec["auc"] = round(auc_score(y[:n_inf], np.asarray(pred)), 4)
+    rec["auc_gate"] = rec["auc"] > 0.7
+
+    _append(out_path, rec)
+    return rec
+
+
+def run_ranker(out_path: str, n_queries: int = 10_000,
+               docs_per_query: int = 120, n_feat: int = 136,
+               num_iterations: int = 50) -> dict:
+    """MSLR-WEB10K-shape LambdaRank on the virtual 8-device CPU mesh."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from synapseml_tpu.gbdt import BoosterConfig, train_booster
+    from synapseml_tpu.gbdt.objectives import make_grouped, ndcg_at_k
+    from synapseml_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(0)
+    n = n_queries * docs_per_query
+    X = rng.normal(size=(n, n_feat)).astype(np.float32)
+    w = rng.normal(size=n_feat).astype(np.float32) / np.sqrt(n_feat)
+    util = X @ w + 0.5 * rng.normal(size=n).astype(np.float32)
+    # 5-level relevance by within-query utility quantile (MSLR labels 0-4)
+    util_q = util.reshape(n_queries, docs_per_query)
+    ranks = util_q.argsort(axis=1).argsort(axis=1) / (docs_per_query - 1)
+    y = np.floor(ranks * 5).clip(0, 4).astype(np.float32).reshape(-1)
+    sizes = np.full(n_queries, docs_per_query, np.int64)
+
+    mesh = make_mesh({"data": 8})
+    cfg = BoosterConfig(objective="lambdarank",
+                        num_iterations=num_iterations,
+                        eval_at=(1, 3, 5, 10))
+    t0 = time.perf_counter()
+    bst = train_booster(X, y, cfg, group_sizes=sizes, mesh=mesh)
+    train_s = time.perf_counter() - t0
+
+    scores = bst.predict(X)
+    gi = make_grouped(y, sizes)
+    import jax.numpy as jnp
+
+    ndcg = {f"ndcg@{k}": round(float(ndcg_at_k(
+        jnp.asarray(y), jnp.asarray(scores), gi, k)), 4)
+        for k in (1, 3, 5, 10)}
+    rec = {"workload": "mslr_web10k_shape_ranker", "captured_at": _ts(),
+           "platform": "cpu-mesh-8", "queries": n_queries,
+           "docs_per_query": docs_per_query, "features": n_feat,
+           "num_iterations": num_iterations,
+           "train_s": round(train_s, 2),
+           "train_row_iters_per_s": round(n * num_iterations / train_s, 1),
+           **ndcg,
+           "ndcg_gate": ndcg["ndcg@10"] > 0.55}
+    _append(out_path, rec)
+    return rec
+
+
+def _append(path: str, rec: dict) -> None:
+    log = []
+    if os.path.exists(path):
+        with open(path) as f:
+            log = json.load(f)
+    log.append(rec)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(log, f, indent=1)
+    print(json.dumps(rec))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=11_000_000)
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--ranker", action="store_true")
+    ap.add_argument("--ranker-iters", type=int, default=50)
+    ap.add_argument("--platform", default=None,
+                    help="pin jax platform (e.g. cpu for smoke runs)")
+    ap.add_argument("--out", default=os.path.join(REPO, "docs",
+                                                  "scale_proof.json"))
+    args = ap.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    if args.ranker:
+        run_ranker(args.out, num_iterations=args.ranker_iters)
+    else:
+        run_higgs(args.rows, args.iters, args.out)
+
+
+if __name__ == "__main__":
+    main()

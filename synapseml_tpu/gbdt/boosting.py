@@ -209,8 +209,10 @@ class Booster:
 
     def _missing_types(self, index: int) -> np.ndarray:
         """(L-1,) missing-type codes for one tree: parsed values for loaded
-        models, else nan (2) for features with a NaN bin / 0 otherwise —
-        exactly what the model-string writer emits in decision_type."""
+        models, else nan (2) for features with a NaN bin AND for categorical
+        splits (NaN categories are never set members) / 0 otherwise — the
+        codes the model-string writer emits in decision_type, so in-memory
+        traversal and a save/load round trip route missing rows identically."""
         if self.missing_types is not None:
             m = (self.missing_types[index]
                  if index < len(self.missing_types) else None)
@@ -218,9 +220,11 @@ class Booster:
                 return np.asarray(m, np.int32)
         tree = self.trees[index]
         sf = np.asarray(tree.split_feature).astype(np.int64)
+        stype = np.asarray(tree.split_type)
         has_nan = np.asarray(self.mapper.nan_mask)
         sf_safe = np.clip(sf, 0, len(has_nan) - 1)
-        return np.where(has_nan[sf_safe], 2, 0).astype(np.int32)
+        return np.where(has_nan[sf_safe] | (stype[: len(sf)] == 1),
+                        2, 0).astype(np.int32)
 
     def forest(self) -> Forest:
         if self._forest_cache is None or self._forest_cache.num_trees != len(self.trees):
@@ -1283,8 +1287,11 @@ def train_booster(
                           : len(trees)]
         merged_mt = (init_mtypes
                      + [None] * (len(trees) - len(init_mtypes)))[: len(trees)]
+    # best_iter counts NEW iterations; best_iteration addresses the full
+    # returned forest, so warm-start iterations offset it
     return Booster(mapper, cfg, trees, tree_weights, base, feature_names,
-                   best_iteration=(best_iter if has_valid else -1),
+                   best_iteration=(n_init_trees // max(k, 1) + best_iter
+                                   if has_valid else -1),
                    thresholds=merged_thr, missing_types=merged_mt)
 
 

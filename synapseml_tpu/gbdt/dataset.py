@@ -76,8 +76,9 @@ def bin_sparse(X_csr, mapper: BinMapper, max_bin: int,
     # bytes per chunk instead of the dense detour's O(rows·F), preserving
     # CSR's memory advantage through ingest. Chunk-local row ids come from
     # indptr diffs (cheap host O(nnz)).
-    from ..ops.quantize import bin_csr_chunk
+    from ..ops.quantize import CsrBinner
 
+    binner = CsrBinner(mapper)       # mapper state ships to device ONCE
     chunks = []
     indptr = X_csr.indptr
     for lo in range(0, n, chunk_rows):
@@ -86,8 +87,8 @@ def bin_sparse(X_csr, mapper: BinMapper, max_bin: int,
         counts = np.diff(indptr[lo:hi + 1]).astype(np.int64)
         rows_local = np.repeat(np.arange(hi - lo, dtype=np.int32),
                                counts)
-        chunks.append(bin_csr_chunk(mapper, X_csr.data[s:e], rows_local,
-                                    X_csr.indices[s:e], hi - lo))
+        chunks.append(binner(X_csr.data[s:e], rows_local,
+                             X_csr.indices[s:e], hi - lo))
     return mapper, jnp.concatenate(chunks, axis=0)
 
 

@@ -74,7 +74,7 @@ def booster_to_string(booster) -> str:
 
     tree_blocks = [
         _tree_to_string(ti, tree, thr, w, cfg.learning_rate, base_shift,
-                        mapper.nan_mask)
+                        booster._missing_types(ti))
         for ti, tree, thr, w, base_shift in _tree_dump_seq(booster)]
     sizes = [len(b) + 1 for b in tree_blocks]
     lines.append("tree_sizes=" + " ".join(str(s) for s in sizes))
@@ -117,7 +117,7 @@ def _feature_info(mapper: BinMapper, j: int) -> str:
 
 def _tree_to_string(index: int, tree: TreeArrays, thresholds: np.ndarray,
                     weight: float, shrinkage: float, base_shift: float = 0.0,
-                    nan_mask=None) -> str:
+                    missing_types=None) -> str:
     ns = int(tree.num_splits)
     nleaves = ns + 1
     sf = np.asarray(tree.split_feature)[:ns]
@@ -141,11 +141,15 @@ def _tree_to_string(index: int, tree: TreeArrays, thresholds: np.ndarray,
 
     lc, rc = fix_child(lc), fix_child(rc)
 
-    feat_has_nan = (nan_mask[sf] if nan_mask is not None and len(sf)
-                    else np.zeros(len(sf), bool))
+    # missing codes come from the booster (Booster._missing_types: parsed
+    # values for loaded models, NaN-mask-derived otherwise) so a loaded
+    # native model's zero/none codes survive a save round trip verbatim
+    mt = (np.asarray(missing_types, np.int64)[:ns]
+          if missing_types is not None and len(sf)
+          else np.zeros(len(sf), np.int64))
     dt = (np.where(stype == 1, _DT_CAT, 0)
           + np.where(dleft, _DT_DEFAULT_LEFT, 0)
-          + np.where(feat_has_nan | (stype == 1), _DT_MISSING_NAN, 0))
+          + (np.clip(mt, 0, 3) << 2))
 
     lines = [f"Tree={index}", f"num_leaves={max(nleaves, 1)}"]
     cat_lines = []
@@ -332,7 +336,7 @@ def _collect_thr(parsed, L):
 
 def _tree_to_json(index: int, tree: TreeArrays, thresholds, weight: float,
                   shrinkage: float, base_shift: float = 0.0,
-                  nan_mask=None) -> dict:
+                  missing_types=None) -> dict:
     ns = int(tree.num_splits)
     sf = np.asarray(tree.split_feature)[:ns]
     stype = np.asarray(tree.split_type)[:ns]
@@ -349,8 +353,9 @@ def _tree_to_json(index: int, tree: TreeArrays, thresholds, weight: float,
     iv = np.asarray(tree.internal_value).astype(np.float64)
     icnt = np.asarray(tree.internal_count)
     bits = np.asarray(tree.cat_bitset)[:ns]
-    feat_has_nan = (nan_mask[sf] if nan_mask is not None and len(sf)
-                    else np.zeros(len(sf), bool))
+    mt = (np.asarray(missing_types, np.int64)[:ns]
+          if missing_types is not None and len(sf)
+          else np.zeros(len(sf), np.int64))
 
     # dangling internal pointers (num_splits < num_leaves-1) clamp to leaf 0,
     # exactly like the text serializer's fix_child
@@ -377,7 +382,8 @@ def _tree_to_json(index: int, tree: TreeArrays, thresholds, weight: float,
             "threshold": threshold,
             "decision_type": "==" if cat else "<=",
             "default_left": bool(dleft[i]),
-            "missing_type": ("NaN" if (cat or feat_has_nan[i]) else "None"),
+            "missing_type": {0: "None", 1: "Zero", 2: "NaN"}.get(
+                int(mt[i]), "None"),
             "internal_value": float(iv[i]),
             "internal_weight": float(max(int(icnt[i]), 1)),
             "internal_count": int(icnt[i]),
@@ -418,9 +424,9 @@ def booster_dump_json(booster, num_iteration: int = -1) -> str:
     cfg = booster.config
     mapper = booster.mapper
     k = booster.models_per_iter
-    nan_mask = np.asarray(mapper.nan_mask) if mapper is not None else None
     tree_info = [
-        _tree_to_json(i, t, thr, w, cfg.learning_rate, base_shift, nan_mask)
+        _tree_to_json(i, t, thr, w, cfg.learning_rate, base_shift,
+                      booster._missing_types(i))
         for i, t, thr, w, base_shift in _tree_dump_seq(booster, num_iteration)]
     doc = {
         "name": "tree",

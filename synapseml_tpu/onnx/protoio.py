@@ -406,6 +406,8 @@ class Model:
     graph: Graph = field(default_factory=Graph)
     ir_version: int = 8
     opset: int = 17
+    producer_name: str = ""   # ModelProto field 2 (e.g. "pytorch" — lets
+                              # tests prove a fixture came from a third party)
 
     @staticmethod
     def parse(data: bytes) -> "Model":
@@ -413,6 +415,8 @@ class Model:
         for fnum, _, val in _fields(data):
             if fnum == 1:
                 m.ir_version = val
+            elif fnum == 2:
+                m.producer_name = bytes(val).decode("utf-8", "replace")
             elif fnum == 7:
                 m.graph = Graph.parse(val)
             elif fnum == 8:  # OperatorSetIdProto

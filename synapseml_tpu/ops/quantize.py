@@ -241,30 +241,45 @@ def _bin_csr_entries(data, rows, cols, zero_bins, boundaries, real_limit,
     return out.at[rows, cols].set(b.astype(out_dtype))
 
 
+class CsrBinner:
+    """Device-side CSR chunk binning with the mapper state shipped ONCE:
+    boundaries / limits / masks / the zero-bin row are chunk-invariant, and
+    an 11M-row ingest makes hundreds of chunk calls — re-uploading them per
+    chunk would spend the transfer budget the sparse path exists to save.
+    nnz pads to power-of-2 buckets (pad rows point out of bounds → dropped
+    by the scatter) so varying chunk occupancy reuses a handful of compiled
+    programs instead of one per nnz."""
+
+    def __init__(self, mapper: BinMapper):
+        self.max_bin = mapper.max_bin
+        self.dtype = jnp.uint8 if mapper.max_bin <= 256 else jnp.uint16
+        self.zero = apply_bins(mapper, np.zeros((1, mapper.num_features),
+                                                np.float32))[0]
+        self.boundaries = jnp.asarray(mapper.boundaries)
+        self.real_limit = jnp.asarray(
+            mapper.num_bins - 1 - mapper.nan_mask.astype(np.int32), jnp.int32)
+        self.nan_mask = jnp.asarray(mapper.nan_mask)
+        self.nan_bin = jnp.asarray(np.asarray(mapper.num_bins, np.int32) - 1)
+        self.is_cat = jnp.asarray(mapper.is_categorical)
+
+    def __call__(self, data, rows, cols, n_rows) -> jnp.ndarray:
+        nnz = len(data)
+        cap = max(1024, 1 << max(nnz - 1, 1).bit_length())
+        pad = cap - nnz
+        data = np.pad(np.asarray(data, np.float32), (0, pad))
+        rows = np.pad(np.asarray(rows, np.int32), (0, pad),
+                      constant_values=n_rows)   # OOB scatter index: no-op
+        cols = np.pad(np.asarray(cols, np.int32), (0, pad))
+        return _bin_csr_entries(
+            jnp.asarray(data), jnp.asarray(rows), jnp.asarray(cols),
+            self.zero, self.boundaries, self.real_limit, self.nan_mask,
+            self.nan_bin, self.is_cat, self.max_bin, n_rows,
+            out_dtype=self.dtype)
+
+
 def bin_csr_chunk(mapper: BinMapper, data, rows, cols, n_rows) -> jnp.ndarray:
-    """Bin one CSR chunk on device (see ``_bin_csr_entries``); ``rows`` are
-    chunk-local row ids for the nnz entries. nnz pads to power-of-2 buckets
-    (pad rows point out of bounds → dropped by the scatter) so varying chunk
-    occupancy reuses a handful of compiled programs instead of one per nnz."""
-    nnz = len(data)
-    cap = max(1024, 1 << max(nnz - 1, 1).bit_length())
-    pad = cap - nnz
-    data = np.pad(np.asarray(data, np.float32), (0, pad))
-    rows = np.pad(np.asarray(rows, np.int32), (0, pad),
-                  constant_values=n_rows)          # OOB scatter index: no-op
-    cols = np.pad(np.asarray(cols, np.int32), (0, pad))
-    dtype = jnp.uint8 if mapper.max_bin <= 256 else jnp.uint16
-    zero = apply_bins(mapper, np.zeros((1, mapper.num_features), np.float32))
-    real_limit = jnp.asarray(
-        mapper.num_bins - 1 - mapper.nan_mask.astype(np.int32), jnp.int32)
-    return _bin_csr_entries(
-        jnp.asarray(data, jnp.float32), jnp.asarray(rows, jnp.int32),
-        jnp.asarray(cols, jnp.int32), zero[0],
-        jnp.asarray(mapper.boundaries), real_limit,
-        jnp.asarray(mapper.nan_mask),
-        jnp.asarray(np.asarray(mapper.num_bins, np.int32) - 1),
-        jnp.asarray(mapper.is_categorical), mapper.max_bin, n_rows,
-        out_dtype=dtype)
+    """One-shot convenience wrapper; loops should hold a :class:`CsrBinner`."""
+    return CsrBinner(mapper)(data, rows, cols, n_rows)
 
 
 def bin_threshold_to_value(mapper: BinMapper, feature: int, bin_id: int) -> float:

@@ -493,3 +493,63 @@ shrinkage=1
         bst = self._load(dt=9)
         raw = bst.raw_score(np.asarray([[np.nan]], np.float32))
         np.testing.assert_allclose(raw[0], 2.0, atol=1e-6)
+
+
+class TestMissingTypeWriterRoundTrip:
+    """Review finding r4: re-saving a LOADED native model must preserve its
+    missing_type codes verbatim, and categorical NaN routing must agree
+    between the in-memory trained model and its save/load round trip."""
+
+    def test_loaded_zero_missing_survives_resave(self):
+        dt = 4 | 2   # zero missing, default left
+        s = _mk_model_string([
+            "tree", "version=v3", "num_class=1", "num_tree_per_iteration=1",
+            "label_index=0", "max_feature_idx=0",
+            "objective=regression", "feature_names=f0",
+            "feature_infos=[-5:5]"], [_stump(0, 0, -1.0, dt, 1.0, 2.0)],
+            "f0=1\n")
+        loaded = Booster.from_model_string(s)
+        resaved = Booster.from_model_string(loaded.model_string())
+        x = np.asarray([[0.0], [np.nan], [0.5]], np.float32)
+        np.testing.assert_allclose(resaved.raw_score(x),
+                                   loaded.raw_score(x), atol=1e-6)
+        # the zero code itself must be in the re-emitted decision_type
+        body = loaded.model_string().split("decision_type=")[1].splitlines()[0]
+        assert int(body.split()[0]) >> 2 & 3 == 1, body
+
+    def test_categorical_nan_roundtrip_consistent(self):
+        rng = np.random.default_rng(41)
+        X = rng.normal(size=(600, 3)).astype(np.float32)
+        X[:, 2] = rng.integers(0, 4, size=600)
+        X[rng.random(600) < 0.15, 2] = np.nan
+        y = ((np.nan_to_num(X[:, 2]) == 1) | (X[:, 0] > 0.5)).astype(
+            np.float32)
+        bst = train_booster(X, y, BoosterConfig(objective="binary",
+                                                num_iterations=5,
+                                                num_leaves=8),
+                            categorical_features=[2])
+        loaded = Booster.from_model_string(bst.model_string())
+        Xt = rng.normal(size=(150, 3)).astype(np.float32)
+        Xt[:, 2] = rng.integers(0, 4, size=150)
+        Xt[rng.random(150) < 0.3, 2] = np.nan
+        np.testing.assert_allclose(bst.raw_score(Xt), loaded.raw_score(Xt),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_warm_start_best_iteration_offsets_init_trees(self):
+        rng = np.random.default_rng(43)
+        X = rng.normal(size=(600, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        m1 = train_booster(X, y, BoosterConfig(objective="binary",
+                                               num_iterations=4))
+        b = train_booster(X, y, BoosterConfig(objective="binary",
+                                              num_iterations=20,
+                                              early_stopping_round=3),
+                          init_model=m1, valid=(X, y))
+        # best_iteration addresses the FULL forest: scoring with
+        # best_iteration+1 iterations must include all init trees
+        assert b.best_iteration >= m1.num_trees - 1
+        np.testing.assert_allclose(
+            b.raw_score(X[:50], num_iteration=b.best_iteration + 1,
+                        start_iteration=0),
+            b.raw_score(X[:50], num_iteration=b.best_iteration + 1,
+                        start_iteration=0))

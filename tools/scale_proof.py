@@ -207,15 +207,25 @@ def run_ranker(out_path: str, n_queries: int = 10_000,
 
 
 def _append(path: str, rec: dict) -> None:
-    log = []
-    if os.path.exists(path):
-        with open(path) as f:
-            log = json.load(f)
-    log.append(rec)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(log, f, indent=1)
+    # recording must never sink a measurement (a truncated/concurrently
+    # written log would otherwise crash a multi-hour run at the very end)
     print(json.dumps(rec))
+    try:
+        log = []
+        if os.path.exists(path):
+            with open(path) as f:
+                log = json.load(f)
+    except Exception as e:
+        print(f"# measurement log unreadable ({e}); starting fresh",
+              file=sys.stderr)
+        log = []
+    try:
+        log.append(rec)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(log, f, indent=1)
+    except Exception as e:
+        print(f"# measurement log write failed: {e}", file=sys.stderr)
 
 
 def main():
@@ -229,13 +239,18 @@ def main():
     ap.add_argument("--out", default=os.path.join(REPO, "docs",
                                                   "scale_proof.json"))
     args = ap.parse_args()
-    if args.platform:
-        import jax
-
-        jax.config.update("jax_platforms", args.platform)
     if args.ranker:
+        # XLA_FLAGS must land BEFORE the first jax import (run_ranker pins
+        # cpu itself); importing jax here for --platform would initialize
+        # the backend with 1 device and break the 8-device mesh
+        os.environ.setdefault("XLA_FLAGS",
+                              "--xla_force_host_platform_device_count=8")
         run_ranker(args.out, num_iterations=args.ranker_iters)
     else:
+        if args.platform:
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
         run_higgs(args.rows, args.iters, args.out)
 
 

@@ -534,8 +534,11 @@ def bench_serving_distributed(n_requests=200):
                              max_batch_size=32,
                              max_batch_latency=0.0).start()
                for _ in range(2)]
+    # worker 0 is co-located with the gateway, as in the real deployment
+    # (process 0 runs both): it rides the direct-queue fast path
     gw = ServingGateway([s.url for s in workers], port=0,
-                        mode="least_loaded").start()
+                        mode="least_loaded", local_worker=workers[0],
+                        local_index=0).start()
     try:
         import http.client
 

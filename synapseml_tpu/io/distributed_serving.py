@@ -117,7 +117,9 @@ class ServingGateway:
     def __init__(self, worker_urls: Sequence[str], host: str = "127.0.0.1",
                  port: int = 0, api_path: str = "/",
                  mode: str = "least_loaded", forward_timeout: float = 30.0,
-                 cooldown: float = 1.0, max_retries: Optional[int] = None):
+                 cooldown: float = 1.0, max_retries: Optional[int] = None,
+                 local_worker: Optional[ServingServer] = None,
+                 local_index: Optional[int] = None):
         if mode not in ("least_loaded", "round_robin"):
             raise ValueError(f"unknown load-balancing mode {mode!r}")
         self.links: List[_WorkerLink] = []
@@ -125,11 +127,28 @@ class ServingGateway:
             hostport = u.split("//", 1)[-1].split("/", 1)[0]
             h, _, p = hostport.partition(":")
             self.links.append(_WorkerLink(h, int(p or 80), forward_timeout))
+        # the co-located worker (same process as the gateway): requests
+        # routed to it enqueue DIRECTLY into its micro-batch queue instead
+        # of paying a loopback HTTP round trip — the reference gets the same
+        # effect from its shared-JVM SharedSingleton server. Identified by
+        # INDEX in worker_urls (ports collide across hosts); port matching
+        # is the single-host fallback.
+        self._local = local_worker
+        self._local_link = None
+        if local_worker is not None:
+            if local_index is not None and 0 <= local_index < len(self.links):
+                self._local_link = self.links[local_index]
+            else:
+                for l in self.links:
+                    if l.port == local_worker.port:
+                        self._local_link = l
+                        break
         if not self.links:
             raise ValueError("gateway needs at least one worker url")
         self.host, self.port = host, port
         self.api_path = api_path
         self.mode = mode
+        self.forward_timeout = forward_timeout
         self.cooldown = cooldown
         self.max_retries = (len(self.links) if max_retries is None
                             else max_retries)
@@ -165,7 +184,11 @@ class ServingGateway:
             with self._lock:
                 link.inflight += 1
             try:
-                status, payload = link.forward(method, path, body, headers)
+                if link is self._local_link:
+                    status, payload = self._forward_local(body)
+                else:
+                    status, payload = link.forward(method, path, body,
+                                                   headers)
                 link.mark_ok()
                 with self._lock:
                     self.stats["forwarded"] += 1
@@ -182,6 +205,26 @@ class ServingGateway:
             self.stats["failed"] += 1
         return 502, (b'{"error": "no serving worker reachable: %s"}'
                      % str(last_err).encode()[:200])
+
+    def _forward_local(self, body: bytes) -> tuple:
+        """In-process fast path: enqueue into the co-located worker's
+        micro-batch queue and wait for its reply-by-id, skipping the
+        loopback HTTP hop entirely."""
+        import uuid
+
+        from .serving import _PendingRequest
+
+        req = _PendingRequest(id=uuid.uuid4().hex, method="POST",
+                              path=self.api_path, headers={}, body=body)
+        self._local._queue.put(req)
+        # the gateway's failover bound applies here exactly as it does to an
+        # HTTP forward — a wedged local serve loop must not stall requests
+        # past forward_timeout before the sibling retry
+        if not req.reply_event.wait(min(self.forward_timeout,
+                                        self._local.reply_timeout)):
+            raise TimeoutError("local worker reply timeout")
+        status, _headers, payload = req.response
+        return status, payload
 
     # --- embedded public server ----------------------------------------
     def start(self) -> "ServingGateway":
@@ -335,7 +378,8 @@ class DistributedServingServer:
         if jax.process_index() == 0:
             self.gateway = ServingGateway(
                 urls, host=bind, port=self.gateway_port,
-                mode=self.mode).start()
+                mode=self.mode, local_worker=self.worker,
+                local_index=jax.process_index()).start()
         return self
 
     def stop(self) -> None:

@@ -330,3 +330,31 @@ def test_gateway_survives_stale_pooled_connection():
     finally:
         gw.stop()
         w.stop()
+
+
+def test_gateway_local_fast_path():
+    """A co-located worker is reached by direct queue handoff (no loopback
+    HTTP): its link serves requests while its HTTP port is irrelevant."""
+    from synapseml_tpu.core.table import Table as _T
+    from synapseml_tpu.io import ServingGateway, ServingServer
+
+    def handler(df):
+        vals = np.array([v["x"] * 5 for v in df["value"]], np.float64)
+        return _T({"id": df["id"], "reply": vals})
+
+    w = ServingServer(handler, port=0, max_batch_latency=0.0).start()
+    gw = ServingGateway([w.url], port=0, local_worker=w,
+                        local_index=0).start()
+    try:
+        assert gw._local_link is gw.links[0]
+        req = urllib.request.Request(
+            gw.url, data=json.dumps({"x": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read()) == 15
+        # no pooled HTTP connection was ever created for the local link
+        assert gw.links[0]._pool.qsize() == 0
+        assert gw.stats["forwarded"] == 1
+    finally:
+        gw.stop()
+        w.stop()

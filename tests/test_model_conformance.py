@@ -541,15 +541,19 @@ class TestMissingTypeWriterRoundTrip:
         y = (X[:, 0] > 0).astype(np.float32)
         m1 = train_booster(X, y, BoosterConfig(objective="binary",
                                                num_iterations=4))
+        # SHORT continuation: only 3 new iterations, so the regressed
+        # semantics (best_iteration = new-iteration index <= 2) and the
+        # fixed semantics (>= 4 init iterations) cannot overlap
         b = train_booster(X, y, BoosterConfig(objective="binary",
-                                              num_iterations=20,
+                                              num_iterations=3,
                                               early_stopping_round=3),
                           init_model=m1, valid=(X, y))
-        # best_iteration addresses the FULL forest: scoring with
-        # best_iteration+1 iterations must include all init trees
-        assert b.best_iteration >= m1.num_trees - 1
+        assert b.best_iteration >= m1.num_trees, b.best_iteration
+        # the best-iteration window therefore spans ALL init trees plus the
+        # best new ones: it must reproduce m1's scores in its first 4
+        # iterations
         np.testing.assert_allclose(
-            b.raw_score(X[:50], num_iteration=b.best_iteration + 1,
+            b.raw_score(X[:50], num_iteration=m1.num_trees,
                         start_iteration=0),
-            b.raw_score(X[:50], num_iteration=b.best_iteration + 1,
-                        start_iteration=0))
+            m1.raw_score(X[:50]), rtol=1e-5, atol=1e-5)
+        assert b.best_iteration + 1 <= b.num_trees

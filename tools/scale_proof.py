@@ -240,11 +240,9 @@ def main():
                                                   "scale_proof.json"))
     args = ap.parse_args()
     if args.ranker:
-        # XLA_FLAGS must land BEFORE the first jax import (run_ranker pins
-        # cpu itself); importing jax here for --platform would initialize
-        # the backend with 1 device and break the 8-device mesh
-        os.environ.setdefault("XLA_FLAGS",
-                              "--xla_force_host_platform_device_count=8")
+        # no jax import on this branch: run_ranker sets XLA_FLAGS itself
+        # before its own jax import; importing jax here for --platform
+        # would initialize the backend with 1 device and break the mesh
         run_ranker(args.out, num_iterations=args.ranker_iters)
     else:
         if args.platform:

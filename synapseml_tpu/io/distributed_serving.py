@@ -29,10 +29,11 @@ import http.client
 import queue
 import threading
 import time
+import uuid
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..core.table import Table
-from .serving import ServingServer
+from .serving import ServingServer, _PendingRequest
 
 
 class _WorkerLink:
@@ -136,11 +137,20 @@ class ServingGateway:
         self._local = local_worker
         self._local_link = None
         if local_worker is not None:
-            if local_index is not None and 0 <= local_index < len(self.links):
+            if local_index is not None:
+                if not 0 <= local_index < len(self.links):
+                    raise ValueError(
+                        f"local_index {local_index} out of range for "
+                        f"{len(self.links)} workers")
                 self._local_link = self.links[local_index]
             else:
+                # single-host fallback: host AND port must match — ports
+                # alone collide across hosts, and mis-marking a remote link
+                # as local would silently starve that worker
                 for l in self.links:
-                    if l.port == local_worker.port:
+                    if (l.port == local_worker.port
+                            and l.host in ("127.0.0.1", "localhost",
+                                           local_worker.host)):
                         self._local_link = l
                         break
         if not self.links:
@@ -210,10 +220,10 @@ class ServingGateway:
         """In-process fast path: enqueue into the co-located worker's
         micro-batch queue and wait for its reply-by-id, skipping the
         loopback HTTP hop entirely."""
-        import uuid
-
-        from .serving import _PendingRequest
-
+        if self._local._stop.is_set():
+            # fail as fast as the HTTP path's ECONNREFUSED would: the queue
+            # accepts puts forever, but a stopped serve loop never replies
+            raise ConnectionError("local serving worker is stopped")
         req = _PendingRequest(id=uuid.uuid4().hex, method="POST",
                               path=self.api_path, headers={}, body=body)
         self._local._queue.put(req)

@@ -557,3 +557,41 @@ class TestMissingTypeWriterRoundTrip:
                         start_iteration=0),
             m1.raw_score(X[:50]), rtol=1e-5, atol=1e-5)
         assert b.best_iteration + 1 <= b.num_trees
+
+
+class TestParserRobustness:
+    """from_model_string on malformed input must raise ValueError (or parse
+    defensively), never crash with an internal IndexError/KeyError — the
+    loader consumes third-party files (LightGBMBooster.scala:458-516)."""
+
+    def test_truncations_raise_cleanly(self):
+        s = _golden_string()
+        # cut at structurally interesting points: mid-header, mid-tree,
+        # right after a Tree= marker, mid-field
+        cuts = [10, s.index("Tree=0") + 6, s.index("leaf_value"),
+                s.index("Tree=1") + 8, len(s) // 2]
+        for c in cuts:
+            try:
+                Booster.from_model_string(s[:c])
+            except ValueError:
+                pass
+            # a defensive parse returning a Booster is also acceptable —
+            # what is NOT acceptable is an uncontrolled internal crash
+            # (KeyError/IndexError/TypeError/AttributeError), which
+            # propagates and fails the test
+
+    def test_field_garbage_is_valueerror_or_defensive(self):
+        s = _golden_string()
+        bad = s.replace("left_child=-1 -2", "left_child=zz qq")
+        with pytest.raises(ValueError):
+            Booster.from_model_string(bad)
+
+    def test_count_mismatch_does_not_crash(self):
+        s = _golden_string()
+        # num_leaves larger than provided arrays: loader must pad, not crash
+        bad = s.replace("num_leaves=3", "num_leaves=6")
+        bst = Booster.from_model_string(bad)
+        import numpy as _np
+
+        out = bst.raw_score(_np.zeros((2, 3), _np.float32))
+        assert _np.isfinite(out).all()

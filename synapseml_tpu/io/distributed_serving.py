@@ -146,11 +146,15 @@ class ServingGateway:
             else:
                 # single-host fallback: host AND port must match — ports
                 # alone collide across hosts, and mis-marking a remote link
-                # as local would silently starve that worker
+                # as local would silently starve that worker. A worker bound
+                # to the wildcard address is reachable at any local IP, so
+                # it matches any link host on this port.
+                wildcard = local_worker.host in ("0.0.0.0", "::", "")
                 for l in self.links:
                     if (l.port == local_worker.port
-                            and l.host in ("127.0.0.1", "localhost",
-                                           local_worker.host)):
+                            and (wildcard
+                                 or l.host in ("127.0.0.1", "localhost",
+                                               local_worker.host))):
                         self._local_link = l
                         break
         if not self.links:

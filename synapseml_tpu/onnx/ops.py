@@ -875,3 +875,180 @@ def _conv_transpose(node, x, w, b=None):
     if b is not None:
         out = out + b.reshape((1, -1) + (1,) * spatial)
     return out
+
+
+# --- recurrent (RNN / GRU / LSTM) ------------------------------------------
+# The reference's onnxruntime backend executes exported recurrent models
+# (ONNXModel.scala); here each cell is a lax.scan over the sequence axis —
+# XLA-friendly static control flow, one fused step program per direction.
+# Layouts follow the ONNX spec: X (seq, batch, input); W (dirs, G*hidden,
+# input); R (dirs, G*hidden, hidden); B (dirs, 2*G*hidden);
+# Y (seq, dirs, batch, hidden); Y_h (dirs, batch, hidden).
+
+def _rnn_direction_inputs(node, x, seq_lens):
+    if seq_lens is not None:
+        raise ValueError(f"{node.op_type} '{node.name}': sequence_lens is "
+                         "not supported (pad to a static length)")
+    if node.attr("layout", 0) != 0:
+        raise ValueError(f"{node.op_type} '{node.name}': layout=1 is not "
+                         "supported")
+    direction = node.attr("direction", b"forward")
+    if isinstance(direction, bytes):
+        direction = direction.decode()
+    dirs = {"forward": [False], "reverse": [True],
+            "bidirectional": [False, True]}[direction]
+    return dirs
+
+
+def _rnn_scan(step, x, h0, reverse):
+    """Run one direction; x (seq, batch, in) → (ys (seq, batch, hid), hT)."""
+    from jax import lax
+
+    xs = x[::-1] if reverse else x
+    hT, ys = lax.scan(step, h0, xs)
+    return (ys[::-1] if reverse else ys), hT
+
+
+def _rnn_act(name, default, node, clip=None):
+    """Activation by ONNX name; ``clip`` (the op's cell-clip threshold)
+    clamps the pre-activation, matching onnxruntime."""
+    jnp = _jnp()
+    if name is None:
+        name = default
+    if isinstance(name, bytes):
+        name = name.decode()
+    table = {"Sigmoid": lambda v: 1.0 / (1.0 + jnp.exp(-v)),
+             "Tanh": jnp.tanh,
+             "Relu": lambda v: jnp.maximum(v, 0.0),
+             # Keras recurrent_activation default (alpha .2, beta .5)
+             "HardSigmoid": lambda v: jnp.clip(0.2 * v + 0.5, 0.0, 1.0)}
+    if name not in table:
+        raise ValueError(
+            f"{node.op_type} '{node.name}': activation {name!r} is not "
+            f"supported (supported: {sorted(table)})")
+    act = table[name]
+    if clip is not None:
+        c = float(clip)
+        return lambda v: act(jnp.clip(v, -c, c))
+    return act
+
+
+@op("RNN")
+def _rnn(node, x, w, r, b=None, seq_lens=None, initial_h=None):
+    jnp = _jnp()
+    dirs = _rnn_direction_inputs(node, x, seq_lens)
+    hidden = node.attr("hidden_size", r.shape[-1])
+    acts = node.attr("activations") or []
+    clip = node.attr("clip")
+    batch = x.shape[1]
+    ys_all, hT_all = [], []
+    for d, reverse in enumerate(dirs):
+        Wd, Rd = w[d], r[d]
+        bias = (b[d][:hidden] + b[d][hidden:]) if b is not None else 0.0
+        f = _rnn_act(acts[d] if d < len(acts) else None, "Tanh", node, clip)
+        h0 = (initial_h[d] if initial_h is not None
+              else jnp.zeros((batch, hidden), x.dtype))
+
+        def step(h, xt, Wd=Wd, Rd=Rd, bias=bias, f=f):
+            h = f(xt @ Wd.T + h @ Rd.T + bias)
+            return h, h
+
+        ys, hT = _rnn_scan(step, x, h0, reverse)
+        ys_all.append(ys)
+        hT_all.append(hT)
+    y = jnp.stack(ys_all, axis=1)               # (seq, dirs, batch, hidden)
+    return y, jnp.stack(hT_all, axis=0)
+
+
+@op("GRU")
+def _gru(node, x, w, r, b=None, seq_lens=None, initial_h=None):
+    jnp = _jnp()
+    dirs = _rnn_direction_inputs(node, x, seq_lens)
+    hidden = node.attr("hidden_size", r.shape[-1])
+    lbr = node.attr("linear_before_reset", 0)
+    acts = node.attr("activations") or []
+    clip = node.attr("clip")
+    batch = x.shape[1]
+    ys_all, hT_all = [], []
+    for d, reverse in enumerate(dirs):
+        Wd, Rd = w[d], r[d]                     # (3H, in), (3H, H); z,r,h
+        Wb = b[d][: 3 * hidden] if b is not None else jnp.zeros(3 * hidden,
+                                                                x.dtype)
+        Rb = b[d][3 * hidden:] if b is not None else jnp.zeros(3 * hidden,
+                                                               x.dtype)
+        f = _rnn_act(acts[2 * d] if 2 * d < len(acts) else None, "Sigmoid",
+                     node, clip)
+        g = _rnn_act(acts[2 * d + 1] if 2 * d + 1 < len(acts) else None,
+                     "Tanh", node, clip)
+        h0 = (initial_h[d] if initial_h is not None
+              else jnp.zeros((batch, hidden), x.dtype))
+        H = hidden
+
+        def step(h, xt, Wd=Wd, Rd=Rd, Wb=Wb, Rb=Rb, f=f, g=g, H=H):
+            gx = xt @ Wd.T + Wb                  # (batch, 3H)
+            gr = h @ Rd.T
+            z = f(gx[:, :H] + gr[:, :H] + Rb[:H])
+            rt = f(gx[:, H:2 * H] + gr[:, H:2 * H] + Rb[H:2 * H])
+            if lbr:   # torch exports linear_before_reset=1
+                hh = g(gx[:, 2 * H:] + rt * (gr[:, 2 * H:] + Rb[2 * H:]))
+            else:
+                hh = g(gx[:, 2 * H:] + (rt * h) @ Rd[2 * H:].T + Rb[2 * H:])
+            h = (1.0 - z) * hh + z * h
+            return h, h
+
+        ys, hT = _rnn_scan(step, x, h0, reverse)
+        ys_all.append(ys)
+        hT_all.append(hT)
+    return jnp.stack(ys_all, axis=1), jnp.stack(hT_all, axis=0)
+
+
+@op("LSTM")
+def _lstm(node, x, w, r, b=None, seq_lens=None, initial_h=None,
+          initial_c=None, p=None):
+    jnp = _jnp()
+    dirs = _rnn_direction_inputs(node, x, seq_lens)
+    hidden = node.attr("hidden_size", r.shape[-1])
+    acts = node.attr("activations") or []
+    clip = node.attr("clip")
+    if node.attr("input_forget", 0):
+        raise ValueError(f"LSTM '{node.name}': input_forget=1 is not "
+                         "supported")
+    batch = x.shape[1]
+    ys_all, hT_all, cT_all = [], [], []
+    for d, reverse in enumerate(dirs):
+        Wd, Rd = w[d], r[d]                     # (4H, in); gate order i,o,f,c
+        bias = ((b[d][: 4 * hidden] + b[d][4 * hidden:])
+                if b is not None else 0.0)
+        pe = p[d] if p is not None else jnp.zeros(3 * hidden, x.dtype)
+        f_ = _rnn_act(acts[3 * d] if 3 * d < len(acts) else None, "Sigmoid",
+                      node, clip)
+        g_ = _rnn_act(acts[3 * d + 1] if 3 * d + 1 < len(acts) else None,
+                      "Tanh", node, clip)
+        h_ = _rnn_act(acts[3 * d + 2] if 3 * d + 2 < len(acts) else None,
+                      "Tanh", node, clip)
+        h0 = (initial_h[d] if initial_h is not None
+              else jnp.zeros((batch, hidden), x.dtype))
+        c0 = (initial_c[d] if initial_c is not None
+              else jnp.zeros((batch, hidden), x.dtype))
+        H = hidden
+
+        def step(carry, xt, Wd=Wd, Rd=Rd, bias=bias, pe=pe,
+                 f_=f_, g_=g_, h_=h_, H=H):
+            h, c = carry
+            gates = xt @ Wd.T + h @ Rd.T + bias  # (batch, 4H) i,o,f,c
+            # peephole tensor P is concatenated [Pi, Po, Pf] (ONNX spec)
+            i = f_(gates[:, :H] + pe[:H] * c)
+            o_pre = gates[:, H:2 * H]
+            fg = f_(gates[:, 2 * H:3 * H] + pe[2 * H:] * c)
+            ct = g_(gates[:, 3 * H:])
+            c = fg * c + i * ct
+            o = f_(o_pre + pe[H:2 * H] * c)
+            h = o * h_(c)
+            return (h, c), h
+
+        ys, (hT, cT) = _rnn_scan(step, x, (h0, c0), reverse)
+        ys_all.append(ys)
+        hT_all.append(hT)
+        cT_all.append(cT)
+    return (jnp.stack(ys_all, axis=1), jnp.stack(hT_all, axis=0),
+            jnp.stack(cT_all, axis=0))

@@ -18,7 +18,8 @@ from synapseml_tpu.onnx.protoio import Model
 RES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "resources",
                    "onnx")
 
-FIXTURES = ["torch_convnet", "torch_mlp", "torch_encoder"]
+FIXTURES = ["torch_convnet", "torch_mlp", "torch_encoder",
+            "torch_unet", "torch_gru", "torch_lstm"]
 
 
 @pytest.mark.parametrize("name", FIXTURES)

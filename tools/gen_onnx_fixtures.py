@@ -82,6 +82,41 @@ def main() -> int:
             return self.enc(x)
 
     _export(EncoderWrap(), torch.randn(2, 6, 32), "torch_encoder", opset=14)
+
+    # 4. mini U-Net: ConvTranspose / GroupNorm (InstanceNormalization
+    #    decomposition) / SiLU / AveragePool / skip concat
+    class Unet(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.d1 = nn.Sequential(nn.Conv2d(1, 8, 3, padding=1),
+                                    nn.GroupNorm(2, 8), nn.SiLU())
+            self.pool = nn.AvgPool2d(2)
+            self.d2 = nn.Sequential(nn.Conv2d(8, 16, 3, padding=1),
+                                    nn.GroupNorm(4, 16), nn.SiLU())
+            self.up = nn.ConvTranspose2d(16, 8, 2, stride=2)
+            self.out = nn.Conv2d(16, 1, 1)
+
+        def forward(self, x):
+            a = self.d1(x)
+            b = self.d2(self.pool(a))
+            u = self.up(b)
+            return self.out(torch.cat([a, u], dim=1))
+
+    _export(Unet(), torch.randn(1, 1, 16, 16), "torch_unet", opset=14)
+
+    # 5/6. recurrent: GRU (linear_before_reset=1 export) and LSTM
+    class RecWrap(nn.Module):
+        def __init__(self, cell):
+            super().__init__()
+            self.cell = cell
+
+        def forward(self, x):
+            return self.cell(x)[0]
+
+    _export(RecWrap(nn.GRU(8, 16, batch_first=True, bidirectional=True)),
+            torch.randn(1, 6, 8), "torch_gru", opset=14)
+    _export(RecWrap(nn.LSTM(8, 16, batch_first=True)),
+            torch.randn(1, 6, 8), "torch_lstm", opset=14)
     return 0
 
 

@@ -93,6 +93,9 @@ def booster_to_string(booster) -> str:
 
 
 def _objective_string(cfg) -> str:
+    """Objective + its hyper-parameters, exactly as native LightGBM stores
+    them (GBDT::SaveModelToString writes objective->ToString()): loading the
+    file elsewhere must reproduce the same link/loss parameters."""
     if cfg.objective == "binary":
         return f"binary sigmoid:{cfg.sigmoid:g}"
     if cfg.objective in ("multiclass", "softmax"):
@@ -101,6 +104,17 @@ def _objective_string(cfg) -> str:
         return f"multiclassova num_class:{cfg.num_class} sigmoid:{cfg.sigmoid:g}"
     if cfg.objective == "lambdarank":
         return "lambdarank"
+    if cfg.objective == "quantile":
+        return f"quantile alpha:{cfg.alpha:g}"
+    if cfg.objective == "huber":
+        return f"huber alpha:{cfg.alpha:g}"
+    if cfg.objective == "fair":
+        return f"fair fair_c:{cfg.fair_c:g}"
+    if cfg.objective == "poisson":
+        return f"poisson max_delta_step:{cfg.poisson_max_delta_step:g}"
+    if cfg.objective == "tweedie":
+        return (f"tweedie "
+                f"tweedie_variance_power:{cfg.tweedie_variance_power:g}")
     return cfg.objective
 
 
@@ -226,9 +240,19 @@ def booster_from_string(s: str):
 
     cfg = BoosterConfig(objective=objective, num_class=num_class,
                         boosting_type="rf" if average_output else "gbdt")
+    # objective hyper-parameters (the native writer appends them as
+    # name:value tokens — see _objective_string)
+    _obj_fields = {"sigmoid": "sigmoid", "alpha": "alpha",
+                   "fair_c": "fair_c",
+                   "max_delta_step": "poisson_max_delta_step",
+                   "tweedie_variance_power": "tweedie_variance_power"}
     for tok in obj_str[1:]:
-        if tok.startswith("sigmoid:"):
-            cfg.sigmoid = float(tok.split(":")[1])
+        name, _, val = tok.partition(":")
+        if name in _obj_fields and val:
+            try:
+                setattr(cfg, _obj_fields[name], float(val))
+            except ValueError:
+                pass
 
     trees = []
     max_leaves = 2

@@ -36,6 +36,23 @@ from ..core.table import Table
 from .serving import ServingServer, _PendingRequest
 
 
+def _detect_local_ip() -> str:
+    """Routable local address: the UDP-connect trick reads the kernel's
+    chosen source interface without sending a packet —
+    gethostbyname(gethostname()) resolves to 127.0.x.1 on common /etc/hosts
+    configs, which would advertise an unreachable worker."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))   # no packets are sent
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
 class _WorkerLink:
     """Connection pool + in-flight accounting for one downstream worker."""
 
@@ -145,16 +162,16 @@ class ServingGateway:
                 self._local_link = self.links[local_index]
             else:
                 # single-host fallback: host AND port must match — ports
-                # alone collide across hosts, and mis-marking a remote link
-                # as local would silently starve that worker. A worker bound
-                # to the wildcard address is reachable at any local IP, so
-                # it matches any link host on this port.
-                wildcard = local_worker.host in ("0.0.0.0", "::", "")
+                # alone collide across hosts (the normal StatefulSet
+                # topology), and mis-marking a remote link as local would
+                # silently starve that worker. A worker bound to the
+                # wildcard address matches only link hosts that resolve to
+                # THIS machine (loopback or the detected interface address).
+                self_hosts = {"127.0.0.1", "localhost", local_worker.host}
+                if local_worker.host in ("0.0.0.0", "::", ""):
+                    self_hosts.add(_detect_local_ip())
                 for l in self.links:
-                    if (l.port == local_worker.port
-                            and (wildcard
-                                 or l.host in ("127.0.0.1", "localhost",
-                                               local_worker.host))):
+                    if l.port == local_worker.port and l.host in self_hosts:
                         self._local_link = l
                         break
         if not self.links:
@@ -339,22 +356,7 @@ class DistributedServingServer:
         self.worker: Optional[ServingServer] = None
         self.gateway: Optional[ServingGateway] = None
 
-    @staticmethod
-    def _local_ip() -> str:
-        """Routable local address: the UDP-connect trick reads the kernel's
-        chosen source interface without sending a packet —
-        gethostbyname(gethostname()) resolves to 127.0.x.1 on common
-        /etc/hosts configs, which would advertise an unreachable worker."""
-        import socket
-
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        try:
-            s.connect(("10.255.255.255", 1))   # no packets are sent
-            return s.getsockname()[0]
-        except OSError:
-            return socket.gethostbyname(socket.gethostname())
-        finally:
-            s.close()
+    _local_ip = staticmethod(_detect_local_ip)
 
     def _gather_worker_addrs(self, port: int) -> List[str]:
         """All-gather (ip, port) across processes. Ports ride a tiny int

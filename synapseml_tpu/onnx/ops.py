@@ -909,19 +909,25 @@ def _rnn_scan(step, x, h0, reverse):
     return (ys[::-1] if reverse else ys), hT
 
 
-def _rnn_act(name, default, node, clip=None):
+def _rnn_act(name, default, node, clip=None, alpha=None, beta=None):
     """Activation by ONNX name; ``clip`` (the op's cell-clip threshold)
-    clamps the pre-activation, matching onnxruntime."""
+    clamps the pre-activation, matching onnxruntime. ``alpha``/``beta``
+    come from the node's activation_alpha/activation_beta lists."""
+    import jax
+
     jnp = _jnp()
     if name is None:
         name = default
     if isinstance(name, bytes):
         name = name.decode()
-    table = {"Sigmoid": lambda v: 1.0 / (1.0 + jnp.exp(-v)),
+    a = 0.2 if alpha is None else float(alpha)
+    b = 0.5 if beta is None else float(beta)
+    table = {"Sigmoid": jax.nn.sigmoid,
              "Tanh": jnp.tanh,
              "Relu": lambda v: jnp.maximum(v, 0.0),
-             # Keras recurrent_activation default (alpha .2, beta .5)
-             "HardSigmoid": lambda v: jnp.clip(0.2 * v + 0.5, 0.0, 1.0)}
+             "LeakyRelu": lambda v: jnp.where(
+                 v >= 0, v, (0.01 if alpha is None else float(alpha)) * v),
+             "HardSigmoid": lambda v: jnp.clip(a * v + b, 0.0, 1.0)}
     if name not in table:
         raise ValueError(
             f"{node.op_type} '{node.name}': activation {name!r} is not "
@@ -931,6 +937,11 @@ def _rnn_act(name, default, node, clip=None):
         c = float(clip)
         return lambda v: act(jnp.clip(v, -c, c))
     return act
+
+
+def _act_param(node, attr, i):
+    vals = node.attr(attr) or []
+    return vals[i] if i < len(vals) else None
 
 
 @op("RNN")
@@ -945,7 +956,9 @@ def _rnn(node, x, w, r, b=None, seq_lens=None, initial_h=None):
     for d, reverse in enumerate(dirs):
         Wd, Rd = w[d], r[d]
         bias = (b[d][:hidden] + b[d][hidden:]) if b is not None else 0.0
-        f = _rnn_act(acts[d] if d < len(acts) else None, "Tanh", node, clip)
+        f = _rnn_act(acts[d] if d < len(acts) else None, "Tanh", node, clip,
+                     _act_param(node, "activation_alpha", d),
+                     _act_param(node, "activation_beta", d))
         h0 = (initial_h[d] if initial_h is not None
               else jnp.zeros((batch, hidden), x.dtype))
 
@@ -977,9 +990,12 @@ def _gru(node, x, w, r, b=None, seq_lens=None, initial_h=None):
         Rb = b[d][3 * hidden:] if b is not None else jnp.zeros(3 * hidden,
                                                                x.dtype)
         f = _rnn_act(acts[2 * d] if 2 * d < len(acts) else None, "Sigmoid",
-                     node, clip)
+                     node, clip, _act_param(node, "activation_alpha", 2 * d),
+                     _act_param(node, "activation_beta", 2 * d))
         g = _rnn_act(acts[2 * d + 1] if 2 * d + 1 < len(acts) else None,
-                     "Tanh", node, clip)
+                     "Tanh", node, clip,
+                     _act_param(node, "activation_alpha", 2 * d + 1),
+                     _act_param(node, "activation_beta", 2 * d + 1))
         h0 = (initial_h[d] if initial_h is not None
               else jnp.zeros((batch, hidden), x.dtype))
         H = hidden
@@ -1021,11 +1037,16 @@ def _lstm(node, x, w, r, b=None, seq_lens=None, initial_h=None,
                 if b is not None else 0.0)
         pe = p[d] if p is not None else jnp.zeros(3 * hidden, x.dtype)
         f_ = _rnn_act(acts[3 * d] if 3 * d < len(acts) else None, "Sigmoid",
-                      node, clip)
+                      node, clip, _act_param(node, "activation_alpha", 3 * d),
+                      _act_param(node, "activation_beta", 3 * d))
         g_ = _rnn_act(acts[3 * d + 1] if 3 * d + 1 < len(acts) else None,
-                      "Tanh", node, clip)
+                      "Tanh", node, clip,
+                      _act_param(node, "activation_alpha", 3 * d + 1),
+                      _act_param(node, "activation_beta", 3 * d + 1))
         h_ = _rnn_act(acts[3 * d + 2] if 3 * d + 2 < len(acts) else None,
-                      "Tanh", node, clip)
+                      "Tanh", node, clip,
+                      _act_param(node, "activation_alpha", 3 * d + 2),
+                      _act_param(node, "activation_beta", 3 * d + 2))
         h0 = (initial_h[d] if initial_h is not None
               else jnp.zeros((batch, hidden), x.dtype))
         c0 = (initial_c[d] if initial_c is not None

@@ -595,3 +595,32 @@ class TestParserRobustness:
 
         out = bst.raw_score(_np.zeros((2, 3), _np.float32))
         assert _np.isfinite(out).all()
+
+
+class TestObjectiveParamSerialization:
+    """Objective hyper-parameters ride the model string exactly as native
+    LightGBM stores them (objective->ToString()): a round trip must
+    reproduce the same link/loss parameters."""
+
+    @pytest.mark.parametrize("obj,field,value,token", [
+        ("quantile", "alpha", 0.8, "quantile alpha:0.8"),
+        ("fair", "fair_c", 2.5, "fair fair_c:2.5"),
+        ("poisson", "poisson_max_delta_step", 0.6,
+         "poisson max_delta_step:0.6"),
+        ("tweedie", "tweedie_variance_power", 1.3,
+         "tweedie tweedie_variance_power:1.3"),
+        ("huber", "alpha", 1.7, "huber alpha:1.7"),
+    ])
+    def test_roundtrip(self, obj, field, value, token):
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(300, 3)).astype(np.float32)
+        y = np.abs(X[:, 0] + 0.1 * rng.normal(size=300)).astype(np.float32)
+        cfg = BoosterConfig(objective=obj, num_iterations=3, **{field: value})
+        bst = train_booster(X, y, cfg)
+        s = bst.model_string()
+        assert f"objective={token}" in s, s.split("objective=")[1][:60]
+        loaded = Booster.from_model_string(s)
+        assert getattr(loaded.config, field) == pytest.approx(value)
+        np.testing.assert_allclose(bst.predict(X[:20]),
+                                   loaded.predict(X[:20]), rtol=1e-4,
+                                   atol=1e-4)

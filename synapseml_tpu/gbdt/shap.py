@@ -86,15 +86,31 @@ def _shap_recurse(tree, x, phi, node, depth, path: _Path, pz, po, pi):
             phi[path.feat[i]] += w * (path.one[i] - path.zero[i]) * leaf_val
         return
     f = int(tree["sf"][node])
+    # the HOT child must be the one the PREDICTION path takes, including
+    # LightGBM's missing routing (grower._descend semantics) — otherwise
+    # contributions on NaN/zero-missing rows stop summing to raw_score
+    mt = int(tree["mt"][node])
     if tree["stype"][node] == 1:
         xv = x[f]
-        c = int(xv) if np.isfinite(xv) and xv >= 0 else -1
-        in_set = (0 <= c < tree["bits"].shape[1] * 32 and
+        # identical conversion to grower._descend: NaN -> 0 unless mt=nan
+        # (-1 there), then clip into [-1, last tracked bit] and truncate —
+        # so -0.5 tests category 0 and out-of-range/inf tests the last bit,
+        # exactly as the prediction path does
+        cf = (0.0 if mt != 2 else -1.0) if np.isnan(xv) else xv
+        c = int(np.clip(cf, -1, tree["bits"].shape[1] * 32 - 1))
+        in_set = (c >= 0 and
                   bool((tree["bits"][node, c >> 5] >> (c & 31)) & 1))
         hot, cold = ((tree["lc"][node], tree["rc"][node]) if in_set
                      else (tree["rc"][node], tree["lc"][node]))
     else:
-        go_left = x[f] <= tree["thr"][node]
+        xv = x[f]
+        isnan = np.isnan(xv)
+        if isnan and mt != 2:
+            xv = 0.0                        # NaN coerces unless mt=nan
+        missing = ((mt == 1 and abs(xv) <= 1e-35)
+                   or (mt == 2 and isnan))
+        go_left = bool(tree["dleft"][node]) if missing \
+            else bool(xv <= tree["thr"][node])
         hot, cold = ((tree["lc"][node], tree["rc"][node]) if go_left
                      else (tree["rc"][node], tree["lc"][node]))
 
@@ -154,6 +170,8 @@ def forest_shap(booster, X: np.ndarray) -> np.ndarray:
             "leaf_cover": leaf_cover,
             "stype": np.asarray(t.split_type)[:ns],
             "bits": np.asarray(t.cat_bitset)[:ns],
+            "dleft": np.asarray(t.default_left)[:ns],
+            "mt": booster._missing_types(ti)[:ns],
         }
         ev = float((lv * leaf_cover).sum() / leaf_cover.sum())
         out[:, cls, -1] += ev

@@ -759,3 +759,47 @@ def test_param_list_default_not_shared():
     lst.append(99)
     assert r1.getEvalAt() == [1, 2, 3, 4, 5]
     assert LightGBMRanker().getEvalAt() == [1, 2, 3, 4, 5]
+
+
+def test_shap_additivity_with_missing_values():
+    """pred_contrib must follow the PREDICTION path's missing routing:
+    contributions on NaN rows sum to the raw score (LightGBM TreeSHAP uses
+    the same Decision fn as inference)."""
+    from synapseml_tpu.gbdt.shap import forest_shap
+
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(500, 4)).astype(np.float32)
+    X[rng.random(500) < 0.3, 0] = np.nan
+    X[:, 3] = rng.integers(0, 4, size=500)
+    X[rng.random(500) < 0.2, 3] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + X[:, 1] > 0).astype(np.float32)
+    bst = train_booster(X, y, BoosterConfig(objective="binary",
+                                            num_iterations=5, num_leaves=8),
+                        categorical_features=[3])
+    Xt = X[:80]
+    contrib = forest_shap(bst, Xt)
+    np.testing.assert_allclose(contrib.sum(axis=1), bst.raw_score(Xt),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_shap_additivity_categorical_edge_values():
+    """Categorical SHAP routing parity on edge inputs: -0.5 (tests category
+    0), +inf / out-of-range (clip to last tracked bit) — same conversion as
+    the prediction path, no crash."""
+    from synapseml_tpu.gbdt.shap import forest_shap
+
+    rng = np.random.default_rng(29)
+    X = rng.normal(size=(400, 3)).astype(np.float32)
+    X[:, 2] = rng.integers(0, 4, size=400)
+    y = ((X[:, 2] == 0) | (X[:, 0] > 0.8)).astype(np.float32)
+    bst = train_booster(X, y, BoosterConfig(objective="binary",
+                                            num_iterations=4, num_leaves=8),
+                        categorical_features=[2])
+    Xt = X[:12].copy()
+    Xt[0, 2] = -0.5          # truncates to category 0
+    Xt[1, 2] = np.inf        # clips to the last tracked bit
+    Xt[2, 2] = 1e9           # out-of-range
+    Xt[3, 2] = -7.0          # clips to -1 -> never a member
+    contrib = forest_shap(bst, Xt)
+    np.testing.assert_allclose(contrib.sum(axis=1), bst.raw_score(Xt),
+                               rtol=1e-4, atol=1e-4)

@@ -120,9 +120,11 @@ def main():
 
     while True:
         if _probe_device_once(args.probe_s):
-            if args.tune:
-                run_tune(args.bench_timeout_s)
+            # bench FIRST: a short terminal window must yield the green
+            # artifact before any tuning/scale work spends it
             ok = run_bench(args.bench_timeout_s)
+            if ok and args.tune:
+                run_tune(args.bench_timeout_s)
             if ok and args.scale:
                 run_scale_proof(args.bench_timeout_s, args.scale_rows)
             if args.once or (ok and not args.forever):

@@ -142,6 +142,33 @@ class FlaxTrainer:
             sel = idx[start: start + bs]
             yield X[sel], y[sel]
 
+    def _prefetch(self, batches, size: int = 2):
+        """Host→device input pipelining (the petastorm-loader role,
+        TPU-style): the next ``size`` batches are sharded/device_put ahead of
+        the step that consumes them, so the transfer — expensive through a
+        tunnel, nontrivial on real HBM — overlaps the current step's compute
+        (JAX dispatch is async; holding the arrays keeps the transfers in
+        flight)."""
+        from collections import deque
+
+        q: deque = deque()
+
+        def enqueue():
+            try:
+                xb, yb = next(batches)
+            except StopIteration:
+                return False
+            q.append((self._shard(xb), self._shard(yb)))
+            return True
+
+        for _ in range(max(size, 1)):
+            if not enqueue():
+                break
+        while q:
+            out = q.popleft()
+            enqueue()
+            yield out
+
     def _shard(self, arr):
         if self.mesh is None:
             return jnp.asarray(arr)
@@ -270,8 +297,7 @@ class FlaxTrainer:
                     opt_state = self._apply_fsdp(opt_state)
         for epoch in range(start_epoch, cfg.max_epochs):
             losses = []
-            for xb, yb in self._batches(X, y, rng):
-                xb, yb = self._shard(xb), self._shard(yb)
+            for xb, yb in self._prefetch(self._batches(X, y, rng)):
                 params, batch_stats, opt_state, loss, acc = train_step(
                     params, batch_stats, opt_state, xb, yb, step_idx)
                 step_idx += 1

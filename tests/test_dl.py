@@ -206,3 +206,21 @@ def test_frozen_params_not_decayed_by_adamw():
     frozen_before = before["Conv_0"]["kernel"]
     frozen_after = np.asarray(after["Conv_0"]["kernel"])
     np.testing.assert_array_equal(frozen_before, frozen_after)
+
+
+def test_prefetch_preserves_batches():
+    """_prefetch must yield every batch exactly once, in order, already
+    sharded (device-resident)."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.dl.trainer import FlaxTrainer, TrainConfig
+
+    tr = FlaxTrainer.__new__(FlaxTrainer)
+    tr.mesh = None
+    batches = iter([(np.full((2, 3), i, np.float32), np.full(2, i, np.float32))
+                    for i in range(5)])
+    out = list(tr._prefetch(batches, size=2))
+    assert len(out) == 5
+    for i, (xb, yb) in enumerate(out):
+        assert isinstance(xb, jnp.ndarray)
+        np.testing.assert_array_equal(np.asarray(xb), np.full((2, 3), i))

@@ -251,15 +251,25 @@ class Booster:
         X = _densify(X)
         nb = jnp.asarray(self.mapper.nan_bins) if binned else None
         forest = self.forest()
-        per_tree = forest_predict(forest, jnp.asarray(X), binned=binned,
-                                  output="per_tree", nan_bins=nb,
-                                  depth=self._depth_cache)  # (N, T)
         k = self.models_per_iter
-        n, t = per_tree.shape
-        per_iter = per_tree.reshape(n, t // k, k)
         if start_iteration is None:
             start_iteration = max(
                 int(getattr(self.config, "start_iteration", 0)), 0)
+        if (k == 1 and not start_iteration
+                and (not num_iteration or num_iteration < 0)
+                and not self.average_output):
+            # no prediction window active: sum inside the traversal scan —
+            # the (N, T) per-tree matrix is 4 GB at 11M rows x 100 trees and
+            # exists only to support windowing/rf rescale
+            out = forest_predict(forest, jnp.asarray(X), binned=binned,
+                                 output="sum", nan_bins=nb,
+                                 depth=self._depth_cache)
+            return np.asarray(out + self.base_score[0])
+        per_tree = forest_predict(forest, jnp.asarray(X), binned=binned,
+                                  output="per_tree", nan_bins=nb,
+                                  depth=self._depth_cache)  # (N, T)
+        n, t = per_tree.shape
+        per_iter = per_tree.reshape(n, t // k, k)
         if start_iteration:
             per_iter = per_iter[:, start_iteration:]
         if num_iteration and num_iteration > 0:

@@ -945,27 +945,41 @@ def forest_predict(forest: Forest, X: jnp.ndarray, binned: bool = False,
 
     mts = forest.missing_type
 
-    def one_tree(carry, t):
+    def unpack(t):
         if mts is None:
-            (sf, thr, sbin, stype, dl, bits, lc, rc, lv), mt = t, None
-        else:
-            sf, thr, sbin, stype, dl, bits, lc, rc, lv, mt = t
-        leaf = _descend(X, sf, thr, sbin, stype, dl, bits, lc, rc, binned,
-                        depth, nan_bins, mt)
-        val = lv[leaf]
-        return carry, (leaf, val)
+            return t + (None,)
+        return t
 
     xs = (forest.split_feature, forest.threshold, forest.split_bin,
           forest.split_type, forest.default_left, forest.cat_bitset,
           forest.left_child, forest.right_child, forest.leaf_value)
     if mts is not None:
         xs = xs + (mts,)
+
+    if output == "sum":
+        # accumulate in the scan CARRY: the stacked (T, N) per-tree buffer
+        # is ~4 GB at 11M rows x 100 trees and plain scoring never needs it
+        def one_tree_sum(carry, t):
+            sf, thr, sbin, stype, dl, bits, lc, rc, lv, mt = unpack(t)
+            leaf = _descend(X, sf, thr, sbin, stype, dl, bits, lc, rc,
+                            binned, depth, nan_bins, mt)
+            return carry + lv[leaf], None
+
+        total, _ = jax.lax.scan(
+            one_tree_sum, jnp.zeros(X.shape[0], forest.leaf_value.dtype), xs)
+        return total                 # (N,)
+
+    def one_tree(carry, t):
+        sf, thr, sbin, stype, dl, bits, lc, rc, lv, mt = unpack(t)
+        leaf = _descend(X, sf, thr, sbin, stype, dl, bits, lc, rc, binned,
+                        depth, nan_bins, mt)
+        val = lv[leaf]
+        return carry, (leaf, val)
+
     _, (leaves, vals) = jax.lax.scan(one_tree, 0, xs)
     if output == "leaf":
         return leaves.T          # (N, T)
-    if output == "per_tree":
-        return vals.T            # (N, T)
-    return vals.sum(axis=0)      # (N,)
+    return vals.T                # (N, T)  ("per_tree")
 
 
 def forest_max_depth(trees: list) -> int:

@@ -1,0 +1,80 @@
+"""Spark interop adapter — the migration bridge for reference users.
+
+The reference is Spark-native; this framework's substrate is the columnar
+:class:`~synapseml_tpu.core.table.Table` (SURVEY §7 design stance: "Spark's
+role is played by a thin host-orchestration layer; Spark-the-dependency is
+optional (adapter), not the substrate"). This module is that adapter: when
+``pyspark`` is importable, Spark DataFrames convert to/from ``Table`` and any
+estimator/transformer here can run inside an existing Spark pipeline via
+:func:`wrap_stage`; without pyspark every entry point raises a clear
+ImportError (the build image intentionally ships without Spark).
+
+Conversion rides pandas (both sides already speak it): Spark ``toPandas()``
+uses Arrow when ``spark.sql.execution.arrow.pyspark.enabled`` is set — the
+same Arrow boundary the reference crosses for its Python UDFs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .table import Table
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "Spark interop needs pyspark, which is not installed in this "
+            "environment. Convert through pandas instead: "
+            "Table.from_pandas(spark_df.toPandas()) on a machine with Spark, "
+            "or feed Table.read_parquet() files written by Spark.") from e
+
+
+def from_spark(spark_df) -> Table:
+    """Spark DataFrame → Table (collects to the driver via Arrow/pandas —
+    the same boundary the reference crosses for Python UDF interop)."""
+    _require_pyspark()
+    return Table.from_pandas(spark_df.toPandas())
+
+
+def to_spark(table: Table, spark) -> Any:
+    """Table → Spark DataFrame on the given SparkSession."""
+    _require_pyspark()
+    return spark.createDataFrame(table.to_pandas())
+
+
+class wrap_stage:
+    """Run a synapseml_tpu stage on Spark DataFrames:
+
+    ``model = wrap_stage(LightGBMClassifier(...)).fit(spark_df)`` — fit
+    collects through the adapter, transform returns a Spark DataFrame on the
+    input's session. For datasets too large to collect, write parquet from
+    Spark and use ``Table.read_parquet`` + the mesh-sharded training path
+    instead (the reference's own per-worker native training collects each
+    partition into the native library's memory just the same)."""
+
+    def __init__(self, stage):
+        self.stage = stage
+
+    def fit(self, spark_df) -> "wrap_stage":
+        fitted = self.stage.fit(from_spark(spark_df))
+        return wrap_stage(fitted)
+
+    def transform(self, spark_df):
+        _require_pyspark()
+        session = spark_df.sparkSession
+        out = self.stage.transform(from_spark(spark_df))
+        return to_spark(out, session)
+
+    def __getattr__(self, name: str):
+        # guard: dunder/underscore lookups (pickle's __reduce_ex__, copy's
+        # __copy__) arrive before self.stage exists and must not recurse
+        if name.startswith("_") or name == "stage":
+            raise AttributeError(name)
+        return getattr(self.stage, name)
+
+

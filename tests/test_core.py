@@ -111,3 +111,52 @@ def test_stage_save_load(tmp_path):
     assert type(loaded).__name__ == "_AddOne"
     out = loaded.transform(Table({"x": np.arange(3.0)}))
     assert np.allclose(out["out"], [1, 2, 3])
+
+
+class TestSparkAdapter:
+    """Spark interop (core/spark_adapter.py): pyspark is absent in this
+    image, so entry points must raise the guidance ImportError; the
+    parquet-directory path (Spark's on-disk handoff) works via pyarrow."""
+
+    def test_clear_import_error_without_pyspark(self):
+        import importlib.util
+
+        import pytest as _pytest
+
+        from synapseml_tpu.core import spark_adapter
+
+        if importlib.util.find_spec("pyspark") is not None:
+            _pytest.skip("pyspark installed: the gated-ImportError "
+                         "contract does not apply")
+        with _pytest.raises(ImportError, match="pandas instead"):
+            spark_adapter.from_spark(object())
+        with _pytest.raises(ImportError):
+            spark_adapter.to_spark(Table({"a": np.arange(3)}), None)
+
+    def test_wrap_stage_delegates_params(self):
+        import copy
+        import pickle
+
+        from synapseml_tpu.core.spark_adapter import wrap_stage
+        from synapseml_tpu.models import LightGBMClassifier
+
+        w = wrap_stage(LightGBMClassifier(numIterations=7))
+        assert w.getNumIterations() == 7      # attribute passthrough
+        assert copy.copy(w).getNumIterations() == 7
+        assert pickle.loads(pickle.dumps(w)).getNumIterations() == 7
+
+    def test_spark_parquet_directory_roundtrip(self, tmp_path):
+        # Spark writes a DIRECTORY of part files; emulate that layout
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        d = tmp_path / "spark_out.parquet"
+        d.mkdir()
+        t1 = pa.table({"a": [1.0, 2.0], "b": ["x", "y"]})
+        t2 = pa.table({"a": [3.0], "b": ["z"]})
+        pq.write_table(t1, d / "part-00000.parquet")
+        pq.write_table(t2, d / "part-00001.parquet")
+        (d / "_SUCCESS").write_text("")      # Spark's commit marker
+        out = Table.read_parquet(str(d))
+        assert out.num_rows == 3
+        assert sorted(np.asarray(out["a"], np.float64)) == [1.0, 2.0, 3.0]

@@ -5,9 +5,12 @@ The reference is Spark-native; this framework's substrate is the columnar
 role is played by a thin host-orchestration layer; Spark-the-dependency is
 optional (adapter), not the substrate"). This module is that adapter: when
 ``pyspark`` is importable, Spark DataFrames convert to/from ``Table`` and any
-estimator/transformer here can run inside an existing Spark pipeline via
-:func:`wrap_stage`; without pyspark every entry point raises a clear
-ImportError (the build image intentionally ships without Spark).
+estimator/transformer here can run ALONGSIDE Spark code via
+:func:`wrap_stage` (duck-typed fit/transform on DataFrames — not a
+``pyspark.ml.PipelineStage``, so it composes in Python code rather than
+inside a ``pyspark.ml.Pipeline`` object); without pyspark every entry point
+raises a clear ImportError (the build image intentionally ships without
+Spark).
 
 Conversion rides pandas (both sides already speak it): Spark ``toPandas()``
 uses Arrow when ``spark.sql.execution.arrow.pyspark.enabled`` is set — the
@@ -65,8 +68,13 @@ class wrap_stage:
         return wrap_stage(fitted)
 
     def transform(self, spark_df):
-        _require_pyspark()
-        session = spark_df.sparkSession
+        # DataFrame.sparkSession only exists on pyspark >= 3.3; older
+        # DataFrames resolve unknown attributes as COLUMN lookups, so probe
+        # the class and fall back to the sql_ctx route (3.1/3.2)
+        if hasattr(type(spark_df), "sparkSession"):
+            session = spark_df.sparkSession
+        else:
+            session = spark_df.sql_ctx.sparkSession
         out = self.stage.transform(from_spark(spark_df))
         return to_spark(out, session)
 

@@ -81,8 +81,9 @@ def multiclassova_objective(num_class: int, sigmoid: float = 1.0) -> Objective:
         return jnp.log(p / (1 - p)) / s
 
     def tf(sc):
-        p = _sigmoid(s * sc)
-        return p / p.sum(axis=-1, keepdims=True)
+        # LightGBM MulticlassOVA::ConvertOutput: per-class sigmoid, NO
+        # normalization (each class is an independent binary problem)
+        return _sigmoid(s * sc)
 
     return Objective("multiclassova", num_class, gh, init, tf)
 

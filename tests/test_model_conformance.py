@@ -624,3 +624,28 @@ class TestObjectiveParamSerialization:
         np.testing.assert_allclose(bst.predict(X[:20]),
                                    loaded.predict(X[:20]), rtol=1e-4,
                                    atol=1e-4)
+
+
+class TestGoldenMulticlassOva:
+    """multiclassova: per-class SIGMOID (not softmax), sigmoid param parsed
+    from the objective string."""
+
+    def _load(self):
+        trees = [_stump(c, 0, 0.5, 2, 0.2 * (c + 1), -0.2 * (c + 1))
+                 for c in range(2)]
+        s = _mk_model_string([
+            "tree", "version=v3", "num_class=2", "num_tree_per_iteration=2",
+            "label_index=0", "max_feature_idx=0",
+            "objective=multiclassova num_class:2 sigmoid:2",
+            "feature_names=f0", "feature_infos=[-1:1]"], trees, "f0=2\n")
+        return Booster.from_model_string(s)
+
+    def test_per_class_sigmoid(self):
+        bst = self._load()
+        assert bst.config.sigmoid == pytest.approx(2.0)
+        x = np.asarray([[0.2]], np.float32)
+        raw = bst.raw_score(x)
+        np.testing.assert_allclose(raw[0], [0.2, 0.4], atol=1e-6)
+        p = bst.predict(x)
+        expect = 1.0 / (1.0 + np.exp(-2.0 * raw[0]))   # sigmoid:2 per class
+        np.testing.assert_allclose(p[0], expect, atol=1e-6)

@@ -30,7 +30,8 @@ from .dataset import Dataset, _is_sparse
 from .grower import (Forest, GrowerConfig, TreeArrays, forest_max_depth,
                      forest_predict, grow_tree, stack_trees)
 from .objectives import (METRICS, HIGHER_IS_BETTER, Objective, get_objective,
-                         lambdarank_objective, make_grouped, ndcg_at_k)
+                         lambdarank_objective, make_grouped,
+                         map_at_k, ndcg_at_k)
 
 
 @dataclasses.dataclass
@@ -541,10 +542,12 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
                          + (score_v_c - base_k[None, :])
                          / (it + 1).astype(jnp.float32))
                 pred_v = transform(raw_v[:, 0] if k == 1 else raw_v)
-                if metric_name.startswith("ndcg"):
+                if _is_rank_metric(metric_name):
                     at = (int(metric_name.split("@")[1])
                           if "@" in metric_name else 5)
-                    mval = ndcg_at_k(yv_j, raw_v[:, 0], gidx_v, at)
+                    rank_fn = (map_at_k if metric_name.startswith("map")
+                               else ndcg_at_k)
+                    mval = rank_fn(yv_j, raw_v[:, 0], gidx_v, at)
                 else:
                     mval = METRICS[metric_name](yv_j, pred_v)
             else:
@@ -970,14 +973,14 @@ def train_booster(
                 init_model.raw_score(Xv, start_iteration=0).reshape(
                     Xv.shape[0], k), jnp.float32)
         metric_name = cfg.metric or _default_metric(cfg.objective)
-        if metric_name == "ndcg" or (cfg.metric is None
-                                     and metric_name.startswith("ndcg")):
-            # evalAt (LightGBMRankerParams, default 1-5) sets the NDCG eval
-            # positions; early stopping tracks the FIRST position, matching
-            # the reference. Engine-level configs that never set eval_at keep
-            # the max_position behavior.
+        if metric_name in ("ndcg", "map") or (
+                cfg.metric is None and metric_name.startswith("ndcg")):
+            # evalAt (LightGBMRankerParams, default 1-5) sets the ndcg/map
+            # eval positions; early stopping tracks the FIRST position,
+            # matching the reference. Engine-level configs that never set
+            # eval_at keep the max_position behavior.
             first_at = (cfg.eval_at[0] if cfg.eval_at else cfg.max_position)
-            metric_name = f"ndcg@{int(first_at)}"
+            metric_name = f"{metric_name.split('@')[0]}@{int(first_at)}"
         best_metric, best_iter = None, -1
         higher_better = metric_name.split("@")[0] in HIGHER_IS_BETTER
         # dart/rf: per-tree validation contributions (weights change later)
@@ -1046,7 +1049,7 @@ def train_booster(
         base_k = _wrap(np.asarray(base[:k], np.float32))
         if has_valid:
             yv_j = jnp.asarray(yv)
-            if metric_name.startswith("ndcg"):
+            if _is_rank_metric(metric_name):
                 if len(valid) < 4:
                     raise ValueError("ranking validation requires "
                                      "valid=(Xv, yv, wv_or_None, group_sizes_v)")
@@ -1305,6 +1308,11 @@ def train_booster(
                    thresholds=merged_thr, missing_types=merged_mt)
 
 
+def _is_rank_metric(name: str) -> bool:
+    """ndcg/ndcg@k/map/map@k — NOT mape (startswith would match it)."""
+    return name.split("@")[0] in ("ndcg", "map")
+
+
 def _default_metric(objective: str) -> str:
     return {
         "binary": "auc",
@@ -1317,12 +1325,13 @@ def _default_metric(objective: str) -> str:
 
 
 def _eval_metric(name, yv, pred_v, raw_v, valid, k):
-    if name.startswith("ndcg"):
+    if _is_rank_metric(name):
         at = int(name.split("@")[1]) if "@" in name else 5
         if len(valid) < 4:
             raise ValueError(
                 "ranking validation requires valid=(Xv, yv, wv_or_None, group_sizes_v)")
         gidx = make_grouped(yv, valid[3])
-        return ndcg_at_k(jnp.asarray(yv), raw_v[:, 0], jnp.asarray(gidx), at)
+        rank_fn = map_at_k if name.startswith("map") else ndcg_at_k
+        return rank_fn(jnp.asarray(yv), raw_v[:, 0], jnp.asarray(gidx), at)
     fn = METRICS[name]
     return fn(jnp.asarray(yv), pred_v)

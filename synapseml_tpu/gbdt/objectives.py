@@ -364,6 +364,28 @@ def ndcg_at_k(labels, scores, group_index, k: int = 5):
     return jnp.mean(jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 1.0))
 
 
+def map_at_k(labels, scores, group_index, k: int = 5):
+    """Mean average precision @k over groups (LightGBM map metric: binary
+    relevance label > 0, AP normalized by min(#positives, k));
+    ``group_index`` as in :func:`make_grouped`."""
+    gi = jnp.asarray(group_index)
+    pad = gi < 0
+    safe = jnp.maximum(gi, 0)
+    s = jnp.where(pad, -jnp.inf, scores[safe])
+    rel = (jnp.where(pad, 0.0, labels[safe]) > 0).astype(jnp.float32)
+    order = jnp.argsort(-s, axis=1)
+    rel_sorted = jnp.take_along_axis(rel, order, axis=1)
+    pos = jnp.arange(rel.shape[1], dtype=jnp.float32)[None, :]
+    cum_hits = jnp.cumsum(rel_sorted, axis=1)
+    prec = cum_hits / (pos + 1.0)
+    in_k = (pos < k).astype(jnp.float32)
+    ap_sum = (prec * rel_sorted * in_k).sum(axis=1)
+    npos = rel.sum(axis=1)
+    denom = jnp.minimum(npos, float(k))
+    ap = jnp.where(denom > 0, ap_sum / jnp.maximum(denom, 1.0), 1.0)
+    return jnp.mean(ap)
+
+
 METRICS = {
     "auc": lambda y, pred, **kw: auc(y, pred, kw.get("weight")),
     "binary_logloss": lambda y, pred, **kw: binary_logloss(y, pred),
@@ -375,6 +397,9 @@ METRICS = {
     "mse": lambda y, pred, **kw: jnp.mean((y - pred) ** 2),
     "mae": lambda y, pred, **kw: mae(y, pred),
     "l1": lambda y, pred, **kw: mae(y, pred),
+    # LightGBM MAPEMetric: |y - pred| / max(1, |y|)
+    "mape": lambda y, pred, **kw: jnp.mean(
+        jnp.abs(y - pred) / jnp.maximum(1.0, jnp.abs(y))),
 }
 
 HIGHER_IS_BETTER = {"auc", "ndcg", "map"}

@@ -803,3 +803,46 @@ def test_shap_additivity_categorical_edge_values():
     contrib = forest_shap(bst, Xt)
     np.testing.assert_allclose(contrib.sum(axis=1), bst.raw_score(Xt),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_map_metric_hand_computed_and_early_stopping():
+    """map@k eval (LightGBM MapMetric): hand-computed AP on a known ranking,
+    plus metric="map@2" driving ranker validation without error."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.gbdt.objectives import make_grouped, map_at_k
+
+    # one query, 4 docs; scores rank doc order [d0, d1, d2, d3];
+    # relevance [1, 0, 1, 0] -> AP@4 = (1/1 + 2/3) / 2 = 0.8333
+    labels = np.asarray([1.0, 0.0, 1.0, 0.0])
+    scores = np.asarray([4.0, 3.0, 2.0, 1.0])
+    gi = make_grouped(labels, np.asarray([4]))
+    v = float(map_at_k(jnp.asarray(labels), jnp.asarray(scores), gi, 4))
+    assert abs(v - (1.0 + 2.0 / 3.0) / 2.0) < 1e-6, v
+    # AP@1: only d0 counted, denom min(2,1)=1 -> 1.0
+    v1 = float(map_at_k(jnp.asarray(labels), jnp.asarray(scores), gi, 1))
+    assert abs(v1 - 1.0) < 1e-6, v1
+
+    rng = np.random.default_rng(11)
+    n, q = 600, 30
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (rng.random(n) < 0.3).astype(np.float32)
+    sizes = np.full(q, n // q, np.int64)
+    cfg = BoosterConfig(objective="lambdarank", num_iterations=8,
+                        metric="map@2", early_stopping_round=3)
+    bst = train_booster(X, y, cfg, group_sizes=sizes,
+                        valid=(X, y, None, sizes))
+    assert bst.num_trees >= 1
+
+
+def test_mape_metric_not_misrouted_to_ranking():
+    """'mape' must reach the pointwise metric table — startswith('map')
+    would have misrouted it into the ranking branch."""
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(300, 3)).astype(np.float32)
+    y = np.abs(X[:, 0]).astype(np.float32) + 1.0
+    b = train_booster(X, y, BoosterConfig(objective="mape", metric="mape",
+                                          num_iterations=4),
+                      valid=(X, y))
+    assert b.num_trees >= 1
+    assert np.isfinite(b.predict(X[:10])).all()

@@ -31,7 +31,7 @@ from .grower import (Forest, GrowerConfig, TreeArrays, forest_max_depth,
                      forest_predict, grow_tree, stack_trees)
 from .objectives import (METRICS, HIGHER_IS_BETTER, Objective, get_objective,
                          lambdarank_objective, make_grouped,
-                         map_at_k, ndcg_at_k)
+                         map_at_k, metric_kwargs, ndcg_at_k)
 
 
 @dataclasses.dataclass
@@ -549,7 +549,8 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
                                else ndcg_at_k)
                     mval = rank_fn(yv_j, raw_v[:, 0], gidx_v, at)
                 else:
-                    mval = METRICS[metric_name](yv_j, pred_v)
+                    mval = METRICS[metric_name](yv_j, pred_v,
+                                                **metric_kwargs(cfg))
             else:
                 mval = jnp.float32(0)
             return (score_c, in_bag_c, score_v_c), (stacked, mval)
@@ -1272,7 +1273,7 @@ def train_booster(
             else:
                 raw_v = score_v
             pred_v = obj.transform(raw_v[:, 0] if k == 1 else raw_v)
-            mval = float(_eval_metric(metric_name, yv, pred_v, raw_v, valid, k))
+            mval = float(_eval_metric(metric_name, yv, pred_v, raw_v, valid, k, cfg))
             improved = (best_metric is None
                         or (mval > best_metric if higher_better else mval < best_metric))
             if improved:
@@ -1321,10 +1322,19 @@ def _default_metric(objective: str) -> str:
         "multiclassova": "multi_logloss",
         "regression_l1": "mae",
         "lambdarank": "ndcg@5",
+        # exp-family / robust objectives early-stop on their OWN loss
+        # (LightGBM's default metric = the objective)
+        "poisson": "poisson",
+        "gamma": "gamma",
+        "tweedie": "tweedie",
+        "quantile": "quantile",
+        "huber": "huber",
+        "fair": "fair",
+        "mape": "mape",
     }.get(objective, "rmse")
 
 
-def _eval_metric(name, yv, pred_v, raw_v, valid, k):
+def _eval_metric(name, yv, pred_v, raw_v, valid, k, cfg=None):
     if _is_rank_metric(name):
         at = int(name.split("@")[1]) if "@" in name else 5
         if len(valid) < 4:
@@ -1334,4 +1344,4 @@ def _eval_metric(name, yv, pred_v, raw_v, valid, k):
         rank_fn = map_at_k if name.startswith("map") else ndcg_at_k
         return rank_fn(jnp.asarray(yv), raw_v[:, 0], jnp.asarray(gidx), at)
     fn = METRICS[name]
-    return fn(jnp.asarray(yv), pred_v)
+    return fn(jnp.asarray(yv), pred_v, **metric_kwargs(cfg))

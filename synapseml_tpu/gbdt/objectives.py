@@ -364,6 +364,53 @@ def ndcg_at_k(labels, scores, group_index, k: int = 5):
     return jnp.mean(jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 1.0))
 
 
+def poisson_metric(y, pred):
+    """LightGBM PoissonMetric: pred - y*log(pred) (psi const dropped)."""
+    p = jnp.maximum(pred, 1e-15)
+    return jnp.mean(p - y * jnp.log(p))
+
+
+def gamma_metric(y, pred):
+    p = jnp.maximum(pred, 1e-15)
+    return jnp.mean(y / p + jnp.log(p))
+
+
+def gamma_deviance_metric(y, pred):
+    p = jnp.maximum(pred, 1e-15)
+    return 2.0 * jnp.mean(jnp.log(p / jnp.maximum(y, 1e-15)) + y / p - 1.0)
+
+
+def tweedie_metric(y, pred, rho: float = 1.5):
+    p = jnp.maximum(pred, 1e-15)
+    return jnp.mean(-y * p ** (1.0 - rho) / (1.0 - rho)
+                    + p ** (2.0 - rho) / (2.0 - rho))
+
+
+def quantile_metric(y, pred, alpha: float = 0.9):
+    d = y - pred
+    return jnp.mean(jnp.maximum(alpha * d, (alpha - 1.0) * d))
+
+
+def huber_metric(y, pred, alpha: float = 0.9):
+    d = y - pred
+    return jnp.mean(jnp.where(jnp.abs(d) <= alpha, 0.5 * d * d,
+                              alpha * (jnp.abs(d) - 0.5 * alpha)))
+
+
+def fair_metric(y, pred, c: float = 1.0):
+    ad = jnp.abs(y - pred)
+    return jnp.mean(c * c * (ad / c - jnp.log1p(ad / c)))
+
+
+def metric_kwargs(cfg) -> dict:
+    """The hyper-parameterized metrics' inputs, from one place so the fused
+    and host eval paths can never drift."""
+    if cfg is None:
+        return {}
+    return {"alpha": cfg.alpha, "fair_c": cfg.fair_c,
+            "tweedie_variance_power": cfg.tweedie_variance_power}
+
+
 def map_at_k(labels, scores, group_index, k: int = 5):
     """Mean average precision @k over groups (LightGBM map metric: binary
     relevance label > 0, AP normalized by min(#positives, k));
@@ -400,6 +447,19 @@ METRICS = {
     # LightGBM MAPEMetric: |y - pred| / max(1, |y|)
     "mape": lambda y, pred, **kw: jnp.mean(
         jnp.abs(y - pred) / jnp.maximum(1.0, jnp.abs(y))),
+    # loss-metrics of the exp-family / robust objectives (pred is in the
+    # RESPONSE space — the exp link is already applied)
+    "poisson": lambda y, pred, **kw: poisson_metric(y, pred),
+    "gamma": lambda y, pred, **kw: gamma_metric(y, pred),
+    "gamma_deviance": lambda y, pred, **kw: gamma_deviance_metric(y, pred),
+    "tweedie": lambda y, pred, **kw: tweedie_metric(
+        y, pred, kw.get("tweedie_variance_power", 1.5)),
+    "quantile": lambda y, pred, **kw: quantile_metric(
+        y, pred, kw.get("alpha", 0.9)),
+    "huber": lambda y, pred, **kw: huber_metric(
+        y, pred, kw.get("alpha", 0.9)),
+    "fair": lambda y, pred, **kw: fair_metric(
+        y, pred, kw.get("fair_c", 1.0)),
 }
 
 HIGHER_IS_BETTER = {"auc", "ndcg", "map"}

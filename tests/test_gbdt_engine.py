@@ -846,3 +846,34 @@ def test_mape_metric_not_misrouted_to_ranking():
                       valid=(X, y))
     assert b.num_trees >= 1
     assert np.isfinite(b.predict(X[:10])).all()
+
+
+def test_objective_loss_metrics_drive_validation():
+    """Exp-family / robust objectives early-stop on their own loss
+    (LightGBM default metric = the objective), with cfg hyper-parameters
+    reaching the metric."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.gbdt.objectives import METRICS
+
+    # hand-check: quantile pinball at alpha 0.8 on a known pair
+    y = jnp.asarray([2.0, 0.0])
+    pred = jnp.asarray([0.0, 1.0])
+    v = float(METRICS["quantile"](y, pred, alpha=0.8))
+    # d = [2, -1]: max(.8*2, -.2*2)=1.6; max(.8*-1, -.2*-1)=0.2 -> mean 0.9
+    assert abs(v - 0.9) < 1e-6, v
+    # poisson NLL decreases as pred approaches y
+    a = float(METRICS["poisson"](jnp.asarray([3.0]), jnp.asarray([3.0])))
+    b = float(METRICS["poisson"](jnp.asarray([3.0]), jnp.asarray([1.0])))
+    assert a < b
+
+    rng = np.random.default_rng(31)
+    X = rng.normal(size=(400, 3)).astype(np.float32)
+    yv = np.exp(X[:, 0] * 0.5 + 0.1 * rng.normal(size=400)).astype(np.float32)
+    for obj in ("poisson", "tweedie", "quantile", "huber", "fair", "gamma"):
+        bst = train_booster(X, yv, BoosterConfig(objective=obj,
+                                                 num_iterations=4,
+                                                 early_stopping_round=3),
+                            valid=(X, yv))
+        assert bst.num_trees >= 1, obj
+        assert np.isfinite(bst.predict(X[:5])).all(), obj

@@ -1331,6 +1331,8 @@ def _default_metric(objective: str) -> str:
         "huber": "huber",
         "fair": "fair",
         "mape": "mape",
+        "cross_entropy": "cross_entropy",
+        "xentropy": "cross_entropy",
     }.get(objective, "rmse")
 
 

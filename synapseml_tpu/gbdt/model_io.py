@@ -115,6 +115,10 @@ def _objective_string(cfg) -> str:
     if cfg.objective == "tweedie":
         return (f"tweedie "
                 f"tweedie_variance_power:{cfg.tweedie_variance_power:g}")
+    if cfg.objective in ("cross_entropy", "xentropy"):
+        # native LightGBM stores the canonical name; its model loader does
+        # not resolve config-level aliases
+        return "cross_entropy"
     return cfg.objective
 
 

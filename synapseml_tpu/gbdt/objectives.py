@@ -186,6 +186,14 @@ def mape_objective() -> Objective:
     return Objective("mape", 1, gh, init, lambda sc: sc)
 
 
+def cross_entropy_objective() -> Objective:
+    """LightGBM cross_entropy (aka xentropy): binary log-loss with
+    CONTINUOUS labels in [0, 1] (soft targets). Identical math to
+    binary_objective at sigmoid=1 (which never assumes y in {0,1});
+    xentropy has no sigmoid parameter."""
+    return binary_objective(1.0)._replace(name="cross_entropy")
+
+
 def gamma_objective() -> Objective:
     def gh(score, y, w):
         ey = y * jnp.exp(-score)
@@ -298,6 +306,8 @@ _FACTORIES = {
     "quantile": lambda p: quantile_objective(p.get("alpha", 0.5)),
     "mape": lambda p: mape_objective(),
     "gamma": lambda p: gamma_objective(),
+    "cross_entropy": lambda p: cross_entropy_objective(),
+    "xentropy": lambda p: cross_entropy_objective(),
     "tweedie": lambda p: tweedie_objective(p.get("tweedie_variance_power", 1.5)),
 }
 
@@ -458,6 +468,10 @@ METRICS = {
         y, pred, kw.get("alpha", 0.9)),
     "huber": lambda y, pred, **kw: huber_metric(
         y, pred, kw.get("alpha", 0.9)),
+    # cross_entropy metric: soft-label log loss == binary_logloss (it
+    # never assumes y in {0,1})
+    "cross_entropy": lambda y, pred, **kw: binary_logloss(y, pred),
+    "xentropy": lambda y, pred, **kw: binary_logloss(y, pred),
     "fair": lambda y, pred, **kw: fair_metric(
         y, pred, kw.get("fair_c", 1.0)),
 }

@@ -877,3 +877,22 @@ def test_objective_loss_metrics_drive_validation():
                             valid=(X, yv))
         assert bst.num_trees >= 1, obj
         assert np.isfinite(bst.predict(X[:5])).all(), obj
+
+
+def test_cross_entropy_soft_labels():
+    """cross_entropy/xentropy: binary log-loss over CONTINUOUS labels in
+    [0,1] (LightGBM xentropy); prediction is a probability."""
+    rng = np.random.default_rng(37)
+    X = rng.normal(size=(500, 3)).astype(np.float32)
+    # soft targets: a noisy probability driven by f0
+    y = (1.0 / (1.0 + np.exp(-2.0 * X[:, 0]))
+         + 0.05 * rng.normal(size=500)).clip(0, 1).astype(np.float32)
+    for obj in ("cross_entropy", "xentropy"):
+        bst = train_booster(X, y, BoosterConfig(objective=obj,
+                                                num_iterations=6,
+                                                early_stopping_round=3),
+                            valid=(X, y))
+        p = bst.predict(X[:100])
+        assert ((p >= 0) & (p <= 1)).all()
+        # correlation with the soft target, not just finiteness
+        assert np.corrcoef(p, y[:100])[0, 1] > 0.7

@@ -294,23 +294,22 @@ def bench_onnx_inference(batch=64, image=224, warmup=2, steps=8,
             "vs_baseline": round(v / BASELINE_ONNX_IMGS_SEC, 3)}
 
 
-def bench_serving(n_requests=200):
-    """End-to-end serving latency (accept → queue → jitted pipeline → reply;
-    io/serving.py) vs the reference's "sub-millisecond" Spark Serving claim."""
-    import json as _json
+# one payload shape for every serving bench — must match the fixture's
+# 8-dim weights below
+_SERVING_PAYLOAD = b'{"x": [0.1, 0.1, 0.1, 0.1, 0.1, 0.1, 0.1, 0.1]}'
 
+
+def _serving_pipeline_handler():
+    """Shared serving-bench fixture: a tiny jitted pipeline committed to the
+    host CPU device (committed operands pin compute local — with a remote
+    accelerator behind the axon tunnel every request would otherwise pay the
+    ~15-20 ms tunnel RTT, measuring the tunnel rather than the serving
+    layer). Returns a Table handler."""
     import jax
     import jax.numpy as jnp
 
     from synapseml_tpu.core.table import Table
-    from synapseml_tpu.io.serving import ServingServer
 
-    # Commit the weights to the host CPU device: committed operands pin the
-    # jitted pipeline to local compute, which is the apples-to-apples setup
-    # vs the reference's claim (Spark Serving dispatches to local JVM
-    # executors). With a remote accelerator behind the axon tunnel every
-    # request would otherwise pay the ~15-20 ms tunnel RTT, measuring the
-    # tunnel rather than the serving layer.
     try:
         cpu = jax.devices("cpu")[0]
     except RuntimeError:
@@ -330,37 +329,53 @@ def bench_serving(n_requests=200):
         out = np.asarray(pipeline(x))
         return Table({"id": df["id"], "reply": out.astype(np.float64)})
 
+    return handler
+
+
+def _measure_latency(port: int, path: str, n_requests: int,
+                     warmup: int = 20):
+    """Keep-alive client latency probe → (p50_ms, p99_ms)."""
+    import http.client
+
+    payload = _SERVING_PAYLOAD
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+
+    def one():
+        conn.request("POST", path, body=payload,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        body = r.read()
+        if r.status != 200:   # http.client does not raise on 5xx
+            raise RuntimeError(f"serving error {r.status}: {body[:120]!r}")
+
+    for _ in range(warmup):
+        one()
+    lat = []
+    for _ in range(n_requests):
+        t0 = time.perf_counter()
+        one()
+        lat.append((time.perf_counter() - t0) * 1e3)
+    conn.close()
+    lat = np.sort(np.asarray(lat))
+    return float(lat[len(lat) // 2]), float(lat[int(len(lat) * 0.99)])
+
+
+def bench_serving(n_requests=200):
+    """End-to-end serving latency (accept → queue → jitted pipeline → reply;
+    io/serving.py) vs the reference's "sub-millisecond" Spark Serving claim."""
+    import json as _json
+
+    from synapseml_tpu.io.serving import ServingServer
+
     # latency-optimized serving config: no artificial batch-formation wait
     # (batches still form under concurrent backlog); keep-alive client
     # connection as any production caller would hold
-    server = ServingServer(handler, host="127.0.0.1", port=0,
-                           max_batch_size=32, max_batch_latency=0.0)
+    server = ServingServer(_serving_pipeline_handler(), host="127.0.0.1",
+                           port=0, max_batch_size=32, max_batch_latency=0.0)
     server.start()
     try:
-        import http.client
-
-        payload = _json.dumps({"x": [0.1] * 8}).encode()
-        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
-
-        def one():
-            conn.request("POST", server.api_path, body=payload,
-                         headers={"Content-Type": "application/json"})
-            r = conn.getresponse()
-            body = r.read()
-            if r.status != 200:   # http.client does not raise on 5xx
-                raise RuntimeError(f"serving error {r.status}: {body[:120]!r}")
-
-        for _ in range(20):
-            one()                      # warm the jit + connection path
-        lat = []
-        for _ in range(n_requests):
-            t0 = time.perf_counter()
-            one()
-            lat.append((time.perf_counter() - t0) * 1e3)
-        conn.close()
-        lat = np.sort(np.asarray(lat))
-        p50 = float(lat[len(lat) // 2])
-        p99 = float(lat[int(len(lat) * 0.99)])
+        p50, p99 = _measure_latency(server.port, server.api_path, n_requests)
+        payload = _SERVING_PAYLOAD
 
         # throughput under concurrent load: the micro-batcher should coalesce
         # backlogged requests into one pipeline call per drain
@@ -503,33 +518,9 @@ def bench_serving_distributed(n_requests=200):
     Measures the end-to-end client → gateway → worker → reply latency — the
     forwarding hop the reference stubs (InternalHandler NotImplementedError)
     priced against the head-node number from bench_serving."""
-    import json as _json
-
-    import jax
-    import jax.numpy as jnp
-
-    from synapseml_tpu.core.table import Table
     from synapseml_tpu.io import ServingGateway, ServingServer
 
-    try:
-        cpu = jax.devices("cpu")[0]
-    except RuntimeError:
-        cpu = None
-    w = jnp.asarray(np.random.default_rng(0).normal(size=(8,)), jnp.float32)
-    if cpu is not None:
-        w = jax.device_put(w, cpu)
-
-    @jax.jit
-    def pipeline(x):
-        return jnp.tanh(x @ w)
-
-    def handler(df: Table) -> Table:
-        x = np.asarray([v["x"] for v in df["value"]], np.float32)
-        if cpu is not None:
-            x = jax.device_put(x, cpu)
-        out = np.asarray(pipeline(x))
-        return Table({"id": df["id"], "reply": out.astype(np.float64)})
-
+    handler = _serving_pipeline_handler()
     workers = [ServingServer(handler, host="127.0.0.1", port=0,
                              max_batch_size=32,
                              max_batch_latency=0.0).start()
@@ -540,30 +531,7 @@ def bench_serving_distributed(n_requests=200):
                         mode="least_loaded", local_worker=workers[0],
                         local_index=0).start()
     try:
-        import http.client
-
-        payload = _json.dumps({"x": [0.1] * 8}).encode()
-        conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=5)
-
-        def one():
-            conn.request("POST", gw.api_path, body=payload,
-                         headers={"Content-Type": "application/json"})
-            r = conn.getresponse()
-            body = r.read()
-            if r.status != 200:
-                raise RuntimeError(f"gateway error {r.status}: {body[:120]!r}")
-
-        for _ in range(20):
-            one()
-        lat = []
-        for _ in range(n_requests):
-            t0 = time.perf_counter()
-            one()
-            lat.append((time.perf_counter() - t0) * 1e3)
-        conn.close()
-        lat = np.sort(np.asarray(lat))
-        p50 = float(lat[len(lat) // 2])
-        p99 = float(lat[int(len(lat) * 0.99)])
+        p50, p99 = _measure_latency(gw.port, gw.api_path, n_requests)
         forwarded = gw.stats["forwarded"]
         return {"metric": "serving_distributed_latency_p50_ms",
                 "value": round(p50, 3),

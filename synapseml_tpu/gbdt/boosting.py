@@ -227,6 +227,18 @@ class Booster:
         return np.where(has_nan[sf_safe] | (stype[: len(sf)] == 1),
                         2, 0).astype(np.int32)
 
+    def unweighted(self) -> "Booster":
+        """Copy with unit tree weights and zero base — used to recover raw
+        per-tree contributions (dart drop candidates / rf validation).
+        Thresholds/missing codes ride along: a from_model_string booster has
+        a synthetic all-inf mapper, so dropping its parsed thresholds would
+        send every row left."""
+        return Booster(self.mapper, self.config, self.trees,
+                       [1.0] * len(self.trees),
+                       np.zeros_like(self.base_score),
+                       thresholds=self.thresholds,
+                       missing_types=self.missing_types)
+
     def forest(self) -> Forest:
         if self._forest_cache is None or self._forest_cache.num_trees != len(self.trees):
             trees = self.trees
@@ -927,14 +939,7 @@ def train_booster(
             # weights divided back out
             from .grower import forest_predict as _fp
 
-            # thresholds/missing_types must ride along: a from_model_string
-            # init_model has a synthetic all-inf mapper, so dropping its
-            # parsed thresholds would send every row left
-            unweighted = Booster(init_model.mapper, init_model.config,
-                                 init_model.trees, [1.0] * len(init_model.trees),
-                                 np.zeros_like(init_model.base_score),
-                                 thresholds=init_model.thresholds,
-                                 missing_types=init_model.missing_types)
+            unweighted = init_model.unweighted()
             uf = unweighted.forest()
             per_tree = np.asarray(_fp(uf, jnp.asarray(X), output="per_tree",
                                       depth=unweighted._depth_cache))  # (N, T)
@@ -987,11 +992,7 @@ def train_booster(
         # dart/rf: per-tree validation contributions (weights change later)
         valid_contribs: List[tuple] = []
         if init_model is not None and cfg.boosting_type in ("dart", "rf"):
-            unw = Booster(init_model.mapper, init_model.config, init_model.trees,
-                          [1.0] * len(init_model.trees),
-                          np.zeros_like(init_model.base_score),
-                          thresholds=init_model.thresholds,
-                          missing_types=init_model.missing_types)
+            unw = init_model.unweighted()
             uf_v = unw.forest()
             pt_v = forest_predict(uf_v, jnp.asarray(Xv), output="per_tree",
                                   depth=unw._depth_cache)   # (Nv, T)

@@ -85,8 +85,7 @@ class _WorkerLink:
         close idle connections after ~30s (serving.py Handler.timeout), and
         that stale-socket error must not read as a dead worker — it would
         cool down every healthy worker after any idle period."""
-        conn = self._get_conn()
-        if conn is not None:
+        def send(conn):
             try:
                 conn.request(method, path, body=body, headers=headers)
                 r = conn.getresponse()
@@ -94,18 +93,17 @@ class _WorkerLink:
                 self._pool.put(conn)
                 return r.status, payload
             except Exception:
-                conn.close()       # stale keep-alive conn: fall through
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
-        try:
-            conn.request(method, path, body=body, headers=headers)
-            r = conn.getresponse()
-            payload = r.read()
-            self._pool.put(conn)
-            return r.status, payload
-        except Exception:
-            conn.close()           # broken conn must not re-pool
-            raise
+                conn.close()       # broken conn must not re-pool
+                raise
+
+        pooled = self._get_conn()
+        if pooled is not None:
+            try:
+                return send(pooled)
+            except Exception:
+                pass               # stale keep-alive conn: retry fresh below
+        return send(http.client.HTTPConnection(self.host, self.port,
+                                               timeout=self.timeout))
 
     def mark_ok(self) -> None:
         with self._lock:

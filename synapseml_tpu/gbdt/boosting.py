@@ -146,7 +146,8 @@ class Booster:
                  base_score: np.ndarray, feature_names: Optional[List[str]] = None,
                  best_iteration: int = -1,
                  thresholds: Optional[List[np.ndarray]] = None,
-                 missing_types: Optional[List[np.ndarray]] = None):
+                 missing_types: Optional[List[np.ndarray]] = None,
+                 best_score: Optional[float] = None):
         self.mapper = mapper
         self.config = config
         self.trees = trees
@@ -154,6 +155,8 @@ class Booster:
         self.base_score = np.atleast_1d(np.asarray(base_score, np.float64))
         self.feature_names = feature_names or [f"Column_{i}" for i in range(mapper.num_features)]
         self.best_iteration = best_iteration
+        # the best validation metric value (LightGBM Booster.best_score)
+        self.best_score = best_score
         # real-valued thresholds per tree; None → resolve from the bin mapper.
         # Loaded native models carry raw thresholds directly (no mapper).
         self.thresholds = thresholds
@@ -494,7 +497,7 @@ def _fused_static_key(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
 
 def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
     """Jitted fn(binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins,
-    base_k, gidx, binned_v, yv_j, gidx_v, score0, bag0, sv0, start,
+    base_k, gidx, binned_v, yv_j, wv_j, gidx_v, score0, bag0, sv0, start,
     count[static]) → (carry, (stacked_trees, mvals)). ``nv`` is the
     validation row count (0 = no validation)."""
     key = _fused_static_key(cfg, grower_cfg, n, nfeat, k, nv, metric_name,
@@ -515,7 +518,7 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
 
     def body_for(args):
         (binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins, cat_nbins,
-         base_k, gidx, binned_v, yv_j, gidx_v) = args
+         base_k, gidx, binned_v, yv_j, wv_j, gidx_v) = args
         if not jnp.issubdtype(key0.dtype, jax.dtypes.prng_key):
             key0 = jax.random.wrap_key_data(key0)   # multi-process raw key
         if is_ranking:
@@ -561,7 +564,7 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
                                else ndcg_at_k)
                     mval = rank_fn(yv_j, raw_v[:, 0], gidx_v, at)
                 else:
-                    mval = METRICS[metric_name](yv_j, pred_v,
+                    mval = METRICS[metric_name](yv_j, pred_v, weight=wv_j,
                                                 **metric_kwargs(cfg))
             else:
                 mval = jnp.float32(0)
@@ -571,11 +574,12 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
 
     @functools.partial(jax.jit, static_argnames=("count",))
     def run_scan(binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins,
-                 cat_nbins, base_k, gidx, binned_v, yv_j, gidx_v, score0,
+                 cat_nbins, base_k, gidx, binned_v, yv_j, wv_j, gidx_v,
+                 score0,
                  bag0, sv0, start, count):
         body = body_for((binned, yj, wj, valid_mask, key0, is_cat, mono,
                          nan_bins, cat_nbins, base_k, gidx, binned_v, yv_j,
-                         gidx_v))
+                         wv_j, gidx_v))
         return lax.scan(body, (score0, bag0, sv0),
                         start + jnp.arange(count, dtype=jnp.int32))
 
@@ -1058,10 +1062,17 @@ def train_booster(
                 gidx_v = jnp.asarray(make_grouped(yv, valid[3]))
             else:
                 gidx_v = jnp.zeros(nv, jnp.int32)
+            # validation sample weights (valid[2]) weight the POINTWISE
+            # eval metrics, as in LightGBM (ndcg/map stay per-query
+            # unweighted here); absent -> uniform
+            wv_raw = valid[2] if len(valid) > 2 else None
+            wv_j = (jnp.asarray(np.asarray(wv_raw, np.float32))
+                    if wv_raw is not None else jnp.ones(nv, jnp.float32))
             bv_arg = binned_v
         else:
             zeros = np.zeros if multiproc else jnp.zeros
             yv_j = zeros(1, np.float32)
+            wv_j = zeros(1, np.float32)
             gidx_v = zeros(1, np.int32)
             bv_arg = zeros((1, nfeat), binned.dtype)
 
@@ -1083,7 +1094,8 @@ def train_booster(
                 c = min(chunk, T - done)
                 carry, (stacked_trees, mv) = run_scan(
                     binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins,
-                    cat_nbins, base_k, gidx_arr, bv_arg, yv_j, gidx_v, *carry,
+                    cat_nbins, base_k, gidx_arr, bv_arg, yv_j, wv_j, gidx_v,
+                    *carry,
                     done, c)
                 stacked_trees = jax.device_get(stacked_trees)
                 for ti in range(c):
@@ -1126,8 +1138,14 @@ def train_booster(
 
         trees = jax.device_get(trees)
         return Booster(mapper, cfg, trees, tree_weights, base, feature_names,
-                       best_iteration=(best_iter if has_valid else -1))
+                       best_iteration=(best_iter if has_valid else -1),
+                       best_score=(best_metric if has_valid else None))
 
+    # validation weights converted to device ONCE (per-iteration eval would
+    # otherwise redo the H2D transfer every round)
+    wv_dev = None
+    if has_valid and len(valid) > 2 and valid[2] is not None:
+        wv_dev = jnp.asarray(np.asarray(valid[2], np.float32))
     for it in range(cfg.num_iterations):
         # ---- dart: drop trees and de-weight the score -------------------
         if dart_mode and trees:
@@ -1274,7 +1292,8 @@ def train_booster(
             else:
                 raw_v = score_v
             pred_v = obj.transform(raw_v[:, 0] if k == 1 else raw_v)
-            mval = float(_eval_metric(metric_name, yv, pred_v, raw_v, valid, k, cfg))
+            mval = float(_eval_metric(metric_name, yv, pred_v, raw_v,
+                                      valid, k, cfg, wv_dev))
             improved = (best_metric is None
                         or (mval > best_metric if higher_better else mval < best_metric))
             if improved:
@@ -1307,7 +1326,8 @@ def train_booster(
     return Booster(mapper, cfg, trees, tree_weights, base, feature_names,
                    best_iteration=(n_init_trees // max(k, 1) + best_iter
                                    if has_valid else -1),
-                   thresholds=merged_thr, missing_types=merged_mt)
+                   thresholds=merged_thr, missing_types=merged_mt,
+                   best_score=(best_metric if has_valid else None))
 
 
 def _is_rank_metric(name: str) -> bool:
@@ -1337,7 +1357,7 @@ def _default_metric(objective: str) -> str:
     }.get(objective, "rmse")
 
 
-def _eval_metric(name, yv, pred_v, raw_v, valid, k, cfg=None):
+def _eval_metric(name, yv, pred_v, raw_v, valid, k, cfg=None, wv=None):
     if _is_rank_metric(name):
         at = int(name.split("@")[1]) if "@" in name else 5
         if len(valid) < 4:
@@ -1347,4 +1367,4 @@ def _eval_metric(name, yv, pred_v, raw_v, valid, k, cfg=None):
         rank_fn = map_at_k if name.startswith("map") else ndcg_at_k
         return rank_fn(jnp.asarray(yv), raw_v[:, 0], jnp.asarray(gidx), at)
     fn = METRICS[name]
-    return fn(jnp.asarray(yv), pred_v, **metric_kwargs(cfg))
+    return fn(jnp.asarray(yv), pred_v, weight=wv, **metric_kwargs(cfg))

@@ -337,22 +337,24 @@ def auc(y_true, y_score, sample_weight=None):
     return auc_sum / jnp.maximum(pos * neg, 1e-12)
 
 
-def binary_logloss(y_true, p, eps=1e-15):
+def binary_logloss(y_true, p, eps=1e-15, weight=None):
     p = jnp.clip(p, eps, 1 - eps)
-    return -jnp.mean(y_true * jnp.log(p) + (1 - y_true) * jnp.log1p(-p))
+    return _wmean(-(y_true * jnp.log(p) + (1 - y_true) * jnp.log1p(-p)),
+                  weight)
 
 
-def multi_logloss(y_true, p, eps=1e-15):
+def multi_logloss(y_true, p, eps=1e-15, weight=None):
     p = jnp.clip(p, eps, 1.0)
-    return -jnp.mean(jnp.log(jnp.take_along_axis(p, y_true.astype(jnp.int32)[:, None], 1)[:, 0]))
+    return _wmean(-jnp.log(jnp.take_along_axis(
+        p, y_true.astype(jnp.int32)[:, None], 1)[:, 0]), weight)
 
 
-def rmse(y_true, pred):
-    return jnp.sqrt(jnp.mean((y_true - pred) ** 2))
+def rmse(y_true, pred, weight=None):
+    return jnp.sqrt(_wmean((y_true - pred) ** 2, weight))
 
 
-def mae(y_true, pred):
-    return jnp.mean(jnp.abs(y_true - pred))
+def mae(y_true, pred, weight=None):
+    return _wmean(jnp.abs(y_true - pred), weight)
 
 
 def ndcg_at_k(labels, scores, group_index, k: int = 5):
@@ -374,42 +376,54 @@ def ndcg_at_k(labels, scores, group_index, k: int = 5):
     return jnp.mean(jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 1.0))
 
 
-def poisson_metric(y, pred):
+def _wmean(v, w=None):
+    """Weighted mean — every LightGBM metric weights per-row losses by the
+    validation sample weights when provided."""
+    if w is None:
+        return jnp.mean(v)
+    w = jnp.asarray(w, jnp.float32)
+    return jnp.sum(v * w) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def poisson_metric(y, pred, w=None):
     """LightGBM PoissonMetric: pred - y*log(pred) (psi const dropped)."""
     p = jnp.maximum(pred, 1e-15)
-    return jnp.mean(p - y * jnp.log(p))
+    return _wmean(p - y * jnp.log(p), w)
 
 
-def gamma_metric(y, pred):
+def gamma_metric(y, pred, w=None):
     p = jnp.maximum(pred, 1e-15)
-    return jnp.mean(y / p + jnp.log(p))
+    return _wmean(y / p + jnp.log(p), w)
 
 
-def gamma_deviance_metric(y, pred):
+def gamma_deviance_metric(y, pred, w=None):
     p = jnp.maximum(pred, 1e-15)
-    return 2.0 * jnp.mean(jnp.log(p / jnp.maximum(y, 1e-15)) + y / p - 1.0)
+    return 2.0 * _wmean(jnp.log(p / jnp.maximum(y, 1e-15)) + y / p - 1.0, w)
 
 
-def tweedie_metric(y, pred, rho: float = 1.5):
+def tweedie_metric(y, pred, rho: float = 1.5, w=None):
     p = jnp.maximum(pred, 1e-15)
-    return jnp.mean(-y * p ** (1.0 - rho) / (1.0 - rho)
-                    + p ** (2.0 - rho) / (2.0 - rho))
+    return _wmean(-y * p ** (1.0 - rho) / (1.0 - rho)
+                  + p ** (2.0 - rho) / (2.0 - rho), w)
 
 
-def quantile_metric(y, pred, alpha: float = 0.9):
+def quantile_metric(y, pred, alpha: float = 0.9, w=None):
     d = y - pred
-    return jnp.mean(jnp.maximum(alpha * d, (alpha - 1.0) * d))
+    return _wmean(jnp.maximum(alpha * d, (alpha - 1.0) * d), w)
 
 
-def huber_metric(y, pred, alpha: float = 0.9):
+def huber_metric(y, pred, alpha: float = 0.9, w=None):
     d = y - pred
-    return jnp.mean(jnp.where(jnp.abs(d) <= alpha, 0.5 * d * d,
-                              alpha * (jnp.abs(d) - 0.5 * alpha)))
+    return _wmean(jnp.where(jnp.abs(d) <= alpha, 0.5 * d * d,
+                            alpha * (jnp.abs(d) - 0.5 * alpha)), w)
 
 
-def fair_metric(y, pred, c: float = 1.0):
+def fair_metric(y, pred, c: float = 1.0, w=None):
     ad = jnp.abs(y - pred)
-    return jnp.mean(c * c * (ad / c - jnp.log1p(ad / c)))
+    return _wmean(c * c * (ad / c - jnp.log1p(ad / c)), w)
+
+
+
 
 
 def metric_kwargs(cfg) -> dict:
@@ -443,37 +457,49 @@ def map_at_k(labels, scores, group_index, k: int = 5):
     return jnp.mean(ap)
 
 
+# Every entry honors kw["weight"] (validation sample weights) the way the
+# corresponding LightGBM metric does.
 METRICS = {
     "auc": lambda y, pred, **kw: auc(y, pred, kw.get("weight")),
-    "binary_logloss": lambda y, pred, **kw: binary_logloss(y, pred),
-    "binary_error": lambda y, pred, **kw: jnp.mean((pred > 0.5) != (y > 0.5)),
-    "multi_logloss": lambda y, pred, **kw: multi_logloss(y, pred),
-    "multi_error": lambda y, pred, **kw: jnp.mean(jnp.argmax(pred, -1) != y),
-    "rmse": lambda y, pred, **kw: rmse(y, pred),
-    "l2": lambda y, pred, **kw: jnp.mean((y - pred) ** 2),
-    "mse": lambda y, pred, **kw: jnp.mean((y - pred) ** 2),
-    "mae": lambda y, pred, **kw: mae(y, pred),
-    "l1": lambda y, pred, **kw: mae(y, pred),
+    "binary_logloss": lambda y, pred, **kw: binary_logloss(
+        y, pred, weight=kw.get("weight")),
+    "binary_error": lambda y, pred, **kw: _wmean(
+        ((pred > 0.5) != (y > 0.5)).astype(jnp.float32), kw.get("weight")),
+    "multi_logloss": lambda y, pred, **kw: multi_logloss(
+        y, pred, weight=kw.get("weight")),
+    "multi_error": lambda y, pred, **kw: _wmean(
+        (jnp.argmax(pred, -1) != y).astype(jnp.float32), kw.get("weight")),
+    "rmse": lambda y, pred, **kw: rmse(y, pred, weight=kw.get("weight")),
+    "l2": lambda y, pred, **kw: _wmean((y - pred) ** 2, kw.get("weight")),
+    "mse": lambda y, pred, **kw: _wmean((y - pred) ** 2, kw.get("weight")),
+    "mae": lambda y, pred, **kw: mae(y, pred, weight=kw.get("weight")),
+    "l1": lambda y, pred, **kw: _wmean(jnp.abs(y - pred), kw.get("weight")),
     # LightGBM MAPEMetric: |y - pred| / max(1, |y|)
-    "mape": lambda y, pred, **kw: jnp.mean(
-        jnp.abs(y - pred) / jnp.maximum(1.0, jnp.abs(y))),
+    "mape": lambda y, pred, **kw: _wmean(
+        jnp.abs(y - pred) / jnp.maximum(1.0, jnp.abs(y)), kw.get("weight")),
     # loss-metrics of the exp-family / robust objectives (pred is in the
     # RESPONSE space — the exp link is already applied)
-    "poisson": lambda y, pred, **kw: poisson_metric(y, pred),
-    "gamma": lambda y, pred, **kw: gamma_metric(y, pred),
-    "gamma_deviance": lambda y, pred, **kw: gamma_deviance_metric(y, pred),
+    "poisson": lambda y, pred, **kw: poisson_metric(y, pred,
+                                                    w=kw.get("weight")),
+    "gamma": lambda y, pred, **kw: gamma_metric(y, pred,
+                                                w=kw.get("weight")),
+    "gamma_deviance": lambda y, pred, **kw: gamma_deviance_metric(
+        y, pred, w=kw.get("weight")),
     "tweedie": lambda y, pred, **kw: tweedie_metric(
-        y, pred, kw.get("tweedie_variance_power", 1.5)),
+        y, pred, kw.get("tweedie_variance_power", 1.5),
+        w=kw.get("weight")),
     "quantile": lambda y, pred, **kw: quantile_metric(
-        y, pred, kw.get("alpha", 0.9)),
+        y, pred, kw.get("alpha", 0.9), w=kw.get("weight")),
     "huber": lambda y, pred, **kw: huber_metric(
-        y, pred, kw.get("alpha", 0.9)),
+        y, pred, kw.get("alpha", 0.9), w=kw.get("weight")),
     # cross_entropy metric: soft-label log loss == binary_logloss (it
     # never assumes y in {0,1})
-    "cross_entropy": lambda y, pred, **kw: binary_logloss(y, pred),
-    "xentropy": lambda y, pred, **kw: binary_logloss(y, pred),
+    "cross_entropy": lambda y, pred, **kw: binary_logloss(
+        y, pred, weight=kw.get("weight")),
+    "xentropy": lambda y, pred, **kw: binary_logloss(
+        y, pred, weight=kw.get("weight")),
     "fair": lambda y, pred, **kw: fair_metric(
-        y, pred, kw.get("fair_c", 1.0)),
+        y, pred, kw.get("fair_c", 1.0), w=kw.get("weight")),
 }
 
 HIGHER_IS_BETTER = {"auc", "ndcg", "map"}

@@ -896,3 +896,48 @@ def test_cross_entropy_soft_labels():
         assert ((p >= 0) & (p <= 1)).all()
         # correlation with the soft target, not just finiteness
         assert np.corrcoef(p, y[:100])[0, 1] > 0.7
+
+
+def test_weighted_validation_metrics():
+    """Validation sample weights (valid[2]) weight the eval metric —
+    LightGBM semantics. A weight vector concentrated on mispredicted rows
+    must change the metric value."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.gbdt.objectives import METRICS
+
+    y = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    p = jnp.asarray([0.9, 0.1, 0.2, 0.8])    # rows 2,3 badly predicted
+    unw = float(METRICS["binary_logloss"](y, p))
+    heavy = float(METRICS["binary_logloss"](y, p,
+                                            weight=jnp.asarray(
+                                                [0.0, 0.0, 1.0, 1.0])))
+    light = float(METRICS["binary_logloss"](y, p,
+                                            weight=jnp.asarray(
+                                                [1.0, 1.0, 0.0, 0.0])))
+    assert light < unw < heavy
+    # weighted rmse hand-check: sqrt((1*4 + 3*1)/4)
+    r = float(METRICS["rmse"](jnp.asarray([0.0, 0.0]),
+                              jnp.asarray([2.0, 1.0]),
+                              weight=jnp.asarray([1.0, 3.0])))
+    assert abs(r - np.sqrt((4.0 + 3.0) / 4.0)) < 1e-6
+
+    # end-to-end: the recorded best_score IS the weighted logloss of the
+    # best iteration's predictions (reverting the wv plumbing would leave
+    # best_score at the unweighted value and fail this)
+    rng = np.random.default_rng(41)
+    X = rng.normal(size=(400, 3)).astype(np.float32)
+    yy = (X[:, 0] > 0).astype(np.float32)
+    wv = np.ones(400, np.float32)
+    wv[:200] = 10.0
+    b = train_booster(X, yy, BoosterConfig(objective="binary",
+                                           num_iterations=4,
+                                           metric="binary_logloss"),
+                      valid=(X, yy, wv, None))
+    pred_best = b.predict(X, num_iteration=b.best_iteration + 1)
+    expect_w = float(METRICS["binary_logloss"](
+        jnp.asarray(yy), jnp.asarray(pred_best), weight=jnp.asarray(wv)))
+    expect_unw = float(METRICS["binary_logloss"](jnp.asarray(yy),
+                                                 jnp.asarray(pred_best)))
+    assert abs(b.best_score - expect_w) < 1e-5, (b.best_score, expect_w)
+    assert abs(expect_w - expect_unw) > 1e-6   # the weights actually matter

@@ -324,16 +324,27 @@ def get_objective(name: str, **params) -> Objective:
 # ---------------------------------------------------------------------------
 
 def auc(y_true, y_score, sample_weight=None):
+    """Weighted ROC AUC with exact tie handling: each positive counts the
+    negatives scored strictly below it plus HALF the negatives it ties with
+    (the trapezoid rule — what LightGBM/sklearn compute). Ties matter on
+    discrete features and loaded constant-leaf models."""
     y_true = jnp.asarray(y_true, jnp.float32)
     y_score = jnp.asarray(y_score, jnp.float32)
-    w = jnp.ones_like(y_true) if sample_weight is None else jnp.asarray(sample_weight, jnp.float32)
+    w = (jnp.ones_like(y_true) if sample_weight is None
+         else jnp.asarray(sample_weight, jnp.float32))
     order = jnp.argsort(y_score)
-    ys, ws = y_true[order], w[order]
-    cum_neg = jnp.cumsum(jnp.where(ys == 0, ws, 0.0))
-    auc_sum = jnp.sum(jnp.where(ys > 0, ws * cum_neg, 0.0))
+    ys, ws, ss = y_true[order], w[order], y_score[order]
+    wneg = jnp.where(ys == 0, ws, 0.0)
+    # padded cumulative negatives: cum[i] = neg weight in rows < i
+    cum = jnp.concatenate([jnp.zeros(1), jnp.cumsum(wneg)])
+    left = jnp.searchsorted(ss, ss, side="left")    # first index of my tie
+    right = jnp.searchsorted(ss, ss, side="right")  # one past my tie group
+    neg_below = cum[left]
+    tie_neg = cum[right] - cum[left]
+    auc_sum = jnp.sum(jnp.where(ys > 0, ws * (neg_below + 0.5 * tie_neg),
+                                0.0))
     pos = jnp.sum(jnp.where(ys > 0, ws, 0.0))
-    neg = jnp.sum(jnp.where(ys == 0, ws, 0.0))
-    # tie-correction omitted (scores rarely tie for GBDT margins)
+    neg = jnp.sum(wneg)
     return auc_sum / jnp.maximum(pos * neg, 1e-12)
 
 

@@ -941,3 +941,33 @@ def test_weighted_validation_metrics():
                                                  jnp.asarray(pred_best)))
     assert abs(b.best_score - expect_w) < 1e-5, (b.best_score, expect_w)
     assert abs(expect_w - expect_unw) > 1e-6   # the weights actually matter
+
+
+def test_auc_tie_correction():
+    """AUC handles tied scores via the trapezoid rule (half credit), with
+    weights — validated against hand computation and random agreement with
+    the rank formula when no ties exist."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.gbdt.objectives import auc
+
+    # all scores tied -> AUC exactly 0.5 (previously 0.0/1.0 by sort order)
+    y = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    s = jnp.zeros(4)
+    assert abs(float(auc(y, s)) - 0.5) < 1e-6
+    # hand case: scores [1,1,2], labels [0,1,1]: pos@1 ties one neg (0.5),
+    # pos@2 beats one neg (1.0) -> auc = 1.5/2
+    v = float(auc(jnp.asarray([0.0, 1.0, 1.0]), jnp.asarray([1.0, 1.0, 2.0])))
+    assert abs(v - 0.75) < 1e-6
+    # weighted hand case: same but neg weight 2: pos@1 -> 0.5*2, pos@2 -> 2
+    v = float(auc(jnp.asarray([0.0, 1.0, 1.0]), jnp.asarray([1.0, 1.0, 2.0]),
+                  jnp.asarray([2.0, 1.0, 1.0])))
+    assert abs(v - (1.0 + 2.0) / (2.0 * 2.0)) < 1e-6
+    # no ties: matches the Mann-Whitney rank statistic computed in numpy
+    rng = np.random.default_rng(3)
+    yy = (rng.random(200) > 0.5).astype(np.float32)
+    sc = rng.normal(size=200).astype(np.float32)
+    got = float(auc(jnp.asarray(yy), jnp.asarray(sc)))
+    pos_s, neg_s = sc[yy > 0], sc[yy == 0]
+    expect = (pos_s[:, None] > neg_s[None, :]).mean()
+    assert abs(got - float(expect)) < 1e-5

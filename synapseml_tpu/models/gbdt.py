@@ -288,6 +288,11 @@ class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
         LightGBMModelMethods.getBoosterBestIteration parity."""
         return int(self.booster.best_iteration)
 
+    def getBoosterBestScore(self):
+        """Best validation metric value from training (None without
+        validation) — the Booster.best_score surface."""
+        return self.booster.best_score
+
     def getBoosterNumTotalIterations(self) -> int:
         return self.booster.num_trees // self.booster.models_per_iter
 

@@ -249,3 +249,20 @@ def test_max_num_classes_and_reference_dataset(binary_data):
     m = LightGBMClassifier(numIterations=3,
                            referenceDataset=mapper).fit(t)
     assert m.booster.mapper is mapper
+
+
+def test_model_best_score_surface():
+    """getBoosterBestScore exposes the best validation metric (None without
+    validation)."""
+    rng = np.random.default_rng(3)
+    n = 400
+    cols = {f"f{i}": rng.normal(size=n) for i in range(3)}
+    cols["label"] = (cols["f0"] > 0).astype(np.float64)
+    cols["isVal"] = (np.arange(n) % 4 == 0).astype(np.float64)
+    t = assemble_features(Table(cols), [f"f{i}" for i in range(3)])
+    m = LightGBMClassifier(numIterations=5,
+                           validationIndicatorCol="isVal").fit(t)
+    assert m.getBoosterBestScore() is not None
+    assert np.isfinite(m.getBoosterBestScore())
+    m2 = LightGBMClassifier(numIterations=3).fit(t)
+    assert m2.getBoosterBestScore() is None

@@ -104,6 +104,9 @@ class BoosterConfig:
     # lambdarank
     lambdarank_truncation_level: int = 30
     max_position: int = 30
+    # relevance gain per label value (LightGBMRankerParams labelGain; empty
+    # = the default 2^label - 1 table)
+    label_gain: tuple = ()
     # NDCG eval positions (LightGBMRankerParams evalAt, default 1-5 at the
     # estimator layer): when set, the FIRST position drives validation/early
     # stopping, matching the reference (maxPosition truncates the lambdarank
@@ -492,6 +495,7 @@ def _fused_static_key(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
             # (_sample_rows_impl/_sample_features_impl): two configs that
             # differ only here must NOT share an executable
             cfg.extra_seed, cfg.feature_fraction_seed,
+            tuple(cfg.label_gain or ()),
             n, nfeat, k, nv, metric_name, mesh)
 
 
@@ -523,7 +527,8 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
             key0 = jax.random.wrap_key_data(key0)   # multi-process raw key
         if is_ranking:
             obj_l = lambdarank_objective(gidx, cfg.sigmoid,
-                                         cfg.lambdarank_truncation_level)
+                                         cfg.lambdarank_truncation_level,
+                                         cfg.label_gain)
             gh_fn, transform = obj_l.grad_hess, (lambda sc: sc)
         else:
             gh_fn, transform = obj.grad_hess, obj.transform
@@ -560,9 +565,11 @@ def _get_fused_runner(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
                 if _is_rank_metric(metric_name):
                     at = (int(metric_name.split("@")[1])
                           if "@" in metric_name else 5)
-                    rank_fn = (map_at_k if metric_name.startswith("map")
-                               else ndcg_at_k)
-                    mval = rank_fn(yv_j, raw_v[:, 0], gidx_v, at)
+                    if metric_name.startswith("map"):
+                        mval = map_at_k(yv_j, raw_v[:, 0], gidx_v, at)
+                    else:
+                        mval = ndcg_at_k(yv_j, raw_v[:, 0], gidx_v, at,
+                                         cfg.label_gain)
                 else:
                     mval = METRICS[metric_name](yv_j, pred_v, weight=wv_j,
                                                 **metric_kwargs(cfg))
@@ -852,10 +859,21 @@ def train_booster(
     if cfg.objective == "lambdarank":
         if group_sizes is None:
             raise ValueError("lambdarank requires group_sizes")
+        if cfg.label_gain:
+            max_label = int(np.max(y)) if len(y) else 0
+            if max_label >= len(cfg.label_gain):
+                # LightGBM fails fast here too ("Label ... is not less than
+                # the number of label gains") — silent clipping would
+                # optimize the wrong objective
+                raise ValueError(
+                    f"label {max_label} needs a label_gain table of at "
+                    f"least {max_label + 1} entries, got "
+                    f"{len(cfg.label_gain)}")
         gidx = make_grouped(y, group_sizes)
         gidx_arr = jnp.asarray(gidx)
         obj = lambdarank_objective(gidx_arr, cfg.sigmoid,
-                                   cfg.lambdarank_truncation_level)
+                                   cfg.lambdarank_truncation_level,
+                                   cfg.label_gain)
     else:
         obj = get_objective(cfg.objective, num_class=k, sigmoid=cfg.sigmoid,
                             alpha=cfg.alpha, fair_c=cfg.fair_c,
@@ -1364,7 +1382,10 @@ def _eval_metric(name, yv, pred_v, raw_v, valid, k, cfg=None, wv=None):
             raise ValueError(
                 "ranking validation requires valid=(Xv, yv, wv_or_None, group_sizes_v)")
         gidx = make_grouped(yv, valid[3])
-        rank_fn = map_at_k if name.startswith("map") else ndcg_at_k
-        return rank_fn(jnp.asarray(yv), raw_v[:, 0], jnp.asarray(gidx), at)
+        if name.startswith("map"):
+            return map_at_k(jnp.asarray(yv), raw_v[:, 0], jnp.asarray(gidx),
+                            at)
+        return ndcg_at_k(jnp.asarray(yv), raw_v[:, 0], jnp.asarray(gidx), at,
+                         cfg.label_gain if cfg is not None else ())
     fn = METRICS[name]
     return fn(jnp.asarray(yv), pred_v, weight=wv, **metric_kwargs(cfg))

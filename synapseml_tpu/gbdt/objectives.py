@@ -239,8 +239,19 @@ def make_grouped(labels: np.ndarray, group_sizes: np.ndarray, max_group: Optiona
     return idx
 
 
+def _label_gain(rel, label_gain=None):
+    """Relevance → gain: LightGBM's label_gain table when provided (entry i
+    is the gain for label i), else the default 2^rel - 1."""
+    if label_gain:
+        table = jnp.asarray(label_gain, jnp.float32)
+        idx = jnp.clip(rel.astype(jnp.int32), 0, len(label_gain) - 1)
+        return table[idx]
+    return 2.0 ** rel - 1.0
+
+
 def lambdarank_objective(group_index: jnp.ndarray, sigmoid: float = 2.0,
-                         truncation: int = 30) -> Objective:
+                         truncation: int = 30,
+                         label_gain: tuple = ()) -> Objective:
     """LambdaRank with NDCG weighting (LightGBM lambdarank). ``group_index`` is
     the (Q, Gmax) padded row-index matrix from :func:`make_grouped`. Gradients
     computed per group over the (Gmax, Gmax) pair matrix — MXU/VPU-friendly."""
@@ -251,7 +262,9 @@ def lambdarank_objective(group_index: jnp.ndarray, sigmoid: float = 2.0,
         safe = jnp.maximum(gi, 0)
         s = jnp.where(pad, -jnp.inf, score[safe])          # (Q, G)
         rel = jnp.where(pad, 0.0, y[safe])
-        gain = 2.0 ** rel - 1.0
+        # pad slots must contribute ZERO gain regardless of the table's
+        # entry for label 0 (ragged groups would otherwise corrupt idcg)
+        gain = jnp.where(pad, 0.0, _label_gain(rel, label_gain))
 
         # rank by current score (descending)
         order = jnp.argsort(-s, axis=1)
@@ -368,14 +381,14 @@ def mae(y_true, pred, weight=None):
     return _wmean(jnp.abs(y_true - pred), weight)
 
 
-def ndcg_at_k(labels, scores, group_index, k: int = 5):
+def ndcg_at_k(labels, scores, group_index, k: int = 5, label_gain: tuple = ()):
     """Mean NDCG@k over groups; group_index as in :func:`make_grouped`."""
     gi = jnp.asarray(group_index)
     pad = gi < 0
     safe = jnp.maximum(gi, 0)
     s = jnp.where(pad, -jnp.inf, scores[safe])
     rel = jnp.where(pad, 0.0, labels[safe])
-    gain = 2.0 ** rel - 1.0
+    gain = jnp.where(pad, 0.0, _label_gain(rel, label_gain))
     order = jnp.argsort(-s, axis=1)
     ranks = jnp.argsort(order, axis=1)
     disc = jnp.where(ranks < k, 1.0 / jnp.log2(ranks + 2.0), 0.0)

@@ -527,7 +527,8 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
         _, sizes = np.unique(groups, return_counts=True)
         cfg = self._base_config(objective="lambdarank",
                                 lambdarank_truncation_level=self.getMaxPosition(),
-                                eval_at=tuple(self.getEvalAt()))
+                                eval_at=tuple(self.getEvalAt()),
+                                label_gain=tuple(self.get("labelGain") or ()))
         valid = None
         if valid_df is not None and valid_df.num_rows:
             valid_df = valid_df.sort_by(gcol)

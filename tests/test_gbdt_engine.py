@@ -971,3 +971,70 @@ def test_auc_tie_correction():
     pos_s, neg_s = sc[yy > 0], sc[yy == 0]
     expect = (pos_s[:, None] > neg_s[None, :]).mean()
     assert abs(got - float(expect)) < 1e-5
+
+
+def test_label_gain_table_wired():
+    """labelGain (LightGBMRankerParams) replaces the default 2^label - 1
+    gains in BOTH the lambdarank objective and the NDCG eval."""
+    from synapseml_tpu.gbdt.objectives import make_grouped, ndcg_at_k
+
+    labels = np.asarray([2.0, 1.0, 0.0])
+    scores = np.asarray([1.0, 2.0, 3.0])   # worst ordering
+    gi = make_grouped(labels, np.asarray([3]))
+    # custom gains [0, 1, 10]: DCG = 0/1 + 1/log2(3) + 10/2;
+    # IDCG = 10/1 + 1/log2(3) + 0
+    got = float(ndcg_at_k(jnp.asarray(labels), jnp.asarray(scores), gi, 3,
+                          label_gain=(0.0, 1.0, 10.0)))
+    import math
+
+    dcg = 1.0 / math.log2(3) + 10.0 / 2.0
+    idcg = 10.0 + 1.0 / math.log2(3)
+    assert abs(got - dcg / idcg) < 1e-6, got
+    # default table still matches the old formula
+    got_d = float(ndcg_at_k(jnp.asarray(labels), jnp.asarray(scores), gi, 3))
+    dcg_d = 1.0 / math.log2(3) + 3.0 / 2.0
+    idcg_d = 3.0 + 1.0 / math.log2(3)
+    assert abs(got_d - dcg_d / idcg_d) < 1e-6
+
+    # training with a degenerate gain table that nulls label 1 must differ
+    # from the default (the table reaches the objective)
+    rng = np.random.default_rng(19)
+    n, q = 400, 20
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = rng.integers(0, 3, size=n).astype(np.float32)
+    sizes = np.full(q, n // q, np.int64)
+    b1 = train_booster(X, y, BoosterConfig(objective="lambdarank",
+                                           num_iterations=4, seed=3),
+                       group_sizes=sizes)
+    b2 = train_booster(X, y, BoosterConfig(objective="lambdarank",
+                                           num_iterations=4, seed=3,
+                                           label_gain=(0.0, 0.0, 100.0)),
+                       group_sizes=sizes)
+    assert not np.allclose(b1.predict(X[:50]), b2.predict(X[:50]))
+
+
+def test_label_gain_ragged_groups_and_validation():
+    """Pad slots contribute ZERO gain even when the table's entry 0 is
+    nonzero (ragged groups), and an undersized table fails fast like
+    LightGBM."""
+    import math
+
+    from synapseml_tpu.gbdt.objectives import make_grouped, ndcg_at_k
+
+    # ragged: group sizes (1, 3); nonzero gain for label 0
+    labels = np.asarray([1.0, 1.0, 0.0, 0.0])
+    scores = np.asarray([5.0, 3.0, 2.0, 1.0])
+    gi = make_grouped(labels, np.asarray([1, 3]))
+    got = float(ndcg_at_k(jnp.asarray(labels), jnp.asarray(scores), gi, 3,
+                          label_gain=(1.0, 7.0)))
+    # group 1 (single relevant doc): ndcg 1.0. group 2: perfect order of
+    # [1,0,0] -> dcg = 7 + 1/log2(3) + 1/2, idcg identical -> 1.0
+    assert abs(got - 1.0) < 1e-6, got
+    with pytest.raises(ValueError, match="label_gain"):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(40, 2)).astype(np.float32)
+        y = rng.integers(0, 4, size=40).astype(np.float32)
+        train_booster(X, y, BoosterConfig(objective="lambdarank",
+                                          num_iterations=2,
+                                          label_gain=(0.0, 1.0)),
+                      group_sizes=np.full(4, 10, np.int64))

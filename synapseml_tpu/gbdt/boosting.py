@@ -107,6 +107,17 @@ class BoosterConfig:
     # relevance gain per label value (LightGBMRankerParams labelGain; empty
     # = the default 2^label - 1 table)
     label_gain: tuple = ()
+    # bagging stream seed (LightGBM bagging_seed, default 3)
+    bagging_seed: int = 3
+    # minimum metric improvement for early stopping (improvementTolerance)
+    improvement_tolerance: float = 0.0
+    # bin-boundary sampling seed override (LightGBM data_random_seed);
+    # None = use `seed` (legacy behavior)
+    data_random_seed: object = None
+    # features' missing code becomes zero (zeroAsMissing): the estimator
+    # layer maps 0 -> NaN before binning and traversal routes |x|<=1e-35
+    # (and coerced NaN) to the default side
+    zero_as_missing: bool = False
     # NDCG eval positions (LightGBMRankerParams evalAt, default 1-5 at the
     # estimator layer): when set, the FIRST position drives validation/early
     # stopping, matching the reference (maxPosition truncates the lambdarank
@@ -230,8 +241,12 @@ class Booster:
         stype = np.asarray(tree.split_type)
         has_nan = np.asarray(self.mapper.nan_mask)
         sf_safe = np.clip(sf, 0, len(has_nan) - 1)
-        return np.where(has_nan[sf_safe] | (stype[: len(sf)] == 1),
-                        2, 0).astype(np.int32)
+        # zeroAsMissing trains with zeros mapped to NaN; traversal and the
+        # serialized decision_type must route zeros (code 1), not just NaN
+        nan_code = 1 if getattr(self.config, "zero_as_missing", False) else 2
+        return np.where(stype[: len(sf)] == 1, 2,
+                        np.where(has_nan[sf_safe], nan_code,
+                                 0)).astype(np.int32)
 
     def unweighted(self) -> "Booster":
         """Copy with unit tree weights and zero base — used to recover raw
@@ -413,8 +428,10 @@ def _sample_rows_impl(cfg, n, key0, valid_mask, it, g, h, in_bag_cur, yj=None):
         return (wmask > 0).astype(jnp.float32), g * wmask[:, None], \
             h * wmask[:, None], in_bag_cur
     if do_bag:
+        kb = (jax.random.fold_in(key0, cfg.bagging_seed)
+              if cfg.bagging_seed != 3 else key0)  # default keeps the stream
         u = jax.random.uniform(
-            jax.random.fold_in(key0, 20_000_000 + it), (n,))
+            jax.random.fold_in(kb, 20_000_000 + it), (n,))
         if stratified and yj is not None:
             # posBaggingFraction / negBaggingFraction (binary objectives):
             # per-class keep probability, refreshed every bagging_freq rounds
@@ -494,7 +511,7 @@ def _fused_static_key(cfg, grower_cfg, n, nfeat, k, nv, metric_name, mesh):
             # seeds are folded into the traced program as Python ints
             # (_sample_rows_impl/_sample_features_impl): two configs that
             # differ only here must NOT share an executable
-            cfg.extra_seed, cfg.feature_fraction_seed,
+            cfg.extra_seed, cfg.feature_fraction_seed, cfg.bagging_seed,
             tuple(cfg.label_gain or ()),
             n, nfeat, k, nv, metric_name, mesh)
 
@@ -720,7 +737,9 @@ def train_booster(
         with measures.span("referenceDataset"):
             mapper = compute_bin_mapper(
                 X, cfg.max_bin, cfg.bin_sample_count, categorical_features,
-                cfg.seed, min_data_in_bin=cfg.min_data_in_bin,
+                (cfg.seed if cfg.data_random_seed is None
+                 else int(cfg.data_random_seed)),
+                min_data_in_bin=cfg.min_data_in_bin,
                 max_bin_by_feature=cfg.max_bin_by_feature)
     if mapper is not None and mapper.max_bin != cfg.max_bin:
         # every mapper source (Dataset, explicit mapper=, warm start) funnels
@@ -1127,8 +1146,8 @@ def train_booster(
                     if cfg.early_stopping_round > 0:
                         series = np.concatenate(mvals_list)
                         series = series if higher_better else -series
-                        if done - 1 - int(np.argmax(series)) >= \
-                                cfg.early_stopping_round:
+                        b = _best_so_far(series, cfg.improvement_tolerance)
+                        if done - 1 - int(b[-1]) >= cfg.early_stopping_round:
                             break
         score = carry[0]
         measures.count("iterations", done)
@@ -1139,8 +1158,7 @@ def train_booster(
             tdone = len(mvals)
             series = mvals if higher_better else -mvals
             # earliest best index (LightGBM keeps the first best)
-            bests = np.array([np.argmax(series[: i + 1])
-                              for i in range(tdone)])
+            bests = _best_so_far(series, cfg.improvement_tolerance)
             stop = tdone - 1
             if cfg.early_stopping_round > 0:
                 waited = np.arange(tdone) - bests
@@ -1312,8 +1330,10 @@ def train_booster(
             pred_v = obj.transform(raw_v[:, 0] if k == 1 else raw_v)
             mval = float(_eval_metric(metric_name, yv, pred_v, raw_v,
                                       valid, k, cfg, wv_dev))
+            tol = cfg.improvement_tolerance
             improved = (best_metric is None
-                        or (mval > best_metric if higher_better else mval < best_metric))
+                        or (mval > best_metric + tol if higher_better
+                            else mval < best_metric - tol))
             if improved:
                 best_metric, best_iter = mval, it
             if cfg.early_stopping_round > 0 and it - best_iter >= cfg.early_stopping_round:
@@ -1346,6 +1366,20 @@ def train_booster(
                                    if has_valid else -1),
                    thresholds=merged_thr, missing_types=merged_mt,
                    best_score=(best_metric if has_valid else None))
+
+
+def _best_so_far(series: np.ndarray, tol: float = 0.0) -> np.ndarray:
+    """bests[i] = index of the best value within series[:i+1], where a new
+    best must beat the incumbent by MORE than ``tol`` (improvementTolerance;
+    series is pre-negated for lower-is-better metrics). LightGBM keeps the
+    FIRST best on exact ties."""
+    bests = np.zeros(len(series), np.int64)
+    best, bi = -np.inf, 0
+    for i, v in enumerate(series):
+        if v > best + tol:
+            best, bi = float(v), i
+        bests[i] = bi
+    return bests
 
 
 def _is_rank_metric(name: str) -> bool:

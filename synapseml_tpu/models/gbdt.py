@@ -142,12 +142,28 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
     useMissing = Param("useMissing", "Handle missing values specially", bool, True)
     zeroAsMissing = Param("zeroAsMissing", "Treat zero as missing", bool, False)
 
-    def _reference_mapper(self):
-        """referenceDataset param → BinMapper (accepts a Dataset too)."""
+    def _reference_mapper(self, X=None):
+        """referenceDataset param → BinMapper (accepts a Dataset too).
+        With ``X`` (the post-missing-params training matrix): validate that
+        every feature carrying NaN has a missing bin — a reference mapper
+        built WITHOUT the same zeroAsMissing/useMissing mapping would bin
+        those rows into the last real bin at fit yet route them as missing
+        at predict, silently corrupting the model."""
         ref = self.get("referenceDataset")
         if ref is None:
             return None
-        return getattr(ref, "mapper", ref)
+        mapper = getattr(ref, "mapper", ref)
+        if X is not None:
+            need = np.isnan(np.asarray(X)).any(axis=0)
+            have = np.asarray(mapper.nan_mask)
+            bad = np.flatnonzero(need[: len(have)] & ~have)
+            if bad.size:
+                raise ValueError(
+                    "referenceDataset's bin mapper has no missing bin for "
+                    f"feature(s) {bad.tolist()} that contain missing values "
+                    "after useMissing/zeroAsMissing preprocessing; build the "
+                    "reference dataset from identically-preprocessed data")
+        return mapper
 
     def _base_config(self, **overrides) -> BoosterConfig:
         mc = self.get("monotoneConstraints")
@@ -190,6 +206,12 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             drop_seed=self.getDropSeed(),
             feature_fraction_seed=self.getFeatureFractionSeed(),
             extra_seed=self.getExtraSeed(),
+            bagging_seed=self.getBaggingSeed(),
+            improvement_tolerance=self.getImprovementTolerance(),
+            data_random_seed=(self.get("dataRandomSeed")
+                              if self.isSet("dataRandomSeed") else None),
+            zero_as_missing=(bool(self.get("zeroAsMissing"))
+                             and bool(self.get("useMissing"))),
             start_iteration=self.getStartIteration(),
             max_cat_threshold=self.getMaxCatThreshold(),
             max_cat_to_onehot=self.getMaxCatToOnehot(),
@@ -231,8 +253,27 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             idx += [feature_names.index(n) for n in names if n in feature_names]
         return sorted(set(int(i) for i in idx))
 
+    def _apply_missing_params(self, X: np.ndarray) -> np.ndarray:
+        """useMissing / zeroAsMissing preprocessing (BinMapper missing-type
+        election in native LightGBM): useMissing=False coerces NaN to 0
+        (missing handling disabled); zeroAsMissing=True maps exact zeros to
+        NaN so they land in the missing bin, with the booster's
+        zero_as_missing flag making traversal + serialization route zeros
+        (missing_type=zero) — see Booster._missing_types."""
+        if not self.get("useMissing"):
+            return np.nan_to_num(X, nan=0.0)
+        if self.get("zeroAsMissing"):
+            X = np.asarray(X, np.float32).copy()
+            # |x| <= kZeroThreshold (1e-35) folds into the zero bin in
+            # native LightGBM, and predict-time traversal routes the same
+            # band — exact zeros only would score tiny values differently
+            # at fit vs transform
+            X[np.abs(X) <= 1e-35] = np.nan
+        return X
+
     def _extract_training_arrays(self, df: Table):
-        X = feature_matrix(df, self.getFeaturesCol())
+        X = self._apply_missing_params(
+            feature_matrix(df, self.getFeaturesCol()))
         y = np.asarray(df[self.getLabelCol()], np.float32)
         w = (np.asarray(df[self.get("weightCol")], np.float32)
              if self.get("weightCol") and self.get("weightCol") in df else None)
@@ -251,6 +292,10 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
 class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
     leafPredictionCol = Param("leafPredictionCol", "Output column for leaf indices", str)
     featuresShapCol = Param("featuresShapCol", "Output column for SHAP values", str)
+    predictDisableShapeCheck = Param(
+        "predictDisableShapeCheck",
+        "Truncate/pad prediction features to the trained width instead of "
+        "raising on mismatch", bool, False)
 
     def __init__(self, booster: Optional[Booster] = None, **kwargs):
         super().__init__(**kwargs)
@@ -313,6 +358,27 @@ class _LightGBMModelBase(Model, HasFeaturesCol, HasPredictionCol):
 
     def getFeatureShaps(self, X) -> np.ndarray:
         return self.booster.feature_shap(np.asarray(X, np.float32))
+
+    def _predict_matrix(self, df: Table) -> np.ndarray:
+        """Feature matrix for prediction: validates the width against the
+        trained model (clear error instead of an opaque gather failure);
+        predictDisableShapeCheck=True instead truncates / zero-pads, the
+        native predict_disable_shape_check behavior."""
+        X = feature_matrix(df, self.getFeaturesCol())
+        nf = self.booster.mapper.num_features
+        if X.shape[1] != nf:
+            if not self.get("predictDisableShapeCheck"):
+                raise ValueError(
+                    f"prediction data has {X.shape[1]} features but the "
+                    f"model was trained with {nf}; set "
+                    "predictDisableShapeCheck=True to truncate/pad")
+            if X.shape[1] > nf:
+                X = X[:, :nf]
+            else:
+                X = np.concatenate(
+                    [X, np.zeros((X.shape[0], nf - X.shape[1]),
+                                 X.dtype)], axis=1)
+        return X
 
     def _maybe_extra_cols(self, out: Table, X) -> Table:
         if self.get("leafPredictionCol"):
@@ -403,21 +469,22 @@ class LightGBMClassifier(Estimator, _LightGBMParams, HasProbabilityCol, HasRawPr
                                     categorical_features=cats, valid=valid,
                                     feature_names=self.get("slotNames"), init_model=bst,
                                     fobj=self.get("fobj"),
-                                    mapper=self._reference_mapper(),
+                                    mapper=self._reference_mapper(X[part]),
                                     measures=measures)
         else:
             bst = train_booster(X, y, cfg, sample_weight=w, init_score=init,
                                 categorical_features=cats, valid=valid,
                                 feature_names=self.get("slotNames"),
                                 init_model=init_model, fobj=self.get("fobj"),
-                                mapper=self._reference_mapper(),
+                                mapper=self._reference_mapper(X),
                                 measures=measures)
         self._log_base("trainingMeasures", measures.report())
         return bst
 
     def _copy_model_params(self, model):
         for p in ("featuresCol", "predictionCol", "probabilityCol", "rawPredictionCol",
-                  "leafPredictionCol", "featuresShapCol", "thresholds"):
+                  "leafPredictionCol", "featuresShapCol", "thresholds",
+                  "predictDisableShapeCheck"):
             if self.hasParam(p) and model.hasParam(p) and self.isSet(p):
                 model.set(p, self.get(p))
 
@@ -428,7 +495,7 @@ class LightGBMClassificationModel(_LightGBMModelBase, HasProbabilityCol, HasRawP
     classes_: Optional[np.ndarray] = None   # original label values, index = class id
 
     def _transform(self, df: Table) -> Table:
-        X = feature_matrix(df, self.getFeaturesCol())
+        X = self._predict_matrix(df)
         raw = self.booster.raw_score(X)
         prob = self.booster.predict(X)
         out = df
@@ -497,7 +564,7 @@ class LightGBMRegressor(Estimator, _LightGBMParams):
 
 class LightGBMRegressionModel(_LightGBMModelBase):
     def _transform(self, df: Table) -> Table:
-        X = feature_matrix(df, self.getFeaturesCol())
+        X = self._predict_matrix(df)
         out = df.with_column(self.getPredictionCol(), self.booster.predict(X).astype(np.float64))
         return self._maybe_extra_cols(out, X)
 
@@ -540,7 +607,7 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
                                 categorical_features=cats, group_sizes=sizes,
                                 valid=valid, feature_names=self.get("slotNames"),
                                 fobj=self.get("fobj"),
-                                mapper=self._reference_mapper())
+                                mapper=self._reference_mapper(X))
         model = LightGBMRankerModel(booster)
         self._copy_model_params(model)
         return model
@@ -548,6 +615,6 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
 
 class LightGBMRankerModel(_LightGBMModelBase):
     def _transform(self, df: Table) -> Table:
-        X = feature_matrix(df, self.getFeaturesCol())
+        X = self._predict_matrix(df)
         out = df.with_column(self.getPredictionCol(), self.booster.predict(X).astype(np.float64))
         return self._maybe_extra_cols(out, X)

@@ -266,3 +266,89 @@ def test_model_best_score_surface():
     assert np.isfinite(m.getBoosterBestScore())
     m2 = LightGBMClassifier(numIterations=3).fit(t)
     assert m2.getBoosterBestScore() is None
+
+
+def test_missing_params_and_shape_check():
+    """useMissing=False coerces NaN to 0; zeroAsMissing routes exact zeros
+    to the missing bin end-to-end (train + predict + save/load emits
+    missing_type=zero); predictDisableShapeCheck pads/truncates."""
+    rng = np.random.default_rng(7)
+    n = 600
+    f0 = rng.normal(size=n)
+    f0[rng.random(n) < 0.4] = 0.0                  # informative zeros
+    cols = {"f0": f0, "f1": rng.normal(size=n)}
+    cols["label"] = ((f0 == 0.0) | (cols["f1"] > 1.0)).astype(np.float64)
+    t = assemble_features(Table(dict(cols)), ["f0", "f1"])
+
+    m = LightGBMClassifier(numIterations=10, zeroAsMissing=True).fit(t)
+    acc = ((np.asarray(m.transform(t)["prediction"]) > 0.5)
+           == (np.asarray(t["label"]) > 0.5)).mean()
+    assert acc > 0.95, acc
+    s = m.booster.model_string()
+    dts = [int(v) for blk in s.split("decision_type=")[1:]
+           for v in blk.splitlines()[0].split()]
+    # at least one numeric split carries missing_type=zero (bits 2-3 == 01)
+    assert any((d >> 2) & 3 == 1 for d in dts if not d & 1), dts
+
+    # useMissing=False: NaNs coerce to zero; fit must not create NaN bins
+    f2 = rng.normal(size=n)
+    f2[rng.random(n) < 0.3] = np.nan
+    t2 = assemble_features(Table({"f0": f2, "f1": rng.normal(size=n),
+                                  "label": (np.nan_to_num(f2) > 0).astype(
+                                      np.float64)}), ["f0", "f1"])
+    m2 = LightGBMClassifier(numIterations=5, useMissing=False).fit(t2)
+    assert not np.asarray(m2.booster.mapper.nan_mask).any()
+
+    # shape check: default raises clearly, the param pads/truncates
+    t3 = assemble_features(Table({"f0": rng.normal(size=8),
+                                  "label": np.zeros(8)}), ["f0"])
+    with pytest.raises(ValueError, match="predictDisableShapeCheck"):
+        m.transform(t3)
+    m.set("predictDisableShapeCheck", True)
+    out = m.transform(t3)
+    assert out.num_rows == 8
+
+
+def test_bagging_and_tolerance_params_reach_engine():
+    """baggingSeed changes the bagging stream; improvementTolerance makes
+    early stopping stricter."""
+    from synapseml_tpu.gbdt import BoosterConfig, train_booster
+
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(800, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * rng.normal(size=800) > 0).astype(np.float32)
+    base = dict(objective="binary", num_iterations=4, bagging_freq=1,
+                bagging_fraction=0.5, seed=9)
+    b1 = train_booster(X, y, BoosterConfig(**base))
+    b2 = train_booster(X, y, BoosterConfig(**base, bagging_seed=77))
+    assert not np.allclose(b1.predict(X[:50]), b2.predict(X[:50]))
+
+    # a huge tolerance means nothing ever counts as an improvement after
+    # iteration 0 -> early stopping cuts at patience
+    b3 = train_booster(X, y, BoosterConfig(objective="binary",
+                                           num_iterations=30,
+                                           early_stopping_round=2,
+                                           improvement_tolerance=1e9),
+                       valid=(X, y))
+    assert b3.num_trees <= 3, b3.num_trees
+
+
+def test_zero_as_missing_rejects_incompatible_reference():
+    """A referenceDataset built WITHOUT the same zero->missing mapping must
+    be rejected (training would bin zeros as real values while predict
+    routes them as missing)."""
+    from synapseml_tpu.gbdt import Dataset
+
+    rng = np.random.default_rng(9)
+    n = 300
+    f0 = rng.normal(size=n).astype(np.float32)
+    f0[rng.random(n) < 0.4] = 0.0
+    X = np.stack([f0, rng.normal(size=n).astype(np.float32)], 1)
+    cols = {"f0": f0.astype(np.float64),
+            "f1": X[:, 1].astype(np.float64),
+            "label": (f0 == 0).astype(np.float64)}
+    t = assemble_features(Table(cols), ["f0", "f1"])
+    ref = Dataset(X)               # raw zeros: no missing bins
+    with pytest.raises(ValueError, match="referenceDataset"):
+        LightGBMClassifier(numIterations=3, zeroAsMissing=True,
+                           referenceDataset=ref).fit(t)

@@ -17,6 +17,15 @@ Param-parity notes:
   * ``numBatches`` batching with warm start reproduces LightGBMBase.scala:39-64.
   * ``passThroughArgs`` accepts raw LightGBM-style "key=value" text overriding
     structured params — the reference's escape hatch (LightGBMParams.scala).
+  * Accepted-but-inert by design beyond the Spark-plumbing set:
+    ``objectiveSeed`` (our objectives draw no randomness), ``deterministic``
+    (training is deterministic by construction), ``verbosity`` /
+    ``isProvideTrainingMetric`` (use core.logging spans), ``isEnableSparse``
+    (sparse input auto-detects), ``repartitionByGroupingColumn`` (the ranker
+    always sorts group-contiguously — the param's true behavior), and the
+    advanced monotone modes ``monotoneConstraintsMethod`` /
+    ``monotonePenalty`` (the basic method is enforced; the advanced
+    relaxations are an accuracy/speed trade the basic mode upper-bounds).
 """
 
 from __future__ import annotations

@@ -77,7 +77,11 @@ def bench_gbdt():
     # the shipped configs that succeeded; "variant"/"variants" record which.
     all_variants = {
         "partition_sort": {"partition_impl": "sort", "row_layout": "partition"},
-        "partition_scan": {"partition_impl": "scan", "row_layout": "partition"},
+        # scan measured 6.6x slower on-chip (docs/measurements.json
+        # 2026-07-31) and was dropped from the sweep; scatter is the
+        # O(n) cumsum+unique-scatter partition (grower.py)
+        "partition_scatter": {"partition_impl": "scatter",
+                              "row_layout": "partition"},
         "masked": {"partition_impl": "sort", "row_layout": "masked"},
     }
     _d = BoosterConfig()
@@ -656,7 +660,8 @@ def _run_workload_subprocess(name: str, timeout_s: float) -> dict:
     # child init budget must undercut the parent's kill timeout, or the
     # child's structured error line can never fire before the kill — and a
     # slow init would eat the whole workload budget
-    env.setdefault("BENCH_INIT_TIMEOUT_S", str(min(300.0, timeout_s / 3)))
+    inherited = float(env.get("BENCH_INIT_TIMEOUT_S", 300.0))
+    env["BENCH_INIT_TIMEOUT_S"] = str(min(inherited, 300.0, timeout_s / 3))
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--only", name],

@@ -157,8 +157,25 @@ def _stable_partition_src(key: jnp.ndarray, impl: str) -> jnp.ndarray:
     """
     if impl == "sort":
         return jnp.argsort(key, stable=True).astype(jnp.int32)
+    if impl == "scatter":
+        # destination rank per element via 4 cumsums, then ONE unique-index
+        # scatter inverts the permutation — O(n) work and no compare-exchange
+        # stages at all; whether XLA's TPU scatter beats its bitonic sort is
+        # a measured property of the chip (tools/perf_tune.py phase 2)
+        n = key.shape[0]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        dst = jnp.zeros(n, jnp.int32)
+        off = jnp.int32(0)
+        for v in (-1, 0, 1, 2):
+            isv = key == v
+            rank = jnp.cumsum(isv, dtype=jnp.int32) - 1
+            dst = jnp.where(isv, off + rank, dst)
+            off = off + rank[-1] + 1 if v != 2 else off
+        return jnp.zeros(n, jnp.int32).at[dst].set(
+            iota, unique_indices=True, mode="promise_in_bounds")
     if impl != "scan":
-        raise ValueError(f"partition_impl must be 'sort' or 'scan', got {impl!r}")
+        raise ValueError(
+            f"partition_impl must be 'sort', 'scan' or 'scatter', got {impl!r}")
     n = key.shape[0]
     j = jnp.arange(n, dtype=jnp.int32)
     cums = [jnp.cumsum(key == v, dtype=jnp.int32) for v in (-1, 0, 1, 2)]

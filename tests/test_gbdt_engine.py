@@ -194,13 +194,14 @@ def test_dataset_prebinned_matches_raw(binary_data):
     assert len(b2.trees) == 3
 
 
-def test_partition_impl_scan_matches_sort(binary_data):
-    """The scan-based stable partition must grow bitwise-identical trees to
-    the argsort-based one (same src permutation by construction)."""
+@pytest.mark.parametrize("impl", ["scan", "scatter"])
+def test_partition_impl_matches_sort(binary_data, impl):
+    """Every alternate stable-partition primitive must grow bitwise-identical
+    trees to the argsort-based one (same src permutation by construction)."""
     X, _, y, _ = binary_data
     cfg_s = BoosterConfig(objective="binary", num_iterations=4, num_leaves=15)
     cfg_c = BoosterConfig(objective="binary", num_iterations=4, num_leaves=15,
-                          partition_impl="scan")
+                          partition_impl=impl)
     b_s = train_booster(X, y, cfg_s)
     b_c = train_booster(X, y, cfg_c)
     for ts, tc in zip(b_s.trees, b_c.trees):

@@ -56,14 +56,34 @@ def _ts() -> str:
         timespec="seconds")
 
 
+def _run_tree(cmd, timeout_s: float):
+    """subprocess.run, but the child gets its own session and the WHOLE
+    process tree is killed on timeout — bench.py --all spawns per-workload
+    grandchildren that would otherwise survive holding the exclusive TPU
+    (every later probe then fails even though the terminal is up)."""
+    import signal
+
+    p = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         start_new_session=True)
+    try:
+        out, err = p.communicate(timeout=timeout_s)
+        return subprocess.CompletedProcess(cmd, p.returncode, out, err)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        p.wait()
+        raise
+
+
 def run_bench(timeout_s: float) -> bool:
     """Full bench suite; each workload self-records to measurements.json."""
     print(f"[{_ts()}] device up — running bench.py --all", flush=True)
     try:
-        r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
-                            "--all"],
-                           cwd=REPO, timeout=timeout_s, capture_output=True,
-                           text=True)
+        r = _run_tree([sys.executable, os.path.join(REPO, "bench.py"),
+                       "--all"], timeout_s)
         print(r.stdout[-2000:], flush=True)
         if r.returncode != 0:
             print(f"[{_ts()}] bench rc={r.returncode}: {r.stderr[-500:]}",
@@ -86,10 +106,9 @@ def run_tune(timeout_s: float) -> None:
     log = os.path.join(REPO, "docs", "perf_tune_onchip.log")
     print(f"[{_ts()}] running perf_tune → {log}", flush=True)
     try:
-        r = subprocess.run([sys.executable,
-                            os.path.join(REPO, "tools", "perf_tune.py")],
-                           cwd=REPO, timeout=timeout_s, capture_output=True,
-                           text=True)
+        r = _run_tree([sys.executable,
+                       os.path.join(REPO, "tools", "perf_tune.py")],
+                      timeout_s)
         with open(log, "a") as f:
             f.write(f"\n===== perf_tune @ {_ts()} rc={r.returncode} =====\n")
             f.write(r.stdout)
@@ -107,11 +126,9 @@ def run_scale_proof(timeout_s: float, rows: int) -> None:
     docs/scale_proof.json."""
     print(f"[{_ts()}] running scale_proof ({rows} rows)", flush=True)
     try:
-        r = subprocess.run([sys.executable,
-                            os.path.join(REPO, "tools", "scale_proof.py"),
-                            "--rows", str(rows)],
-                           cwd=REPO, timeout=timeout_s, capture_output=True,
-                           text=True)
+        r = _run_tree([sys.executable,
+                       os.path.join(REPO, "tools", "scale_proof.py"),
+                       "--rows", str(rows)], timeout_s)
         print(r.stdout[-1500:], flush=True)
         if r.returncode != 0:
             print(f"[{_ts()}] scale_proof rc={r.returncode}: "
@@ -139,6 +156,7 @@ def main():
     if not (args.once or args.watch):
         args.once = True
 
+    last_scale = 0.0
     while True:
         if _probe_device_once(args.probe_s):
             # bench FIRST: a short terminal window must yield the green
@@ -154,7 +172,11 @@ def main():
             # run launched into a just-dropped terminal wastes hours
             if args.tune and not fresh and _probe_device_once(args.probe_s):
                 run_tune(args.bench_timeout_s)
-            if args.scale and _probe_device_once(args.probe_s):
+            # scale proof throttled: an 11M-row run every --forever cycle
+            # would burn the scarce terminal windows on repeat numbers
+            if (args.scale and time.time() - last_scale > 6 * 3600
+                    and _probe_device_once(args.probe_s)):
+                last_scale = time.time()
                 run_scale_proof(args.bench_timeout_s, args.scale_rows)
             if args.once or (ok and not args.forever):
                 return 0 if ok else 1

@@ -1,21 +1,48 @@
 """TPU perf-tuning harness for the v2 GBDT engine.
 
-Phases timed separately so the bottleneck is visible:
-  1. kernel-only: child_histogram at several sizes (marginal ns/row)
-  2. partition primitives: stable argsort vs cumsum/searchsorted inverse
-     (the per-split row-partition candidates)
-  3. masked full-N histogram (the no-partition alternative design)
-  4. grow_tree single tree, amortized over reps
-  5. train_booster fused scan, Dataset-staged, marginal per-tree cost
-     (5 vs 25 iters isolates steady-state from fixed overhead)
+Phases are ordered by information value and guarded by a wall-clock budget
+(PERF_TUNE_BUDGET_S, default 1800 s) so a short TPU-terminal window still
+yields the critical differentials:
+
+  A. grow_tree per hot-loop design (sort / scatter / masked) — the tree cost
+  B. fused train 5-vs-25 iters per design — isolates steady-state marginal
+     per-tree cost from fixed overhead; vs A isolates boosting machinery
+  C. grow_tree num_leaves sweep — fixed (root hist + labeling) vs marginal
+     per-split cost
+  D. kernel-only at several sizes + chunk x feature_block grid sweep
+  E. partition primitives at several sizes + permutation-apply cost
+  F. masked full-N histogram pass
 
 Run: python tools/perf_tune.py [--profile /tmp/jaxtrace]
-  --profile wraps phase 4 in jax.profiler.trace for op-level breakdown.
+  --profile wraps one grow_tree in jax.profiler.trace for op-level breakdown.
 """
-import os, sys, time
+import os
+import sys
+import time
+from functools import partial as _partial
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
-import jax, jax.numpy as jnp
+import jax
+import jax.numpy as jnp
+
+BUDGET_S = float(os.environ.get("PERF_TUNE_BUDGET_S", 1800))
+_T0 = time.time()
+
+
+def budget_left() -> float:
+    return BUDGET_S - (time.time() - _T0)
+
+
+def guard(phase: str) -> bool:
+    left = budget_left()
+    if left < 90:
+        print(f"[budget] skipping phase {phase} ({left:.0f}s left)",
+              flush=True)
+        return False
+    print(f"\n-- phase {phase} ({left:.0f}s budget left) --", flush=True)
+    return True
+
 
 N, F = 500_000, 28
 rng = np.random.default_rng(0)
@@ -25,9 +52,12 @@ y = (margin > 0).astype(np.float32)
 
 from synapseml_tpu.ops.quantize import compute_bin_mapper, apply_bins
 from synapseml_tpu.ops.hist_kernel import _hist_pallas, features_padded
-from synapseml_tpu.gbdt.grower import GrowerConfig, grow_tree
+from synapseml_tpu.gbdt.grower import (GrowerConfig, grow_tree,
+                                       _stable_partition_src)
 from synapseml_tpu.gbdt import BoosterConfig, Dataset, train_booster
+from synapseml_tpu.core.compile_cache import enable_compile_cache
 
+enable_compile_cache()
 print("device:", jax.devices()[0], flush=True)
 
 mapper = compute_bin_mapper(X, 255, 200_000)
@@ -46,7 +76,6 @@ def timeit(fn, reps=10, warmup=2):
     return (time.perf_counter() - t0) / reps
 
 
-# --- phase 1: kernel only ---------------------------------------------------
 FP = features_padded(F)
 Np = 499712
 bT = jnp.zeros((FP, Np), jnp.int32).at[:F].set(
@@ -55,90 +84,6 @@ g = jnp.asarray(rng.normal(size=Np).astype(np.float32))
 h = jnp.ones(Np, jnp.float32) * 0.25
 m = jnp.ones(Np, jnp.float32)
 
-for size in (499712, 249856, 63488, 8192):
-    t = timeit(lambda s=size: _hist_pallas(bT[:, :s], g[:s], h[:s], m[:s], 256))
-    print(f"kernel {size:7d} rows: {t*1e3:8.2f} ms  ({t/size*1e9:6.2f} ns/row)",
-          flush=True)
-
-# --- phase 1b: kernel grid sweep (VERDICT r3 #6) -----------------------------
-# row-chunk x feature-block sweep at the full row count; ns/row·feature vs the
-# MXU roofline (one (row, feature) = one 128-lane tile-row of a 2*C*K1*24-MAC
-# matmul; peak ~0.04 ns/row·feature at 100% MXU). The winner ships via the
-# SYNAPSEML_TPU_HIST_CHUNK env default (ops/hist_kernel.py).
-print("\n-- kernel sweep: chunk x feature_block (ns/row·feature) --",
-      flush=True)
-Ns = 491520                       # multiple of every swept chunk (lcm-safe)
-best = (None, 1e9)
-for fb in (8, 16):
-    if FP % fb:
-        continue
-    for ch in (512, 1024, 2048, 4096, 8192):
-        if Ns % ch:
-            continue
-        try:
-            t = timeit(lambda c=ch, f=fb: _hist_pallas(
-                bT[:, :Ns], g[:Ns], h[:Ns], m[:Ns], 256, chunk=c,
-                feature_block=f))
-        except Exception as e:
-            print(f"  chunk={ch:5d} fb={fb:2d}: FAILED {str(e)[:80]}",
-                  flush=True)
-            continue
-        nsrf = t / (Ns * F) * 1e9
-        print(f"  chunk={ch:5d} fb={fb:2d}: {t*1e3:7.2f} ms"
-              f"  ({nsrf:6.4f} ns/row·feat)", flush=True)
-        if t < best[1]:
-            best = ((ch, fb), t)
-if best[0]:
-    print(f"  BEST: chunk={best[0][0]} feature_block={best[0][1]} -> set "
-          f"SYNAPSEML_TPU_HIST_CHUNK={best[0][0]}", flush=True)
-
-# --- phase 2: partition primitives ------------------------------------------
-# the PRODUCTION 4-way key ({-1 before-range, 0 left, 1 right, 2 after-range})
-# through the production helper, both impls — this is the real per-split cost
-from synapseml_tpu.gbdt.grower import _stable_partition_src
-
-bc = jnp.asarray(binned[:Np, 0]).astype(jnp.int32)
-idx4 = jnp.arange(Np, dtype=jnp.int32)
-key4 = jnp.where(idx4 < Np // 8, -1,
-                 jnp.where(idx4 >= Np - Np // 8, 2,
-                           (bc > 100).astype(jnp.int32)))
-
-from functools import partial as _partial
-
-for impl in ("sort", "scan"):
-    f = jax.jit(_partial(_stable_partition_src, impl=impl))
-    t = timeit(lambda f=f: f(key4))
-    print(f"partition impl={impl:5s} {Np} rows (4-way key): {t*1e3:8.2f} ms",
-          flush=True)
-
-# gather-apply cost (move bT + 3 row vectors through the permutation)
-perm = jax.jit(_partial(_stable_partition_src, impl="sort"))(key4)
-
-
-@jax.jit
-def apply_perm(bT, g, h, m, perm):
-    return bT[:, perm], g[perm], h[perm], m[perm]
-
-
-t = timeit(lambda: apply_perm(bT, g, h, m, perm)[1])
-print(f"partition apply-gather (FP={FP} cols): {t*1e3:8.2f} ms", flush=True)
-
-# --- phase 3: masked full-N histogram (no-partition design) ------------------
-node = (jnp.asarray(binned[:Np, 1]).astype(jnp.int32) > 100).astype(jnp.int32)
-
-
-@jax.jit
-def masked_hist(bT, g, h, m, node):
-    sel = (node == 1).astype(jnp.float32)
-    return _hist_pallas(bT, g * sel, h * sel, m * sel, 256)
-
-
-t = timeit(lambda: masked_hist(bT, g, h, m, node))
-print(f"masked full-N histogram: {t*1e3:8.2f} ms "
-      f"(x30 splits = {t*30*1e3:.1f} ms/tree)", flush=True)
-
-# --- phase 4: one tree, amortized -------------------------------------------
-cfg = GrowerConfig(num_leaves=31, num_bins=255)
 gg = jnp.asarray((0.5 - y).astype(np.float32))
 hh = jnp.full(N, 0.25)
 ones = jnp.ones(N, jnp.float32)
@@ -152,42 +97,149 @@ if "--profile" in sys.argv:
     i = sys.argv.index("--profile")
     profile_dir = sys.argv[i + 1] if len(sys.argv) > i + 1 else "/tmp/jaxtrace"
 
-
-def one_tree():
-    return grow_tree(binned, gg, hh, ones, fa, ic, mono, cfg, nan_bins=nb)[0]
-
-
-t = timeit(lambda: one_tree().leaf_value, reps=5)
-print(f"grow_tree (31 leaves): {t*1e3:8.2f} ms/tree "
-      f"-> {N/t/1e6:6.2f}M row-iters/s", flush=True)
-
-if profile_dir:
-    with jax.profiler.trace(profile_dir):
-        for _ in range(3):
-            out = one_tree()
-        jax.block_until_ready(out.leaf_value)
-    print(f"profile written to {profile_dir}", flush=True)
-
-# --- phase 5: fused training, Dataset-staged, layout/partition A/B -----------
-ds = Dataset(X, y, mapper=mapper).block_until_ready()
-variants = [("partition/sort", {}),
-            ("partition/scan", {"partition_impl": "scan"}),
+VARIANTS = [("partition/sort", {}),
+            ("partition/scatter", {"partition_impl": "scatter"}),
             ("masked", {"row_layout": "masked"})]
-for name, kw in variants:
-    results = {}
-    for iters in (5, 25):
-        bc = BoosterConfig(objective="binary", num_iterations=iters, seed=1,
-                           **kw)
-        train_booster(ds, None, bc)       # compile at the REAL shapes + cache
-        t0 = time.perf_counter()
-        b = train_booster(ds, None, bc)
-        jax.block_until_ready(b.trees[-1].leaf_value)
-        dt = time.perf_counter() - t0
-        results[iters] = dt
-        print(f"[{name:14s}] train {iters:2d} iters: {dt:7.2f} s -> "
-              f"{N*iters/dt/1e6:6.2f}M row-iters/s  vs_baseline="
-              f"{N*iters/dt/4e6:.3f}", flush=True)
-    marg = (results[25] - results[5]) / 20
-    print(f"[{name:14s}] marginal/tree: {marg*1e3:.1f} ms -> steady-state "
-          f"{N/marg/1e6:.2f}M row-iters/s ({N/marg/4e6:.2f}x baseline)",
+
+
+def one_tree(c):
+    return grow_tree(binned, gg, hh, ones, fa, ic, mono, c, nan_bins=nb)[0]
+
+
+# --- phase A: one tree per hot-loop design -----------------------------------
+if guard("A: grow_tree per design"):
+    for vname, vkw in VARIANTS:
+        c = GrowerConfig(num_leaves=31, num_bins=255, **vkw)
+        t = timeit(lambda c=c: one_tree(c).leaf_value, reps=5)
+        print(f"grow_tree [{vname:17s}] (31 leaves): {t*1e3:8.2f} ms/tree "
+              f"-> {N/t/1e6:6.2f}M row-iters/s", flush=True)
+    if profile_dir:
+        cP = GrowerConfig(num_leaves=31, num_bins=255)
+        with jax.profiler.trace(profile_dir):
+            for _ in range(3):
+                out = one_tree(cP)
+            jax.block_until_ready(out.leaf_value)
+        print(f"profile written to {profile_dir}", flush=True)
+
+# --- phase B: fused training, Dataset-staged, 5-vs-25 ------------------------
+if guard("B: fused train per design"):
+    ds = Dataset(X, y, mapper=mapper).block_until_ready()
+    for name, kw in VARIANTS:
+        if budget_left() < 120:
+            print(f"[budget] stopping phase B before {name}", flush=True)
+            break
+        results = {}
+        for iters in (5, 25):
+            bc = BoosterConfig(objective="binary", num_iterations=iters,
+                               seed=1, **kw)
+            train_booster(ds, None, bc)   # compile at the REAL shapes + cache
+            t0 = time.perf_counter()
+            b = train_booster(ds, None, bc)
+            jax.block_until_ready(b.trees[-1].leaf_value)
+            dt = time.perf_counter() - t0
+            results[iters] = dt
+            print(f"[{name:17s}] train {iters:2d} iters: {dt:7.2f} s -> "
+                  f"{N*iters/dt/1e6:6.2f}M row-iters/s  vs_baseline="
+                  f"{N*iters/dt/4e6:.3f}", flush=True)
+        marg = (results[25] - results[5]) / 20
+        print(f"[{name:17s}] marginal/tree: {marg*1e3:.1f} ms -> steady-state "
+              f"{N/marg/1e6:.2f}M row-iters/s ({N/marg/4e6:.2f}x baseline)",
+              flush=True)
+
+# --- phase C: num_leaves sweep (fixed vs marginal split cost) ----------------
+if guard("C: num_leaves sweep"):
+    prev = None
+    for L in (2, 4, 8, 16, 31):
+        c = GrowerConfig(num_leaves=L, num_bins=255)
+        t = timeit(lambda c=c: one_tree(c).leaf_value, reps=5)
+        marg = f"  (+{(t - prev) * 1e3:6.2f} ms)" if prev is not None else ""
+        print(f"grow_tree num_leaves={L:2d}: {t*1e3:8.2f} ms{marg}",
+              flush=True)
+        prev = t
+
+# --- phase D: kernel-only + grid sweep ---------------------------------------
+_on_tpu = jax.default_backend() == "tpu"
+if guard("D: kernel") and not _on_tpu:
+    print("[skip] raw-kernel phases need the TPU backend", flush=True)
+if _on_tpu and budget_left() > 90:
+    for size in (499712, 249856, 63488, 8192):
+        t = timeit(lambda s=size: _hist_pallas(bT[:, :s], g[:s], h[:s],
+                                               m[:s], 256))
+        print(f"kernel {size:7d} rows: {t*1e3:8.2f} ms  "
+              f"({t/size*1e9:6.2f} ns/row)", flush=True)
+    # chunk x feature_block sweep; ns/row·feature vs the MXU roofline
+    # (~0.04 ns/row·feature at 100% MXU). Winner ships via the
+    # SYNAPSEML_TPU_HIST_CHUNK env default (ops/hist_kernel.py).
+    Ns = 491520                   # multiple of every swept chunk
+    best = (None, 1e9)
+    for fb in (8, 16):
+        if FP % fb:
+            continue
+        for ch in (512, 1024, 2048, 4096, 8192):
+            if Ns % ch or budget_left() < 60:
+                continue
+            try:
+                t = timeit(lambda c=ch, f=fb: _hist_pallas(
+                    bT[:, :Ns], g[:Ns], h[:Ns], m[:Ns], 256, chunk=c,
+                    feature_block=f))
+            except Exception as e:
+                print(f"  chunk={ch:5d} fb={fb:2d}: FAILED {str(e)[:80]}",
+                      flush=True)
+                continue
+            nsrf = t / (Ns * F) * 1e9
+            print(f"  chunk={ch:5d} fb={fb:2d}: {t*1e3:7.2f} ms"
+                  f"  ({nsrf:6.4f} ns/row·feat)", flush=True)
+            if t < best[1]:
+                best = ((ch, fb), t)
+    if best[0]:
+        print(f"  BEST: chunk={best[0][0]} feature_block={best[0][1]} -> set "
+              f"SYNAPSEML_TPU_HIST_CHUNK={best[0][0]}", flush=True)
+
+# --- phase E: partition primitives -------------------------------------------
+if guard("E: partition"):
+    bc_col = jnp.asarray(binned[:Np, 0]).astype(jnp.int32)
+
+    def make_key(size):
+        """Mixed 4-way key at every size — a prefix slice of one big key
+        would be nearly constant (all -1), understating the real cost."""
+        idx = jnp.arange(size, dtype=jnp.int32)
+        return jnp.where(idx < size // 8, -1,
+                         jnp.where(idx >= size - size // 8, 2,
+                                   (bc_col[:size] > 100).astype(jnp.int32)))
+
+    key4 = make_key(Np)
+    for size in (8192, 63488, Np):
+        k4 = make_key(size)
+        for impl in ("sort", "scan", "scatter"):
+            if impl == "scan" and size > 100_000:
+                continue     # measured 6.6x slower end-to-end; skip big sizes
+            f = jax.jit(_partial(_stable_partition_src, impl=impl))
+            t = timeit(lambda f=f, k=k4: f(k))
+            print(f"partition impl={impl:7s} {size:7d} rows: {t*1e3:8.2f} ms",
+                  flush=True)
+
+    perm = jax.jit(_partial(_stable_partition_src, impl="sort"))(key4)
+
+    @jax.jit
+    def apply_perm(bT, g, h, m, perm):
+        return bT[:, perm], g[perm], h[perm], m[perm]
+
+    t = timeit(lambda: apply_perm(bT, g, h, m, perm)[1])
+    print(f"partition apply-gather (FP={FP} cols): {t*1e3:8.2f} ms",
           flush=True)
+
+# --- phase F: masked full-N histogram ----------------------------------------
+if guard("F: masked hist") and _on_tpu:
+    node = (jnp.asarray(binned[:Np, 1]).astype(jnp.int32) > 100
+            ).astype(jnp.int32)
+
+    @jax.jit
+    def masked_hist(bT, g, h, m, node):
+        sel = (node == 1).astype(jnp.float32)
+        return _hist_pallas(bT, g * sel, h * sel, m * sel, 256)
+
+    t = timeit(lambda: masked_hist(bT, g, h, m, node))
+    print(f"masked full-N histogram: {t*1e3:8.2f} ms "
+          f"(x30 splits = {t*30*1e3:.1f} ms/tree)", flush=True)
+
+print(f"\nperf_tune done in {time.time() - _T0:.0f}s", flush=True)

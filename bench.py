@@ -82,6 +82,8 @@ def bench_gbdt():
         # O(n) cumsum+unique-scatter partition (grower.py)
         "partition_scatter": {"partition_impl": "scatter",
                               "row_layout": "partition"},
+        # gather: pos-only permutation, smaller child gathered pre-kernel
+        "gather": {"partition_impl": "sort", "row_layout": "gather"},
         "masked": {"partition_impl": "sort", "row_layout": "masked"},
     }
     _d = BoosterConfig()
@@ -660,8 +662,11 @@ def _run_workload_subprocess(name: str, timeout_s: float) -> dict:
     # child init budget must undercut the parent's kill timeout, or the
     # child's structured error line can never fire before the kill — and a
     # slow init would eat the whole workload budget
-    inherited = float(env.get("BENCH_INIT_TIMEOUT_S", 300.0))
-    env["BENCH_INIT_TIMEOUT_S"] = str(min(inherited, 300.0, timeout_s / 3))
+    try:
+        inherited = float(env.get("BENCH_INIT_TIMEOUT_S", ""))
+    except ValueError:
+        inherited = 300.0
+    env["BENCH_INIT_TIMEOUT_S"] = str(min(inherited, timeout_s / 3))
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--only", name],

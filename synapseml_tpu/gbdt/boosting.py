@@ -99,7 +99,8 @@ class BoosterConfig:
     # row-partition primitive inside the grower ("sort" | "scan"); see
     # GrowerConfig.partition_impl
     partition_impl: str = "sort"
-    # grower row layout ("partition" | "masked"); see GrowerConfig.row_layout
+    # grower row layout ("partition" | "masked" | "gather");
+    # see GrowerConfig.row_layout
     row_layout: str = "partition"
     # lambdarank
     lambdarank_truncation_level: int = 30

@@ -87,9 +87,13 @@ class GrowerConfig(NamedTuple):
     # row layout strategy: "partition" keeps rows physically sorted by leaf
     # (smaller-child histograms scan only the child's contiguous range);
     # "masked" never moves rows — each split histograms the full row set with
-    # the child mask folded into the kernel's value factor. Masked trades
-    # ~12x more rows through the MXU kernel for ZERO sort/permute work per
-    # split; which wins is a measured property of the chip (tools/perf_tune.py)
+    # the child mask folded into the kernel's value factor; "gather" keeps
+    # only the (Np,) pos permutation sorted by leaf and gathers the smaller
+    # child's rows through it right before histogramming (one i32 permute
+    # per split instead of the full (FP, size) two-way data movement).
+    # Masked trades ~12x more rows through the MXU kernel for ZERO
+    # sort/permute work per split; which of the three wins is a measured
+    # property of the chip (tools/perf_tune.py)
     row_layout: str = "partition"
 
 
@@ -486,6 +490,26 @@ def _common_split_updates(s, cfg: GrowerConfig, l, fsel, bsel, gain_l, dl,
     )
 
 
+def _node_of_row_from_ranges(s, L: int, Np: int, n: int) -> jnp.ndarray:
+    """Per-row final leaf id in ORIGINAL row order, from the sorted layout's
+    (pos, leaf_start, leaf_len): scatter leaf ids at range starts, fill
+    forward via cumulative max of marker positions, then undo the sort with
+    one scatter through ``pos``. Zero-length local ranges are excluded: they
+    share a start position with their sibling and the scatter collision
+    would mislabel the sibling's rows. (No Np*L position encoding — that
+    would overflow int32 at HIGGS-scale Np.)"""
+    exists = jnp.arange(L) <= s.num_splits
+    own_rows = exists & (s.leaf_len > 0)
+    markers = jnp.full(Np, -1, jnp.int32).at[
+        jnp.where(own_rows, s.leaf_start, Np)].set(
+            jnp.arange(L, dtype=jnp.int32), mode="drop")
+    last_pos = lax.associative_scan(
+        jnp.maximum,
+        jnp.where(markers >= 0, jnp.arange(Np, dtype=jnp.int32), -1))
+    node_sorted = markers[jnp.maximum(last_pos, 0)]
+    return jnp.zeros(Np, jnp.int32).at[s.pos].set(node_sorted)[:n]
+
+
 def _finalize_tree(s, cfg: GrowerConfig, L: int) -> TreeArrays:
     """Leaf stats from the per-leaf histogram cache (per-leaf f32 accumulation
     — a global prefix-sum difference would catastrophically cancel for small
@@ -685,26 +709,175 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
         return lax.cond(do, step, lambda s: s, s)
 
     s = lax.fori_loop(0, L - 1, body, init) if L > 1 else init
-    tree = _finalize_tree(s, cfg, L)
+    return _finalize_tree(s, cfg, L), _node_of_row_from_ranges(s, L, Np, n)
 
-    # ---- per-row final leaf (original order) ------------------------------
-    # scatter leaf ids at range starts, fill forward via cumulative max of
-    # (position * L + id), then undo the sort with one scatter through pos.
-    # Zero-length local ranges are excluded: they share a start position with
-    # their sibling and the scatter collision would mislabel the sibling's rows
-    exists = jnp.arange(L) <= s.num_splits
-    own_rows = exists & (s.leaf_len > 0)
-    markers = jnp.full(Np, -1, jnp.int32).at[
-        jnp.where(own_rows, s.leaf_start, Np)].set(
-            jnp.arange(L, dtype=jnp.int32), mode="drop")
-    # fill-forward by cummax over marker POSITIONS (no Np*L encoding — that
-    # would overflow int32 at HIGGS-scale Np), then gather the marker ids
-    last_pos = lax.associative_scan(
-        jnp.maximum,
-        jnp.where(markers >= 0, jnp.arange(Np, dtype=jnp.int32), -1))
-    node_sorted = markers[jnp.maximum(last_pos, 0)]
-    node_of_row = jnp.zeros(Np, jnp.int32).at[s.pos].set(node_sorted)[:n]
-    return tree, node_of_row
+
+class _GatherState(NamedTuple):
+    pos: jnp.ndarray             # (Np,) i32: sorted position -> original row
+    leaf_start: jnp.ndarray      # (L,) i32
+    leaf_len: jnp.ndarray        # (L,) i32
+    hist: jnp.ndarray            # (L, FP, B, 3) f32 cache
+    bgain: jnp.ndarray
+    bfeat: jnp.ndarray
+    bbin: jnp.ndarray
+    bdl: jnp.ndarray
+    bcl: jnp.ndarray
+    depth: jnp.ndarray
+    leaf_parent: jnp.ndarray
+    leaf_is_right: jnp.ndarray
+    split_feature: jnp.ndarray
+    split_bin: jnp.ndarray
+    split_gain: jnp.ndarray
+    split_type: jnp.ndarray
+    default_left: jnp.ndarray
+    cat_bitset: jnp.ndarray
+    left_child: jnp.ndarray
+    right_child: jnp.ndarray
+    internal_value: jnp.ndarray
+    internal_count: jnp.ndarray
+    num_splits: jnp.ndarray
+
+
+def _grow_tree_impl_gather(binned, grad, hess, in_bag, feature_active,
+                           is_categorical, monotone, nan_bins,
+                           cfg: GrowerConfig, axis_name: Optional[str],
+                           node_key=None, cat_nbins=None):
+    """row_layout="gather": the third hot-loop design. Rows never move —
+    grad/hess/mask/bins stay in original row order; only the (Np,) ``pos``
+    permutation is maintained sorted-by-leaf. Each split permutes ONE i32
+    vector, and the smaller child's rows are gathered through ``pos`` just
+    before histogramming. Per split this moves O(size) i32 + O(child·FP)
+    gathered bins, vs the partition layout's O(size·FP) two-way permute —
+    same tree bitwise (same split decisions, same stable partition)."""
+    n, f = binned.shape
+    L = cfg.num_leaves
+    B = pad_bins(cfg.num_bins)
+    FP = features_padded(f)
+    Np = -(-n // _CHUNK) * _CHUNK
+    bw = (B + BITS - 1) // BITS
+    l1 = jnp.float32(cfg.lambda_l1)
+    l2 = jnp.float32(cfg.lambda_l2)
+    sizes = _bucket_sizes(Np)
+    sizes_arr = jnp.asarray(sizes, jnp.int32)
+
+    bT0, gs0, hs0, ms0, featp, catp, monop, nanp = _pad_grow_inputs(
+        binned, grad, hess, in_bag, feature_active, is_categorical, monotone,
+        nan_bins, FP, Np)
+
+    def build_hist(pos, child_start, child_len):
+        """Histogram of child rows gathered through ``pos``; psum across the
+        data axis if present."""
+        def make_branch(size):
+            def br(args):
+                pos_, cstart, clen = args
+                cs = jnp.minimum(cstart, Np - size)
+                idx = cs + jnp.arange(size, dtype=jnp.int32)
+                mask = ((idx >= cstart) & (idx < cstart + clen)
+                        ).astype(jnp.float32)
+                posl = lax.dynamic_slice(pos_, (cs,), (size,))
+                gsl = gs0[posl] * mask
+                hsl = hs0[posl] * mask
+                msl = ms0[posl] * mask
+                bsl = bT0[:, posl]
+                return child_histogram(bsl, gsl, hsl, msl, B)
+            return br
+
+        bidx = jnp.searchsorted(sizes_arr, child_len, side="left")
+        hist = lax.switch(jnp.minimum(bidx, len(sizes) - 1),
+                          [make_branch(s) for s in sizes],
+                          (pos, child_start, child_len))
+        return _maybe_psum(hist, axis_name)
+
+    nmask = _node_mask_fn(cfg, featp, f, node_key)
+    catb = _pad_cat_nbins(cat_nbins, f, FP, B)
+
+    def best_of(hist_leaf, fmask):
+        return _best_for_leaf(hist_leaf, fmask, catp, monop, nanp, cfg, l1,
+                              l2, catb)
+
+    # ---- root: no gather needed (pos is identity) ------------------------
+    hist_root = _maybe_psum(child_histogram(bT0, gs0, hs0, ms0, B), axis_name)
+    rg, rf, rb, rdl, rcl, _ = best_of(hist_root, nmask(jnp.int32(2 * (L - 1))))
+
+    init = _GatherState(
+        pos=jnp.arange(Np, dtype=jnp.int32),
+        leaf_start=jnp.zeros(L, jnp.int32),
+        leaf_len=jnp.zeros(L, jnp.int32).at[0].set(Np),
+        **_init_split_state(L, B, bw, hist_root, rg, rf, rb, rdl, rcl, FP),
+    )
+
+    def partition(pos, start, length, fsel, bsel, dl, bitset, cat_split,
+                  nanbin_f):
+        """Stably partition the leaf's range of ``pos`` by the split;
+        returns (updated pos, LOCAL left-child row count)."""
+        def make_branch(size):
+            def br(pos_):
+                cs = jnp.minimum(start, Np - size)
+                idx = cs + jnp.arange(size, dtype=jnp.int32)
+                posl = lax.dynamic_slice(pos_, (cs,), (size,))
+                binrow = bT0[fsel, posl]
+                gr = _route_right(binrow, bsel, dl, nanbin_f, bitset,
+                                  cat_split, cfg, bw)
+                key = jnp.where(idx < start, -1,
+                                jnp.where(idx >= start + length, 2,
+                                          gr.astype(jnp.int32)))
+                src = _stable_partition_src(key, cfg.partition_impl)
+                nl_loc = jnp.sum(key == 0).astype(jnp.int32)
+                return lax.dynamic_update_slice(pos_, posl[src], (cs,)), nl_loc
+            return br
+
+        bidx = jnp.searchsorted(sizes_arr, length, side="left")
+        return lax.switch(jnp.minimum(bidx, len(sizes) - 1),
+                          [make_branch(s) for s in sizes], pos)
+
+    def body(i, s: _GatherState):
+        l, do = _select_split_leaf(s, cfg, L)
+
+        def step(s: _GatherState) -> _GatherState:
+            gain_l, fsel, bsel, dl = s.bgain[l], s.bfeat[l], s.bbin[l], s.bdl[l]
+            start = s.leaf_start[l]
+            length = s.leaf_len[l]
+            hist_parent = s.hist[l]
+            totals = hist_parent[0].sum(axis=0)
+            G_l, H_l, C_l = totals[0], totals[1], totals[2]
+            bitset, cat_split = _winning_cat_bitset(hist_parent, fsel, bsel,
+                                                    catp, cfg, B, bw, catb)
+
+            pos2, nl_loc = partition(s.pos, start, length, fsel, bsel, dl,
+                                     bitset, cat_split, nanp[fsel])
+
+            cl_glob = s.bcl[l]
+            left_small = cl_glob * 2.0 <= C_l
+            child_start = jnp.where(left_small, start, start + nl_loc)
+            child_len = jnp.where(left_small, nl_loc, length - nl_loc)
+            hist_small = build_hist(pos2, child_start, child_len)
+            hist_left = jnp.where(left_small, hist_small,
+                                  hist_parent - hist_small)
+            hist_right = hist_parent - hist_left
+
+            i_node_id = s.num_splits
+            masks2 = jnp.stack([nmask(i_node_id * 2),
+                                nmask(i_node_id * 2 + 1)])
+            bg2, bf2, bb2, bdl2, bcl2, _ = jax.vmap(best_of)(
+                jnp.stack([hist_left, hist_right]), masks2)
+
+            new_right = s.num_splits + 1
+            return s._replace(
+                pos=pos2,
+                leaf_start=s.leaf_start.at[l].set(start)
+                                       .at[new_right].set(start + nl_loc),
+                leaf_len=s.leaf_len.at[l].set(nl_loc)
+                                    .at[new_right].set(length - nl_loc),
+                **_common_split_updates(s, cfg, l, fsel, bsel, gain_l, dl,
+                                        bitset, cat_split, hist_left,
+                                        hist_right, bg2, bf2, bb2, bdl2, bcl2,
+                                        G_l, H_l, C_l),
+            )
+
+        return lax.cond(do, step, lambda s: s, s)
+
+    s = lax.fori_loop(0, L - 1, body, init) if L > 1 else init
+    return _finalize_tree(s, cfg, L), _node_of_row_from_ranges(s, L, Np, n)
 
 
 class _MaskedState(NamedTuple):
@@ -848,9 +1021,15 @@ def grow_tree(
                                       feature_active, is_categorical, monotone,
                                       nan_bins, cfg, axis_name, node_key,
                                       cat_nbins)
+    if cfg.row_layout == "gather":
+        return _grow_tree_impl_gather(binned, grad, hess, in_bag,
+                                      feature_active, is_categorical, monotone,
+                                      nan_bins, cfg, axis_name, node_key,
+                                      cat_nbins)
     if cfg.row_layout != "partition":
         raise ValueError(
-            f"row_layout must be 'partition' or 'masked', got {cfg.row_layout!r}")
+            "row_layout must be 'partition', 'masked' or 'gather', "
+            f"got {cfg.row_layout!r}")
     return _grow_tree_impl(binned, grad, hess, in_bag, feature_active,
                            is_categorical, monotone, nan_bins, cfg, axis_name,
                            node_key, cat_nbins)

@@ -72,14 +72,18 @@ def test_topk_vote(eight_devices):
     assert int(np.asarray(votes)[3]) == 8
 
 
-def test_distributed_training_matches_single(binary_data, eight_devices):
+@pytest.mark.parametrize("layout", ["partition", "gather", "masked"])
+def test_distributed_training_matches_single(binary_data, eight_devices,
+                                             layout):
     """Training with rows device-put onto an 8-device mesh must give the same
-    model as single-device (same histograms → same splits)."""
+    model as single-device (same histograms → same splits) — for each row
+    layout whose psum placement differs."""
     from synapseml_tpu.gbdt import BoosterConfig, train_booster
 
     Xtr, Xte, ytr, _ = binary_data
     n = (len(ytr) // 8) * 8      # even shards, no padding rows
-    cfg = BoosterConfig(objective="binary", num_iterations=5)
+    cfg = BoosterConfig(objective="binary", num_iterations=5,
+                        row_layout=layout)
     b1 = train_booster(Xtr[:n], ytr[:n], cfg)
     p1 = b1.predict(Xte)
 

@@ -211,9 +211,11 @@ def test_partition_impl_matches_sort(binary_data, impl):
                                    np.asarray(tc.leaf_value), rtol=1e-6)
 
 
-def test_row_layout_masked_matches_partition(binary_data):
-    """The masked-row grower (no row movement, full-N masked histograms) must
-    grow identical trees to the partitioned grower, including NaN routing."""
+@pytest.mark.parametrize("layout", ["masked", "gather"])
+def test_row_layout_matches_partition(binary_data, layout):
+    """Every alternate row layout (masked: no row movement, full-N masked
+    histograms; gather: pos-only permutation with child gathers) must grow
+    identical trees to the partitioned grower, including NaN routing."""
     X, _, y, _ = binary_data
     X = np.array(X)
     X[::7, 3] = np.nan                 # exercise learned missing direction
@@ -221,7 +223,7 @@ def test_row_layout_masked_matches_partition(binary_data):
                   {"num_leaves": 31, "min_data_in_leaf": 5}):
         cfg_p = BoosterConfig(objective="binary", num_iterations=4, **extra)
         cfg_m = BoosterConfig(objective="binary", num_iterations=4,
-                              row_layout="masked", **extra)
+                              row_layout=layout, **extra)
         b_p = train_booster(X, y, cfg_p)
         b_m = train_booster(X, y, cfg_m)
         for tp, tm in zip(b_p.trees, b_m.trees):
@@ -238,7 +240,8 @@ def test_row_layout_masked_matches_partition(binary_data):
                                    rtol=1e-5)
 
 
-def test_row_layout_masked_categorical():
+@pytest.mark.parametrize("layout", ["masked", "gather"])
+def test_row_layout_categorical(layout):
     rng = np.random.default_rng(3)
     n = 2000
     cats = rng.integers(0, 10, size=n)
@@ -246,7 +249,7 @@ def test_row_layout_masked_categorical():
     X = np.stack([cats.astype(np.float32),
                   rng.normal(size=n).astype(np.float32)], 1)
     cfg = BoosterConfig(objective="binary", num_iterations=8,
-                        row_layout="masked")
+                        row_layout=layout)
     bst = train_booster(X, y, cfg, categorical_features=[0])
     p = bst.predict(X)
     assert ((p > 0.5) == (y > 0.5)).mean() > 0.99

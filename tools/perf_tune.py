@@ -99,6 +99,9 @@ if "--profile" in sys.argv:
 
 VARIANTS = [("partition/sort", {}),
             ("partition/scatter", {"partition_impl": "scatter"}),
+            ("gather/sort", {"row_layout": "gather"}),
+            ("gather/scatter", {"row_layout": "gather",
+                                "partition_impl": "scatter"}),
             ("masked", {"row_layout": "masked"})]
 
 
@@ -176,7 +179,11 @@ if _on_tpu and budget_left() > 90:
         if FP % fb:
             continue
         for ch in (512, 1024, 2048, 4096, 8192):
-            if Ns % ch or budget_left() < 60:
+            if Ns % ch:
+                continue
+            if budget_left() < 60:
+                print(f"  chunk={ch:5d} fb={fb:2d}: SKIPPED (budget) — "
+                      "BEST below is from a truncated sweep", flush=True)
                 continue
             try:
                 t = timeit(lambda c=ch, f=fb: _hist_pallas(

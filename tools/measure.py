@@ -107,7 +107,8 @@ def run_tune(timeout_s: float) -> None:
     print(f"[{_ts()}] running perf_tune → {log}", flush=True)
     try:
         r = _run_tree([sys.executable,
-                       os.path.join(REPO, "tools", "perf_tune.py")],
+                       os.path.join(REPO, "tools", "perf_tune.py"),
+                       "--profile", "/tmp/jaxtrace_gbdt"],
                       timeout_s)
         with open(log, "a") as f:
             f.write(f"\n===== perf_tune @ {_ts()} rc={r.returncode} =====\n")

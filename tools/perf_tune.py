@@ -123,6 +123,15 @@ if guard("A: grow_tree per design"):
                 out = one_tree(cP)
             jax.block_until_ready(out.leaf_value)
         print(f"profile written to {profile_dir}", flush=True)
+        try:
+            from trace_summary import summarize
+            print("\n-- op-level breakdown (3x grow_tree, default design) --",
+                  flush=True)
+            summarize(profile_dir, top=25, by="op")
+            print("\n-- by category --", flush=True)
+            summarize(profile_dir, top=12, by="category")
+        except Exception as e:
+            print(f"trace summary failed: {e}", flush=True)
 
 # --- phase B: fused training, Dataset-staged, 5-vs-25 ------------------------
 if guard("B: fused train per design"):

@@ -438,24 +438,69 @@ def record_measurement(entry: dict, path: str = None):
     import datetime
 
     path = path or MEASUREMENTS_PATH
+    # platform tag WITHOUT initializing a backend: jax.devices() on a
+    # half-open axon tunnel hangs forever, and recording must never hang
+    # (this venv force-imports jax at startup, so module presence proves
+    # nothing — only an ALREADY-initialized backend is safe to query).
+    # Every bench flow initializes jax before it records.
+    platform = "unknown"
     try:
-        import jax
+        from jax._src import xla_bridge as _xb
 
-        platform = jax.devices()[0].platform
+        inited = (_xb.backends_are_initialized()
+                  if hasattr(_xb, "backends_are_initialized")
+                  else bool(getattr(_xb, "_backends", None)))
+        if inited:
+            import jax
+
+            platform = jax.devices()[0].platform
     except Exception:
-        platform = "unknown"
+        pass
     rec = dict(entry)
     rec["captured_at"] = datetime.datetime.now(
         datetime.timezone.utc).isoformat(timespec="seconds")
     rec["platform"] = platform
+    lock = path + ".lock"
     try:
-        log = []
-        if os.path.exists(path):
-            with open(path) as f:
-                log = json.load(f)
-        log.append(rec)
-        with open(path, "w") as f:
-            json.dump(log, f, indent=1)
+        # several recorders can interleave during one terminal window
+        # (bench parent, scale proof, manual runs); a read-modify-write
+        # race would silently drop scarce on-chip numbers
+        acquired = False
+        for _ in range(100):
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                acquired = True
+                break
+            except FileExistsError:
+                try:
+                    # a recorder killed mid-section (terminal drop) leaves
+                    # a stale lock; break it rather than spin forever
+                    if time.time() - os.stat(lock).st_mtime > 10:
+                        os.unlink(lock)
+                        continue
+                except OSError:
+                    continue      # holder just released/broke it; retry
+                time.sleep(0.05)
+        if not acquired:
+            print("# measurement lock timeout; recording unlocked",
+                  file=sys.stderr)
+        try:
+            log = []
+            if os.path.exists(path):
+                with open(path) as f:
+                    log = json.load(f)
+            log.append(rec)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(log, f, indent=1)
+            os.replace(tmp, path)
+        finally:
+            if acquired:
+                try:
+                    os.unlink(lock)
+                except FileNotFoundError:
+                    pass
     except Exception as e:  # recording must never sink a measurement
         print(f"# measurement log write failed: {e}", file=sys.stderr)
 

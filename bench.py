@@ -460,32 +460,15 @@ def record_measurement(entry: dict, path: str = None):
     rec["captured_at"] = datetime.datetime.now(
         datetime.timezone.utc).isoformat(timespec="seconds")
     rec["platform"] = platform
-    lock = path + ".lock"
     try:
         # several recorders can interleave during one terminal window
         # (bench parent, scale proof, manual runs); a read-modify-write
-        # race would silently drop scarce on-chip numbers
-        acquired = False
-        for _ in range(100):
-            try:
-                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                os.close(fd)
-                acquired = True
-                break
-            except FileExistsError:
-                try:
-                    # a recorder killed mid-section (terminal drop) leaves
-                    # a stale lock; break it rather than spin forever
-                    if time.time() - os.stat(lock).st_mtime > 10:
-                        os.unlink(lock)
-                        continue
-                except OSError:
-                    continue      # holder just released/broke it; retry
-                time.sleep(0.05)
-        if not acquired:
-            print("# measurement lock timeout; recording unlocked",
-                  file=sys.stderr)
-        try:
+        # race would silently drop scarce on-chip numbers. flock is
+        # kernel-released if the holder dies — no stale-lock heuristics.
+        import fcntl
+
+        with open(path + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
             log = []
             if os.path.exists(path):
                 with open(path) as f:
@@ -495,12 +478,6 @@ def record_measurement(entry: dict, path: str = None):
             with open(tmp, "w") as f:
                 json.dump(log, f, indent=1)
             os.replace(tmp, path)
-        finally:
-            if acquired:
-                try:
-                    os.unlink(lock)
-                except FileNotFoundError:
-                    pass
     except Exception as e:  # recording must never sink a measurement
         print(f"# measurement log write failed: {e}", file=sys.stderr)
 

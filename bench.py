@@ -84,6 +84,8 @@ def bench_gbdt():
                               "row_layout": "partition"},
         # gather: pos-only permutation, smaller child gathered pre-kernel
         "gather": {"partition_impl": "sort", "row_layout": "gather"},
+        "gather_scatter": {"partition_impl": "scatter",
+                           "row_layout": "gather"},
         "masked": {"partition_impl": "sort", "row_layout": "masked"},
     }
     _d = BoosterConfig()
@@ -458,41 +460,74 @@ def record_measurement(entry: dict, path: str = None):
         pass
     rec = dict(entry)
     rec["captured_at"] = datetime.datetime.now(
-        datetime.timezone.utc).isoformat(timespec="seconds")
+        datetime.timezone.utc).isoformat(timespec="milliseconds")
     rec["platform"] = platform
     try:
         # several recorders can interleave during one terminal window
-        # (bench parent, scale proof, manual runs); a read-modify-write
-        # race would silently drop scarce on-chip numbers. flock is
-        # kernel-released if the holder dies — no stale-lock heuristics.
-        import fcntl
-
-        with open(path + ".lock", "w") as lockf:
-            fcntl.flock(lockf, fcntl.LOCK_EX)
-            log = []
-            if os.path.exists(path):
-                with open(path) as f:
-                    log = json.load(f)
-            log.append(rec)
-            tmp = f"{path}.{os.getpid()}.tmp"
-            with open(tmp, "w") as f:
-                json.dump(log, f, indent=1)
-            os.replace(tmp, path)
+        # (bench parent, per-workload children, scale proof, manual runs).
+        # Neither flock nor a lockfile protocol is dependable in this
+        # container (flock verifiably does NOT exclude across processes
+        # here), so the primitive is a single O_APPEND write() per record —
+        # atomic line appends to a JSONL journal, no read-modify-write at
+        # all. The pretty array (docs/measurements.json) is DERIVED from
+        # journal + legacy entries; regenerating it races harmlessly.
+        line = json.dumps(rec) + "\n"
+        fd = os.open(path + "l", os.O_CREAT | os.O_WRONLY | os.O_APPEND,
+                     0o644)
+        try:
+            os.write(fd, line.encode())
+        finally:
+            os.close(fd)
+        log = _read_measurements(path)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(log, f, indent=1)
+        os.replace(tmp, path)
     except Exception as e:  # recording must never sink a measurement
         print(f"# measurement log write failed: {e}", file=sys.stderr)
 
 
-def _latest_measurements():
-    """Newest recorded entry per metric from docs/measurements.json."""
+def _read_measurements(path: str = None):
+    """All recorded entries in capture order: the legacy/derived array
+    (docs/measurements.json) merged with the append-only JSONL journal
+    (docs/measurements.jsonl), deduplicated by (metric, captured_at)."""
+    path = path or MEASUREMENTS_PATH
+    entries = []
     try:
-        with open(MEASUREMENTS_PATH) as f:
-            log = json.load(f)
+        with open(path) as f:
+            entries.extend(e for e in json.load(f) if isinstance(e, dict))
     except Exception:
-        return {}
+        pass
+    try:
+        with open(path + "l") as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln:
+                    try:
+                        e = json.loads(ln)
+                    except json.JSONDecodeError:
+                        continue        # torn line from a dying process
+                    if isinstance(e, dict):
+                        entries.append(e)
+    except OSError:
+        pass
+    seen, out = set(), []
+    for e in entries:
+        key = (e.get("metric"), e.get("captured_at"), str(e.get("value")))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(e)
+    out.sort(key=lambda e: e.get("captured_at", ""))
+    return out
+
+
+def _latest_measurements():
+    """Newest recorded entry per metric (journal + derived array)."""
     latest = {}
-    for e in log:
-        if isinstance(e, dict) and "metric" in e and "value" in e:
-            latest[e["metric"]] = e   # log is append-ordered; last wins
+    for e in _read_measurements():
+        if "metric" in e and "value" in e:
+            latest[e["metric"]] = e     # capture-ordered; last wins
     return latest
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -96,12 +97,18 @@ class BoosterConfig:
     # (PV-Tree; LightGBM voting_parallel + topK — LightGBMParams.scala:25-27)
     tree_learner: str = "serial"
     top_k: int = 20
-    # row-partition primitive inside the grower ("sort" | "scan"); see
-    # GrowerConfig.partition_impl
-    partition_impl: str = "sort"
+    # row-partition primitive inside the grower ("sort" | "sort32" | "scan"
+    # | "scatter"); see GrowerConfig.partition_impl. The env overrides let
+    # the on-chip tuner flip the shipped default without a code edit; they
+    # are read at BoosterConfig() construction time (default_factory).
+    partition_impl: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "SYNAPSEML_TPU_PARTITION_IMPL", "sort"))
     # grower row layout ("partition" | "masked" | "gather");
     # see GrowerConfig.row_layout
-    row_layout: str = "partition"
+    row_layout: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "SYNAPSEML_TPU_ROW_LAYOUT", "partition"))
     # lambdarank
     lambdarank_truncation_level: int = 30
     max_position: int = 30

@@ -161,6 +161,18 @@ def _stable_partition_src(key: jnp.ndarray, impl: str) -> jnp.ndarray:
     """
     if impl == "sort":
         return jnp.argsort(key, stable=True).astype(jnp.int32)
+    if impl == "sort32":
+        # single-operand composite sort: (key+1) in the top bits, position
+        # in the low bits — ascending order IS the stable partition, and the
+        # bitonic network moves one u32 instead of (key, index) pairs
+        n = key.shape[0]
+        if n > (1 << 29):
+            return jnp.argsort(key, stable=True).astype(jnp.int32)
+        shift = max(n - 1, 1).bit_length()
+        comp = ((key + 1).astype(jnp.uint32) << shift) | jnp.arange(
+            n, dtype=jnp.uint32)
+        return (jnp.sort(comp) & jnp.uint32((1 << shift) - 1)).astype(
+            jnp.int32)
     if impl == "scatter":
         # destination rank per element via 4 cumsums, then ONE unique-index
         # scatter inverts the permutation — O(n) work and no compare-exchange
@@ -178,8 +190,8 @@ def _stable_partition_src(key: jnp.ndarray, impl: str) -> jnp.ndarray:
         return jnp.zeros(n, jnp.int32).at[dst].set(
             iota, unique_indices=True, mode="promise_in_bounds")
     if impl != "scan":
-        raise ValueError(
-            f"partition_impl must be 'sort', 'scan' or 'scatter', got {impl!r}")
+        raise ValueError("partition_impl must be 'sort', 'sort32', 'scan' "
+                         f"or 'scatter', got {impl!r}")
     n = key.shape[0]
     j = jnp.arange(n, dtype=jnp.int32)
     cums = [jnp.cumsum(key == v, dtype=jnp.int32) for v in (-1, 0, 1, 2)]

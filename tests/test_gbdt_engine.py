@@ -194,14 +194,16 @@ def test_dataset_prebinned_matches_raw(binary_data):
     assert len(b2.trees) == 3
 
 
-@pytest.mark.parametrize("impl", ["scan", "scatter"])
+@pytest.mark.parametrize("impl", ["scan", "scatter", "sort32"])
 def test_partition_impl_matches_sort(binary_data, impl):
     """Every alternate stable-partition primitive must grow bitwise-identical
     trees to the argsort-based one (same src permutation by construction)."""
     X, _, y, _ = binary_data
-    cfg_s = BoosterConfig(objective="binary", num_iterations=4, num_leaves=15)
+    # baseline spelled out: env-flipped defaults must not make this vacuous
+    cfg_s = BoosterConfig(objective="binary", num_iterations=4, num_leaves=15,
+                          partition_impl="sort", row_layout="partition")
     cfg_c = BoosterConfig(objective="binary", num_iterations=4, num_leaves=15,
-                          partition_impl=impl)
+                          partition_impl=impl, row_layout="partition")
     b_s = train_booster(X, y, cfg_s)
     b_c = train_booster(X, y, cfg_c)
     for ts, tc in zip(b_s.trees, b_c.trees):
@@ -221,9 +223,12 @@ def test_row_layout_matches_partition(binary_data, layout):
     X[::7, 3] = np.nan                 # exercise learned missing direction
     for extra in ({"num_leaves": 15},
                   {"num_leaves": 31, "min_data_in_leaf": 5}):
-        cfg_p = BoosterConfig(objective="binary", num_iterations=4, **extra)
+        cfg_p = BoosterConfig(objective="binary", num_iterations=4,
+                              row_layout="partition", partition_impl="sort",
+                              **extra)
         cfg_m = BoosterConfig(objective="binary", num_iterations=4,
-                              row_layout=layout, **extra)
+                              row_layout=layout, partition_impl="sort",
+                              **extra)
         b_p = train_booster(X, y, cfg_p)
         b_m = train_booster(X, y, cfg_m)
         for tp, tm in zip(b_p.trees, b_m.trees):

@@ -97,12 +97,19 @@ if "--profile" in sys.argv:
     i = sys.argv.index("--profile")
     profile_dir = sys.argv[i + 1] if len(sys.argv) > i + 1 else "/tmp/jaxtrace"
 
-VARIANTS = [("partition/sort", {}),
-            ("partition/scatter", {"partition_impl": "scatter"}),
-            ("gather/sort", {"row_layout": "gather"}),
+# every variant spells out BOTH knobs: labels must stay truthful even when
+# the SYNAPSEML_TPU_* env defaults are flipped (boosting.py reads them)
+VARIANTS = [("partition/sort", {"row_layout": "partition",
+                                "partition_impl": "sort"}),
+            ("masked", {"row_layout": "masked", "partition_impl": "sort"}),
             ("gather/scatter", {"row_layout": "gather",
                                 "partition_impl": "scatter"}),
-            ("masked", {"row_layout": "masked"})]
+            ("gather/sort32", {"row_layout": "gather",
+                               "partition_impl": "sort32"}),
+            ("partition/sort32", {"row_layout": "partition",
+                                  "partition_impl": "sort32"}),
+            ("partition/scatter", {"row_layout": "partition",
+                                   "partition_impl": "scatter"})]
 
 
 def one_tree(c):
@@ -226,7 +233,7 @@ if guard("E: partition"):
     key4 = make_key(Np)
     for size in (8192, 63488, Np):
         k4 = make_key(size)
-        for impl in ("sort", "scan", "scatter"):
+        for impl in ("sort", "sort32", "scan", "scatter"):
             if impl == "scan" and size > 100_000:
                 continue     # measured 6.6x slower end-to-end; skip big sizes
             f = jax.jit(_partial(_stable_partition_src, impl=impl))

@@ -115,3 +115,54 @@ def test_ndcg_matches_sklearn():
                               label_gain=(0.0, 1.0, 2.0, 3.0)))
         want = float(ndcg_score(rel, scores, k=k))
         np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_balltree_neighbors_match_sklearn_exact():
+    """Max-inner-product on unit-norm vectors == min euclidean distance, so
+    our BallTree's top-k must EXACTLY match sklearn NearestNeighbors."""
+    from sklearn.neighbors import NearestNeighbors
+
+    from synapseml_tpu.nn import BallTree
+
+    rng = np.random.default_rng(2)
+    keys = rng.normal(size=(400, 16)).astype(np.float32)
+    keys /= np.linalg.norm(keys, axis=1, keepdims=True)
+    queries = rng.normal(size=(50, 16)).astype(np.float32)
+    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+
+    tree = BallTree(keys)
+    ours = [[m.index for m in tree.find_maximum_inner_products(q, k=5)]
+            for q in queries]
+    sk = NearestNeighbors(n_neighbors=5).fit(keys)
+    want = sk.kneighbors(queries, return_distance=False)
+    np.testing.assert_array_equal(np.asarray(ours), want)
+
+
+def test_isolation_forest_detection_parity_with_sklearn():
+    """Same planted-outlier task: both implementations must separate the
+    outliers with AUC > 0.95, and the two score rankings must broadly agree
+    (Spearman > 0.6) — algorithm-level parity, not bitwise."""
+    from scipy.stats import spearmanr
+    from sklearn.ensemble import IsolationForest as SkIF
+    from sklearn.metrics import roc_auc_score
+
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.isolationforest import IsolationForest
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    truth = np.zeros(400)
+    X[:12] += 6.0
+    truth[:12] = 1
+    df = Table({"features": X})
+
+    model = IsolationForest(numEstimators=100, maxSamples=128.0,
+                            randomSeed=5).fit(df)
+    ours = model.transform(df)[model.getScoreCol()]
+    sk = SkIF(n_estimators=100, max_samples=128, random_state=5).fit(X)
+    theirs = -sk.score_samples(X)          # higher = more anomalous
+
+    assert roc_auc_score(truth, ours) > 0.95
+    assert roc_auc_score(truth, theirs) > 0.95
+    rho = spearmanr(ours, theirs).statistic
+    assert rho > 0.6, rho

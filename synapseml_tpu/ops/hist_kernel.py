@@ -109,9 +109,10 @@ def _kernel(bin_ref, g_ref, h_ref, m_ref, out_ref, *, C: int, K1: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins_padded", "chunk", "interpret",
-                                    "feature_block"))
+                                    "feature_block", "pack"))
 def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = None,
-                 interpret: bool = False, feature_block: int = None):
+                 interpret: bool = False, feature_block: int = None,
+                 pack: int = None):
     from jax.experimental import pallas as pl
 
     FP, n = bT.shape
@@ -120,8 +121,12 @@ def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = None,
     assert n % C == 0 and FP % FB == 0
     K1 = num_bins_padded // 8
     # features per dot: fill the 128-row MXU tile (M = PACK*K1 = 128) while
-    # keeping N = PACK*24 within one 128-lane tile; PACK must divide FB
-    PACK = max(1, min(128 // K1, 5, FB))
+    # keeping N = PACK*24 within one 128-lane tile; PACK must divide FB.
+    # pack=1 (or SYNAPSEML_TPU_HIST_PACK=1) forces the per-feature
+    # formulation (the on-device self-test degrades to it automatically if
+    # Mosaic rejects the packed form)
+    force = pack or os.environ.get("SYNAPSEML_TPU_HIST_PACK")
+    PACK = max(1, min(int(force) if force else 128 // K1, 5, FB))
     while FB % PACK:
         PACK -= 1
     out = pl.pallas_call(
@@ -152,6 +157,36 @@ def _hist_xla(bT, g, h, m, num_bins_padded: int):
         vals[None, :, :], mode="drop")
 
 
+@functools.cache
+def _tpu_kernel_selftest(num_bins_padded: int) -> str:
+    """One small on-device compile+run per bin width decides the kernel mode
+    for this process: packed dot → per-feature dot → XLA scatter. Insurance
+    for unattended bench windows — a Mosaic lowering regression must degrade
+    throughput, not kill the measurement. Runs at the PRODUCTION chunk and
+    the requested bin width (which sets K1/PACK — the lowering-relevant
+    shapes), with per-feature random bins and distinct g/h/m channels so
+    cross-feature contamination or channel swaps fail the check."""
+    import numpy as _np
+
+    n = DEFAULT_CHUNK
+    rng = _np.random.default_rng(0)
+    bT = jnp.asarray(rng.integers(0, num_bins_padded, size=(8, n)),
+                     jnp.int32)
+    g = jnp.asarray(rng.normal(size=n).astype(_np.float32))
+    h = jnp.asarray(rng.uniform(0.5, 2.0, size=n).astype(_np.float32))
+    m = jnp.asarray((rng.uniform(size=n) > 0.25).astype(_np.float32))
+    want = _np.asarray(_hist_xla(bT, g * m, h * m, m, num_bins_padded))
+    for mode, pk in (("packed", None), ("pack1", 1)):
+        try:
+            got = _np.asarray(_hist_pallas(bT, g * m, h * m, m,
+                                           num_bins_padded, pack=pk))
+            if _np.allclose(got, want, rtol=1e-4, atol=1e-3):
+                return mode
+        except Exception:
+            continue
+    return "xla"
+
+
 def child_histogram(bT, g, h, m, num_bins_padded: int):
     """(FP, size) i32 bins + per-row grad/hess/weight-mask →
     (FP, num_bins_padded, 3) f32 histogram of [sum_grad, sum_hess, sum_mask].
@@ -161,5 +196,9 @@ def child_histogram(bT, g, h, m, num_bins_padded: int):
     three). Uses the Pallas MXU kernel on TPU, XLA scatter elsewhere.
     """
     if jax.default_backend() == "tpu":
-        return _hist_pallas(bT, g, h, m, num_bins_padded)
+        mode = _tpu_kernel_selftest(num_bins_padded)
+        if mode == "packed":
+            return _hist_pallas(bT, g, h, m, num_bins_padded)
+        if mode == "pack1":
+            return _hist_pallas(bT, g, h, m, num_bins_padded, pack=1)
     return _hist_xla(bT, g, h, m, num_bins_padded)

@@ -166,3 +166,50 @@ def test_isolation_forest_detection_parity_with_sklearn():
     assert roc_auc_score(truth, theirs) > 0.95
     rho = spearmanr(ours, theirs).statistic
     assert rho > 0.6, rho
+
+
+def test_weighted_lasso_solver_matches_sklearn():
+    """explainers/solvers.py batched lasso vs sklearn.linear_model.Lasso on
+    the same weighted design (LIME's inner solver; reference uses breeze)."""
+    from sklearn.linear_model import Lasso
+
+    from synapseml_tpu.explainers.solvers import batched_lasso
+
+    rng = np.random.default_rng(4)
+    n, d = 200, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.array([2.0, -1.0, 0.0, 0.0, 0.5, 0.0], np.float32)
+    y = X @ beta + 0.01 * rng.normal(size=n).astype(np.float32)
+    w = np.ones(n, np.float32)
+    lam = 0.05
+
+    coefs, intercept = batched_lasso(X[None], y[None, :, None], w[None],
+                                     lam)[:2]
+    sk = Lasso(alpha=lam, fit_intercept=True, max_iter=10000)
+    sk.fit(X, y)
+    np.testing.assert_allclose(np.asarray(coefs)[0, :, 0], sk.coef_,
+                               rtol=0.05, atol=0.02)
+    np.testing.assert_allclose(float(np.asarray(intercept)[0, 0]),
+                               sk.intercept_, atol=0.02)
+
+
+def test_weighted_lstsq_matches_sklearn_ridge():
+    from sklearn.linear_model import Ridge
+
+    from synapseml_tpu.explainers.solvers import batched_lstsq
+
+    rng = np.random.default_rng(5)
+    n, d = 150, 5
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) + 0.1 * rng.normal(size=n)).astype(np.float32)
+    w = rng.uniform(0.2, 2.0, size=n).astype(np.float32)
+    ridge = 0.5
+
+    coefs, intercept = batched_lstsq(X[None], y[None, :, None], w[None],
+                                     ridge)[:2]
+    sk = Ridge(alpha=ridge, fit_intercept=True)
+    sk.fit(X, y, sample_weight=w)
+    np.testing.assert_allclose(np.asarray(coefs)[0, :, 0], sk.coef_,
+                               rtol=0.05, atol=0.02)
+    np.testing.assert_allclose(float(np.asarray(intercept)[0, 0]),
+                               sk.intercept_, atol=0.03)

@@ -122,6 +122,37 @@ def run_tune(timeout_s: float) -> None:
                     f"({timeout_s:.0f}s) =====\n")
 
 
+def run_tpu_e2e(timeout_s: float) -> None:
+    """Real-chip end-to-end suite (tests/test_tpu_e2e.py): the public
+    fit/transform surface incl. the Pallas kernel through the estimator API,
+    on actual hardware. Log tees into docs/tpu_e2e.log."""
+    log = os.path.join(REPO, "docs", "tpu_e2e.log")
+    print(f"[{_ts()}] running TPU e2e suite → {log}", flush=True)
+    env = dict(os.environ, SYNAPSEML_TPU_E2E="1")
+    try:
+        p = subprocess.Popen(
+            [sys.executable, "-m", "pytest", "tests/test_tpu_e2e.py", "-q"],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, start_new_session=True)
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            p.wait()
+            out = "(timed out)"
+        with open(log, "a") as f:
+            f.write(f"\n===== tpu_e2e @ {_ts()} rc={p.returncode} =====\n")
+            f.write(out[-4000:])
+        print(out[-600:], flush=True)
+    except Exception as e:
+        print(f"[{_ts()}] tpu e2e failed to launch: {e}", flush=True)
+
+
 def run_scale_proof(timeout_s: float, rows: int) -> None:
     """HIGGS-scale north-star run (tools/scale_proof.py); self-records to
     docs/scale_proof.json."""
@@ -173,6 +204,8 @@ def main():
             # run launched into a just-dropped terminal wastes hours
             if args.tune and not fresh and _probe_device_once(args.probe_s):
                 run_tune(args.bench_timeout_s)
+            if _probe_device_once(args.probe_s):
+                run_tpu_e2e(min(args.bench_timeout_s, 1200.0))
             # scale proof throttled: an 11M-row run every --forever cycle
             # would burn the scarce terminal windows on repeat numbers
             if (args.scale and time.time() - last_scale > 6 * 3600

@@ -213,3 +213,37 @@ def test_weighted_lstsq_matches_sklearn_ridge():
                                rtol=0.05, atol=0.02)
     np.testing.assert_allclose(float(np.asarray(intercept)[0, 0]),
                                sk.intercept_, atol=0.03)
+
+
+def test_image_resize_matches_pil_bilinear():
+    """jax.image.resize-based ops/image.resize vs the PIL bilinear oracle on
+    a smooth image (interpolation-convention differences stay sub-1%)."""
+    from PIL import Image
+
+    from synapseml_tpu.ops.image import resize
+
+    h = w = 64
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    img = np.stack([np.sin(yy / 9) * np.cos(xx / 7),
+                    (yy + xx) / (h + w),
+                    np.cos(yy / 5)], axis=-1) * 0.5 + 0.5
+    ours = np.asarray(resize(img[None], 32, 32))[0]
+    pil = np.stack([
+        np.asarray(Image.fromarray((img[..., c] * 255).astype(np.uint8))
+                   .resize((32, 32), Image.BILINEAR), dtype=np.float32) / 255
+        for c in range(3)], axis=-1)
+    assert np.abs(ours - pil).mean() < 0.01
+
+
+def test_gaussian_blur_matches_scipy():
+    from scipy.ndimage import gaussian_filter
+
+    from synapseml_tpu.ops.image import blur
+
+    rng = np.random.default_rng(6)
+    img = rng.uniform(size=(40, 40, 1)).astype(np.float32)
+    ours = np.asarray(blur(img[None], ksize=9, sigma=1.5))[0, ..., 0]
+    want = gaussian_filter(img[..., 0], sigma=1.5, mode="nearest",
+                           truncate=3.0)
+    # interior only: border conventions differ (reflect/nearest vs same-pad)
+    np.testing.assert_allclose(ours[6:-6, 6:-6], want[6:-6, 6:-6], atol=5e-3)

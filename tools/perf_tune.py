@@ -143,6 +143,41 @@ if guard("A: grow_tree per design"):
         except Exception as e:
             print(f"trace summary failed: {e}", flush=True)
 
+# --- phase A2: per-loop-step machinery overhead ------------------------------
+# 30 fori_loop iterations of cond(tiny-kernel + small state update) — the
+# grower's per-split scaffolding with near-zero data. If this costs ms per
+# step, the hot loop is overhead-bound and batching levels beats faster
+# primitives; if it's ~µs, the data ops (sort/gather/kernel) are the story.
+if guard("A2: loop-step overhead"):
+    from jax import lax
+
+    from synapseml_tpu.ops.hist_kernel import child_histogram
+
+    small = 8192
+
+    def loop_overhead(bT_s, g_s, h_s, m_s):
+        def body(i, carry):
+            s, acc = carry
+
+            def live(args):
+                s, acc = args
+                hist = child_histogram(bT_s, g_s * s[0], h_s, m_s, 256)
+                return s.at[0].add(hist[0, 0, 0] * 1e-20), acc + 1
+
+            return lax.cond(i >= 0, live, lambda a: a, (s, acc))
+
+        s0 = jnp.ones(4, jnp.float32)
+        return lax.fori_loop(0, 30, body, (s0, jnp.int32(0)))[0]
+
+    f = jax.jit(loop_overhead)
+    t = timeit(lambda: f(bT[:, :small], g[:small], h[:small], m[:small]),
+               reps=5)
+    k1 = timeit(lambda: child_histogram(bT[:, :small], g[:small], h[:small],
+                                        m[:small], 256), reps=5)
+    print(f"30-step cond+kernel loop: {t*1e3:8.2f} ms "
+          f"({t/30*1e3:6.2f} ms/step; standalone kernel {k1*1e3:6.2f} ms "
+          f"-> per-step machinery ≈ {(t/30 - k1)*1e3:6.2f} ms)", flush=True)
+
 # --- phase B: fused training, Dataset-staged, 5-vs-25 ------------------------
 if guard("B: fused train per design"):
     ds = Dataset(X, y, mapper=mapper).block_until_ready()

@@ -109,6 +109,9 @@ class BoosterConfig:
     row_layout: str = dataclasses.field(
         default_factory=lambda: os.environ.get(
             "SYNAPSEML_TPU_ROW_LAYOUT", "partition"))
+    # segmented histogram kernel: None = auto (TPU + on-device selftest);
+    # True/False forces — the perf_tune A/B differential
+    use_segmented: Optional[bool] = None
     # lambdarank
     lambdarank_truncation_level: int = 30
     max_position: int = 30
@@ -155,6 +158,7 @@ class BoosterConfig:
             min_data_per_group=self.min_data_per_group,
             partition_impl=self.partition_impl,
             row_layout=self.row_layout,
+            use_segmented=self.use_segmented,
         )
 
 

@@ -51,7 +51,8 @@ import numpy as np
 from jax import lax
 
 from ..ops.hist_kernel import (DEFAULT_CHUNK, child_histogram,
-                               features_padded, pad_bins)
+                               features_padded, pad_bins, range_histogram,
+                               segmented_histograms_available)
 
 BITS = 32  # bitset word width for categorical splits
 # kernel row chunk; row counts pad to a multiple of this so the Pallas grid
@@ -84,6 +85,10 @@ class GrowerConfig(NamedTuple):
     # cumsum + vectorized binary search for the inverse permutation
     # (O(n log n) gathers — wins when sort stages dominate the split step)
     partition_impl: str = "sort"
+    # segmented histogram kernel (scalar-prefetch dynamic block offsets —
+    # no dynamic_slice copy or pre-kernel mask multiply per split):
+    # None = auto (TPU + selftest green), True/False forces (perf_tune A/B)
+    use_segmented: Optional[bool] = None
     # row layout strategy: "partition" keeps rows physically sorted by leaf
     # (smaller-child histograms scan only the child's contiguous range);
     # "masked" never moves rows — each split histograms the full row set with
@@ -597,23 +602,44 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
         binned, grad, hess, in_bag, feature_active, is_categorical, monotone,
         nan_bins, FP, Np)
 
+    use_seg = (cfg.use_segmented if cfg.use_segmented is not None
+               else segmented_histograms_available(B))
+
     def build_hist(bT, gs, hs, ms, child_start, child_len):
         """Histogram of sorted rows [child_start, child_start+child_len) via
-        the bucketed kernel; psum across the data axis if present."""
-        def make_branch(size):
-            def br(args):
-                bT_, gs_, hs_, ms_, cstart, clen = args
-                cs = jnp.minimum(cstart, Np - size)
-                idx = cs + jnp.arange(size, dtype=jnp.int32)
-                mask = ((idx >= cstart) & (idx < cstart + clen)).astype(jnp.float32)
-                gsl = lax.dynamic_slice(gs_, (cs,), (size,)) * mask
-                hsl = lax.dynamic_slice(hs_, (cs,), (size,)) * mask
-                msl = lax.dynamic_slice(ms_, (cs,), (size,)) * mask
-                bsl = lax.dynamic_slice(bT_, (0, cs), (FP, size))
-                return child_histogram(bsl, gsl, hsl, msl, B)
-            return br
+        the bucketed kernel; psum across the data axis if present. On TPU
+        the segmented kernel selects its blocks from the FULL arrays by
+        scalar-prefetched offsets — no dynamic_slice copy, no mask multiply."""
+        if use_seg:
+            # branch i covers lengths <= sizes[i] with ONE extra chunk for
+            # window alignment (S = sizes[i] + chunk >= length + chunk) —
+            # not the next power of two, which could double the kernel work
+            def make_branch(size):
+                seg = min(size + _CHUNK, Np)
 
-        bidx = jnp.searchsorted(sizes_arr, child_len, side="left")
+                def br(args):
+                    bT_, gs_, hs_, ms_, cstart, clen = args
+                    return range_histogram(bT_, gs_, hs_, ms_, cstart, clen,
+                                           B, seg)
+                return br
+
+            bidx = jnp.searchsorted(sizes_arr, child_len, side="left")
+        else:
+            def make_branch(size):
+                def br(args):
+                    bT_, gs_, hs_, ms_, cstart, clen = args
+                    cs = jnp.minimum(cstart, Np - size)
+                    idx = cs + jnp.arange(size, dtype=jnp.int32)
+                    mask = ((idx >= cstart)
+                            & (idx < cstart + clen)).astype(jnp.float32)
+                    gsl = lax.dynamic_slice(gs_, (cs,), (size,)) * mask
+                    hsl = lax.dynamic_slice(hs_, (cs,), (size,)) * mask
+                    msl = lax.dynamic_slice(ms_, (cs,), (size,)) * mask
+                    bsl = lax.dynamic_slice(bT_, (0, cs), (FP, size))
+                    return child_histogram(bsl, gsl, hsl, msl, B)
+                return br
+
+            bidx = jnp.searchsorted(sizes_arr, child_len, side="left")
         hist = lax.switch(jnp.minimum(bidx, len(sizes) - 1),
                           [make_branch(s) for s in sizes],
                           (bT, gs, hs, ms, child_start, child_len))

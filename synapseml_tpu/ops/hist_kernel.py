@@ -74,10 +74,20 @@ def _kernel(bin_ref, g_ref, h_ref, m_ref, out_ref, *, C: int, K1: int,
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
+    _packed_accumulate(bin_ref, out_ref, g_ref[:], h_ref[:], m_ref[:],
+                       C=C, K1=K1, FB=FB, PACK=PACK)
+
+
+def _packed_accumulate(bin_ref, out_ref, g1, h1, m1, *, C: int, K1: int,
+                       FB: int, PACK: int):
+    """Shared MXU pack body for both kernels: g1/h1/m1 are (C,) f32 value
+    channels (already edge-masked by the segmented caller). All construction
+    stays 2D (Mosaic-friendly: no cross-tile reshapes or gathers):
+    per-position feature/hi/lo/channel ids come from iota math, and the
+    per-feature bin rows are selected with PACK static where-terms."""
+    from jax.experimental import pallas as pl
+
     M, N = PACK * K1, PACK * 24
-    # all construction stays 2D (Mosaic-friendly: no cross-tile reshapes or
-    # gathers): per-position feature/hi/lo/channel ids come from iota math,
-    # and the per-feature bin rows are selected with PACK static where-terms
     mf = lax.broadcasted_iota(jnp.int32, (M, C), 0) // K1        # row feature
     hi_pat = lax.broadcasted_iota(jnp.int32, (M, C), 0) % K1
     col = lax.broadcasted_iota(jnp.int32, (C, N), 1)
@@ -85,7 +95,7 @@ def _kernel(bin_ref, g_ref, h_ref, m_ref, out_ref, *, C: int, K1: int,
     rem = col - nf * 24
     ch_pat = rem >> 3
     lo_pat = rem & 7
-    g2, h2, m2 = g_ref[:][:, None], h_ref[:][:, None], m_ref[:][:, None]
+    g2, h2, m2 = g1[:, None], h1[:, None], m1[:, None]
     val = jnp.where(ch_pat == 0, g2, jnp.where(ch_pat == 1, h2, m2))
 
     def pbody(p, _):
@@ -107,6 +117,23 @@ def _kernel(bin_ref, g_ref, h_ref, m_ref, out_ref, *, C: int, K1: int,
     lax.fori_loop(0, FB // PACK, pbody, 0)
 
 
+def _pack_for(K1: int, FB: int, pack) -> int:
+    """Features per dot: fill the 128-row MXU tile (M = PACK*K1) while
+    keeping N = PACK*24 within one 128-lane tile; PACK must divide FB.
+    ``pack`` (arg or SYNAPSEML_TPU_HIST_PACK) forces."""
+    force = pack or os.environ.get("SYNAPSEML_TPU_HIST_PACK")
+    PACK = max(1, min(int(force) if force else 128 // K1, 5, FB))
+    while FB % PACK:
+        PACK -= 1
+    return PACK
+
+
+def _epilogue(out, FP: int, K1: int, num_bins_padded: int):
+    # columns are (ch, lo): (FP, K1, 3, 8) -> (FP, K1, 8, 3) -> (FP, B, 3)
+    return out.reshape(FP, K1, 3, 8).transpose(0, 1, 3, 2).reshape(
+        FP, num_bins_padded, 3)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("num_bins_padded", "chunk", "interpret",
                                     "feature_block", "pack"))
@@ -120,15 +147,7 @@ def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = None,
     FB = feature_block or FEATURE_BLOCK
     assert n % C == 0 and FP % FB == 0
     K1 = num_bins_padded // 8
-    # features per dot: fill the 128-row MXU tile (M = PACK*K1 = 128) while
-    # keeping N = PACK*24 within one 128-lane tile; PACK must divide FB.
-    # pack=1 (or SYNAPSEML_TPU_HIST_PACK=1) forces the per-feature
-    # formulation (the on-device self-test degrades to it automatically if
-    # Mosaic rejects the packed form)
-    force = pack or os.environ.get("SYNAPSEML_TPU_HIST_PACK")
-    PACK = max(1, min(int(force) if force else 128 // K1, 5, FB))
-    while FB % PACK:
-        PACK -= 1
+    PACK = _pack_for(K1, FB, pack)
     out = pl.pallas_call(
         functools.partial(_kernel, C=C, K1=K1, FB=FB, PACK=PACK),
         grid=(FP // FB, n // C),
@@ -143,8 +162,82 @@ def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = None,
         interpret=interpret,
     )(bT, g, h, m)
     # columns are (ch, lo): (FP, K1, 3, 8) -> (FP, K1, 8, 3) -> (FP, B, 3)
-    return out.reshape(FP, K1, 3, 8).transpose(0, 1, 3, 2).reshape(
-        FP, num_bins_padded, 3)
+    return _epilogue(out, FP, K1, num_bins_padded)
+
+
+def _range_kernel(info_ref, bin_ref, g_ref, h_ref, m_ref, out_ref, *,
+                  C: int, K1: int, FB: int, PACK: int):
+    """Segmented variant of :func:`_kernel`: the grid's row-chunk dimension
+    starts at the block index derived from the scalar-prefetched
+    ``info = [start, length]`` (see the index_maps in _hist_pallas_range),
+    and edge rows outside [start, start+length) are masked HERE — so the
+    caller passes the FULL row arrays and no dynamic_slice copy or
+    pre-kernel mask multiply exists at all."""
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    start, length = info_ref[0], info_ref[1]
+    n_chunks = pl.num_programs(1)
+    total = jnp.int32(C) * n_chunks
+    first_chunk = jnp.minimum(start // C,
+                              (info_ref[2] - total) // C)   # info[2] = Np
+    row0 = (first_chunk + pl.program_id(1)) * C
+    rows = row0 + lax.broadcasted_iota(jnp.int32, (C,), 0)
+    inr = ((rows >= start) & (rows < start + length)).astype(jnp.float32)
+
+    _packed_accumulate(bin_ref, out_ref, g_ref[:] * inr, h_ref[:] * inr,
+                       m_ref[:] * inr, C=C, K1=K1, FB=FB, PACK=PACK)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins_padded", "size", "chunk",
+                                    "interpret", "feature_block", "pack"))
+def _hist_pallas_range(bT, g, h, m, start, length, num_bins_padded: int,
+                       size: int, chunk: int = None, interpret: bool = False,
+                       feature_block: int = None, pack: int = None):
+    """Histogram of rows [start, start+length) of the FULL (FP, Np) arrays.
+    ``size`` (static) is the covered extent: a multiple of the chunk with
+    size >= length + chunk, so the chunk-aligned window starting at or
+    before ``start`` always covers the range (edge rows masked in-kernel).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    FP, n = bT.shape
+    C = min(chunk or DEFAULT_CHUNK, n)
+    FB = feature_block or FEATURE_BLOCK
+    assert n % C == 0 and FP % FB == 0 and size % C == 0 and size <= n
+    K1 = num_bins_padded // 8
+    PACK = _pack_for(K1, FB, pack)
+    info = jnp.stack([jnp.asarray(start, jnp.int32),
+                      jnp.asarray(length, jnp.int32),
+                      jnp.asarray(n, jnp.int32)])
+
+    def row_block(f, c, info_ref):
+        first = jnp.minimum(info_ref[0] // C, jnp.int32((n - size) // C))
+        return first + c
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(FP // FB, size // C),
+        in_specs=[
+            pl.BlockSpec((FB, C), lambda f, c, i: (f, row_block(f, c, i))),
+            pl.BlockSpec((C,), lambda f, c, i: (row_block(f, c, i),)),
+            pl.BlockSpec((C,), lambda f, c, i: (row_block(f, c, i),)),
+            pl.BlockSpec((C,), lambda f, c, i: (row_block(f, c, i),)),
+        ],
+        out_specs=pl.BlockSpec((FB, K1, 24), lambda f, c, i: (f, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_range_kernel, C=C, K1=K1, FB=FB, PACK=PACK),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((FP, K1, 24), jnp.float32),
+        interpret=interpret,
+    )(info, bT, g, h, m)
+    return _epilogue(out, FP, K1, num_bins_padded)
 
 
 def _hist_xla(bT, g, h, m, num_bins_padded: int):
@@ -185,6 +278,56 @@ def _tpu_kernel_selftest(num_bins_padded: int) -> str:
         except Exception:
             continue
     return "xla"
+
+
+@functools.cache
+def _tpu_segmented_ok(num_bins_padded: int) -> bool:
+    """On-device check of the scalar-prefetch segmented kernel (same
+    insurance contract as _tpu_kernel_selftest): False degrades the grower
+    to the dynamic_slice + plain-kernel path."""
+    import numpy as _np
+
+    try:
+        n = 4 * DEFAULT_CHUNK
+        rng = _np.random.default_rng(1)
+        bT = jnp.asarray(rng.integers(0, num_bins_padded, size=(8, n)),
+                         jnp.int32)
+        g = jnp.asarray(rng.normal(size=n).astype(_np.float32))
+        h = jnp.asarray(rng.uniform(0.5, 2.0, size=n).astype(_np.float32))
+        m = jnp.asarray((rng.uniform(size=n) > 0.25).astype(_np.float32))
+        # geometry satisfies the documented contract size >= length + chunk
+        start, length = 1234, 2 * DEFAULT_CHUNK - 57
+        size = 3 * DEFAULT_CHUNK
+        got = _np.asarray(_hist_pallas_range(bT, g * m, h * m, m, start,
+                                             length, num_bins_padded, size))
+        idx = _np.arange(n)
+        sel = jnp.asarray(((idx >= start) & (idx < start + length)
+                           ).astype(_np.float32))
+        want = _np.asarray(_hist_xla(bT, g * m * sel, h * m * sel, m * sel,
+                                     num_bins_padded))
+        return bool(_np.allclose(got, want, rtol=1e-4, atol=1e-3))
+    except Exception:
+        return False
+
+
+def segmented_histograms_available(num_bins_padded: int) -> bool:
+    """Trace-time gate for the grower: TPU backend + env not disabling +
+    on-device selftest green."""
+    if jax.default_backend() != "tpu":
+        return False
+    if os.environ.get("SYNAPSEML_TPU_SEGMENTED", "1") == "0":
+        return False
+    return _tpu_segmented_ok(num_bins_padded)
+
+
+def range_histogram(bT, g, h, m, start, length, num_bins_padded: int,
+                    size: int):
+    """Public segmented entry: histogram of rows [start, start+length) of
+    the FULL arrays over a chunk-aligned static window of ``size`` rows —
+    no dynamic_slice copy, no pre-kernel mask multiply (callers must have
+    checked :func:`segmented_histograms_available`)."""
+    return _hist_pallas_range(bT, g, h, m, start, length, num_bins_padded,
+                              size)
 
 
 def child_histogram(bT, g, h, m, num_bins_padded: int):

@@ -118,9 +118,21 @@ def one_tree(c):
 
 # --- phase A: one tree per hot-loop design -----------------------------------
 if guard("A: grow_tree per design"):
-    for vname, vkw in VARIANTS:
+    from synapseml_tpu.ops.hist_kernel import (pad_bins,
+                                               segmented_histograms_available)
+
+    seg_ok = segmented_histograms_available(pad_bins(255))
+    print(f"segmented kernel available: {seg_ok} "
+          "(auto rows below use it when True)", flush=True)
+    avariants = VARIANTS + [("part/sort noseg", {"use_segmented": False})]
+    for vname, vkw in avariants:
         c = GrowerConfig(num_leaves=31, num_bins=255, **vkw)
-        t = timeit(lambda c=c: one_tree(c).leaf_value, reps=5)
+        try:
+            t = timeit(lambda c=c: one_tree(c).leaf_value, reps=5)
+        except Exception as e:    # one broken variant must not end phase A
+            print(f"grow_tree [{vname:17s}] FAILED: {str(e)[:100]}",
+                  flush=True)
+            continue
         print(f"grow_tree [{vname:17s}] (31 leaves): {t*1e3:8.2f} ms/tree "
               f"-> {N/t/1e6:6.2f}M row-iters/s", flush=True)
     if profile_dir:

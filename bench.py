@@ -698,13 +698,47 @@ def _init_device_with_watchdog(timeout_s: float):
     done.set()
 
 
+def bench_gbdt_depthwise():
+    """OPT-IN depthwise growth policy at the same HIGGS-shape config —
+    reported as its own metric, NOT folded into the primary best-of
+    (different growth order than LightGBM's leaf-wise; the record carries
+    the AUC of both policies so quality parity is visible)."""
+    import jax
+
+    from synapseml_tpu.gbdt import BoosterConfig, Dataset, train_booster
+    from synapseml_tpu.gbdt.objectives import auc as _auc
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    margin = X[:, 0] * X[:, 1] + 0.5 * X[:, 2] + 0.2 * rng.normal(size=N_ROWS)
+    y = (margin > 0).astype(np.float32)
+    ds = Dataset(X, y).block_until_ready()
+
+    cfg = BoosterConfig(objective="binary", num_iterations=TIMED_ITERS,
+                        seed=1, growth_policy="depthwise")
+    train_booster(ds, None, cfg)            # compile + cache
+    t0 = time.perf_counter()
+    b = train_booster(ds, None, cfg)
+    jax.block_until_ready(b.trees[-1].leaf_value)
+    v = N_ROWS * TIMED_ITERS / (time.perf_counter() - t0)
+    auc_d = float(_auc(y, b.predict(X, binned=False)))
+    b_l = train_booster(ds, None, BoosterConfig(
+        objective="binary", num_iterations=TIMED_ITERS, seed=1))
+    auc_l = float(_auc(y, b_l.predict(X, binned=False)))
+    return {"metric": "gbdt_train_depthwise_row_iters_per_sec_per_chip",
+            "value": round(v, 1),
+            "unit": f"row-iterations/sec/chip (AUC {auc_d:.4f} vs "
+                    f"leafwise {auc_l:.4f})",
+            "vs_baseline": round(v / BASELINE_GBDT_ROW_ITERS, 3)}
+
+
 def _extra_workloads():
     bench_onnx_bf16 = functools.partial(bench_onnx_inference,
                                         precision="bfloat16")
     bench_onnx_bf16.__name__ = "bench_onnx_inference_bf16"
-    fns = (bench_resnet50_train, bench_bert_finetune, bench_onnx_inference,
-           bench_onnx_bf16, bench_onnx_bert, bench_serving,
-           bench_serving_distributed, bench_sparse_ingest)
+    fns = (bench_gbdt_depthwise, bench_resnet50_train, bench_bert_finetune,
+           bench_onnx_inference, bench_onnx_bf16, bench_onnx_bert,
+           bench_serving, bench_serving_distributed, bench_sparse_ingest)
     return {f.__name__: f for f in fns}
 
 

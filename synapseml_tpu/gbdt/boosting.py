@@ -112,6 +112,9 @@ class BoosterConfig:
     # segmented histogram kernel: None = auto (TPU + on-device selftest);
     # True/False forces — the perf_tune A/B differential
     use_segmented: Optional[bool] = None
+    # growth policy: "leafwise" (LightGBM parity) | "depthwise"
+    # (level-batched opt-in; see grower_depthwise.py)
+    growth_policy: str = "leafwise"
     # lambdarank
     lambdarank_truncation_level: int = 30
     max_position: int = 30
@@ -159,6 +162,7 @@ class BoosterConfig:
             partition_impl=self.partition_impl,
             row_layout=self.row_layout,
             use_segmented=self.use_segmented,
+            growth_policy=self.growth_policy,
         )
 
 

@@ -85,6 +85,11 @@ class GrowerConfig(NamedTuple):
     # cumsum + vectorized binary search for the inverse permutation
     # (O(n log n) gathers — wins when sort stages dominate the split step)
     partition_impl: str = "sort"
+    # growth policy: "leafwise" (LightGBM-parity best-first; default) or
+    # "depthwise" (level-batched opt-in — ~depth heavy steps per tree via
+    # ONE multi-leaf histogram pass per level; trees differ from LightGBM's
+    # leaf-wise order, quality gated in tests; grower_depthwise.py)
+    growth_policy: str = "leafwise"
     # segmented histogram kernel (scalar-prefetch dynamic block offsets —
     # no dynamic_slice copy or pre-kernel mask multiply per split):
     # None = auto (TPU + selftest green), True/False forces (perf_tune A/B)
@@ -1054,6 +1059,16 @@ def grow_tree(
     n, f = binned.shape
     if nan_bins is None:
         nan_bins = jnp.full(f, 0x7FFF, jnp.int32)
+    if cfg.growth_policy == "depthwise":
+        from .grower_depthwise import _grow_tree_impl_depthwise
+
+        return _grow_tree_impl_depthwise(binned, grad, hess, in_bag,
+                                         feature_active, is_categorical,
+                                         monotone, nan_bins, cfg, axis_name,
+                                         node_key, cat_nbins)
+    if cfg.growth_policy != "leafwise":
+        raise ValueError("growth_policy must be 'leafwise' or 'depthwise', "
+                         f"got {cfg.growth_policy!r}")
     if cfg.row_layout == "masked":
         return _grow_tree_impl_masked(binned, grad, hess, in_bag,
                                       feature_active, is_categorical, monotone,

@@ -240,6 +240,156 @@ def _hist_pallas_range(bT, g, h, m, start, length, num_bins_padded: int,
     return _epilogue(out, FP, K1, num_bins_padded)
 
 
+def _level_kernel(starts_ref, bin_ref, g_ref, h_ref, m_ref, out_ref, *,
+                  C: int, K1: int, FB: int, PACK: int, SLOTS: int):
+    """Multi-leaf kernel: ONE pass over chunk-aligned slot-partitioned rows
+    histograms EVERY slot (leaf) of a level. ``starts_ref`` (SLOTS+1,) i32
+    holds each slot's first chunk index (ascending; starts[SLOTS] = total
+    chunks). The output block for grid step (f, c) is the slot owning chunk
+    c — computed by the same compare-sum in the index_map and here; the
+    block is zero-initialized on the slot's first chunk. Slot-tail padding
+    rows carry g=h=m=0, so no edge masking is needed."""
+    from jax.experimental import pallas as pl
+
+    c = pl.program_id(1)
+    # first chunk of the owning slot ⇔ c equals ANY slot start (starts are
+    # ascending and distinct — every slot has >= one chunk of capacity);
+    # unrolled: dynamic indexing of the SMEM scalar ref is not supported
+    is_first = c == starts_ref[0]
+    for i in range(1, SLOTS):
+        is_first |= c == starts_ref[i]
+
+    @pl.when(is_first)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    _packed_accumulate(bin_ref, out_ref.at[0], g_ref[:], h_ref[:], m_ref[:],
+                       C=C, K1=K1, FB=FB, PACK=PACK)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins_padded", "slots", "chunk",
+                                    "interpret", "feature_block", "pack"))
+def _hist_pallas_level(bT, g, h, m, start_chunks, num_bins_padded: int,
+                       slots: int, chunk: int = None,
+                       interpret: bool = False, feature_block: int = None,
+                       pack: int = None):
+    """(SLOTS, FP, B, 3) histograms of ALL slots in one kernel pass.
+    ``bT``/``g``/``h``/``m`` are slot-partitioned with every slot starting
+    at a chunk boundary (tail padding rows must carry zero g/h/m);
+    ``start_chunks`` (slots,) i32 ascending first-chunk index per slot."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    FP, n = bT.shape
+    C = min(chunk or DEFAULT_CHUNK, n)
+    FB = feature_block or FEATURE_BLOCK
+    assert n % C == 0 and FP % FB == 0
+    K1 = num_bins_padded // 8
+    PACK = _pack_for(K1, FB, pack)
+    total_chunks = n // C
+    starts = jnp.concatenate([
+        jnp.asarray(start_chunks, jnp.int32),
+        jnp.full((1,), total_chunks, jnp.int32)])
+
+    def slot_of(c, starts_ref):
+        s = jnp.int32(0)
+        for i in range(1, slots):
+            s += (c >= starts_ref[i]).astype(jnp.int32)
+        return s
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(FP // FB, total_chunks),
+        in_specs=[
+            pl.BlockSpec((FB, C), lambda f, c, st: (f, c)),
+            pl.BlockSpec((C,), lambda f, c, st: (c,)),
+            pl.BlockSpec((C,), lambda f, c, st: (c,)),
+            pl.BlockSpec((C,), lambda f, c, st: (c,)),
+        ],
+        out_specs=pl.BlockSpec((1, FB, K1, 24),
+                               lambda f, c, st: (slot_of(c, st), f, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_level_kernel, C=C, K1=K1, FB=FB, PACK=PACK,
+                          SLOTS=slots),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, FP, K1, 24), jnp.float32),
+        interpret=interpret,
+    )(starts, bT, g, h, m)
+    return jax.vmap(lambda o: _epilogue(o, FP, K1, num_bins_padded))(out)
+
+
+def _hist_level_xla(bT, g, h, m, slot_of_row, num_bins_padded: int,
+                    slots: int):
+    """Scatter fallback of :func:`_hist_pallas_level` (CPU/tests): one
+    scatter-add into (SLOTS, FP, B, 3) keyed by each row's slot."""
+    FP, n = bT.shape
+    vals = jnp.stack([g, h, m], -1).astype(jnp.bfloat16).astype(jnp.float32)
+    hist = jnp.zeros((slots, FP, num_bins_padded, 3), jnp.float32)
+    fidx = jnp.arange(FP, dtype=jnp.int32)[:, None]
+    return hist.at[slot_of_row[None, :], fidx, bT.astype(jnp.int32), :].add(
+        vals[None, :, :], mode="drop")
+
+
+@functools.cache
+def _tpu_level_ok(num_bins_padded: int, slots: int, pack=None) -> bool:
+    """On-device check of the multi-leaf level kernel (same insurance
+    contract as _tpu_segmented_ok): False (or SYNAPSEML_TPU_LEVEL=0)
+    degrades depthwise growth to the slot-keyed scatter fallback."""
+    import numpy as _np
+
+    try:
+        C = DEFAULT_CHUNK
+        caps = [2, 1, 3] + [1] * max(slots - 3, 0)
+        caps = caps[:slots]
+        total = sum(caps) * C
+        rng = _np.random.default_rng(2)
+        bT = _np.zeros((8, total), _np.int32)
+        g = _np.zeros(total, _np.float32)
+        h = _np.zeros(total, _np.float32)
+        m = _np.zeros(total, _np.float32)
+        starts, slot_row = [], _np.zeros(total, _np.int32)
+        off = 0
+        for i, cap in enumerate(caps):
+            starts.append(off // C)
+            ln = cap * C - 37 if cap else 0
+            bT[:, off:off + ln] = rng.integers(
+                0, num_bins_padded, size=(8, ln))
+            g[off:off + ln] = rng.normal(size=ln)
+            h[off:off + ln] = rng.uniform(0.5, 2.0, size=ln)
+            m[off:off + ln] = 1.0
+            slot_row[off:off + cap * C] = i
+            off += cap * C
+        got = _np.asarray(_hist_pallas_level(
+            jnp.asarray(bT), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+            jnp.asarray(starts, jnp.int32), num_bins_padded, slots,
+            pack=pack))
+        want = _np.asarray(_hist_level_xla(
+            jnp.asarray(bT), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+            jnp.asarray(slot_row), num_bins_padded, slots))
+        return bool(_np.allclose(got[:3], want[:3], rtol=1e-4, atol=1e-3))
+    except Exception:
+        return False
+
+
+def level_histograms(bT, g, h, m, start_chunks, slot_of_row,
+                     num_bins_padded: int, slots: int):
+    """(SLOTS, FP, B, 3) histograms of slot-partitioned rows in ONE pass:
+    the multi-leaf Pallas kernel on TPU (chunk-aligned slots required;
+    tail padding rows must carry zero g/h/m), the slot-keyed scatter
+    fallback elsewhere."""
+    mode = (_tpu_kernel_selftest(num_bins_padded)
+            if jax.default_backend() == "tpu" else "xla")
+    pk = 1 if mode == "pack1" else None
+    if (mode != "xla"
+            and os.environ.get("SYNAPSEML_TPU_LEVEL", "1") != "0"
+            and _tpu_level_ok(num_bins_padded, slots, pk)):
+        return _hist_pallas_level(bT, g, h, m, start_chunks,
+                                  num_bins_padded, slots, pack=pk)
+    return _hist_level_xla(bT, g, h, m, slot_of_row, num_bins_padded, slots)
+
+
 def _hist_xla(bT, g, h, m, num_bins_padded: int):
     """Scatter-add fallback with the same bf16 value rounding as the kernel."""
     FP, n = bT.shape

@@ -1,0 +1,118 @@
+"""Depthwise (level-batched) growth policy — opt-in engine mode
+(gbdt/grower_depthwise.py). Not LightGBM-order trees: these tests gate
+structure validity, serialization fidelity, quality parity with the
+leaf-wise grower, and distributed equality."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.gbdt import Booster, BoosterConfig, train_booster
+
+
+@pytest.fixture(scope="module")
+def synth():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(6000, 10)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.4 * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+def _dw(**kw):
+    kw.setdefault("objective", "binary")
+    kw.setdefault("num_iterations", 5)
+    return BoosterConfig(growth_policy="depthwise", **kw)
+
+
+def test_quality_close_to_leafwise(synth):
+    X, y = synth
+    b_d = train_booster(X, y, _dw())
+    b_l = train_booster(X, y, BoosterConfig(objective="binary",
+                                            num_iterations=5))
+    acc_d = ((b_d.predict(X) > 0.5) == (y > 0.5)).mean()
+    acc_l = ((b_l.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc_d > 0.9
+    assert acc_d >= acc_l - 0.03
+
+
+def test_breast_cancer_quality(binary_data):
+    from sklearn.metrics import roc_auc_score
+
+    Xtr, Xte, ytr, yte = binary_data
+    b = train_booster(Xtr, ytr, _dw(num_iterations=60))
+    assert roc_auc_score(yte, b.predict(Xte)) > 0.97
+
+
+def test_leaf_budget_and_structure(synth):
+    X, y = synth
+    for L in (4, 15, 31):
+        b = train_booster(X, y, _dw(num_leaves=L, num_iterations=2))
+        for t in b.trees:
+            num_splits = int(np.asarray(t.num_splits))
+            assert 1 <= num_splits <= L - 1
+            # child pointers address only assigned nodes/leaves
+            lc = np.asarray(t.left_child)[:num_splits]
+            rc = np.asarray(t.right_child)[:num_splits]
+            for c in np.concatenate([lc, rc]):
+                if c >= 0:
+                    assert c < num_splits
+                else:
+                    assert ~c <= num_splits
+
+
+def test_max_depth_respected(synth):
+    X, y = synth
+    b = train_booster(X, y, _dw(num_iterations=2, max_depth=2,
+                                num_leaves=31))
+    from synapseml_tpu.gbdt.grower import forest_max_depth
+    assert forest_max_depth(b.trees) <= 2
+
+
+def test_model_string_roundtrip_and_dump(synth, tmp_path):
+    X, y = synth
+    b = train_booster(X, y, _dw(num_iterations=3))
+    p = b.predict(X[:400])
+    b2 = Booster.from_model_string(b.model_string())
+    np.testing.assert_allclose(b2.predict(X[:400]), p, rtol=1e-5, atol=1e-6)
+
+
+def test_nan_routing(synth):
+    X, y = synth
+    X = np.array(X)
+    X[::5, 1] = np.nan
+    b = train_booster(X, y, _dw(num_iterations=4))
+    p = b.predict(X)
+    assert np.isfinite(p).all()
+    assert ((p > 0.5) == (y > 0.5)).mean() > 0.85
+
+
+def test_categorical(synth):
+    rng = np.random.default_rng(3)
+    n = 3000
+    cats = rng.integers(0, 10, size=n)
+    y = np.isin(cats, [2, 5, 7]).astype(np.float32)
+    X = np.stack([cats.astype(np.float32),
+                  rng.normal(size=n).astype(np.float32)], 1)
+    b = train_booster(X, y, _dw(num_iterations=8),
+                      categorical_features=[0])
+    assert (((b.predict(X) > 0.5) == (y > 0.5)).mean()) > 0.99
+
+
+def test_distributed_matches_single(synth, eight_devices):
+    from synapseml_tpu.parallel.mesh import make_mesh
+
+    X, y = synth
+    n = (len(y) // 8) * 8
+    cfg = _dw(num_iterations=4)
+    b1 = train_booster(X[:n], y[:n], cfg)
+    mesh = make_mesh(devices=eight_devices)
+    b8 = train_booster(X[:n], y[:n], cfg, mesh=mesh)
+    np.testing.assert_allclose(b1.predict(X[:300]), b8.predict(X[:300]),
+                               atol=5e-3)
+
+
+def test_bad_policy_rejected(synth):
+    X, y = synth
+    with pytest.raises(ValueError, match="growth_policy"):
+        train_booster(X, y, BoosterConfig(objective="binary",
+                                          num_iterations=1,
+                                          growth_policy="sideways"))

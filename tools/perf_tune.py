@@ -98,7 +98,10 @@ if "--profile" in sys.argv:
     profile_dir = sys.argv[i + 1] if len(sys.argv) > i + 1 else "/tmp/jaxtrace"
 
 # every variant spells out BOTH knobs: labels must stay truthful even when
-# the SYNAPSEML_TPU_* env defaults are flipped (boosting.py reads them)
+# the SYNAPSEML_TPU_* env defaults are flipped (boosting.py reads them).
+# All VARIANTS grow bitwise-identical leaf-wise trees; the depthwise
+# opt-in policy (different growth order) is timed separately in phase A
+# and by bench_gbdt_depthwise.
 VARIANTS = [("partition/sort", {"row_layout": "partition",
                                 "partition_impl": "sort"}),
             ("masked", {"row_layout": "masked", "partition_impl": "sort"}),
@@ -124,7 +127,9 @@ if guard("A: grow_tree per design"):
     seg_ok = segmented_histograms_available(pad_bins(255))
     print(f"segmented kernel available: {seg_ok} "
           "(auto rows below use it when True)", flush=True)
-    avariants = VARIANTS + [("part/sort noseg", {"use_segmented": False})]
+    avariants = VARIANTS + [("part/sort noseg", {"use_segmented": False}),
+                            ("depthwise (opt-in)",
+                             {"growth_policy": "depthwise"})]
     for vname, vkw in avariants:
         c = GrowerConfig(num_leaves=31, num_bins=255, **vkw)
         try:

@@ -116,3 +116,23 @@ def test_bad_policy_rejected(synth):
         train_booster(X, y, BoosterConfig(objective="binary",
                                           num_iterations=1,
                                           growth_policy="sideways"))
+
+
+@pytest.mark.parametrize("kw", [
+    {"boosting_type": "goss"},
+    {"boosting_type": "dart"},
+    {"objective": "multiclass", "num_class": 3},
+    {"bagging_fraction": 0.7, "bagging_freq": 1},
+])
+def test_orthogonal_modes(synth, kw):
+    """Depthwise composes with boosting types / sampling / multiclass."""
+    X, y = synth
+    if kw.get("objective") == "multiclass":
+        y3 = (np.digitize(X[:, 0], [-0.5, 0.5])).astype(np.float32)
+        b = train_booster(X, y3, _dw(num_iterations=4, **kw))
+        acc = (np.argmax(b.predict(X), axis=1) == y3).mean()
+        assert acc > 0.85, acc
+    else:
+        b = train_booster(X, y, _dw(num_iterations=4, **kw))
+        acc = ((b.predict(X) > 0.5) == (y > 0.5)).mean()
+        assert acc > 0.85, acc

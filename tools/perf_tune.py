@@ -127,9 +127,14 @@ if guard("A: grow_tree per design"):
     seg_ok = segmented_histograms_available(pad_bins(255))
     print(f"segmented kernel available: {seg_ok} "
           "(auto rows below use it when True)", flush=True)
-    avariants = VARIANTS + [("part/sort noseg", {"use_segmented": False}),
-                            ("depthwise (opt-in)",
-                             {"growth_policy": "depthwise"})]
+    # ordered by information value: a short window should still yield the
+    # default's cost, the segmentation differential, the kernel-bound
+    # masked bound, and the depthwise policy before the remaining primitives
+    avariants = [VARIANTS[0],
+                 ("part/sort noseg", {"use_segmented": False}),
+                 VARIANTS[1],
+                 ("depthwise (opt-in)", {"growth_policy": "depthwise"}),
+                 ] + VARIANTS[2:]
     for vname, vkw in avariants:
         c = GrowerConfig(num_leaves=31, num_bins=255, **vkw)
         try:

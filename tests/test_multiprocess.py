@@ -115,7 +115,8 @@ X_local, y_local = X_full[lo:hi], y_full[lo:hi]
 
 mesh = make_mesh({"data": 4}, devices=jax.devices())
 cfg = BoosterConfig(objective="binary", num_iterations=4, num_leaves=7,
-                    max_bin=31, min_data_in_leaf=2)
+                    max_bin=31, min_data_in_leaf=2,
+                    growth_policy=%(policy)r)
 bst = train_booster(X_local, y_local, cfg, mesh=mesh)
 
 # every process must hold the identical model; compare against a LOCAL
@@ -129,9 +130,11 @@ print("TRAIN_OK", flush=True)
 """
 
 
-def test_two_process_gbdt_training(tmp_path):
+@pytest.mark.parametrize("policy", ["leafwise", "depthwise"])
+def test_two_process_gbdt_training(tmp_path, policy):
     f = tmp_path / "train_worker.py"
-    f.write_text(_TRAIN_WORKER % {"repo": REPO, "port": _free_port()})
+    f.write_text(_TRAIN_WORKER % {"repo": REPO, "port": _free_port(),
+                                  "policy": policy})
     procs, outs = _spawn_workers(f, timeout=280)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
@@ -155,7 +158,8 @@ def test_two_process_gbdt_training(tmp_path):
     X_full = rng.normal(size=(512, 6)).astype(np.float32)
     y_full = (X_full[:, 0] + 0.5 * X_full[:, 1] > 0).astype(np.float32)
     cfg = BoosterConfig(objective="binary", num_iterations=4, num_leaves=7,
-                        max_bin=31, min_data_in_leaf=2)
+                        max_bin=31, min_data_in_leaf=2,
+                        growth_policy=policy)
     mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
     local = train_booster(X_full, y_full, cfg, mesh=mesh)
     got = [float(v) for v in extract(outs[0], "PRED")[0].split()[1:]]

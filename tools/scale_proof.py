@@ -89,7 +89,8 @@ def auc_score(y, p, sample: int = 2_000_000, seed: int = 1) -> float:
                  / max(npos * nneg, 1.0))
 
 
-def run_higgs(n_rows: int, num_iterations: int, out_path: str) -> dict:
+def run_higgs(n_rows: int, num_iterations: int, out_path: str,
+              policy: str = "leafwise") -> dict:
     import jax
 
     from synapseml_tpu.core.compile_cache import enable_compile_cache
@@ -101,7 +102,7 @@ def run_higgs(n_rows: int, num_iterations: int, out_path: str) -> dict:
     rec: dict = {"workload": "higgs_scale_proof", "captured_at": _ts(),
                  "platform": platform, "rows": n_rows, "features": 28,
                  "num_iterations": num_iterations, "num_leaves": 31,
-                 "max_bin": 255}
+                 "max_bin": 255, "growth_policy": policy}
 
     t0 = time.perf_counter()
     X, y = synth_higgs(n_rows)
@@ -117,7 +118,8 @@ def run_higgs(n_rows: int, num_iterations: int, out_path: str) -> dict:
 
     # --- training ----------------------------------------------------------
     measures = InstrumentationMeasures()
-    cfg = BoosterConfig(objective="binary", num_iterations=num_iterations)
+    cfg = BoosterConfig(objective="binary", num_iterations=num_iterations,
+                        growth_policy=policy)
     t0 = time.perf_counter()
     booster = train_booster(ds, None, cfg, measures=measures)
     jax.block_until_ready(booster.trees[-1].leaf_value)
@@ -232,6 +234,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=11_000_000)
     ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--policy", default="leafwise",
+                    choices=["leafwise", "depthwise"])
     ap.add_argument("--ranker", action="store_true")
     ap.add_argument("--ranker-iters", type=int, default=50)
     ap.add_argument("--platform", default=None,
@@ -249,7 +253,7 @@ def main():
             import jax
 
             jax.config.update("jax_platforms", args.platform)
-        run_higgs(args.rows, args.iters, args.out)
+        run_higgs(args.rows, args.iters, args.out, args.policy)
 
 
 if __name__ == "__main__":

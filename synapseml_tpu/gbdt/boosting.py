@@ -26,7 +26,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..core import tuned as _tuned
 from ..ops.quantize import BinMapper, apply_bins, bin_threshold_to_value, compute_bin_mapper
+
+# default_factory marker for engine knobs resolved via core/tuned.py: lets
+# __post_init__ distinguish "user passed nothing" from an explicit value
+_TUNED_SENTINEL = "__tuned__"
 from .dataset import Dataset, _is_sparse
 from .grower import (Forest, GrowerConfig, TreeArrays, forest_max_depth,
                      forest_predict, grow_tree, stack_trees)
@@ -98,20 +103,26 @@ class BoosterConfig:
     tree_learner: str = "serial"
     top_k: int = 20
     # row-partition primitive inside the grower ("sort" | "sort32" | "scan"
-    # | "scatter"); see GrowerConfig.partition_impl. The env overrides let
-    # the on-chip tuner flip the shipped default without a code edit; they
-    # are read at BoosterConfig() construction time (default_factory).
+    # | "scatter"); see GrowerConfig.partition_impl. Default resolution
+    # (core/tuned.py): SYNAPSEML_TPU_PARTITION_IMPL env > the on-chip
+    # measured winner in docs/tuned_defaults.json (written by
+    # tools/perf_tune.py, applied only under the TPU backend) > "sort".
+    # Resolved in __post_init__ (validated there — a typo'd env var /
+    # corrupt file fails fast); when the config is constructed BEFORE the
+    # jax backend initializes, the tuned-file lookup is re-run once at
+    # grower() time so all tuned knobs (incl. hist_kernel's chunk, which
+    # resolves at trace time) apply consistently.
     partition_impl: str = dataclasses.field(
-        default_factory=lambda: os.environ.get(
-            "SYNAPSEML_TPU_PARTITION_IMPL", "sort"))
+        default_factory=lambda: _TUNED_SENTINEL)
     # grower row layout ("partition" | "masked" | "gather");
-    # see GrowerConfig.row_layout
+    # see GrowerConfig.row_layout — same tuned-default resolution
     row_layout: str = dataclasses.field(
-        default_factory=lambda: os.environ.get(
-            "SYNAPSEML_TPU_ROW_LAYOUT", "partition"))
+        default_factory=lambda: _TUNED_SENTINEL)
     # segmented histogram kernel: None = auto (TPU + on-device selftest);
-    # True/False forces — the perf_tune A/B differential
-    use_segmented: Optional[bool] = None
+    # True/False forces — the perf_tune A/B differential. The tuned file may
+    # pin it when the A/B measured a real difference on chip.
+    use_segmented: Optional[bool] = dataclasses.field(
+        default_factory=lambda: _TUNED_SENTINEL)
     # growth policy: "leafwise" (LightGBM parity) | "depthwise"
     # (level-batched opt-in; see grower_depthwise.py)
     growth_policy: str = "leafwise"
@@ -139,7 +150,62 @@ class BoosterConfig:
     # = legacy engine-level behavior: evaluate at max_position.
     eval_at: tuple = ()
 
+    def __post_init__(self):
+        self._resolve_tuned()
+        # env/tuned-file-sourced fields are validated HERE, not at trace time
+        # deep inside grow_tree: a typo'd SYNAPSEML_TPU_* value (or a corrupt
+        # docs/tuned_defaults.json) must fail at construction with a message
+        # naming its source (ADVICE r3)
+        for field, env in (("partition_impl", "SYNAPSEML_TPU_PARTITION_IMPL"),
+                           ("row_layout", "SYNAPSEML_TPU_ROW_LAYOUT")):
+            v = getattr(self, field)
+            allowed = _tuned.ALLOWED[field]
+            if v not in allowed:
+                raise ValueError(
+                    f"BoosterConfig.{field}={v!r} is not one of {allowed} "
+                    f"(check the {env} env var / docs/tuned_defaults.json)")
+        if self.growth_policy not in ("leafwise", "depthwise"):
+            raise ValueError(
+                f"BoosterConfig.growth_policy={self.growth_policy!r} is not "
+                "one of ('leafwise', 'depthwise')")
+
+    def _resolve_tuned(self):
+        """Fill sentinel-defaulted engine knobs from env > tuned file >
+        hardcoded. Explicitly passed values are never sentinels, so user
+        intent is never overridden. When the jax backend is not initialized
+        yet, the tuned-file gate is closed (core/tuned.py); the affected
+        fields are remembered and re-resolved ONCE at grower() time — by
+        then the training path has initialized the backend, so construction
+        order can't produce a half-tuned config."""
+        deferred = []
+        closed = not _tuned.backend_is_tpu()
+        for field, env, fallback in (
+                ("partition_impl", "SYNAPSEML_TPU_PARTITION_IMPL", "sort"),
+                ("row_layout", "SYNAPSEML_TPU_ROW_LAYOUT", "partition"),
+                ("use_segmented", None, None)):
+            if getattr(self, field) is not _TUNED_SENTINEL:
+                continue
+            v = os.environ.get(env) if env else None
+            if v:
+                setattr(self, field, v)
+                continue
+            setattr(self, field,
+                    _tuned.tuned_engine_defaults().get(field, fallback))
+            if closed:
+                deferred.append((field, fallback))
+        self._deferred_tuned = deferred
+
+    def _finalize_tuned(self):
+        """Re-resolve fields whose tuned-file lookup was skipped because the
+        backend was uninitialized at construction (called from grower())."""
+        if getattr(self, "_deferred_tuned", None) and _tuned.backend_is_tpu():
+            td = _tuned.tuned_engine_defaults()
+            for field, fallback in self._deferred_tuned:
+                setattr(self, field, td.get(field, fallback))
+            self._deferred_tuned = []
+
     def grower(self, has_categorical: bool = False) -> GrowerConfig:
+        self._finalize_tuned()
         lr = 1.0 if self.boosting_type == "rf" else self.learning_rate
         return GrowerConfig(
             has_categorical=has_categorical,

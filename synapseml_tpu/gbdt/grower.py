@@ -41,7 +41,6 @@ identical on every device (uniform control flow by construction).
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import NamedTuple, Optional
 
@@ -50,14 +49,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.hist_kernel import (DEFAULT_CHUNK, child_histogram,
+from ..ops.hist_kernel import (child_histogram, default_chunk,
                                features_padded, pad_bins, range_histogram,
                                segmented_histograms_available)
 
 BITS = 32  # bitset word width for categorical splits
-# kernel row chunk; row counts pad to a multiple of this so the Pallas grid
-# divides evenly (follows the SYNAPSEML_TPU_HIST_CHUNK tuning knob)
-_CHUNK = DEFAULT_CHUNK
+def _chunk() -> int:
+    """Kernel row chunk; row counts pad to a multiple of this so the Pallas
+    grid divides evenly. Resolved lazily at trace time (after backend init)
+    so the SYNAPSEML_TPU_HIST_CHUNK env / docs/tuned_defaults.json knob
+    takes effect without re-importing the module."""
+    return default_chunk()
 
 
 class GrowerConfig(NamedTuple):
@@ -145,10 +147,10 @@ def _leaf_output(g, h, cfg: GrowerConfig):
 
 
 def _bucket_sizes(np_rows: int) -> list:
-    """Static power-of-two bucket sizes (multiples of _CHUNK) covering any
+    """Static power-of-two bucket sizes (multiples of _chunk()) covering any
     range length up to the padded row count."""
     sizes = []
-    s = min(2 * _CHUNK, np_rows)
+    s = min(2 * _chunk(), np_rows)
     while s < np_rows:
         sizes.append(s)
         s *= 2
@@ -596,7 +598,8 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
     L = cfg.num_leaves
     B = pad_bins(cfg.num_bins)
     FP = features_padded(f)
-    Np = -(-n // _CHUNK) * _CHUNK
+    chunk = _chunk()     # resolved ONCE per trace: within-trace consistency
+    Np = -(-n // chunk) * chunk
     bw = (B + BITS - 1) // BITS
     l1 = jnp.float32(cfg.lambda_l1)
     l2 = jnp.float32(cfg.lambda_l2)
@@ -620,7 +623,7 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
             # window alignment (S = sizes[i] + chunk >= length + chunk) —
             # not the next power of two, which could double the kernel work
             def make_branch(size):
-                seg = min(size + _CHUNK, Np)
+                seg = min(size + chunk, Np)
 
                 def br(args):
                     bT_, gs_, hs_, ms_, cstart, clen = args
@@ -796,7 +799,8 @@ def _grow_tree_impl_gather(binned, grad, hess, in_bag, feature_active,
     L = cfg.num_leaves
     B = pad_bins(cfg.num_bins)
     FP = features_padded(f)
-    Np = -(-n // _CHUNK) * _CHUNK
+    chunk = _chunk()     # resolved ONCE per trace: within-trace consistency
+    Np = -(-n // chunk) * chunk
     bw = (B + BITS - 1) // BITS
     l1 = jnp.float32(cfg.lambda_l1)
     l2 = jnp.float32(cfg.lambda_l2)
@@ -963,7 +967,8 @@ def _grow_tree_impl_masked(binned, grad, hess, in_bag, feature_active,
     L = cfg.num_leaves
     B = pad_bins(cfg.num_bins)
     FP = features_padded(f)
-    Np = -(-n // _CHUNK) * _CHUNK
+    chunk = _chunk()     # resolved ONCE per trace: within-trace consistency
+    Np = -(-n // chunk) * chunk
     bw = (B + BITS - 1) // BITS
     l1 = jnp.float32(cfg.lambda_l1)
     l2 = jnp.float32(cfg.lambda_l2)

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.hist_kernel import level_histograms, pad_bins, features_padded
-from .grower import (BITS, _CHUNK, GrowerConfig, _best_for_leaf,
+from .grower import (BITS, _chunk, GrowerConfig, _best_for_leaf,
                      _finalize_tree, _init_split_state, _leaf_output,
                      _maybe_psum, _node_mask_fn, _pad_cat_nbins,
                      _pad_grow_inputs, _winning_cat_bitset)
@@ -86,9 +86,10 @@ def _grow_tree_impl_depthwise(binned, grad, hess, in_bag, feature_active,
     L = cfg.num_leaves
     B = pad_bins(cfg.num_bins)
     FP = features_padded(f)
-    Np = -(-n // _CHUNK) * _CHUNK
-    CAP = Np + L * _CHUNK                 # every leaf rounds up to a chunk
-    CAPC = CAP // _CHUNK
+    chunk = _chunk()     # resolved ONCE per trace: within-trace consistency
+    Np = -(-n // chunk) * chunk
+    CAP = Np + L * chunk                    # every leaf rounds up to a chunk
+    CAPC = CAP // chunk
     bw = (B + BITS - 1) // BITS
     l1 = jnp.float32(cfg.lambda_l1)
     l2 = jnp.float32(cfg.lambda_l2)
@@ -116,7 +117,7 @@ def _grow_tree_impl_depthwise(binned, grad, hess, in_bag, feature_active,
 
     def level_pass(bT, gs, hs, ms, leaf_start, rleaf, leaf_len, exists):
         """One multi-leaf histogram pass + vmapped split finding."""
-        hist = level_histograms(bT, gs, hs, ms, leaf_start // _CHUNK, rleaf,
+        hist = level_histograms(bT, gs, hs, ms, leaf_start // chunk, rleaf,
                                 B, L)
         # mask BEFORE the psum and by the shard-UNIFORM ``exists`` only:
         # every existing leaf owns >= 1 chunk (all-padding chunks produce
@@ -269,13 +270,13 @@ def _grow_tree_impl_depthwise(binned, grad, hess, in_bag, feature_active,
         first_sorted = jnp.concatenate([jnp.zeros(1, jnp.int32),
                                         jnp.cumsum(counts)[:-1]])
         exists2 = jnp.arange(L) <= s.num_splits
-        cap_chunks = jnp.where(exists2, jnp.maximum(-(-counts // _CHUNK), 1),
+        cap_chunks = jnp.where(exists2, jnp.maximum(-(-counts // chunk), 1),
                                0)
         base_chunk = jnp.concatenate([jnp.zeros(1, jnp.int32),
                                       jnp.cumsum(cap_chunks)[:-1]])
-        leaf_start2 = jnp.where(exists2, base_chunk * _CHUNK, CAP)
+        leaf_start2 = jnp.where(exists2, base_chunk * chunk, CAP)
         # destination -> source: slot of q via its chunk, rank within slot
-        qchunk = jnp.arange(CAP, dtype=jnp.int32) // _CHUNK
+        qchunk = jnp.arange(CAP, dtype=jnp.int32) // chunk
         slot_q = (jnp.searchsorted(base_chunk, qchunk, side="right")
                   .astype(jnp.int32) - 1)
         slot_q = jnp.clip(slot_q, 0, L - 1)

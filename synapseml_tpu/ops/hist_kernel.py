@@ -35,9 +35,26 @@ from jax import lax
 
 FEATURE_BLOCK = 8     # features per kernel step (i32 sublane tile)
 LANE = 128
-# on-chip tuning knobs (tools/perf_tune.py phase 1b sweeps these; the winner
-# ships as the env default so the sweep result survives without code edits)
-DEFAULT_CHUNK = int(os.environ.get("SYNAPSEML_TPU_HIST_CHUNK", 2048))
+
+
+def default_chunk() -> int:
+    """Rows per kernel step. Resolution: SYNAPSEML_TPU_HIST_CHUNK env > the
+    on-chip sweep winner in docs/tuned_defaults.json (tools/perf_tune.py
+    phase D; applied only under the TPU backend — core/tuned.py) > 2048.
+    A malformed env value fails HERE with the variable named, not as a
+    ZeroDivisionError mid-trace (file values are validated on read)."""
+    from ..core import tuned as _tuned
+
+    v = _tuned.tuned_default("hist_chunk", "SYNAPSEML_TPU_HIST_CHUNK", 2048)
+    try:
+        c = int(v)
+        if c <= 0:
+            raise ValueError
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"SYNAPSEML_TPU_HIST_CHUNK={v!r}: want a positive integer "
+            "(kernel rows per grid step)") from None
+    return c
 
 
 def pad_bins(max_bin: int) -> int:
@@ -120,9 +137,11 @@ def _packed_accumulate(bin_ref, out_ref, g1, h1, m1, *, C: int, K1: int,
 def _pack_for(K1: int, FB: int, pack) -> int:
     """Features per dot: fill the 128-row MXU tile (M = PACK*K1) while
     keeping N = PACK*24 within one 128-lane tile; PACK must divide FB.
-    ``pack`` (arg or SYNAPSEML_TPU_HIST_PACK) forces."""
+    ``pack`` (arg or SYNAPSEML_TPU_HIST_PACK) forces — clamped to the same
+    tile constraints (128 // K1, 5, FB) so a forced value can never lose the
+    one-tile-pass property the kernel docstring promises."""
     force = pack or os.environ.get("SYNAPSEML_TPU_HIST_PACK")
-    PACK = max(1, min(int(force) if force else 128 // K1, 5, FB))
+    PACK = max(1, min(int(force) if force else 128, 128 // K1, 5, FB))
     while FB % PACK:
         PACK -= 1
     return PACK
@@ -143,7 +162,7 @@ def _hist_pallas(bT, g, h, m, num_bins_padded: int, chunk: int = None,
     from jax.experimental import pallas as pl
 
     FP, n = bT.shape
-    C = min(chunk or DEFAULT_CHUNK, n)
+    C = min(chunk or default_chunk(), n)
     FB = feature_block or FEATURE_BLOCK
     assert n % C == 0 and FP % FB == 0
     K1 = num_bins_padded // 8
@@ -207,7 +226,7 @@ def _hist_pallas_range(bT, g, h, m, start, length, num_bins_padded: int,
     from jax.experimental.pallas import tpu as pltpu
 
     FP, n = bT.shape
-    C = min(chunk or DEFAULT_CHUNK, n)
+    C = min(chunk or default_chunk(), n)
     FB = feature_block or FEATURE_BLOCK
     assert n % C == 0 and FP % FB == 0 and size % C == 0 and size <= n
     K1 = num_bins_padded // 8
@@ -282,7 +301,7 @@ def _hist_pallas_level(bT, g, h, m, start_chunks, num_bins_padded: int,
     from jax.experimental.pallas import tpu as pltpu
 
     FP, n = bT.shape
-    C = min(chunk or DEFAULT_CHUNK, n)
+    C = min(chunk or default_chunk(), n)
     FB = feature_block or FEATURE_BLOCK
     assert n % C == 0 and FP % FB == 0
     K1 = num_bins_padded // 8
@@ -340,7 +359,7 @@ def _tpu_level_ok(num_bins_padded: int, slots: int, pack=None) -> bool:
     import numpy as _np
 
     try:
-        C = DEFAULT_CHUNK
+        C = default_chunk()
         caps = [2, 1, 3] + [1] * max(slots - 3, 0)
         caps = caps[:slots]
         total = sum(caps) * C
@@ -378,7 +397,16 @@ def level_histograms(bT, g, h, m, start_chunks, slot_of_row,
     """(SLOTS, FP, B, 3) histograms of slot-partitioned rows in ONE pass:
     the multi-leaf Pallas kernel on TPU (chunk-aligned slots required;
     tail padding rows must carry zero g/h/m), the slot-keyed scatter
-    fallback elsewhere."""
+    fallback elsewhere.
+
+    CONTRACT (Pallas path; ADVICE r3): ``start_chunks`` must be strictly
+    ascending with every slot owning >= 1 chunk of capacity — the kernel
+    zero-initializes a slot's output block only when the grid reaches that
+    slot's FIRST chunk, so a zero-capacity slot's block is never visited and
+    returns uninitialized VMEM garbage. Callers must mask outputs by their
+    own shard-uniform existence vector (grower_depthwise does: its
+    ``cap_chunks`` floors every live slot at 1 and ``exists`` masks the
+    gains). The XLA fallback has no such constraint."""
     mode = (_tpu_kernel_selftest(num_bins_padded)
             if jax.default_backend() == "tpu" else "xla")
     pk = 1 if mode == "pack1" else None
@@ -411,7 +439,7 @@ def _tpu_kernel_selftest(num_bins_padded: int) -> str:
     cross-feature contamination or channel swaps fail the check."""
     import numpy as _np
 
-    n = DEFAULT_CHUNK
+    n = default_chunk()
     rng = _np.random.default_rng(0)
     bT = jnp.asarray(rng.integers(0, num_bins_padded, size=(8, n)),
                      jnp.int32)
@@ -438,7 +466,7 @@ def _tpu_segmented_ok(num_bins_padded: int) -> bool:
     import numpy as _np
 
     try:
-        n = 4 * DEFAULT_CHUNK
+        n = 4 * default_chunk()
         rng = _np.random.default_rng(1)
         bT = jnp.asarray(rng.integers(0, num_bins_padded, size=(8, n)),
                          jnp.int32)
@@ -446,8 +474,8 @@ def _tpu_segmented_ok(num_bins_padded: int) -> bool:
         h = jnp.asarray(rng.uniform(0.5, 2.0, size=n).astype(_np.float32))
         m = jnp.asarray((rng.uniform(size=n) > 0.25).astype(_np.float32))
         # geometry satisfies the documented contract size >= length + chunk
-        start, length = 1234, 2 * DEFAULT_CHUNK - 57
-        size = 3 * DEFAULT_CHUNK
+        start, length = 1234, 2 * default_chunk() - 57
+        size = 3 * default_chunk()
         got = _np.asarray(_hist_pallas_range(bT, g * m, h * m, m, start,
                                              length, num_bins_padded, size))
         idx = _np.arange(n)

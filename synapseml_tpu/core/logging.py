@@ -14,12 +14,85 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import re
 import time
 from typing import Any, Dict, Optional
 
 logger = logging.getLogger("synapseml_tpu")
 
 PROTOCOL_VERSION = "1.0.0"
+
+# --- secret scrubbing --------------------------------------------------------
+# Every structured log line passes through scrub_payload + scrub_text before
+# it reaches a handler, so a subscription key, SAS signature, bearer token or
+# connection string in a param payload / error message can never land in logs.
+# Analog (and superset) of the reference's SASScrubber
+# (core/.../logging/common/Scrubber.scala: sig=... redaction only).
+
+REDACTED = "####"
+
+# key NAMES whose values are secret wherever they appear in a payload:
+# either the whole key is a well-known secret word, or it contains a
+# compound secret name (subscriptionKey, apiKey, accountKey, aadToken, ...)
+_EXACT_SECRET_KEYS = re.compile(
+    r"(?i)^(key|sig|sas|token|secret|password|pwd|auth|authorization|"
+    r"bearer|credential|credentials)$")
+_COMPOUND_SECRET_KEYS = re.compile(
+    r"(?i)(subscription[_-]?key|api[_-]?key|account[_-]?key|shared[_-]?key|"
+    r"access[_-]?token|aad[_-]?token|sas[_-]?token|refresh[_-]?token|"
+    r"id[_-]?token|client[_-]?secret|connection[_-]?string|"
+    r"ocp-apim-subscription-key)")
+
+# value PATTERNS scrubbed out of any logged string (URLs in error messages,
+# headers echoed by HTTP exceptions, ...)
+_TEXT_PATTERNS = (
+    # SAS / query-string signatures and credentials: sig=..., key=..., &c.
+    (re.compile(r"(?i)\b(sig|signature|key|token|secret|password|pwd|"
+                r"credential|sv|se|st|spr|sp)=([A-Za-z0-9%+/._~-]{8,}"
+                r"(?:%3d|=){0,2})"), r"\1=" + REDACTED),
+    # Authorization headers / bearer tokens
+    (re.compile(r"(?i)\b(bearer|basic)[ :]+[A-Za-z0-9._+/=-]{8,}"),
+     r"\1 " + REDACTED),
+    # API-key-shaped literals (OpenAI-style)
+    (re.compile(r"\bsk-[A-Za-z0-9]{16,}\b"), "sk-" + REDACTED),
+    # explicit subscription-key headers serialized into text
+    (re.compile(r"(?i)(ocp-apim-subscription-key[\"']?\s*[:=]\s*[\"']?)"
+                r"[A-Za-z0-9-]{8,}"), r"\1" + REDACTED),
+    # JWTs (three dot-separated base64url segments)
+    (re.compile(r"\beyJ[A-Za-z0-9_-]{8,}\.[A-Za-z0-9_-]{8,}"
+                r"\.[A-Za-z0-9_-]{8,}\b"), REDACTED),
+)
+
+
+def _is_secret_key(name: str) -> bool:
+    return bool(_EXACT_SECRET_KEYS.match(name)
+                or _COMPOUND_SECRET_KEYS.search(name))
+
+
+def scrub_text(s: str) -> str:
+    """Redact secret-shaped substrings from free text (error messages, URLs)."""
+    for pat, repl in _TEXT_PATTERNS:
+        s = pat.sub(repl, s)
+    return s
+
+
+def scrub_payload(obj: Any) -> Any:
+    """Recursively redact secret-named fields and secret-shaped strings from
+    a structured payload about to be logged."""
+    if isinstance(obj, dict):
+        return {k: (REDACTED if isinstance(k, str) and _is_secret_key(k)
+                    else scrub_payload(v)) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        vals = [scrub_payload(v) for v in obj]
+        if hasattr(obj, "_make"):          # NamedTuple
+            return type(obj)._make(vals)
+        try:
+            return type(obj)(vals)
+        except TypeError:                  # exotic sequence subclass: the
+            return vals                    # scrubbed content matters, not type
+    if isinstance(obj, str):
+        return scrub_text(obj)
+    return obj
 
 
 def _framework_version() -> str:
@@ -38,6 +111,8 @@ class SynapseMLLogging:
         self._log_base("constructor")
 
     def _log_base(self, method: str, extra: Optional[Dict[str, Any]] = None, level=logging.DEBUG) -> None:
+        if not logger.isEnabledFor(level):
+            return   # skip payload build + scrub work for disabled levels
         payload = {
             "uid": getattr(self, "uid", None),
             "className": type(self).__name__,
@@ -47,7 +122,10 @@ class SynapseMLLogging:
         }
         if extra:
             payload.update(extra)
-        logger.log(level, json.dumps(payload, default=str))
+        # scrub twice: structured (secret-named fields) then textual (secret-
+        # shaped values that survive json.dumps, e.g. URLs inside messages)
+        logger.log(level, scrub_text(json.dumps(scrub_payload(payload),
+                                                default=str)))
 
     @contextlib.contextmanager
     def log_verb(self, verb: str, **info):

@@ -1,0 +1,101 @@
+"""Secret scrubbing in structured logging (core/logging.py).
+
+The reference scrubs SAS signatures from logged payloads
+(core/.../logging/common/Scrubber.scala); this side scrubs a superset —
+secret-named fields (subscriptionKey, tokens, connection strings) and
+secret-shaped text (SAS sig=, Bearer headers, sk- keys, JWTs) — and the
+tests pin VERDICT r3 #7's contract: a service key must never reach a log
+line, including through error messages.
+"""
+
+import json
+import logging
+
+import pytest
+
+from synapseml_tpu.core.logging import (REDACTED, SynapseMLLogging,
+                                        scrub_payload, scrub_text)
+
+SECRET = "c0ffee1234deadbeef5678abcd"
+
+
+class _Stage(SynapseMLLogging):
+    uid = "stage_test_1"
+
+
+@pytest.fixture
+def records(caplog):
+    caplog.set_level(logging.DEBUG, logger="synapseml_tpu")
+    return caplog
+
+
+def test_subscription_key_field_never_logged(records):
+    _Stage()._log_base("constructor", {"subscriptionKey": SECRET,
+                                       "featuresCol": "features"})
+    text = "\n".join(r.getMessage() for r in records.records)
+    assert SECRET not in text
+    assert "features" in text          # non-secret fields survive
+    assert json.loads(text)["subscriptionKey"] == REDACTED
+
+
+def test_error_message_with_sas_url_scrubbed(records):
+    stage = _Stage()
+    with pytest.raises(RuntimeError):
+        with stage.log_verb("transform"):
+            raise RuntimeError(
+                "GET https://acct.blob.example/c/b?sv=2021-08-06&"
+                f"sig={SECRET}%3D failed")
+    text = "\n".join(r.getMessage() for r in records.records)
+    assert SECRET not in text
+    assert "acct.blob.example" in text    # the useful part survives
+
+
+def test_bearer_token_scrubbed(records):
+    _Stage()._log_base("transform", {"message":
+                                     f"Authorization: Bearer {SECRET}.x.y"})
+    text = "\n".join(r.getMessage() for r in records.records)
+    assert SECRET not in text
+
+
+@pytest.mark.parametrize("key", [
+    "subscriptionKey", "apiKey", "api_key", "accountKey", "AADToken",
+    "accessToken", "sasToken", "clientSecret", "connectionString",
+    "password", "token", "Authorization", "credentials"])
+def test_secret_key_names(key):
+    assert scrub_payload({key: SECRET})[key] == REDACTED
+
+
+def test_non_secret_keys_untouched():
+    p = {"featuresCol": "features", "numIterations": 100,
+         "labelCol": "label", "nested": {"batchSize": 32}}
+    assert scrub_payload(p) == p
+
+
+def test_namedtuple_payload_survives(records):
+    """A NamedTuple inside a payload must serialize (via _make), not raise
+    out of log_verb and fail the operation (code-review r4 finding)."""
+    import collections
+    import logging as _logging
+
+    Pt = collections.namedtuple("Pt", "x secretToken")
+    _Stage()._log_base("transform", {"point": Pt(1, SECRET)},
+                       level=_logging.INFO)
+    text = "\n".join(r.getMessage() for r in records.records)
+    assert "point" in text
+
+
+def test_disabled_level_skips_work(caplog):
+    caplog.set_level(logging.WARNING, logger="synapseml_tpu")
+    _Stage()._log_base("constructor", {"x": 1})   # DEBUG: below threshold
+    assert not caplog.records
+
+
+def test_text_patterns():
+    assert SECRET not in scrub_text(f"...&sig={SECRET}%3d&se=2026")
+    assert SECRET not in scrub_text(f"Ocp-Apim-Subscription-Key: {SECRET}")
+    assert "sk-" + "a" * 24 not in scrub_text("key was sk-" + "a" * 24)
+    jwt = "eyJ" + "a" * 20 + "." + "b" * 20 + "." + "c" * 20
+    assert jwt not in scrub_text(f"token {jwt} rejected")
+    # nested structures and lists are walked
+    out = scrub_payload({"headers": [{"Authorization": f"Bearer {SECRET}"}]})
+    assert SECRET not in json.dumps(out)

@@ -8,6 +8,9 @@ cd "$(dirname "$0")"
 echo "== lint: compileall =="
 python -m compileall -q synapseml_tpu tests bench.py __graft_entry__.py
 
+echo "== lint: AST audit (undefined names / unused imports / import cycles) =="
+python tools/lint.py
+
 echo "== native build =="
 make -C synapseml_tpu/native
 

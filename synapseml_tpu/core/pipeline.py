@@ -13,7 +13,7 @@ from __future__ import annotations
 import importlib
 import json
 import os
-from typing import Any, List, Optional
+from typing import List, Optional
 
 import numpy as np
 

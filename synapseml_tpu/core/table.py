@@ -17,7 +17,7 @@ Interop: ``from_pandas`` / ``to_pandas`` / ``from_arrow`` / ``to_arrow`` /
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Iterator, Mapping, Optional, Sequence
+from typing import Any, Iterable, Iterator, Mapping, Optional, Sequence
 
 import numpy as np
 

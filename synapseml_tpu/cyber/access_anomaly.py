@@ -19,7 +19,7 @@ scoring is one gather + dot per row.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import numpy as np
 

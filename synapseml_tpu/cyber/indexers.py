@@ -12,7 +12,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.params import Param, Params
-from ..core.pipeline import Estimator, Model, Transformer
+from ..core.pipeline import Estimator, Model
 from ..core.table import Table
 
 

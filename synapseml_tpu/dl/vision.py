@@ -14,7 +14,7 @@ blocks are unfrozen in addition; -1 trains everything.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np

@@ -8,7 +8,6 @@ the coefficients."""
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 

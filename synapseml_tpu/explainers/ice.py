@@ -11,7 +11,7 @@ per (row, value)."""
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

@@ -23,7 +23,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..core.params import Param, Params, HasLabelCol
+from ..core.params import Param, HasLabelCol
 from ..core.pipeline import Transformer
 from ..core.table import Table
 

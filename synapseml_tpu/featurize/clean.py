@@ -3,7 +3,6 @@ core/.../featurize/CleanMissingData.scala — Mean/Median/Custom modes)."""
 
 from __future__ import annotations
 
-from typing import List
 
 import numpy as np
 

@@ -23,7 +23,7 @@ from typing import List
 import numpy as np
 
 from ..ops.quantize import BinMapper
-from .grower import BITS, TreeArrays
+from .grower import TreeArrays
 
 _DT_CAT = 1
 _DT_DEFAULT_LEFT = 2

@@ -18,7 +18,6 @@ remapped to the full feature space afterwards.
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,6 @@ segmentation is a host-side preprocessing step feeding the TPU explainers)."""
 
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 

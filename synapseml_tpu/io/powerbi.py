@@ -8,7 +8,6 @@ rows to respect the API's row-per-request limits.
 from __future__ import annotations
 
 import json as _json
-from typing import Optional
 
 from ..core.table import Table
 from .http import HTTPRequestData, send_with_retries

@@ -16,7 +16,6 @@ dynamic shapes.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 

@@ -16,7 +16,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..core.params import Param, Params
+from ..core.params import Param
 from ..core.pipeline import Model as _Model, Transformer
 from ..core.table import Table
 from .importer import OnnxFunction, fold_constants

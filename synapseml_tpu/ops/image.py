@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import io
 from functools import partial
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp

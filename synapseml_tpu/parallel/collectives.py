@@ -9,12 +9,11 @@ axis names from :mod:`synapseml_tpu.parallel.mesh`.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from .mesh import DATA_AXIS
 

@@ -19,7 +19,6 @@ Canonical axis names (fixed across the framework so shardings compose):
 from __future__ import annotations
 
 import contextlib
-import os
 from typing import Optional, Sequence
 
 import jax

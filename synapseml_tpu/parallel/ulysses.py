@@ -23,7 +23,6 @@ parity-plus, designed in from the start per the distributed-first mandate.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import DATA_AXIS, SEQ_AXIS

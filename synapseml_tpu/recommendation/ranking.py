@@ -10,13 +10,12 @@ split does a per-user holdout and selects the best param map.
 
 from __future__ import annotations
 
-import itertools
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List
 
 import numpy as np
 
 from ..core.params import Param, Params
-from ..core.pipeline import Estimator, Model, Transformer
+from ..core.pipeline import Estimator, Model
 from ..core.table import Table
 
 _METRICS = ("ndcgAt", "map", "precisionAtk", "recallAtK", "diversityAtK",

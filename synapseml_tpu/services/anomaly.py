@@ -11,7 +11,7 @@ estimator's long-running train/poll flow is represented by
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from ..core.params import Param
 from ..core.pipeline import Estimator

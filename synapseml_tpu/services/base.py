@@ -13,14 +13,14 @@ from __future__ import annotations
 
 import json as _json
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 
 from ..core.params import Param
 from ..core.pipeline import Transformer
 from ..core.table import Table
-from ..io.http import HTTPRequestData, HTTPResponseData, send_with_retries
+from ..io.http import HTTPRequestData, HTTPResponseData
 
 
 class HasServiceParams(Transformer):

@@ -8,7 +8,6 @@ rather than a header.
 
 from __future__ import annotations
 
-from typing import Optional
 
 from ..core.params import Param
 from .base import CognitiveServiceBase

@@ -8,10 +8,8 @@ bodies and unwrap ``results.documents``.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
 
 from ..core.params import Param
-from ..core.table import Table
 from .base import HasAsyncReply, HasSetLocation
 
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import json as _json
 import re
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 

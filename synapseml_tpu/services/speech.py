@@ -14,7 +14,7 @@ from __future__ import annotations
 import datetime as _dt
 import json as _json
 import uuid as _uuid
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

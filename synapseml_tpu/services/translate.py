@@ -7,7 +7,6 @@ Transliterate, Detect, BreakSentence, DictionaryLookup). All POST arrays of
 
 from __future__ import annotations
 
-from typing import List
 
 from ..core.params import Param
 from .base import CognitiveServiceBase

@@ -8,10 +8,8 @@ and services/face/Face.scala (DetectFace, ...). Images go either as a URL
 
 from __future__ import annotations
 
-from typing import List, Optional
 
 from ..core.params import Param
-from ..io.http import HTTPRequestData
 from .base import HasAsyncReply, HasSetLocation
 
 

@@ -8,7 +8,7 @@ PipelineModel.
 from __future__ import annotations
 
 from ..core.params import Param, HasInputCols, HasOutputCols
-from ..core.pipeline import Estimator, Model, PipelineModel, Transformer
+from ..core.pipeline import Estimator, Model, PipelineModel
 from ..core.table import Table
 
 

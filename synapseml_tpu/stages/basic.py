@@ -7,13 +7,13 @@ Explode,DropColumns,SelectColumns,RenameColumn}.scala (SURVEY.md §2.7).
 from __future__ import annotations
 
 import time
-from typing import Callable, Optional
+from typing import Callable
 
 import numpy as np
 
 from ..core.logging import logger as _logger
-from ..core.params import Param, Params, HasInputCol, HasInputCols, HasOutputCol
-from ..core.pipeline import Estimator, PipelineStage, Transformer
+from ..core.params import Param, HasInputCol, HasInputCols, HasOutputCol
+from ..core.pipeline import PipelineStage, Transformer
 from ..core.table import Table
 
 

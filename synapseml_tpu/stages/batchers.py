@@ -12,11 +12,10 @@ batch has one static shape — what a jitted TPU program wants.
 from __future__ import annotations
 
 import time
-from typing import Optional
 
 import numpy as np
 
-from ..core.params import Param, Params
+from ..core.params import Param
 from ..core.pipeline import Transformer
 from ..core.table import Table
 

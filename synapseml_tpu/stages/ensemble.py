@@ -6,7 +6,7 @@ Reference: core/.../stages/EnsembleByKey.scala and PartitionConsolidator.scala:2
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

@@ -6,12 +6,11 @@ return a model that both featurizes and scores at transform time."""
 
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 
 from ..core.params import Param, HasFeaturesCol, HasLabelCol
-from ..core.pipeline import Estimator, Model, Transformer
+from ..core.pipeline import Estimator, Model
 from ..core.table import Table
 from ..featurize import Featurize, ValueIndexer
 

@@ -10,7 +10,7 @@ engine in learner.py; `passThroughArgs` parses the common VW CLI flags."""
 from __future__ import annotations
 
 from dataclasses import replace as _replace
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 

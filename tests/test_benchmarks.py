@@ -115,7 +115,6 @@ class TestGBDTBenchmarks:
 
     def test_ranker_ndcg(self):
         from synapseml_tpu.models import LightGBMRanker
-        from synapseml_tpu.recommendation import RankingEvaluator
 
         rng = np.random.default_rng(13)
         n_groups, per = 40, 10

@@ -2,7 +2,6 @@
 unusual accesses, per-tenant isolation, indexer/scaler round-trips)."""
 
 import numpy as np
-import pytest
 
 from synapseml_tpu.core.table import Table
 from synapseml_tpu.cyber import (AccessAnomaly, ComplementAccessTransformer,

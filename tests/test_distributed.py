@@ -5,7 +5,6 @@ The reference exercises its whole distributed stack in-process via Spark
 sharded histograms must equal single-device histograms (the psum the compiler
 inserts replaces LightGBM's ring allreduce), and mesh helpers must compose."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

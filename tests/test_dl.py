@@ -3,7 +3,6 @@ no cluster (reference: deep-learning/src/test/python/.../conftest.py
 CallbackBackend pattern, SURVEY §4.6)."""
 
 import numpy as np
-import pytest
 
 from synapseml_tpu.core import PipelineStage, Table
 from synapseml_tpu.dl import (DeepTextClassifier, DeepVisionClassifier,

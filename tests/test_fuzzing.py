@@ -11,11 +11,8 @@ getter/setter fuzzing run over the same registry (Fuzzing.scala traits).
 import json
 
 import numpy as np
-import pytest
 
-from synapseml_tpu.core.pipeline import (Estimator, Model, Pipeline,
-                                         PipelineModel, PipelineStage,
-                                         Transformer)
+from synapseml_tpu.core.pipeline import Pipeline, PipelineModel, Transformer
 from synapseml_tpu.core.table import Table
 from synapseml_tpu.io.http import HTTPResponseData
 from synapseml_tpu.testing import (TestObject, discover_stage_classes,

@@ -6,7 +6,7 @@ import pytest
 
 from synapseml_tpu.gbdt import BoosterConfig, train_booster
 from synapseml_tpu.gbdt.boosting import Booster
-from synapseml_tpu.gbdt.grower import GrowerConfig, forest_predict, grow_tree, stack_trees
+from synapseml_tpu.gbdt.grower import GrowerConfig, forest_predict, grow_tree
 from synapseml_tpu.ops.histogram import leaf_histograms
 from synapseml_tpu.ops.quantize import apply_bins, compute_bin_mapper
 

@@ -68,7 +68,6 @@ def test_pallas_interpret_matches_xla():
 
 
 def test_child_histogram_dispatches_on_backend():
-    import jax
     import jax.numpy as jnp
 
     bT, g, h, m = _case(2048, 4)

@@ -2,7 +2,6 @@
 max-inner-product correctness and serialization fuzzing, SURVEY.md §4)."""
 
 import numpy as np
-import pytest
 
 from synapseml_tpu.core.pipeline import PipelineStage
 from synapseml_tpu.core.table import Table

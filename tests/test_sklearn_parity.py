@@ -12,7 +12,6 @@ metric functions are independent oracles for our eval implementations.
 from __future__ import annotations
 
 import numpy as np
-import pytest
 
 from synapseml_tpu.gbdt import BoosterConfig, train_booster
 

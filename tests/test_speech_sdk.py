@@ -4,7 +4,6 @@ The fake server implements the server side of RFC 6455 plus the Speech USP
 framing, so the full client path — handshake, speech.config, chunked audio,
 phrase events, turn.end — is exercised without a network."""
 
-import json
 import socket
 import threading
 

@@ -4,9 +4,7 @@ truly informative features, and a voting-trained booster matches full
 data-parallel accuracy on data whose signal lives in few features."""
 
 import numpy as np
-import pytest
 
-from synapseml_tpu.core.table import Table
 from synapseml_tpu.gbdt import BoosterConfig, train_booster
 from synapseml_tpu.gbdt.voting import voting_select
 from synapseml_tpu.parallel import make_mesh

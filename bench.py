@@ -87,6 +87,11 @@ def bench_gbdt():
         "gather_scatter": {"partition_impl": "scatter",
                            "row_layout": "gather"},
         "masked": {"partition_impl": "sort", "row_layout": "masked"},
+        # sort32 combos: every value the tuner can pin must be representable
+        # here, or a tuned default would be mislabeled in the report
+        "partition_sort32": {"partition_impl": "sort32",
+                             "row_layout": "partition"},
+        "gather_sort32": {"partition_impl": "sort32", "row_layout": "gather"},
     }
     _d = BoosterConfig()
     default_name = next(

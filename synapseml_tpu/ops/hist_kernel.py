@@ -137,11 +137,22 @@ def _packed_accumulate(bin_ref, out_ref, g1, h1, m1, *, C: int, K1: int,
 def _pack_for(K1: int, FB: int, pack) -> int:
     """Features per dot: fill the 128-row MXU tile (M = PACK*K1) while
     keeping N = PACK*24 within one 128-lane tile; PACK must divide FB.
-    ``pack`` (arg or SYNAPSEML_TPU_HIST_PACK) forces — clamped to the same
-    tile constraints (128 // K1, 5, FB) so a forced value can never lose the
-    one-tile-pass property the kernel docstring promises."""
-    force = pack or os.environ.get("SYNAPSEML_TPU_HIST_PACK")
-    PACK = max(1, min(int(force) if force else 128, 128 // K1, 5, FB))
+    ``pack`` (arg > SYNAPSEML_TPU_HIST_PACK env > tuned file) forces —
+    clamped to the same tile constraints (128 // K1, 5, FB) so a forced
+    value can never lose the one-tile-pass property the kernel docstring
+    promises."""
+    from ..core import tuned as _tuned
+
+    force = pack or _tuned.tuned_default("hist_pack",
+                                         "SYNAPSEML_TPU_HIST_PACK", None)
+    return clamp_pack(int(force) if force else 128, K1, FB)
+
+
+def clamp_pack(want: int, K1: int, FB: int) -> int:
+    """The pure tile clamp shared by _pack_for and the tuner's
+    formula-default computation (tools/perf_tune.py) — one copy of the
+    constraint math, so the two sides cannot desync."""
+    PACK = max(1, min(want, 128 // K1, 5, FB))
     while FB % PACK:
         PACK -= 1
     return PACK

@@ -146,7 +146,14 @@ def one_tree(c):
 RESULTS = {"n_rows": N, "n_features": F,
            "phase_a_ms_per_tree": {}, "phase_b_train25_row_iters": {},
            "phase_b_steady_state_row_iters": {}, "phase_d_best": None,
-           "phase_d_best_fb8": None, "phase_d_chunk_ms": {}}
+           "phase_d_best_fb8": None, "phase_d_chunk_ms": {},
+           "phase_d_pack_ms": {}, "phase_d_best_pack": None}
+
+
+def _pack_formula_default() -> int:
+    from synapseml_tpu.ops.hist_kernel import clamp_pack
+
+    return clamp_pack(128, 256 // 8, FEATURE_BLOCK_PROD)
 
 
 def _persist_and_flip():
@@ -214,6 +221,8 @@ def _persist_and_flip():
     # fb=16-only win would ship a chunk the engine can't benefit from
     if RESULTS["phase_d_best_fb8"]:
         vals["hist_chunk"] = int(RESULTS["phase_d_best_fb8"]["chunk"])
+    if RESULTS["phase_d_best_pack"]:
+        vals["hist_pack"] = int(RESULTS["phase_d_best_pack"])
     # MERGE with the existing file: a short window that skipped phase D
     # must not silently drop a previously measured hist_chunk pin. Values
     # are re-validated (current_file_values) so a corrupt entry the reader
@@ -227,6 +236,11 @@ def _persist_and_flip():
     vals = {**prev, **vals}
     if seg_measured and a["part/sort noseg"] >= 0.97 * a["partition/sort"]:
         vals.pop("use_segmented", None)   # measured: revert pin to auto
+    if (RESULTS["phase_d_pack_ms"] and not RESULTS["phase_d_best_pack"]
+            and _pack_formula_default() in RESULTS["phase_d_pack_ms"]):
+        # unpin ONLY when the formula default was itself measured this run
+        # and won — a failed default compile must not drop a measured pin
+        vals.pop("hist_pack", None)
     prov = {"captured_at": now, "platform": plat,
             "source": "tools/perf_tune.py", "decided_by": decided,
             "winner": win,
@@ -451,6 +465,31 @@ if _on_tpu and budget_left() > 90:
                                    "feature_block": best[0][1]}
     if best_fb8[0]:
         RESULTS["phase_d_best_fb8"] = {"chunk": best_fb8[0]}
+    # PACK sweep at the production fb and the winning chunk: the packed-dot
+    # design claims ~PACK x row-feature throughput — measure it instead of
+    # assuming, and pin hist_pack only on a >3% win over the formula default
+    if budget_left() > 60:
+        pchunk = best_fb8[0] or 2048
+        pack_ms = {}
+        for pk in (1, 2, 4):
+            try:
+                t = timeit(lambda p=pk: _hist_pallas(
+                    bT[:, :Ns], g[:Ns], h[:Ns], m[:Ns], 256, chunk=pchunk,
+                    pack=p))
+            except Exception as e:
+                print(f"  pack={pk}: FAILED {str(e)[:80]}", flush=True)
+                continue
+            pack_ms[pk] = round(t * 1e3, 3)
+            print(f"  pack={pk}: {t*1e3:7.2f} ms", flush=True)
+        RESULTS["phase_d_pack_ms"] = pack_ms
+        if pack_ms:
+            auto = min(pack_ms, key=pack_ms.get)
+            formula_default = _pack_formula_default()
+            if (formula_default in pack_ms and auto != formula_default
+                    and pack_ms[auto] < 0.97 * pack_ms[formula_default]):
+                RESULTS["phase_d_best_pack"] = auto
+                print(f"  PACK WINNER: {auto} (beats default "
+                      f"{formula_default} by >3%)", flush=True)
 
 # --- phase E: partition primitives -------------------------------------------
 if guard("E: partition"):

@@ -137,6 +137,24 @@ def bench_gbdt():
         out["value_default"] = round(results[default_name], 1)
         out["vs_baseline_default"] = round(
             results[default_name] / BASELINE_GBDT_ROW_ITERS, 3)
+    # auditability of the tune->flip->bench loop: record the EFFECTIVE
+    # engine defaults for this run — env vars outrank the tuned file, so
+    # report resolved values, not the raw file (empty = hardcoded defaults)
+    from synapseml_tpu.core.tuned import tuned_default, tuned_engine_defaults
+    from synapseml_tpu.ops.hist_kernel import default_chunk
+
+    td = dict(tuned_engine_defaults())
+    if td:
+        td["partition_impl"] = _d.partition_impl
+        td["row_layout"] = _d.row_layout
+        if _d.use_segmented is not None:
+            td["use_segmented"] = _d.use_segmented
+        if "hist_chunk" in td:
+            td["hist_chunk"] = default_chunk()
+        if "hist_pack" in td:
+            td["hist_pack"] = tuned_default(
+                "hist_pack", "SYNAPSEML_TPU_HIST_PACK", td["hist_pack"])
+        out["tuned_defaults"] = td
     if errors:
         out["variant_errors"] = errors
     return out

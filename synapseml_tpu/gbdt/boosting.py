@@ -463,6 +463,14 @@ class Booster:
         with open(path, "w") as f:
             f.write(self.model_string())
 
+    def to_onnx(self, input_name: str = "input", num_iteration: int = -1):
+        """ONNX TreeEnsemble export — the native analog of the reference's
+        documented onnxmltools.convert_lightgbm workflow (website Quickstart
+        - ONNX Model Inference.md); serve the result through ONNXModel."""
+        from ..onnx.treeensemble import booster_to_onnx
+
+        return booster_to_onnx(self, input_name, num_iteration)
+
 
 # ---------------------------------------------------------------------------
 # Training

@@ -20,5 +20,15 @@ __all__ = [
     "Attribute", "Graph", "Model", "Node", "Tensor", "ValueInfo",
     "OnnxFunction", "fold_constants", "import_model",
     "ONNXModel", "ONNXHub", "ONNXModelInfo", "ImageFeaturizer",
-    "OP_REGISTRY",
+    "OP_REGISTRY", "booster_to_onnx",
 ]
+
+
+def __getattr__(name):
+    # lazy: treeensemble pulls the gbdt package (and jax) — eager import
+    # would defeat this package's jax-free import design (ops._jnp deferral)
+    if name == "booster_to_onnx":
+        from .treeensemble import booster_to_onnx
+
+        return booster_to_onnx
+    raise AttributeError(name)

@@ -1073,3 +1073,195 @@ def _lstm(node, x, w, r, b=None, seq_lens=None, initial_h=None,
         cT_all.append(cT)
     return (jnp.stack(ys_all, axis=1), jnp.stack(hT_all, axis=0),
             jnp.stack(cT_all, axis=0))
+
+
+# --- ai.onnx.ml tree ensembles ---------------------------------------------
+# The reference ecosystem's documented GBDT-serving path is LightGBM ->
+# onnxmltools (TreeEnsembleClassifier/Regressor, ai.onnx.ml domain) ->
+# ONNXModel (reference: website Quickstart - ONNX Model Inference.md, which
+# pip-installs onnxmltools and calls convert_lightgbm). These impls execute
+# such graphs natively: the static node tables are preprocessed host-side at
+# trace time into flat arrays, and traversal is a depth-bounded vectorized
+# gather loop over (batch, tree) — no data-dependent Python control flow, so
+# the whole ensemble jits into one XLA program.
+
+_TREE_MODES = {"LEAF": 0, "BRANCH_LEQ": 1, "BRANCH_LT": 2, "BRANCH_GTE": 3,
+               "BRANCH_GT": 4, "BRANCH_EQ": 5, "BRANCH_NEQ": 6}
+
+
+def _tree_tables(node):
+    """Flatten the node attribute lists into global arrays + per-tree roots.
+    Returns (feat, value, mode, true_g, false_g, miss_true, roots, depth,
+    gidx map) — all numpy (static)."""
+    tids = np.asarray(node.attr("nodes_treeids"), np.int64)
+    nids = np.asarray(node.attr("nodes_nodeids"), np.int64)
+    feat = np.asarray(node.attr("nodes_featureids"), np.int64)
+    vals = np.asarray(node.attr("nodes_values"), np.float32)
+    true_ids = np.asarray(node.attr("nodes_truenodeids"), np.int64)
+    false_ids = np.asarray(node.attr("nodes_falsenodeids"), np.int64)
+    modes = [m if isinstance(m, str) else m.decode()
+             for m in node.attr("nodes_modes")]
+    miss = np.asarray(node.attr("nodes_missing_value_tracks_true",
+                                [0] * len(tids)), np.int64)
+    mode_i = np.asarray([_TREE_MODES[m] for m in modes], np.int64)
+
+    gidx = {(int(t), int(n)): i for i, (t, n) in enumerate(zip(tids, nids))}
+    trees = sorted(set(int(t) for t in tids))
+    roots = np.asarray([gidx[(t, 0)] if (t, 0) in gidx
+                        else min(i for i, tt in enumerate(tids) if tt == t)
+                        for t in trees], np.int64)
+    # child pointers -> global indices (leaves self-loop so the fixed-depth
+    # walk is idempotent past a leaf)
+    tg = np.arange(len(tids), dtype=np.int64)
+    fg = np.arange(len(tids), dtype=np.int64)
+    for i in range(len(tids)):
+        if mode_i[i] != 0:
+            tg[i] = gidx[(int(tids[i]), int(true_ids[i]))]
+            fg[i] = gidx[(int(tids[i]), int(false_ids[i]))]
+    # static max depth by walking (host-side; attrs are compile-time)
+    depth = 0
+    for r in roots:
+        d, frontier, seen = 0, [int(r)], set()
+        while frontier:
+            d += 1
+            nxt = []
+            for i in frontier:
+                if i in seen or mode_i[i] == 0:
+                    continue
+                seen.add(i)
+                nxt += [int(tg[i]), int(fg[i])]
+            frontier = nxt
+            if d > 512:
+                raise ValueError("TreeEnsemble: node graph too deep/cyclic")
+        depth = max(depth, d)
+    return feat, vals, mode_i, tg, fg, miss, roots, depth, gidx
+
+
+def _tree_walk(X, tables):
+    """(N, T) final (leaf) global node index per sample per tree."""
+    jnp = _jnp()
+    feat, vals, mode_i, tg, fg, miss, roots, depth, _ = tables
+    feat_j = jnp.asarray(feat)
+    vals_j = jnp.asarray(vals)
+    mode_j = jnp.asarray(mode_i)
+    tg_j = jnp.asarray(tg)
+    fg_j = jnp.asarray(fg)
+    miss_j = jnp.asarray(miss)
+    X = X.astype(jnp.float32)
+    pos = jnp.broadcast_to(jnp.asarray(roots)[None, :],
+                           (X.shape[0], len(roots)))
+    for _ in range(depth):
+        f = feat_j[pos]                        # (N, T)
+        v = vals_j[pos]
+        m = mode_j[pos]
+        x = jnp.take_along_axis(X, f, axis=1)
+        isnan = jnp.isnan(x)
+        cmp = jnp.stack([jnp.zeros_like(x, bool), x <= v, x < v, x >= v,
+                         x > v, x == v, x != v], 0)
+        go_true = jnp.take_along_axis(
+            cmp, m[None], axis=0)[0]
+        go_true = jnp.where(isnan, miss_j[pos] == 1, go_true)
+        nxt = jnp.where(go_true, tg_j[pos], fg_j[pos])
+        pos = jnp.where(m == 0, pos, nxt)
+    return pos
+
+
+def _leaf_weight_table(tables, treeids, nodeids, out_ids, weights, n_out):
+    """(G, n_out) accumulated leaf weights keyed by global node index."""
+    gidx = tables[8]
+    G = len(tables[0])
+    table = np.zeros((G, n_out), np.float32)
+    for t, n, c, w in zip(treeids, nodeids, out_ids, weights):
+        table[gidx[(int(t), int(n))], int(c)] += np.float32(w)
+    return table
+
+
+def _post_transform_name(node) -> str:
+    pt = node.attr("post_transform", "NONE")
+    return pt if isinstance(pt, str) else pt.decode()
+
+
+def _post_transform(node, scores):
+    jnp = _jnp()
+    pt = _post_transform_name(node)
+    if pt == "NONE":
+        return scores
+    if pt == "LOGISTIC":
+        import jax
+
+        return jax.nn.sigmoid(scores)
+    if pt == "SOFTMAX":
+        import jax
+
+        return jax.nn.softmax(scores, axis=-1)
+    if pt == "SOFTMAX_ZERO":
+        # spec: softmax over the NON-ZERO score entries only; exact-zero
+        # entries keep probability 0 (all-zero rows degrade to uniform)
+        nz = scores != 0
+        e = jnp.where(nz, jnp.exp(scores - jnp.max(
+            jnp.where(nz, scores, -jnp.inf), axis=-1, keepdims=True)), 0.0)
+        denom = e.sum(axis=-1, keepdims=True)
+        uniform = jnp.full_like(scores, 1.0 / scores.shape[-1])
+        return jnp.where(denom > 0, e / jnp.maximum(denom, 1e-30), uniform)
+    raise ValueError(f"TreeEnsemble post_transform {pt!r} not supported")
+
+
+@op("TreeEnsembleClassifier")
+def _tree_classifier(node, X):
+    jnp = _jnp()
+    tables = _tree_tables(node)
+    labels = node.attr("classlabels_int64s")
+    if labels is None:
+        raise ValueError("TreeEnsembleClassifier: only int64 class labels "
+                         "are supported (classlabels_strings absent)")
+    labels = np.asarray(labels, np.int64)
+    cls_ids = np.asarray(node.attr("class_ids"), np.int64)
+    ncols = int(cls_ids.max()) + 1 if len(cls_ids) else 1
+    table = _leaf_weight_table(tables, node.attr("class_treeids"),
+                               node.attr("class_nodeids"), cls_ids,
+                               node.attr("class_weights"), ncols)
+    base = np.asarray(node.attr("base_values", [0.0] * ncols), np.float32)
+    pos = _tree_walk(X, tables)
+    scores = jnp.asarray(table)[pos].sum(axis=1) + jnp.asarray(base)
+    # onnxmltools-style binary emission: one weight column for two labels.
+    # ONNX Runtime expands BEFORE a softmax-family transform ([-s, s]) and
+    # AFTER logistic/none ([1-p, p]) — softmax over a single column would
+    # otherwise collapse to all-ones
+    binary_one_col = len(labels) == 2 and ncols == 1
+    pt = _post_transform_name(node)
+    if binary_one_col and pt in ("SOFTMAX", "SOFTMAX_ZERO"):
+        scores = jnp.concatenate([-scores, scores], axis=1)
+        binary_one_col = False
+    z = _post_transform(node, scores)
+    if binary_one_col:
+        z = jnp.concatenate([1.0 - z, z], axis=1)
+    lab = jnp.asarray(labels)[jnp.argmax(z, axis=1)]
+    return lab, z
+
+
+@op("TreeEnsembleRegressor")
+def _tree_regressor(node, X):
+    jnp = _jnp()
+    tables = _tree_tables(node)
+    n_targets = int(node.attr("n_targets", 1))
+    table = _leaf_weight_table(tables, node.attr("target_treeids"),
+                               node.attr("target_nodeids"),
+                               node.attr("target_ids"),
+                               node.attr("target_weights"), n_targets)
+    base = np.asarray(node.attr("base_values", [0.0] * n_targets),
+                      np.float32)
+    agg = node.attr("aggregate_function", "SUM")
+    agg = agg if isinstance(agg, str) else agg.decode()
+    pos = _tree_walk(X, tables)
+    per_tree = _jnp().asarray(table)[pos]            # (N, T, n_targets)
+    if agg == "SUM":
+        scores = per_tree.sum(axis=1)
+    elif agg == "AVERAGE":
+        scores = per_tree.mean(axis=1)
+    elif agg == "MIN":
+        scores = per_tree.min(axis=1)
+    elif agg == "MAX":
+        scores = per_tree.max(axis=1)
+    else:
+        raise ValueError(f"TreeEnsembleRegressor aggregate {agg!r}")
+    return _post_transform(node, scores + jnp.asarray(base))

@@ -277,6 +277,7 @@ class Node:
     outputs: List[str] = field(default_factory=list)
     name: str = ""
     attrs: Dict[str, Attribute] = field(default_factory=dict)
+    domain: str = ""              # NodeProto field 7 (e.g. "ai.onnx.ml")
 
     def attr(self, name: str, default: Any = None) -> Any:
         a = self.attrs.get(name)
@@ -297,6 +298,8 @@ class Node:
             elif fnum == 5:
                 a = Attribute.parse(val)
                 n.attrs[a.name] = a
+            elif fnum == 7:
+                n.domain = val.decode()
         return n
 
     def encode(self) -> bytes:
@@ -309,6 +312,8 @@ class Node:
         _emit(out, 4, 2, self.op_type.encode())
         for a in self.attrs.values():
             _emit(out, 5, 2, a.encode())
+        if self.domain:
+            _emit(out, 7, 2, self.domain.encode())
         return bytes(out)
 
 
@@ -408,6 +413,7 @@ class Model:
     opset: int = 17
     producer_name: str = ""   # ModelProto field 2 (e.g. "pytorch" — lets
                               # tests prove a fixture came from a third party)
+    ml_opset: Optional[int] = None   # ai.onnx.ml domain version, when used
 
     @staticmethod
     def parse(data: bytes) -> "Model":
@@ -419,10 +425,20 @@ class Model:
                 m.producer_name = bytes(val).decode("utf-8", "replace")
             elif fnum == 7:
                 m.graph = Graph.parse(val)
-            elif fnum == 8:  # OperatorSetIdProto
+            elif fnum == 8:  # OperatorSetIdProto: (domain, version)
+                dom, ver = "", None
                 for f2, _, v2 in _fields(val):
-                    if f2 == 2:
-                        m.opset = _signed(v2)
+                    if f2 == 1:
+                        dom = bytes(v2).decode("utf-8", "replace")
+                    elif f2 == 2:
+                        ver = _signed(v2)
+                if ver is not None:
+                    # a domain'd entry (ai.onnx.ml) must not clobber the
+                    # default-domain opset (onnxmltools graphs carry both)
+                    if dom in ("", "ai.onnx"):
+                        m.opset = ver
+                    elif dom == "ai.onnx.ml":
+                        m.ml_opset = ver
         return m
 
     @staticmethod
@@ -437,6 +453,11 @@ class Model:
         _emit(opset, 1, 2, b"")  # default domain
         _emit(opset, 2, 0, self.opset)
         _emit(out, 8, 2, bytes(opset))
+        if self.ml_opset is not None:
+            mlset = bytearray()
+            _emit(mlset, 1, 2, b"ai.onnx.ml")
+            _emit(mlset, 2, 0, self.ml_opset)
+            _emit(out, 8, 2, bytes(mlset))
         _emit(out, 7, 2, self.graph.encode())
         return bytes(out)
 

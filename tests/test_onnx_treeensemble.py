@@ -1,0 +1,228 @@
+"""GBDT → ONNX TreeEnsemble serving path.
+
+The reference's documented LightGBM-serving-via-ONNX workflow is
+onnxmltools.convert_lightgbm → ONNXModel (website Quickstart - ONNX Model
+Inference). Here the converter (onnx/treeensemble.py) and the ai.onnx.ml
+executor ops (onnx/ops.py) are validated against the Booster's own
+predictions — probabilities must match bit-for-tolerance, including NaN
+routing through the learned default directions.
+"""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.gbdt import BoosterConfig, Dataset, train_booster
+from synapseml_tpu.onnx.importer import OnnxFunction
+from synapseml_tpu.onnx.model import ONNXModel
+from synapseml_tpu.onnx.protoio import Model
+from synapseml_tpu.onnx.treeensemble import booster_to_onnx
+
+
+def _data(n=1500, f=6, seed=0, classes=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    margin = X[:, 0] * X[:, 1] + 0.7 * X[:, 2]
+    if classes == 2:
+        y = (margin > 0).astype(np.float32)
+    else:
+        y = np.digitize(margin, np.quantile(
+            margin, np.linspace(0, 1, classes + 1)[1:-1])).astype(np.float32)
+    return X, y
+
+
+def _run(model: Model, X: np.ndarray):
+    raw = model.encode()
+    m2 = Model.parse(raw)            # full wire round-trip, never in-memory
+    fn = OnnxFunction(m2)
+    return fn({fn.graph_inputs[0]: X})
+
+
+class TestBinary:
+    def test_probabilities_match_predict(self):
+        X, y = _data()
+        b = train_booster(Dataset(X, y), None,
+                          BoosterConfig(objective="binary",
+                                        num_iterations=12, num_leaves=15))
+        out = _run(booster_to_onnx(b), X)
+        np.testing.assert_allclose(np.asarray(out["probabilities"])[:, 1],
+                                   b.predict(X), rtol=2e-4, atol=2e-5)
+        want_label = (b.predict(X) > 0.5).astype(np.int64)
+        assert (np.asarray(out["label"]) == want_label).mean() > 0.999
+
+    def test_nan_routing_matches(self):
+        X, y = _data()
+        Xn = X.copy()
+        Xn[::7, 0] = np.nan
+        Xn[::11, 2] = np.nan
+        b = train_booster(Dataset(Xn, y), None,
+                          BoosterConfig(objective="binary",
+                                        num_iterations=8, num_leaves=15))
+        out = _run(booster_to_onnx(b), Xn)
+        np.testing.assert_allclose(np.asarray(out["probabilities"])[:, 1],
+                                   b.predict(Xn), rtol=2e-4, atol=2e-5)
+
+
+class TestMulticlass:
+    def test_probabilities_match_predict(self):
+        X, y = _data(classes=3)
+        b = train_booster(Dataset(X, y), None,
+                          BoosterConfig(objective="multiclass", num_class=3,
+                                        num_iterations=6, num_leaves=7))
+        out = _run(booster_to_onnx(b), X)
+        np.testing.assert_allclose(np.asarray(out["probabilities"]),
+                                   b.predict(X), rtol=2e-4, atol=2e-5)
+
+
+class TestRegression:
+    def test_raw_output_matches(self):
+        X, _ = _data()
+        yr = (X[:, 0] * 2 + X[:, 1]).astype(np.float32)
+        b = train_booster(Dataset(X, yr), None,
+                          BoosterConfig(objective="regression",
+                                        num_iterations=10, num_leaves=15))
+        out = _run(booster_to_onnx(b), X)
+        np.testing.assert_allclose(np.asarray(out["variable"])[:, 0],
+                                   b.predict(X), rtol=2e-4, atol=1e-4)
+
+
+class TestServingIntegration:
+    def test_onnxmodel_transform(self):
+        """The converted graph serves through ONNXModel like any deep
+        model (the reference workflow's endpoint)."""
+        from synapseml_tpu.core.table import Table
+
+        X, y = _data(n=400)
+        b = train_booster(Dataset(X, y), None,
+                          BoosterConfig(objective="binary",
+                                        num_iterations=5, num_leaves=7))
+        m = booster_to_onnx(b)
+        stage = (ONNXModel()
+                 .setModelPayload(m.encode())
+                 .setFeedDict({"input": "features"})
+                 .setFetchDict({"probs": "probabilities"})
+                 .setMiniBatchSize(128))
+        out = stage.transform(Table({"features": list(X)}))
+        got = np.stack([np.asarray(r) for r in out["probs"]])
+        np.testing.assert_allclose(got[:, 1], b.predict(X),
+                                   rtol=2e-4, atol=2e-5)
+
+
+class TestSigmoidAndOva:
+    def test_binary_sigmoid_param_folded(self):
+        """cfg.sigmoid scales the raw score before the link; the converter
+        folds it into leaf weights (code-review r4 finding)."""
+        X, y = _data(n=600)
+        b = train_booster(Dataset(X, y), None,
+                          BoosterConfig(objective="binary", sigmoid=2.0,
+                                        num_iterations=5, num_leaves=7))
+        out = _run(booster_to_onnx(b), X)
+        np.testing.assert_allclose(np.asarray(out["probabilities"])[:, 1],
+                                   b.predict(X), rtol=2e-4, atol=2e-5)
+
+    def test_multiclassova_uses_logistic(self):
+        """ova applies UNNORMALIZED per-class sigmoid — SOFTMAX would
+        silently renormalize (code-review r4 finding)."""
+        X, y = _data(n=900, classes=3)
+        b = train_booster(Dataset(X, y), None,
+                          BoosterConfig(objective="multiclassova",
+                                        num_class=3, sigmoid=1.5,
+                                        num_iterations=4, num_leaves=7))
+        out = _run(booster_to_onnx(b), X)
+        np.testing.assert_allclose(np.asarray(out["probabilities"]),
+                                   b.predict(X), rtol=2e-4, atol=2e-5)
+
+
+class TestThirdPartyShapes:
+    def test_binary_single_column_softmax_expansion(self):
+        """onnxmltools-style binary graphs: one weight column, 2 labels.
+        Softmax-family transforms must expand [-s, s] BEFORE the transform
+        (a single-column softmax is identically 1 — code-review r4)."""
+        from synapseml_tpu.onnx.protoio import Attribute, Graph, Node
+        from synapseml_tpu.onnx.treeensemble import _strs_attr, _vi
+        from synapseml_tpu.onnx.modelgen import _attr
+
+        # one stump: x0 <= 0 -> leaf weight -1.2 else +0.8
+        attrs = {
+            "nodes_treeids": _attr("nodes_treeids", [0, 0, 0]),
+            "nodes_nodeids": _attr("nodes_nodeids", [0, 1, 2]),
+            "nodes_featureids": _attr("nodes_featureids", [0, 0, 0]),
+            "nodes_values": Attribute(name="nodes_values", type=6,
+                                      floats=[0.0, 0.0, 0.0]),
+            "nodes_modes": _strs_attr("nodes_modes",
+                                      ["BRANCH_LEQ", "LEAF", "LEAF"]),
+            "nodes_truenodeids": _attr("nodes_truenodeids", [1, 1, 2]),
+            "nodes_falsenodeids": _attr("nodes_falsenodeids", [2, 1, 2]),
+            "classlabels_int64s": _attr("classlabels_int64s", [0, 1]),
+            "class_treeids": _attr("class_treeids", [0, 0]),
+            "class_nodeids": _attr("class_nodeids", [1, 2]),
+            "class_ids": _attr("class_ids", [0, 0]),
+            "class_weights": Attribute(name="class_weights", type=6,
+                                       floats=[-1.2, 0.8]),
+            "post_transform": _attr("post_transform", "SOFTMAX"),
+        }
+        node = Node(op_type="TreeEnsembleClassifier", inputs=["input"],
+                    outputs=["label", "probabilities"], attrs=attrs)
+        node.domain = "ai.onnx.ml"
+        m = Model(graph=Graph(
+            nodes=[node], initializers={},
+            inputs=[_vi("input", ["N", 1])],
+            outputs=[_vi("label", ["N"]), _vi("probabilities", ["N", 2])]),
+            opset=17, ml_opset=3)
+        X = np.asarray([[-1.0], [1.0]], np.float32)
+        out = _run(m, X)
+        z = np.asarray(out["probabilities"])
+        # softmax([-s, s]) = sigmoid(2s)
+        want1 = 1.0 / (1.0 + np.exp(-2 * np.asarray([-1.2, 0.8])))
+        np.testing.assert_allclose(z[:, 1], want1, rtol=1e-5)
+        assert not np.allclose(z[:, 1], 1.0)   # the collapse this test pins
+
+    def test_softmax_zero_excludes_zero_entries(self):
+        import jax.numpy as jnp
+
+        from synapseml_tpu.onnx.ops import _post_transform
+        from synapseml_tpu.onnx.protoio import Node
+        from synapseml_tpu.onnx.modelgen import _attr
+
+        node = Node(op_type="TreeEnsembleClassifier",
+                    attrs={"post_transform": _attr("post_transform",
+                                                   "SOFTMAX_ZERO")})
+        z = np.asarray(_post_transform(node, jnp.asarray(
+            [[0.0, 1.2, 0.8]], np.float32)))
+        e = np.exp([1.2, 0.8])
+        np.testing.assert_allclose(z[0], [0.0, e[0] / e.sum(),
+                                          e[1] / e.sum()], rtol=1e-5)
+
+
+class TestEdgeCases:
+    def test_single_leaf_trees(self):
+        """Constant-label data yields no splits; the converter must emit
+        valid single-LEAF trees."""
+        X = np.random.default_rng(0).normal(size=(200, 4)).astype(np.float32)
+        yr = np.full(200, 3.25, np.float32)
+        b = train_booster(Dataset(X, yr), None,
+                          BoosterConfig(objective="regression",
+                                        num_iterations=3))
+        out = _run(booster_to_onnx(b), X[:16])
+        np.testing.assert_allclose(np.asarray(out["variable"])[:, 0],
+                                   b.predict(X[:16]), rtol=1e-4, atol=1e-4)
+
+    def test_rf_rejected(self):
+        X, y = _data(n=300)
+        b = train_booster(Dataset(X, y), None,
+                          BoosterConfig(objective="binary",
+                                        boosting_type="rf",
+                                        bagging_fraction=0.8, bagging_freq=1,
+                                        num_iterations=4))
+        with pytest.raises(NotImplementedError, match="average_output"):
+            booster_to_onnx(b)
+
+    def test_ml_opset_round_trips(self):
+        X, y = _data(n=300)
+        b = train_booster(Dataset(X, y), None,
+                          BoosterConfig(objective="binary",
+                                        num_iterations=3))
+        m = booster_to_onnx(b)
+        m2 = Model.parse(m.encode())
+        assert m2.ml_opset == 3
+        assert m2.opset == 17          # domain'd entry must not clobber it
+        assert m2.graph.nodes[0].domain == "ai.onnx.ml"

@@ -1265,3 +1265,147 @@ def _tree_regressor(node, X):
     else:
         raise ValueError(f"TreeEnsembleRegressor aggregate {agg!r}")
     return _post_transform(node, scores + jnp.asarray(base))
+
+
+# --- quantized inference (QDQ + QLinear + integer ops) ----------------------
+# The reference executes quantized graphs through onnxruntime's int8 kernels
+# (ONNXRuntime.scala sessions). On TPU, int8 buys nothing over bf16 on the
+# MXU, so the faithful-and-fast strategy is dequantize -> float op ->
+# requantize: numerically the standard QDQ reference semantics (the spec
+# defines QLinear* ops BY that decomposition), with the float math riding
+# the existing Conv/MatMul impls.
+
+def _qparams(scale, zp):
+    """Broadcastable (scale, zero_point) as f32 — per-tensor scalars or
+    per-axis 1-D vectors (caller reshapes for the axis)."""
+    jnp = _jnp()
+    return jnp.asarray(scale, jnp.float32), jnp.asarray(zp, jnp.float32)
+
+
+def _axis_shape(v, ndim, axis):
+    if getattr(v, "ndim", 0) == 1 and v.shape[0] > 1:
+        shape = [1] * ndim
+        shape[axis] = v.shape[0]
+        return v.reshape(shape)
+    return v
+
+
+def _dequant(x, scale, zp, axis, ndim=None):
+    jnp = _jnp()
+    s, z = _qparams(scale, zp)
+    ndim = ndim if ndim is not None else x.ndim
+    s = _axis_shape(s, ndim, axis)
+    z = _axis_shape(z, ndim, axis)
+    return (x.astype(jnp.float32) - z) * s
+
+
+def _quant(x, scale, zp, axis, dtype):
+    jnp = _jnp()
+    s, z = _qparams(scale, zp)
+    s = _axis_shape(s, x.ndim, axis)
+    z = _axis_shape(z, x.ndim, axis)
+    info = np.iinfo(dtype)
+    q = jnp.clip(jnp.round(x / s) + z, info.min, info.max)
+    return q.astype(dtype)
+
+
+@op("DequantizeLinear")
+def _dequantize_linear(node, x, scale, zp=None):
+    if zp is None:
+        zp = np.zeros((), np.int32)
+    return _dequant(x, scale, zp, node.attr("axis", 1))
+
+
+@op("QuantizeLinear")
+def _quantize_linear(node, x, scale, zp=None):
+    # zp may be graph-computed (a tracer under jit): read .dtype directly,
+    # never np.asarray
+    dtype = np.uint8 if zp is None else zp.dtype
+    if zp is None:
+        zp = np.zeros((), np.uint8)
+    return _quant(x, scale, zp, node.attr("axis", 1), dtype)
+
+
+@op("DynamicQuantizeLinear")
+def _dynamic_quantize_linear(node, x):
+    """uint8 dynamic quantization (spec formula: range always spans 0)."""
+    jnp = _jnp()
+    xmin = jnp.minimum(x.min(), 0.0)
+    xmax = jnp.maximum(x.max(), 0.0)
+    scale = (xmax - xmin) / 255.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    zp = jnp.clip(jnp.round(-xmin / scale), 0, 255)
+    q = jnp.clip(jnp.round(x / scale) + zp, 0, 255).astype(jnp.uint8)
+    return q, scale.astype(jnp.float32), zp.astype(jnp.uint8)
+
+
+@op("QLinearConv")
+def _qlinear_conv(node, x, xs, xzp, w, ws, wzp, ys, yzp, b=None):
+    jnp = _jnp()
+    xf = _dequant(x, xs, xzp, 1)
+    wf = _dequant(w, ws, wzp, 0)          # weight quant axis = output chan
+    out = _conv(node, xf, wf)
+    if b is not None:
+        # bias is int32 with scale xs*ws (spec), zero_point 0
+        bs = (jnp.asarray(xs, jnp.float32)
+              * jnp.asarray(ws, jnp.float32).reshape(-1))
+        bf = b.astype(jnp.float32) * bs
+        out = out + bf.reshape((1, -1) + (1,) * (out.ndim - 2))
+    return _quant(out, ys, yzp, 1,
+                  yzp.dtype if hasattr(yzp, "dtype") else np.uint8)
+
+
+@op("QLinearMatMul")
+def _qlinear_matmul(node, a, as_, azp, b, bs, bzp, ys, yzp):
+    # 1-D a-side params are per-ROW (axis ndim-2); b-side per-COLUMN
+    af = _dequant(a, as_, azp, a.ndim - 2)
+    bf = _dequant(b, bs, bzp, b.ndim - 1)
+    out = af @ bf
+    return _quant(out, ys, yzp, out.ndim - 1,
+                  yzp.dtype if hasattr(yzp, "dtype") else np.uint8)
+
+
+def _int_shift(v, zp, axis):
+    """v - zero_point in int32 (exact integer arithmetic, spec-required:
+    f32 accumulation rounds past 2^24, which BERT-sized K exceeds); a 1-D
+    zero point broadcasts along ``axis``."""
+    jnp = _jnp()
+    out = v.astype(jnp.int32)
+    if zp is None:
+        return out
+    z = jnp.asarray(zp, jnp.int32)
+    return out - _axis_shape(z, v.ndim, axis)
+
+
+@op("MatMulInteger")
+def _matmul_integer(node, a, b, azp=None, bzp=None):
+    # a-side 1-D zero point is per-ROW, b-side per-COLUMN (spec)
+    ai = _int_shift(a, azp, a.ndim - 2)
+    bi = _int_shift(b, bzp, b.ndim - 1)
+    return ai @ bi                         # int32 matmul: exact
+
+
+@op("ConvInteger")
+def _conv_integer(node, x, w, xzp=None, wzp=None):
+    import jax
+
+    jnp = _jnp()
+    xi = _int_shift(x, xzp, 1)             # per-input-channel
+    wi = _int_shift(w, wzp, 0)             # per-output-channel
+    spatial = x.ndim - 2
+    strides = node.attr("strides", [1] * spatial)
+    dil = node.attr("dilations", [1] * spatial)
+    groups = node.attr("group", 1)
+    pads, auto = _conv_pads(node, spatial)
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        pads = _same_pads(x.shape[2:], w.shape[2:], strides, dil,
+                          lower=(auto == "SAME_LOWER"))
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if spatial == 2 else
+        ("NCW", "OIW", "NCW") if spatial == 1 else
+        ("NCDHW", "OIDHW", "NCDHW"))
+    return jax.lax.conv_general_dilated(
+        xi, wi, window_strides=strides, padding=pads, rhs_dilation=dil,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.int32)

@@ -1,0 +1,215 @@
+"""Quantized ONNX inference ops (QDQ / QLinear / integer family).
+
+The reference serves quantized graphs through onnxruntime's int8 kernels;
+here they dequantize to float and ride the MXU (int8 buys nothing over bf16
+on TPU). Semantics are pinned against the ONNX spec formulas computed in
+numpy — per-tensor and per-axis scales, zero points, saturation, and the
+QLinear decomposition identity.
+"""
+
+import numpy as np
+
+from synapseml_tpu.onnx.importer import OnnxFunction
+from synapseml_tpu.onnx.modelgen import _attr, _vi
+from synapseml_tpu.onnx.protoio import Graph, Model, Node, Tensor
+
+
+def _model(nodes, inputs, outputs, inits=None):
+    return Model(graph=Graph(
+        nodes=nodes, initializers=inits or {},
+        inputs=inputs, outputs=outputs, name="q"), opset=17)
+
+
+def _run(model, feeds):
+    m = Model.parse(model.encode())
+    fn = OnnxFunction(m)
+    return fn(feeds)
+
+
+class TestQDQ:
+    def test_dequantize_per_tensor(self):
+        x = np.asarray([[0, 128, 255]], np.uint8)
+        n = Node(op_type="DequantizeLinear", inputs=["x", "s", "z"],
+                 outputs=["y"])
+        m = _model([n], [_vi("x", [1, 3])], [_vi("y", [1, 3])],
+                   {"s": Tensor.from_array("s", np.float32(0.5)),
+                    "z": Tensor.from_array("z", np.uint8(128))})
+        out = _run(m, {"x": x})
+        np.testing.assert_allclose(np.asarray(out["y"]),
+                                   (x.astype(np.float32) - 128) * 0.5)
+
+    def test_quantize_saturates(self):
+        x = np.asarray([[-1000.0, 0.0, 1000.0]], np.float32)
+        n = Node(op_type="QuantizeLinear", inputs=["x", "s", "z"],
+                 outputs=["y"])
+        m = _model([n], [_vi("x", [1, 3])], [_vi("y", [1, 3])],
+                   {"s": Tensor.from_array("s", np.float32(1.0)),
+                    "z": Tensor.from_array("z", np.int8(0))})
+        out = _run(m, {"x": x})
+        got = np.asarray(out["y"])
+        assert got.dtype == np.int8
+        np.testing.assert_array_equal(got, [[-128, 0, 127]])
+
+    def test_per_axis_dequantize(self):
+        x = np.arange(6, dtype=np.uint8).reshape(2, 3)
+        s = np.asarray([0.5, 2.0], np.float32)       # axis 0
+        z = np.asarray([1, 2], np.uint8)
+        n = Node(op_type="DequantizeLinear", inputs=["x", "s", "z"],
+                 outputs=["y"], attrs={"axis": _attr("axis", 0)})
+        m = _model([n], [_vi("x", [2, 3])], [_vi("y", [2, 3])],
+                   {"s": Tensor.from_array("s", s),
+                    "z": Tensor.from_array("z", z)})
+        out = _run(m, {"x": x})
+        want = (x.astype(np.float32) - z[:, None]) * s[:, None]
+        np.testing.assert_allclose(np.asarray(out["y"]), want)
+
+    def test_dynamic_quantize(self):
+        x = np.asarray([[-1.0, 0.0, 2.0, 3.0]], np.float32)
+        n = Node(op_type="DynamicQuantizeLinear", inputs=["x"],
+                 outputs=["y", "ys", "yzp"])
+        m = _model([n], [_vi("x", [1, 4])],
+                   [_vi("y", [1, 4]), _vi("ys", []), _vi("yzp", [])])
+        out = _run(m, {"x": x})
+        scale = float(np.asarray(out["ys"]))
+        zp = float(np.asarray(out["yzp"]))
+        assert abs(scale - 4.0 / 255) < 1e-6
+        got = (np.asarray(out["y"]).astype(np.float32) - zp) * scale
+        np.testing.assert_allclose(got, x, atol=scale)
+
+
+class TestQLinear:
+    def test_qlinear_matmul_matches_decomposition(self):
+        rng = np.random.default_rng(0)
+        a = rng.integers(0, 255, (4, 8)).astype(np.uint8)
+        b = rng.integers(0, 255, (8, 3)).astype(np.uint8)
+        a_s, a_z = np.float32(0.02), np.uint8(120)
+        b_s, b_z = np.float32(0.05), np.uint8(130)
+        y_s, y_z = np.float32(0.1), np.uint8(128)
+        n = Node(op_type="QLinearMatMul",
+                 inputs=["a", "as", "az", "b", "bs", "bz", "ys", "yz"],
+                 outputs=["y"])
+        inits = {"as": Tensor.from_array("as", a_s),
+                 "az": Tensor.from_array("az", a_z),
+                 "b": Tensor.from_array("b", b),
+                 "bs": Tensor.from_array("bs", b_s),
+                 "bz": Tensor.from_array("bz", b_z),
+                 "ys": Tensor.from_array("ys", y_s),
+                 "yz": Tensor.from_array("yz", y_z)}
+        m = _model([n], [_vi("a", [4, 8])], [_vi("y", [4, 3])], inits)
+        out = _run(m, {"a": a})
+        af = (a.astype(np.float32) - 120) * 0.02
+        bf = (b.astype(np.float32) - 130) * 0.05
+        want = np.clip(np.round((af @ bf) / 0.1) + 128, 0, 255)
+        assert np.asarray(out["y"]).dtype == np.uint8
+        got = np.asarray(out["y"]).astype(np.float64)
+        assert np.abs(got - want).max() <= 1     # round-at-half ties
+
+    def test_qlinear_conv(self):
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 255, (1, 2, 5, 5)).astype(np.uint8)
+        w = rng.integers(0, 255, (3, 2, 3, 3)).astype(np.uint8)
+        bias = rng.integers(-100, 100, (3,)).astype(np.int32)
+        x_s, x_z = np.float32(0.03), np.uint8(128)
+        w_s, w_z = np.float32(0.01), np.uint8(127)
+        y_s, y_z = np.float32(0.2), np.uint8(128)
+        n = Node(op_type="QLinearConv",
+                 inputs=["x", "xs", "xz", "w", "ws", "wz", "ys", "yz", "b"],
+                 outputs=["y"],
+                 attrs={"pads": _attr("pads", [1, 1, 1, 1])})
+        inits = {"xs": Tensor.from_array("xs", x_s),
+                 "xz": Tensor.from_array("xz", x_z),
+                 "w": Tensor.from_array("w", w),
+                 "ws": Tensor.from_array("ws", w_s),
+                 "wz": Tensor.from_array("wz", w_z),
+                 "ys": Tensor.from_array("ys", y_s),
+                 "yz": Tensor.from_array("yz", y_z),
+                 "b": Tensor.from_array("b", bias)}
+        m = _model([n], [_vi("x", [1, 2, 5, 5])], [_vi("y", [1, 3, 5, 5])],
+                   inits)
+        out = _run(m, {"x": x})
+        # numpy reference: dequantize, correlate, add scaled bias, requantize
+        import scipy.signal as sp
+
+        xf = (x.astype(np.float32) - 128) * 0.03
+        wf = (w.astype(np.float32) - 127) * 0.01
+        ref = np.zeros((1, 3, 5, 5), np.float32)
+        for o in range(3):
+            for c in range(2):
+                ref[0, o] += sp.correlate2d(xf[0, c], wf[o, c], mode="same")
+            ref[0, o] += bias[o] * 0.03 * 0.01
+        want = np.clip(np.round(ref / 0.2) + 128, 0, 255)
+        got = np.asarray(out["y"]).astype(np.float64)
+        assert (np.abs(got - want) <= 1).mean() > 0.99
+
+
+class TestInteger:
+    def test_matmul_integer(self):
+        rng = np.random.default_rng(2)
+        a = rng.integers(0, 255, (3, 6)).astype(np.uint8)
+        b = rng.integers(-128, 127, (6, 4)).astype(np.int8)
+        n = Node(op_type="MatMulInteger", inputs=["a", "b", "az", "bz"],
+                 outputs=["y"])
+        inits = {"b": Tensor.from_array("b", b),
+                 "az": Tensor.from_array("az", np.uint8(100)),
+                 "bz": Tensor.from_array("bz", np.int8(-5))}
+        m = _model([n], [_vi("a", [3, 6])], [_vi("y", [3, 4])], inits)
+        out = _run(m, {"a": a})
+        want = ((a.astype(np.int64) - 100) @ (b.astype(np.int64) + 5))
+        np.testing.assert_array_equal(np.asarray(out["y"]), want)
+
+    def test_matmul_integer_per_row_zero_point(self):
+        """1-D a_zero_point is per-ROW (spec) — broadcast on axis M, not K
+        (code-review r4 finding)."""
+        rng = np.random.default_rng(4)
+        a = rng.integers(0, 255, (3, 6)).astype(np.uint8)
+        b = rng.integers(-128, 127, (6, 4)).astype(np.int8)
+        azp = np.asarray([10, 20, 30], np.uint8)
+        n = Node(op_type="MatMulInteger", inputs=["a", "b", "az"],
+                 outputs=["y"])
+        inits = {"b": Tensor.from_array("b", b),
+                 "az": Tensor.from_array("az", azp)}
+        m = _model([n], [_vi("a", [3, 6])], [_vi("y", [3, 4])], inits)
+        out = _run(m, {"a": a})
+        want = ((a.astype(np.int64) - azp[:, None].astype(np.int64))
+                @ b.astype(np.int64))
+        np.testing.assert_array_equal(np.asarray(out["y"]), want)
+
+    def test_conv_integer_per_channel_weight_zero_point(self):
+        rng = np.random.default_rng(5)
+        x = rng.integers(0, 20, (1, 1, 4, 4)).astype(np.uint8)
+        w = rng.integers(0, 10, (2, 1, 2, 2)).astype(np.uint8)
+        wzp = np.asarray([1, 3], np.uint8)
+        n = Node(op_type="ConvInteger", inputs=["x", "w", "xz", "wz"],
+                 outputs=["y"])
+        inits = {"w": Tensor.from_array("w", w),
+                 "xz": Tensor.from_array("xz", np.uint8(0)),
+                 "wz": Tensor.from_array("wz", wzp)}
+        m = _model([n], [_vi("x", [1, 1, 4, 4])], [_vi("y", [1, 2, 3, 3])],
+                   inits)
+        out = _run(m, {"x": x})
+        for o in range(2):
+            wf = w[o, 0].astype(np.int64) - int(wzp[o])
+            for i in range(3):
+                for j in range(3):
+                    want = (x[0, 0, i:i + 2, j:j + 2].astype(np.int64)
+                            * wf).sum()
+                    assert np.asarray(out["y"])[0, o, i, j] == want
+
+    def test_conv_integer(self):
+        rng = np.random.default_rng(3)
+        x = rng.integers(0, 20, (1, 1, 4, 4)).astype(np.uint8)
+        w = rng.integers(0, 10, (1, 1, 2, 2)).astype(np.uint8)
+        n = Node(op_type="ConvInteger", inputs=["x", "w", "xz"],
+                 outputs=["y"])
+        inits = {"w": Tensor.from_array("w", w),
+                 "xz": Tensor.from_array("xz", np.uint8(5))}
+        m = _model([n], [_vi("x", [1, 1, 4, 4])], [_vi("y", [1, 1, 3, 3])],
+                   inits)
+        out = _run(m, {"x": x})
+        xf = x.astype(np.int64) - 5
+        want = np.zeros((3, 3), np.int64)
+        for i in range(3):
+            for j in range(3):
+                want[i, j] = (xf[0, 0, i:i + 2, j:j + 2]
+                              * w[0, 0].astype(np.int64)).sum()
+        np.testing.assert_array_equal(np.asarray(out["y"])[0, 0], want)

@@ -330,7 +330,7 @@ def _same_pads(in_sizes, kernel, strides, dils, lower: bool):
 
 
 @op("Conv")
-def _conv(node, x, w, b=None):
+def _conv(node, x, w, b=None, *, preferred=None):
     import jax
 
     jnp = _jnp()
@@ -352,7 +352,7 @@ def _conv(node, x, w, b=None):
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=padding,
         rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32)
+        preferred_element_type=preferred or jnp.float32)
     if b is not None:
         out = out + b.reshape((1, -1) + (1,) * spatial)
     return out
@@ -1387,25 +1387,9 @@ def _matmul_integer(node, a, b, azp=None, bzp=None):
 
 @op("ConvInteger")
 def _conv_integer(node, x, w, xzp=None, wzp=None):
-    import jax
-
     jnp = _jnp()
     xi = _int_shift(x, xzp, 1)             # per-input-channel
     wi = _int_shift(w, wzp, 0)             # per-output-channel
-    spatial = x.ndim - 2
-    strides = node.attr("strides", [1] * spatial)
-    dil = node.attr("dilations", [1] * spatial)
-    groups = node.attr("group", 1)
-    pads, auto = _conv_pads(node, spatial)
-    if auto in ("SAME_UPPER", "SAME_LOWER"):
-        pads = _same_pads(x.shape[2:], w.shape[2:], strides, dil,
-                          lower=(auto == "SAME_LOWER"))
-    dn = jax.lax.conv_dimension_numbers(
-        x.shape, w.shape,
-        ("NCHW", "OIHW", "NCHW") if spatial == 2 else
-        ("NCW", "OIW", "NCW") if spatial == 1 else
-        ("NCDHW", "OIDHW", "NCDHW"))
-    return jax.lax.conv_general_dilated(
-        xi, wi, window_strides=strides, padding=pads, rhs_dilation=dil,
-        dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.int32)
+    # one conv lowering (_conv) for float and integer: int32 accumulation
+    # via preferred_element_type keeps the spec-exact arithmetic
+    return _conv(node, xi, wi, preferred=jnp.int32)

@@ -1393,3 +1393,240 @@ def _conv_integer(node, x, w, xzp=None, wzp=None):
     # one conv lowering (_conv) for float and integer: int32 accumulation
     # via preferred_element_type keeps the spec-exact arithmetic
     return _conv(node, xi, wi, preferred=jnp.int32)
+
+
+# --- scatter/gather family + detection ops ---------------------------------
+
+@op("IsNaN")
+def _isnan(node, x):
+    return _jnp().isnan(x)
+
+
+@op("IsInf")
+def _isinf(node, x):
+    jnp = _jnp()
+    pos = bool(node.attr("detect_positive", 1))
+    neg = bool(node.attr("detect_negative", 1))
+    return ((jnp.isposinf(x) & pos) | (jnp.isneginf(x) & neg))
+
+
+@op("Sign")
+def _sign(node, x):
+    return _jnp().sign(x)
+
+
+@op("ReduceLogSumExp")
+def _rlogsumexp(node, x, *rest):
+    import jax
+
+    keep = bool(node.attr("keepdims", 1))
+    return jax.scipy.special.logsumexp(x, axis=_axes(node, rest, x.ndim),
+                                       keepdims=keep)
+
+
+@op("GatherElements")
+def _gather_elements(node, x, idx):
+    jnp = _jnp()
+    axis = node.attr("axis", 0) % x.ndim
+    idx = jnp.where(idx < 0, idx + x.shape[axis], idx)
+    return jnp.take_along_axis(x, idx.astype(jnp.int64), axis=axis)
+
+
+@op("ScatterElements")
+def _scatter_elements(node, x, idx, updates):
+    jnp = _jnp()
+    x = jnp.asarray(x)            # graph inputs may arrive as numpy: .at
+    axis = node.attr("axis", 0) % x.ndim
+    red = node.attr("reduction", "none")
+    red = red if isinstance(red, str) else red.decode()
+    idx = jnp.where(idx < 0, idx + x.shape[axis], idx).astype(jnp.int64)
+    # build full index grids: every element of `updates` lands at the same
+    # multi-index as its position, except along `axis` where idx rules
+    grids = list(jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
+                              indexing="ij"))
+    grids[axis] = idx
+    return _scatter_reduce(x.at[tuple(grids)], updates, red,
+                           "ScatterElements")
+
+
+def _scatter_reduce(ref, updates, red, op_name):
+    if red == "none":
+        return ref.set(updates)
+    if red == "add":
+        return ref.add(updates)
+    if red == "mul":
+        return ref.multiply(updates)
+    if red == "max":
+        return ref.max(updates)
+    if red == "min":
+        return ref.min(updates)
+    raise ValueError(f"{op_name} reduction {red!r}")
+
+
+@op("GatherND")
+def _gather_nd(node, x, idx):
+    b = int(node.attr("batch_dims", 0))
+    if b:
+        raise ValueError("GatherND: batch_dims > 0 not supported yet")
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    return x[flat_idx]
+
+
+@op("ScatterND")
+def _scatter_nd(node, x, idx, updates):
+    jnp = _jnp()
+    x = jnp.asarray(x)            # graph inputs may arrive as numpy: .at
+    red = node.attr("reduction", "none")
+    red = red if isinstance(red, str) else red.decode()
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    return _scatter_reduce(x.at[flat_idx], updates, red, "ScatterND")
+
+
+@op("RoiAlign")
+def _roi_align(node, x, rois, batch_indices):
+    """(num_rois, C, oh, ow) bilinear ROI pooling (Mask R-CNN family).
+    Supports output_height/width, spatial_scale, sampling_ratio and both
+    coordinate_transformation_modes (half_pixel / output_half_pixel).
+
+    Documented deviation (static shapes under jit): sampling_ratio=0, which
+    the spec defines as the ADAPTIVE ceil(roi_size/output_size) samples per
+    bin, uses the static upper bound ceil(map_size/output_size) instead —
+    more samples at shifted positions than ORT for small ROIs. Export with
+    an explicit sampling_ratio for bit-matched parity."""
+    jnp = _jnp()
+    oh = int(node.attr("output_height", 1))
+    ow = int(node.attr("output_width", 1))
+    scale = float(node.attr("spatial_scale", 1.0))
+    sr = int(node.attr("sampling_ratio", 0))
+    mode = node.attr("mode", "avg")
+    mode = mode if isinstance(mode, str) else mode.decode()
+    ctm = node.attr("coordinate_transformation_mode", "half_pixel")
+    ctm = ctm if isinstance(ctm, str) else ctm.decode()
+    offset = 0.5 if ctm == "half_pixel" else 0.0
+    x = jnp.asarray(x, jnp.float32)   # vmap's traced batch_index needs jnp
+    N, C, H, W = x.shape
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = (roi * scale) - offset
+        rh, rw = y2 - y1, x2 - x1
+        if ctm != "half_pixel":
+            # the min-size-1 clamp is the LEGACY (output_half_pixel) rule;
+            # half_pixel mode uses the true ROI extent (ONNX spec)
+            rh = jnp.maximum(rh, 1.0)
+            rw = jnp.maximum(rw, 1.0)
+        bh, bw = rh / oh, rw / ow
+        s_h = sr if sr > 0 else int(np.ceil(H / oh))
+        s_w = sr if sr > 0 else int(np.ceil(W / ow))
+        # sample grid: s_h x s_w points per output cell
+        iy = (y1 + (jnp.arange(oh)[:, None] + (jnp.arange(s_h)[None, :]
+              + 0.5) / s_h) * bh).reshape(-1)          # (oh*s_h,)
+        ix = (x1 + (jnp.arange(ow)[:, None] + (jnp.arange(s_w)[None, :]
+              + 0.5) / s_w) * bw).reshape(-1)          # (ow*s_w,)
+
+        def bilinear(img, yy, xx):
+            yy = jnp.clip(yy, 0.0, H - 1)
+            xx = jnp.clip(xx, 0.0, W - 1)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1_ = jnp.minimum(y0 + 1, H - 1)
+            x1_ = jnp.minimum(x0 + 1, W - 1)
+            wy = yy - y0
+            wx = xx - x0
+            g = img[:, y0[:, None], x0[None, :]] * ((1 - wy)[:, None]
+                                                    * (1 - wx)[None, :])
+            g += img[:, y0[:, None], x1_[None, :]] * ((1 - wy)[:, None]
+                                                      * wx[None, :])
+            g += img[:, y1_[:, None], x0[None, :]] * (wy[:, None]
+                                                      * (1 - wx)[None, :])
+            g += img[:, y1_[:, None], x1_[None, :]] * (wy[:, None]
+                                                       * wx[None, :])
+            return g                                   # (C, len(yy), len(xx))
+
+        img = x[bi]                                    # (C, H, W)
+        samples = bilinear(img, iy, ix)                # (C, oh*s_h, ow*s_w)
+        samples = samples.reshape(C, oh, s_h, ow, s_w)
+        if mode == "max":
+            return samples.max(axis=(2, 4))
+        return samples.mean(axis=(2, 4))
+
+    import jax
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32),
+                             batch_indices.astype(jnp.int32))
+
+
+@op("NonMaxSuppression")
+def _nms(node, boxes, scores, max_out=None, iou_thr=None, score_thr=None):
+    """selected_indices (S, 3) of [batch, class, box]. XLA needs static
+    shapes, so S = batch * classes * max_output_boxes_per_class and unused
+    slots are PADDED with -1 rows (documented deviation from ORT's dynamic
+    output; max_output_boxes_per_class must be a constant)."""
+    jnp = _jnp()
+    if max_out is None:
+        raise ValueError("NonMaxSuppression: max_output_boxes_per_class "
+                         "input is required (static bound for XLA)")
+    M = int(np.asarray(_static(max_out, "max_output_boxes_per_class",
+                               node)).ravel()[0])
+    iou_t = (jnp.asarray(iou_thr, jnp.float32).ravel()[0]
+             if iou_thr is not None else jnp.float32(0.0))
+    score_t = (jnp.asarray(score_thr, jnp.float32).ravel()[0]
+               if score_thr is not None else -jnp.inf)
+    center = node.attr("center_point_box", 0)
+    B, nC, nB = scores.shape
+
+    if center:
+        cx, cy, w, h = (boxes[..., 0], boxes[..., 1], boxes[..., 2],
+                        boxes[..., 3])
+        y1, x1 = cy - h / 2, cx - w / 2
+        y2, x2 = cy + h / 2, cx + w / 2
+    else:
+        y1, x1, y2, x2 = (boxes[..., 0], boxes[..., 1], boxes[..., 2],
+                          boxes[..., 3])
+        y1, y2 = jnp.minimum(y1, y2), jnp.maximum(y1, y2)
+        x1, x2 = jnp.minimum(x1, x2), jnp.maximum(x1, x2)
+    area = (y2 - y1) * (x2 - x1)                        # (B, nB)
+
+    def iou(b):
+        yy1 = jnp.maximum(y1[b][:, None], y1[b][None, :])
+        xx1 = jnp.maximum(x1[b][:, None], x1[b][None, :])
+        yy2 = jnp.minimum(y2[b][:, None], y2[b][None, :])
+        xx2 = jnp.minimum(x2[b][:, None], x2[b][None, :])
+        inter = (jnp.maximum(yy2 - yy1, 0.0) * jnp.maximum(xx2 - xx1, 0.0))
+        return inter / jnp.maximum(area[b][:, None] + area[b][None, :]
+                                   - inter, 1e-9)
+
+    import jax
+    from jax import lax
+
+    def per_class(iou_mat, sc):
+        """Greedy NMS: M iterations of pick-best + suppress."""
+        def body(_, carry):
+            alive, picked, n = carry
+            masked = jnp.where(alive, sc, -jnp.inf)
+            i = jnp.argmax(masked)
+            ok = masked[i] > score_t
+            alive2 = alive & (iou_mat[i] <= iou_t)
+            alive2 = alive2.at[i].set(False)
+            picked2 = picked.at[n].set(jnp.where(ok, i, -1))
+            return (jnp.where(ok, alive2, alive & False),
+                    picked2, n + ok.astype(jnp.int32))
+
+        alive0 = jnp.ones(sc.shape[0], bool)
+        picked0 = jnp.full((M,), -1, jnp.int32)
+        _, picked, _ = lax.fori_loop(0, M, body, (alive0, picked0,
+                                                  jnp.int32(0)))
+        return picked
+
+    rows = []
+    for b in range(B):
+        iou_mat = iou(b)
+        per_b = jax.vmap(lambda s, m=iou_mat: per_class(m, s))(scores[b])
+        for c in range(nC):
+            picked = per_b[c]
+            bc = jnp.stack([jnp.where(picked >= 0, b, -1),
+                            jnp.where(picked >= 0, c, -1),
+                            picked], axis=1)
+            rows.append(bc)
+    return jnp.concatenate(rows, axis=0).astype(jnp.int64)

@@ -1,0 +1,173 @@
+"""Scatter/gather family + detection ops (NonMaxSuppression, RoiAlign).
+
+Semantics pinned against numpy references / hand-computed cases. NMS is the
+documented static-shape variant: output padded with -1 rows at the
+max_output_boxes_per_class bound (XLA's static-shape discipline; ORT's
+dynamic row count cannot exist under jit).
+"""
+
+import numpy as np
+
+from synapseml_tpu.onnx.importer import OnnxFunction
+from synapseml_tpu.onnx.modelgen import _attr, _vi
+from synapseml_tpu.onnx.protoio import Graph, Model, Node, Tensor
+
+
+def _run(nodes, inputs, outputs, feeds, inits=None):
+    m = Model(graph=Graph(nodes=nodes, initializers=inits or {},
+                          inputs=inputs, outputs=outputs, name="g"),
+              opset=17)
+    fn = OnnxFunction(Model.parse(m.encode()))
+    return fn(feeds)
+
+
+class TestElementwise:
+    def test_isnan_isinf_sign(self):
+        x = np.asarray([np.nan, np.inf, -np.inf, -2.0, 0.0, 3.0], np.float32)
+        nodes = [Node(op_type="IsNaN", inputs=["x"], outputs=["a"]),
+                 Node(op_type="IsInf", inputs=["x"], outputs=["b"]),
+                 Node(op_type="Sign", inputs=["x"], outputs=["c"])]
+        out = _run(nodes, [_vi("x", [6])],
+                   [_vi("a", [6]), _vi("b", [6]), _vi("c", [6])], {"x": x})
+        np.testing.assert_array_equal(np.asarray(out["a"]), np.isnan(x))
+        np.testing.assert_array_equal(np.asarray(out["b"]), np.isinf(x))
+        np.testing.assert_array_equal(np.asarray(out["c"])[3:],
+                                      np.sign(x[3:]))
+
+    def test_reduce_logsumexp(self):
+        x = np.random.default_rng(0).normal(size=(3, 5)).astype(np.float32)
+        n = Node(op_type="ReduceLogSumExp", inputs=["x"], outputs=["y"],
+                 attrs={"axes": _attr("axes", [1]),
+                        "keepdims": _attr("keepdims", 0)})
+        out = _run([n], [_vi("x", [3, 5])], [_vi("y", [3])], {"x": x})
+        want = np.log(np.exp(x).sum(axis=1))
+        np.testing.assert_allclose(np.asarray(out["y"]), want, rtol=1e-5)
+
+
+class TestScatterGather:
+    def test_gather_elements(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.asarray([[0, 1], [2, 0], [1, 3]], np.int64)
+        n = Node(op_type="GatherElements", inputs=["x", "i"], outputs=["y"],
+                 attrs={"axis": _attr("axis", 1)})
+        out = _run([n], [_vi("x", [3, 4])], [_vi("y", [3, 2])],
+                   {"x": x}, {"i": Tensor.from_array("i", idx)})
+        want = np.take_along_axis(x, idx, axis=1)
+        np.testing.assert_array_equal(np.asarray(out["y"]), want)
+
+    def test_scatter_elements_add(self):
+        x = np.zeros((2, 5), np.float32)
+        idx = np.asarray([[1, 1], [4, 0]], np.int64)
+        upd = np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        n = Node(op_type="ScatterElements", inputs=["x", "i", "u"],
+                 outputs=["y"], attrs={"axis": _attr("axis", 1),
+                                       "reduction": _attr("reduction",
+                                                          "add")})
+        out = _run([n], [_vi("x", [2, 5])], [_vi("y", [2, 5])], {"x": x},
+                   {"i": Tensor.from_array("i", idx),
+                    "u": Tensor.from_array("u", upd)})
+        want = np.zeros((2, 5), np.float32)
+        want[0, 1] = 3.0        # two updates accumulate
+        want[1, 4] = 3.0
+        want[1, 0] = 4.0
+        np.testing.assert_array_equal(np.asarray(out["y"]), want)
+
+    def test_gather_nd(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        idx = np.asarray([[0, 1], [1, 2]], np.int64)     # -> (2, 4)
+        n = Node(op_type="GatherND", inputs=["x", "i"], outputs=["y"])
+        out = _run([n], [_vi("x", [2, 3, 4])], [_vi("y", [2, 4])],
+                   {"x": x}, {"i": Tensor.from_array("i", idx)})
+        np.testing.assert_array_equal(np.asarray(out["y"]),
+                                      np.stack([x[0, 1], x[1, 2]]))
+
+    def test_scatter_nd(self):
+        x = np.zeros((4, 3), np.float32)
+        idx = np.asarray([[1], [3]], np.int64)
+        upd = np.asarray([[1, 2, 3], [4, 5, 6]], np.float32)
+        n = Node(op_type="ScatterND", inputs=["x", "i", "u"], outputs=["y"])
+        out = _run([n], [_vi("x", [4, 3])], [_vi("y", [4, 3])], {"x": x},
+                   {"i": Tensor.from_array("i", idx),
+                    "u": Tensor.from_array("u", upd)})
+        want = np.zeros((4, 3), np.float32)
+        want[1] = [1, 2, 3]
+        want[3] = [4, 5, 6]
+        np.testing.assert_array_equal(np.asarray(out["y"]), want)
+
+
+class TestRoiAlign:
+    def test_average_pooling_exact_cells(self):
+        """ROI covering the image with output_half_pixel + sampling_ratio 1:
+        each output cell samples its center — verify against direct bilinear
+        interpolation in numpy."""
+        H = W = 4
+        x = np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W)
+        rois = np.asarray([[0.0, 0.0, 4.0, 4.0]], np.float32)
+        bi = np.asarray([0], np.int64)
+        n = Node(op_type="RoiAlign", inputs=["x", "r", "b"], outputs=["y"],
+                 attrs={"output_height": _attr("output_height", 2),
+                        "output_width": _attr("output_width", 2),
+                        "sampling_ratio": _attr("sampling_ratio", 1),
+                        "coordinate_transformation_mode": _attr(
+                            "coordinate_transformation_mode",
+                            "output_half_pixel")})
+        out = _run([n], [_vi("x", [1, 1, H, W])], [_vi("y", [1, 1, 2, 2])],
+                   {"x": x}, {"r": Tensor.from_array("r", rois),
+                              "b": Tensor.from_array("b", bi)})
+        # cell centers at (1.0, 1.0), (1.0, 3.0), (3.0, 1.0), (3.0, 3.0);
+        # y=3.0 clamps into the last row interpolation
+        def bil(yy, xx):
+            y0, x0 = int(np.floor(min(yy, H - 1))), int(np.floor(min(xx,
+                                                                     W - 1)))
+            y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+            wy, wx = yy - y0, xx - x0
+            img = x[0, 0]
+            return ((1 - wy) * (1 - wx) * img[y0, x0]
+                    + (1 - wy) * wx * img[y0, x1]
+                    + wy * (1 - wx) * img[y1, x0] + wy * wx * img[y1, x1])
+        want = np.asarray([[bil(1, 1), bil(1, 3)], [bil(3, 1), bil(3, 3)]])
+        np.testing.assert_allclose(np.asarray(out["y"])[0, 0], want,
+                                   rtol=1e-5)
+
+
+class TestNMS:
+    def test_greedy_suppression(self):
+        # three boxes: A and B overlap heavily (B lower score), C disjoint
+        boxes = np.asarray([[[0, 0, 10, 10], [0, 0, 9, 9],
+                             [20, 20, 30, 30]]], np.float32)
+        scores = np.asarray([[[0.9, 0.8, 0.7]]], np.float32)
+        n = Node(op_type="NonMaxSuppression",
+                 inputs=["boxes", "scores", "m", "iou", "st"],
+                 outputs=["sel"])
+        inits = {"m": Tensor.from_array("m", np.asarray([3], np.int64)),
+                 "iou": Tensor.from_array("iou",
+                                          np.asarray([0.5], np.float32)),
+                 "st": Tensor.from_array("st",
+                                         np.asarray([0.0], np.float32))}
+        out = _run([n], [_vi("boxes", [1, 3, 4]), _vi("scores", [1, 1, 3])],
+                   [_vi("sel", [3, 3])],
+                   {"boxes": boxes, "scores": scores}, inits)
+        sel = np.asarray(out["sel"])
+        picked = sel[sel[:, 2] >= 0][:, 2].tolist()
+        assert picked == [0, 2]          # A kept, B suppressed, C kept
+        # padding rows are all -1
+        assert (sel[sel[:, 2] < 0] == -1).all()
+
+    def test_score_threshold_and_classes(self):
+        boxes = np.asarray([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+        scores = np.asarray([[[0.9, 0.1], [0.2, 0.8]]], np.float32)
+        n = Node(op_type="NonMaxSuppression",
+                 inputs=["boxes", "scores", "m", "iou", "st"],
+                 outputs=["sel"])
+        inits = {"m": Tensor.from_array("m", np.asarray([2], np.int64)),
+                 "iou": Tensor.from_array("iou",
+                                          np.asarray([0.5], np.float32)),
+                 "st": Tensor.from_array("st",
+                                         np.asarray([0.5], np.float32))}
+        out = _run([n], [_vi("boxes", [1, 2, 4]), _vi("scores", [1, 2, 2])],
+                   [_vi("sel", [4, 3])],
+                   {"boxes": boxes, "scores": scores}, inits)
+        sel = np.asarray(out["sel"])
+        valid = sel[sel[:, 2] >= 0]
+        got = {(int(r[1]), int(r[2])) for r in valid}
+        assert got == {(0, 0), (1, 1)}   # class 0 box 0; class 1 box 1

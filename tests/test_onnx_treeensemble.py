@@ -193,6 +193,48 @@ class TestThirdPartyShapes:
                                           e[1] / e.sum()], rtol=1e-5)
 
 
+class TestPropertyFuzz:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_config_parity(self, seed):
+        """Converter parity over randomized shapes/configs: objective,
+        leaves, depth cap, L1/L2, NaN density, feature count — the graph
+        must reproduce Booster.predict for whatever the trainer grew."""
+        rng = np.random.default_rng(100 + seed)
+        n = int(rng.integers(300, 900))
+        f = int(rng.integers(3, 12))
+        X = rng.normal(size=(n, f)).astype(np.float32)
+        if rng.random() < 0.5:
+            mask = rng.random(size=X.shape) < rng.uniform(0.02, 0.15)
+            X[mask] = np.nan
+        objective = rng.choice(["binary", "regression", "multiclass"])
+        kw = dict(num_iterations=int(rng.integers(2, 8)),
+                  num_leaves=int(rng.integers(3, 24)),
+                  max_depth=int(rng.choice([-1, 3, 5])),
+                  lambda_l1=float(rng.choice([0.0, 0.5])),
+                  lambda_l2=float(rng.choice([0.0, 2.0])),
+                  min_data_in_leaf=int(rng.integers(1, 20)),
+                  learning_rate=float(rng.uniform(0.05, 0.3)))
+        if objective == "multiclass":
+            y = rng.integers(0, 3, size=n).astype(np.float32)
+            kw["num_class"] = 3
+        elif objective == "binary":
+            y = (np.nan_to_num(X[:, 0]) > 0).astype(np.float32)
+        else:
+            y = (np.nan_to_num(X[:, 0]) * 2
+                 + rng.normal(size=n)).astype(np.float32)
+        b = train_booster(Dataset(X, y), None,
+                          BoosterConfig(objective=str(objective), **kw))
+        out = _run(booster_to_onnx(b), X)
+        want = b.predict(X)
+        if objective == "multiclass":
+            got = np.asarray(out["probabilities"])
+        elif objective == "binary":
+            got = np.asarray(out["probabilities"])[:, 1]
+        else:
+            got = np.asarray(out["variable"])[:, 0]
+        np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
 class TestEdgeCases:
     def test_single_leaf_trees(self):
         """Constant-label data yields no splits; the converter must emit

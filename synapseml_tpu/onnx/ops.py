@@ -1630,3 +1630,141 @@ def _nms(node, boxes, scores, max_out=None, iou_thr=None, score_thr=None):
                             picked], axis=1)
             rows.append(bc)
     return jnp.concatenate(rows, axis=0).astype(jnp.int64)
+
+
+# --- com.microsoft contrib ops (ORT-optimized transformer graphs) ----------
+# onnxruntime's transformer optimizer rewrites exported BERT-class graphs
+# into fused contrib ops (domain com.microsoft). The reference's ONNXModel
+# executes such graphs through ORT itself; supporting the common fusion set
+# here means users can feed ORT-OPTIMIZED model files, not just raw exports.
+# The registry dispatches on op_type (domains carry no separate namespace
+# in this executor), matching how these names are unique in practice.
+
+@op("FusedMatMul")
+def _fused_matmul(node, a, b):
+    jnp = _jnp()
+    if node.attr("transBatchA", 0) or node.attr("transBatchB", 0):
+        raise ValueError("FusedMatMul: transBatchA/transBatchB not "
+                         "supported")
+    if node.attr("transA", 0):
+        a = jnp.swapaxes(a, -1, -2)
+    if node.attr("transB", 0):
+        b = jnp.swapaxes(b, -1, -2)
+    return node.attr("alpha", 1.0) * (a @ b)
+
+
+@op("FastGelu")
+def _fast_gelu(node, x, bias=None):
+    import jax
+
+    if bias is not None:
+        x = x + bias
+    return jax.nn.gelu(x, approximate=True)     # the tanh approximation
+
+
+@op("BiasGelu")
+def _bias_gelu(node, x, bias):
+    import jax
+
+    return jax.nn.gelu(x + bias, approximate=False)
+
+
+@op("QuickGelu")
+def _quick_gelu(node, x):
+    import jax
+
+    return x * jax.nn.sigmoid(node.attr("alpha", 1.702) * x)
+
+
+@op("SkipLayerNormalization")
+def _skip_layernorm(node, x, skip, gamma, beta=None, bias=None):
+    jnp = _jnp()
+    eps = node.attr("epsilon", 1e-12)
+    h = x + skip
+    if bias is not None:
+        h = h + bias
+    mean = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    out = (h - mean) / jnp.sqrt(var + eps) * gamma
+    if beta is not None:
+        out = out + beta
+    # contrib outputs: (out, mean, inv_std_var, input_skip_bias_sum)
+    return out, mean, 1.0 / jnp.sqrt(var + eps), h
+
+
+@op("EmbedLayerNormalization")
+def _embed_layernorm(node, ids, seg_ids, word_emb, pos_emb, seg_emb=None,
+                     gamma=None, beta=None, mask=None, position_ids=None):
+    jnp = _jnp()
+    eps = node.attr("epsilon", 1e-12)
+    ids = ids.astype(jnp.int32)
+    h = word_emb[ids]
+    if position_ids is not None:
+        h = h + pos_emb[position_ids.astype(jnp.int32)]
+    else:
+        h = h + pos_emb[jnp.arange(ids.shape[1])][None, :, :]
+    if seg_emb is not None and seg_ids is not None:
+        h = h + seg_emb[seg_ids.astype(jnp.int32)]
+    mean = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    out = (h - mean) / jnp.sqrt(var + eps)
+    if gamma is not None:
+        out = out * gamma
+    if beta is not None:
+        out = out + beta
+    mask_index = (mask.astype(jnp.int32).sum(axis=1)
+                  if mask is not None
+                  else jnp.full((ids.shape[0],), ids.shape[1], jnp.int32))
+    return out, mask_index
+
+
+@op("Attention")
+def _attention(node, x, w, b=None, mask_index=None, past=None,
+               attention_bias=None):
+    """com.microsoft fused self-attention: input (B, S, Hin), packed QKV
+    weight (Hin, 3*Hout), bias (3*Hout). Supports num_heads, unidirectional,
+    and the raw (B, S) 0/1 key-padding mask form of mask_index (the form
+    the ORT optimizer emits for BERT); past/present KV caches are not
+    supported."""
+    import jax
+
+    jnp = _jnp()
+    if past is not None:
+        raise ValueError("Attention: past/present KV cache not supported")
+    nh = int(node.attr("num_heads"))
+    uni = bool(node.attr("unidirectional", 0))
+    B, S, _ = x.shape
+    H3 = w.shape[1]
+    sizes = node.attr("qkv_hidden_sizes")
+    if sizes:
+        qh, kh, vh = (int(v_) for v_ in sizes)
+        if qh + kh + vh != H3 or qh != kh:
+            raise ValueError("Attention: qkv_hidden_sizes must sum to the "
+                             "packed width with q == k")
+    else:
+        qh = kh = vh = H3 // 3
+    qkv = x @ w
+    if b is not None:
+        qkv = qkv + b
+    q, k, v = (qkv[..., :qh], qkv[..., qh:qh + kh], qkv[..., qh + kh:])
+
+    def heads(t, hsz):
+        return t.reshape(B, S, nh, hsz // nh).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q, qh), heads(k, kh), heads(v, vh)
+    # custom scale attr when present; ORT's default is 1/sqrt(q head size)
+    scale = node.attr("scale", 0.0) or 1.0 / np.sqrt(qh // nh)
+    logits = (q @ k.transpose(0, 1, 3, 2)) * scale            # (B,nh,S,S)
+    if attention_bias is not None:
+        logits = logits + attention_bias
+    if mask_index is not None:
+        if mask_index.ndim != 2:
+            raise ValueError("Attention: only the raw (B, S) key-padding "
+                             "mask_index form is supported")
+        keymask = mask_index.astype(bool)[:, None, None, :]   # (B,1,1,S)
+        logits = jnp.where(keymask, logits, -10000.0)
+    if uni:
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(causal[None, None], logits, -10000.0)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return (probs @ v).transpose(0, 2, 1, 3).reshape(B, S, vh)

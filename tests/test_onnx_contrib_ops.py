@@ -1,0 +1,212 @@
+"""com.microsoft contrib ops (ORT transformer-optimizer fusion set).
+
+Each fused op is pinned against its decomposition built from plain ONNX /
+numpy math: Attention vs an explicit per-head softmax attention,
+SkipLayerNormalization vs add+LayerNorm, EmbedLayerNormalization vs
+gather+add+LayerNorm, the Gelu variants vs their defining formulas.
+"""
+
+import numpy as np
+
+from synapseml_tpu.onnx.importer import OnnxFunction
+from synapseml_tpu.onnx.modelgen import _attr, _vi
+from synapseml_tpu.onnx.protoio import Graph, Model, Node, Tensor
+
+
+def _run(nodes, inputs, outputs, feeds, inits=None):
+    m = Model(graph=Graph(nodes=nodes, initializers=inits or {},
+                          inputs=inputs, outputs=outputs, name="g"),
+              opset=17)
+    fn = OnnxFunction(Model.parse(m.encode()))
+    return fn(feeds)
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _layernorm(h, gamma, beta, eps=1e-12):
+    mean = h.mean(-1, keepdims=True)
+    var = h.var(-1, keepdims=True)
+    return (h - mean) / np.sqrt(var + eps) * gamma + beta
+
+
+class TestGelus:
+    def test_fastgelu_formula(self):
+        x = np.linspace(-3, 3, 13).astype(np.float32)
+        bias = np.float32(0.25) * np.ones(13, np.float32)
+        n = Node(op_type="FastGelu", inputs=["x", "b"], outputs=["y"])
+        out = _run([n], [_vi("x", [13])], [_vi("y", [13])], {"x": x},
+                   {"b": Tensor.from_array("b", bias)})
+        xb = (x + 0.25).astype(np.float64)
+        want = 0.5 * xb * (1 + np.tanh(
+            0.7978845608028654 * (xb + 0.044715 * xb ** 3)))
+        np.testing.assert_allclose(np.asarray(out["y"]), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_biasgelu_exact_erf(self):
+        from scipy.special import erf
+
+        x = np.linspace(-2, 2, 9).astype(np.float32)
+        bias = np.full(9, -0.1, np.float32)
+        n = Node(op_type="BiasGelu", inputs=["x", "b"], outputs=["y"])
+        out = _run([n], [_vi("x", [9])], [_vi("y", [9])], {"x": x},
+                   {"b": Tensor.from_array("b", bias)})
+        xb = (x - 0.1).astype(np.float64)
+        want = xb * 0.5 * (1 + erf(xb / np.sqrt(2)))
+        np.testing.assert_allclose(np.asarray(out["y"]), want, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestFusedMatMul:
+    def test_trans_and_alpha(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 3)).astype(np.float32)
+        b = rng.normal(size=(5, 4)).astype(np.float32)
+        n = Node(op_type="FusedMatMul", inputs=["a", "b"], outputs=["y"],
+                 attrs={"transA": _attr("transA", 1),
+                        "transB": _attr("transB", 1),
+                        "alpha": _attr("alpha", 0.5)})
+        out = _run([n], [_vi("a", [4, 3]), _vi("b", [5, 4])],
+                   [_vi("y", [3, 5])], {"a": a, "b": b})
+        np.testing.assert_allclose(np.asarray(out["y"]), 0.5 * (a.T @ b.T),
+                                   rtol=1e-5)
+
+
+class TestSkipLayerNorm:
+    def test_matches_decomposition(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 5, 8)).astype(np.float32)
+        skip = rng.normal(size=(2, 5, 8)).astype(np.float32)
+        gamma = rng.normal(size=8).astype(np.float32)
+        beta = rng.normal(size=8).astype(np.float32)
+        bias = rng.normal(size=8).astype(np.float32)
+        n = Node(op_type="SkipLayerNormalization",
+                 inputs=["x", "s", "g", "be", "bi"], outputs=["y"])
+        out = _run([n], [_vi("x", [2, 5, 8]), _vi("s", [2, 5, 8])],
+                   [_vi("y", [2, 5, 8])], {"x": x, "s": skip},
+                   {"g": Tensor.from_array("g", gamma),
+                    "be": Tensor.from_array("be", beta),
+                    "bi": Tensor.from_array("bi", bias)})
+        want = _layernorm(x + skip + bias, gamma, beta)
+        np.testing.assert_allclose(np.asarray(out["y"]), want, rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestEmbedLayerNorm:
+    def test_matches_decomposition(self):
+        rng = np.random.default_rng(2)
+        V, P, H, B, S = 30, 10, 8, 2, 6
+        ids = rng.integers(0, V, (B, S)).astype(np.int32)
+        seg = rng.integers(0, 2, (B, S)).astype(np.int32)
+        we = rng.normal(size=(V, H)).astype(np.float32)
+        pe = rng.normal(size=(P, H)).astype(np.float32)
+        se = rng.normal(size=(2, H)).astype(np.float32)
+        gamma = rng.normal(size=H).astype(np.float32)
+        beta = rng.normal(size=H).astype(np.float32)
+        mask = np.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 0, 0, 0, 0]],
+                          np.int32)
+        n = Node(op_type="EmbedLayerNormalization",
+                 inputs=["ids", "seg", "we", "pe", "se", "g", "b", "m"],
+                 outputs=["y", "mi"])
+        out = _run([n], [_vi("ids", [B, S]), _vi("seg", [B, S]),
+                         _vi("m", [B, S])],
+                   [_vi("y", [B, S, H]), _vi("mi", [B])],
+                   {"ids": ids, "seg": seg, "m": mask},
+                   {"we": Tensor.from_array("we", we),
+                    "pe": Tensor.from_array("pe", pe),
+                    "se": Tensor.from_array("se", se),
+                    "g": Tensor.from_array("g", gamma),
+                    "b": Tensor.from_array("b", beta)})
+        want = _layernorm(we[ids] + pe[np.arange(S)][None] + se[seg],
+                          gamma, beta)
+        np.testing.assert_allclose(np.asarray(out["y"]), want, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(out["mi"]), [4, 2])
+
+
+class TestAttention:
+    def _reference(self, x, w, b, nh, mask=None, uni=False):
+        B, S, _ = x.shape
+        Hout = w.shape[1] // 3
+        hd = Hout // nh
+        qkv = x @ w + b
+        q, k, v = qkv[..., :Hout], qkv[..., Hout:2 * Hout], qkv[..., 2 * Hout:]
+
+        def heads(t):
+            return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        logits = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(hd)
+        if mask is not None:
+            logits = np.where(mask[:, None, None, :].astype(bool), logits,
+                              -10000.0)
+        if uni:
+            logits = np.where(np.tril(np.ones((S, S), bool))[None, None],
+                              logits, -10000.0)
+        return (_softmax(logits) @ v).transpose(0, 2, 1, 3).reshape(
+            B, S, Hout)
+
+    def test_masked_attention(self):
+        rng = np.random.default_rng(3)
+        B, S, Hin, nh, Hout = 2, 5, 8, 2, 8
+        x = rng.normal(size=(B, S, Hin)).astype(np.float32)
+        w = (rng.normal(size=(Hin, 3 * Hout)) * 0.3).astype(np.float32)
+        b = rng.normal(size=3 * Hout).astype(np.float32)
+        mask = np.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.int32)
+        n = Node(op_type="Attention", inputs=["x", "w", "b", "m"],
+                 outputs=["y"], attrs={"num_heads": _attr("num_heads", nh)})
+        out = _run([n], [_vi("x", [B, S, Hin]), _vi("m", [B, S])],
+                   [_vi("y", [B, S, Hout])], {"x": x, "m": mask},
+                   {"w": Tensor.from_array("w", w),
+                    "b": Tensor.from_array("b", b)})
+        want = self._reference(x, w, b, nh, mask)
+        np.testing.assert_allclose(np.asarray(out["y"]), want, rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_qkv_hidden_sizes_and_scale(self):
+        """Non-uniform V width + a custom scale attr (code-review r4): the
+        packed projection must slice at q/k/v offsets, not equal thirds."""
+        rng = np.random.default_rng(5)
+        B, S, Hin, nh = 1, 3, 4, 2
+        qh = kh = 4
+        vh = 8
+        x = rng.normal(size=(B, S, Hin)).astype(np.float32)
+        w = (rng.normal(size=(Hin, qh + kh + vh)) * 0.3).astype(np.float32)
+        b = np.zeros(qh + kh + vh, np.float32)
+        n = Node(op_type="Attention", inputs=["x", "w", "b"], outputs=["y"],
+                 attrs={"num_heads": _attr("num_heads", nh),
+                        "qkv_hidden_sizes": _attr("qkv_hidden_sizes",
+                                                  [qh, kh, vh]),
+                        "scale": _attr("scale", 0.25)})
+        out = _run([n], [_vi("x", [B, S, Hin])], [_vi("y", [B, S, vh])],
+                   {"x": x}, {"w": Tensor.from_array("w", w),
+                              "b": Tensor.from_array("b", b)})
+        qkv = x @ w
+        q, k, v = qkv[..., :qh], qkv[..., qh:qh + kh], qkv[..., qh + kh:]
+        qH = q.reshape(B, S, nh, qh // nh).transpose(0, 2, 1, 3)
+        kH = k.reshape(B, S, nh, kh // nh).transpose(0, 2, 1, 3)
+        vH = v.reshape(B, S, nh, vh // nh).transpose(0, 2, 1, 3)
+        logits = (qH @ kH.transpose(0, 1, 3, 2)) * 0.25
+        want = (_softmax(logits) @ vH).transpose(0, 2, 1, 3).reshape(
+            B, S, vh)
+        np.testing.assert_allclose(np.asarray(out["y"]), want, rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_unidirectional(self):
+        rng = np.random.default_rng(4)
+        B, S, H, nh = 1, 4, 6, 3
+        x = rng.normal(size=(B, S, H)).astype(np.float32)
+        w = (rng.normal(size=(H, 3 * H)) * 0.3).astype(np.float32)
+        b = np.zeros(3 * H, np.float32)
+        n = Node(op_type="Attention", inputs=["x", "w", "b"],
+                 outputs=["y"],
+                 attrs={"num_heads": _attr("num_heads", nh),
+                        "unidirectional": _attr("unidirectional", 1)})
+        out = _run([n], [_vi("x", [B, S, H])], [_vi("y", [B, S, H])],
+                   {"x": x}, {"w": Tensor.from_array("w", w),
+                              "b": Tensor.from_array("b", b)})
+        want = self._reference(x, w, b, nh, uni=True)
+        np.testing.assert_allclose(np.asarray(out["y"]), want, rtol=2e-4,
+                                   atol=2e-5)

@@ -73,7 +73,7 @@ def _tuned_file_values() -> dict:
     return {}
 
 
-def _run_tree(cmd, timeout_s: float):
+def _run_tree(cmd, timeout_s: float, env=None):
     """subprocess.run, but the child gets its own session and the WHOLE
     process tree is killed on timeout — bench.py --all spawns per-workload
     grandchildren that would otherwise survive holding the exclusive TPU
@@ -85,7 +85,7 @@ def _run_tree(cmd, timeout_s: float):
 
     p = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
                          stderr=subprocess.PIPE, text=True,
-                         start_new_session=True)
+                         start_new_session=True, env=env)
     try:
         out, err = p.communicate(timeout=timeout_s)
         return subprocess.CompletedProcess(cmd, p.returncode, out, err)
@@ -142,14 +142,22 @@ def run_bench(timeout_s: float) -> bool:
 
 
 def run_tune(timeout_s: float) -> None:
-    """GBDT hot-loop A/B; tee phase breakdown into a committed log."""
+    """GBDT hot-loop A/B; tee phase breakdown into a committed log.
+    The tuner's internal budget is capped at 900 s here (its standalone
+    default is 1800 s): observed windows run ~18 min, and a tune that eats
+    the whole window leaves no room for the bench that must re-measure the
+    flipped default. Phases are information-ordered, so the 900 s cut still
+    yields the flip-deciding differentials; operators can override via
+    PERF_TUNE_BUDGET_S."""
     log = os.path.join(REPO, "docs", "perf_tune_onchip.log")
     print(f"[{_ts()}] running perf_tune → {log}", flush=True)
+    env = dict(os.environ)
+    env.setdefault("PERF_TUNE_BUDGET_S", "900")
     try:
         r = _run_tree([sys.executable,
                        os.path.join(REPO, "tools", "perf_tune.py"),
                        "--profile", "/tmp/jaxtrace_gbdt"],
-                      timeout_s)
+                      timeout_s, env=env)
         with open(log, "a") as f:
             f.write(f"\n===== perf_tune @ {_ts()} rc={r.returncode} =====\n")
             f.write(r.stdout)
@@ -236,10 +244,17 @@ def main():
             # when a fresh (<24h) on-chip primary is already recorded, the
             # tune pass runs first — its phase breakdown is what actually
             # moves the number, and windows have been short (~18 min)
+            # the DEFAULT config's recorded number reflects the tuned-file
+            # values in effect when its bench STARTED; any flip landing
+            # after that point (tune pass, or bench's own sweep persist)
+            # means the window must close with a default re-measure
+            last_default_vals = None
             fresh = _fresh_primary_recorded(hours=24.0)
             if fresh and args.tune:
                 run_tune(args.bench_timeout_s)
+            pre = _tuned_file_values()
             ok = run_bench(args.bench_timeout_s)
+            last_default_vals = pre
             # each follow-on pass re-probes first: a 3600s-timeout on-chip
             # run launched into a just-dropped terminal wastes hours
             if args.tune and not fresh and _probe_device_once(args.probe_s):
@@ -252,9 +267,30 @@ def main():
                 # would only repeat a number we already hold
                 if (_tuned_file_values() != before
                         and _probe_device_once(args.probe_s)):
+                    pre = _tuned_file_values()
                     ok = run_bench(args.bench_timeout_s) or ok
+                    last_default_vals = pre
             if _probe_device_once(args.probe_s):
                 run_tpu_e2e(min(args.bench_timeout_s, 1200.0))
+            # close the window: if ANY flip postdates the last default
+            # measurement, re-measure default-only (sweep budget 0 — the
+            # default runs first and no alternate can persist another flip,
+            # so this terminates)
+            if (last_default_vals is not None
+                    and _tuned_file_values() != last_default_vals
+                    and _probe_device_once(args.probe_s)):
+                print(f"[{_ts()}] defaults flipped after the last default "
+                      "measurement — re-measuring primary only", flush=True)
+                env = dict(os.environ, BENCH_BUDGET_S="0",
+                           BENCH_GBDT_SWEEP_BUDGET_S="0")
+                try:
+                    r = _run_tree([sys.executable,
+                                   os.path.join(REPO, "bench.py")],
+                                  min(args.bench_timeout_s, 1500.0), env=env)
+                    print(r.stdout[-800:], flush=True)
+                except subprocess.TimeoutExpired:
+                    print(f"[{_ts()}] primary re-measure timed out",
+                          flush=True)
             # scale proof throttled: an 11M-row run every --forever cycle
             # would burn the scarce terminal windows on repeat numbers
             if (args.scale and time.time() - last_scale > 6 * 3600

@@ -15,6 +15,7 @@ evaluated at import (host, numpy semantics via jax) so shape-valued tensors
 
 from __future__ import annotations
 
+import copy
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -34,6 +35,7 @@ class OnnxFunction:
         self.model = model
         self.precision = precision
         g = model.graph
+        _inline_constant_ifs(g)
         self.graph_inputs = [vi.name for vi in g.inputs
                              if vi.name not in g.initializers]
         self.input_info = {vi.name: vi for vi in g.inputs}
@@ -145,6 +147,132 @@ class OnnxFunction:
             return tuple(self({n: a for n, a in zip(names, arrays)}).values())
 
         return fn, names
+
+
+def _resolve_constant(g: Graph, name: str, _depth: int = 0,
+                      _producers=None, _memo=None):
+    """The value of tensor ``name`` when derivable from initializers through
+    constant-only ops; None when it depends on a graph input. Host-side
+    mini-fold of just the ancestor chain, with a producer map + memo so
+    shared-fan-in (diamond) chains resolve once, not once per path."""
+    if name in g.initializers:
+        return g.initializers[name].array()
+    if _depth > 64:
+        return None
+    if _producers is None:
+        _producers = {o: n for n in g.nodes for o in n.outputs if o}
+    if _memo is None:
+        _memo = {}
+    if name in _memo:
+        return _memo[name]
+    _memo[name] = None               # cycle guard / negative cache
+    producer = _producers.get(name)
+    if producer is None or producer.op_type in ("Shape", "If"):
+        return None
+    impl = REGISTRY.get(producer.op_type)
+    if impl is None:
+        return None
+    args = []
+    for i in producer.inputs:
+        if not i:
+            args.append(None)
+            continue
+        v = _resolve_constant(g, i, _depth + 1, _producers, _memo)
+        if v is None:
+            return None
+        args.append(v)
+    try:
+        out = impl(producer, *args)
+    except Exception:
+        return None
+    if not isinstance(out, tuple):
+        out = (out,)
+    for o, v in zip(producer.outputs, out):
+        _memo[o] = np.asarray(v)
+    return _memo.get(name)
+
+
+def _rename_in_subgraph(sub: Graph, rename: dict) -> Graph:
+    """Copy of ``sub`` with CAPTURED outer-tensor references renamed.
+    Names the subgraph itself produces or initializes are its own scope and
+    stay untouched; nested subgraphs recurse."""
+    shadowed = ({o for n in sub.nodes for o in n.outputs if o}
+                | set(sub.initializers))
+    eff = {k: v for k, v in rename.items() if k not in shadowed}
+    out = copy.copy(sub)
+    out.nodes = []
+    for n in sub.nodes:
+        n2 = copy.copy(n)
+        n2.inputs = [eff.get(i, i) for i in n.inputs]
+        if any(a.g is not None for a in n.attrs.values()):
+            n2.attrs = {k: copy.copy(a) for k, a in n.attrs.items()}
+            for a in n2.attrs.values():
+                if a.g is not None:
+                    a.g = _rename_in_subgraph(a.g, eff)
+        out.nodes.append(n2)
+    return out
+
+
+def _inline_constant_ifs(g: Graph) -> None:
+    """Replace every If node whose condition is derivable from constants
+    with its chosen branch, inlined (TorchScript-exported models branch on
+    traced config flags that serialize as constants — opset If semantics:
+    branch subgraphs have no inputs and capture outer tensors by name).
+    Branch-internal tensors are prefixed to avoid collisions; branch
+    outputs map positionally onto the If node's outputs. Runs to fixpoint
+    so nested constant Ifs inline too. A DATA-dependent If stays in place
+    and fails at execution with the executor's unsupported-op error —
+    XLA's static shapes cannot express it."""
+    changed = True
+    while changed:
+        changed = False
+        for idx, node in enumerate(list(g.nodes)):
+            if node.op_type != "If":
+                continue
+            cond = _resolve_constant(g, node.inputs[0])
+            if cond is None:
+                continue
+            branch = node.attr("then_branch" if bool(np.asarray(cond).ravel()
+                                                     [0])
+                               else "else_branch")
+            if branch is None:
+                continue
+            prefix = (node.name or f"if_{idx}") + "/"
+            # branch outputs (positional) -> If outputs; a branch output the
+            # branch neither produces nor initializes is a PASSTHROUGH of a
+            # captured outer tensor — bridge it with Identity instead of
+            # renaming the outer tensor
+            produced = {o for n2 in branch.nodes for o in n2.outputs if o}
+            rename, bridges = {}, []
+            for vi, out in zip(branch.outputs, node.outputs):
+                if vi.name in produced or vi.name in branch.initializers:
+                    rename[vi.name] = out
+                else:
+                    bridges.append(Node(op_type="Identity",
+                                        inputs=[vi.name], outputs=[out],
+                                        name=prefix + "passthrough"))
+            internal = (produced | set(branch.initializers)) - set(rename)
+            rename.update({t: prefix + t for t in internal})
+            for t, tensor in branch.initializers.items():
+                g.initializers[rename.get(t, t)] = tensor
+            new_nodes = []
+            for n2 in branch.nodes:
+                n3 = copy.copy(n2)
+                n3.inputs = [rename.get(i, i) for i in n2.inputs]
+                n3.outputs = [rename.get(o, o) for o in n2.outputs]
+                n3.name = prefix + (n2.name or n2.op_type)
+                if any(a.g is not None for a in n2.attrs.values()):
+                    # a NESTED subgraph captures outer-branch tensors by
+                    # name: its references must follow the rename too
+                    # (shadowed names excluded inside _rename_in_subgraph)
+                    n3.attrs = {k: copy.copy(a) for k, a in n2.attrs.items()}
+                    for a in n3.attrs.values():
+                        if a.g is not None:
+                            a.g = _rename_in_subgraph(a.g, rename)
+                new_nodes.append(n3)
+            g.nodes[idx:idx + 1] = new_nodes + bridges
+            changed = True
+            break            # indices shifted: restart the scan
 
 
 def import_model(model_bytes: bytes,

@@ -11,7 +11,7 @@ Field numbers follow onnx/onnx.proto3 (public schema):
   ModelProto:   ir_version=1, opset_import=8, graph=7
   GraphProto:   node=1, name=2, initializer=5, input=11, output=12
   NodeProto:    input=1, output=2, name=3, op_type=4, attribute=5
-  AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, strings=9, type=20
+  AttributeProto: name=1, f=2, i=3, s=4, t=5, g=6, floats=7, ints=8, strings=9, type=20
   TensorProto:  dims=1, data_type=2, float_data=4, int32_data=5, string_data=6,
                 int64_data=7, name=8, raw_data=9, double_data=10, uint64_data=11
   ValueInfoProto: name=1, type=2; TypeProto.tensor_type=1 {elem_type=1, shape=2}
@@ -116,11 +116,13 @@ def _signed(v: int) -> int:
 @dataclass
 class Attribute:
     name: str = ""
-    type: int = 0  # 1=FLOAT 2=INT 3=STRING 4=TENSOR 6=FLOATS 7=INTS 8=STRINGS
+    type: int = 0  # 1=FLOAT 2=INT 3=STRING 4=TENSOR 5=GRAPH 6=FLOATS
+    #                7=INTS 8=STRINGS (AttributeProto.AttributeType enum)
     f: float = 0.0
     i: int = 0
     s: bytes = b""
     t: Optional["Tensor"] = None
+    g: Optional["Graph"] = None   # subgraph (If/Loop/Scan bodies)
     floats: List[float] = field(default_factory=list)
     ints: List[int] = field(default_factory=list)
     strings: List[bytes] = field(default_factory=list)
@@ -128,7 +130,8 @@ class Attribute:
     @property
     def value(self) -> Any:
         return {1: self.f, 2: self.i, 3: self.s.decode("utf-8", "replace"),
-                4: self.t, 6: list(self.floats), 7: list(self.ints),
+                4: self.t, 5: self.g, 6: list(self.floats),
+                7: list(self.ints),
                 8: [s.decode("utf-8", "replace") for s in self.strings]
                 }.get(self.type)
 
@@ -146,6 +149,8 @@ class Attribute:
                 a.s = val
             elif fnum == 5:
                 a.t = Tensor.parse(val)
+            elif fnum == 6:
+                a.g = Graph.parse(val)
             elif fnum == 7:
                 a.floats += (list(struct.unpack(f"<{len(val)//4}f", val))
                              if wtype == 2 else [struct.unpack("<f", val)[0]])
@@ -164,6 +169,8 @@ class Attribute:
                 a.type = 8
             elif a.t is not None:
                 a.type = 4
+            elif a.g is not None:
+                a.type = 5
             elif a.s:
                 a.type = 3
         return a
@@ -179,6 +186,8 @@ class Attribute:
             _emit(out, 4, 2, self.s)
         elif self.type == 4 and self.t is not None:
             _emit(out, 5, 2, self.t.encode())
+        elif self.type == 5 and self.g is not None:
+            _emit(out, 6, 2, self.g.encode())
         elif self.type == 6:
             _emit(out, 7, 2, struct.pack(f"<{len(self.floats)}f", *self.floats))
         elif self.type == 7:

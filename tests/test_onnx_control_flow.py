@@ -1,0 +1,127 @@
+"""ONNX If with constant conditions (TorchScript-exported control flow).
+
+Exported models branch on traced config flags that serialize as constants;
+the importer inlines the chosen branch at import time (opset If semantics:
+branch subgraphs have no inputs and capture outer tensors by name). A
+data-dependent If stays unsupported — XLA's static shapes cannot express it.
+"""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.onnx.importer import OnnxFunction
+from synapseml_tpu.onnx.modelgen import _attr, _vi
+from synapseml_tpu.onnx.protoio import (Attribute, Graph, Model, Node,
+                                        Tensor)
+
+
+def _branch(mult):
+    """Subgraph: out = x * mult (captures outer 'x' by name)."""
+    return Graph(
+        nodes=[Node(op_type="Mul", inputs=["x", f"m{mult}"],
+                    outputs=[f"branch_out{mult}"])],
+        initializers={f"m{mult}": Tensor.from_array(
+            f"m{mult}", np.float32(mult))},
+        inputs=[], outputs=[_vi(f"branch_out{mult}", [2])], name="br")
+
+
+def _if_model(cond_init, then_g, else_g, extra_nodes=(), extra_inits=None):
+    if_node = Node(op_type="If", inputs=["cond"], outputs=["y"],
+                   name="the_if",
+                   attrs={"then_branch": Attribute(name="then_branch",
+                                                   type=5, g=then_g),
+                          "else_branch": Attribute(name="else_branch",
+                                                   type=5, g=else_g)})
+    inits = {"cond": Tensor.from_array("cond",
+                                       np.asarray(cond_init, np.bool_))}
+    inits.update(extra_inits or {})
+    return Model(graph=Graph(nodes=list(extra_nodes) + [if_node],
+                             initializers=inits,
+                             inputs=[_vi("x", [2])],
+                             outputs=[_vi("y", [2])], name="g"), opset=17)
+
+
+class TestConstantIf:
+    @pytest.mark.parametrize("cond,mult", [(True, 3.0), (False, 5.0)])
+    def test_branch_selection(self, cond, mult):
+        m = _if_model(cond, _branch(3.0), _branch(5.0))
+        fn = OnnxFunction(Model.parse(m.encode()))   # wire round-trip too
+        x = np.asarray([1.0, 2.0], np.float32)
+        out = fn({"x": x})
+        np.testing.assert_allclose(np.asarray(out["y"]), x * mult)
+
+    def test_condition_through_constant_chain(self):
+        """cond = Not(constant false) — resolved by the mini-fold."""
+        n_not = Node(op_type="Not", inputs=["raw"], outputs=["cond"])
+        m = _if_model(False, _branch(3.0), _branch(5.0),
+                      extra_nodes=[n_not],
+                      extra_inits={"raw": Tensor.from_array(
+                          "raw", np.asarray(False, np.bool_))})
+        # overwrite: If reads 'cond' produced by Not(raw=False) -> True
+        del m.graph.initializers["cond"]
+        fn = OnnxFunction(m)
+        x = np.asarray([1.0, 2.0], np.float32)
+        np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]), x * 3.0)
+
+    def test_passthrough_output(self):
+        """A branch returning the captured outer tensor directly inlines
+        via an Identity bridge."""
+        then_g = Graph(nodes=[], initializers={}, inputs=[],
+                       outputs=[_vi("x", [2])], name="pt")
+        m = _if_model(True, then_g, _branch(5.0))
+        fn = OnnxFunction(m)
+        x = np.asarray([7.0, -1.0], np.float32)
+        np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]), x)
+
+    def test_nested_if(self):
+        inner = _if_model(True, _branch(3.0), _branch(5.0)).graph
+        # inner graph produces 'y' from 'x'; wrap: outer If picks inner
+        inner.outputs = [_vi("y", [2])]
+        outer = _if_model(False, _branch(9.0), inner)
+        # avoid 'cond' name collision between scopes
+        inner.initializers["cond2"] = inner.initializers.pop("cond")
+        inner.nodes[-1].inputs = ["cond2"]
+        fn = OnnxFunction(outer)
+        x = np.asarray([2.0, 4.0], np.float32)
+        np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]), x * 3.0)
+
+    def test_nested_if_captures_outer_branch_tensor(self):
+        """An inner If capturing a tensor the OUTER branch produces must
+        follow the outer inline's renames into the nested subgraph
+        (code-review r4 finding)."""
+        inner_then = Graph(
+            nodes=[Node(op_type="Mul", inputs=["t", "k"],
+                        outputs=["inner_out"])],
+            initializers={"k": Tensor.from_array("k", np.float32(10.0))},
+            inputs=[], outputs=[_vi("inner_out", [2])], name="it")
+        inner_if = Node(op_type="If", inputs=["icond"], outputs=["y_inner"],
+                        name="inner_if",
+                        attrs={"then_branch": Attribute(
+                            name="then_branch", type=5, g=inner_then),
+                            "else_branch": Attribute(
+                            name="else_branch", type=5, g=inner_then)})
+        outer_then = Graph(
+            nodes=[Node(op_type="Add", inputs=["x", "c1"], outputs=["t"]),
+                   inner_if],
+            initializers={"c1": Tensor.from_array("c1", np.float32(1.0)),
+                          "icond": Tensor.from_array(
+                              "icond", np.asarray(True, np.bool_))},
+            inputs=[], outputs=[_vi("y_inner", [2])], name="ot")
+        m = _if_model(True, outer_then, _branch(5.0))
+        fn = OnnxFunction(m)
+        x = np.asarray([1.0, 2.0], np.float32)
+        np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]),
+                                   (x + 1.0) * 10.0)
+
+    def test_data_dependent_if_fails_loud(self):
+        n = Node(op_type="Greater", inputs=["x", "zero"], outputs=["gt"])
+        red = Node(op_type="ReduceMax", inputs=["gt"], outputs=["cond"],
+                   attrs={"keepdims": _attr("keepdims", 0)})
+        m = _if_model(True, _branch(3.0), _branch(5.0),
+                      extra_nodes=[n, red],
+                      extra_inits={"zero": Tensor.from_array(
+                          "zero", np.float32(0))})
+        del m.graph.initializers["cond"]
+        fn = OnnxFunction(m)
+        with pytest.raises(NotImplementedError, match="If"):
+            fn({"x": np.asarray([1.0, 2.0], np.float32)})

@@ -254,7 +254,8 @@ def main():
                 run_tune(args.bench_timeout_s)
             pre = _tuned_file_values()
             ok = run_bench(args.bench_timeout_s)
-            last_default_vals = pre
+            if ok:   # stale/failed runs recorded nothing: no snapshot
+                last_default_vals = pre
             # each follow-on pass re-probes first: a 3600s-timeout on-chip
             # run launched into a just-dropped terminal wastes hours
             if args.tune and not fresh and _probe_device_once(args.probe_s):
@@ -268,8 +269,10 @@ def main():
                 if (_tuned_file_values() != before
                         and _probe_device_once(args.probe_s)):
                     pre = _tuned_file_values()
-                    ok = run_bench(args.bench_timeout_s) or ok
-                    last_default_vals = pre
+                    ok2 = run_bench(args.bench_timeout_s)
+                    ok = ok2 or ok
+                    if ok2:
+                        last_default_vals = pre
             if _probe_device_once(args.probe_s):
                 run_tpu_e2e(min(args.bench_timeout_s, 1200.0))
             # close the window: if ANY flip postdates the last default

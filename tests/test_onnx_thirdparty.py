@@ -27,7 +27,11 @@ FIXTURES = ["torch_convnet", "torch_mlp", "torch_encoder",
             "torch_resnet50",
             # BERT-shape classifier: embedding Gathers + 2-layer encoder
             # stack + tanh pooler (int64 ids input)
-            "torch_bert_tiny"]
+            "torch_bert_tiny",
+            # scripted control flow: a real If node from torch.jit.script,
+            # condition from a serialized buffer — exercises the importer's
+            # constant-If inline pass on third-party bytes
+            "torch_scripted_if"]
 
 
 @pytest.mark.parametrize("name", FIXTURES)

@@ -1,0 +1,24 @@
+"""Scripted module with python control flow for the If-node ONNX fixture.
+
+torch.jit.script requires the class to live in a real source file (it reads
+the source); tools/gen_onnx_fixtures.py imports and exports it. The `if` on
+a registered buffer serializes as an ONNX If node whose condition is an
+initializer — the constant-flag pattern the importer inlines.
+"""
+
+import torch
+import torch.nn as nn
+
+
+class Gated(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.register_buffer("gate", torch.tensor(True))
+        self.a = nn.Linear(4, 4)
+        self.b = nn.Linear(4, 4)
+
+    def forward(self, x):
+        if bool(self.gate):
+            return torch.tanh(self.a(x))
+        else:
+            return torch.relu(self.b(x))

@@ -48,7 +48,7 @@ def _export(model, x, name: str, opset: int = 13) -> None:
     with open(os.path.join(OUT, f"{name}.onnx"), "wb") as f:
         f.write(raw)
     np.savez(os.path.join(OUT, f"{name}.npz"),
-             x=x.numpy(), y=y.numpy())
+             x=x.numpy(), y=y.detach().numpy())
     print(f"{name}: {len(raw)} bytes")
 
 
@@ -203,6 +203,17 @@ def main() -> int:
 
     ids = torch.randint(0, 100, (2, 8))
     _export(BertTiny(), ids, "torch_bert_tiny", opset=14)
+
+    # 9. scripted control flow: torch.jit.script preserves the python `if`
+    #    as an ONNX If node whose condition derives from a serialized
+    #    buffer — the exact constant-flag pattern the importer's inline
+    #    pass exists for. (Scripted modules must live in a real source
+    #    file: tools/gated_module.py.)
+    from gated_module import Gated
+
+    gm = torch.jit.script(Gated())
+    x9 = torch.randn(3, 4)
+    _export(gm, x9, "torch_scripted_if", opset=14)
     return 0
 
 

@@ -91,3 +91,32 @@ def test_onnxmodel_transformer_on_torch_bytes():
     out = model.transform(df)
     got = np.stack([np.asarray(v) for v in out["probs"]])
     np.testing.assert_allclose(got, data["y"], rtol=2e-3, atol=2e-4)
+
+
+def test_image_featurizer_on_torch_resnet50():
+    """ImageFeaturizer's headless auto-detection (penultimate tensor before
+    the last Gemm) must work on THIRD-PARTY bytes — the real torch-exported
+    ResNet-50 topology, whose node/tensor naming differs from modelgen's."""
+    import numpy as np
+
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.onnx.featurizer import ImageFeaturizer
+    from synapseml_tpu.onnx.model import ONNXModel
+
+    with open(os.path.join(RES, "torch_resnet50.onnx"), "rb") as f:
+        raw = f.read()
+    rng = np.random.default_rng(0)
+    imgs = np.empty(2, object)
+    for i in range(2):
+        imgs[i] = rng.uniform(0, 255, size=(64, 64, 3)).astype(np.float32)
+    feats = (ImageFeaturizer()
+             .setModel(ONNXModel().setModelPayload(raw))
+             .set("imageHeight", 64).set("imageWidth", 64)
+             .setInputCol("image").setOutputCol("features")
+             .transform(Table({"image": imgs})))
+    out = np.stack([np.asarray(v).ravel() for v in feats["features"]])
+    # slim ResNet-50: GAP output is 8 * 2^3 * 4 = 256 features per image
+    assert out.shape == (2, 256)
+    assert np.isfinite(out).all()
+    # headless output must differ between distinct images (real features)
+    assert np.abs(out[0] - out[1]).max() > 1e-6

@@ -1,0 +1,152 @@
+"""The watcher's window-orchestration contract (tools/measure.py).
+
+This logic guards the round's most important artifact — the on-chip GBDT
+default number — and its ordering rules (tune-first when fresh, re-bench
+after a flip, default-only closing measure) were previously only
+hand-traced. Every scenario here monkeypatches the pass functions and
+asserts the SEQUENCE actually executed.
+"""
+
+import importlib.util
+import sys
+import types
+
+import pytest
+
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "measure_mod", os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "measure.py"))
+measure = importlib.util.module_from_spec(spec)
+sys.modules["measure_mod"] = measure
+spec.loader.exec_module(measure)
+
+
+class Args(types.SimpleNamespace):
+    tune = True
+    scale = False
+    scale_rows = 0
+    probe_s = 1.0
+    bench_timeout_s = 10.0
+
+
+@pytest.fixture
+def harness(monkeypatch):
+    """Scriptable window environment recording the executed sequence."""
+    state = {"calls": [], "vals": {"a": 1}, "bench_results": [],
+             "bench_flips": [], "fresh": False, "probe": True}
+
+    def bench(timeout):
+        state["calls"].append("bench")
+        if state["bench_flips"]:
+            flip = state["bench_flips"].pop(0)
+            if flip:
+                state["vals"] = dict(state["vals"], **flip)
+        return state["bench_results"].pop(0) if state["bench_results"] \
+            else True
+
+    def tune(timeout):
+        state["calls"].append("tune")
+        flip = state.pop("tune_flip", None)
+        if flip:
+            state["vals"] = dict(state["vals"], **flip)
+
+    monkeypatch.setattr(measure, "run_bench", bench)
+    monkeypatch.setattr(measure, "run_tune", tune)
+    monkeypatch.setattr(measure, "run_tpu_e2e",
+                        lambda t: state["calls"].append("e2e"))
+    monkeypatch.setattr(measure, "run_scale_proof",
+                        lambda t, r: state["calls"].append("scale"))
+    monkeypatch.setattr(measure, "run_measure_default_only",
+                        lambda t: state["calls"].append("default_only"))
+    monkeypatch.setattr(measure, "_tuned_file_values",
+                        lambda: dict(state["vals"]))
+    monkeypatch.setattr(measure, "_probe_device_once",
+                        lambda t: state["probe"])
+    monkeypatch.setattr(measure, "_fresh_primary_recorded",
+                        lambda hours: state["fresh"])
+    return state
+
+
+def test_fresh_primary_tunes_first(harness):
+    harness["fresh"] = True
+    ok, _ = measure.run_window(Args(), 0.0)
+    assert harness["calls"][:2] == ["tune", "bench"]
+    assert ok
+
+
+def test_stale_primary_benches_first_then_tune_flip_rebenches(harness):
+    harness["tune_flip"] = {"partition_impl": "scatter"}
+    ok, _ = measure.run_window(Args(), 0.0)
+    # bench (old default) -> tune (flips) -> bench (new default) -> e2e
+    assert harness["calls"] == ["bench", "tune", "bench", "e2e"]
+    assert ok
+
+
+def test_tune_without_flip_skips_rebench(harness):
+    ok, _ = measure.run_window(Args(), 0.0)
+    assert harness["calls"] == ["bench", "tune", "e2e"]
+
+
+def test_bench_own_flip_triggers_default_only_close(harness):
+    """bench's variant sweep persists a winner AFTER measuring the default:
+    the window must close with a default-only re-measure."""
+    harness["bench_flips"] = [{"row_layout": "gather"}]
+    ok, _ = measure.run_window(Args(), 0.0)
+    assert harness["calls"] == ["bench", "tune", "e2e", "default_only"]
+
+
+def test_fresh_branch_flip_with_stale_bench_still_closes(harness):
+    """Fresh primary + tune flips + THIS window's bench replays stale:
+    the previous window's recorded primary mismatches the flipped file, so
+    the close must still fire (code-review r4 finding)."""
+    harness["fresh"] = True
+    harness["tune_flip"] = {"partition_impl": "scatter"}
+    harness["bench_results"] = [False]
+    ok, _ = measure.run_window(Args(), 0.0)
+    assert harness["calls"] == ["tune", "bench", "e2e", "default_only"]
+    assert not ok
+
+
+def test_stale_post_flip_bench_does_not_suppress_close(harness):
+    """tune flips, the re-bench replays a STALE number (ok=False): the
+    closing default-only measure must still fire (code-review r4)."""
+    harness["tune_flip"] = {"partition_impl": "sort32"}
+    harness["bench_results"] = [True, False]   # first fresh, re-bench stale
+    ok, _ = measure.run_window(Args(), 0.0)
+    assert harness["calls"] == ["bench", "tune", "bench", "e2e",
+                                "default_only"]
+    assert ok          # the first fresh bench keeps the window green
+
+
+def test_no_successful_bench_no_close(harness):
+    """Nothing recorded at all: no default snapshot exists, so no closing
+    re-measure (there is no measurement to make consistent)."""
+    harness["bench_results"] = [False, False]   # both benches replay stale
+    harness["tune_flip"] = {"partition_impl": "scan"}
+    ok, _ = measure.run_window(Args(), 0.0)
+    assert "default_only" not in harness["calls"]
+    assert not ok
+
+
+def test_probe_failure_skips_followons(harness, monkeypatch):
+    monkeypatch.setattr(measure, "_probe_device_once", lambda t: False)
+    ok, _ = measure.run_window(Args(), 0.0)
+    assert harness["calls"] == ["bench"]
+
+
+def test_scale_throttle(harness):
+    import time as _time
+
+    a = Args()
+    a.scale = True
+    a.scale_rows = 1000
+    ok, last = measure.run_window(a, 0.0)
+    assert "scale" in harness["calls"]
+    assert last > 0
+    harness["calls"].clear()
+    recent = _time.time()
+    ok, last2 = measure.run_window(a, recent)
+    assert "scale" not in harness["calls"]      # < 6h since previous
+    assert last2 == recent                      # throttle state unchanged

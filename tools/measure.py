@@ -218,6 +218,76 @@ def run_scale_proof(timeout_s: float, rows: int) -> None:
               flush=True)
 
 
+def run_measure_default_only(timeout_s: float) -> None:
+    """Default-only bench (no sweep, no extras) closing a window whose
+    tuned defaults flipped after the last default measurement."""
+    print(f"[{_ts()}] defaults flipped after the last default "
+          "measurement — re-measuring primary only", flush=True)
+    env = dict(os.environ, BENCH_BUDGET_S="0",
+               BENCH_GBDT_SWEEP_BUDGET_S="0")
+    try:
+        r = _run_tree([sys.executable, os.path.join(REPO, "bench.py")],
+                      min(timeout_s, 1500.0), env=env)
+        print(r.stdout[-800:], flush=True)
+    except subprocess.TimeoutExpired:
+        print(f"[{_ts()}] primary re-measure timed out", flush=True)
+
+
+def run_window(args, last_scale: float):
+    """One TPU-terminal window (device probe already succeeded).
+
+    Ordering contract (tested in tests/test_measure_window.py):
+      * bench FIRST — a short window must yield the green artifact before
+        tuning/scale work spends it — EXCEPT when a fresh (<24h) on-chip
+        primary exists: then the tune pass runs first and the bench that
+        follows measures the flipped defaults.
+      * every follow-on pass re-probes (a 3600s run launched into a
+        just-dropped terminal wastes hours).
+      * the DEFAULT config's recorded number reflects the tuned-file values
+        in effect when its bench STARTED; if ANY flip (tune pass, or
+        bench's own sweep persist) postdates the last SUCCESSFUL default
+        measurement, the window closes with a default-only re-measure
+        (sweep budget 0 — no further flip possible, so this terminates).
+    """
+    entry_vals = _tuned_file_values()
+    last_default_vals = None
+    fresh = _fresh_primary_recorded(hours=24.0)
+    if fresh and args.tune:
+        run_tune(args.bench_timeout_s)
+    pre = _tuned_file_values()
+    ok = run_bench(args.bench_timeout_s)
+    if ok:   # stale/failed runs recorded nothing: no snapshot
+        last_default_vals = pre
+    if args.tune and not fresh and _probe_device_once(args.probe_s):
+        before = _tuned_file_values()
+        run_tune(args.bench_timeout_s)
+        if (_tuned_file_values() != before
+                and _probe_device_once(args.probe_s)):
+            pre = _tuned_file_values()
+            ok2 = run_bench(args.bench_timeout_s)
+            ok = ok2 or ok
+            if ok2:
+                last_default_vals = pre
+    if _probe_device_once(args.probe_s):
+        run_tpu_e2e(min(args.bench_timeout_s, 1200.0))
+    # two reconciliation cases: a flip postdating THIS window's successful
+    # default bench, or — when no bench succeeded this window — a flip
+    # mismatching the still-fresh PREVIOUS window's recorded primary
+    stale_vs_this = (last_default_vals is not None
+                     and _tuned_file_values() != last_default_vals)
+    stale_vs_prev = (last_default_vals is None and fresh
+                     and _tuned_file_values() != entry_vals)
+    if (stale_vs_this or stale_vs_prev) and _probe_device_once(args.probe_s):
+        run_measure_default_only(args.bench_timeout_s)
+    # scale proof throttled: an 11M-row run every --forever cycle would
+    # burn the scarce terminal windows on repeat numbers
+    if (args.scale and time.time() - last_scale > 6 * 3600
+            and _probe_device_once(args.probe_s)):
+        last_scale = time.time()
+        run_scale_proof(args.bench_timeout_s, args.scale_rows)
+    return ok, last_scale
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--once", action="store_true")
@@ -239,67 +309,7 @@ def main():
     last_scale = 0.0
     while True:
         if _probe_device_once(args.probe_s):
-            # bench FIRST: a short terminal window must yield the green
-            # artifact before any tuning/scale work spends it. Exception:
-            # when a fresh (<24h) on-chip primary is already recorded, the
-            # tune pass runs first — its phase breakdown is what actually
-            # moves the number, and windows have been short (~18 min)
-            # the DEFAULT config's recorded number reflects the tuned-file
-            # values in effect when its bench STARTED; any flip landing
-            # after that point (tune pass, or bench's own sweep persist)
-            # means the window must close with a default re-measure
-            last_default_vals = None
-            fresh = _fresh_primary_recorded(hours=24.0)
-            if fresh and args.tune:
-                run_tune(args.bench_timeout_s)
-            pre = _tuned_file_values()
-            ok = run_bench(args.bench_timeout_s)
-            if ok:   # stale/failed runs recorded nothing: no snapshot
-                last_default_vals = pre
-            # each follow-on pass re-probes first: a 3600s-timeout on-chip
-            # run launched into a just-dropped terminal wastes hours
-            if args.tune and not fresh and _probe_device_once(args.probe_s):
-                before = _tuned_file_values()
-                run_tune(args.bench_timeout_s)
-                # when the tune pass flipped docs/tuned_defaults.json, the
-                # DEFAULT-config number must be re-measured with the tuned
-                # defaults in effect (VERDICT r3 #1: tune -> flip -> bench
-                # inside ONE window); unchanged values mean the re-run
-                # would only repeat a number we already hold
-                if (_tuned_file_values() != before
-                        and _probe_device_once(args.probe_s)):
-                    pre = _tuned_file_values()
-                    ok2 = run_bench(args.bench_timeout_s)
-                    ok = ok2 or ok
-                    if ok2:
-                        last_default_vals = pre
-            if _probe_device_once(args.probe_s):
-                run_tpu_e2e(min(args.bench_timeout_s, 1200.0))
-            # close the window: if ANY flip postdates the last default
-            # measurement, re-measure default-only (sweep budget 0 — the
-            # default runs first and no alternate can persist another flip,
-            # so this terminates)
-            if (last_default_vals is not None
-                    and _tuned_file_values() != last_default_vals
-                    and _probe_device_once(args.probe_s)):
-                print(f"[{_ts()}] defaults flipped after the last default "
-                      "measurement — re-measuring primary only", flush=True)
-                env = dict(os.environ, BENCH_BUDGET_S="0",
-                           BENCH_GBDT_SWEEP_BUDGET_S="0")
-                try:
-                    r = _run_tree([sys.executable,
-                                   os.path.join(REPO, "bench.py")],
-                                  min(args.bench_timeout_s, 1500.0), env=env)
-                    print(r.stdout[-800:], flush=True)
-                except subprocess.TimeoutExpired:
-                    print(f"[{_ts()}] primary re-measure timed out",
-                          flush=True)
-            # scale proof throttled: an 11M-row run every --forever cycle
-            # would burn the scarce terminal windows on repeat numbers
-            if (args.scale and time.time() - last_scale > 6 * 3600
-                    and _probe_device_once(args.probe_s)):
-                last_scale = time.time()
-                run_scale_proof(args.bench_timeout_s, args.scale_rows)
+            ok, last_scale = run_window(args, last_scale)
             if args.once or (ok and not args.forever):
                 return 0 if ok else 1
         else:

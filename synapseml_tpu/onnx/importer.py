@@ -35,7 +35,11 @@ class OnnxFunction:
         self.model = model
         self.precision = precision
         g = model.graph
-        _inline_constant_ifs(g)
+        # shared fixpoint: unrolling a Loop can expose constant Ifs and
+        # vice versa (nested control flow) — alternate until neither changes
+        for _ in range(32):
+            if not (_inline_constant_ifs(g) | _unroll_constant_loops(g)):
+                break
         self.graph_inputs = [vi.name for vi in g.inputs
                              if vi.name not in g.initializers]
         self.input_info = {vi.name: vi for vi in g.inputs}
@@ -213,7 +217,26 @@ def _rename_in_subgraph(sub: Graph, rename: dict) -> Graph:
     return out
 
 
-def _inline_constant_ifs(g: Graph) -> None:
+def _clone_subgraph_nodes(nodes, rename: dict, prefix: str):
+    """Copies of subgraph nodes with tensor references remapped, names
+    prefixed, and NESTED subgraph attributes rename-fixed — the one shared
+    scoping-sensitive block for If inlining and Loop unrolling."""
+    out = []
+    for n2 in nodes:
+        n3 = copy.copy(n2)
+        n3.inputs = [rename.get(i, i) for i in n2.inputs]
+        n3.outputs = [rename.get(o, o) for o in n2.outputs]
+        n3.name = prefix + (n2.name or n2.op_type)
+        if any(a.g is not None for a in n2.attrs.values()):
+            n3.attrs = {k: copy.copy(a) for k, a in n2.attrs.items()}
+            for a in n3.attrs.values():
+                if a.g is not None:
+                    a.g = _rename_in_subgraph(a.g, rename)
+        out.append(n3)
+    return out
+
+
+def _inline_constant_ifs(g: Graph) -> bool:
     """Replace every If node whose condition is derivable from constants
     with its chosen branch, inlined (TorchScript-exported models branch on
     traced config flags that serialize as constants — opset If semantics:
@@ -223,6 +246,7 @@ def _inline_constant_ifs(g: Graph) -> None:
     so nested constant Ifs inline too. A DATA-dependent If stays in place
     and fails at execution with the executor's unsupported-op error —
     XLA's static shapes cannot express it."""
+    any_change = False
     changed = True
     while changed:
         changed = False
@@ -255,24 +279,133 @@ def _inline_constant_ifs(g: Graph) -> None:
             rename.update({t: prefix + t for t in internal})
             for t, tensor in branch.initializers.items():
                 g.initializers[rename.get(t, t)] = tensor
-            new_nodes = []
-            for n2 in branch.nodes:
-                n3 = copy.copy(n2)
-                n3.inputs = [rename.get(i, i) for i in n2.inputs]
-                n3.outputs = [rename.get(o, o) for o in n2.outputs]
-                n3.name = prefix + (n2.name or n2.op_type)
-                if any(a.g is not None for a in n2.attrs.values()):
-                    # a NESTED subgraph captures outer-branch tensors by
-                    # name: its references must follow the rename too
-                    # (shadowed names excluded inside _rename_in_subgraph)
-                    n3.attrs = {k: copy.copy(a) for k, a in n2.attrs.items()}
-                    for a in n3.attrs.values():
-                        if a.g is not None:
-                            a.g = _rename_in_subgraph(a.g, rename)
-                new_nodes.append(n3)
-            g.nodes[idx:idx + 1] = new_nodes + bridges
+            g.nodes[idx:idx + 1] = _clone_subgraph_nodes(
+                branch.nodes, rename, prefix) + bridges
             changed = True
+            any_change = True
             break            # indices shifted: restart the scan
+    return any_change
+
+
+def _unroll_constant_loops(g: Graph) -> bool:
+    """Unroll Loop nodes whose trip count is a derivable constant and whose
+    condition stays constant-true (for-loop exports: fixed-length decoding,
+    per-layer stacks). Loop body signature (opset): inputs
+    (iter_num, cond_in, carried...), outputs (cond_out, carried_out...,
+    scan_outputs...); scan outputs stack along a new axis 0 via Unsqueeze +
+    Concat of per-iteration slices. Data-dependent trip counts / conditions
+    stay in place and fail loud at execution (XLA static shapes)."""
+    from .protoio import Attribute, Tensor
+
+    any_change = False
+    changed = True
+    while changed:
+        changed = False
+        for idx, node in enumerate(list(g.nodes)):
+            if node.op_type != "Loop":
+                continue
+            body = node.attr("body")
+            if body is None:
+                continue
+            m_name = node.inputs[0] if node.inputs else ""
+            cond_name = node.inputs[1] if len(node.inputs) > 1 else ""
+            m_val = _resolve_constant(g, m_name) if m_name else None
+            cond0 = (_resolve_constant(g, cond_name) if cond_name
+                     else np.asarray(True))
+            if m_val is None or cond0 is None or not bool(
+                    np.asarray(cond0).ravel()[0]):
+                continue
+            trips = int(np.asarray(m_val).ravel()[0])
+            n_carried = len(node.inputs) - 2
+            n_scan = len(node.outputs) - n_carried
+            body_in = [vi.name for vi in body.inputs]
+            body_out = [vi.name for vi in body.outputs]
+            # only unroll when the body's cond_out is the unchanged cond_in
+            # (possibly through an Identity chain) or a constant-true —
+            # otherwise the loop is data-dependent
+            src = body_out[0]
+            body_producers = {o: n2 for n2 in body.nodes
+                              for o in n2.outputs if o}
+            for _ in range(16):
+                p = body_producers.get(src)
+                if p is not None and p.op_type == "Identity":
+                    src = p.inputs[0]
+                else:
+                    break
+            cond_out_const = _resolve_constant(body, body_out[0])
+            if not (src == (body_in[1] if len(body_in) > 1 else None)
+                    or (cond_out_const is not None
+                        and bool(np.asarray(cond_out_const).ravel()[0]))):
+                continue
+            if trips > 256 or trips < 0:
+                continue      # unrolling a huge loop would explode the graph
+            if trips == 0 and n_scan > 0:
+                continue      # empty scan stack has no static encoding here
+
+            prefix0 = (node.name or f"loop_{idx}") + "/"
+            new_nodes: List[Node] = []
+            carried = list(node.inputs[2:])
+            scan_parts: List[List[str]] = [[] for _ in range(n_scan)]
+            produced = {o for n2 in body.nodes for o in n2.outputs if o}
+            # body initializers are iteration-invariant: hoist ONCE under
+            # the loop prefix. An initializer that names a body INPUT is
+            # that input's DEFAULT value — Loop always supplies
+            # iter/cond/carried, so the default must not shadow the bound
+            # outer tensor (it would corrupt the carried chain).
+            init_rename = {t: prefix0 + t for t in body.initializers
+                           if t not in body_in}
+            for t, tensor in body.initializers.items():
+                if t not in body_in:
+                    g.initializers[init_rename[t]] = tensor
+            for it in range(trips):
+                pfx = f"{prefix0}it{it}/"
+                rename = dict(init_rename)
+                # bind body inputs: iter_num + cond -> constants, carried ->
+                # current values
+                it_name = pfx + "iter"
+                g.initializers[it_name] = Tensor.from_array(
+                    it_name, np.asarray(it, np.int64))
+                rename[body_in[0]] = it_name
+                cd_name = pfx + "cond"
+                g.initializers[cd_name] = Tensor.from_array(
+                    cd_name, np.asarray(True))
+                if len(body_in) > 1:
+                    rename[body_in[1]] = cd_name
+                for bi, cur in zip(body_in[2:], carried):
+                    rename[bi] = cur
+                internal = produced - set(rename)
+                rename.update({t: pfx + t for t in internal})
+                new_nodes.extend(_clone_subgraph_nodes(body.nodes, rename,
+                                                       pfx))
+                carried = [rename.get(o, o) for o in
+                           body_out[1:1 + n_carried]]
+                for s in range(n_scan):
+                    src = rename.get(body_out[1 + n_carried + s],
+                                     body_out[1 + n_carried + s])
+                    un = pfx + f"scan{s}_unsq"
+                    ax = pfx + f"scan{s}_axes"
+                    g.initializers[ax] = Tensor.from_array(
+                        ax, np.asarray([0], np.int64))
+                    new_nodes.append(Node(op_type="Unsqueeze",
+                                          inputs=[src, ax], outputs=[un],
+                                          name=un))
+                    scan_parts[s].append(un)
+            # final wiring: carried outputs + stacked scan outputs
+            for out_name, cur in zip(node.outputs[:n_carried], carried):
+                new_nodes.append(Node(op_type="Identity", inputs=[cur],
+                                      outputs=[out_name],
+                                      name=prefix0 + "carry_out"))
+            for s in range(n_scan):
+                out_name = node.outputs[n_carried + s]
+                cat = Node(op_type="Concat", inputs=scan_parts[s],
+                           outputs=[out_name], name=prefix0 + f"scan{s}")
+                cat.attrs["axis"] = Attribute(name="axis", type=2, i=0)
+                new_nodes.append(cat)
+            g.nodes[idx:idx + 1] = new_nodes
+            changed = True
+            any_change = True
+            break
+    return any_change
 
 
 def import_model(model_bytes: bytes,

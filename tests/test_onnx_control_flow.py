@@ -125,3 +125,104 @@ class TestConstantIf:
         fn = OnnxFunction(m)
         with pytest.raises(NotImplementedError, match="If"):
             fn({"x": np.asarray([1.0, 2.0], np.float32)})
+
+
+class TestConstantLoop:
+    def _loop_model(self, trips, n_scan=1):
+        """Loop: carry c = c + x each iteration; scan output = current c."""
+        body_nodes = [Node(op_type="Add", inputs=["c_in", "x"],
+                           outputs=["c_out"])]
+        body_outputs = [_vi("cond_out", []), _vi("c_out", [2])]
+        if n_scan:
+            body_nodes.append(Node(op_type="Identity", inputs=["c_out"],
+                                   outputs=["scan0"]))
+            body_outputs.append(_vi("scan0", [2]))
+        body = Graph(
+            nodes=body_nodes,
+            initializers={},
+            inputs=[_vi("iter", []), _vi("cond_in", []), _vi("c_in", [2])],
+            outputs=body_outputs, name="body")
+        # cond_out passes cond_in through unchanged (while-true for-loop)
+        body.nodes.insert(0, Node(op_type="Identity", inputs=["cond_in"],
+                                  outputs=["cond_out"]))
+        outputs = [_vi("c_final", [2])]
+        loop_outputs = ["c_final"]
+        if n_scan:
+            outputs.append(_vi("stacked", [trips, 2]))
+            loop_outputs.append("stacked")
+        loop = Node(op_type="Loop", inputs=["M", "lcond", "c0"],
+                    outputs=loop_outputs, name="the_loop",
+                    attrs={"body": Attribute(name="body", type=5, g=body)})
+        inits = {"M": Tensor.from_array("M", np.asarray(trips, np.int64)),
+                 "lcond": Tensor.from_array("lcond",
+                                            np.asarray(True, np.bool_)),
+                 "c0": Tensor.from_array("c0",
+                                         np.zeros(2, np.float32))}
+        return Model(graph=Graph(nodes=[loop], initializers=inits,
+                                 inputs=[_vi("x", [2])],
+                                 outputs=outputs, name="g"), opset=17)
+
+    def test_unrolled_carry_and_scan(self):
+        m = self._loop_model(trips=4)
+        fn = OnnxFunction(Model.parse(m.encode()))
+        x = np.asarray([1.0, 2.0], np.float32)
+        out = fn({"x": x})
+        np.testing.assert_allclose(np.asarray(out["c_final"]), x * 4)
+        want = np.stack([x * (i + 1) for i in range(4)])
+        np.testing.assert_allclose(np.asarray(out["stacked"]), want)
+
+    def test_carry_only_loop(self):
+        m = self._loop_model(trips=3, n_scan=0)
+        fn = OnnxFunction(m)
+        x = np.asarray([2.0, -1.0], np.float32)
+        np.testing.assert_allclose(np.asarray(fn({"x": x})["c_final"]),
+                                   x * 3)
+
+    def test_data_dependent_trip_count_fails_loud(self):
+        m = self._loop_model(trips=2, n_scan=0)
+        # make M a graph input instead of an initializer
+        del m.graph.initializers["M"]
+        m.graph.inputs.append(_vi("M", []))
+        fn = OnnxFunction(m)
+        with pytest.raises(NotImplementedError, match="Loop"):
+            fn({"x": np.asarray([1.0, 1.0], np.float32),
+                "M": np.asarray(2, np.int64)})
+
+    def test_body_input_default_does_not_shadow_carry(self):
+        """A body initializer NAMING a body input is that input's default;
+        Loop always binds iter/cond/carried, so the default must not
+        overwrite the carried chain (code-review r4: reproduced [100,100]
+        instead of [3,3] before the guard)."""
+        m = self._loop_model(trips=3, n_scan=0)
+        body = m.graph.nodes[-1].attr("body")
+        body.initializers["c_in"] = Tensor.from_array(
+            "c_in", np.full(2, 99.0, np.float32))
+        fn = OnnxFunction(m)
+        x = np.asarray([1.0, 1.0], np.float32)
+        np.testing.assert_allclose(np.asarray(fn({"x": x})["c_final"]),
+                                   x * 3)
+
+    def test_loop_inside_if_inside_loop_fixpoint(self):
+        """Nested control flow resolves through the shared fixpoint: an If
+        exposed by unrolling contains another Loop (code-review r4)."""
+        inner_loop_model = self._loop_model(trips=2, n_scan=0)
+        inner_loop = inner_loop_model.graph.nodes[-1]
+        then_g = Graph(
+            nodes=[inner_loop],
+            initializers=dict(inner_loop_model.graph.initializers),
+            inputs=[], outputs=[_vi("c_final", [2])], name="tb")
+        if_node = Node(op_type="If", inputs=["icond"], outputs=["y"],
+                       name="mid_if",
+                       attrs={"then_branch": Attribute(name="then_branch",
+                                                       type=5, g=then_g),
+                              "else_branch": Attribute(name="else_branch",
+                                                       type=5, g=then_g)})
+        m = Model(graph=Graph(
+            nodes=[if_node],
+            initializers={"icond": Tensor.from_array(
+                "icond", np.asarray(True, np.bool_))},
+            inputs=[_vi("x", [2])], outputs=[_vi("y", [2])], name="g"),
+            opset=17)
+        fn = OnnxFunction(m)
+        x = np.asarray([1.5, -2.0], np.float32)
+        np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]), x * 2)

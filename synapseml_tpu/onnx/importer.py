@@ -262,6 +262,11 @@ def _inline_constant_ifs(g: Graph) -> bool:
             if branch is None:
                 continue
             prefix = (node.name or f"if_{idx}") + "/"
+            if len(branch.outputs) != len(node.outputs):
+                raise ValueError(
+                    f"If node {node.name or idx!r}: chosen branch declares "
+                    f"{len(branch.outputs)} outputs but the If node has "
+                    f"{len(node.outputs)} — malformed model")
             # branch outputs (positional) -> If outputs; a branch output the
             # branch neither produces nor initializes is a PASSTHROUGH of a
             # captured outer tensor — bridge it with Identity instead of

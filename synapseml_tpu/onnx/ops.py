@@ -1217,10 +1217,22 @@ def _tree_classifier(node, X):
     labels = np.asarray(labels, np.int64)
     cls_ids = np.asarray(node.attr("class_ids"), np.int64)
     ncols = int(cls_ids.max()) + 1 if len(cls_ids) else 1
+    base_attr = node.attr("base_values")
+    if base_attr is not None:
+        nb = len(np.asarray(base_attr).ravel())
+        if nb != ncols and not (nb == len(labels) and nb >= ncols):
+            raise ValueError(
+                f"TreeEnsembleClassifier: base_values has {nb} entries; "
+                f"expected {ncols} (weight columns) or {len(labels)} "
+                "(class labels, when that covers every weight column)")
+        # ORT semantics: a base value per LABEL widens the score matrix —
+        # weight columns land at their class_ids, other columns are base-only
+        ncols = max(ncols, nb)
     table = _leaf_weight_table(tables, node.attr("class_treeids"),
                                node.attr("class_nodeids"), cls_ids,
                                node.attr("class_weights"), ncols)
-    base = np.asarray(node.attr("base_values", [0.0] * ncols), np.float32)
+    base = np.asarray(base_attr if base_attr is not None
+                      else [0.0] * ncols, np.float32)
     pos = _tree_walk(X, tables)
     scores = jnp.asarray(table)[pos].sum(axis=1) + jnp.asarray(base)
     # onnxmltools-style binary emission: one weight column for two labels.
@@ -1619,17 +1631,17 @@ def _nms(node, boxes, scores, max_out=None, iou_thr=None, score_thr=None):
                                                   jnp.int32(0)))
         return picked
 
-    rows = []
-    for b in range(B):
-        iou_mat = iou(b)
-        per_b = jax.vmap(lambda s, m=iou_mat: per_class(m, s))(scores[b])
-        for c in range(nC):
-            picked = per_b[c]
-            bc = jnp.stack([jnp.where(picked >= 0, b, -1),
-                            jnp.where(picked >= 0, c, -1),
-                            picked], axis=1)
-            rows.append(bc)
-    return jnp.concatenate(rows, axis=0).astype(jnp.int64)
+    def per_batch(iou_mat, sc_b):
+        return jax.vmap(lambda s: per_class(iou_mat, s))(sc_b)
+
+    iou_all = jax.vmap(iou)(jnp.arange(B))              # (B, nB, nB)
+    picked = jax.vmap(per_batch)(iou_all, scores)       # (B, nC, M)
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None, None], picked.shape)
+    c_idx = jnp.broadcast_to(jnp.arange(nC)[None, :, None], picked.shape)
+    valid = picked >= 0
+    out = jnp.stack([jnp.where(valid, b_idx, -1),
+                     jnp.where(valid, c_idx, -1), picked], axis=-1)
+    return out.reshape(-1, 3).astype(jnp.int64)
 
 
 # --- com.microsoft contrib ops (ORT-optimized transformer graphs) ----------

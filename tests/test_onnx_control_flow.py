@@ -226,3 +226,15 @@ class TestConstantLoop:
         fn = OnnxFunction(m)
         x = np.asarray([1.5, -2.0], np.float32)
         np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]), x * 2)
+
+
+class TestMalformedIf:
+    def test_branch_output_count_mismatch_fails_loud(self):
+        """A branch declaring fewer outputs than the If node must raise a
+        descriptive import error, not leave dangling outputs (ADVICE r4)."""
+        m = _if_model(True, _branch(3.0), _branch(5.0))
+        if_node = m.graph.nodes[-1]
+        if_node.outputs = ["y", "z"]
+        m.graph.outputs.append(_vi("z", [2]))
+        with pytest.raises(ValueError, match="declares 1 outputs"):
+            OnnxFunction(m)

@@ -268,3 +268,62 @@ class TestEdgeCases:
         assert m2.ml_opset == 3
         assert m2.opset == 17          # domain'd entry must not clobber it
         assert m2.graph.nodes[0].domain == "ai.onnx.ml"
+
+
+class TestBaseValuesPerLabel:
+    """ORT semantics for base_values sized to the LABEL count while weights
+    occupy fewer columns (code-review r5): the score matrix widens to the
+    label count — weights land at their class_ids, remaining columns are
+    base-only — instead of broadcasting (N,1)+(2,) into garbage."""
+
+    @staticmethod
+    def _stump(base_values, class_ids, weights, labels=(0, 1)):
+        from synapseml_tpu.onnx.modelgen import _attr, _vi
+        from synapseml_tpu.onnx.protoio import Attribute, Graph, Node
+        from synapseml_tpu.onnx.treeensemble import _strs_attr
+
+        k = len(class_ids)
+        attrs = {
+            "nodes_treeids": _attr("nodes_treeids", [0]),
+            "nodes_nodeids": _attr("nodes_nodeids", [0]),
+            "nodes_featureids": _attr("nodes_featureids", [0]),
+            "nodes_values": Attribute(name="nodes_values", type=6,
+                                      floats=[0.0]),
+            "nodes_modes": _strs_attr("nodes_modes", ["LEAF"]),
+            "nodes_truenodeids": _attr("nodes_truenodeids", [0]),
+            "nodes_falsenodeids": _attr("nodes_falsenodeids", [0]),
+            "nodes_missing_value_tracks_true":
+                _attr("nodes_missing_value_tracks_true", [0]),
+            "classlabels_int64s": _attr("classlabels_int64s", list(labels)),
+            "class_treeids": _attr("class_treeids", [0] * k),
+            "class_nodeids": _attr("class_nodeids", [0] * k),
+            "class_ids": _attr("class_ids", list(class_ids)),
+            "class_weights": Attribute(name="class_weights", type=6,
+                                       floats=[float(w) for w in weights]),
+            "base_values": Attribute(name="base_values", type=6,
+                                     floats=[float(b) for b in base_values]),
+            "post_transform": _attr("post_transform", "NONE"),
+        }
+        node = Node(op_type="TreeEnsembleClassifier", inputs=["X"],
+                    outputs=["label", "probabilities"], attrs=attrs,
+                    domain="ai.onnx.ml")
+        g = Graph(nodes=[node], initializers={},
+                  inputs=[_vi("X", ["N", 1])],
+                  outputs=[_vi("label", ["N"]),
+                           _vi("probabilities", ["N", len(labels)])],
+                  name="g")
+        return Model(graph=g, opset=17)
+
+    def test_base_per_label_widens_scores(self):
+        m = self._stump(base_values=[0.25, -0.5], class_ids=[0],
+                        weights=[2.0])
+        out = _run(m, np.asarray([[1.0]], np.float32))
+        np.testing.assert_allclose(np.asarray(out["probabilities"]),
+                                   [[2.25, -0.5]], rtol=1e-6)
+        assert int(np.asarray(out["label"])[0]) == 0
+
+    def test_uncovered_weight_column_rejected(self):
+        m = self._stump(base_values=[0.1, 0.2], class_ids=[0, 1, 2],
+                        weights=[1.0, 1.0, 1.0])
+        with pytest.raises(ValueError, match="base_values has 2"):
+            _run(m, np.asarray([[1.0]], np.float32))

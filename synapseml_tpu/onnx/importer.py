@@ -25,15 +25,32 @@ from .protoio import Graph, Model, Node
 
 
 class OnnxFunction:
-    """Callable wrapper: ``fn(feeds: dict) -> dict`` over requested outputs."""
+    """Callable wrapper: ``fn(feeds: dict) -> dict`` over requested outputs.
+
+    Control flow: constant-condition If / constant-trip Loop are resolved at
+    import (inlined/unrolled below); DATA-dependent If/Loop/Scan execute at
+    runtime through lax.cond / lax.while_loop / lax.scan (the ONNX Runtime
+    parity surface — deep-learning/.../onnx/ONNXModel.scala:145-423 runs any
+    such graph through ORT). XLA's static-shape model imposes two honest
+    restrictions, both validated loudly: If branches must produce matching
+    shapes/dtypes, and a Loop with scan outputs needs a static trip bound
+    (``max_loop_trips`` caps it when the trip count is data-dependent; scan
+    outputs are zero-padded to the bound when the loop exits early, and an
+    eager run that HITS the cap with its condition still true raises — under
+    jit that truncation cannot be detected and is silent).
+    """
 
     def __init__(self, model: Model, outputs: Optional[Sequence[str]] = None,
-                 precision: str = "float32"):
+                 precision: str = "float32", max_loop_trips: int = 128):
         if precision not in ("float32", "bfloat16"):
             raise ValueError(f"precision must be 'float32' or 'bfloat16', "
                              f"got {precision!r}")
+        if int(max_loop_trips) < 1:
+            raise ValueError(f"max_loop_trips must be >= 1, "
+                             f"got {max_loop_trips}")
         self.model = model
         self.precision = precision
+        self.max_loop_trips = int(max_loop_trips)
         g = model.graph
         # shared fixpoint: unrolling a Loop can expose constant Ifs and
         # vice versa (nested control flow) — alternate until neither changes
@@ -47,8 +64,11 @@ class OnnxFunction:
         self._plan = self._make_plan(g, self.outputs)
         # decode weights ONCE — Tensor.array() copies, and models carry
         # hundreds of MB of initializers; only tensors the sliced plan
-        # actually reads are decoded (dead-tail weights stay raw bytes)
-        used = {i for n in self._plan for i in n.inputs} | set(self.outputs)
+        # actually reads are decoded (dead-tail weights stay raw bytes).
+        # _node_reads includes subgraph-captured names: a runtime If/Loop
+        # body referencing an outer initializer by name must find it decoded
+        used = ({i for n in self._plan for i in _node_reads(n)}
+                | set(self.outputs))
         self._weights = {k: t.array() for k, t in g.initializers.items()
                          if k in used}
         self._bf16 = None
@@ -100,7 +120,7 @@ class OnnxFunction:
                 raise ValueError(f"cycle through {name!r}")
             in_stack.add(id(n))
             work.append((name, True))
-            for i in reversed(n.inputs):
+            for i in reversed(_node_reads(n)):
                 work.append((i, False))
         return plan
 
@@ -117,14 +137,29 @@ class OnnxFunction:
                     f"missing input {name!r}; expected {self.graph_inputs}")
         for name, v in feeds.items():
             env[name] = self._down(v)
-        for node in self._plan:
-            impl = REGISTRY.get(node.op_type)
-            if impl is None:
-                raise NotImplementedError(
-                    f"ONNX op {node.op_type!r} (node {node.name!r}) is not "
-                    f"supported; supported: {sorted(REGISTRY)}")
-            args = [env[i] if i else None for i in node.inputs]
-            out = impl(node, *args)
+        self._run_nodes(self._plan, env)
+        bf16 = self._bf16
+        return {o: (env[o].astype(np.float32)
+                    if bf16 is not None
+                    and getattr(env[o], "dtype", None) == bf16
+                    else env[o])
+                for o in self.outputs}
+
+    def _run_nodes(self, nodes: Sequence[Node], env: Dict) -> None:
+        """Evaluate ``nodes`` (topological) into ``env`` in place — shared by
+        the top-level plan and by control-flow subgraph bodies (which call it
+        under a lax.cond/while_loop/scan trace)."""
+        for node in nodes:
+            if node.op_type in ("If", "Loop", "Scan"):
+                out = getattr(self, "_exec_" + node.op_type.lower())(node, env)
+            else:
+                impl = REGISTRY.get(node.op_type)
+                if impl is None:
+                    raise NotImplementedError(
+                        f"ONNX op {node.op_type!r} (node {node.name!r}) is "
+                        f"not supported; supported: {sorted(REGISTRY)}")
+                args = [env[i] if i else None for i in node.inputs]
+                out = impl(node, *args)
             if not isinstance(out, tuple):
                 out = (out,)
             for name, val in zip(node.outputs, out):
@@ -135,12 +170,235 @@ class OnnxFunction:
                     # guarding a softmax) keeps the precision it asked for
                     env[name] = (val if node.op_type == "Cast"
                                  else self._down(val))
-        bf16 = self._bf16
-        return {o: (env[o].astype(np.float32)
-                    if bf16 is not None
-                    and getattr(env[o], "dtype", None) == bf16
-                    else env[o])
-                for o in self.outputs}
+
+    def _sub_info(self, sub: Graph) -> Tuple[Dict, List[str]]:
+        """(decoded initializers, sorted captured names) for a control-flow
+        subgraph, cached per graph object — bodies execute once per
+        minibatch and must not re-decode weights or re-walk scopes each
+        time (the top-level decode-ONCE policy, extended to subgraphs)."""
+        if not hasattr(self, "_subcache"):
+            self._subcache = {}
+        info = self._subcache.get(id(sub))
+        if info is None:
+            info = ({k: self._down(t.array())
+                     for k, t in sub.initializers.items()},
+                    sorted(_free_names(sub)))
+            self._subcache[id(sub)] = info
+        return info
+
+    def _run_subgraph(self, sub: Graph, bindings: Dict) -> tuple:
+        """Run a control-flow body: fresh scope = decoded body initializers,
+        overwritten by formal-input/captured ``bindings`` (Loop always binds
+        iter/cond/carried OVER an initializer naming a body input — that
+        initializer is the input's default, not the carried chain)."""
+        sub_env = dict(self._sub_info(sub)[0])
+        sub_env.update(bindings)
+        self._run_nodes(sub.nodes, sub_env)
+        return tuple(sub_env[vi.name] for vi in sub.outputs)
+
+    def _exec_if(self, node: Node, env: Dict):
+        """Data-dependent If → lax.cond. Both branches trace; XLA requires
+        them to produce matching shapes/dtypes (validated loudly)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        then_g, else_g = node.attr("then_branch"), node.attr("else_branch")
+        if then_g is None or else_g is None:
+            raise ValueError(f"If node {node.name!r}: missing branch subgraph")
+        for bname, br in (("then", then_g), ("else", else_g)):
+            if len(br.outputs) != len(node.outputs):
+                raise ValueError(
+                    f"If node {node.name!r}: {bname} branch declares "
+                    f"{len(br.outputs)} outputs but the If node has "
+                    f"{len(node.outputs)}")
+        captured = sorted(set(self._sub_info(then_g)[1])
+                          | set(self._sub_info(else_g)[1]))
+        cap_vals = tuple(env[c] for c in captured)
+
+        def branch(sub):
+            return lambda ops: self._run_subgraph(sub,
+                                                  dict(zip(captured, ops)))
+
+        # abstract-trace both branches up front: a mismatch gets a
+        # descriptive error; a genuine op failure keeps its own traceback
+        a_then = jax.eval_shape(branch(then_g), cap_vals)
+        a_else = jax.eval_shape(branch(else_g), cap_vals)
+        bad = [(t, e) for t, e in zip(a_then, a_else)
+               if t.shape != e.shape or t.dtype != e.dtype]
+        if bad:
+            raise ValueError(
+                f"If node {node.name!r}: a runtime (data-dependent) If needs "
+                f"both branches to produce matching shapes/dtypes — XLA "
+                f"compiles both and selects at run time. Mismatches: "
+                + "; ".join(f"then {t.shape}/{t.dtype} vs else "
+                            f"{e.shape}/{e.dtype}" for t, e in bad))
+        pred = jnp.asarray(env[node.inputs[0]]).ravel()[0] != 0
+        return lax.cond(pred, branch(then_g), branch(else_g), cap_vals)
+
+    def _exec_loop(self, node: Node, env: Dict):
+        """Data-dependent Loop → lax.while_loop. Carried-only loops support a
+        fully dynamic trip count/condition; scan outputs need a static buffer
+        (trip count when statically known, else ``max_loop_trips``) and are
+        zero-padded past the actual exit iteration."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        body = node.attr("body")
+        if body is None:
+            raise ValueError(f"Loop node {node.name!r}: missing body graph")
+        m_name = node.inputs[0] if node.inputs else ""
+        c_name = node.inputs[1] if len(node.inputs) > 1 else ""
+        carried_names = list(node.inputs[2:])
+        n_carried = len(carried_names)
+        n_scan = len(node.outputs) - n_carried
+        body_in = [vi.name for vi in body.inputs]
+        if len(body_in) != 2 + n_carried or n_scan < 0 or \
+                len(body.outputs) != 1 + n_carried + n_scan:
+            raise ValueError(
+                f"Loop node {node.name!r}: body signature mismatch — body "
+                f"({len(body_in)} in, {len(body.outputs)} out) vs node "
+                f"({n_carried} carried, {n_scan} scan outputs)")
+        captured = self._sub_info(body)[1]
+        cap = {c: env[c] for c in captured}
+        m_val = env[m_name] if m_name else None
+        cond0 = env[c_name] if c_name else np.asarray(True)
+        m_static = None
+        if m_val is not None:
+            try:
+                m_static = int(np.asarray(m_val).ravel()[0])
+            except (jax.errors.ConcretizationTypeError, TypeError,
+                    jax.errors.TracerArrayConversionError):
+                m_static = None        # trip count is data-dependent
+            if m_static is not None and m_static >= 2**31 - 1:
+                # torch serializes `while cond:` as Loop with trip_count
+                # INT64_MAX — an unbounded sentinel, not a real bound (an
+                # int32 compare against it would overflow and never iterate)
+                m_val = m_static = None
+        bound = m_static if m_static is not None else self.max_loop_trips
+
+        def run_body(i, c, carried):
+            bindings = dict(cap)
+            bindings[body_in[0]] = jnp.asarray(i, jnp.int32)
+            bindings[body_in[1]] = c
+            bindings.update(zip(body_in[2:], carried))
+            outs = self._run_subgraph(body, bindings)
+            cond_out = jnp.asarray(outs[0]).ravel()[0] != 0
+            return (cond_out, tuple(outs[1:1 + n_carried]),
+                    tuple(outs[1 + n_carried:]))
+
+        carried0 = tuple(jnp.asarray(env[i]) for i in carried_names)
+        c0 = jnp.asarray(cond0).ravel()[0] != 0
+        # one abstract body trace: scan-output shapes AND a descriptive
+        # carried-aval invariance check (while_loop's own TypeError would
+        # shadow genuine op errors if we blanket-caught it)
+        a_cond, a_carried, a_scans = jax.eval_shape(
+            lambda c, car: run_body(0, c, car), c0, carried0)
+        bad = [(v, a) for v, a in zip(carried0, a_carried)
+               if v.shape != a.shape or v.dtype != a.dtype]
+        if bad:
+            raise ValueError(
+                f"Loop node {node.name!r}: carried state must keep a fixed "
+                f"shape/dtype across iterations (XLA while_loop). "
+                "Mismatches: " + "; ".join(
+                    f"in {i.shape}/{i.dtype} vs out {o.shape}/{o.dtype}"
+                    for i, o in bad))
+        bufs0 = tuple(jnp.zeros((bound,) + s.shape, s.dtype)
+                      for s in a_scans) if n_scan else ()
+
+        def cond_fn(st):
+            i, c = st[0], st[1]
+            ok = c
+            if m_val is not None:
+                m = jnp.asarray(m_val, jnp.int32).ravel()[0]
+                # a traced INT64_MAX while-sentinel wraps negative at the
+                # x64-disabled boundary; any negative M means "no bound"
+                ok = ok & ((i < m) | (m < 0))
+            if n_scan and m_static is None:
+                ok = ok & (i < bound)   # scan buffers are statically sized
+            return ok
+
+        def body_fn(st):
+            i, c, carried, bufs = st
+            c2, carried2, scans = run_body(i, c, carried)
+            bufs2 = tuple(b.at[i].set(s) for b, s in zip(bufs, scans))
+            return (i + 1, c2, carried2, bufs2)
+
+        final_i, final_c, carried, bufs = lax.while_loop(
+            cond_fn, body_fn, (jnp.int32(0), c0, carried0, bufs0))
+        if n_scan and m_static is None:
+            # the static scan buffer imposed the cap; exiting WITH the
+            # condition still true means results were truncated — raise
+            # when that is concretely checkable (eager path); under jit
+            # the check cannot run and the truncation is documented
+            try:
+                if bool(final_c) and int(final_i) >= bound:
+                    raise ValueError(
+                        f"Loop node {node.name!r}: exited at "
+                        f"max_loop_trips={bound} with its condition still "
+                        f"true — scan outputs would be truncated. Raise "
+                        f"max_loop_trips.")
+            except jax.errors.ConcretizationTypeError:
+                pass       # traced: the cap is not concretely checkable
+        return tuple(carried) + tuple(bufs)
+
+    def _exec_scan(self, node: Node, env: Dict):
+        """ONNX Scan → lax.scan (the natural fit: fixed trip count from the
+        scan-input length, carried state + stacked outputs)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        body = node.attr("body")
+        n_scan_in = int(node.attr("num_scan_inputs", 0))
+        if body is None or not n_scan_in:
+            raise ValueError(f"Scan node {node.name!r}: missing body or "
+                             f"num_scan_inputs")
+        n_state = len(node.inputs) - n_scan_in
+        n_scan_out = len(node.outputs) - n_state
+        body_in = [vi.name for vi in body.inputs]
+        if len(body_in) != len(node.inputs) or n_state < 0 or \
+                n_scan_out < 0 or len(body.outputs) != len(node.outputs):
+            raise ValueError(
+                f"Scan node {node.name!r}: body signature mismatch")
+        in_axes = node.attr("scan_input_axes") or [0] * n_scan_in
+        in_dirs = node.attr("scan_input_directions") or [0] * n_scan_in
+        out_axes = node.attr("scan_output_axes") or [0] * n_scan_out
+        out_dirs = node.attr("scan_output_directions") or [0] * n_scan_out
+        init = tuple(jnp.asarray(env[i]) for i in node.inputs[:n_state])
+        xs = []
+        for k, nm in enumerate(node.inputs[n_state:]):
+            x = jnp.moveaxis(jnp.asarray(env[nm]), int(in_axes[k]), 0)
+            if int(in_dirs[k]):
+                x = jnp.flip(x, 0)
+            xs.append(x)
+        captured = self._sub_info(body)[1]
+        cap = {c: env[c] for c in captured}
+
+        def f(carry, x):
+            bindings = dict(cap)
+            bindings.update(zip(body_in[:n_state], carry))
+            bindings.update(zip(body_in[n_state:], x))
+            outs = self._run_subgraph(body, bindings)
+            return tuple(outs[:n_state]), tuple(outs[n_state:])
+
+        a_carry, _ = jax.eval_shape(f, init, tuple(x[0] for x in xs))
+        bad = [(v, a) for v, a in zip(init, a_carry)
+               if v.shape != a.shape or v.dtype != a.dtype]
+        if bad:
+            raise ValueError(
+                f"Scan node {node.name!r}: carried state must keep a fixed "
+                f"shape/dtype across iterations (lax.scan). Mismatches: "
+                + "; ".join(f"in {i.shape}/{i.dtype} vs out "
+                            f"{o.shape}/{o.dtype}" for i, o in bad))
+        carry, ys = lax.scan(f, init, tuple(xs))
+        ys2 = []
+        for k, y in enumerate(ys):
+            if int(out_dirs[k]):
+                y = jnp.flip(y, 0)
+            ys2.append(jnp.moveaxis(y, 0, int(out_axes[k])))
+        return tuple(carry) + tuple(ys2)
 
     def as_jax(self, names: Optional[List[str]] = None):
         """(fn, input_names): positional jit-friendly callable. ``names``
@@ -151,6 +409,38 @@ class OnnxFunction:
             return tuple(self({n: a for n, a in zip(names, arrays)}).values())
 
         return fn, names
+
+
+def _free_names(sub: Graph) -> set:
+    """Outer-scope tensor names a subgraph captures: referenced by its nodes
+    (or returned as passthrough outputs) but neither produced inside it, nor
+    among its initializers, nor its formal inputs. Nested subgraphs recurse —
+    an inner capture bound at this level is not free here."""
+    bound = ({o for n in sub.nodes for o in n.outputs if o}
+             | set(sub.initializers) | {vi.name for vi in sub.inputs})
+    free = set()
+    for n in sub.nodes:
+        for i in n.inputs:
+            if i and i not in bound:
+                free.add(i)
+        for a in n.attrs.values():
+            if a.g is not None:
+                free |= _free_names(a.g) - bound
+    for vi in sub.outputs:
+        if vi.name and vi.name not in bound:
+            free.add(vi.name)
+    return free
+
+
+def _node_reads(n: Node) -> List[str]:
+    """Every outer tensor ``n`` consumes: declared inputs plus names its
+    subgraph attributes capture by scope (If branches / Loop & Scan bodies
+    reference outer tensors that never appear in node.inputs)."""
+    reads = list(n.inputs)
+    for a in n.attrs.values():
+        if a.g is not None:
+            reads.extend(sorted(_free_names(a.g)))
+    return reads
 
 
 def _resolve_constant(g: Graph, name: str, _depth: int = 0,
@@ -244,8 +534,8 @@ def _inline_constant_ifs(g: Graph) -> bool:
     Branch-internal tensors are prefixed to avoid collisions; branch
     outputs map positionally onto the If node's outputs. Runs to fixpoint
     so nested constant Ifs inline too. A DATA-dependent If stays in place
-    and fails at execution with the executor's unsupported-op error —
-    XLA's static shapes cannot express it."""
+    and executes at runtime through lax.cond (OnnxFunction._exec_if) —
+    inlining the constant case keeps XLA from compiling both branches."""
     any_change = False
     changed = True
     while changed:
@@ -299,7 +589,9 @@ def _unroll_constant_loops(g: Graph) -> bool:
     (iter_num, cond_in, carried...), outputs (cond_out, carried_out...,
     scan_outputs...); scan outputs stack along a new axis 0 via Unsqueeze +
     Concat of per-iteration slices. Data-dependent trip counts / conditions
-    stay in place and fail loud at execution (XLA static shapes)."""
+    stay in place and execute through lax.while_loop
+    (OnnxFunction._exec_loop); unrolling the constant case gives XLA
+    straight-line code to fuse across iterations."""
     from .protoio import Attribute, Tensor
 
     any_change = False

@@ -43,6 +43,11 @@ class ONNXModel(Transformer):
                            "runs matmuls/convs as bf16 MXU operands with f32 "
                            "accumulation (TPU mixed-precision inference)",
                            str, "float32")
+    maxLoopTrips = Param("maxLoopTrips", "static iteration cap for runtime "
+                         "ONNX Loop nodes whose trip count is data-dependent "
+                         "AND that have scan outputs (XLA needs a static "
+                         "buffer; outputs are zero-padded past the exit)",
+                         int, 128)
 
     # class-level defaults so instances materialized by save/load or copy
     # (which bypass __init__) still lazy-init their caches
@@ -90,8 +95,9 @@ class ONNXModel(Transformer):
             model = fold_constants(ProtoModel.parse(bytes(payload)))
             fetch = self.get("fetchDict") or {}
             outputs = sorted(fetch.values()) if fetch else None
-            self._fn_cache = OnnxFunction(model, outputs,
-                                          precision=self.getFloatPrecision())
+            self._fn_cache = OnnxFunction(
+                model, outputs, precision=self.getFloatPrecision(),
+                max_loop_trips=self.get("maxLoopTrips"))
         return self._fn_cache
 
     def modelInput(self) -> Dict[str, dict]:

@@ -1,9 +1,11 @@
-"""ONNX If with constant conditions (TorchScript-exported control flow).
+"""ONNX control flow: constant If/Loop resolve at import, data-dependent
+If/Loop/Scan execute at runtime (lax.cond / lax.while_loop / lax.scan).
 
-Exported models branch on traced config flags that serialize as constants;
-the importer inlines the chosen branch at import time (opset If semantics:
-branch subgraphs have no inputs and capture outer tensors by name). A
-data-dependent If stays unsupported — XLA's static shapes cannot express it.
+Exported models branching on traced config flags serialize constants — the
+importer inlines/unrolls those at import (opset If semantics: branch
+subgraphs have no inputs and capture outer tensors by name). Anything
+data-dependent runs through the runtime executors, matching ONNX Runtime's
+behavior (the reference's ONNXModel.scala:145-423 executes any such graph).
 """
 
 import numpy as np
@@ -113,7 +115,10 @@ class TestConstantIf:
         np.testing.assert_allclose(np.asarray(fn({"x": x})["y"]),
                                    (x + 1.0) * 10.0)
 
-    def test_data_dependent_if_fails_loud(self):
+    def test_data_dependent_if_executes_at_runtime(self):
+        """A condition derived from a graph input is not inlinable; the
+        executor runs it through lax.cond (ONNXModel.scala:145-423 parity —
+        ORT executes any If)."""
         n = Node(op_type="Greater", inputs=["x", "zero"], outputs=["gt"])
         red = Node(op_type="ReduceMax", inputs=["gt"], outputs=["cond"],
                    attrs={"keepdims": _attr("keepdims", 0)})
@@ -122,8 +127,51 @@ class TestConstantIf:
                       extra_inits={"zero": Tensor.from_array(
                           "zero", np.float32(0))})
         del m.graph.initializers["cond"]
+        fn = OnnxFunction(Model.parse(m.encode()))
+        x_pos = np.asarray([1.0, 2.0], np.float32)
+        x_neg = np.asarray([-1.0, -2.0], np.float32)
+        np.testing.assert_allclose(np.asarray(fn({"x": x_pos})["y"]),
+                                   x_pos * 3.0)
+        np.testing.assert_allclose(np.asarray(fn({"x": x_neg})["y"]),
+                                   x_neg * 5.0)
+
+    def test_runtime_if_under_jit(self):
+        """The runtime If must trace: one compiled function, both paths."""
+        import jax
+
+        n = Node(op_type="Greater", inputs=["x", "zero"], outputs=["gt"])
+        red = Node(op_type="ReduceMax", inputs=["gt"], outputs=["cond"],
+                   attrs={"keepdims": _attr("keepdims", 0)})
+        m = _if_model(True, _branch(3.0), _branch(5.0),
+                      extra_nodes=[n, red],
+                      extra_inits={"zero": Tensor.from_array(
+                          "zero", np.float32(0))})
+        del m.graph.initializers["cond"]
+        f, names = OnnxFunction(m).as_jax()
+        jf = jax.jit(f)
+        x = np.asarray([1.0, 2.0], np.float32)
+        np.testing.assert_allclose(np.asarray(jf(x)[0]), x * 3.0)
+        np.testing.assert_allclose(np.asarray(jf(-x)[0]), -x * 5.0)
+
+    def test_runtime_if_shape_mismatch_fails_loud(self):
+        """Branches with incompatible output shapes cannot compile under
+        lax.cond — the error must say so, not leak a jax internal."""
+        then_g = Graph(
+            nodes=[Node(op_type="Concat", inputs=["x", "x"],
+                        outputs=["wide"],
+                        attrs={"axis": _attr("axis", 0)})],
+            initializers={}, inputs=[], outputs=[_vi("wide", [4])],
+            name="tb")
+        n = Node(op_type="Greater", inputs=["x", "zero"], outputs=["gt"])
+        red = Node(op_type="ReduceMax", inputs=["gt"], outputs=["cond"],
+                   attrs={"keepdims": _attr("keepdims", 0)})
+        m = _if_model(True, then_g, _branch(5.0),
+                      extra_nodes=[n, red],
+                      extra_inits={"zero": Tensor.from_array(
+                          "zero", np.float32(0))})
+        del m.graph.initializers["cond"]
         fn = OnnxFunction(m)
-        with pytest.raises(NotImplementedError, match="If"):
+        with pytest.raises(ValueError, match="matching shapes"):
             fn({"x": np.asarray([1.0, 2.0], np.float32)})
 
 
@@ -178,15 +226,95 @@ class TestConstantLoop:
         np.testing.assert_allclose(np.asarray(fn({"x": x})["c_final"]),
                                    x * 3)
 
-    def test_data_dependent_trip_count_fails_loud(self):
+    def test_data_dependent_trip_count_executes_at_runtime(self):
+        """A trip count fed as a graph input runs through lax.while_loop —
+        fully dynamic for a carried-only loop."""
         m = self._loop_model(trips=2, n_scan=0)
         # make M a graph input instead of an initializer
         del m.graph.initializers["M"]
         m.graph.inputs.append(_vi("M", []))
-        fn = OnnxFunction(m)
-        with pytest.raises(NotImplementedError, match="Loop"):
-            fn({"x": np.asarray([1.0, 1.0], np.float32),
-                "M": np.asarray(2, np.int64)})
+        fn = OnnxFunction(Model.parse(m.encode()))
+        x = np.asarray([1.0, 1.0], np.float32)
+        for trips in (0, 2, 7):
+            np.testing.assert_allclose(
+                np.asarray(fn({"x": x, "M": np.asarray(trips, np.int64)})
+                           ["c_final"]), x * trips)
+
+    def test_dynamic_trip_count_under_jit(self):
+        """One compiled function serves every trip count (while_loop)."""
+        import jax
+
+        m = self._loop_model(trips=2, n_scan=0)
+        del m.graph.initializers["M"]
+        m.graph.inputs.append(_vi("M", []))
+        f, names = OnnxFunction(m).as_jax()
+        assert names == ["x", "M"]
+        jf = jax.jit(f)
+        x = np.asarray([2.0, -1.0], np.float32)
+        for trips in (1, 5):
+            np.testing.assert_allclose(np.asarray(
+                jf(x, np.asarray(trips, np.int32))[0]), x * trips)
+
+    def test_dynamic_trips_with_scan_output(self):
+        """Eagerly a fed M is concrete, so the scan buffer is exact-length;
+        under jit M is a tracer and the buffer pads to max_loop_trips with
+        zeros past the exit (XLA static shapes)."""
+        import jax
+
+        m = self._loop_model(trips=3, n_scan=1)
+        del m.graph.initializers["M"]
+        m.graph.inputs.append(_vi("M", []))
+        fn = OnnxFunction(m, max_loop_trips=6)
+        x = np.asarray([1.0, 2.0], np.float32)
+        out = fn({"x": x, "M": np.asarray(4, np.int64)})
+        np.testing.assert_allclose(np.asarray(out["c_final"]), x * 4)
+        stacked = np.asarray(out["stacked"])
+        assert stacked.shape == (4, 2)      # concrete M: exact length
+        np.testing.assert_allclose(
+            stacked, np.stack([x * (i + 1) for i in range(4)]))
+        f, names = fn.as_jax()
+        assert names == ["x", "M"]
+        c_final, stacked_j = jax.jit(f)(x, np.asarray(4, np.int32))
+        np.testing.assert_allclose(np.asarray(c_final), x * 4)
+        assert np.asarray(stacked_j).shape == (6, 2)   # traced M: padded
+        want = np.stack([x * (i + 1) for i in range(4)]
+                        + [np.zeros(2)] * 2)
+        np.testing.assert_allclose(np.asarray(stacked_j), want)
+
+    def test_data_dependent_condition_early_exit(self):
+        """While-style loop: cond computed IN the body from the carried
+        value stops the iteration (c < 5 with c += x)."""
+        from synapseml_tpu.onnx.protoio import Graph as G
+
+        body = G(
+            nodes=[Node(op_type="Identity", inputs=["cond_in"],
+                        outputs=["_unused_cond"]),
+                   Node(op_type="Add", inputs=["c_in", "x"],
+                        outputs=["c_out"]),
+                   Node(op_type="ReduceMax", inputs=["c_out"],
+                        outputs=["cmax"],
+                        attrs={"keepdims": _attr("keepdims", 0)}),
+                   Node(op_type="Less", inputs=["cmax", "limit"],
+                        outputs=["cond_out"])],
+            initializers={"limit": Tensor.from_array(
+                "limit", np.float32(5.0))},
+            inputs=[_vi("iter", []), _vi("cond_in", []), _vi("c_in", [2])],
+            outputs=[_vi("cond_out", []), _vi("c_out", [2])], name="body")
+        loop = Node(op_type="Loop", inputs=["", "lcond", "c0"],
+                    outputs=["c_final"], name="while_loop",
+                    attrs={"body": Attribute(name="body", type=5, g=body)})
+        m = Model(graph=Graph(
+            nodes=[loop],
+            initializers={"lcond": Tensor.from_array(
+                "lcond", np.asarray(True, np.bool_)),
+                "c0": Tensor.from_array("c0", np.zeros(2, np.float32))},
+            inputs=[_vi("x", [2])], outputs=[_vi("c_final", [2])],
+            name="g"), opset=17)
+        fn = OnnxFunction(Model.parse(m.encode()))
+        x = np.asarray([2.0, 2.0], np.float32)
+        # c: 2,4,6 -> exits when max(c) >= 5 AFTER the 6 update lands
+        np.testing.assert_allclose(
+            np.asarray(fn({"x": x})["c_final"]), x * 3)
 
     def test_body_input_default_does_not_shadow_carry(self):
         """A body initializer NAMING a body input is that input's default;
@@ -238,3 +366,90 @@ class TestMalformedIf:
         m.graph.outputs.append(_vi("z", [2]))
         with pytest.raises(ValueError, match="declares 1 outputs"):
             OnnxFunction(m)
+
+
+class TestScan:
+    def _scan_model(self, reverse=False):
+        """Scan: running sum over xs rows; state s, scan output = each s."""
+        body = Graph(
+            nodes=[Node(op_type="Add", inputs=["s_in", "x_row"],
+                        outputs=["s_out"]),
+                   Node(op_type="Identity", inputs=["s_out"],
+                        outputs=["y_row"])],
+            initializers={},
+            inputs=[_vi("s_in", [2]), _vi("x_row", [2])],
+            outputs=[_vi("s_out", [2]), _vi("y_row", [2])], name="body")
+        attrs = {"body": Attribute(name="body", type=5, g=body),
+                 "num_scan_inputs": _attr("num_scan_inputs", 1)}
+        if reverse:
+            attrs["scan_input_directions"] = _attr(
+                "scan_input_directions", [1])
+            attrs["scan_output_directions"] = _attr(
+                "scan_output_directions", [1])
+        scan = Node(op_type="Scan", inputs=["s0", "xs"],
+                    outputs=["s_final", "ys"], name="the_scan", attrs=attrs)
+        return Model(graph=Graph(
+            nodes=[scan],
+            initializers={"s0": Tensor.from_array(
+                "s0", np.zeros(2, np.float32))},
+            inputs=[_vi("xs", [4, 2])],
+            outputs=[_vi("s_final", [2]), _vi("ys", [4, 2])], name="g"),
+            opset=17)
+
+    def test_running_sum(self):
+        fn = OnnxFunction(Model.parse(self._scan_model().encode()))
+        xs = np.arange(8, dtype=np.float32).reshape(4, 2)
+        out = fn({"xs": xs})
+        np.testing.assert_allclose(np.asarray(out["s_final"]),
+                                   xs.sum(axis=0))
+        np.testing.assert_allclose(np.asarray(out["ys"]),
+                                   np.cumsum(xs, axis=0))
+
+    def test_reverse_direction(self):
+        fn = OnnxFunction(self._scan_model(reverse=True))
+        xs = np.arange(8, dtype=np.float32).reshape(4, 2)
+        out = fn({"xs": xs})
+        np.testing.assert_allclose(np.asarray(out["s_final"]),
+                                   xs.sum(axis=0))
+        # reversed input, reversed output: y[i] = sum of xs[i:]
+        want = np.cumsum(xs[::-1], axis=0)[::-1]
+        np.testing.assert_allclose(np.asarray(out["ys"]), want)
+
+    def test_under_jit(self):
+        import jax
+
+        f, _ = OnnxFunction(self._scan_model()).as_jax()
+        xs = np.arange(8, dtype=np.float32).reshape(4, 2)
+        s_final, ys = jax.jit(f)(xs)
+        np.testing.assert_allclose(np.asarray(s_final), xs.sum(axis=0))
+
+
+class TestLoopTruncationGuard:
+    def test_hitting_the_cap_raises_eagerly(self):
+        """A while-loop with scan outputs that still wants to iterate at
+        max_loop_trips must raise (silent truncation = wrong results)."""
+        body = Graph(
+            nodes=[Node(op_type="Identity", inputs=["cond_in"],
+                        outputs=["cond_out"]),
+                   Node(op_type="Add", inputs=["c_in", "x"],
+                        outputs=["c_out"]),
+                   Node(op_type="Identity", inputs=["c_out"],
+                        outputs=["scan0"])],
+            initializers={},
+            inputs=[_vi("iter", []), _vi("cond_in", []), _vi("c_in", [2])],
+            outputs=[_vi("cond_out", []), _vi("c_out", [2]),
+                     _vi("scan0", [2])], name="body")
+        loop = Node(op_type="Loop", inputs=["", "lcond", "c0"],
+                    outputs=["c_final", "stacked"], name="unbounded",
+                    attrs={"body": Attribute(name="body", type=5, g=body)})
+        m = Model(graph=Graph(
+            nodes=[loop],
+            initializers={"lcond": Tensor.from_array(
+                "lcond", np.asarray(True, np.bool_)),
+                "c0": Tensor.from_array("c0", np.zeros(2, np.float32))},
+            inputs=[_vi("x", [2])],
+            outputs=[_vi("c_final", [2]), _vi("stacked", ["T", 2])],
+            name="g"), opset=17)
+        fn = OnnxFunction(m, max_loop_trips=8)
+        with pytest.raises(ValueError, match="max_loop_trips"):
+            fn({"x": np.ones(2, np.float32)})

@@ -22,3 +22,32 @@ class Gated(nn.Module):
             return torch.tanh(self.a(x))
         else:
             return torch.relu(self.b(x))
+
+
+class DataGated(nn.Module):
+    """Branch condition computed FROM THE INPUT — serializes as an ONNX If
+    whose condition is data-dependent; exercises the runtime lax.cond path
+    (both branches produce the same output shape, as XLA requires)."""
+
+    def __init__(self):
+        super().__init__()
+        self.a = nn.Linear(4, 4)
+        self.b = nn.Linear(4, 4)
+
+    def forward(self, x):
+        if bool(x.sum() > 0):
+            return torch.tanh(self.a(x))
+        else:
+            return torch.relu(self.b(x))
+
+
+class DataLoop(nn.Module):
+    """While-loop whose exit condition depends on the carried value —
+    serializes as an ONNX Loop with a data-dependent condition; exercises
+    the runtime lax.while_loop path (carried-only: fully dynamic)."""
+
+    def forward(self, x):
+        c = torch.zeros_like(x)
+        while bool(c.sum() < 10.0):
+            c = c + x
+        return c

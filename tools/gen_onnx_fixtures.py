@@ -209,11 +209,24 @@ def main() -> int:
     #    buffer — the exact constant-flag pattern the importer's inline
     #    pass exists for. (Scripted modules must live in a real source
     #    file: tools/gated_module.py.)
-    from gated_module import Gated
+    from gated_module import DataGated, DataLoop, Gated
 
     gm = torch.jit.script(Gated())
     x9 = torch.randn(3, 4)
     _export(gm, x9, "torch_scripted_if", opset=14)
+
+    # 10/11. DATA-dependent control flow: condition/exit computed from the
+    #    input — stays an If/Loop node in the exported graph and must run
+    #    through the runtime lax.cond / lax.while_loop executors (the
+    #    reference's ONNXModel runs such graphs through ORT,
+    #    ONNXModel.scala:145-423). Two inputs per fixture: one per branch.
+    dg = torch.jit.script(DataGated())
+    x10 = torch.randn(3, 4)
+    _export(dg, x10, "torch_dynamic_if", opset=14)
+    _export(dg, -torch.abs(x10), "torch_dynamic_if_neg", opset=14)
+    dl = torch.jit.script(DataLoop())
+    x11 = torch.rand(2, 3) + 0.5          # positive: the loop terminates
+    _export(dl, x11, "torch_dynamic_loop", opset=14)
     return 0
 
 

@@ -1,0 +1,279 @@
+"""Deliberately slow, obviously-correct NumPy oracle for GBDT tree growth.
+
+A differential-testing reference (VERDICT r4 #4): plain Python loops and
+scalar arithmetic implementing LightGBM's split semantics — leaf-wise growth,
+ThresholdL1 gain, learned NaN direction, ordered categorical splits with
+cat_l2/cat_smooth, monotone constraints, min_data/min_hessian/min_gain
+validity — written independently from the XLA engine (synapseml_tpu/gbdt/
+grower.py implements the same published semantics vectorized; this file is
+the readable loop form the engine's fori_loop/cumsum machinery is checked
+against). The reference project pins accuracy with tolerance CSVs
+(lightgbm/src/test/resources/benchmarks/); this oracle is the stronger,
+structure-exact analog available without the remote datasets.
+
+NOT implemented (matching the property tests' scope): bagging/GOSS/DART row
+sampling (RNG-sequence specific), feature_fraction < 1, linear trees.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclass
+class OracleParams:
+    num_leaves: int = 31
+    max_depth: int = 0                  # 0 = unlimited
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    learning_rate: float = 1.0
+    max_delta_step: float = 0.0
+    # categorical knobs (LightGBM names)
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    min_data_per_group: int = 100
+    max_cat_to_onehot: int = 4
+    max_cat_threshold: int = 32
+    monotone_constraints: Optional[List[int]] = None
+
+
+@dataclass
+class OracleSplit:
+    gain: float
+    feature: int
+    bin: int                       # numeric: last bin going left
+    # (categorical splits carry left_bins instead; bin stays -1)
+    default_left: bool
+    categorical: bool
+    left_bins: Optional[set] = None    # categorical: raw bin values left
+
+
+@dataclass
+class OracleNode:
+    rows: np.ndarray                   # row indices in this node
+    depth: int = 0
+    split: Optional[OracleSplit] = None
+    left: Optional["OracleNode"] = None
+    right: Optional["OracleNode"] = None
+    value: float = 0.0
+
+
+@dataclass
+class OracleTree:
+    root: OracleNode
+    leaves: List[OracleNode] = field(default_factory=list)
+
+    def predict_raw(self, binned: np.ndarray, nan_bins: np.ndarray):
+        out = np.zeros(binned.shape[0])
+        for r in range(binned.shape[0]):
+            node = self.root
+            while node.split is not None:
+                s = node.split
+                b = int(binned[r, s.feature])
+                if s.categorical:
+                    go_left = b in s.left_bins
+                elif b == int(nan_bins[s.feature]):
+                    go_left = s.default_left
+                else:
+                    go_left = b <= s.bin
+                node = node.left if go_left else node.right
+            out[r] = node.value
+        return out
+
+
+def _threshold_l1(g: float, l1: float) -> float:
+    return math.copysign(max(abs(g) - l1, 0.0), g)
+
+
+def _leaf_objective(g: float, h: float, l1: float, l2: float) -> float:
+    gt = _threshold_l1(g, l1)
+    return gt * gt / (h + l2)
+
+
+def _leaf_output(g: float, h: float, p: OracleParams) -> float:
+    out = -_threshold_l1(g, p.lambda_l1) / (h + p.lambda_l2)
+    if p.max_delta_step > 0:
+        out = min(max(out, -p.max_delta_step), p.max_delta_step)
+    return out
+
+
+def _hist(binned, grad, hess, rows, f: int, B: int):
+    """(B, 3) [sum_g, sum_h, count] for one feature over ``rows`` — the
+    obvious loop."""
+    h = np.zeros((B, 3))
+    for r in rows:
+        b = int(binned[r, f])
+        h[b, 0] += grad[r]
+        h[b, 1] += hess[r]
+        h[b, 2] += 1.0
+    return h
+
+
+def _child_gain(GL, HL, CL, G, H, C, l1, l2, p: OracleParams, parent_obj,
+                mono: int):
+    GR, HR, CR = G - GL, H - HL, C - CL
+    if CL < p.min_data_in_leaf or CR < p.min_data_in_leaf:
+        return -math.inf
+    if HL < p.min_sum_hessian_in_leaf or HR < p.min_sum_hessian_in_leaf:
+        return -math.inf
+    if mono != 0:
+        vl = -GL / (HL + p.lambda_l2)
+        vr = -GR / (HR + p.lambda_l2)
+        if mono > 0 and not (vl <= vr):
+            return -math.inf
+        if mono < 0 and not (vl >= vr):
+            return -math.inf
+    return (_leaf_objective(GL, HL, l1, l2)
+            + _leaf_objective(GR, HR, l1, l2) - parent_obj)
+
+
+def _best_numeric(hist_f, nan_bin: int, B: int, p: OracleParams, mono: int):
+    """Best (gain, bin, default_left) for one numeric feature: every divider
+    t (bins 0..t left), NaN bin routed right naturally (it sits at the end)
+    or added to the left (default_left) — take whichever gains more."""
+    G, H, C = hist_f.sum(axis=0)
+    parent = _leaf_objective(G, H, p.lambda_l1, p.lambda_l2)
+    has_nan = nan_bin < B
+    nanG, nanH, nanC = (hist_f[nan_bin] if has_nan else (0.0, 0.0, 0.0))
+    best = (-math.inf, 0, False)
+    GL = HL = CL = 0.0
+    for t in range(B):
+        GL += hist_f[t, 0]
+        HL += hist_f[t, 1]
+        CL += hist_f[t, 2]
+        g_r = _child_gain(GL, HL, CL, G, H, C, p.lambda_l1, p.lambda_l2,
+                          p, parent, mono)
+        if g_r > best[0]:
+            best = (g_r, t, False)
+        if has_nan:
+            g_l = _child_gain(GL + nanG, HL + nanH, CL + nanC, G, H, C,
+                              p.lambda_l1, p.lambda_l2, p, parent, mono)
+            if g_l > best[0]:
+                best = (g_l, t, True)
+    return best
+
+
+def _best_categorical(hist_f, B: int, n_cats: int, p: OracleParams,
+                      mono: int):
+    """Best (gain, left_bins) for a categorical feature: bins ordered by
+    G/(H + cat_smooth) with thin groups (count < min_data_per_group) last;
+    candidates are sorted-order prefixes (many-vs-many, capped by
+    max_cat_threshold) or single sorted categories when the feature's
+    category count <= max_cat_to_onehot; children and parent gains carry the
+    extra cat_l2."""
+    G, H, C = hist_f.sum(axis=0)
+    l2c = p.lambda_l2 + p.cat_l2
+    parent = _leaf_objective(G, H, p.lambda_l1, l2c)
+    usable = [(b, hist_f[b, 0] / (hist_f[b, 1] + p.cat_smooth))
+              for b in range(B)
+              if hist_f[b, 2] >= p.min_data_per_group and hist_f[b, 2] > 0]
+    order = [b for b, _ in sorted(usable, key=lambda t: t[1])]
+    onehot = n_cats <= p.max_cat_to_onehot
+    best = (-math.inf, None)
+    GL = HL = CL = 0.0
+    for k, b in enumerate(order):
+        if onehot:
+            GL, HL, CL = hist_f[b, 0], hist_f[b, 1], hist_f[b, 2]
+        else:
+            if k >= p.max_cat_threshold:
+                break
+            GL += hist_f[b, 0]
+            HL += hist_f[b, 1]
+            CL += hist_f[b, 2]
+        g = _child_gain(GL, HL, CL, G, H, C, p.lambda_l1, l2c, p,
+                        parent, mono)
+        if g > best[0]:
+            left = {b} if onehot else set(order[:k + 1])
+            best = (g, left)
+    return best
+
+
+def _best_split(binned, grad, hess, rows, nan_bins, is_categorical,
+                cat_nbins, B: int, p: OracleParams) -> Optional[OracleSplit]:
+    F = binned.shape[1]
+    mono_all = p.monotone_constraints or [0] * F
+    best: Optional[OracleSplit] = None
+    for f in range(F):
+        hist_f = _hist(binned, grad, hess, rows, f, B)
+        if is_categorical[f]:
+            gain, left_bins = _best_categorical(
+                hist_f, B, int(cat_nbins[f]), p, mono_all[f])
+            if left_bins is not None and (best is None or gain > best.gain):
+                best = OracleSplit(gain, f, -1, False, True, left_bins)
+        else:
+            gain, t, dl = _best_numeric(hist_f, int(nan_bins[f]), B, p,
+                                        mono_all[f])
+            if math.isfinite(gain) and (best is None or gain > best.gain):
+                best = OracleSplit(gain, f, t, dl, False)
+    return best
+
+
+def oracle_grow_tree(binned: np.ndarray, grad: np.ndarray, hess: np.ndarray,
+                     nan_bins: np.ndarray, is_categorical: np.ndarray,
+                     cat_nbins: np.ndarray, B: int,
+                     p: OracleParams) -> OracleTree:
+    """Leaf-wise growth: repeatedly split the leaf whose best candidate has
+    the highest gain (> min_gain_to_split), to at most num_leaves leaves,
+    honoring max_depth. Ties go to the earliest-created leaf (LightGBM
+    Tree::Split numbering: left child keeps the parent's slot, right child
+    is appended)."""
+    root = OracleNode(rows=np.arange(binned.shape[0]))
+    leaves = [root]
+    cand = [_best_split(binned, grad, hess, root.rows, nan_bins,
+                        is_categorical, cat_nbins, B, p)]
+    for _ in range(p.num_leaves - 1):
+        best_i, best_gain = -1, -math.inf
+        for i, c in enumerate(cand):
+            if c is None:
+                continue
+            if p.max_depth > 0 and leaves[i].depth >= p.max_depth:
+                continue
+            if c.gain > best_gain:          # strict: first leaf wins ties
+                best_i, best_gain = i, c.gain
+        if best_i < 0 or not (best_gain > p.min_gain_to_split):
+            break
+        node, s = leaves[best_i], cand[best_i]
+        node.split = s
+        b_col = binned[node.rows, s.feature]
+        if s.categorical:
+            go_left = np.isin(b_col, list(s.left_bins))
+        else:
+            go_left = b_col <= s.bin
+            nb = int(nan_bins[s.feature])
+            go_left = np.where(b_col == nb, s.default_left, go_left)
+        node.left = OracleNode(rows=node.rows[go_left], depth=node.depth + 1)
+        node.right = OracleNode(rows=node.rows[~go_left],
+                                depth=node.depth + 1)
+        # left keeps the parent's leaf slot, right appends (tie-break parity)
+        leaves[best_i] = node.left
+        leaves.append(node.right)
+        cand[best_i] = _best_split(binned, grad, hess, node.left.rows,
+                                   nan_bins, is_categorical, cat_nbins, B, p)
+        cand.append(_best_split(binned, grad, hess, node.right.rows,
+                                nan_bins, is_categorical, cat_nbins, B, p))
+    for leaf in leaves:
+        G = float(grad[leaf.rows].sum())
+        H = float(hess[leaf.rows].sum())
+        leaf.value = _leaf_output(G, H, p) * p.learning_rate
+    return OracleTree(root=root, leaves=leaves)
+
+
+def oracle_bin_index(x: float, bounds: np.ndarray, num_bins: int,
+                     has_nan: bool) -> int:
+    """The spec sentence for numeric binning, verbatim: bin(x) = first i
+    with x <= bounds[i]; beyond all bounds -> last real bin; NaN -> the
+    dedicated trailing bin."""
+    n_real = num_bins - (1 if has_nan else 0)
+    if math.isnan(x):
+        return num_bins - 1
+    for i in range(min(len(bounds), n_real - 1)):
+        if x <= bounds[i]:
+            return i
+    return n_real - 1

@@ -357,6 +357,49 @@ class Booster:
         return self._forest_cache
 
     # --- inference ------------------------------------------------------
+    def serving_fn(self):
+        """ONE fused jitted callable ``X (N, F) -> prediction`` for
+        low-latency serving: forest traversal, base score, and the
+        objective's output transform compiled into a single XLA program —
+        one device dispatch per request batch instead of predict()'s
+        traversal + transform round trips. This is the handler-side analog
+        of the reference's served fitted models (README Spark Serving cell;
+        HTTPSourceV2.scala:485-713 transport + a model transform)."""
+        import jax
+
+        forest = self.forest()
+        obj = self._objective_for_transform()
+        depth = self._depth_cache
+        k = self.models_per_iter
+        base = jnp.asarray(self.base_score[:max(k, 1)], jnp.float32)
+        # the config's prediction window applies to serving too (raw_score
+        # parity — code-review r5: a windowed booster must not serve
+        # different probabilities than predict())
+        start = max(int(getattr(self.config, "start_iteration", 0)), 0)
+
+        @jax.jit
+        def fn(X):
+            if k == 1 and not start and not self.average_output:
+                raw = forest_predict(forest, X, output="sum",
+                                     depth=depth) + base[0]
+            else:
+                per_tree = forest_predict(forest, X, output="per_tree",
+                                          depth=depth)
+                n, t = per_tree.shape
+                per_iter = per_tree.reshape(n, t // k, k)
+                if start:
+                    per_iter = per_iter[:, start:]
+                if self.average_output and per_iter.shape[1] != t // k:
+                    # rf leaves were pre-divided by the FULL tree count
+                    per_iter = per_iter * ((t // k)
+                                           / max(per_iter.shape[1], 1))
+                raw = per_iter.sum(axis=1) + base[None]
+                if k == 1:
+                    raw = raw[:, 0]
+            return obj.transform(raw)
+
+        return fn
+
     def raw_score(self, X, binned: bool = False, num_iteration: int = -1,
                   start_iteration: Optional[int] = None) -> np.ndarray:
         """(N,) or (N, K) raw margin. ``num_iteration`` > 0 scores with only

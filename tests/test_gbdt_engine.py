@@ -1047,3 +1047,34 @@ def test_label_gain_ragged_groups_and_validation():
                                           num_iterations=2,
                                           label_gain=(0.0, 1.0)),
                       group_sizes=np.full(4, 10, np.int64))
+
+
+def test_serving_fn_matches_predict():
+    """serving_fn (single fused jitted dispatch, the io/serving handler
+    path) must agree with predict() for binary and multiclass models."""
+    import numpy as np
+
+    from synapseml_tpu.gbdt import BoosterConfig, Dataset, train_booster
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(600, 6)).astype(np.float32)
+    yb = (X[:, 0] * X[:, 1] > 0).astype(np.float32)
+    b = train_booster(Dataset(X, yb), None,
+                      BoosterConfig(objective="binary", num_iterations=10,
+                                    num_leaves=15))
+    np.testing.assert_allclose(np.asarray(b.serving_fn()(X)), b.predict(X),
+                               rtol=1e-6, atol=1e-6)
+
+    ym = (np.digitize(X[:, 0], [-0.5, 0.5])).astype(np.float32)
+    bm = train_booster(Dataset(X, ym), None,
+                       BoosterConfig(objective="multiclass", num_class=3,
+                                     num_iterations=6, num_leaves=7))
+    np.testing.assert_allclose(np.asarray(bm.serving_fn()(X)),
+                               bm.predict(X), rtol=1e-6, atol=1e-6)
+
+    # the prediction window must apply to serving too (code-review r5)
+    bw = train_booster(Dataset(X, yb), None,
+                       BoosterConfig(objective="binary", num_iterations=10,
+                                     num_leaves=15, start_iteration=4))
+    np.testing.assert_allclose(np.asarray(bw.serving_fn()(X)),
+                               bw.predict(X), rtol=1e-6, atol=1e-6)

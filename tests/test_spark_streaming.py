@@ -1,0 +1,197 @@
+"""Streaming Spark adapter: partition-wise transfer in bounded memory
+(VERDICT r4 #5 — the toPandas() bridge cannot fit HIGGS-class data; match
+LightGBMBase.scala:608-628 mapPartitions dispatch + :509-550 sample-then-
+stream reference dataset).
+
+pyspark is absent in this image, so the adapter is duck-typed over
+``.columns`` + ``.toLocalIterator()`` and driven here with a fake chunked
+Spark DataFrame that yields rows exactly like pyspark's local iterator
+(one partition at a time)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.spark_adapter import (dataset_from_spark,
+                                              from_spark_streamed,
+                                              iter_spark_chunks)
+from synapseml_tpu.gbdt import BoosterConfig, Dataset, train_booster
+
+
+class FakeSparkDF:
+    """Minimal Spark-DataFrame shape: named columns, row iterator that
+    yields tuples partition by partition, plan re-executable (a second
+    toLocalIterator restarts — as Spark re-runs the plan)."""
+
+    def __init__(self, cols: dict, n_partitions: int = 7):
+        self._cols = dict(cols)
+        self.columns = list(cols)
+        self._n = len(next(iter(cols.values())))
+        self._parts = np.array_split(np.arange(self._n), n_partitions)
+        self.iterations = 0          # how many times the plan executed
+
+    def toLocalIterator(self):
+        self.iterations += 1
+        for part in self._parts:
+            for i in part:
+                yield tuple(self._cols[c][i] for c in self.columns)
+
+
+def _data(n=3000, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+def _fake_df(X, y):
+    cols = {f"f{i}": X[:, i] for i in range(X.shape[1])}
+    cols["label"] = y
+    return FakeSparkDF(cols)
+
+
+class TestIterChunks:
+    def test_chunks_cover_all_rows_in_order(self):
+        X, y = _data(n=1000)
+        df = _fake_df(X, y)
+        chunks = list(iter_spark_chunks(df, chunk_rows=128))
+        assert [len(c["label"]) for c in chunks[:-1]] == [128] * 7
+        got = np.concatenate([c["f0"] for c in chunks])
+        np.testing.assert_array_equal(got, X[:, 0])
+
+    def test_streamed_table_matches(self):
+        X, y = _data(n=500)
+        t = from_spark_streamed(_fake_df(X, y), chunk_rows=64)
+        np.testing.assert_array_equal(np.asarray(t["f2"]), X[:, 2])
+        np.testing.assert_array_equal(np.asarray(t["label"]), y)
+
+
+class TestDatasetFromBatches:
+    def test_identical_to_whole_matrix_dataset(self):
+        """Chunked construction must produce byte-identical binned data
+        when the sample covers every row."""
+        X, y = _data()
+        whole = Dataset(X, y, max_bin=32)
+        chunks = ((X[i:i + 257], y[i:i + 257])
+                  for i in range(0, len(y), 257))
+        streamed = Dataset.from_batches(chunks, max_bin=32,
+                                        bin_sample_count=len(y))
+        np.testing.assert_array_equal(np.asarray(streamed.binned),
+                                      np.asarray(whole.binned))
+        np.testing.assert_array_equal(streamed.label, y)
+        assert streamed.X is None          # raw floats were never kept
+
+    def test_prefix_sample_trains(self):
+        """mapper=None path: boundaries from the first bin_sample_count
+        rows; the booster must still train to quality."""
+        X, y = _data(n=4000)
+        chunks = ((X[i:i + 500], y[i:i + 500])
+                  for i in range(0, len(y), 500))
+        ds = Dataset.from_batches(chunks, bin_sample_count=1200)
+        b = train_booster(ds, None,
+                          BoosterConfig(objective="binary",
+                                        num_iterations=30, num_leaves=15))
+        from sklearn.metrics import roc_auc_score
+
+        assert roc_auc_score(y, b.predict(X)) > 0.85
+
+    def test_empty_iterator_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            Dataset.from_batches(iter(()))
+
+
+class TestDatasetFromSpark:
+    def test_two_pass_binning_matches_whole(self):
+        """Reservoir sample covering every row -> same bin boundaries ->
+        byte-identical binned matrix; the plan executes exactly twice."""
+        X, y = _data()
+        df = _fake_df(X, y)
+        ds = dataset_from_spark(df, [f"f{i}" for i in range(5)],
+                                label_col="label", chunk_rows=333,
+                                max_bin=32, bin_sample_count=len(y))
+        assert df.iterations == 2
+        whole = Dataset(X, y, max_bin=32)
+        np.testing.assert_array_equal(np.asarray(ds.binned),
+                                      np.asarray(whole.binned))
+        np.testing.assert_array_equal(ds.label, y)
+
+    def test_ordered_stream_needs_two_pass(self):
+        """An ORDERED stream (sorted by a feature) biases a prefix sample;
+        the reservoir pass keeps quantile boundaries honest. Gate: the
+        two-pass mapper's boundaries must span the full value range."""
+        X, y = _data()
+        order = np.argsort(X[:, 0])
+        Xs, ys = X[order], y[order]
+        df = _fake_df(Xs, ys)
+        ds2 = dataset_from_spark(df, [f"f{i}" for i in range(5)],
+                                 label_col="label", chunk_rows=200,
+                                 max_bin=32, bin_sample_count=400,
+                                 two_pass=True)
+        ds1 = dataset_from_spark(_fake_df(Xs, ys),
+                                 [f"f{i}" for i in range(5)],
+                                 label_col="label", chunk_rows=200,
+                                 max_bin=32, bin_sample_count=400,
+                                 two_pass=False)
+        hi2 = ds2.mapper.boundaries[0]
+        hi1 = ds1.mapper.boundaries[0]
+        top2 = hi2[np.isfinite(hi2)].max()
+        top1 = hi1[np.isfinite(hi1)].max()
+        # prefix sample saw only the LOWEST f0 values; reservoir spans all
+        assert top2 > np.quantile(X[:, 0], 0.9)
+        assert top1 < np.quantile(X[:, 0], 0.2)
+
+    def test_trains_end_to_end(self):
+        X, y = _data(n=4000)
+        ds = dataset_from_spark(_fake_df(X, y),
+                                [f"f{i}" for i in range(5)],
+                                label_col="label", chunk_rows=512)
+        b = train_booster(ds, None,
+                          BoosterConfig(objective="binary",
+                                        num_iterations=30, num_leaves=15))
+        from sklearn.metrics import roc_auc_score
+
+        assert roc_auc_score(y, b.predict(X)) > 0.85
+
+
+class TestStreamedNaNSemantics:
+    def test_two_pass_allocates_nan_bin_for_late_nans(self):
+        """NaNs living ONLY in the tail of the stream: the reservoir pass's
+        full-stream has_nan must still allocate the missing bin (sample-
+        independent missing-ness, matching Dataset(X) on the same data)."""
+        X, y = _data(n=2000)
+        X[1500:, 3] = np.nan                 # NaNs only after row 1500
+        ds = dataset_from_spark(_fake_df(X, y),
+                                [f"f{i}" for i in range(5)],
+                                label_col="label", chunk_rows=400,
+                                max_bin=32, bin_sample_count=300)
+        assert bool(ds.mapper.nan_mask[3])
+        whole = Dataset(X, y, max_bin=32)
+        assert bool(whole.mapper.nan_mask[3])
+
+    def test_prefix_path_fails_loud_on_late_nans(self):
+        """One-pass prefix sampling cannot see tail NaNs — silently
+        clamping them into a value bin would train a different model, so
+        from_batches raises with guidance (code-review r5)."""
+        X, y = _data(n=2000)
+        X[1500:, 2] = np.nan
+        chunks = ((X[i:i + 400], y[i:i + 400])
+                  for i in range(0, len(y), 400))
+        with pytest.raises(ValueError, match="two-pass"):
+            Dataset.from_batches(chunks, bin_sample_count=400)
+
+    def test_user_mapper_flag_preserved(self):
+        """A caller-provided mapper must keep __init__'s user-mapper
+        semantics (binning-knob mismatch checks are meaningless then)."""
+        X, y = _data(n=800)
+        whole = Dataset(X, y, max_bin=32)
+        chunks = ((X[i:i + 200], y[i:i + 200])
+                  for i in range(0, len(y), 200))
+        ds = Dataset.from_batches(chunks, mapper=whole.mapper, max_bin=32)
+        assert ds._user_mapper is True
+        np.testing.assert_array_equal(np.asarray(ds.binned),
+                                      np.asarray(whole.binned))
+
+    def test_empty_iterator_with_mapper_rejected(self):
+        X, y = _data(n=100)
+        m = Dataset(X, y, max_bin=32).mapper
+        with pytest.raises(ValueError, match="empty"):
+            Dataset.from_batches(iter(()), mapper=m)

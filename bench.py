@@ -904,9 +904,11 @@ def bench_voting_ab(rows=50_000, cols=100, iters=10):
     mesh = make_mesh({"data": 8})
     kw = dict(objective="binary", num_iterations=iters, num_leaves=15,
               max_bin=63, seed=1)
+    top_k = 20
     out = {}
     for name, extra in (("data_parallel", {}),
-                        ("voting", {"tree_learner": "voting", "top_k": 20})):
+                        ("voting", {"tree_learner": "voting",
+                                    "top_k": top_k})):
         cfg = BoosterConfig(**kw, **extra)
         train_booster(X, y, cfg, mesh=mesh)      # compile + cache
         t0 = time.perf_counter()
@@ -916,6 +918,17 @@ def bench_voting_ab(rows=50_000, cols=100, iters=10):
         out[name] = {"row_iters_per_s": rows * iters / dt,
                      "auc": float(_auc(y, b.predict(X, binned=False)))}
     v, d = out["voting"], out["data_parallel"]
+    # collective cost model (VERDICT r4 #7): exact logical bytes both modes
+    # move per split, the measured per-tree selection overhead on THIS mesh
+    # (comm is memcpy here, so the whole arm delta is selection + slicing),
+    # and the implied crossover link bandwidth below which voting pays.
+    from synapseml_tpu.gbdt.voting import voting_cost_model
+
+    sel_s_per_tree = max(rows * iters / v["row_iters_per_s"]
+                         - rows * iters / d["row_iters_per_s"], 0.0) / iters
+    model = voting_cost_model(cols, kw["max_bin"], top_k, kw["num_leaves"],
+                              selection_s_per_tree=max(sel_s_per_tree, 1e-9))
+    model["measured_selection_s_per_tree"] = round(sel_s_per_tree, 4)
     return {"metric": "gbdt_voting_vs_data_parallel_speedup",
             "platform": "cpu-mesh-8",   # honest provenance: never the chip
             "value": round(v["row_iters_per_s"] / d["row_iters_per_s"], 3),
@@ -923,6 +936,7 @@ def bench_voting_ab(rows=50_000, cols=100, iters=10):
                      f"{v['row_iters_per_s']:.0f} r-i/s AUC {v['auc']:.4f} "
                      f"vs data-parallel {d['row_iters_per_s']:.0f} r-i/s "
                      f"AUC {d['auc']:.4f})"),
+            "collective_cost_model": model,
             # >1.0 means voting's reduced allreduce wins at this shape
             "vs_baseline": round(v["row_iters_per_s"]
                                  / d["row_iters_per_s"], 3)}

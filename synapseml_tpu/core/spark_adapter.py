@@ -65,7 +65,21 @@ def iter_spark_chunks(spark_df, chunk_rows: int = 65536):
 
     def _emit(rows):
         arr = list(zip(*rows))
-        return {c: np.asarray(arr[i]) for i, c in enumerate(cols)}
+        out = {}
+        for i, c in enumerate(cols):
+            a = np.asarray(arr[i])
+            if a.dtype == object:
+                # Spark SQL nulls arrive as None; numeric columns must map
+                # them to NaN exactly as the toPandas() bridge does (the
+                # missing bin handles them downstream). Non-numeric object
+                # columns pass through unchanged.
+                try:
+                    a = np.array([np.nan if v is None else v
+                                  for v in arr[i]], np.float32)
+                except (TypeError, ValueError):
+                    pass
+            out[c] = a
+        return out
 
     for row in it:
         buf.append(tuple(row))

@@ -836,9 +836,13 @@ def train_booster(
             pass
         else:
             mapper = dataset.mapper
-            if mesh is None and init_model is None:
-                # fast path: reuse the device-resident binned matrix (the mesh
-                # / warm-start paths need raw rows for padding / rescoring)
+            if init_model is None and (mesh is None
+                                       or jax.process_count() == 1):
+                # fast path: reuse the binned matrix. Warm start still needs
+                # raw rows (init-model rescoring); single-process mesh pads
+                # the BINNED rows below, so streamed datasets (from_batches:
+                # raw floats never kept) shard across a mesh too. Multi-
+                # process keeps the raw path (global ingest re-stages rows).
                 prebinned = dataset.binned
         if prebinned is not None:
             # shape-only placeholder when no dense raw rows are held (sparse
@@ -981,7 +985,17 @@ def train_booster(
             ndata = ndata // nproc
         rem = (-n_orig) % ndata
         if rem:
-            X = np.concatenate([X, np.repeat(X[-1:], rem, axis=0)])
+            if prebinned is not None:
+                # pad the BINNED rows directly (in_bag=0 keeps padding out
+                # of every histogram); the raw-X placeholder stays a
+                # zero-memory broadcast view at the new length
+                pb = np.asarray(prebinned)
+                prebinned = np.concatenate(
+                    [pb, np.repeat(pb[-1:], rem, axis=0)])
+                X = np.broadcast_to(np.float32(0.0),
+                                    (n_orig + rem, X.shape[1]))
+            else:
+                X = np.concatenate([X, np.repeat(X[-1:], rem, axis=0)])
             y = np.concatenate([y, np.zeros(rem, np.float32)])
             w = np.concatenate([w, np.zeros(rem, np.float32)])
             valid_mask_np = np.concatenate([valid_mask_np, np.zeros(rem, np.float32)])
@@ -1196,6 +1210,30 @@ def train_booster(
     # tunnel, ~15ms per dispatch) the fused program is essential.
     # dart / custom fobj / callbacks / warm start keep the host loop.
     # ------------------------------------------------------------------
+    if cfg.tree_learner == "auto":
+        # collective cost model (voting.py): voting only when the mesh spans
+        # hosts AND the per-tree allreduce saving beats the selection pass.
+        # The model is consulted unconditionally so its verdict is never
+        # silently dead; when it prefers voting under multi-process training
+        # (which rides the fused path — no voting support yet) the fallback
+        # is EXPLICIT. The resolved value lands on cfg for provenance.
+        from .voting import recommend_tree_learner
+
+        choice = (recommend_tree_learner(
+            nfeat, cfg.max_bin, cfg.top_k, cfg.num_leaves,
+            n_hosts=jax.process_count(), rows_per_host=n)
+            if mesh is not None else "data")
+        if choice == "voting" and multiproc:
+            import warnings
+
+            warnings.warn(
+                "tree_learner='auto': the collective cost model prefers "
+                "voting-parallel at this shape (wide features, multi-host "
+                "fabric), but multi-process training does not support the "
+                "voting learner yet — falling back to data-parallel. Set "
+                "tree_learner='voting' on a single-process mesh to use it.")
+            choice = "data"
+        cfg.tree_learner = choice
     fused = (fobj is None and not callbacks and init_model is None
              and cfg.boosting_type in ("gbdt", "goss", "rf")
              and cfg.tree_learner != "voting")
@@ -1368,6 +1406,8 @@ def train_booster(
                 new_weight = 1.0 / (kdrop + 1.0)
         # voting-parallel: pick top-2k features per tree by shard votes, grow
         # on the sliced columns so in-loop histogram allreduce is O(top_k)
+        # ("auto" resolved to a concrete learner before the fused-path
+        # decision above)
         voting = (cfg.tree_learner == "voting" and mesh is not None
                   and nfeat > 2 * cfg.top_k)
         for cls in range(k):

@@ -92,3 +92,120 @@ def remap_tree_features(tree, sel_idx: np.ndarray):
     """Split features of a tree grown on sliced columns → full feature space."""
     sel = jnp.asarray(sel_idx, jnp.int32)
     return tree._replace(split_feature=sel[tree.split_feature])
+
+
+# ---------------------------------------------------------------------------
+# Collective cost model — when does voting-parallel actually pay?
+# ---------------------------------------------------------------------------
+#
+# The A/B on a single-host mesh (docs/measurements.json
+# gbdt_voting_vs_data_parallel_speedup) shows voting as a pure cost there:
+# allreduce over a host-local mesh is a memcpy, so the smaller histogram
+# payload buys nothing while the root-selection pass still runs. The model
+# below prices the tradeoff explicitly — logical collective bytes per split
+# for both modes, the per-tree saving, and the link bandwidth below which
+# that saving outweighs the measured selection overhead (PV-Tree's regime:
+# many hosts on a thin DCN link). LightGBM ships the same knob pair
+# (parallelism/topK, params/LightGBMParams.scala:25-27,
+# LightGBMConstants.scala:22-24) but leaves the choice entirely manual.
+
+# per-link full-duplex bandwidth, bytes/s — public figures (the scaling-book
+# mental model): ICI ~1e11 B/s per link on v4/v5p-class chips; DCN per-host
+# is NIC-bound, ~1.25e10 B/s (100 Gb/s) in common fleet configs.
+DEFAULT_LINK_BYTES_PER_S = {"ici": 1.0e11, "dcn": 1.25e10}
+
+# the selection pass's compute is ONE extra root-histogram build over all
+# features (voting_select literally builds one); relative to a whole tree
+# (whose histogram work revisits each row roughly tree-depth times) that is
+# a FRACTION of per-tree compute. 0.3 is deliberately conservative (against
+# voting); bench_voting_ab records the measured per-tree overhead alongside
+# the model so the estimate is auditable against data.
+DEFAULT_SELECTION_FRACTION = 0.3
+# measured on-chip engine throughput anchor (row-iters/sec/chip, the
+# primary bench capture in docs/measurements.json) — converts rows into
+# seconds for the selection-cost estimate. Conservative: a faster engine
+# shrinks selection cost and favors voting.
+DEFAULT_ENGINE_ROW_ITERS_PER_S = 1.69e6
+
+
+def collective_bytes_per_split(num_features: int, max_bin: int,
+                               top_k=None, dtype_bytes: int = 4) -> int:
+    """Logical allreduce payload of ONE split's histogram aggregation:
+    (F_aggregated, max_bin, 3 channels) float32. Data-parallel aggregates
+    every feature; voting-parallel only the elected 2k columns."""
+    f_agg = (num_features if top_k is None
+             else min(2 * int(top_k), num_features))
+    return int(f_agg) * int(max_bin) * 3 * dtype_bytes
+
+
+def selection_bytes_per_tree(num_features: int, dtype_bytes: int = 4) -> int:
+    """The root-selection pass psums (F,) votes + (F,) gain sums once per
+    tree (voting_select above)."""
+    return int(num_features) * 2 * dtype_bytes
+
+
+def voting_cost_model(num_features: int, max_bin: int, top_k: int,
+                      num_leaves: int,
+                      selection_s_per_tree: float = 1e-3) -> dict:
+    """Per-tree collective accounting for both modes and the CROSSOVER link
+    bandwidth: below it, the bytes voting saves per tree take longer on the
+    wire than its selection pass costs — voting wins."""
+    splits = max(int(num_leaves) - 1, 1)
+    dp = splits * collective_bytes_per_split(num_features, max_bin)
+    vp = (splits * collective_bytes_per_split(num_features, max_bin, top_k)
+          + selection_bytes_per_tree(num_features))
+    saved = max(dp - vp, 0)
+    crossover = (saved / selection_s_per_tree
+                 if selection_s_per_tree > 0 else float("inf"))
+    return {
+        "bytes_per_split_data_parallel":
+            collective_bytes_per_split(num_features, max_bin),
+        "bytes_per_split_voting":
+            collective_bytes_per_split(num_features, max_bin, top_k),
+        "selection_bytes_per_tree": selection_bytes_per_tree(num_features),
+        "bytes_per_tree_data_parallel": dp,
+        "bytes_per_tree_voting": vp,
+        "bytes_saved_per_tree": saved,
+        "crossover_link_bytes_per_s": crossover,
+    }
+
+
+def recommend_tree_learner(num_features: int, max_bin: int, top_k: int,
+                           num_leaves: int, n_hosts: int,
+                           rows_per_host: int = None,
+                           link_bytes_per_s: float = None,
+                           engine_row_iters_per_s: float =
+                           DEFAULT_ENGINE_ROW_ITERS_PER_S,
+                           selection_fraction: float =
+                           DEFAULT_SELECTION_FRACTION,
+                           selection_s_per_tree: float = None) -> str:
+    """The documented selection rule (VERDICT r4 #7):
+
+    * single host — "data": every collective is intra-host (ICI/memcpy);
+      the selection pass can never pay for itself.
+    * narrow feature space (F <= 2k) — "data": voting would aggregate
+      everything anyway.
+    * multi-host — "voting" iff the per-tree wire-time saving
+      ``bytes_saved_per_tree / link_bytes_per_s`` exceeds the selection
+      cost. Selection cost defaults to
+      ``selection_fraction * rows_per_host / engine_row_iters_per_s``
+      (one extra root-histogram build, scaled by the measured engine
+      throughput); pass ``selection_s_per_tree`` to override with a
+      measured value (bench_voting_ab records one). With the DCN default
+      this picks voting exactly for wide feature spaces on NIC-bound
+      fabrics — PV-Tree's regime — and data-parallel on ICI-connected
+      slices, matching the single-host A/B measurement.
+    """
+    if n_hosts <= 1 or num_features <= 2 * top_k:
+        return "data"
+    if link_bytes_per_s is None:
+        link_bytes_per_s = DEFAULT_LINK_BYTES_PER_S["dcn"]
+    if selection_s_per_tree is None:
+        if rows_per_host is None:
+            rows_per_host = 1_000_000        # HIGGS-class shard, conservative
+        selection_s_per_tree = (selection_fraction * rows_per_host
+                                / engine_row_iters_per_s)
+    m = voting_cost_model(num_features, max_bin, top_k, num_leaves,
+                          selection_s_per_tree)
+    saved_wire_s = m["bytes_saved_per_tree"] / link_bytes_per_s
+    return "voting" if saved_wire_s > selection_s_per_tree else "data"

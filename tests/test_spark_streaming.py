@@ -195,3 +195,45 @@ class TestStreamedNaNSemantics:
         m = Dataset(X, y, max_bin=32).mapper
         with pytest.raises(ValueError, match="empty"):
             Dataset.from_batches(iter(()), mapper=m)
+
+
+class TestStreamedDatasetOnMesh:
+    def test_streamed_dataset_trains_on_mesh(self):
+        """A streamed (raw-floats-never-kept) Dataset must shard across a
+        single-process mesh: the binned rows pad directly (code-review r5 —
+        this is exactly the HIGGS-across-a-mesh scenario the streaming
+        ingest exists for). Predictions must match the whole-matrix mesh
+        fit."""
+        from synapseml_tpu.parallel import make_mesh
+
+        X, y = _data(n=3001)        # NOT divisible by 8: padding exercised
+        ds = dataset_from_spark(_fake_df(X, y),
+                                [f"f{i}" for i in range(5)],
+                                label_col="label", chunk_rows=500,
+                                max_bin=32, bin_sample_count=len(y))
+        assert ds.X is None
+        mesh = make_mesh({"data": 8})
+        cfg = BoosterConfig(objective="binary", num_iterations=8,
+                            num_leaves=15, max_bin=32)
+        b = train_booster(ds, None, cfg, mesh=mesh)
+        whole = train_booster(Dataset(X, y, max_bin=32), None, cfg,
+                              mesh=mesh)
+        np.testing.assert_allclose(b.predict(X), whole.predict(X),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestNullHandling:
+    def test_spark_nulls_become_nan(self):
+        """Spark SQL nulls (None in rows) in numeric columns map to NaN —
+        same as the toPandas() bridge — and train through the missing bin;
+        string columns keep their objects."""
+        X, y = _data(n=400)
+        vals = [None if i % 7 == 0 else float(X[i, 0])
+                for i in range(400)]
+        names = np.asarray([f"row{i}" for i in range(400)], object)
+        df = FakeSparkDF({"f0": np.asarray(vals, object), "name": names})
+        chunks = list(iter_spark_chunks(df, chunk_rows=128))
+        col = np.concatenate([c["f0"] for c in chunks])
+        assert col.dtype == np.float32
+        assert np.isnan(col[0]) and np.isnan(col[7])
+        assert chunks[0]["name"].dtype.kind in ("U", "O")  # strings intact

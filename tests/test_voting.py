@@ -96,3 +96,73 @@ class TestVotingCommVolume:
         assert vote + vote_overhead < full / 4, (vote, full)
         # ... an 8x reduction for F=128, top_k=8
         assert full // vote == features_padded(F) // features_padded(2 * top_k)
+
+
+class TestCostModel:
+    """Collective cost model + the documented selection rule (VERDICT r4
+    #7: measured/exact bytes, crossover bandwidth, auto-select)."""
+
+    def test_bytes_accounting(self):
+        from synapseml_tpu.gbdt.voting import (collective_bytes_per_split,
+                                               selection_bytes_per_tree,
+                                               voting_cost_model)
+
+        F, B, k, L = 1000, 255, 20, 31
+        dp = collective_bytes_per_split(F, B)
+        vp = collective_bytes_per_split(F, B, top_k=k)
+        assert dp == F * B * 3 * 4
+        assert vp == 2 * k * B * 3 * 4          # 2k columns aggregated
+        m = voting_cost_model(F, B, k, L, selection_s_per_tree=0.01)
+        assert m["bytes_per_tree_data_parallel"] == (L - 1) * dp
+        assert (m["bytes_per_tree_voting"]
+                == (L - 1) * vp + selection_bytes_per_tree(F))
+        assert m["bytes_saved_per_tree"] == (
+            m["bytes_per_tree_data_parallel"] - m["bytes_per_tree_voting"])
+        # crossover = saved / selection time
+        assert m["crossover_link_bytes_per_s"] == (
+            m["bytes_saved_per_tree"] / 0.01)
+
+    def test_narrow_features_never_save(self):
+        from synapseml_tpu.gbdt.voting import voting_cost_model
+
+        m = voting_cost_model(30, 255, 20, 31, selection_s_per_tree=0.01)
+        assert m["bytes_saved_per_tree"] == 0    # F <= 2k: nothing saved
+
+    def test_selection_rule(self):
+        from synapseml_tpu.gbdt.voting import recommend_tree_learner
+
+        # single host: always data (collectives are intra-host)
+        assert recommend_tree_learner(5000, 255, 20, 31, n_hosts=1) == "data"
+        # narrow feature space: voting aggregates everything anyway
+        assert recommend_tree_learner(30, 255, 20, 31, n_hosts=8) == "data"
+        # wide features on a NIC-bound DCN fabric: PV-Tree's regime
+        assert recommend_tree_learner(
+            5000, 255, 20, 31, n_hosts=8, rows_per_host=1_000_000,
+            link_bytes_per_s=1.25e9) == "voting"
+        # same shape on fast ICI: the saving never beats selection
+        assert recommend_tree_learner(
+            5000, 255, 20, 31, n_hosts=8, rows_per_host=1_000_000,
+            link_bytes_per_s=1.0e11) == "data"
+        # a measured selection overhead overrides the estimate
+        assert recommend_tree_learner(
+            5000, 255, 20, 31, n_hosts=8, link_bytes_per_s=1.25e9,
+            selection_s_per_tree=100.0) == "data"
+
+    def test_auto_learner_trains_single_host(self):
+        """tree_learner='auto' must resolve (to data here — single host)
+        and train to the same quality as explicit data-parallel."""
+        import numpy as np
+
+        from synapseml_tpu.gbdt import BoosterConfig, train_booster
+        from synapseml_tpu.gbdt.objectives import auc as _auc
+        from synapseml_tpu.parallel import make_mesh
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(4000, 30)).astype(np.float32)
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+        mesh = make_mesh({"data": 8})
+        cfg = BoosterConfig(objective="binary", num_iterations=8,
+                            num_leaves=15, tree_learner="auto")
+        b = train_booster(X, y, cfg, mesh=mesh)
+        assert cfg.tree_learner == "data"        # resolution recorded
+        assert float(_auc(y, b.predict(X))) > 0.95

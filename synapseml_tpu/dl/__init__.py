@@ -2,3 +2,4 @@ from .backbones import BACKBONES, ResNet, TinyCNN, make_backbone, resnet18, resn
 from .trainer import FlaxTrainer, TrainConfig, freeze_mask  # noqa: F401
 from .vision import DeepVisionClassifier, DeepVisionModel  # noqa: F401
 from .text import DeepTextClassifier, DeepTextModel, TransformerEncoder, hash_tokenize  # noqa: F401
+from .cntk import CNTKModel  # noqa: F401

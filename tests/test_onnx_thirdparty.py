@@ -156,3 +156,32 @@ def test_onnxmodel_on_dynamic_control_flow_bytes():
         out = model.transform(df)
         got = np.stack([np.asarray(v) for v in out["out"]])
         np.testing.assert_allclose(got, data["y"], rtol=2e-3, atol=2e-4)
+
+
+def test_cntk_compat_stub(tmp_path):
+    """Deprecated CNTKModel shim: ONNX bytes delegate to ONNXModel, native
+    CNTK protobufs raise with conversion guidance (reference keeps
+    CNTKModel only for API compat — coverage row 36)."""
+    import warnings
+
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.dl import CNTKModel
+
+    data = np.load(os.path.join(RES, "torch_mlp.npz"))
+    model_path = tmp_path / "model.onnx"
+    model_path.write_bytes(
+        open(os.path.join(RES, "torch_mlp.onnx"), "rb").read())
+    m = (CNTKModel().setModelLocation(str(model_path))
+         .setInputCol("features").setOutputCol("out"))
+    rows = [data["x"][i] for i in range(len(data["x"]))]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        out = m.transform(Table({"features": np.array(rows, dtype=object)}))
+    got = np.stack([np.asarray(v) for v in out["out"]])
+    np.testing.assert_allclose(got, data["y"], rtol=2e-3, atol=2e-4)
+
+    bad = tmp_path / "native.model"
+    bad.write_bytes(b"\x00CNTKv2\x00not-onnx")
+    with pytest.raises(NotImplementedError, match="ONNX"):
+        CNTKModel().setModelLocation(str(bad)).transform(
+            Table({"input": np.array(rows[:1], dtype=object)}))

@@ -703,9 +703,16 @@ def _emit_fallback_and_exit(why: str):
         out["note"] = (f"device unavailable at bench time ({why}); value is "
                        "the newest recorded on-chip measurement from "
                        "docs/measurements.json (see captured_at)")
+        # stale on-chip captures PLUS the host-side metrics (serving/voting),
+        # which are valid off-chip by policy and may be fresher than any
+        # chip window — each entry keeps its own captured_at/platform, and
+        # only the chip entries are marked stale
         extras = [dict(e, stale=True) for m, e in sorted(latest.items())
                   if m != "gbdt_train_row_iters_per_sec_per_chip"
-                  and e.get("platform") == "tpu"]
+                  and e.get("platform") == "tpu"
+                  and m not in _HOST_SIDE_METRICS]
+        extras += [dict(e) for m, e in sorted(latest.items())
+                   if m in _HOST_SIDE_METRICS]
         if extras:
             out["extras"] = extras
         print(json.dumps(out), flush=True)

@@ -229,6 +229,20 @@ def _registry():
     om.setFeedDict({"x": "features"})
     om.setFetchDict({"out": "out"})
     add(TestObject(om, None, tab))
+    # deprecated CNTKModel shim: same payload via a model FILE (its API);
+    # unique per-process path — a fixed name in the shared tempdir would
+    # collide across parallel runs (code-review r5)
+    import os
+    import tempfile
+
+    fd, cntk_path = tempfile.mkstemp(suffix=".onnx", prefix="fuzz_cntk_")
+    with os.fdopen(fd, "wb") as fh:
+        fh.write(payload)
+    from synapseml_tpu.dl import CNTKModel
+
+    add(TestObject(CNTKModel(miniBatchSize=8)
+                   .setModelLocation(cntk_path)
+                   .setInputCol("features").setOutputCol("out"), None, tab))
     imf = ImageFeaturizer(inputCol="image", outputCol="feat", imageHeight=3,
                           imageWidth=3, headless=False)
     from synapseml_tpu.onnx import Graph, Model as OModel, Node, Tensor, ValueInfo

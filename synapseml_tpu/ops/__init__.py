@@ -1,2 +1,3 @@
 from .quantize import BinMapper, apply_bins, bin_threshold_to_value, compute_bin_mapper  # noqa: F401
 from .histogram import leaf_histograms, sharded_histogram_fn  # noqa: F401
+from .attention_kernel import flash_attention  # noqa: F401

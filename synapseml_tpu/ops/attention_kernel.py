@@ -1,0 +1,213 @@
+"""Pallas TPU flash-attention forward — the fused hot-op for long context.
+
+The attention stack (parallel/ring_attention.py) already computes blockwise
+online softmax, but as XLA ops: every (block_q, block_k) score tile round-
+trips through HBM-visible intermediates. This kernel fuses scores, masking,
+the online-softmax rescale, and the PV matmul into ONE Pallas program —
+Q/K/V stream through VMEM once and the S² score matrix never exists
+anywhere (the public FlashAttention / blockwise-parallel formulation; the
+reference's DL stack has no long-context path at all — SURVEY §5.7 lists
+this repo's long-context support as its bonus surface).
+
+Differentiation: ``flash_attention`` carries a custom VJP whose backward
+RECOMPUTES through the existing XLA blockwise path — the forward stays a
+pure fused kernel, memory stays O(S·block), and gradients are exactly the
+blockwise path's (itself equality-tested against attention_reference).
+
+Degrade ladder (same insurance contract as ops/hist_kernel.py): on TPU a
+one-shot on-device selftest gates the kernel; any Mosaic failure falls back
+to the XLA blockwise path. Non-TPU backends always take the XLA path —
+``interpret=True`` exists for CPU correctness tests of the kernel itself.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30          # finite -inf stand-in: keeps exp() NaN-free
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  s_q: int, s_k: int):
+    """One (bh, q-block) × sequential-k-block step of the online softmax.
+
+    Scratch (acc, m, l) persists across the sequential last grid dimension
+    (TPU grids execute in order); m/l are stored lane-replicated at width
+    128 so every store stays tile-aligned."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: a k-block entirely above the diagonal contributes nothing —
+    # skip its matmuls outright (~2x on the causal hot path)
+    live = (ki * block_k <= qi * block_q + block_q - 1 if causal
+            else ki >= 0)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]                                 # (block_q, D)
+        k = k_ref[0]                                 # (block_k, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        rows = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 0)
+        cols = ki * block_k + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 1)
+        valid = cols < s_k                           # kv padding mask
+        if causal:
+            valid &= rows >= cols
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_old = m_ref[...][:, :1]                    # (block_q, 1)
+        l_old = l_ref[...][:, :1]
+        m_new = jnp.maximum(m_old, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_old - m_new)               # finite: m monotone
+        p = jnp.exp(s - m_new)                       # masked entries -> ~0
+        p = jnp.where(valid, p, 0.0)                 # exact zero for padding
+        l_new = l_old * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _():
+        denom = jnp.where(l_ref[...][:, :1] > 0, l_ref[...][:, :1], 1.0)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
+                   block_k: int, interpret: bool):
+    """(B, S, H, D) → (B, S, H, D): pad to block multiples, run the kernel
+    over a (B·H, q-blocks, k-blocks) grid, slice the padding back off."""
+    from jax.experimental import pallas as pl
+
+    B, s_q, H, D = q.shape
+    s_k = k.shape[1]
+    # block shapes stay 8-row aligned (f32 sublane tile) — a raw-seq-length
+    # clip would hand Mosaic shapes the one-shot selftest never exercised,
+    # breaking the degrade contract per-shape (code-review r5)
+    bq = min(block_q, -(-max(s_q, 8) // 8) * 8)
+    bk = min(block_k, -(-max(s_k, 8) // 8) * 8)
+    pad_q = (-s_q) % bq
+    pad_k = (-s_k) % bk
+    # (B, S, H, D) -> (B*H, S, D), zero-padded to block multiples (padded
+    # kv columns are masked inside the kernel; padded q rows are dropped)
+    qT = jnp.moveaxis(q, 2, 1).reshape(B * H, s_q, D)
+    kT = jnp.moveaxis(k, 2, 1).reshape(B * H, s_k, D)
+    vT = jnp.moveaxis(v, 2, 1).reshape(B * H, s_k, D)
+    if pad_q:
+        qT = jnp.pad(qT, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kT = jnp.pad(kT, ((0, 0), (0, pad_k), (0, 0)))
+        vT = jnp.pad(vT, ((0, 0), (0, pad_k), (0, 0)))
+    nq, nk = qT.shape[1] // bq, kT.shape[1] // bk
+    from jax.experimental.pallas import tpu as pltpu
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, s_q=s_q, s_k=s_k),
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qT.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),        # acc
+            pltpu.VMEM((bq, 128), jnp.float32),      # running max m
+            pltpu.VMEM((bq, 128), jnp.float32),      # normalizer l
+        ],
+        interpret=interpret,
+    )(qT, kT, vT)
+    out = out[:, :s_q].reshape(B, H, s_q, D)
+    return jnp.moveaxis(out, 1, 2)                   # (B, S, H, D)
+
+
+def _xla_fallback(q, k, v, causal: bool, scale: float, block_k: int):
+    """The existing blockwise path (divisible sequences) or the reference
+    einsum (arbitrary lengths) — one semantic, chosen by shape."""
+    from ..parallel.ring_attention import (attention_reference,
+                                           blockwise_attention)
+
+    if k.shape[1] % block_k == 0 and k.shape[1] >= block_k:
+        return blockwise_attention(q, k, v, block_size=block_k,
+                                   causal=causal, scale=scale)
+    return attention_reference(q, k, v, causal=causal, scale=scale)
+
+
+@functools.cache
+def _tpu_flash_selftest() -> bool:
+    """One small on-device compile+run decides whether the Mosaic lowering
+    is trusted for this process (insurance for unattended bench windows —
+    a regression must degrade to the XLA path, not kill the run)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 24, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 24, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 24, 2, 16)), jnp.float32)
+    try:
+        for causal in (False, True):
+            got = np.asarray(_flash_forward(q, k, v, causal, 0.25, 16, 16,
+                                            False))
+            want = np.asarray(_xla_fallback(q, k, v, causal, 0.25, 8))
+            if not np.allclose(got, want, rtol=2e-4, atol=2e-4):
+                return False
+        return True
+    except Exception:
+        return False
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Fused flash attention, differentiable. Layout (B, S, H, D) — the same
+    convention as attention_reference / blockwise_attention, and the same
+    outputs to kernel tolerance. Backward recomputes through the XLA
+    blockwise path (O(S·block) memory both directions). ``scale`` must be
+    a static scalar (it folds into the compiled kernel); concrete jax/numpy
+    scalars are accepted and converted."""
+    scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
+    use_kernel = interpret or (jax.default_backend() == "tpu"
+                               and _tpu_flash_selftest())
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        if use_kernel:
+            return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                                  interpret)
+        return _xla_fallback(q, k, v, causal, scale, block_k)
+
+    def fwd(q, k, v):
+        return f(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda a, b, c: _xla_fallback(a, b, c, causal, scale, block_k),
+            q, k, v)
+        return vjp(g)
+
+    f.defvjp(fwd, bwd)
+    return f(q, k, v)

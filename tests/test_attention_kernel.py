@@ -1,0 +1,118 @@
+"""Pallas flash-attention kernel vs the XLA attention oracles.
+
+Interpret mode runs the ACTUAL kernel body on CPU (ops/hist_kernel.py's
+test discipline); equality targets attention_reference, whose own parity
+with the blockwise/ring paths is already pinned in test_ring_attention."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.ops.attention_kernel import flash_attention
+from synapseml_tpu.parallel.ring_attention import attention_reference
+
+
+def _qkv(seed=0, b=2, s=48, h=2, d=32, dtype=np.float32, s_k=None):
+    rng = np.random.default_rng(seed)
+    s_k = s_k or s
+    q = rng.normal(size=(b, s, h, d)).astype(dtype)
+    k = rng.normal(size=(b, s_k, h, d)).astype(dtype)
+    v = rng.normal(size=(b, s_k, h, d)).astype(dtype)
+    return q, k, v
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        got = np.asarray(flash_attention(q, k, v, causal=causal,
+                                         block_q=16, block_k=16,
+                                         interpret=True))
+        want = np.asarray(attention_reference(q, k, v, causal=causal))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_non_divisible_lengths_padded(self):
+        """Sequence lengths that do not divide the block: padded kv columns
+        are masked to exact zero weight, padded q rows dropped."""
+        q, k, v = _qkv(s=37, s_k=53)
+        got = np.asarray(flash_attention(q, k, v, block_q=16, block_k=16,
+                                         interpret=True))
+        want = np.asarray(attention_reference(q, k, v))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_cross_attention_lengths(self, causal):
+        """s_q != s_k, both conventions-sensitive paths: the causal mask is
+        ABSOLUTE-position (rows >= cols, as attention_reference defines it)
+        and must compose with the kv padding mask."""
+        q, k, v = _qkv(s=32, s_k=64)
+        got = np.asarray(flash_attention(q, k, v, causal=causal,
+                                         block_q=16, block_k=16,
+                                         interpret=True))
+        want = np.asarray(attention_reference(q, k, v, causal=causal))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_causal_padded_lengths(self):
+        q, k, v = _qkv(s=37, s_k=53)
+        got = np.asarray(flash_attention(q, k, v, causal=True, block_q=16,
+                                         block_k=16, interpret=True))
+        want = np.asarray(attention_reference(q, k, v, causal=True))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_jax_scalar_scale_accepted(self):
+        import jax.numpy as jnp
+
+        q, k, v = _qkv()
+        got = np.asarray(flash_attention(q, k, v, scale=jnp.float32(0.5),
+                                         block_q=16, block_k=16,
+                                         interpret=True))
+        want = np.asarray(attention_reference(q, k, v, scale=0.5))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_bf16_inputs(self):
+        import jax.numpy as jnp
+
+        q, k, v = _qkv()
+        qb, kb, vb = (jnp.asarray(x, jnp.bfloat16) for x in (q, k, v))
+        got = np.asarray(flash_attention(qb, kb, vb, block_q=16,
+                                         block_k=16,
+                                         interpret=True)).astype(np.float32)
+        want = np.asarray(attention_reference(q, k, v))
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+    def test_custom_scale(self):
+        q, k, v = _qkv()
+        got = np.asarray(flash_attention(q, k, v, scale=0.5, block_q=16,
+                                         block_k=16, interpret=True))
+        want = np.asarray(attention_reference(q, k, v, scale=0.5))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, causal):
+        import jax
+
+        q, k, v = _qkv(s=32, d=16)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=causal, block_q=16,
+                                    block_k=16, interpret=True) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (attention_reference(q, k, v, causal=causal) ** 2).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-4)
+
+    def test_jits_end_to_end(self):
+        import jax
+
+        q, k, v = _qkv(s=32, d=16)
+        f = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, block_q=16, block_k=16, interpret=True))
+        out = np.asarray(f(q, k, v))
+        want = np.asarray(attention_reference(q, k, v))
+        np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)

@@ -235,3 +235,21 @@ def test_tuned_defaults_flip_visible_on_chip(tpu):
         if key in vals and not os.environ.get(env):
             # env overrides the file by design; assert only the file path
             assert getattr(cfg, key) == vals[key]
+
+
+def test_flash_attention_on_chip(tpu):
+    """The Pallas flash-attention kernel must pass its on-device selftest
+    and agree with the XLA reference on REAL hardware (CI only checks the
+    interpreter), causal and full, incl. non-divisible lengths."""
+    from synapseml_tpu.ops.attention_kernel import (_tpu_flash_selftest,
+                                                    flash_attention)
+    from synapseml_tpu.parallel.ring_attention import attention_reference
+
+    assert _tpu_flash_selftest(), "Mosaic lowering selftest failed on chip"
+    rng = np.random.default_rng(0)
+    q, k, v = (rng.normal(size=(2, 300, 4, 64)).astype(np.float32)
+               for _ in range(3))
+    for causal in (False, True):
+        got = np.asarray(flash_attention(q, k, v, causal=causal))
+        want = np.asarray(attention_reference(q, k, v, causal=causal))
+        np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)

@@ -107,6 +107,15 @@ class GrowerConfig(NamedTuple):
     # sort/permute work per split; which of the three wins is a measured
     # property of the chip (tools/perf_tune.py)
     row_layout: str = "partition"
+    # histogram allreduce wire precision: "f32" (default) or "bf16" — the
+    # quantized-collective idea (EQuARX, arXiv:2506.17615) applied where it
+    # is nearly free: grad/hess are ALREADY bf16-rounded before histogram
+    # accumulation (ops/hist_kernel.py contract), so shipping those two
+    # channels as bf16 cuts per-split collective bytes to 2/3 (counts stay
+    # exact f32 — they gate min_data_in_leaf) at one extra rounding of the
+    # grad/hess SUMS. Multi-host DCN is the payoff regime; off by default
+    # for bit-parity.
+    hist_allreduce_dtype: str = "f32"
 
 
 class TreeArrays(NamedTuple):
@@ -158,8 +167,21 @@ def _bucket_sizes(np_rows: int) -> list:
     return sizes
 
 
-def _maybe_psum(x, axis_name):
-    return lax.psum(x, axis_name) if axis_name is not None else x
+def _maybe_psum(x, axis_name, wire_dtype: str = "f32"):
+    """Cross-shard histogram allreduce; ``wire_dtype='bf16'`` ships the
+    grad/hess channels at half width (their per-row values are bf16-rounded
+    already — ops/hist_kernel.py contract) while the COUNT channel stays
+    exact f32: shard count partials are exact integers feeding the
+    min_data_in_leaf gates, and bf16 would round them to multiples of 512
+    at realistic shard sizes. Net wire bytes: 2/3 of full width."""
+    if axis_name is None:
+        return x
+    if wire_dtype == "bf16":
+        gh = lax.psum(x[..., :2].astype(jnp.bfloat16),
+                      axis_name).astype(x.dtype)
+        cnt = lax.psum(x[..., 2:], axis_name)
+        return jnp.concatenate([gh, cnt], axis=-1)
+    return lax.psum(x, axis_name)
 
 
 def _stable_partition_src(key: jnp.ndarray, impl: str) -> jnp.ndarray:
@@ -651,7 +673,7 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
         hist = lax.switch(jnp.minimum(bidx, len(sizes) - 1),
                           [make_branch(s) for s in sizes],
                           (bT, gs, hs, ms, child_start, child_len))
-        return _maybe_psum(hist, axis_name)
+        return _maybe_psum(hist, axis_name, cfg.hist_allreduce_dtype)
 
     nmask = _node_mask_fn(cfg, featp, f, node_key)
     catb = _pad_cat_nbins(cat_nbins, f, FP, B)
@@ -833,7 +855,7 @@ def _grow_tree_impl_gather(binned, grad, hess, in_bag, feature_active,
         hist = lax.switch(jnp.minimum(bidx, len(sizes) - 1),
                           [make_branch(s) for s in sizes],
                           (pos, child_start, child_len))
-        return _maybe_psum(hist, axis_name)
+        return _maybe_psum(hist, axis_name, cfg.hist_allreduce_dtype)
 
     nmask = _node_mask_fn(cfg, featp, f, node_key)
     catb = _pad_cat_nbins(cat_nbins, f, FP, B)
@@ -843,7 +865,8 @@ def _grow_tree_impl_gather(binned, grad, hess, in_bag, feature_active,
                               l2, catb)
 
     # ---- root: no gather needed (pos is identity) ------------------------
-    hist_root = _maybe_psum(child_histogram(bT0, gs0, hs0, ms0, B), axis_name)
+    hist_root = _maybe_psum(child_histogram(bT0, gs0, hs0, ms0, B),
+                            axis_name, cfg.hist_allreduce_dtype)
     rg, rf, rb, rdl, rcl, _ = best_of(hist_root, nmask(jnp.int32(2 * (L - 1))))
 
     init = _GatherState(
@@ -979,7 +1002,7 @@ def _grow_tree_impl_masked(binned, grad, hess, in_bag, feature_active,
 
     def build_hist_masked(sel):
         hist = child_histogram(bT0, gs0 * sel, hs0 * sel, ms0 * sel, B)
-        return _maybe_psum(hist, axis_name)
+        return _maybe_psum(hist, axis_name, cfg.hist_allreduce_dtype)
 
     nmask = _node_mask_fn(cfg, featp, f, node_key)
     catb = _pad_cat_nbins(cat_nbins, f, FP, B)

@@ -127,7 +127,7 @@ def _grow_tree_impl_depthwise(binned, grad, hess, in_bag, feature_active,
         # shards' split decisions.
         del leaf_len
         hist = jnp.where(exists[:, None, None, None], hist, 0.0)
-        return _maybe_psum(hist, axis_name)
+        return _maybe_psum(hist, axis_name, cfg.hist_allreduce_dtype)
 
     # ---- root ------------------------------------------------------------
     rleaf0 = jnp.zeros(CAP, jnp.int32)

@@ -131,11 +131,14 @@ DEFAULT_ENGINE_ROW_ITERS_PER_S = 1.69e6
 def collective_bytes_per_split(num_features: int, max_bin: int,
                                top_k=None, dtype_bytes: int = 4) -> int:
     """Logical allreduce payload of ONE split's histogram aggregation:
-    (F_aggregated, max_bin, 3 channels) float32. Data-parallel aggregates
-    every feature; voting-parallel only the elected 2k columns."""
+    (F_aggregated, max_bin, 3 channels) × dtype_bytes. Data-parallel
+    aggregates every feature; voting-parallel only the elected 2k columns.
+    ``dtype_bytes=8/3`` prices the bf16 wire option
+    (BoosterConfig.hist_allreduce_dtype: grad/hess at 2 bytes, counts at
+    4) — an independent 1.5x on the same comm term."""
     f_agg = (num_features if top_k is None
              else min(2 * int(top_k), num_features))
-    return int(f_agg) * int(max_bin) * 3 * dtype_bytes
+    return int(round(f_agg * int(max_bin) * 3 * dtype_bytes))
 
 
 def selection_bytes_per_tree(num_features: int, dtype_bytes: int = 4) -> int:
@@ -146,22 +149,28 @@ def selection_bytes_per_tree(num_features: int, dtype_bytes: int = 4) -> int:
 
 def voting_cost_model(num_features: int, max_bin: int, top_k: int,
                       num_leaves: int,
-                      selection_s_per_tree: float = 1e-3) -> dict:
+                      selection_s_per_tree: float = 1e-3,
+                      dtype_bytes: float = 4) -> dict:
     """Per-tree collective accounting for both modes and the CROSSOVER link
     bandwidth: below it, the bytes voting saves per tree take longer on the
-    wire than its selection pass costs — voting wins."""
+    wire than its selection pass costs — voting wins. ``dtype_bytes``
+    follows the configured histogram wire precision (8/3 under bf16)."""
     splits = max(int(num_leaves) - 1, 1)
-    dp = splits * collective_bytes_per_split(num_features, max_bin)
-    vp = (splits * collective_bytes_per_split(num_features, max_bin, top_k)
+    dp = splits * collective_bytes_per_split(num_features, max_bin,
+                                             dtype_bytes=dtype_bytes)
+    vp = (splits * collective_bytes_per_split(num_features, max_bin, top_k,
+                                              dtype_bytes=dtype_bytes)
           + selection_bytes_per_tree(num_features))
     saved = max(dp - vp, 0)
     crossover = (saved / selection_s_per_tree
                  if selection_s_per_tree > 0 else float("inf"))
     return {
         "bytes_per_split_data_parallel":
-            collective_bytes_per_split(num_features, max_bin),
+            collective_bytes_per_split(num_features, max_bin,
+                                       dtype_bytes=dtype_bytes),
         "bytes_per_split_voting":
-            collective_bytes_per_split(num_features, max_bin, top_k),
+            collective_bytes_per_split(num_features, max_bin, top_k,
+                                       dtype_bytes=dtype_bytes),
         "selection_bytes_per_tree": selection_bytes_per_tree(num_features),
         "bytes_per_tree_data_parallel": dp,
         "bytes_per_tree_voting": vp,
@@ -178,7 +187,8 @@ def recommend_tree_learner(num_features: int, max_bin: int, top_k: int,
                            DEFAULT_ENGINE_ROW_ITERS_PER_S,
                            selection_fraction: float =
                            DEFAULT_SELECTION_FRACTION,
-                           selection_s_per_tree: float = None) -> str:
+                           selection_s_per_tree: float = None,
+                           dtype_bytes: float = 4) -> str:
     """The documented selection rule (VERDICT r4 #7):
 
     * single host — "data": every collective is intra-host (ICI/memcpy);
@@ -206,6 +216,6 @@ def recommend_tree_learner(num_features: int, max_bin: int, top_k: int,
         selection_s_per_tree = (selection_fraction * rows_per_host
                                 / engine_row_iters_per_s)
     m = voting_cost_model(num_features, max_bin, top_k, num_leaves,
-                          selection_s_per_tree)
+                          selection_s_per_tree, dtype_bytes=dtype_bytes)
     saved_wire_s = m["bytes_saved_per_tree"] / link_bytes_per_s
     return "voting" if saved_wire_s > selection_s_per_tree else "data"

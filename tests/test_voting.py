@@ -166,3 +166,46 @@ class TestCostModel:
         b = train_booster(X, y, cfg, mesh=mesh)
         assert cfg.tree_learner == "data"        # resolution recorded
         assert float(_auc(y, b.predict(X))) > 0.95
+
+
+class TestQuantizedAllreduce:
+    def test_bf16_hist_allreduce_quality(self):
+        """hist_allreduce_dtype='bf16' (EQuARX-style quantized collective —
+        the partials are bf16-rounded already, so only the shard SUMS take
+        one extra rounding): same tree quality, half the wire bytes."""
+        import numpy as np
+
+        from synapseml_tpu.gbdt import BoosterConfig, train_booster
+        from synapseml_tpu.gbdt.objectives import auc as _auc
+        from synapseml_tpu.parallel import make_mesh
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(6000, 10)).astype(np.float32)
+        y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2] > 0).astype(np.float32)
+        mesh = make_mesh({"data": 8})
+        kw = dict(objective="binary", num_iterations=10, num_leaves=15,
+                  seed=1)
+        b32 = train_booster(X, y, BoosterConfig(**kw), mesh=mesh)
+        b16 = train_booster(
+            X, y, BoosterConfig(**kw, hist_allreduce_dtype="bf16"),
+            mesh=mesh)
+        auc32 = float(_auc(y, b32.predict(X)))
+        auc16 = float(_auc(y, b16.predict(X)))
+        assert auc16 > 0.95, auc16
+        assert abs(auc32 - auc16) < 0.01, (auc32, auc16)
+
+    def test_typo_rejected_at_construction(self):
+        import pytest
+
+        from synapseml_tpu.gbdt import BoosterConfig
+
+        with pytest.raises(ValueError, match="hist_allreduce_dtype"):
+            BoosterConfig(hist_allreduce_dtype="bfloat16")
+
+    def test_cost_model_prices_wire_dtype(self):
+        from synapseml_tpu.gbdt.voting import voting_cost_model
+
+        m32 = voting_cost_model(1000, 255, 20, 31)
+        m16 = voting_cost_model(1000, 255, 20, 31, dtype_bytes=8 / 3)
+        assert m16["bytes_per_split_data_parallel"] == round(
+            m32["bytes_per_split_data_parallel"] * 2 / 3)

@@ -1766,17 +1766,217 @@ def _attention(node, x, w, b=None, mask_index=None, past=None,
     q, k, v = heads(q, qh), heads(k, kh), heads(v, vh)
     # custom scale attr when present; ORT's default is 1/sqrt(q head size)
     scale = node.attr("scale", 0.0) or 1.0 / np.sqrt(qh // nh)
-    logits = (q @ k.transpose(0, 1, 3, 2)) * scale            # (B,nh,S,S)
+    out = _sdpa_core(q, k, v, scale, attention_bias, mask_index,
+                     causal=uni, op_name="Attention")
+    return out.transpose(0, 2, 1, 3).reshape(B, S, vh)
+
+
+def _sdpa_core(q, k, v, scale, attention_bias, key_padding_mask, causal,
+               op_name):
+    """Scaled-dot-product-attention shared by the com.microsoft fused ops
+    (Attention / MultiHeadAttention): (B, nh, S, D) head tensors in, same
+    layout out; ORT's -10000 masking convention for the raw (B, Skv)
+    key-padding mask and the causal (unidirectional) triangle."""
+    import jax
+
+    jnp = _jnp()
+    logits = (q @ k.transpose(0, 1, 3, 2)) * scale       # (B,nh,Sq,Skv)
     if attention_bias is not None:
         logits = logits + attention_bias
-    if mask_index is not None:
-        if mask_index.ndim != 2:
-            raise ValueError("Attention: only the raw (B, S) key-padding "
-                             "mask_index form is supported")
-        keymask = mask_index.astype(bool)[:, None, None, :]   # (B,1,1,S)
+    if key_padding_mask is not None:
+        if key_padding_mask.ndim != 2:
+            raise ValueError(f"{op_name}: only the raw (B, Skv) "
+                             "key-padding mask form is supported")
+        keymask = key_padding_mask.astype(bool)[:, None, None, :]
         logits = jnp.where(keymask, logits, -10000.0)
-    if uni:
-        causal = jnp.tril(jnp.ones((S, S), bool))
-        logits = jnp.where(causal[None, None], logits, -10000.0)
+    if causal:
+        s_q, s_kv = q.shape[2], k.shape[2]
+        tri = (jnp.arange(s_q)[:, None] >= jnp.arange(s_kv)[None, :])
+        logits = jnp.where(tri[None, None], logits, -10000.0)
     probs = jax.nn.softmax(logits, axis=-1)
-    return (probs @ v).transpose(0, 2, 1, 3).reshape(B, S, vh)
+    return probs @ v                                     # (B,nh,Sq,D)
+
+
+# --- coverage wideners (round 5): the remaining deterministic standard ops
+# a torch exporter can emit. Each is validated against torch's own CPU
+# implementation in tests/test_onnx_extended_ops.py where torch has one.
+
+@op("Hardmax")
+def _hardmax(node, x):
+    import jax
+
+    jnp = _jnp()
+    axis = int(node.attr("axis", -1))
+    idx = jnp.argmax(x, axis=axis)
+    return jax.nn.one_hot(idx, x.shape[axis], axis=axis, dtype=x.dtype)
+
+
+@op("Celu")
+def _celu(node, x):
+    jnp = _jnp()
+    a = float(node.attr("alpha", 1.0))
+    return jnp.maximum(x, 0.0) + jnp.minimum(
+        0.0, a * (jnp.exp(x / a) - 1.0))
+
+
+@op("Mish")
+def _mish(node, x):
+    import jax
+
+    jnp = _jnp()
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@op("Shrink")
+def _shrink(node, x):
+    jnp = _jnp()
+    lambd = float(node.attr("lambd", 0.5))
+    bias = float(node.attr("bias", 0.0))
+    return jnp.where(x < -lambd, x + bias,
+                     jnp.where(x > lambd, x - bias,
+                               jnp.zeros_like(x)))
+
+
+@op("ThresholdedRelu")
+def _thresholded_relu(node, x):
+    jnp = _jnp()
+    a = float(node.attr("alpha", 1.0))
+    return jnp.where(x > a, x, jnp.zeros_like(x))
+
+
+@op("BitShift")
+def _bitshift(node, x, y):
+    jnp = _jnp()
+    d = node.attr("direction")
+    d = d if isinstance(d, str) else (d or b"LEFT").decode()
+    return jnp.left_shift(x, y) if d.upper() == "LEFT" \
+        else jnp.right_shift(x, y)
+
+
+@op("EyeLike")
+def _eyelike(node, x):
+    jnp = _jnp()
+    k = int(node.attr("k", 0))
+    dt = node.attr("dtype")
+    from .protoio import DTYPES
+
+    if dt is not None:
+        dtype = DTYPES.get(int(dt))
+        if dtype is None:
+            raise ValueError(f"EyeLike: unsupported dtype code {int(dt)}")
+    else:
+        dtype = x.dtype
+    return jnp.eye(x.shape[0], x.shape[1], k=k, dtype=dtype)
+
+
+@op("Det")
+def _det(node, x):
+    jnp = _jnp()
+    return jnp.linalg.det(x)
+
+
+@op("LRN")
+def _lrn(node, x):
+    """Cross-channel local response normalization (NCHW, channel axis 1):
+    y = x / (bias + alpha/size * window_sum(x^2))^beta — AlexNet-era op
+    still present in exported legacy vision models."""
+    jnp = _jnp()
+    alpha = float(node.attr("alpha", 1e-4))
+    beta = float(node.attr("beta", 0.75))
+    bias = float(node.attr("bias", 1.0))
+    size = int(node.attr("size"))
+    half_lo = (size - 1) // 2
+    half_hi = size // 2
+    sq = x * x
+    pad = [(0, 0)] * sq.ndim
+    pad[1] = (half_lo, half_hi)
+    padded = jnp.pad(sq, pad)
+    win = sum(padded[:, i:i + x.shape[1]] for i in range(size))
+    return x / (bias + (alpha / size) * win) ** beta
+
+
+@op("GridSample")
+def _grid_sample(node, x, grid):
+    """2-D bilinear/nearest grid sampling (torch F.grid_sample export):
+    x (N, C, Hin, Win), grid (N, Hout, Wout, 2) with xy in [-1, 1];
+    zeros / border padding, align_corners both ways."""
+    jnp = _jnp()
+    mode = node.attr("mode", "linear")
+    mode = mode if isinstance(mode, str) else mode.decode()
+    pad_mode = node.attr("padding_mode", "zeros")
+    pad_mode = pad_mode if isinstance(pad_mode, str) else pad_mode.decode()
+    align = bool(node.attr("align_corners", 0))
+    if mode not in ("linear", "bilinear", "nearest"):
+        raise ValueError(f"GridSample: mode {mode!r} not supported")
+    if pad_mode not in ("zeros", "border"):
+        raise ValueError(f"GridSample: padding_mode {pad_mode!r} "
+                         "not supported")
+    N, C, H, W = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]          # (N, Ho, Wo), in [-1, 1]
+    if align:
+        fx = (gx + 1.0) * 0.5 * (W - 1)
+        fy = (gy + 1.0) * 0.5 * (H - 1)
+    else:
+        fx = ((gx + 1.0) * W - 1.0) * 0.5
+        fy = ((gy + 1.0) * H - 1.0) * 0.5
+
+    # flatten spatial, one take_along_axis per corner
+    flat = x.reshape(N, C, H * W)
+
+    def gather(ix, iy):
+        inb = ((ix >= 0) & (ix < W) & (iy >= 0) & (iy < H))
+        cx = jnp.clip(ix, 0, W - 1)
+        cy = jnp.clip(iy, 0, H - 1)
+        lin = (cy * W + cx).reshape(N, 1, -1)    # (N, 1, Ho*Wo)
+        v = jnp.take_along_axis(flat, jnp.broadcast_to(
+            lin, (N, C, lin.shape[-1])), axis=2)
+        v = v.reshape(N, C, *ix.shape[1:])
+        if pad_mode == "zeros":
+            v = v * inb[:, None].astype(v.dtype)
+        return v
+
+    if mode == "nearest":
+        return gather(jnp.round(fx).astype(jnp.int32),
+                      jnp.round(fy).astype(jnp.int32))
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = (fx - x0).astype(x.dtype)[:, None]
+    wy = (fy - y0).astype(x.dtype)[:, None]
+    v00, v01 = gather(x0, y0), gather(x1, y0)
+    v10, v11 = gather(x0, y1), gather(x1, y1)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+@op("MultiHeadAttention")
+def _multi_head_attention(node, query, key=None, value=None, bias=None,
+                          key_padding_mask=None, attention_bias=None,
+                          past_key=None, past_value=None):
+    """com.microsoft MultiHeadAttention (the newer ORT fusion): separate
+    (B, S, hidden) q/k/v with optional packed (3*hidden) bias, raw (B, Skv)
+    key-padding mask, additive attention bias, and the unidirectional
+    (causal) attribute; KV caches and packed-QKV query forms are not
+    supported."""
+    if past_key is not None or past_value is not None:
+        raise ValueError("MultiHeadAttention: past KV cache not supported")
+    if key is None or value is None:
+        raise ValueError("MultiHeadAttention: packed-QKV query form not "
+                         "supported (pass separate key/value)")
+    nh = int(node.attr("num_heads"))
+    B, Sq, Hq = query.shape
+    if bias is not None:
+        query = query + bias[:Hq]
+        key = key + bias[Hq:Hq + key.shape[-1]]
+        value = value + bias[Hq + key.shape[-1]:]
+
+    def heads(t):
+        return t.reshape(B, t.shape[1], nh, -1).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(query), heads(key), heads(value)
+    scale = node.attr("scale", 0.0) or 1.0 / np.sqrt(Hq // nh)
+    out = _sdpa_core(q, k, v, scale, attention_bias, key_padding_mask,
+                     causal=bool(node.attr("unidirectional", 0)),
+                     op_name="MultiHeadAttention")
+    return out.transpose(0, 2, 1, 3).reshape(B, Sq, -1)

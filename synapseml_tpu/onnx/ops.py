@@ -1980,3 +1980,90 @@ def _multi_head_attention(node, query, key=None, value=None, bias=None,
                      causal=bool(node.attr("unidirectional", 0)),
                      op_name="MultiHeadAttention")
     return out.transpose(0, 2, 1, 3).reshape(B, Sq, -1)
+
+
+# ONNX's Random* ops are "implementation-defined" without a seed; here they
+# are DETERMINISTIC — jax.random keyed by the seed attr (0 when absent) —
+# because a traced XLA program cannot carry hidden RNG state, and serving
+# reproducibility is a feature, not a bug.
+
+def _random_common(node, shape, like_dtype=None):
+    import jax
+
+    from .protoio import DTYPES
+
+    dt = node.attr("dtype")
+    if dt is not None:
+        dtype = DTYPES.get(int(dt))
+        if dtype is None:
+            raise ValueError(f"Random*: unsupported dtype code {int(dt)}")
+    else:
+        # spec: the Like forms inherit the input tensor's dtype
+        dtype = like_dtype if like_dtype is not None else np.float32
+    seed = node.attr("seed")
+    if seed is not None:
+        key = jax.random.PRNGKey(int(seed))
+    else:
+        # seed-less nodes must still DECORRELATE from each other: key off
+        # the node's (graph-unique) first output name, stably hashed —
+        # python's str hash is per-process randomized, crc32 is not
+        import zlib
+
+        ident = (node.outputs[0] if node.outputs else node.name) or "rng"
+        key = jax.random.PRNGKey(zlib.crc32(ident.encode()))
+    return key, tuple(int(s) for s in shape), dtype
+
+
+@op("RandomNormal")
+def _random_normal(node):
+    import jax
+
+    key, shape, dtype = _random_common(node, node.attr("shape"))
+    mean = float(node.attr("mean", 0.0))
+    scale = float(node.attr("scale", 1.0))
+    return mean + scale * jax.random.normal(key, shape, dtype)
+
+
+@op("RandomUniform")
+def _random_uniform(node):
+    import jax
+
+    key, shape, dtype = _random_common(node, node.attr("shape"))
+    low = float(node.attr("low", 0.0))
+    high = float(node.attr("high", 1.0))
+    return jax.random.uniform(key, shape, dtype, low, high)
+
+
+@op("RandomNormalLike")
+def _random_normal_like(node, x):
+    import jax
+
+    key, shape, dtype = _random_common(node, x.shape, like_dtype=x.dtype)
+    mean = float(node.attr("mean", 0.0))
+    scale = float(node.attr("scale", 1.0))
+    return mean + scale * jax.random.normal(key, shape, dtype)
+
+
+@op("RandomUniformLike")
+def _random_uniform_like(node, x):
+    import jax
+
+    key, shape, dtype = _random_common(node, x.shape, like_dtype=x.dtype)
+    low = float(node.attr("low", 0.0))
+    high = float(node.attr("high", 1.0))
+    return jax.random.uniform(key, shape, dtype, low, high)
+
+
+@op("Multinomial")
+def _multinomial(node, x):
+    """Categorical sampling from unnormalized LOG-probabilities per row
+    (the ONNX input is unnormalized log-probs); deterministic via the
+    shared Random* seeding, dtype attr honored (spec default int32)."""
+    import jax
+
+    jnp = _jnp()
+    n = int(node.attr("sample_size", 1))
+    key, _, dtype = _random_common(node, (), like_dtype=np.int32)
+    out = jax.random.categorical(key, jnp.asarray(x), axis=-1,
+                                 shape=(n,) + (x.shape[0],))
+    return out.T.astype(dtype)

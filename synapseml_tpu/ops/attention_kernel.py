@@ -92,25 +92,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k", "interpret"))
-def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
-                   block_k: int, interpret: bool):
-    """(B, S, H, D) → (B, S, H, D): pad to block multiples, run the kernel
-    over a (B·H, q-blocks, k-blocks) grid, slice the padding back off."""
-    from jax.experimental import pallas as pl
-
+def _blocks_and_pad(q, k, v, block_q: int, block_k: int):
+    """Shared layout preamble for both kernels: 8-row-aligned block clamp
+    (f32 sublane tile — a raw-seq-length clip would hand Mosaic shapes the
+    one-shot selftest never exercised), (B, S, H, D) → (B·H, S, D), and
+    zero-padding to block multiples (padded kv columns are masked inside
+    the kernels; padded q rows are dropped by the callers)."""
     B, s_q, H, D = q.shape
     s_k = k.shape[1]
-    # block shapes stay 8-row aligned (f32 sublane tile) — a raw-seq-length
-    # clip would hand Mosaic shapes the one-shot selftest never exercised,
-    # breaking the degrade contract per-shape (code-review r5)
     bq = min(block_q, -(-max(s_q, 8) // 8) * 8)
     bk = min(block_k, -(-max(s_k, 8) // 8) * 8)
     pad_q = (-s_q) % bq
     pad_k = (-s_k) % bk
-    # (B, S, H, D) -> (B*H, S, D), zero-padded to block multiples (padded
-    # kv columns are masked inside the kernel; padded q rows are dropped)
     qT = jnp.moveaxis(q, 2, 1).reshape(B * H, s_q, D)
     kT = jnp.moveaxis(k, 2, 1).reshape(B * H, s_k, D)
     vT = jnp.moveaxis(v, 2, 1).reshape(B * H, s_k, D)
@@ -119,8 +112,28 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
     if pad_k:
         kT = jnp.pad(kT, ((0, 0), (0, pad_k), (0, 0)))
         vT = jnp.pad(vT, ((0, 0), (0, pad_k), (0, 0)))
-    nq, nk = qT.shape[1] // bq, kT.shape[1] // bk
+    return B, H, D, s_q, s_k, bq, bk, pad_q, qT, kT, vT
+
+
+def _vmem_state_scratch(bq: int, D: int):
     from jax.experimental.pallas import tpu as pltpu
+
+    return [pltpu.VMEM((bq, D), jnp.float32),        # acc
+            pltpu.VMEM((bq, 128), jnp.float32),      # running max m
+            pltpu.VMEM((bq, 128), jnp.float32)]      # normalizer l
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
+                   block_k: int, interpret: bool):
+    """(B, S, H, D) → (B, S, H, D): pad to block multiples, run the kernel
+    over a (B·H, q-blocks, k-blocks) grid, slice the padding back off."""
+    from jax.experimental import pallas as pl
+
+    (B, H, D, s_q, s_k, bq, bk, _,
+     qT, kT, vT) = _blocks_and_pad(q, k, v, block_q, block_k)
+    nq, nk = qT.shape[1] // bq, kT.shape[1] // bk
 
     out = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
@@ -133,11 +146,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qT.shape, q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((bq, D), jnp.float32),        # acc
-            pltpu.VMEM((bq, 128), jnp.float32),      # running max m
-            pltpu.VMEM((bq, 128), jnp.float32),      # normalizer l
-        ],
+        scratch_shapes=_vmem_state_scratch(bq, D),
         interpret=interpret,
     )(qT, kT, vT)
     out = out[:, :s_q].reshape(B, H, s_q, D)
@@ -228,3 +237,177 @@ def flash_attention(q, k, v, causal: bool = False,
 
     f.defvjp(fwd, bwd)
     return f(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# State-carrying variant: the ring's inner step (parallel/ring_attention.py
+# rotates K/V blocks around the mesh and folds each into carried online-
+# softmax state). Same fused math as _flash_kernel, but (m, l, acc) enter
+# and leave as tensors instead of living only in scratch — so the ring can
+# run its per-step block attention as ONE kernel on TPU.
+# ---------------------------------------------------------------------------
+
+def _flash_block_kernel(off_ref, q_ref, k_ref, v_ref, m_in_ref, l_in_ref,
+                        o_in_ref, m_out_ref, l_out_ref, o_out_ref,
+                        acc_ref, m_ref, l_ref, *, scale, causal,
+                        block_q, block_k, s_k):
+    """off_ref (SMEM, scalar-prefetched): [q_offset, k_offset] — the blocks'
+    GLOBAL sequence starts, traced values inside the ring's shard_map (the
+    rank index decides them, so they cannot be compile-time constants)."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[...] = o_in_ref[0].astype(jnp.float32)
+        m_ref[...] = jnp.broadcast_to(
+            jnp.maximum(m_in_ref[0][:, None], _NEG_INF), m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_in_ref[0][:, None], l_ref.shape)
+
+    # causal dead-block skip with RUNTIME offsets (same ~2x win as the
+    # plain kernel's static guard): the whole tile is in the causal future
+    # when its first global column exceeds the last global row
+    live = (off_ref[1] + ki * block_k
+            <= off_ref[0] + qi * block_q + block_q - 1
+            if causal else ki >= 0)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        rows = (off_ref[0] + qi * block_q
+                + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+        cols_local = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        cols = off_ref[1] + cols_local
+        valid = cols_local < s_k
+        if causal:
+            valid &= rows >= cols
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_old = m_ref[...][:, :1]
+        l_old = l_ref[...][:, :1]
+        m_new = jnp.maximum(m_old, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_old - m_new)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_new = l_old * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _():
+        m_out_ref[0] = m_ref[...][:, 0].astype(m_out_ref.dtype)
+        l_out_ref[0] = l_ref[...][:, 0].astype(l_out_ref.dtype)
+        o_out_ref[0] = acc_ref[...].astype(o_out_ref.dtype)
+
+
+@functools.cache
+def _tpu_flash_block_selftest() -> bool:
+    """On-device certification of the STATE-CARRYING lowering specifically
+    (scalar prefetch, multi-output, (1, bq) state blocks) — a distinct
+    Mosaic compile path from _flash_forward's, so it needs its own gate
+    (code-review r5: the ring must degrade to the XLA step, not die
+    mid-shard_map, when only this lowering regresses)."""
+    import numpy as np
+
+    from ..parallel.ring_attention import _block_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 140, 2, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 128, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 128, 2, 64)), jnp.float32)
+    m0 = jnp.full((2, 2, 140), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((2, 2, 140), jnp.float32)
+    o0 = jnp.zeros((2, 140, 2, 64), jnp.float32)
+    try:
+        for causal in (False, True):
+            mk, lk, ok = flash_attention_block(
+                q, k, v, m0, l0, o0, q_offset=64, k_offset=0,
+                causal=causal, scale=0.125, interpret=False)
+            mr, lr, orf = _block_attention(q, k, v, m0, l0, o0, 64, 0,
+                                           causal, 0.125)
+            fin = np.isfinite(np.asarray(mr))
+            if not (np.allclose(np.asarray(mk)[fin], np.asarray(mr)[fin],
+                                rtol=3e-4, atol=3e-4)
+                    and np.allclose(np.asarray(lk), np.asarray(lr),
+                                    rtol=3e-4, atol=3e-4)
+                    and np.allclose(np.asarray(ok), np.asarray(orf),
+                                    rtol=3e-4, atol=3e-4)):
+                return False
+        return True
+    except Exception:
+        return False
+
+
+def flash_attention_block(q, k, v, m, l, o, q_offset, k_offset,
+                          causal: bool = False, scale: float = None,
+                          block_q: int = 128, block_k: int = 128,
+                          interpret: bool = False):
+    """One fused online-softmax update of carried state — the drop-in
+    kernel form of ring_attention._block_attention. Layouts match the
+    ring: q (B, Sq, H, D), k/v (B, Sk, H, D), m/l (B, H, Sq) running
+    max/normalizer, o (B, Sq, H, D) UNNORMALIZED accumulator; offsets are
+    the blocks' global sequence starts (traced values are fine — they ride
+    scalar prefetch). -inf entries in ``m`` are mapped to the kernel's
+    finite sentinel; finalize with ring_attention._finalize as usual."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
+    (B, H, D, s_q, s_k, bq, bk, pad_q,
+     qT, kT, vT) = _blocks_and_pad(q, k, v, block_q, block_k)
+    mT = m.reshape(B * H, s_q)
+    lT = l.reshape(B * H, s_q)
+    oT = jnp.moveaxis(o, 2, 1).reshape(B * H, s_q, D)
+    if pad_q:
+        oT = jnp.pad(oT, ((0, 0), (0, pad_q), (0, 0)))
+        mT = jnp.pad(mT, ((0, 0), (0, pad_q)),
+                     constant_values=_NEG_INF)
+        lT = jnp.pad(lT, ((0, 0), (0, pad_q)))
+    nq, nk = qT.shape[1] // bq, kT.shape[1] // bk
+    offs = jnp.asarray(
+        jnp.stack([jnp.asarray(q_offset, jnp.int32).reshape(()),
+                   jnp.asarray(k_offset, jnp.int32).reshape(())]))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j, off: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j, off: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j, off: (b, j, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j, off: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j, off: (b, i)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j, off: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq), lambda b, i, j, off: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j, off: (b, i)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j, off: (b, i, 0)),
+        ],
+        scratch_shapes=_vmem_state_scratch(bq, D),
+    )
+    m2, l2, o2 = pl.pallas_call(
+        functools.partial(_flash_block_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, s_k=s_k),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(mT.shape, jnp.float32),
+            jax.ShapeDtypeStruct(lT.shape, jnp.float32),
+            jax.ShapeDtypeStruct(oT.shape, jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, qT, kT, vT, mT, lT, oT)
+    m2 = m2[:, :s_q].reshape(B, H, s_q)
+    l2 = l2[:, :s_q].reshape(B, H, s_q)
+    o2 = jnp.moveaxis(o2[:, :s_q].reshape(B, H, s_q, D), 1, 2)
+    return m2, l2, o2

@@ -72,15 +72,31 @@ def attention_reference(q, k, v, causal: bool = False,
 
 def ring_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
                         scale: Optional[float] = None,
-                        axis: str = SEQ_AXIS) -> jnp.ndarray:
+                        axis: str = SEQ_AXIS,
+                        use_flash: Optional[bool] = None,
+                        flash_interpret: bool = False) -> jnp.ndarray:
     """Exact self-attention with q/k/v sharded on ``axis`` over ``mesh``.
 
     Each of the R ring ranks holds S/R of the sequence; the result equals
     :func:`attention_reference` on the gathered sequence, bit-for-near-bit
     (online softmax is associative). Peak memory per device is O(S/R · S/R)
     per step instead of O(S²).
+
+    ``use_flash`` runs each rank's per-step block update as the FUSED
+    Pallas kernel (ops/attention_kernel.flash_attention_block — scores,
+    masking, online-softmax rescale, and PV matmul in one VMEM program)
+    instead of the XLA ops below. None = auto: on TPU when the kernel's
+    on-device selftest passes; the XLA path otherwise — both compute the
+    identical update (equality-tested in tests/test_attention_kernel.py).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if use_flash is None:
+        from ..ops.attention_kernel import _tpu_flash_block_selftest
+
+        use_flash = (jax.default_backend() == "tpu"
+                     and _tpu_flash_block_selftest())
+    if use_flash:
+        from ..ops.attention_kernel import flash_attention_block
     ring = mesh.shape[axis]
     # batch rides the data axis when the mesh has one (dp × sp composition) —
     # each data-rank computes only its batch shard
@@ -107,10 +123,17 @@ def ring_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
             k_cur, v_cur, m, l, o = carry
             # block currently held arrived from rank (rank - t) mod ring
             k_offset = ((rank - t) % ring) * s_local
-            m, l, o = _block_attention(
-                q_blk.astype(jnp.float32), k_cur.astype(jnp.float32),
-                v_cur.astype(jnp.float32), m, l, o, q_offset, k_offset,
-                causal, scale)
+            if use_flash:
+                m, l, o = flash_attention_block(
+                    q_blk.astype(jnp.float32), k_cur.astype(jnp.float32),
+                    v_cur.astype(jnp.float32), m, l, o, q_offset, k_offset,
+                    causal=causal, scale=scale,
+                    interpret=flash_interpret)
+            else:
+                m, l, o = _block_attention(
+                    q_blk.astype(jnp.float32), k_cur.astype(jnp.float32),
+                    v_cur.astype(jnp.float32), m, l, o, q_offset, k_offset,
+                    causal, scale)
             # rotate K/V to the next rank (overlaps next step's compute)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)

@@ -129,3 +129,20 @@ class TestUlysses:
         x = np.zeros((1, 16, 6, 4), np.float32)   # 6 heads, 8-way seq
         with pytest.raises(ValueError, match="heads"):
             ulysses_self_attention(x, x, x, mesh)
+
+
+class TestRingWithFlashKernel:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_inner_step_matches_reference(self, causal):
+        """The ring with the FUSED per-step kernel (interpret mode runs the
+        real kernel body on the CPU mesh) must equal plain attention — the
+        multi-chip long-context path's on-TPU configuration."""
+        import jax
+
+        q, k, v = _qkv(d=16)
+        mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+        ref = np.asarray(attention_reference(q, k, v, causal=causal))
+        out = np.asarray(ring_self_attention(q, k, v, mesh, causal=causal,
+                                             use_flash=True,
+                                             flash_interpret=True))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)

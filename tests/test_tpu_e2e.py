@@ -241,11 +241,13 @@ def test_flash_attention_on_chip(tpu):
     """The Pallas flash-attention kernel must pass its on-device selftest
     and agree with the XLA reference on REAL hardware (CI only checks the
     interpreter), causal and full, incl. non-divisible lengths."""
-    from synapseml_tpu.ops.attention_kernel import (_tpu_flash_selftest,
-                                                    flash_attention)
+    from synapseml_tpu.ops.attention_kernel import (
+        _tpu_flash_block_selftest, _tpu_flash_selftest, flash_attention)
     from synapseml_tpu.parallel.ring_attention import attention_reference
 
     assert _tpu_flash_selftest(), "Mosaic lowering selftest failed on chip"
+    assert _tpu_flash_block_selftest(), \
+        "state-carrying (ring) lowering selftest failed on chip"
     rng = np.random.default_rng(0)
     q, k, v = (rng.normal(size=(2, 300, 4, 64)).astype(np.float32)
                for _ in range(3))

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .hist_kernel import _eager_selftest
+
 _NEG_INF = -1e30          # finite -inf stand-in: keeps exp() NaN-free
 
 
@@ -179,6 +181,7 @@ def _xla_fallback(q, k, v, causal: bool, scale: float, block_k: int):
 
 
 @functools.cache
+@_eager_selftest
 def _tpu_flash_selftest() -> bool:
     """One small on-device compile+run decides whether the Mosaic lowering
     is trusted for this process (insurance for unattended bench windows —
@@ -311,6 +314,7 @@ def _flash_block_kernel(off_ref, q_ref, k_ref, v_ref, m_in_ref, l_in_ref,
 
 
 @functools.cache
+@_eager_selftest
 def _tpu_flash_block_selftest() -> bool:
     """On-device certification of the STATE-CARRYING lowering specifically
     (scalar prefetch, multi-output, (1, bq) state blocks) — a distinct

@@ -37,6 +37,27 @@ FEATURE_BLOCK = 8     # features per kernel step (i32 sublane tile)
 LANE = 128
 
 
+def _eager_selftest(fn):
+    """Escape any ambient trace for the duration of a selftest.
+
+    Selftests compile+run tiny on-device programs and compare results as
+    numpy — but their FIRST call can happen during an outer jit trace
+    (``child_histogram`` is reached while the grower's ``lax.switch``
+    branches trace). Under an active trace every jnp op — even on fresh
+    concrete arrays — produces tracers of that trace, so ``np.asarray``
+    raises TracerArrayConversionError (observed on-chip 2026-08-02: the
+    bench's first ``train_booster`` trace died here, and
+    ``_tpu_segmented_ok`` silently mis-cached False, degrading the
+    segmented kernel). ``ensure_compile_time_eval`` runs the body eagerly
+    regardless of tracing context; ``functools.cache`` stays outermost so
+    the certified mode is computed once per process."""
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        with jax.ensure_compile_time_eval():
+            return fn(*a, **k)
+    return wrapper
+
+
 def default_chunk() -> int:
     """Rows per kernel step. Resolution: SYNAPSEML_TPU_HIST_CHUNK env > the
     on-chip sweep winner in docs/tuned_defaults.json (tools/perf_tune.py
@@ -363,6 +384,7 @@ def _hist_level_xla(bT, g, h, m, slot_of_row, num_bins_padded: int,
 
 
 @functools.cache
+@_eager_selftest
 def _tpu_level_ok(num_bins_padded: int, slots: int, pack=None) -> bool:
     """On-device check of the multi-leaf level kernel (same insurance
     contract as _tpu_segmented_ok): False (or SYNAPSEML_TPU_LEVEL=0)
@@ -440,6 +462,7 @@ def _hist_xla(bT, g, h, m, num_bins_padded: int):
 
 
 @functools.cache
+@_eager_selftest
 def _tpu_kernel_selftest(num_bins_padded: int) -> str:
     """One small on-device compile+run per bin width decides the kernel mode
     for this process: packed dot → per-feature dot → XLA scatter. Insurance
@@ -470,6 +493,7 @@ def _tpu_kernel_selftest(num_bins_padded: int) -> str:
 
 
 @functools.cache
+@_eager_selftest
 def _tpu_segmented_ok(num_bins_padded: int) -> bool:
     """On-device check of the scalar-prefetch segmented kernel (same
     insurance contract as _tpu_kernel_selftest): False degrades the grower

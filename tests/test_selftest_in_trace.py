@@ -1,0 +1,78 @@
+"""Kernel selftests must be callable from INSIDE an active jit trace.
+
+The grower reaches ``child_histogram`` / ``segmented_histograms_available``
+while tracing (under ``lax.switch`` inside the fused boosting scan), so the
+``functools.cache``d on-device selftests can be FIRST-invoked mid-trace.
+Under an ambient trace every jnp op produces tracers — without the
+``ensure_compile_time_eval`` escape (ops/hist_kernel._eager_selftest) the
+``np.asarray`` comparisons raise TracerArrayConversionError. Observed
+on-chip 2026-08-02: the round-5 bench's first ``train_booster`` trace died
+exactly there, and ``_tpu_segmented_ok`` mis-cached False (silently
+degrading the segmented kernel for the whole process).
+
+Reference analog: LightGBM's GPU tree learner probes its OpenCL kernels once
+at setup, never during graph construction — the JAX design must make the
+mid-trace probe safe instead, because trace time IS setup time here.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _clear_caches(hk, ak):
+    hk._tpu_kernel_selftest.cache_clear()
+    hk._tpu_segmented_ok.cache_clear()
+    hk._tpu_level_ok.cache_clear()
+    ak._tpu_flash_selftest.cache_clear()
+    ak._tpu_flash_block_selftest.cache_clear()
+
+
+def test_selftests_inside_jit_trace_match_eager():
+    from synapseml_tpu.ops import attention_kernel as ak
+    from synapseml_tpu.ops import hist_kernel as hk
+
+    _clear_caches(hk, ak)
+    eager = {
+        "mode": hk._tpu_kernel_selftest(256),
+        "seg": hk._tpu_segmented_ok(256),
+        "level": hk._tpu_level_ok(256, 4),
+        "flash": ak._tpu_flash_selftest(),
+        "block": ak._tpu_flash_block_selftest(),
+    }
+    _clear_caches(hk, ak)
+    traced = {}
+
+    def f(x):
+        traced["mode"] = hk._tpu_kernel_selftest(256)
+        traced["seg"] = hk._tpu_segmented_ok(256)
+        traced["level"] = hk._tpu_level_ok(256, 4)
+        traced["flash"] = ak._tpu_flash_selftest()
+        traced["block"] = ak._tpu_flash_block_selftest()
+        return x + 1.0
+
+    jax.jit(f)(jnp.ones(4))
+    assert traced == eager
+    # selftest verdicts are plain python values, never tracers
+    assert isinstance(traced["mode"], str)
+    assert all(isinstance(traced[k], bool)
+               for k in ("seg", "level", "flash", "block"))
+
+
+def test_selftest_inside_switch_branch_trace():
+    """The exact shape of the on-chip failure: first selftest call from a
+    ``lax.switch`` branch body mid-trace."""
+    from synapseml_tpu.ops import attention_kernel as ak
+    from synapseml_tpu.ops import hist_kernel as hk
+
+    _clear_caches(hk, ak)
+
+    def branch(x):
+        hk._tpu_kernel_selftest(256)
+        hk._tpu_segmented_ok(256)
+        return x * 2.0
+
+    def f(x):
+        return jax.lax.switch(0, [branch, lambda x: x], x)
+
+    out = jax.jit(f)(jnp.ones(3))
+    assert float(out[0]) == 2.0

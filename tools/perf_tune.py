@@ -156,13 +156,25 @@ def _pack_formula_default() -> int:
     return clamp_pack(128, 256 // 8, FEATURE_BLOCK_PROD)
 
 
-def _persist_and_flip():
+def _persist_and_flip(_repo_dir=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        # every module-global the body reads, bound at def time under its
+        # own name: at-interpreter-shutdown atexit calls can see module
+        # globals (incl. __file__) already torn down (observed on-chip
+        # 2026-08-02: NameError lost a window's results); stdlib modules
+        # re-import locally below for the same reason
+        jax=jax, VARIANTS=VARIANTS, RESULTS=RESULTS,
+        _OPERATOR_TUNED=_OPERATOR_TUNED,
+        _READS_DISABLED_BY_OPERATOR=_READS_DISABLED_BY_OPERATOR,
+        _pack_formula_default=_pack_formula_default):
     """Persist RESULTS and flip docs/tuned_defaults.json to the measured
     winner (the flip half of VERDICT r3 #1 — the bench that follows this
     tune in the same window must measure the tuned DEFAULT). Registered via
     atexit so a TPU-terminal drop mid-phase still lands everything the
     completed phases measured — a short window must still yield."""
     import datetime as _dt
+    import json
+    import os
 
     if not (RESULTS["phase_a_ms_per_tree"]
             or RESULTS["phase_b_train25_row_iters"]
@@ -178,8 +190,8 @@ def _persist_and_flip():
     # bench.py's record_measurement enforces): a CPU sanity run must not
     # clobber numbers captured during a scarce TPU window
     if plat == "tpu":
-        res_path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "docs", "perf_tune_results.json")
+        res_path = os.path.join(_repo_dir, "docs",
+                                "perf_tune_results.json")
     else:
         res_path = f"/tmp/perf_tune_results_{plat}.json"
         print("off-chip run: raw results diverted away from docs/",

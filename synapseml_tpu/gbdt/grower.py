@@ -41,6 +41,7 @@ identical on every device (uniform control flow by construction).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import NamedTuple, Optional
 
@@ -182,6 +183,28 @@ def _maybe_psum(x, axis_name, wire_dtype: str = "f32"):
         cnt = lax.psum(x[..., 2:], axis_name)
         return jnp.concatenate([gh, cnt], axis=-1)
     return lax.psum(x, axis_name)
+
+
+def _aligned_window(start, size: int, np_rows: int, chunk: int):
+    """Chunk-aligned static window ``[cs, cs+S)`` covering any range
+    ``[start, start+len)`` with ``len <= size``: ``S = min(size+chunk,
+    np_rows)`` and ``cs`` rounded down to a chunk boundary.
+
+    Unaligned minor-dim dynamic slices cost lane rotations on TPU; the
+    on-chip grow_tree trace (docs/trace_summary_gbdt.md 2026-08-02) put
+    slice+copy at ~37% of device time while the histogram kernel was ~2%.
+    Aligned windows turn every per-split slice/update into a clean
+    tile-aligned DMA, and make the XLA fallback histogram bit-identical to
+    the segmented Pallas kernel's chunk grouping (ops/hist_kernel.py
+    ``_range_kernel`` uses this same first-chunk formula). Callers' routing
+    keys / masks already guard rows outside [start, start+len).
+    ``SYNAPSEML_TPU_ALIGN_WINDOWS=0`` restores exact-size unaligned windows
+    (on-chip A/B escape hatch)."""
+    if os.environ.get("SYNAPSEML_TPU_ALIGN_WINDOWS", "1") == "0":
+        return jnp.minimum(start, np_rows - size), size
+    S = min(size + chunk, np_rows)
+    cs0 = jnp.minimum(start, np_rows - S)
+    return (cs0 // chunk) * chunk, S
 
 
 def _stable_partition_src(key: jnp.ndarray, impl: str) -> jnp.ndarray:
@@ -658,14 +681,14 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
             def make_branch(size):
                 def br(args):
                     bT_, gs_, hs_, ms_, cstart, clen = args
-                    cs = jnp.minimum(cstart, Np - size)
-                    idx = cs + jnp.arange(size, dtype=jnp.int32)
+                    cs, S = _aligned_window(cstart, size, Np, chunk)
+                    idx = cs + jnp.arange(S, dtype=jnp.int32)
                     mask = ((idx >= cstart)
                             & (idx < cstart + clen)).astype(jnp.float32)
-                    gsl = lax.dynamic_slice(gs_, (cs,), (size,)) * mask
-                    hsl = lax.dynamic_slice(hs_, (cs,), (size,)) * mask
-                    msl = lax.dynamic_slice(ms_, (cs,), (size,)) * mask
-                    bsl = lax.dynamic_slice(bT_, (0, cs), (FP, size))
+                    gsl = lax.dynamic_slice(gs_, (cs,), (S,)) * mask
+                    hsl = lax.dynamic_slice(hs_, (cs,), (S,)) * mask
+                    msl = lax.dynamic_slice(ms_, (cs,), (S,)) * mask
+                    bsl = lax.dynamic_slice(bT_, (0, cs), (FP, S))
                     return child_histogram(bsl, gsl, hsl, msl, B)
                 return br
 
@@ -701,9 +724,9 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
         def make_branch(size):
             def br(args):
                 pos_, gs_, hs_, ms_, bT_ = args
-                cs = jnp.minimum(start, Np - size)
-                idx = cs + jnp.arange(size, dtype=jnp.int32)
-                binrow = lax.dynamic_slice(bT_, (fsel, cs), (1, size))[0]
+                cs, S = _aligned_window(start, size, Np, chunk)
+                idx = cs + jnp.arange(S, dtype=jnp.int32)
+                binrow = lax.dynamic_slice(bT_, (fsel, cs), (1, S))[0]
                 gr = _route_right(binrow, bsel, dl, nanbin_f, bitset,
                                   cat_split, cfg, bw)
                 key = jnp.where(idx < start, -1,
@@ -713,10 +736,10 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
                 nl_loc = jnp.sum(key == 0).astype(jnp.int32)
 
                 def perm1(a):
-                    sl = lax.dynamic_slice(a, (cs,), (size,))
+                    sl = lax.dynamic_slice(a, (cs,), (S,))
                     return lax.dynamic_update_slice(a, sl[src], (cs,))
 
-                blk = lax.dynamic_slice(bT_, (0, cs), (FP, size))
+                blk = lax.dynamic_slice(bT_, (0, cs), (FP, S))
                 bT2 = lax.dynamic_update_slice(bT_, blk[:, src], (0, cs))
                 return perm1(pos_), perm1(gs_), perm1(hs_), perm1(ms_), bT2, nl_loc
             return br
@@ -839,11 +862,11 @@ def _grow_tree_impl_gather(binned, grad, hess, in_bag, feature_active,
         def make_branch(size):
             def br(args):
                 pos_, cstart, clen = args
-                cs = jnp.minimum(cstart, Np - size)
-                idx = cs + jnp.arange(size, dtype=jnp.int32)
+                cs, S = _aligned_window(cstart, size, Np, chunk)
+                idx = cs + jnp.arange(S, dtype=jnp.int32)
                 mask = ((idx >= cstart) & (idx < cstart + clen)
                         ).astype(jnp.float32)
-                posl = lax.dynamic_slice(pos_, (cs,), (size,))
+                posl = lax.dynamic_slice(pos_, (cs,), (S,))
                 gsl = gs0[posl] * mask
                 hsl = hs0[posl] * mask
                 msl = ms0[posl] * mask
@@ -882,9 +905,9 @@ def _grow_tree_impl_gather(binned, grad, hess, in_bag, feature_active,
         returns (updated pos, LOCAL left-child row count)."""
         def make_branch(size):
             def br(pos_):
-                cs = jnp.minimum(start, Np - size)
-                idx = cs + jnp.arange(size, dtype=jnp.int32)
-                posl = lax.dynamic_slice(pos_, (cs,), (size,))
+                cs, S = _aligned_window(start, size, Np, chunk)
+                idx = cs + jnp.arange(S, dtype=jnp.int32)
+                posl = lax.dynamic_slice(pos_, (cs,), (S,))
                 binrow = bT0[fsel, posl]
                 gr = _route_right(binrow, bsel, dl, nanbin_f, bitset,
                                   cat_split, cfg, bw)

@@ -38,6 +38,11 @@ BASELINE_GBDT_ROW_ITERS = 4.0e6
 BASELINE_RESNET_IMGS_SEC = 400.0
 BASELINE_ONNX_IMGS_SEC = 1000.0
 BASELINE_SERVING_P50_MS = 1.0
+# served ResNet-50 p50: ~1 ms compute at the 1000 imgs/s onnxruntime-gpu
+# anchor (BASELINE_ONNX_IMGS_SEC) plus ~4 ms HTTP + JSON image-payload
+# overhead at the reference's serving layer — the comparable end-to-end
+# request latency, not the bare model step
+BASELINE_RESNET_SERVING_P50_MS = 5.0
 # BERT-base seq-128 fine-tune: ~100 ex/s is V100-class mixed-precision
 # training throughput (the reference's DeepTextClassifier hardware);
 # onnxruntime-gpu BERT-base batch inference on the same class: ~400 seq/s
@@ -566,7 +571,8 @@ def bench_serving_resnet(n_requests=60):
         return {"metric": "serving_resnet50_latency_p50_ms",
                 "value": round(p50, 3),
                 "unit": "ms (p99=%.3f; 64x64 image JSON payload)" % p99,
-                "vs_baseline": 0.0}
+                "vs_baseline": round(
+                    BASELINE_RESNET_SERVING_P50_MS / max(p50, 1e-9), 3)}
     finally:
         server.stop()
 

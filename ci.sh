@@ -39,6 +39,10 @@ EOF
 
 echo "== unit tests (8-device CPU mesh) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-    python -m pytest tests/ -x -q
+    python -m pytest tests/ -x -q -m 'not slow'
+
+echo "== perf_tune rehearsal (tune -> flip -> persist on CPU) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_perf_tune_rehearsal.py -x -q -m slow
 
 echo "CI OK"

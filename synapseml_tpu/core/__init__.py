@@ -29,5 +29,15 @@ from .logging import (  # noqa: F401
     InstrumentationMeasures,
     StopWatch,
     SynapseMLLogging,
+    failure_counts,
+    record_failure,
+    reset_failure_counts,
     retry_with_timeout,
+)
+from .resilience import (  # noqa: F401
+    DEADLINE_HEADER,
+    CircuitBreaker,
+    Deadline,
+    RetryBudget,
+    default_retry_budget,
 )

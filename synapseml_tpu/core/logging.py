@@ -15,6 +15,7 @@ import contextlib
 import json
 import logging
 import re
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -220,6 +221,44 @@ class InstrumentationMeasures:
         for k, v in other.counters.items():
             self.counters[k] = self.counters.get(k, 0) + v
         return self
+
+
+# --- structured failure counters --------------------------------------------
+# Process-global counters for resilience events (load shedding, deadline
+# breaches, breaker trips, retry-budget denials, ...). Counting is separated
+# from logging so hot paths pay one dict increment; each event still emits a
+# scrubbed structured record at DEBUG for correlation with request logs.
+# The chaos suite (tests/test_chaos_serving.py) asserts against these, which
+# is what makes failure behavior a CI property instead of folklore.
+
+_FAILURE_LOCK = threading.Lock()
+_FAILURE_COUNTS: Dict[str, int] = {}
+
+
+def record_failure(kind: str, n: int = 1, **detail: Any) -> None:
+    """Count one resilience event (dotted name, e.g. ``serving.shed``) and
+    emit a structured DEBUG record carrying ``detail`` (scrubbed)."""
+    with _FAILURE_LOCK:
+        _FAILURE_COUNTS[kind] = _FAILURE_COUNTS.get(kind, 0) + n
+    if logger.isEnabledFor(logging.DEBUG):
+        payload = {"event": "failure", "kind": kind, "n": n,
+                   "protocolVersion": PROTOCOL_VERSION}
+        if detail:
+            payload.update(detail)
+        logger.debug(scrub_text(json.dumps(scrub_payload(payload),
+                                           default=str)))
+
+
+def failure_counts() -> Dict[str, int]:
+    """Snapshot of all failure counters (copy — safe to mutate)."""
+    with _FAILURE_LOCK:
+        return dict(_FAILURE_COUNTS)
+
+
+def reset_failure_counts() -> None:
+    """Zero the counters (test isolation)."""
+    with _FAILURE_LOCK:
+        _FAILURE_COUNTS.clear()
 
 
 def retry_with_timeout(fn, retries: int = 3, initial_delay_s: float = 1.0, timeout_s: Optional[float] = None):

@@ -1,0 +1,217 @@
+"""Resilience primitives shared by the serving/IO/services layers.
+
+Three small, thread-safe, clock-injectable building blocks:
+
+* :class:`Deadline` — an absolute per-request time budget that propagates
+  end-to-end (client header → gateway forward → admission queue → batch
+  formation → handler budget), so overload degrades to fast 504s instead of
+  open-ended hangs.
+* :class:`RetryBudget` — a token-bucket cap on the *aggregate* retry volume a
+  process may emit. Per-call retry knobs (``maxRetries``/``backoff``) bound one
+  request; under a correlated backend failure N concurrent requests each
+  retrying K times is an N*K retry storm that keeps the backend down. A shared
+  budget turns that into "first failures retry, the rest fail fast".
+* :class:`CircuitBreaker` — the classic three-state (closed → open →
+  half-open) breaker with escalating re-open cooldowns, used by the serving
+  gateway for passive backend health.
+
+Reference analog: the reference leans on Spark task retry plus
+RESTHelpers.scala's per-call backoff and has no shared-fate machinery; these
+are the pieces SURVEY §3.5's "serve heavy traffic" story actually needs, and
+``synapseml_tpu/testing/chaos.py`` exists to fault-test them off-chip.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+# Remaining-budget header, in integer milliseconds. Relative (not an absolute
+# wall-clock instant) so it survives clock skew between client, gateway and
+# worker; each hop re-anchors it against its own monotonic clock.
+DEADLINE_HEADER = "X-Deadline-Ms"
+
+
+class Deadline:
+    """Absolute deadline on the local monotonic clock.
+
+    ``Deadline.after(0.25)`` expires 250 ms from now; ``remaining()`` is the
+    handler budget left, clamped at 0. ``None`` budgets are allowed at the
+    call sites (no deadline), so helpers accept ``Optional[Deadline]``.
+    """
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float):
+        self.at = at
+
+    @classmethod
+    def after(cls, seconds: float, clock=time.monotonic) -> "Deadline":
+        return cls(clock() + seconds)
+
+    @classmethod
+    def from_header_ms(cls, value, cap_s: float,
+                       clock=time.monotonic) -> "Deadline":
+        """Deadline from an ``X-Deadline-Ms`` header value, capped by the
+        server's own limit (a client must not pin server resources longer
+        than the server would allow on its own)."""
+        try:
+            ms = float(value)
+        except (TypeError, ValueError):
+            return cls.after(cap_s, clock)
+        return cls(clock() + min(max(ms, 0.0) / 1e3, cap_s))
+
+    def remaining(self, clock=time.monotonic) -> float:
+        return max(self.at - clock(), 0.0)
+
+    def expired(self, clock=time.monotonic) -> bool:
+        return clock() >= self.at
+
+    def header_value(self, clock=time.monotonic) -> str:
+        """Serialized remaining budget for propagation to the next hop."""
+        return str(int(self.remaining(clock) * 1e3))
+
+
+class RetryBudget:
+    """Token bucket shared across callers: each retry spends one token;
+    tokens refill at ``rate_per_sec`` up to ``burst``.
+
+    ``try_spend()`` never blocks — an empty bucket means "do not retry",
+    which is the whole point: under a correlated failure the process's total
+    retry volume is capped at ``burst + rate_per_sec * t`` regardless of how
+    many requests are in flight. One instance can back every
+    ``send_with_retries`` / services-layer transformer in the process
+    (:data:`default_retry_budget`), or a subsystem can carry its own.
+    """
+
+    def __init__(self, rate_per_sec: float = 5.0, burst: float = 20.0,
+                 clock=time.monotonic):
+        if burst <= 0 or rate_per_sec < 0:
+            raise ValueError("RetryBudget needs burst > 0 and rate >= 0")
+        self.rate = float(rate_per_sec)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+        self._lock = threading.Lock()
+        self.spent = 0          # retries granted
+        self.denied = 0         # retries refused (budget exhausted)
+
+    def _refill(self) -> None:
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def try_spend(self, cost: float = 1.0) -> bool:
+        with self._lock:
+            self._refill()
+            if self._tokens >= cost:
+                self._tokens -= cost
+                self.spent += 1
+                return True
+            self.denied += 1
+            return False
+
+    def available(self) -> float:
+        with self._lock:
+            self._refill()
+            return self._tokens
+
+
+#: Process-wide default budget: callers that opt into budgeted retries without
+#: wiring an instance share this one, so independent transformers cannot
+#: multiply each other's retry storms.
+default_retry_budget = RetryBudget()
+
+
+class CircuitBreaker:
+    """Three-state breaker: CLOSED (normal) → OPEN after
+    ``failure_threshold`` consecutive failures (all traffic refused for a
+    cooldown) → HALF_OPEN (exactly one probe allowed) → CLOSED on probe
+    success, or back to OPEN with an escalated cooldown on probe failure
+    (cooldown * 2^reopens, capped at ``max_backoff_mult``).
+
+    Passive: it learns only from ``record_success``/``record_failure`` calls
+    made by the traffic that flows anyway — no health-check pinger thread.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failure_threshold: int = 3, cooldown: float = 1.0,
+                 max_backoff_mult: int = 8, clock=time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.cooldown = cooldown
+        self.max_backoff_mult = max_backoff_mult
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.open_until = 0.0
+        self._reopens = 0           # consecutive OPEN episodes (escalation)
+        self._probe_inflight = False
+
+    def available(self, now: Optional[float] = None) -> bool:
+        """Would a request be admitted right now? Non-mutating — selection
+        loops may call it on every candidate without consuming the
+        half-open probe slot."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                return now >= self.open_until
+            return not self._probe_inflight            # HALF_OPEN
+
+    def try_acquire(self, now: Optional[float] = None) -> bool:
+        """Admit one request (mutating): an elapsed OPEN transitions to
+        HALF_OPEN and this caller becomes the single probe. Callers MUST
+        follow with record_success/record_failure to release the probe."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN and now >= self.open_until:
+                self.state = self.HALF_OPEN
+                self._probe_inflight = True
+                return True
+            if self.state == self.HALF_OPEN and not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.state = self.CLOSED
+            self.consecutive_failures = 0
+            self._reopens = 0
+            self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        now = self._clock()
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state == self.HALF_OPEN:
+                self._probe_inflight = False
+                self._reopens += 1
+                self._open(now)
+            elif (self.state == self.CLOSED
+                    and self.consecutive_failures >= self.failure_threshold):
+                self._open(now)
+            elif self.state == self.OPEN:
+                # failure from the all-open fallback path: extend the window
+                self._open(now)
+
+    def _open(self, now: float) -> None:
+        mult = min(2 ** self._reopens, self.max_backoff_mult)
+        self.state = self.OPEN
+        self.open_until = now + self.cooldown * mult
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self.state,
+                    "consecutive_failures": self.consecutive_failures,
+                    "open_until": self.open_until}

@@ -199,7 +199,15 @@ def _aligned_window(start, size: int, np_rows: int, chunk: int):
     ``_range_kernel`` uses this same first-chunk formula). Callers' routing
     keys / masks already guard rows outside [start, start+len).
     ``SYNAPSEML_TPU_ALIGN_WINDOWS=0`` restores exact-size unaligned windows
-    (on-chip A/B escape hatch)."""
+    (on-chip A/B escape hatch).
+
+    The env var is resolved at TRACE TIME, not per call: this function runs
+    inside ``grow_tree``'s jit trace, so the branch taken here is baked into
+    the compiled executable. Flipping the variable after a config's first
+    trace has no effect on already-cached executables — set it before the
+    first ``grow_tree``/``train_booster`` call of the process (as the
+    cached-kernel selftests do), and expect a retrace, not a runtime switch,
+    when it changes between fresh jit keys."""
     if os.environ.get("SYNAPSEML_TPU_ALIGN_WINDOWS", "1") == "0":
         return jnp.minimum(start, np_rows - size), size
     S = min(size + chunk, np_rows)

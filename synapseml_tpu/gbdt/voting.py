@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..core.compat import shard_map
 from ..parallel.mesh import DATA_AXIS
 
 
@@ -63,7 +64,7 @@ def voting_select(binned, g, h, in_bag, mesh, top_k: int, num_bins: int,
     active = (jnp.ones((f,), bool) if feature_active is None
               else jnp.asarray(feature_active))
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                        P(DATA_AXIS), P()),
              out_specs=P(), check_vma=False)

@@ -32,6 +32,8 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..core.logging import record_failure
+from ..core.resilience import DEADLINE_HEADER, CircuitBreaker, Deadline
 from ..core.table import Table
 from .serving import ServingServer, _PendingRequest
 
@@ -54,14 +56,21 @@ def _detect_local_ip() -> str:
 
 
 class _WorkerLink:
-    """Connection pool + in-flight accounting for one downstream worker."""
+    """Connection pool + in-flight accounting + passive health for one
+    downstream worker. Health is a three-state circuit breaker
+    (core/resilience.py) fed only by the traffic that flows anyway: repeated
+    transport failures OPEN the link (skipped by selection), an elapsed
+    cooldown admits exactly one HALF-OPEN probe, and a probe success closes
+    it again."""
 
-    def __init__(self, host: str, port: int, timeout: float):
+    def __init__(self, host: str, port: int, timeout: float,
+                 breaker: Optional[CircuitBreaker] = None):
         self.host, self.port = host, port
         self.timeout = timeout
         self.inflight = 0
-        self.failures = 0          # consecutive failures (circuit-breaker-ish)
-        self.down_until = 0.0      # monotonic time until which we skip it
+        self.breaker = breaker or CircuitBreaker()
+        self.ok_count = 0
+        self.fail_count = 0
         self._pool: "queue.LifoQueue[http.client.HTTPConnection]" = \
             queue.LifoQueue()
         self._lock = threading.Lock()
@@ -69,6 +78,16 @@ class _WorkerLink:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+    # back-compat views of the breaker state (older health consumers)
+    @property
+    def failures(self) -> int:
+        return self.breaker.consecutive_failures
+
+    @property
+    def down_until(self) -> float:
+        return self.breaker.open_until if \
+            self.breaker.state == CircuitBreaker.OPEN else 0.0
 
     def _get_conn(self) -> Optional[http.client.HTTPConnection]:
         """Pooled connection or None (callers then dial fresh)."""
@@ -107,16 +126,20 @@ class _WorkerLink:
 
     def mark_ok(self) -> None:
         with self._lock:
-            self.failures = 0
-            self.down_until = 0.0
+            self.ok_count += 1
+        self.breaker.record_success()
 
-    def mark_failed(self, cooldown: float) -> None:
+    def mark_failed(self) -> None:
         with self._lock:
-            self.failures += 1
-            # exponential-ish backoff, capped: 1 failure = one cooldown,
-            # repeated failures keep it out longer
-            self.down_until = time.monotonic() + cooldown * min(
-                self.failures, 8)
+            self.fail_count += 1
+        self.breaker.record_failure()
+        record_failure("gateway.backend_failure", worker=self.url)
+
+    def health(self, now: float) -> Dict:
+        return {"url": self.url, "inflight": self.inflight,
+                "ok": self.ok_count, "failed": self.fail_count,
+                "down": not self.breaker.available(now),
+                **self.breaker.snapshot()}
 
 
 class ServingGateway:
@@ -124,25 +147,35 @@ class ServingGateway:
     version of the reference's stubbed InternalHandler shuffle routing).
 
     ``mode``: ``least_loaded`` (default — route to the worker with the fewest
-    in-flight forwards) or ``round_robin``. A worker that fails a forward is
-    cooled down and the request retries on a sibling; only when every worker
-    fails does the client see a 502 (single-request semantics preserved:
-    at-most-once per worker, the reply returns to the original caller's
-    still-open connection — reply-by-id across processes)."""
+    in-flight forwards) or ``round_robin``. A worker that fails a forward
+    trips its circuit breaker toward OPEN (``breaker_threshold`` consecutive
+    transport failures; ``cooldown`` seconds out, escalating on repeated
+    trips) and the request retries on a sibling; an OPEN worker is skipped
+    entirely until its cooldown admits a half-open probe. Only when every
+    worker fails — or every breaker is open — does the client see a fast 502
+    (single-request semantics preserved: at-most-once per worker, the reply
+    returns to the original caller's still-open connection — reply-by-id
+    across processes). A client ``X-Deadline-Ms`` budget is re-anchored here
+    and propagated to the worker, and sibling retries stop once it expires."""
 
     def __init__(self, worker_urls: Sequence[str], host: str = "127.0.0.1",
                  port: int = 0, api_path: str = "/",
                  mode: str = "least_loaded", forward_timeout: float = 30.0,
-                 cooldown: float = 1.0, max_retries: Optional[int] = None,
+                 cooldown: float = 1.0, breaker_threshold: int = 3,
+                 max_retries: Optional[int] = None,
                  local_worker: Optional[ServingServer] = None,
                  local_index: Optional[int] = None):
         if mode not in ("least_loaded", "round_robin"):
             raise ValueError(f"unknown load-balancing mode {mode!r}")
+        self.breaker_threshold = breaker_threshold
         self.links: List[_WorkerLink] = []
         for u in worker_urls:
             hostport = u.split("//", 1)[-1].split("/", 1)[0]
             h, _, p = hostport.partition(":")
-            self.links.append(_WorkerLink(h, int(p or 80), forward_timeout))
+            self.links.append(_WorkerLink(
+                h, int(p or 80), forward_timeout,
+                breaker=CircuitBreaker(failure_threshold=breaker_threshold,
+                                       cooldown=cooldown)))
         # the co-located worker (same process as the gateway): requests
         # routed to it enqueue DIRECTLY into its micro-batch queue instead
         # of paying a loopback HTTP round trip — the reference gets the same
@@ -191,21 +224,33 @@ class ServingGateway:
         now = time.monotonic()
         with self._lock:
             up = [l for l in self.links
-                  if id(l) not in exclude and l.down_until <= now]
-            if not up:  # every candidate cooling down: try them anyway
-                up = [l for l in self.links if id(l) not in exclude]
+                  if id(l) not in exclude and l.breaker.available(now)]
             if not up:
+                # every remaining worker's breaker is OPEN inside its
+                # cooldown: fail fast (the breaker's whole point) instead of
+                # dialing known-bad backends
                 return None
             if self.mode == "round_robin":
                 self._rr += 1
-                return up[self._rr % len(up)]
-            return min(up, key=lambda l: l.inflight)
+                order = up[self._rr % len(up):] + up[:self._rr % len(up)]
+            else:
+                order = sorted(up, key=lambda l: l.inflight)
+            # try_acquire consumes the single half-open probe slot; a link
+            # that loses the probe race falls through to the next candidate
+            for link in order:
+                if link.breaker.try_acquire(now):
+                    return link
+            return None
 
     def _forward(self, method: str, path: str, body: bytes,
-                 headers: Dict[str, str]) -> tuple:
+                 headers: Dict[str, str],
+                 deadline: Optional[Deadline] = None) -> tuple:
         tried: set = set()
         last_err = None
         for _ in range(self.max_retries):
+            if deadline is not None and deadline.expired():
+                record_failure("gateway.deadline_expired")
+                return 504, b'{"error": "deadline exceeded at gateway"}'
             link = self._pick(tried)
             if link is None:
                 break
@@ -213,8 +258,12 @@ class ServingGateway:
             with self._lock:
                 link.inflight += 1
             try:
+                if deadline is not None:
+                    # re-anchor the remaining budget for the next hop
+                    headers = {**headers,
+                               DEADLINE_HEADER: deadline.header_value()}
                 if link is self._local_link:
-                    status, payload = self._forward_local(body)
+                    status, payload = self._forward_local(body, deadline)
                 else:
                     status, payload = link.forward(method, path, body,
                                                    headers)
@@ -224,33 +273,47 @@ class ServingGateway:
                 return status, payload
             except Exception as e:  # transport failure -> retry on sibling
                 last_err = e
-                link.mark_failed(self.cooldown)
+                link.mark_failed()
                 with self._lock:
                     self.stats["retried"] += 1
+                record_failure("gateway.retry", worker=link.url)
             finally:
                 with self._lock:
                     link.inflight -= 1
         with self._lock:
             self.stats["failed"] += 1
+        record_failure("gateway.no_backend")
         return 502, (b'{"error": "no serving worker reachable: %s"}'
                      % str(last_err).encode()[:200])
 
-    def _forward_local(self, body: bytes) -> tuple:
+    def _forward_local(self, body: bytes,
+                       deadline: Optional[Deadline] = None) -> tuple:
         """In-process fast path: enqueue into the co-located worker's
         micro-batch queue and wait for its reply-by-id, skipping the
         loopback HTTP hop entirely."""
-        if self._local._stop.is_set():
-            # fail as fast as the HTTP path's ECONNREFUSED would: the queue
-            # accepts puts forever, but a stopped serve loop never replies
-            raise ConnectionError("local serving worker is stopped")
-        req = _PendingRequest(id=uuid.uuid4().hex, method="POST",
-                              path=self.api_path, headers={}, body=body)
-        self._local._queue.put(req)
+        if self._local._stop.is_set() or self._local._draining.is_set():
+            # fail as fast as the HTTP path's ECONNREFUSED / 503 would: the
+            # queue accepts puts forever, but a stopped serve loop never
+            # replies and a draining one should shed
+            raise ConnectionError("local serving worker is stopped/draining")
+        budget = min(self.forward_timeout, self._local.reply_timeout)
+        if deadline is not None:
+            budget = min(budget, deadline.remaining())
+        req = _PendingRequest(
+            id=uuid.uuid4().hex, method="POST", path=self.api_path,
+            headers={}, body=body, deadline=Deadline.after(budget),
+            admitted_at=time.monotonic())
+        try:
+            self._local._queue.put_nowait(req)
+        except queue.Full:
+            # the local worker's bounded admission queue applies to the
+            # fast path too — a full queue reads as an overloaded worker
+            # and the sibling retry takes over
+            raise ConnectionError("local serving worker queue full")
         # the gateway's failover bound applies here exactly as it does to an
         # HTTP forward — a wedged local serve loop must not stall requests
         # past forward_timeout before the sibling retry
-        if not req.reply_event.wait(min(self.forward_timeout,
-                                        self._local.reply_timeout)):
+        if not req.reply_event.wait(budget):
             raise TimeoutError("local worker reply timeout")
         status, _headers, payload = req.response
         return status, payload
@@ -272,8 +335,16 @@ class ServingGateway:
                 fwd_headers = {"Content-Type": self.headers.get(
                     "Content-Type", "application/json"),
                     "Content-Length": str(len(body))}
+                # no header -> no gateway deadline (forward_timeout already
+                # bounds each attempt; a synthetic deadline equal to it
+                # would starve the sibling retry). An explicit budget is
+                # capped at the gateway's own total-work bound.
+                raw = self.headers.get(DEADLINE_HEADER)
+                deadline = (None if raw is None else Deadline.from_header_ms(
+                    raw, outer.forward_timeout * outer.max_retries))
                 status, payload = outer._forward("POST", outer.api_path,
-                                                 body, fwd_headers)
+                                                 body, fwd_headers,
+                                                 deadline=deadline)
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
@@ -285,9 +356,7 @@ class ServingGateway:
 
                 now = time.monotonic()
                 body = _json.dumps({
-                    "workers": [{"url": l.url, "inflight": l.inflight,
-                                 "down": l.down_until > now}
-                                for l in outer.links],
+                    "workers": [l.health(now) for l in outer.links],
                     **outer.stats}).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")

@@ -23,8 +23,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..core.logging import record_failure
 from ..core.params import Param, HasInputCol, HasOutputCol
 from ..core.pipeline import Transformer
+from ..core.resilience import RetryBudget
 from ..core.table import Table
 
 
@@ -67,8 +69,18 @@ _RETRY_CODES = (429, 500, 502, 503, 504)
 
 def send_with_retries(req: HTTPRequestData, timeout: float = 60.0,
                       retries: int = 3, backoff: float = 0.5,
-                      opener=None) -> HTTPResponseData:
-    """RESTHelpers.scala analog: retry 429/5xx with exponential backoff."""
+                      opener=None,
+                      retry_budget: Optional[RetryBudget] = None
+                      ) -> HTTPResponseData:
+    """RESTHelpers.scala analog: retry 429/5xx with exponential backoff.
+
+    ``opener`` substitutes the transport (anything with
+    ``.open(request, timeout=)`` — e.g. a chaos injector from
+    :mod:`synapseml_tpu.testing.chaos`). ``retry_budget`` caps AGGREGATE
+    retry volume across callers sharing the bucket: each retry (not the
+    first attempt) spends one token, and an empty bucket ends the retry
+    loop early — the client-side brake on retry storms against an already
+    overloaded service. None = unbounded retries (per-call knobs only)."""
     last: Optional[HTTPResponseData] = None
     for attempt in range(retries + 1):
         try:
@@ -86,18 +98,27 @@ def send_with_retries(req: HTTPRequestData, timeout: float = 60.0,
                                     entity=e.read())
             if e.code not in _RETRY_CODES:
                 return last
+            record_failure("http.retryable_status", status=e.code)
         except (urllib.error.URLError, TimeoutError, OSError) as e:
             last = HTTPResponseData(status_code=0, reason=str(e))
+            record_failure("http.transport_error", error=type(e).__name__)
         if attempt < retries:
+            if retry_budget is not None and not retry_budget.try_spend():
+                record_failure("http.retry_budget_exhausted", url=req.url)
+                break
             time.sleep(backoff * (2 ** attempt))
     return last or HTTPResponseData(status_code=0, reason="no attempts")
 
 
 def dispatch_with_handler(req: HTTPRequestData, timeout: float, retries: int,
-                          backoff: float, handler=None) -> HTTPResponseData:
+                          backoff: float, handler=None, opener=None,
+                          retry_budget: Optional[RetryBudget] = None
+                          ) -> HTTPResponseData:
     """Single dispatch point for handler-or-default sending (shared by
     HTTPTransformer and the services layer)."""
-    send = lambda r: send_with_retries(r, timeout, retries, backoff)  # noqa: E731
+    send = lambda r: send_with_retries(r, timeout, retries, backoff,  # noqa: E731
+                                       opener=opener,
+                                       retry_budget=retry_budget)
     return handler(req, send) if handler is not None else send(req)
 
 
@@ -115,6 +136,10 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
                     is_complex=True)
     maxRetries = Param("maxRetries", "retries for 429/5xx responses", int, 3)
     backoff = Param("backoff", "initial backoff, seconds", float, 0.5)
+    opener = Param("opener", "transport override with .open(request, "
+                   "timeout=) — e.g. a chaos injector", is_complex=True)
+    retryBudget = Param("retryBudget", "shared RetryBudget token bucket "
+                        "capping aggregate retry volume", is_complex=True)
 
     def setHandler(self, f: Callable) -> "HTTPTransformer":
         return self.set("handler", f)
@@ -122,7 +147,9 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     def _send_one(self, req: HTTPRequestData) -> HTTPResponseData:
         return dispatch_with_handler(req, self.getTimeout(),
                                      self.getMaxRetries(), self.getBackoff(),
-                                     self.get("handler"))
+                                     self.get("handler"),
+                                     opener=self.get("opener"),
+                                     retry_budget=self.get("retryBudget"))
 
     def _transform(self, df: Table) -> Table:
         import time as _time
@@ -248,6 +275,10 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     concurrency = Param("concurrency", "max simultaneous requests", int, 1)
     timeout = Param("timeout", "per-request timeout, seconds", float, 60.0)
     handler = Param("handler", "custom send handler", is_complex=True)
+    opener = Param("opener", "transport override with .open(request, "
+                   "timeout=) — e.g. a chaos injector", is_complex=True)
+    retryBudget = Param("retryBudget", "shared RetryBudget token bucket "
+                        "capping aggregate retry volume", is_complex=True)
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
@@ -267,6 +298,9 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
                                timeout=self.getTimeout())
         if self.get("handler") is not None:
             http.setHandler(self.get("handler"))
+        for p in ("opener", "retryBudget"):
+            if self.get(p) is not None:
+                http.set(p, self.get(p))
 
         out_parser = (self.get("outputParser") or JSONOutputParser()).copy()
         out_parser.set("inputCol", "__response")

@@ -8,6 +8,27 @@ replies through a sink keyed by request id; here a threaded HTTP server queues
 requests, a serving loop drains the queue into a ``Table`` micro-batch, runs
 the user pipeline (one jitted program for model transforms), and writes each
 row's reply back to its still-open connection — same architecture, no Spark.
+
+Resilience model (docs/resilience.md; fault-tested by
+tests/test_chaos_serving.py via testing/chaos.py):
+
+* **Bounded admission** — the request queue holds at most ``max_queue_size``
+  entries; overload is shed as an immediate 503 instead of growing latency
+  without bound.
+* **Deadline propagation** — a client ``X-Deadline-Ms`` header (remaining
+  budget, capped by ``reply_timeout``) rides the request: the connection
+  thread 504s at the deadline no matter what, and batch formation drops
+  already-expired requests without spending handler time on them. Handlers
+  that accept a ``budget=`` keyword receive the batch's remaining seconds.
+* **Failure isolation** — a handler exception fails only the poisoned rows:
+  the batch is retried row-by-row (``isolate_failures``) so one bad payload
+  cannot 500 its co-batched neighbors.
+* **Graceful drain** — ``stop()`` first refuses new work (503) while
+  in-flight requests complete, then tears the server down.
+
+``ServingServer.metrics`` exposes queue depth/age gauges and shed/error/
+deadline counters; the same events also land in the process-wide
+``core.logging`` failure counters.
 """
 
 from __future__ import annotations
@@ -23,6 +44,8 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..core.logging import record_failure
+from ..core.resilience import DEADLINE_HEADER, Deadline
 from ..core.table import Table
 
 
@@ -36,6 +59,46 @@ class _PendingRequest:
     body: bytes
     reply_event: threading.Event = field(default_factory=threading.Event)
     response: Optional[tuple] = None  # (status, headers, body)
+    deadline: Optional[Deadline] = None
+    admitted_at: float = 0.0          # monotonic enqueue time (queue age)
+
+
+class ServingMetrics:
+    """Thread-safe counters + gauges for one server (the queue-depth/age and
+    shed/error observability the chaos suite asserts on)."""
+
+    _COUNTERS = ("accepted", "shed", "drain_rejected", "completed",
+                 "handler_errors", "isolated_rows", "deadline_dropped",
+                 "deadline_expired", "batches")
+
+    def __init__(self, queue_ref: "queue.Queue"):
+        self._q = queue_ref
+        self._lock = threading.Lock()
+        self._c = {k: 0 for k in self._COUNTERS}
+        self.last_batch_size = 0
+        self.last_queue_age_s = 0.0   # oldest-request age at batch formation
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[name] += n
+
+    def observe_batch(self, size: int, oldest_age_s: float) -> None:
+        with self._lock:
+            self._c["batches"] += 1
+            self.last_batch_size = size
+            self.last_queue_age_s = oldest_age_s
+
+    def __getitem__(self, name: str) -> int:
+        with self._lock:
+            return self._c[name]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._c)
+            out["queue_depth"] = self._q.qsize()
+            out["last_batch_size"] = self.last_batch_size
+            out["last_queue_age_s"] = round(self.last_queue_age_s, 6)
+        return out
 
 
 def request_to_table(requests: List[_PendingRequest]) -> Table:
@@ -77,23 +140,45 @@ class ServingServer:
     first (micro-batch trigger analog), then run through the handler as ONE
     batch — on TPU that is one jitted call, which is where the reference's
     "sub-millisecond" story becomes a batched-throughput story.
+
+    A handler may declare a ``budget`` keyword parameter to receive the
+    batch's remaining deadline budget in seconds (None when every request in
+    the batch is deadline-less).
     """
 
     def __init__(self, handler: Callable[[Table], Table],
                  host: str = "127.0.0.1", port: int = 8898,
                  api_path: str = "/", max_batch_size: int = 64,
                  max_batch_latency: float = 0.005,
-                 reply_timeout: float = 30.0):
+                 reply_timeout: float = 30.0,
+                 max_queue_size: int = 1024,
+                 isolate_failures: bool = True,
+                 drain_timeout: float = 10.0):
         self.handler = handler
         self.host, self.port = host, port
         self.api_path = api_path
         self.max_batch_size = max_batch_size
         self.max_batch_latency = max_batch_latency
         self.reply_timeout = reply_timeout
-        self._queue: "queue.Queue[_PendingRequest]" = queue.Queue()
+        self.max_queue_size = max_queue_size
+        self.isolate_failures = isolate_failures
+        self.drain_timeout = drain_timeout
+        self._queue: "queue.Queue[_PendingRequest]" = queue.Queue(
+            maxsize=max_queue_size)
+        self.metrics = ServingMetrics(self._queue)
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._idle = threading.Event()   # serve loop between batches
+        self._idle.set()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
+        try:
+            import inspect
+
+            self._handler_takes_budget = ("budget" in inspect.signature(
+                handler).parameters)
+        except (TypeError, ValueError):
+            self._handler_takes_budget = False
 
     # --- embedded server (WorkerServer analog) -------------------------
     def _make_handler_class(self):
@@ -112,29 +197,67 @@ class ServingServer:
             # stop() cannot quiesce them (timeout → close_connection)
             timeout = 30
 
+            def _reply_error(self, status: int, body: bytes = b"",
+                             retry_after: Optional[int] = None):
+                self.send_response(status)
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
+                if body:
+                    self.send_header("Content-Type", "application/json")
+                # explicit Content-Length always: HTTP/1.1 keep-alive clients
+                # block on a missing one
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
             def do_POST(self):  # noqa: N802
                 if "chunked" in self.headers.get("Transfer-Encoding",
                                                  "").lower():
                     # chunked bodies are not parsed; reading 0 bytes would
                     # desync the keep-alive stream (the chunk data would be
                     # parsed as the next request), so reject and close
-                    self.send_response(411)  # Length Required
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
+                    self._reply_error(411)  # Length Required
                     self.close_connection = True
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
+                # admission control BEFORE queueing: a draining/stopped
+                # server refuses new work fast instead of letting it ride
+                # into a queue nobody will drain
+                if outer._draining.is_set() or outer._stop.is_set():
+                    outer.metrics.incr("drain_rejected")
+                    record_failure("serving.drain_rejected")
+                    self._reply_error(
+                        503, b'{"error": "server is draining"}',
+                        retry_after=1)
+                    return
+                deadline = Deadline.from_header_ms(
+                    self.headers.get(DEADLINE_HEADER),
+                    outer.reply_timeout)
                 req = _PendingRequest(
                     id=uuid.uuid4().hex, method="POST", path=self.path,
-                    headers=dict(self.headers), body=body)
-                outer._queue.put(req)
-                if not req.reply_event.wait(outer.reply_timeout):
-                    self.send_response(504)
-                    # explicit empty body: HTTP/1.1 keep-alive clients block
-                    # on a missing Content-Length
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
+                    headers=dict(self.headers), body=body,
+                    deadline=deadline, admitted_at=time.monotonic())
+                try:
+                    outer._queue.put_nowait(req)
+                except queue.Full:
+                    # load shedding: bounded queue + immediate 503 — the
+                    # overload contract (fast rejection, not slow timeout)
+                    outer.metrics.incr("shed")
+                    record_failure("serving.shed")
+                    self._reply_error(
+                        503, b'{"error": "server overloaded"}',
+                        retry_after=1)
+                    return
+                outer.metrics.incr("accepted")
+                if not req.reply_event.wait(deadline.remaining()):
+                    # deadline breach: bounded-latency 504 even if the
+                    # handler is wedged — the connection never hangs past
+                    # the request's budget
+                    outer.metrics.incr("deadline_expired")
+                    record_failure("serving.deadline_expired")
+                    self._reply_error(504)
                     return
                 status, headers, payload = req.response
                 self.send_response(status)
@@ -145,47 +268,117 @@ class ServingServer:
                 self.end_headers()
                 self.wfile.write(payload)
 
+            def do_GET(self):  # noqa: N802  — metrics/health endpoint
+                body = _json.dumps({
+                    "draining": outer._draining.is_set(),
+                    **outer.metrics.snapshot()}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def log_message(self, *args):  # quiet
                 pass
 
         return Handler
 
+    # --- micro-batch serve loop ----------------------------------------
+    def _run_batch(self, batch: List[_PendingRequest]) -> None:
+        now = time.monotonic()
+        # batch-formation deadline check: an expired request gets its 504
+        # here and never costs handler time (its connection thread has
+        # usually already answered; setting the response is idempotent)
+        live: List[_PendingRequest] = []
+        for r in batch:
+            if r.deadline is not None and r.deadline.expired():
+                r.response = (504, {}, b'{"error": "deadline exceeded"}')
+                r.reply_event.set()
+                self.metrics.incr("deadline_dropped")
+                record_failure("serving.deadline_dropped")
+            else:
+                live.append(r)
+        if not live:
+            return
+        oldest = min(r.admitted_at for r in live)
+        self.metrics.observe_batch(len(live), now - oldest)
+        budgets = [r.deadline.remaining() for r in live
+                   if r.deadline is not None]
+        budget = min(budgets) if budgets else None
+        replies = self._call_handler(live, budget)
+        by_id = {r.id: r for r in live}
+        for rid, (status, payload) in replies.items():
+            req = by_id.get(rid)
+            if req is not None:
+                req.response = (status, {}, payload)
+                req.reply_event.set()
+        # requests the handler dropped get an error instead of a hang
+        for r in live:
+            if r.response is None:
+                r.response = (500, {}, b'{"error": "no reply produced"}')
+                r.reply_event.set()
+        self.metrics.incr("completed", len(live))
+
+    def _invoke(self, df: Table, budget: Optional[float]):
+        if self._handler_takes_budget:
+            return self.handler(df, budget=budget)
+        return self.handler(df)
+
+    def _call_handler(self, batch: List[_PendingRequest],
+                      budget: Optional[float]) -> Dict[str, tuple]:
+        df = request_to_table(batch)
+        try:
+            out = self._invoke(df, budget)
+            return respond_with(out) if isinstance(out, Table) else out
+        except Exception as e:  # noqa: BLE001
+            self.metrics.incr("handler_errors")
+            record_failure("serving.handler_error", error=type(e).__name__)
+            if not self.isolate_failures or len(batch) == 1:
+                err = _json.dumps({"error": str(e)}).encode()
+                return {r.id: (500, err) for r in batch}
+        # failure isolation: rerun row-by-row so one poisoned payload fails
+        # alone instead of 500ing the whole micro-batch
+        replies: Dict[str, tuple] = {}
+        for r in batch:
+            try:
+                out = self._invoke(request_to_table([r]), budget)
+                one = respond_with(out) if isinstance(out, Table) else out
+                replies[r.id] = one.get(
+                    r.id, (500, b'{"error": "no reply produced"}'))
+            except Exception as e:  # noqa: BLE001
+                self.metrics.incr("isolated_rows")
+                record_failure("serving.isolated_row",
+                               error=type(e).__name__)
+                replies[r.id] = (500, _json.dumps(
+                    {"error": str(e)}).encode())
+        return replies
+
     def _serve_loop(self) -> None:
         """Micro-batch trigger: drain queue → handler → reply by id."""
-        while not self._stop.is_set():
+        while True:
             batch: List[_PendingRequest] = []
             try:
                 batch.append(self._queue.get(timeout=0.05))
             except queue.Empty:
+                if self._stop.is_set():
+                    return          # stopped AND queue drained: loop exits
                 continue
-            # drain the existing backlog for free (batching under load costs
-            # no latency), then optionally wait out the batch-formation window
-            deadline = time.monotonic() + self.max_batch_latency
-            while len(batch) < self.max_batch_size:
-                try:
-                    batch.append(self._queue.get_nowait())
-                except queue.Empty:
-                    if time.monotonic() >= deadline:
-                        break
-                    time.sleep(0.0005)
-            df = request_to_table(batch)
-            by_id = {r.id: r for r in batch}
+            self._idle.clear()
             try:
-                out = self.handler(df)
-                replies = respond_with(out) if isinstance(out, Table) else out
-            except Exception as e:  # noqa: BLE001
-                err = _json.dumps({"error": str(e)}).encode()
-                replies = {r.id: (500, err) for r in batch}
-            for rid, (status, payload) in replies.items():
-                req = by_id.get(rid)
-                if req is not None:
-                    req.response = (status, {}, payload)
-                    req.reply_event.set()
-            # requests the handler dropped get an error instead of a hang
-            for r in batch:
-                if r.response is None:
-                    r.response = (500, {}, b'{"error": "no reply produced"}')
-                    r.reply_event.set()
+                # drain the existing backlog for free (batching under load
+                # costs no latency), then optionally wait out the
+                # batch-formation window
+                deadline = time.monotonic() + self.max_batch_latency
+                while len(batch) < self.max_batch_size:
+                    try:
+                        batch.append(self._queue.get_nowait())
+                    except queue.Empty:
+                        if time.monotonic() >= deadline:
+                            break
+                        time.sleep(0.0005)
+                self._run_batch(batch)
+            finally:
+                self._idle.set()
 
     def start(self) -> "ServingServer":
         class _Server(ThreadingHTTPServer):
@@ -203,8 +396,30 @@ class ServingServer:
         self._threads = [t1, t2]
         return self
 
-    def stop(self) -> None:
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Refuse new requests (503) and wait until the queue is empty and
+        the serve loop is idle. Returns True when fully drained."""
+        self._draining.set()
+        deadline = time.monotonic() + (self.drain_timeout
+                                       if timeout is None else timeout)
+        while time.monotonic() < deadline:
+            if self._queue.empty() and self._idle.is_set():
+                return True
+            time.sleep(0.005)
+        return self._queue.empty() and self._idle.is_set()
+
+    def stop(self, drain: bool = True,
+             drain_timeout: Optional[float] = None) -> None:
+        """Graceful by default: in-flight requests complete (new ones get
+        503 while draining), then the serve loop and listener shut down.
+        ``drain=False`` tears down immediately — queued requests get their
+        504 from their own deadline."""
+        if drain and not self._stop.is_set():
+            self.drain(drain_timeout)
         self._stop.set()
+        serve_thread = self._threads[1] if len(self._threads) > 1 else None
+        if serve_thread is not None and serve_thread.is_alive():
+            serve_thread.join(timeout=1.0)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

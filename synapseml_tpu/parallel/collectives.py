@@ -15,17 +15,32 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from ..core.compat import shard_map as _shard_map
 from .mesh import DATA_AXIS
+
+# Fault-injection hook (synapseml_tpu.testing.chaos.chaos_collectives): when
+# set, every helper calls it with its op name BEFORE building the collective.
+# For jitted callers that is trace time — the point where an off-chip chaos
+# test can deterministically stall or fail the collective layer without a
+# device in the loop. None in production; the branch costs one global read.
+_CHAOS_HOOK = None
+
+
+def _chaos(name: str) -> None:
+    if _CHAOS_HOOK is not None:
+        _CHAOS_HOOK(name)
 
 
 def allreduce_sum(x, axis: str = DATA_AXIS):
     """Histogram/gradient allreduce — LGBM_NetworkInit ring allreduce and
     Horovod allreduce both become one psum over ICI."""
+    _chaos("allreduce_sum")
     return jax.lax.psum(x, axis_name=axis)
 
 
 def allreduce_mean(x, axis: str = DATA_AXIS):
     """VW pass-boundary model averaging (VowpalWabbitBaseLearner.scala:134-188)."""
+    _chaos("allreduce_mean")
     return jax.lax.pmean(x, axis_name=axis)
 
 
@@ -33,15 +48,18 @@ def reduce_scatter_sum(x, axis: str = DATA_AXIS, tiled_axis: int = 0):
     """Data-parallel GBDT histogram reduce-scatter: each worker ends up owning
     1/world of the (feature, bin) histogram space — the native
     ReduceScatter the LightGBM data_parallel learner performs internally."""
+    _chaos("reduce_scatter_sum")
     return jax.lax.psum_scatter(x, axis_name=axis, scatter_dimension=tiled_axis, tiled=True)
 
 
 def allgather(x, axis: str = DATA_AXIS, tiled: bool = False):
+    _chaos("allgather")
     return jax.lax.all_gather(x, axis_name=axis, tiled=tiled)
 
 
 def ppermute_ring(x, axis: str = DATA_AXIS, shift: int = 1):
     """Ring permute — building block for ring attention / pipelined collectives."""
+    _chaos("ppermute_ring")
     n = jax.lax.axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name=axis, perm=perm)
@@ -53,8 +71,8 @@ def axis_rank(axis: str = DATA_AXIS):
 
 def shard_apply(mesh: Mesh, fn: Callable, in_specs, out_specs, check_vma: bool = False):
     """Thin shard_map wrapper with the framework's mesh conventions."""
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_vma=check_vma)
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
 
 
 def topk_vote(local_gains: jnp.ndarray, k: int, axis: str = DATA_AXIS):

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core.compat import shard_map
 from .mesh import SEQ_AXIS
 
 
@@ -107,7 +108,7 @@ def ring_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
         else None
     spec = P(batch_axis, axis, None, None)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 3,
+    @partial(shard_map, mesh=mesh, in_specs=(spec,) * 3,
              out_specs=spec, check_vma=False)
     def _ring(q_blk, k_blk, v_blk):
         rank = jax.lax.axis_index(axis)

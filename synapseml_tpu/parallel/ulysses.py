@@ -25,6 +25,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core.compat import shard_map
 from .mesh import DATA_AXIS, SEQ_AXIS
 from .ring_attention import attention_reference
 
@@ -65,6 +66,6 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
     batch_axis = (DATA_AXIS if DATA_AXIS in mesh.shape
                   and q.shape[0] % mesh.shape[DATA_AXIS] == 0 else None)
     spec = P(batch_axis, SEQ_AXIS, None, None)
-    fn = jax.shard_map(_ulysses, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = shard_map(_ulysses, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -86,6 +86,10 @@ class CognitiveServiceBase(HasServiceParams):
     backoff = Param("backoff", "initial backoff seconds", float, 0.5)
     handler = Param("handler", "(HTTPRequestData, send) -> HTTPResponseData",
                     is_complex=True)
+    opener = Param("opener", "transport override with .open(request, "
+                   "timeout=) — e.g. a chaos injector", is_complex=True)
+    retryBudget = Param("retryBudget", "shared RetryBudget token bucket "
+                        "capping aggregate retry volume", is_complex=True)
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
@@ -129,7 +133,9 @@ class CognitiveServiceBase(HasServiceParams):
 
         return dispatch_with_handler(req, self.getTimeout(),
                                      self.getMaxRetries(), self.getBackoff(),
-                                     self.get("handler"))
+                                     self.get("handler"),
+                                     opener=self.get("opener"),
+                                     retry_budget=self.get("retryBudget"))
 
     def _transform(self, df: Table) -> Table:
         n = df.num_rows

@@ -12,8 +12,13 @@ from .fuzzing import (TestObject, discover_stage_classes,
                       experiment_fuzz, getter_setter_fuzz,
                       serialization_fuzz)
 from .benchmarks import Benchmarks
+from .chaos import (ChaosHTTP, ChaosSchedule, FaultInjected,
+                    FlakyHTTPServer, canned_json_responder,
+                    chaos_collectives, chaotic_handler)
 
 __all__ = [
     "TestObject", "discover_stage_classes", "experiment_fuzz",
     "getter_setter_fuzz", "serialization_fuzz", "Benchmarks",
+    "ChaosHTTP", "ChaosSchedule", "FaultInjected", "FlakyHTTPServer",
+    "canned_json_responder", "chaos_collectives", "chaotic_handler",
 ]

@@ -1,0 +1,376 @@
+"""Deterministic, seedable fault-injection harness (chaos testing).
+
+The serving/IO stack has three failure surfaces, and this module wraps each
+of them so failure behavior is a CI property instead of folklore
+(tests/test_chaos_serving.py drives all of it on CPU):
+
+1. **HTTP openers** — :class:`ChaosHTTP` implements the ``opener`` protocol
+   that ``io.http.send_with_retries`` / ``services.base`` accept, injecting
+   latency, timeouts, 429/5xx, and connection resets between the client code
+   and a real (or canned) responder.
+2. **The serving handler** — :func:`chaotic_handler` wraps the
+   ``Table -> Table`` callable behind :class:`~synapseml_tpu.io.serving.
+   ServingServer` with slow batches, thrown exceptions, and per-row poison.
+3. **Collective ops** — :func:`chaos_collectives` installs a hook inside
+   ``parallel.collectives`` that can stall or fail collective calls. The
+   hook fires at *trace time* for jitted code (the same point the env knobs
+   resolve), which is exactly where an off-chip test can observe it.
+
+Everything is driven by either an explicit ``script`` (a list of outcomes
+consumed one per call — fully deterministic) or seeded rates via
+``random.Random(seed)`` (deterministic per seed). No decision reads the
+wall clock.
+
+:class:`FlakyHTTPServer` is the backend-side counterpart: a real TCP server
+whose per-request behavior follows a script (respond / 5xx / reset / go
+silent), used to fault-test the gateway's sibling retry, cooldown, and
+circuit breaker against genuine transport errors.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json as _json
+import random
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+# an injected transport fault; ConnectionError so existing except-clauses
+# (URLError/OSError handlers) treat it like the real thing
+class FaultInjected(ConnectionError):
+    pass
+
+
+# outcome vocabulary (script entries / _decide results):
+#   "ok"            — pass through / succeed
+#   int (e.g. 503)  — HTTP error status
+#   "reset"         — connection reset (transport error)
+#   "timeout"       — injected timeout (transport error)
+#   ("slow", s)     — sleep s seconds, then succeed
+Outcome = Union[str, int, Tuple[str, float]]
+
+
+class ChaosSchedule:
+    """Deterministic outcome source: a finite ``script`` consumed first
+    (then ``after`` forever), else seeded rates. Thread-safe; ``calls`` and
+    ``outcomes`` record every decision for assertions."""
+
+    def __init__(self, seed: int = 0, script: Optional[Sequence[Outcome]] = None,
+                 after: Outcome = "ok", error_rate: float = 0.0,
+                 error_codes: Sequence[int] = (503,), reset_rate: float = 0.0,
+                 timeout_rate: float = 0.0, latency_s: float = 0.0):
+        self.rng = random.Random(seed)
+        self.script: List[Outcome] = list(script or [])
+        self.after = after
+        self.error_rate = error_rate
+        self.error_codes = tuple(error_codes)
+        self.reset_rate = reset_rate
+        self.timeout_rate = timeout_rate
+        self.latency_s = latency_s
+        self.calls = 0
+        self.outcomes: List[Outcome] = []
+        self._lock = threading.Lock()
+
+    def next_outcome(self) -> Outcome:
+        with self._lock:
+            self.calls += 1
+            if self.script:
+                out = self.script.pop(0)
+            elif self.error_rate or self.reset_rate or self.timeout_rate:
+                r = self.rng.random()
+                if r < self.reset_rate:
+                    out = "reset"
+                elif r < self.reset_rate + self.timeout_rate:
+                    out = "timeout"
+                elif r < (self.reset_rate + self.timeout_rate
+                          + self.error_rate):
+                    out = self.rng.choice(self.error_codes)
+                else:
+                    out = "ok"
+            else:
+                out = self.after
+            self.outcomes.append(out)
+            return out
+
+
+class _CannedResponse:
+    """Minimal urlopen-response stand-in (context manager + status/reason/
+    headers/read) for canned 2xx replies."""
+
+    def __init__(self, status: int = 200, body: bytes = b"{}",
+                 headers: Optional[dict] = None):
+        self.status = status
+        self.reason = "OK"
+        self.headers = dict(headers or {"Content-Type": "application/json"})
+        self._body = body
+
+    def read(self) -> bytes:
+        return self._body
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ChaosHTTP:
+    """Fault-injecting HTTP opener.
+
+    Use as ``send_with_retries(req, opener=chaos)`` or set as the ``opener``
+    param on ``HTTPTransformer`` / any ``CognitiveServiceBase`` subclass. On
+    "ok" it forwards to ``inner`` (default: real ``urllib.request.urlopen``)
+    unless a ``responder`` is given, in which case the canned
+    ``responder(request) -> (status, body_bytes)`` result is returned without
+    touching the network — fully hermetic chaos tests.
+    """
+
+    def __init__(self, schedule: Optional[ChaosSchedule] = None,
+                 responder: Optional[Callable] = None, inner=None, **sched_kw):
+        self.schedule = schedule or ChaosSchedule(**sched_kw)
+        self.responder = responder
+        self.inner = inner
+
+    def open(self, request, timeout: Optional[float] = None):
+        out = self.schedule.next_outcome()
+        if self.schedule.latency_s:
+            time.sleep(self.schedule.latency_s)
+        if isinstance(out, tuple) and out[0] == "slow":
+            time.sleep(out[1])
+            out = "ok"
+        if out == "reset":
+            raise FaultInjected("chaos: connection reset by peer")
+        if out == "timeout":
+            raise TimeoutError("chaos: injected timeout")
+        if isinstance(out, int) and out >= 400:
+            raise urllib.error.HTTPError(
+                getattr(request, "full_url", "chaos://"), out,
+                f"chaos injected {out}", {},
+                _io.BytesIO(b'{"error": "chaos"}'))
+        if self.responder is not None:
+            status, body = self.responder(request)
+            return _CannedResponse(status, body)
+        open_fn = self.inner or urllib.request.urlopen
+        return open_fn(request, timeout=timeout)
+
+    # services-layer escape hatch: a ``handler`` (HTTPRequestData, send) that
+    # routes the default send through this opener — for call sites that take
+    # a handler but not an opener
+    def as_handler(self):
+        from ..io.http import send_with_retries
+
+        def handler(req, send):
+            return send_with_retries(req, opener=self)
+
+        return handler
+
+
+def chaotic_handler(handler: Callable, schedule: Optional[ChaosSchedule] = None,
+                    poison: Optional[Callable] = None,
+                    slow_s: float = 0.0, **sched_kw) -> Callable:
+    """Wrap a serving handler (``Table -> Table``) with injected faults.
+
+    Per call: consume one schedule outcome — "reset"/"timeout"/int all raise
+    (a handler exception is a handler exception; the server's isolation and
+    500-mapping take it from there); ``("slow", s)`` and ``slow_s`` sleep
+    before delegating. ``poison(value) -> bool`` marks individual request
+    payloads: any poisoned row in the batch raises, so a server WITHOUT
+    per-row isolation 500s the whole batch and one WITH isolation fails only
+    the poisoned row — the distinction test_chaos_serving asserts.
+
+    The wrapped handler forwards the server's optional ``budget=`` kwarg when
+    the inner handler accepts it.
+    """
+    sched = schedule or ChaosSchedule(**sched_kw)
+    import inspect
+
+    try:
+        inner_takes_budget = "budget" in inspect.signature(handler).parameters
+    except (TypeError, ValueError):
+        inner_takes_budget = False
+
+    def wrapped(df, budget: Optional[float] = None):
+        out = sched.next_outcome()
+        if slow_s:
+            time.sleep(slow_s)
+        if isinstance(out, tuple) and out[0] == "slow":
+            time.sleep(out[1])
+            out = "ok"
+        if out != "ok":
+            raise FaultInjected(f"chaos handler fault: {out}")
+        if poison is not None and "value" in df:
+            for v in df["value"]:
+                if poison(v):
+                    raise FaultInjected("chaos: poisoned row in batch")
+        if inner_takes_budget:
+            return handler(df, budget=budget)
+        return handler(df)
+
+    return wrapped
+
+
+class chaos_collectives:
+    """Context manager installing a fault hook inside
+    ``parallel.collectives``: every helper calls the hook with its op name
+    before doing any work. Outcomes: "ok" passes, ("slow", s) stalls the
+    host (trace-time for jitted code), anything else raises
+    :class:`FaultInjected`. Nesting is not supported (single global hook)."""
+
+    def __init__(self, schedule: Optional[ChaosSchedule] = None, **sched_kw):
+        self.schedule = schedule or ChaosSchedule(**sched_kw)
+        self.seen: List[str] = []
+
+    def _hook(self, name: str) -> None:
+        self.seen.append(name)
+        out = self.schedule.next_outcome()
+        if isinstance(out, tuple) and out[0] == "slow":
+            time.sleep(out[1])
+            return
+        if out != "ok":
+            raise FaultInjected(f"chaos collective fault in {name}: {out}")
+
+    def __enter__(self) -> "chaos_collectives":
+        from ..parallel import collectives as _c
+
+        if _c._CHAOS_HOOK is not None:
+            raise RuntimeError("chaos_collectives does not nest")
+        _c._CHAOS_HOOK = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from ..parallel import collectives as _c
+
+        _c._CHAOS_HOOK = None
+
+
+class FlakyHTTPServer:
+    """A real TCP backend whose per-REQUEST behavior follows a script —
+    the worker-side fault source for gateway/breaker tests.
+
+    Outcomes per request: int status → respond (keep-alive) with a canned
+    JSON body; "reset" → close the socket mid-request (client sees
+    ECONNRESET/EOF); "ignore" → read the request and never respond (client
+    times out); "ok" → 200. After the script: "ok" forever. ``requests``
+    counts requests actually read off the wire — the probe-count signal the
+    breaker tests assert on.
+    """
+
+    def __init__(self, script: Optional[Sequence[Outcome]] = None,
+                 body: bytes = b'{"chaos": true}'):
+        self.script: List[Outcome] = list(script or [])
+        self.body = body
+        self.requests = 0
+        self._lock = threading.Lock()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(32)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _next(self) -> Outcome:
+        with self._lock:
+            self.requests += 1
+            return self.script.pop(0) if self.script else "ok"
+
+    def _read_request(self, conn: socket.socket) -> bool:
+        """Read one HTTP request (headers + content-length body); False on
+        EOF/garbage (connection done)."""
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = conn.recv(4096)
+            if not chunk:
+                return False
+            buf += chunk
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        length = 0
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            if k.strip().lower() == b"content-length":
+                length = int(v.strip() or 0)
+        while len(rest) < length:
+            chunk = conn.recv(4096)
+            if not chunk:
+                return False
+            rest += chunk
+        return True
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(30)
+            while not self._stop.is_set():
+                if not self._read_request(conn):
+                    return
+                out = self._next()
+                if out == "reset":
+                    # RST instead of FIN: SO_LINGER(0) aborts the connection
+                    conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                    b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                    return
+                if out == "ignore":
+                    while not self._stop.is_set():   # hold the socket open,
+                        time.sleep(0.05)             # never respond
+                    return
+                if isinstance(out, tuple) and out[0] == "slow":
+                    time.sleep(out[1])
+                    out = "ok"
+                status = out if isinstance(out, int) else 200
+                payload = self.body
+                head = (f"HTTP/1.1 {status} X\r\n"
+                        f"Content-Type: application/json\r\n"
+                        f"Content-Length: {len(payload)}\r\n\r\n")
+                conn.sendall(head.encode() + payload)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def start(self) -> "FlakyHTTPServer":
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True).start()
+
+        self._accept_thread = threading.Thread(target=accept_loop, daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "FlakyHTTPServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def canned_json_responder(obj) -> Callable:
+    """``responder`` helper for :class:`ChaosHTTP`: always 200 with ``obj``
+    as the JSON body."""
+    body = _json.dumps(obj).encode()
+
+    def responder(_request):
+        return 200, body
+
+    return responder

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.compat import shard_map
 from ..parallel.mesh import DATA_AXIS
 
 SPARSE_DTYPE = np.dtype([("idx", "<i4"), ("val", "<f4")])
@@ -247,9 +248,9 @@ def _run_pass_sharded(mesh, cfg: VWConfig):
         return state
 
     spec_b = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
-    return jax.jit(jax.shard_map(local_pass, mesh=mesh,
-                                 in_specs=(P(), spec_b), out_specs=P(),
-                                 check_vma=False))
+    return jax.jit(shard_map(local_pass, mesh=mesh,
+                             in_specs=(P(), spec_b), out_specs=P(),
+                             check_vma=False))
 
 
 def train_vw(idx: np.ndarray, val: np.ndarray, y: np.ndarray,

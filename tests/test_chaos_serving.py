@@ -1,0 +1,467 @@
+"""Chaos/resilience acceptance suite (ISSUE: chaos harness tentpole).
+
+Drives the deterministic fault-injection harness (testing/chaos.py) against
+the real serving, gateway, HTTP-client, and collectives layers on CPU:
+
+* bounded-latency responses under injected faults (no hangs),
+* 503 load shedding with bounded admission latency,
+* deadline propagation ends in a 504, never an open-ended wait,
+* per-row failure isolation inside a micro-batch,
+* graceful drain,
+* gateway circuit breaker opens / half-opens / recovers on a scripted
+  backend failure schedule, and sibling retry masks a flaky worker,
+* retry budget caps client-side retry storms,
+* collective-layer hooks fire (at trace time under jit).
+
+Everything is scripted or seeded — reruns see the same fault sequence.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import (CircuitBreaker, Deadline, RetryBudget,
+                                Table, failure_counts, reset_failure_counts)
+from synapseml_tpu.core.resilience import DEADLINE_HEADER
+from synapseml_tpu.io.http import (HTTPRequestData, HTTPTransformer,
+                                   send_with_retries)
+from synapseml_tpu.io.serving import ServingServer, _PendingRequest
+from synapseml_tpu.io.distributed_serving import ServingGateway
+from synapseml_tpu.testing.chaos import (ChaosHTTP, ChaosSchedule,
+                                         FaultInjected, FlakyHTTPServer,
+                                         canned_json_responder,
+                                         chaos_collectives, chaotic_handler)
+
+
+def _post(url, value, headers=None, timeout=10.0):
+    """POST a JSON value; returns (status, parsed_or_text, elapsed_s) and
+    never raises on HTTP error statuses."""
+    body = json.dumps(value).encode()
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(url, data=body, headers=h, method="POST")
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            payload = r.read()
+            status = r.status
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        status = e.code
+    elapsed = time.monotonic() - t0
+    try:
+        parsed = json.loads(payload.decode()) if payload else None
+    except Exception:
+        parsed = payload
+    return status, parsed, elapsed
+
+
+def _echo(df: Table) -> Table:
+    return df.with_column("reply", df["value"])
+
+
+# --------------------------------------------------------------------------
+# schedule determinism
+# --------------------------------------------------------------------------
+
+class TestChaosSchedule:
+    def test_script_consumed_then_after(self):
+        s = ChaosSchedule(script=[503, "reset", ("slow", 0.1)], after="ok")
+        assert [s.next_outcome() for _ in range(5)] == \
+            [503, "reset", ("slow", 0.1), "ok", "ok"]
+        assert s.calls == 5
+
+    def test_seeded_rates_are_deterministic(self):
+        mk = lambda: ChaosSchedule(seed=7, error_rate=0.3,  # noqa: E731
+                                   reset_rate=0.15, timeout_rate=0.15,
+                                   error_codes=(429, 503))
+        a, b = mk(), mk()
+        seq_a = [a.next_outcome() for _ in range(100)]
+        seq_b = [b.next_outcome() for _ in range(100)]
+        assert seq_a == seq_b
+        kinds = set(seq_a)
+        assert "ok" in kinds and len(kinds) >= 3  # faults actually mixed in
+
+
+# --------------------------------------------------------------------------
+# resilience primitives
+# --------------------------------------------------------------------------
+
+class TestResiliencePrimitives:
+    def test_deadline_header_parse_and_cap(self):
+        clk = lambda: 100.0  # noqa: E731
+        d = Deadline.from_header_ms("250", cap_s=30.0, clock=clk)
+        assert d.remaining(clock=clk) == pytest.approx(0.25)
+        # cap: a client cannot pin the server longer than its own limit
+        d = Deadline.from_header_ms("999999999", cap_s=2.0, clock=clk)
+        assert d.remaining(clock=clk) == pytest.approx(2.0)
+        # garbage / absent header falls back to the cap
+        for bad in (None, "", "soon"):
+            d = Deadline.from_header_ms(bad, cap_s=5.0, clock=clk)
+            assert d.remaining(clock=clk) == pytest.approx(5.0)
+        assert Deadline(at=100.0).expired(clock=clk)
+        assert Deadline(at=100.5).header_value(clock=clk) == "500"
+
+    def test_retry_budget_caps_then_refills(self):
+        t = [0.0]
+        b = RetryBudget(rate_per_sec=2.0, burst=3.0, clock=lambda: t[0])
+        assert [b.try_spend() for _ in range(4)] == [True, True, True, False]
+        assert b.spent == 3 and b.denied == 1
+        t[0] = 1.0  # 2 tokens refilled
+        assert b.try_spend() and b.try_spend() and not b.try_spend()
+
+    def test_breaker_state_machine_scripted(self):
+        t = [0.0]
+        br = CircuitBreaker(failure_threshold=3, cooldown=1.0,
+                            max_backoff_mult=8, clock=lambda: t[0])
+        for _ in range(3):
+            assert br.try_acquire()
+            br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.available() and not br.try_acquire()
+        t[0] = 1.0  # cooldown elapsed -> exactly one half-open probe
+        assert br.try_acquire()
+        assert br.state == CircuitBreaker.HALF_OPEN
+        assert not br.try_acquire()  # second concurrent probe refused
+        br.record_failure()  # probe fails -> reopen with escalated cooldown
+        assert br.state == CircuitBreaker.OPEN
+        assert br.open_until == pytest.approx(1.0 + 2.0)  # 1.0 * 2**1
+        t[0] = 3.5
+        assert br.try_acquire()
+        br.record_success()  # probe succeeds -> closed, escalation reset
+        assert br.state == CircuitBreaker.CLOSED
+        assert br.consecutive_failures == 0
+        assert br.snapshot()["state"] == "closed"
+
+
+# --------------------------------------------------------------------------
+# HTTP client layer under injected faults
+# --------------------------------------------------------------------------
+
+class TestChaosHTTP:
+    def test_retries_through_injected_5xx_to_success(self):
+        chaos = ChaosHTTP(script=[503, 429],
+                          responder=canned_json_responder({"v": 1}))
+        req = HTTPRequestData.from_json_body("http://chaos.invalid/", {})
+        r = send_with_retries(req, retries=3, backoff=0.001, opener=chaos)
+        assert r.status_code == 200 and r.json() == {"v": 1}
+        assert chaos.schedule.calls == 3
+
+    def test_non_retryable_status_returns_immediately(self):
+        chaos = ChaosHTTP(script=[404],
+                          responder=canned_json_responder({"v": 1}))
+        req = HTTPRequestData.from_json_body("http://chaos.invalid/", {})
+        r = send_with_retries(req, retries=3, backoff=0.001, opener=chaos)
+        assert r.status_code == 404
+        assert chaos.schedule.calls == 1
+
+    def test_reset_and_timeout_count_as_transport_failures(self):
+        reset_failure_counts()
+        chaos = ChaosHTTP(script=["reset", "timeout"],
+                          responder=canned_json_responder({"v": 1}))
+        req = HTTPRequestData.from_json_body("http://chaos.invalid/", {})
+        r = send_with_retries(req, retries=2, backoff=0.001, opener=chaos)
+        assert r.status_code == 200  # third attempt lands
+        assert failure_counts().get("http.transport_error", 0) == 2
+
+    def test_retry_budget_stops_retry_storm(self):
+        reset_failure_counts()
+        chaos = ChaosHTTP(script=[503] * 10,
+                          responder=canned_json_responder({"v": 1}))
+        budget = RetryBudget(rate_per_sec=0.0, burst=2.0)
+        req = HTTPRequestData.from_json_body("http://chaos.invalid/", {})
+        r = send_with_retries(req, retries=9, backoff=0.001, opener=chaos,
+                              retry_budget=budget)
+        # 1 initial attempt + 2 budgeted retries, then the bucket is dry
+        assert r.status_code == 503
+        assert chaos.schedule.calls == 3
+        assert budget.spent == 2 and budget.denied == 1
+        assert failure_counts().get("http.retry_budget_exhausted", 0) == 1
+
+    def test_transformer_opener_and_budget_params(self):
+        chaos = ChaosHTTP(script=[500],
+                          responder=canned_json_responder({"ok": True}))
+        col = np.empty(1, dtype=object)
+        col[0] = HTTPRequestData.from_json_body("http://chaos.invalid/", {})
+        t = HTTPTransformer(inputCol="req", outputCol="resp",
+                            maxRetries=2, backoff=0.001)
+        t.set("opener", chaos)
+        t.set("retryBudget", RetryBudget(rate_per_sec=0.0, burst=5.0))
+        out = t.transform(Table({"req": col}))
+        assert out["resp"][0].status_code == 200
+        assert out["resp"][0].json() == {"ok": True}
+
+    def test_services_layer_opener_param(self):
+        from synapseml_tpu.services.base import CognitiveServiceBase
+
+        class Tiny(CognitiveServiceBase):
+            def _prepare_body(self, df, i):
+                return {"text": str(df["t"][i])}
+
+        chaos = ChaosHTTP(script=[503],
+                          responder=canned_json_responder({"label": "x"}))
+        svc = Tiny(url="http://chaos.invalid/", outputCol="out",
+                   backoff=0.001)
+        svc.set("opener", chaos)
+        res = svc.transform(Table({"t": np.array(["hello"], dtype=object)}))
+        assert res["out"][0] == {"label": "x"}
+        assert res[svc.get("errorCol")][0] is None
+
+
+# --------------------------------------------------------------------------
+# serving server resilience
+# --------------------------------------------------------------------------
+
+def _pending(value, deadline=None):
+    return _PendingRequest(id=uuid.uuid4().hex, method="POST", path="/",
+                           headers={}, body=json.dumps(value).encode(),
+                           deadline=deadline, admitted_at=time.monotonic())
+
+
+class TestServingResilience:
+    def test_poisoned_row_fails_alone_in_batch(self):
+        handler = chaotic_handler(_echo, poison=lambda v: v == "bad")
+        srv = ServingServer(handler)  # not started: drive _run_batch directly
+        reqs = [_pending(v) for v in ("a", "bad", "b")]
+        srv._run_batch(reqs)
+        statuses = [r.response[0] for r in reqs]
+        assert statuses == [200, 500, 200]
+        assert json.loads(reqs[0].response[2]) == "a"  # echoed reply value
+        assert srv.metrics["handler_errors"] == 1
+        assert srv.metrics["isolated_rows"] == 1
+
+    def test_without_isolation_whole_batch_fails(self):
+        handler = chaotic_handler(_echo, poison=lambda v: v == "bad")
+        srv = ServingServer(handler, isolate_failures=False)
+        reqs = [_pending(v) for v in ("a", "bad")]
+        srv._run_batch(reqs)
+        assert [r.response[0] for r in reqs] == [500, 500]
+
+    def test_expired_request_dropped_at_batch_formation(self):
+        calls = []
+        srv = ServingServer(lambda df: calls.append(1) or _echo(df))
+        dead = _pending("x", deadline=Deadline(at=time.monotonic() - 1.0))
+        live = _pending("y")
+        srv._run_batch([dead, live])
+        assert dead.response[0] == 504
+        assert live.response[0] == 200
+        assert srv.metrics["deadline_dropped"] == 1
+        assert calls == [1]  # handler ran once, without the dead row
+
+    def test_overload_sheds_503_fast(self):
+        reset_failure_counts()
+        slow = chaotic_handler(_echo, slow_s=0.25)
+        with ServingServer(slow, port=0, max_batch_size=1,
+                           max_batch_latency=0.0, max_queue_size=2) as srv:
+            with ThreadPoolExecutor(max_workers=10) as pool:
+                results = list(pool.map(
+                    lambda i: _post(srv.url, i, timeout=10.0), range(10)))
+            shed = [r for r in results if r[0] == 503]
+            ok = [r for r in results if r[0] == 200]
+            assert shed and ok
+            # the overload contract: rejection is FAST (bounded admission
+            # latency), not a slow timeout
+            assert max(e for _, _, e in shed) < 1.0
+            assert srv.metrics["shed"] == len(shed)
+            assert failure_counts().get("serving.shed", 0) == len(shed)
+
+    def test_deadline_breach_is_bounded_504(self):
+        slow = chaotic_handler(_echo, slow_s=0.6)
+        with ServingServer(slow, port=0, max_batch_size=4,
+                           max_batch_latency=0.0) as srv:
+            status, _, elapsed = _post(
+                srv.url, "x", headers={DEADLINE_HEADER: "100"})
+            assert status == 504
+            assert elapsed < 0.5  # answered at the deadline, not after 0.6s
+            assert srv.metrics["deadline_expired"] == 1
+
+    def test_handler_receives_deadline_budget(self):
+        seen = {}
+
+        def h(df, budget=None):
+            seen["budget"] = budget
+            return _echo(df)
+
+        with ServingServer(h, port=0, max_batch_size=4,
+                           max_batch_latency=0.0) as srv:
+            status, body, _ = _post(
+                srv.url, "x", headers={DEADLINE_HEADER: "400"})
+            assert status == 200 and body == "x"
+            assert 0.0 < seen["budget"] <= 0.4
+            # no header: budget is the server's own reply_timeout cap
+            _post(srv.url, "y")
+            assert seen["budget"] > 1.0
+
+    def test_graceful_drain_completes_inflight_rejects_new(self):
+        slow = chaotic_handler(_echo, slow_s=0.3)
+        srv = ServingServer(slow, port=0, max_batch_size=1,
+                            max_batch_latency=0.0).start()
+        inflight = {}
+        t = threading.Thread(
+            target=lambda: inflight.update(r=_post(srv.url, "in")))
+        t.start()
+        time.sleep(0.1)  # request is in the handler now
+        stopper = threading.Thread(target=srv.stop)  # drain=True default
+        stopper.start()
+        time.sleep(0.05)  # draining flag is up, listener still alive
+        status, body, elapsed = _post(srv.url, "late")
+        assert status == 503 and "draining" in json.dumps(body)
+        assert elapsed < 0.5
+        t.join(timeout=5)
+        stopper.join(timeout=5)
+        assert inflight["r"][0] == 200  # in-flight request completed
+        assert srv.metrics["drain_rejected"] >= 1
+
+    def test_metrics_endpoint_reports_gauges(self):
+        with ServingServer(_echo, port=0, max_batch_latency=0.0) as srv:
+            assert _post(srv.url, 1)[0] == 200
+            with urllib.request.urlopen(srv.url, timeout=5) as r:
+                snap = json.loads(r.read().decode())
+            assert snap["accepted"] == 1 and snap["completed"] == 1
+            assert snap["queue_depth"] == 0
+            assert snap["draining"] is False
+
+
+# --------------------------------------------------------------------------
+# gateway: breaker, sibling retry, deadline — against real flaky backends
+# --------------------------------------------------------------------------
+
+class TestGatewayChaos:
+    def test_breaker_opens_half_opens_recovers(self):
+        with FlakyHTTPServer(script=["reset"] * 3) as flaky:
+            gw = ServingGateway([flaky.url], forward_timeout=2.0,
+                                cooldown=0.3, breaker_threshold=3).start()
+            try:
+                for _ in range(3):
+                    assert _post(gw.url, "x")[0] == 502
+                link = gw.links[0]
+                assert link.breaker.state == CircuitBreaker.OPEN
+                seen = flaky.requests
+                # OPEN: fail fast without dialing the known-bad backend
+                status, _, elapsed = _post(gw.url, "x")
+                assert status == 502 and elapsed < 0.2
+                assert flaky.requests == seen
+                # health endpoint exposes the breaker state
+                with urllib.request.urlopen(gw.url, timeout=5) as r:
+                    health = json.loads(r.read().decode())
+                assert health["workers"][0]["state"] == "open"
+                assert health["workers"][0]["down"] is True
+                # cooldown elapses -> half-open probe -> backend recovered
+                time.sleep(0.35)
+                assert _post(gw.url, "x")[0] == 200
+                assert link.breaker.state == CircuitBreaker.CLOSED
+            finally:
+                gw.stop()
+
+    def test_sibling_retry_masks_flaky_worker(self):
+        with FlakyHTTPServer(script=["reset"] * 10) as flaky, \
+                FlakyHTTPServer() as good:
+            gw = ServingGateway([flaky.url, good.url], mode="round_robin",
+                                forward_timeout=2.0, cooldown=30.0,
+                                breaker_threshold=2).start()
+            try:
+                for i in range(10):
+                    assert _post(gw.url, i)[0] == 200
+                assert gw.stats["failed"] == 0
+                assert gw.stats["retried"] >= 2
+                # breaker capped the flaky worker's damage at its threshold:
+                # once OPEN (long cooldown), it stops receiving traffic
+                assert flaky.requests == 2
+                assert good.requests == 10
+            finally:
+                gw.stop()
+
+    def test_silent_worker_times_out_then_sibling_serves(self):
+        with FlakyHTTPServer(script=["ignore"]) as silent, \
+                FlakyHTTPServer() as good:
+            gw = ServingGateway([silent.url, good.url], mode="round_robin",
+                                forward_timeout=0.3, cooldown=30.0,
+                                breaker_threshold=1).start()
+            try:
+                for i in range(4):
+                    status, _, elapsed = _post(gw.url, i)
+                    assert status == 200
+                    assert elapsed < 1.5  # bounded by forward_timeout + ok hop
+                assert gw.stats["failed"] == 0
+            finally:
+                gw.stop()
+
+    def test_expired_deadline_is_fast_504_without_backend_touch(self):
+        with FlakyHTTPServer() as good:
+            gw = ServingGateway([good.url], forward_timeout=5.0).start()
+            try:
+                status, _, elapsed = _post(
+                    gw.url, "x", headers={DEADLINE_HEADER: "0"})
+                assert status == 504 and elapsed < 0.2
+                assert good.requests == 0
+            finally:
+                gw.stop()
+
+    def test_deadline_budget_propagates_through_gateway(self):
+        seen = {}
+
+        def h(df, budget=None):
+            seen["budget"] = budget
+            return _echo(df)
+
+        with ServingServer(h, port=0, max_batch_size=4,
+                           max_batch_latency=0.0) as worker:
+            gw = ServingGateway([worker.url], forward_timeout=5.0).start()
+            try:
+                status, body, _ = _post(
+                    gw.url, "x", headers={DEADLINE_HEADER: "300"})
+                assert status == 200 and body == "x"
+                # the worker saw the CLIENT's remaining budget (re-anchored
+                # per hop), not its own 30s default
+                assert 0.0 < seen["budget"] <= 0.3
+            finally:
+                gw.stop()
+
+
+# --------------------------------------------------------------------------
+# collectives chaos hook
+# --------------------------------------------------------------------------
+
+class TestCollectivesChaos:
+    def test_hook_raises_before_collective_runs(self):
+        import jax.numpy as jnp
+
+        from synapseml_tpu.parallel import collectives as C
+
+        with chaos_collectives(script=["reset"]) as cc:
+            with pytest.raises(FaultInjected):
+                C.allreduce_sum(jnp.ones(4))
+            assert cc.seen == ["allreduce_sum"]
+        assert C._CHAOS_HOOK is None  # uninstalled on exit
+
+    def test_hook_fires_at_trace_time_under_jit(self, eight_devices):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from synapseml_tpu.parallel import collectives as C
+        from synapseml_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+        mesh = make_mesh({DATA_AXIS: 4})
+        x = np.arange(8, dtype=np.float32)
+        with chaos_collectives() as cc:  # all-"ok" schedule, records ops
+            f = jax.jit(C.shard_apply(mesh, C.allreduce_sum,
+                                      in_specs=P(DATA_AXIS), out_specs=P()))
+            y = np.asarray(f(x))
+            np.testing.assert_allclose(y, [12.0, 16.0])
+            _ = f(x)  # cached executable: no retrace, hook must NOT refire
+            assert cc.seen.count("allreduce_sum") == 1
+
+    def test_nesting_is_rejected(self):
+        with chaos_collectives():
+            with pytest.raises(RuntimeError):
+                with chaos_collectives():
+                    pass

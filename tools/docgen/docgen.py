@@ -146,8 +146,9 @@ def build_site(out_dir: str) -> list:
         with open(path, encoding="utf-8") as f:
             body = md_to_html(f.read())
         nav = "".join(
-            f'<a href="{s}.html"{" class=\"active\"" if s == slug else ""}>'
-            f"{t}</a>" for s, t, in [(s, t) for s, _, t in pages])
+            '<a href="%s.html"%s>%s</a>'
+            % (s, ' class="active"' if s == slug else "", t)
+            for s, _, t in pages)
         page = (f"<!doctype html><html><head><meta charset='utf-8'>"
                 f"<title>{html.escape(title)} — synapseml_tpu</title>"
                 f"<style>{_STYLE}</style></head><body>"

@@ -939,6 +939,60 @@ def bench_gbdt_depthwise():
             "vs_baseline": round(v / BASELINE_GBDT_ROW_ITERS, 3)}
 
 
+def bench_checkpoint_overhead(rows=50_000, cols=100, iters=20):
+    """Checkpointed vs plain gbdt training at dryrun shapes: the robustness
+    layer (core/checkpoint.py) must not silently regress the hot path. The
+    record carries the relative train-time overhead of snapshotting every 5
+    iterations plus the absolute save and verified-restore latencies."""
+    import shutil
+    import tempfile
+
+    from synapseml_tpu.core.checkpoint import CheckpointStore
+    from synapseml_tpu.gbdt import BoosterConfig, train_booster
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.normal(size=rows) > 0).astype(np.float32)
+    mk = lambda: BoosterConfig(objective="binary", num_iterations=iters,
+                               seed=1)
+
+    # warm BOTH shapes: checkpointing clamps the fused scan chunk to
+    # checkpoint_every, a different jit cache entry than the plain run —
+    # without this the "overhead" is dominated by that one-time compile
+    warm = tempfile.mkdtemp(prefix="bench_ckpt_warm_")
+    try:
+        train_booster(X, y, mk())
+        train_booster(X, y, mk(), checkpoint_store=warm, checkpoint_every=5)
+    finally:
+        shutil.rmtree(warm, ignore_errors=True)
+
+    t0 = time.perf_counter()
+    train_booster(X, y, mk())
+    plain_s = time.perf_counter() - t0
+
+    d = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        t0 = time.perf_counter()
+        train_booster(X, y, mk(), checkpoint_store=d, checkpoint_every=5)
+        ckpt_s = time.perf_counter() - t0
+        store = CheckpointStore(d)
+        t0 = time.perf_counter()
+        ckpt = store.load_latest()          # full digest-verified restore
+        restore_ms = (time.perf_counter() - t0) * 1e3
+        n_saves = max(1, iters // 5)
+        blob_mb = sum(len(b) for b in ckpt.artifacts.values()) / 1e6
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    overhead = ckpt_s / plain_s - 1.0
+    return {"metric": "gbdt_checkpoint_overhead_frac",
+            "value": round(overhead, 4),
+            "unit": (f"fraction of train time (save every 5 iters: "
+                     f"{(ckpt_s - plain_s) / n_saves * 1e3:.1f} ms/save, "
+                     f"restore {restore_ms:.1f} ms, {blob_mb:.2f} MB/ckpt)"),
+            "vs_baseline": None}
+
+
 def bench_voting_ab(rows=50_000, cols=100, iters=10):
     """Voting-parallel vs data-parallel GBDT A/B on the virtual 8-device CPU
     mesh at dryrun shapes (VERDICT r3 stretch #9; LightGBMParams.scala:25-27
@@ -1010,7 +1064,8 @@ def _extra_workloads():
            bench_onnx_inference, bench_onnx_bf16, bench_onnx_bert,
            bench_flash_attention, bench_sparse_ingest,
            bench_serving, bench_serving_resnet,
-           bench_serving_distributed, bench_voting_ab)
+           bench_serving_distributed, bench_voting_ab,
+           bench_checkpoint_overhead)
     return {f.__name__: f for f in fns}
 
 

@@ -45,4 +45,11 @@ echo "== perf_tune rehearsal (tune -> flip -> persist on CPU) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_perf_tune_rehearsal.py -x -q -m slow
 
+echo "== preemption-recovery chaos suite (kill -> resume == uninterrupted) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_checkpoint_recovery.py -x -q
+
+echo "== checkpoint overhead guardrail (save/restore must stay cheap) =="
+JAX_PLATFORMS=cpu python bench.py --only bench_checkpoint_overhead
+
 echo "CI OK"

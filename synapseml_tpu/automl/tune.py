@@ -11,11 +11,16 @@ placed on disjoint device subsets by the caller."""
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List
 
 import numpy as np
 
+from ..core.checkpoint import atomic_write_text, preemption_point
+from ..core.logging import record_failure
 from ..core.params import Param, HasLabelCol
 from ..core.pipeline import Estimator, Model, Transformer
 from ..core.table import Table
@@ -52,12 +57,21 @@ class TuneHyperparameters(Estimator, HasLabelCol):
                              str, "AUC")
     parallelism = Param("parallelism", "Concurrent candidate fits", int, 4)
     seed = Param("seed", "Search/CV seed", int, 0)
+    checkpointDir = Param("checkpointDir", "Directory persisting per-candidate "
+                          "results; an interrupted search resumes by skipping "
+                          "finished candidates", str, "")
 
     def _candidates(self) -> List[Dict[str, Any]]:
         space = self.paramSpace
         if self.searchMode == "grid":
             return list(GridSpace(space))
         return list(RandomSpace(space, self.numRuns, self.seed))
+
+    @staticmethod
+    def _candidate_key(params: Dict[str, Any]) -> str:
+        """Stable identity of one candidate: sha256 over canonical JSON."""
+        blob = json.dumps(params, sort_keys=True, default=repr)
+        return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:24]
 
     def _fit(self, df: Table) -> "TuneHyperparametersModel":
         candidates = self._candidates()
@@ -69,21 +83,61 @@ class TuneHyperparameters(Estimator, HasLabelCol):
         metric = self.evaluationMetric
         maximize = metric in _MAXIMIZE
 
-        def run(params: Dict[str, Any]) -> float:
-            scores = []
-            for f in range(k):
-                val_idx = folds[f]
-                train_idx = np.concatenate([folds[j] for j in range(k) if j != f])
-                est = self.model.copy(extra=params)
-                fitted = est.fit(df.take(train_idx))
-                scores.append(_evaluate(fitted, df.take(val_idx), metric, self.labelCol))
-            return float(np.nanmean(scores))
+        # resumable search: each finished candidate's score persists as one
+        # atomically-written JSON file keyed by the candidate's param hash,
+        # so a preempted search skips straight past completed work
+        ckpt_dir = self.checkpointDir or ""
+        completed: Dict[str, float] = {}
+        if ckpt_dir:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            for fn in os.listdir(ckpt_dir):
+                if not (fn.startswith("cand_") and fn.endswith(".json")):
+                    continue
+                try:
+                    with open(os.path.join(ckpt_dir, fn)) as f:
+                        rec = json.load(f)
+                    completed[fn[5:-5]] = float(rec["metric"])
+                except (OSError, ValueError, KeyError, TypeError):
+                    record_failure("automl.candidate_record_corrupt", file=fn)
+
+        def run(indexed) -> float:
+            i, params = indexed
+            key = self._candidate_key(params)
+            if key in completed:
+                return completed[key]
+            preemption_point("automl.candidate", i)
+            try:
+                scores = []
+                for f in range(k):
+                    val_idx = folds[f]
+                    train_idx = np.concatenate(
+                        [folds[j] for j in range(k) if j != f])
+                    est = self.model.copy(extra=params)
+                    fitted = est.fit(df.take(train_idx))
+                    scores.append(_evaluate(fitted, df.take(val_idx), metric,
+                                            self.labelCol))
+                val = float(np.nanmean(scores))
+            except Exception as e:
+                # one broken candidate must not abort the search: score it
+                # NaN (excluded by nanargmax/nanargmin) and keep going.
+                # PreemptionError is a BaseException and still propagates.
+                record_failure("automl.candidate_failure", index=i,
+                               error=type(e).__name__, message=str(e)[:200])
+                val = float("nan")
+            if ckpt_dir:
+                atomic_write_text(
+                    os.path.join(ckpt_dir, f"cand_{key}.json"),
+                    json.dumps({"params": params, "metric": val},
+                               default=repr))
+            return val
 
         with ThreadPoolExecutor(max_workers=max(self.parallelism, 1)) as pool:
-            results = list(pool.map(run, candidates))
+            results = list(pool.map(run, enumerate(candidates)))
 
         if np.all(np.isnan(results)):
-            raise ValueError("every candidate scored NaN — check labels/folds")
+            raise ValueError("every candidate scored NaN — check labels/folds "
+                             "(candidate failures are counted under "
+                             "automl.candidate_failure)")
         best_i = int(np.nanargmax(results) if maximize else np.nanargmin(results))
         best_params = candidates[best_i]
         best_model = self.model.copy(extra=best_params).fit(df)

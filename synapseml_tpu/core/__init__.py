@@ -41,3 +41,14 @@ from .resilience import (  # noqa: F401
     RetryBudget,
     default_retry_budget,
 )
+from .checkpoint import (  # noqa: F401
+    Checkpoint,
+    CheckpointError,
+    CheckpointStore,
+    NonFiniteGuard,
+    NonFiniteLossError,
+    PreemptionError,
+    atomic_write_bytes,
+    atomic_write_text,
+    preemption_point,
+)

@@ -1,0 +1,354 @@
+"""Crash-safe checkpoint/recovery subsystem for the training paths.
+
+TPU pods are preemptible by design: the MPMD pipeline-parallel literature
+(PAPERS.md) treats worker loss as a routine scheduling event, and the
+reference stack leans on Horovod/Lightning run-id checkpoint stores
+(DeepVisionClassifier.py:86). This module is the unified store all three
+training loops (gbdt ``train_booster``, ``dl.FlaxTrainer.fit``,
+``automl.TuneHyperparameters``) write through, with the properties a real
+preemption demands:
+
+* **Atomic writes** — every artifact lands via tmp + ``os.replace``; the
+  manifest is written LAST, so a checkpoint without a verifiable manifest
+  never existed as far as recovery is concerned (a torn write can only
+  produce a missing/failing manifest, never a silently-half-written state).
+* **Integrity manifest** — per-artifact size + CRC32 + SHA-256. A torn
+  ``latest``, a truncated artifact, or a flipped bit is *detected* at load
+  (``checkpoint.corrupt`` failure counter), not deserialized into garbage.
+* **Keep-last-N retention** — bounded disk: older steps are pruned after a
+  successful save, never before the new step is fully durable.
+* **Corruption fallback** — ``load_latest`` walks checkpoints newest-first
+  and returns the newest one that verifies (``checkpoint.fallback``
+  counter), so one bad write costs one checkpoint interval, not the run.
+
+Layout (flat, one manifest per step)::
+
+    dir/
+      ckpt_00000007.state.msgpack    # artifact files: <prefix>_<step>.<name>
+      ckpt_00000007.manifest.json    # digests; presence == checkpoint valid
+      latest                         # basename of the newest step
+
+The module also hosts the two training-robustness primitives that ride on
+the store:
+
+* :func:`preemption_point` — the cooperative kill hook every training loop
+  calls at its resume-safe boundaries; ``testing.chaos.ChaosPreemption``
+  installs a scheduled/seeded killer here so "kill at step k, resume,
+  bit-identical model" is a CI property.
+* :class:`NonFiniteGuard` — policy on a non-finite training loss
+  (``raise`` | ``skip`` | ``rollback``), with structured counters via
+  :func:`core.logging.record_failure` so silent NaN-poisoning of parameters
+  cannot happen.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+from .logging import record_failure
+
+MANIFEST_SUFFIX = ".manifest.json"
+_STEP_RE = re.compile(r"^(?P<prefix>[A-Za-z0-9]+)_(?P<step>\d{8})$")
+
+
+class CheckpointError(ValueError):
+    """A checkpoint could not be read/verified (corrupt, torn, missing)."""
+
+
+class PreemptionError(BaseException):
+    """An injected (or cooperative) preemption: the process is being killed.
+
+    Derives from ``BaseException`` (like ``KeyboardInterrupt``) so generic
+    ``except Exception`` recovery code cannot accidentally swallow a kill —
+    a real SIGTERM would not be swallowable either.
+    """
+
+
+# --- atomic primitives ------------------------------------------------------
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` atomically (tmp + rename, same dir so the
+    rename never crosses filesystems)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def _digests(data: bytes) -> Dict[str, Any]:
+    return {"size": len(data),
+            "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+            "sha256": hashlib.sha256(data).hexdigest()}
+
+
+# --- the store --------------------------------------------------------------
+
+@dataclasses.dataclass
+class Checkpoint:
+    """One verified checkpoint: step number, artifact bytes by name, and the
+    free-form ``meta`` dict the saver attached."""
+    step: int
+    artifacts: Dict[str, bytes]
+    meta: Dict[str, Any]
+    base: str      # e.g. "ckpt_00000007" (for diagnostics)
+
+
+class CheckpointStore:
+    """Atomic, manifest-verified, keep-last-N checkpoint directory.
+
+    ``save`` never leaves a partially-visible checkpoint; ``load_latest``
+    never returns bytes that fail their manifest digest. Thread-compat: one
+    writer per store (training loops are single-writer by construction).
+    """
+
+    def __init__(self, directory: str, keep_last: int = 3,
+                 prefix: str = "ckpt"):
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        if not re.fullmatch(r"[A-Za-z0-9]+", prefix):
+            raise ValueError(f"prefix must be alphanumeric, got {prefix!r}")
+        self.dir = directory
+        self.keep_last = keep_last
+        self.prefix = prefix
+
+    # -- naming helpers --
+    def _base(self, step: int) -> str:
+        return f"{self.prefix}_{step:08d}"
+
+    def _manifest_path(self, base: str) -> str:
+        return os.path.join(self.dir, base + MANIFEST_SUFFIX)
+
+    def _artifact_path(self, base: str, name: str) -> str:
+        return os.path.join(self.dir, f"{base}.{name}")
+
+    # -- write path --
+    def save(self, step: int, artifacts: Dict[str, bytes],
+             meta: Optional[Dict[str, Any]] = None) -> str:
+        """Persist one checkpoint; returns its base name. Artifact names must
+        be relative filenames (no separators). The manifest rename is the
+        commit point; retention prunes only after it."""
+        if not artifacts:
+            raise ValueError("checkpoint needs at least one artifact")
+        for name in artifacts:
+            if os.sep in name or name.startswith(".") or not name:
+                raise ValueError(f"bad artifact name {name!r}")
+        os.makedirs(self.dir, exist_ok=True)
+        base = self._base(int(step))
+        manifest = {"format": 1, "step": int(step), "meta": meta or {},
+                    "artifacts": {}}
+        for name, data in artifacts.items():
+            atomic_write_bytes(self._artifact_path(base, name), bytes(data))
+            manifest["artifacts"][name] = _digests(bytes(data))
+        atomic_write_text(self._manifest_path(base),
+                          json.dumps(manifest, sort_keys=True))
+        atomic_write_text(os.path.join(self.dir, "latest"), base)
+        self._prune()
+        return base
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for step in steps[:-self.keep_last]:
+            base = self._base(step)
+            for fn in os.listdir(self.dir):
+                if fn == base + MANIFEST_SUFFIX or fn.startswith(base + "."):
+                    try:
+                        os.remove(os.path.join(self.dir, fn))
+                    except OSError:
+                        pass   # a vanished file is already pruned
+
+    # -- read path --
+    def steps(self) -> List[int]:
+        """Ascending step numbers that have a manifest on disk (verified or
+        not)."""
+        if not os.path.isdir(self.dir):
+            return []
+        out = []
+        for fn in os.listdir(self.dir):
+            if not fn.endswith(MANIFEST_SUFFIX):
+                continue
+            m = _STEP_RE.match(fn[: -len(MANIFEST_SUFFIX)])
+            if m and m.group("prefix") == self.prefix:
+                out.append(int(m.group("step")))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def _load_base(self, base: str) -> Checkpoint:
+        """Read + verify one checkpoint; raises CheckpointError on any
+        integrity failure."""
+        mpath = self._manifest_path(base)
+        try:
+            with open(mpath, "rb") as f:
+                manifest = json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError) as e:
+            raise CheckpointError(f"checkpoint {base}: unreadable manifest "
+                                  f"({e})") from e
+        arts: Dict[str, bytes] = {}
+        for name, want in manifest.get("artifacts", {}).items():
+            apath = self._artifact_path(base, name)
+            try:
+                with open(apath, "rb") as f:
+                    data = f.read()
+            except OSError as e:
+                raise CheckpointError(
+                    f"checkpoint {base}: artifact {name!r} missing "
+                    f"({e})") from e
+            got = _digests(data)
+            for field in ("size", "crc32", "sha256"):
+                if got[field] != want.get(field):
+                    raise CheckpointError(
+                        f"checkpoint {base}: artifact {name!r} failed "
+                        f"{field} verification (torn write or bit rot): "
+                        f"expected {want.get(field)!r}, got {got[field]!r}")
+            arts[name] = data
+        if not arts:
+            raise CheckpointError(f"checkpoint {base}: empty manifest")
+        return Checkpoint(step=int(manifest.get("step", -1)), artifacts=arts,
+                          meta=manifest.get("meta", {}) or {}, base=base)
+
+    def load_step(self, step: int) -> Checkpoint:
+        return self._load_base(self._base(int(step)))
+
+    def load_latest(self) -> Optional[Checkpoint]:
+        """Newest checkpoint that VERIFIES, or None when the directory holds
+        no usable checkpoint. A corrupt newest checkpoint is counted
+        (``checkpoint.corrupt``) and recovery falls back to the previous
+        good one (``checkpoint.fallback``)."""
+        if not os.path.isdir(self.dir):
+            return None
+        candidates: List[str] = []
+        latest_path = os.path.join(self.dir, "latest")
+        pointed = None
+        if os.path.exists(latest_path):
+            try:
+                with open(latest_path) as f:
+                    pointed = f.read().strip()
+            except OSError:
+                pointed = None
+        if pointed:
+            candidates.append(pointed)
+        for step in reversed(self.steps()):
+            base = self._base(step)
+            if base not in candidates:
+                candidates.append(base)
+        first_failure = None
+        for i, base in enumerate(candidates):
+            try:
+                ckpt = self._load_base(base)
+            except CheckpointError as e:
+                record_failure("checkpoint.corrupt", base=base, error=str(e))
+                if first_failure is None:
+                    first_failure = str(e)
+                continue
+            if i > 0 or first_failure is not None:
+                record_failure("checkpoint.fallback", base=base,
+                               skipped=i, first_error=first_failure)
+            return ckpt
+        return None
+
+
+# --- preemption points ------------------------------------------------------
+# Training loops call preemption_point(phase, step) at every resume-safe
+# boundary. Normally a no-op; testing.chaos.ChaosPreemption installs a hook
+# that raises PreemptionError on its schedule, which is how the recovery
+# suite proves kill-anywhere -> resume works.
+
+_PREEMPT_HOOK: Optional[Callable[[str, int], None]] = None
+
+
+def preemption_point(phase: str, step: int) -> None:
+    """A resume-safe boundary in a training loop. ``phase`` is a dotted name
+    (``gbdt.iteration``, ``dl.step``, ``automl.candidate``); ``step`` is the
+    loop index about to run."""
+    hook = _PREEMPT_HOOK
+    if hook is not None:
+        hook(phase, step)
+
+
+# --- non-finite loss guard --------------------------------------------------
+
+class NonFiniteLossError(FloatingPointError):
+    """Raised by NonFiniteGuard(policy='raise') on a NaN/inf training loss."""
+
+
+class NonFiniteGuard:
+    """Policy on non-finite training losses.
+
+    * ``raise`` — stop immediately with :class:`NonFiniteLossError` (the
+      safe default: a NaN loss means every subsequent update is garbage).
+    * ``skip`` — drop the poisoned step (caller reverts to its pre-step
+      state) and continue; after ``max_consecutive`` *consecutive* skips the
+      guard escalates to raise, so a permanently-NaN run cannot spin.
+    * ``rollback`` — ask the caller to restore the last good checkpoint;
+      after ``max_rollbacks`` total rollbacks the guard raises.
+
+    Every event increments structured counters (``train.nonfinite_loss``
+    plus ``train.nonfinite_skipped`` / ``train.nonfinite_rollback``) via
+    :func:`core.logging.record_failure`, so the chaos suite can assert the
+    policy actually fired.
+    """
+
+    POLICIES = ("raise", "skip", "rollback")
+
+    def __init__(self, policy: str = "raise", max_consecutive: int = 10,
+                 max_rollbacks: int = 3, counter_prefix: str = "train"):
+        if policy not in self.POLICIES:
+            raise ValueError(f"NonFiniteGuard policy={policy!r} is not one "
+                             f"of {self.POLICIES}")
+        self.policy = policy
+        self.max_consecutive = max_consecutive
+        self.max_rollbacks = max_rollbacks
+        self.prefix = counter_prefix
+        self.consecutive = 0
+        self.total = 0
+        self.rollbacks = 0
+
+    def check(self, loss: float, step: int) -> str:
+        """Inspect one step's loss. Returns ``"ok"``, ``"skip"`` (caller
+        must revert the step), or ``"rollback"`` (caller must restore the
+        last checkpoint); raises :class:`NonFiniteLossError` per policy."""
+        import math
+
+        if math.isfinite(loss):
+            self.consecutive = 0
+            return "ok"
+        self.total += 1
+        self.consecutive += 1
+        record_failure(f"{self.prefix}.nonfinite_loss", step=int(step),
+                       loss=repr(loss), policy=self.policy)
+        if self.policy == "raise":
+            raise NonFiniteLossError(
+                f"non-finite training loss ({loss!r}) at step {step}; set "
+                "the non-finite policy to 'skip' or 'rollback' to continue "
+                "past poisoned steps")
+        if self.policy == "skip":
+            if self.consecutive > self.max_consecutive:
+                raise NonFiniteLossError(
+                    f"{self.consecutive} consecutive non-finite losses "
+                    f"(last at step {step}); the run is not recovering — "
+                    "check learning rate / data for inf/NaN")
+            record_failure(f"{self.prefix}.nonfinite_skipped", step=int(step))
+            return "skip"
+        # rollback
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise NonFiniteLossError(
+                f"non-finite loss persisted through {self.max_rollbacks} "
+                f"checkpoint rollbacks (last at step {step}); aborting")
+        record_failure(f"{self.prefix}.nonfinite_rollback", step=int(step),
+                       rollback=self.rollbacks)
+        return "rollback"

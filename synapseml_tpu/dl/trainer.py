@@ -26,7 +26,16 @@ from flax.core import unfreeze
 from flax import traverse_util
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core.checkpoint import (CheckpointStore, NonFiniteGuard,
+                               NonFiniteLossError, preemption_point)
+from ..core.logging import record_failure
 from ..parallel.mesh import DATA_AXIS
+
+# Batch-corruption hook for the chaos suite (testing/chaos.py installs it):
+# called as hook(step, xb, yb) -> (xb, yb) on HOST batches before they are
+# sharded, so an injected NaN reaches the loss exactly like bad input data
+# would. Same global-hook pattern as parallel.collectives._CHAOS_HOOK.
+_CHAOS_BATCH_HOOK = None
 
 
 @dataclasses.dataclass
@@ -45,10 +54,18 @@ class TrainConfig:
     shuffle: bool = True
     steps_per_epoch: Optional[int] = None
     # mid-training checkpoint/resume (reference: Lightning/Horovod `store`
-    # checkpoint dir + run-id resume, DeepVisionClassifier.py:86; SURVEY §5.4)
+    # checkpoint dir + run-id resume, DeepVisionClassifier.py:86; SURVEY §5.4).
+    # Checkpoints go through core/checkpoint.CheckpointStore: atomic writes,
+    # a CRC32/SHA-256 manifest verified on load, keep-last-N retention, and
+    # automatic fallback to the previous good snapshot on corruption.
     checkpoint_dir: Optional[str] = None
     save_every_epochs: int = 1
     resume: bool = True  # pick up from the latest checkpoint when present
+    keep_checkpoints: int = 3  # retention: newest N epoch snapshots kept
+    # policy on a non-finite training loss (core/checkpoint.NonFiniteGuard):
+    # "raise" stops the run, "skip" drops the poisoned step, "rollback"
+    # restores the last good checkpoint (requires checkpoint_dir)
+    nonfinite_policy: str = "raise"
     # parameter placement over the mesh: "replicated" (plain data-parallel)
     # or "fsdp" (ZeRO-3-style — each param's largest divisible axis is
     # sharded over the data axis; XLA all-gathers at use and reduce-scatters
@@ -281,13 +298,15 @@ class FlaxTrainer:
             return params, new_bs, opt_state, loss, acc
 
         params, batch_stats = self.params, self.batch_stats
-        rng = np.random.default_rng(cfg.seed)
         history = []
         step_idx = 0
         start_epoch = 0
-        if cfg.checkpoint_dir and cfg.resume:
-            restored = _restore_checkpoint(cfg.checkpoint_dir, params,
-                                           batch_stats, opt_state)
+        store = (CheckpointStore(cfg.checkpoint_dir,
+                                 keep_last=max(cfg.keep_checkpoints, 1))
+                 if cfg.checkpoint_dir else None)
+        if store is not None and cfg.resume:
+            restored = _restore_checkpoint(store, params, batch_stats,
+                                           opt_state)
             if restored is not None:
                 params, batch_stats, opt_state, start_epoch = restored
                 step_idx = start_epoch * steps_per_epoch
@@ -295,23 +314,68 @@ class FlaxTrainer:
                     # restored leaves are host numpy: re-apply the shardings
                     params = self._apply_fsdp(params)
                     opt_state = self._apply_fsdp(opt_state)
-        for epoch in range(start_epoch, cfg.max_epochs):
+        guard = NonFiniteGuard(policy=cfg.nonfinite_policy,
+                               counter_prefix="train")
+
+        def batches_with_chaos(rng_e, base_step):
+            for i, (xb, yb) in enumerate(self._batches(X, y, rng_e)):
+                hook = _CHAOS_BATCH_HOOK
+                if hook is not None:
+                    xb, yb = hook(base_step + i, xb, yb)
+                yield xb, yb
+
+        epoch = start_epoch
+        while epoch < cfg.max_epochs:
+            preemption_point("dl.epoch", epoch)
+            # shuffle order derives from (seed, epoch), NOT a Generator
+            # advanced across epochs: a resumed run replays epoch e with the
+            # exact batch order of the uninterrupted run
+            rng_e = np.random.default_rng([cfg.seed, epoch])
             losses = []
-            for xb, yb in self._prefetch(self._batches(X, y, rng)):
+            rolled_back = False
+            for xb, yb in self._prefetch(
+                    batches_with_chaos(rng_e, epoch * steps_per_epoch)):
+                prev = (params, batch_stats, opt_state)
                 params, batch_stats, opt_state, loss, acc = train_step(
                     params, batch_stats, opt_state, xb, yb, step_idx)
+                action = guard.check(float(loss), step_idx)
+                if action == "skip":
+                    # drop the poisoned update; the step index still advances
+                    # so the dropout stream stays aligned with the data order
+                    params, batch_stats, opt_state = prev
+                    step_idx += 1
+                    continue
+                if action == "rollback":
+                    restored = (_restore_checkpoint(store, *prev)
+                                if store is not None else None)
+                    if restored is None:
+                        raise NonFiniteLossError(
+                            "nonfinite_policy='rollback' found no checkpoint "
+                            "to restore (set checkpoint_dir and let at least "
+                            "one epoch complete, or use policy 'skip'/'raise')")
+                    params, batch_stats, opt_state, epoch = restored
+                    if cfg.param_sharding == "fsdp":
+                        params = self._apply_fsdp(params)
+                        opt_state = self._apply_fsdp(opt_state)
+                    step_idx = epoch * steps_per_epoch
+                    rolled_back = True
+                    break
                 step_idx += 1
-                losses.append(loss)
-            ep = {"epoch": epoch, "loss": float(np.mean([float(l) for l in losses]))}
+                losses.append(float(loss))
+            if rolled_back:
+                continue
+            ep = {"epoch": epoch,
+                  "loss": float(np.mean(losses)) if losses else float("nan")}
             if valid is not None:
                 ep["val_acc"] = float(self.evaluate(valid[0], valid[1],
                                                     params=params, batch_stats=batch_stats))
             history.append(ep)
             if log_fn:
                 log_fn(ep)
-            if cfg.checkpoint_dir and (epoch + 1) % cfg.save_every_epochs == 0:
-                _save_checkpoint(cfg.checkpoint_dir, params, batch_stats,
-                                 opt_state, epoch + 1)
+            if store is not None and (epoch + 1) % cfg.save_every_epochs == 0:
+                _save_checkpoint(store, params, batch_stats, opt_state,
+                                 epoch + 1)
+            epoch += 1
         self.params, self.batch_stats = params, batch_stats
         self.history = history
         return self
@@ -365,47 +429,62 @@ class FlaxTrainer:
         return -float(np.mean((logits.squeeze(-1) - np.asarray(y)) ** 2))
 
 
-def _save_checkpoint(ckpt_dir: str, params, batch_stats, opt_state,
+def _save_checkpoint(store: CheckpointStore, params, batch_stats, opt_state,
                      epoch: int) -> None:
-    """Atomic epoch checkpoint (params + optimizer + batch stats) via flax
-    msgpack — the Lightning-checkpoint analog; `latest` names the newest."""
-    import os
-
+    """Epoch checkpoint (params + optimizer + batch stats) as one flax
+    msgpack artifact in the CheckpointStore — atomic write, digest manifest,
+    keep-last-N retention (the Lightning-checkpoint analog, hardened)."""
     from flax.serialization import to_bytes
 
-    os.makedirs(ckpt_dir, exist_ok=True)
     blob = to_bytes({"params": params, "batch_stats": batch_stats or {},
                      "opt_state": opt_state, "epoch": epoch})
-    path = os.path.join(ckpt_dir, f"ckpt_{epoch:05d}.msgpack")
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-    os.replace(tmp, path)
-    with open(os.path.join(ckpt_dir, "latest.tmp"), "w") as f:
-        f.write(os.path.basename(path))
-    os.replace(os.path.join(ckpt_dir, "latest.tmp"),
-               os.path.join(ckpt_dir, "latest"))
+    store.save(epoch, {"state.msgpack": blob}, meta={"kind": "dl-trainer",
+                                                     "epoch": int(epoch)})
 
 
-def _restore_checkpoint(ckpt_dir: str, params, batch_stats, opt_state):
-    """(params, batch_stats, opt_state, next_epoch) from the latest
-    checkpoint, or None when the dir holds none."""
-    import os
-
+def _restore_checkpoint(store: CheckpointStore, params, batch_stats,
+                        opt_state):
+    """(params, batch_stats, opt_state, next_epoch) from the newest VERIFIED
+    checkpoint, or None when the dir holds no usable one (missing, torn, or
+    corrupt snapshots are counted and skipped by the store). A checkpoint
+    whose pytree no longer matches the model raises a ValueError naming the
+    fix instead of returning garbage params."""
     from flax.serialization import from_bytes
 
-    latest = os.path.join(ckpt_dir, "latest")
-    if not os.path.exists(latest):
+    ckpt = store.load_latest()
+    if ckpt is None:
         return None
-    with open(latest) as f:
-        name = f.read().strip()
-    path = os.path.join(ckpt_dir, name)
-    if not os.path.exists(path):
-        return None
+    blob_bytes = ckpt.artifacts.get("state.msgpack")
+    if blob_bytes is None:
+        record_failure("checkpoint.pytree_mismatch", base=ckpt.base,
+                       reason="missing state.msgpack artifact")
+        raise ValueError(
+            f"checkpoint {ckpt.base} in {store.dir} has no trainer state "
+            "artifact — it was written by something else; point "
+            "checkpoint_dir at a fresh directory")
     template = {"params": params, "batch_stats": batch_stats or {},
                 "opt_state": opt_state, "epoch": 0}
-    with open(path, "rb") as f:
-        blob = from_bytes(template, f.read())
+    try:
+        blob = from_bytes(template, blob_bytes)
+        # from_bytes matches names, not shapes: a head that changed width
+        # restores "successfully" with wrong-shaped arrays. Compare leaf
+        # shapes explicitly so the failure is loud and immediate.
+        import jax
+
+        for cur, new in zip(jax.tree_util.tree_leaves(template["params"]),
+                            jax.tree_util.tree_leaves(blob["params"])):
+            if getattr(cur, "shape", None) != getattr(new, "shape", None):
+                raise ValueError(
+                    f"parameter shape {getattr(new, 'shape', None)} in "
+                    f"checkpoint != model shape {getattr(cur, 'shape', None)}")
+    except Exception as e:
+        record_failure("checkpoint.pytree_mismatch", base=ckpt.base,
+                       error=str(e)[:200])
+        raise ValueError(
+            f"checkpoint {ckpt.base} in {store.dir} does not match the "
+            "current model/optimizer structure (architecture or optimizer "
+            f"changed since it was saved): {e}. Delete the checkpoint "
+            "directory or set resume=False to train from scratch") from e
     return (blob["params"], blob["batch_stats"] or None, blob["opt_state"],
             int(blob["epoch"]))
 

@@ -788,12 +788,30 @@ def train_booster(
     mapper: Optional[BinMapper] = None,       # pre-computed reference dataset analog
     mesh=None,                                # jax.sharding.Mesh: shard rows over DATA_AXIS
     measures=None,                            # InstrumentationMeasures (§5.1)
+    checkpoint_store=None,                    # CheckpointStore or directory path
+    checkpoint_every: int = 0,                # snapshot every K iterations (0 = default 10)
+    resume: bool = True,                      # continue from the newest matching snapshot
 ) -> Booster:
     from ..core.logging import InstrumentationMeasures
 
     if measures is None:
         measures = InstrumentationMeasures()
     cfg = config
+    # --- crash-safe snapshots (core/checkpoint.py): periodic forest + loop
+    # state, resumable bit-for-bit because all per-iteration sampling is
+    # stateless fold_in(seed, it) and the carried score is saved exactly
+    ckpt_store = checkpoint_store
+    if isinstance(ckpt_store, str):
+        from ..core.checkpoint import CheckpointStore
+
+        ckpt_store = CheckpointStore(ckpt_store)
+    if ckpt_store is not None and checkpoint_every <= 0:
+        checkpoint_every = 10
+    if ckpt_store is not None and mesh is not None and jax.process_count() > 1:
+        # multi-process snapshots need a global-array gather protocol; until
+        # then fail loudly rather than silently training without protection
+        raise NotImplementedError(
+            "checkpoint_store is not supported for multi-process training yet")
     if _is_sparse(X):
         if mesh is not None or init_model is not None:
             # these paths need raw dense rows anyway (padding / rescoring) and
@@ -1310,8 +1328,28 @@ def train_booster(
         carry = (score, in_bag_cur, score_v0)
         mvals_list = []
         done = 0
+        if ckpt_store is not None:
+            from ..core.checkpoint import preemption_point
+
+            # snapshot boundaries must fall on chunk boundaries (the carry is
+            # only exact between scan invocations)
+            chunk = min(chunk, max(1, checkpoint_every))
+            fingerprint = _train_fingerprint(cfg, n, nfeat, y, n_init_trees)
+            state = _ckpt_load_gbdt(ckpt_store, fingerprint, "fused") \
+                if resume else None
+            if state is not None:
+                done = int(state["iteration"])
+                trees = list(state["trees"])
+                tree_weights = list(state["tree_weights"])
+                mvals_list = [np.asarray(m) for m in state["mvals"]]
+                carry = tuple(jnp.asarray(a) for a in state["carry"])
+                if mesh is not None:
+                    carry = (jax.device_put(carry[0], row2),
+                             jax.device_put(carry[1], row1), carry[2])
         with measures.span("trainingIterations"):
             while done < T:
+                if ckpt_store is not None:
+                    preemption_point("gbdt.chunk", done)
                 c = min(chunk, T - done)
                 carry, (stacked_trees, mv) = run_scan(
                     binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins,
@@ -1325,14 +1363,24 @@ def train_booster(
                                                   stacked_trees))
                         tree_weights.append(1.0)
                 done += c
+                stop = False
                 if has_valid:
                     mvals_list.append(np.asarray(mv))
                     if cfg.early_stopping_round > 0:
                         series = np.concatenate(mvals_list)
                         series = series if higher_better else -series
                         b = _best_so_far(series, cfg.improvement_tolerance)
-                        if done - 1 - int(b[-1]) >= cfg.early_stopping_round:
-                            break
+                        stop = (done - 1 - int(b[-1])
+                                >= cfg.early_stopping_round)
+                if ckpt_store is not None and (done >= T or not stop):
+                    _ckpt_save_gbdt(
+                        ckpt_store, done,
+                        {"iteration": done, "trees": trees,
+                         "tree_weights": tree_weights, "mvals": mvals_list,
+                         "carry": jax.device_get(carry)},
+                        fingerprint, "fused", measures)
+                if stop:
+                    break
         score = carry[0]
         measures.count("iterations", done)
 
@@ -1366,7 +1414,34 @@ def train_booster(
     wv_dev = None
     if has_valid and len(valid) > 2 and valid[2] is not None:
         wv_dev = jnp.asarray(np.asarray(valid[2], np.float32))
-    for it in range(cfg.num_iterations):
+    start_it = 0
+    if ckpt_store is not None:
+        from ..core.checkpoint import preemption_point
+
+        fingerprint = _train_fingerprint(cfg, n, nfeat, y, n_init_trees)
+        state = _ckpt_load_gbdt(ckpt_store, fingerprint, "host") \
+            if resume else None
+        if state is not None:
+            start_it = int(state["iteration"])
+            trees = list(state["trees"])
+            tree_weights = list(state["tree_weights"])
+            tree_contribs = list(state["tree_contribs"])
+            score = jnp.asarray(state["score"])
+            in_bag_cur = jnp.asarray(state["in_bag_cur"])
+            if mesh is not None:
+                score = jax.device_put(score, row2)
+                in_bag_cur = jax.device_put(in_bag_cur, row1)
+            # dart's drop decisions come from this stateful host Generator;
+            # restoring it is what makes the resumed drop sequence identical
+            rng = state["rng"]
+            if has_valid:
+                score_v = jnp.asarray(state["score_v"])
+                valid_contribs = list(state["valid_contribs"])
+                best_metric = state["best_metric"]
+                best_iter = int(state["best_iter"])
+    for it in range(start_it, cfg.num_iterations):
+        if ckpt_store is not None:
+            preemption_point("gbdt.iteration", it)
         # ---- dart: drop trees and de-weight the score -------------------
         if dart_mode and trees:
             nt = len(trees)
@@ -1533,6 +1608,27 @@ def train_booster(
             for cb in callbacks:
                 cb(it, trees)
 
+        if ckpt_store is not None and (it + 1) % checkpoint_every == 0:
+            payload = {
+                "iteration": it + 1,
+                "trees": jax.device_get(trees),
+                "tree_weights": list(tree_weights),
+                "tree_contribs": [(c, np.asarray(jax.device_get(v)))
+                                  for c, v in tree_contribs],
+                "score": np.asarray(jax.device_get(score)),
+                "in_bag_cur": np.asarray(jax.device_get(in_bag_cur)),
+                "rng": rng,
+            }
+            if has_valid:
+                payload["score_v"] = np.asarray(jax.device_get(score_v))
+                payload["valid_contribs"] = [
+                    (c, np.asarray(jax.device_get(v)))
+                    for c, v in valid_contribs]
+                payload["best_metric"] = best_metric
+                payload["best_iter"] = best_iter
+            _ckpt_save_gbdt(ckpt_store, it + 1, payload, fingerprint, "host",
+                            measures)
+
     # single batched device→host transfer of the whole forest (the per-tree
     # pulls were VERDICT weak #7)
     trees = jax.device_get(trees)
@@ -1552,6 +1648,48 @@ def train_booster(
                                    if has_valid else -1),
                    thresholds=merged_thr, missing_types=merged_mt,
                    best_score=(best_metric if has_valid else None))
+
+
+def _train_fingerprint(cfg, n, nfeat, y, n_init_trees) -> str:
+    """Identity of a training run for resume-compatibility: config + data
+    shape + label digest + warm-start length. A snapshot whose fingerprint
+    differs belongs to a DIFFERENT run and must not be resumed from."""
+    import hashlib
+    import zlib
+
+    h = hashlib.sha256()
+    h.update(repr(sorted(dataclasses.asdict(cfg).items())).encode())
+    h.update(repr((int(n), int(nfeat), int(n_init_trees),
+                   zlib.crc32(np.ascontiguousarray(
+                       np.asarray(y, np.float32)).tobytes()))).encode())
+    return h.hexdigest()
+
+
+def _ckpt_save_gbdt(store, iteration, payload, fingerprint, path, measures):
+    import pickle
+
+    with measures.span("checkpointSave"):
+        store.save(int(iteration),
+                   {"state.pkl": pickle.dumps(payload, protocol=4)},
+                   meta={"kind": "gbdt", "path": path,
+                         "fingerprint": fingerprint})
+
+
+def _ckpt_load_gbdt(store, fingerprint, path):
+    """Newest verified snapshot matching this run, or None (fresh start)."""
+    import pickle
+
+    from ..core.logging import record_failure
+
+    ckpt = store.load_latest()
+    if ckpt is None:
+        return None
+    if (ckpt.meta.get("kind") != "gbdt" or ckpt.meta.get("path") != path
+            or ckpt.meta.get("fingerprint") != fingerprint):
+        record_failure("checkpoint.fingerprint_mismatch", base=ckpt.base,
+                       ckpt_kind=ckpt.meta.get("kind"))
+        return None
+    return pickle.loads(ckpt.artifacts["state.pkl"])
 
 
 def _best_so_far(series: np.ndarray, tol: float = 0.0) -> np.ndarray:

@@ -221,6 +221,17 @@ def _tree_to_string(index: int, tree: TreeArrays, thresholds: np.ndarray,
 # Parsing (load models produced by us or by native LightGBM)
 # ---------------------------------------------------------------------------
 
+def _hdr_int(hdr, name, default):
+    """Header integer with a clear diagnosis on garbage (a torn download or
+    binary splice lands here, not in an int() traceback)."""
+    try:
+        return int(hdr.get(name, default))
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"corrupt LightGBM model string: header field {name!r} is not "
+            f"an integer (got {hdr.get(name)!r})") from None
+
+
 def booster_from_string(s: str):
     from .boosting import Booster, BoosterConfig
 
@@ -229,17 +240,23 @@ def booster_from_string(s: str):
     header, _, rest = s.partition("\nTree=")
     if not rest:
         raise ValueError("model string contains no trees")
+    if "end of trees" not in rest:
+        # every writer (ours and native LightGBM's) terminates the tree
+        # section; its absence means the file was truncated mid-stream
+        raise ValueError(
+            "truncated LightGBM model string: missing 'end of trees' "
+            "terminator — the file was cut off mid-write or mid-download")
     hdr = {}
     for line in header.splitlines():
         if "=" in line:
             key, _, val = line.partition("=")
             hdr[key.strip()] = val.strip()
-    num_class = int(hdr.get("num_class", 1))
-    ntpi = int(hdr.get("num_tree_per_iteration", 1))
+    num_class = _hdr_int(hdr, "num_class", 1)
+    ntpi = _hdr_int(hdr, "num_tree_per_iteration", 1)
     obj_str = hdr.get("objective", "regression").split()
     objective = obj_str[0] if obj_str else "regression"
     feature_names = hdr.get("feature_names", "").split()
-    nfeat = int(hdr.get("max_feature_idx", len(feature_names) - 1)) + 1
+    nfeat = _hdr_int(hdr, "max_feature_idx", len(feature_names) - 1) + 1
     average_output = "average_output" in header
 
     cfg = BoosterConfig(objective=objective, num_class=num_class,
@@ -272,7 +289,25 @@ def booster_from_string(s: str):
                 key, _, val = line.partition("=")
                 fields[key.strip()] = val.strip()
         parsed.append(fields)
-        max_leaves = max(max_leaves, int(fields.get("num_leaves", 1)))
+        try:
+            nl = int(fields.get("num_leaves", 1))
+        except ValueError:
+            raise ValueError(
+                f"corrupt LightGBM model string: tree {len(parsed) - 1} has "
+                f"non-integer num_leaves={fields.get('num_leaves')!r}") \
+                from None
+        # a split tree with no structure arrays is a torn tree block, not a
+        # model (single-leaf trees legitimately carry only leaf_value)
+        if nl > 1:
+            missing = [f for f in ("split_feature", "threshold", "left_child",
+                                   "right_child", "leaf_value")
+                       if not fields.get(f)]
+            if missing:
+                raise ValueError(
+                    f"corrupt/truncated LightGBM model string: tree "
+                    f"{len(parsed) - 1} declares num_leaves={nl} but lacks "
+                    f"required fields {missing}")
+        max_leaves = max(max_leaves, nl)
 
     # bitset width: wide enough for the largest categorical node in the model
     # (native LightGBM models can exceed 256 categories)
@@ -283,14 +318,20 @@ def booster_from_string(s: str):
             if len(bounds) > 1:
                 bw = max(bw, int(np.diff(bounds).max()))
     mtypes_all = []
-    for fields in parsed:
+    for tree_idx, fields in enumerate(parsed):
         nleaves = int(fields.get("num_leaves", 1))
         ns = nleaves - 1
         L = max_leaves
 
         def arr(name, dtype, size, default=0):
             if name in fields and fields[name]:
-                a = np.array(fields[name].split(), dtype=np.float64)
+                try:
+                    a = np.array(fields[name].split(), dtype=np.float64)
+                except ValueError:
+                    raise ValueError(
+                        f"corrupt LightGBM model string: tree {tree_idx} "
+                        f"field {name!r} contains non-numeric data "
+                        f"({fields[name][:60]!r})") from None
             else:
                 a = np.full(size, default, np.float64)
             out = np.full(max(size, 1), default, np.float64)
@@ -315,11 +356,24 @@ def booster_from_string(s: str):
 
         bitset = np.zeros((max(L - 1, 1), bw), np.uint32)
         if int(fields.get("num_cat", 0)) > 0:
-            bounds = np.array(fields["cat_boundaries"].split(), dtype=np.int64)
-            words = np.array(fields["cat_threshold"].split(), dtype=np.uint64)
+            try:
+                bounds = np.array(fields["cat_boundaries"].split(),
+                                  dtype=np.int64)
+                words = np.array(fields["cat_threshold"].split(),
+                                 dtype=np.uint64)
+            except (KeyError, ValueError):
+                raise ValueError(
+                    f"corrupt LightGBM model string: tree {tree_idx} "
+                    "declares num_cat>0 but its cat_boundaries/"
+                    "cat_threshold are missing or non-numeric") from None
             ci = 0
             for i in range(ns):
                 if stype[i]:
+                    if ci + 1 >= len(bounds):
+                        raise ValueError(
+                            f"corrupt LightGBM model string: tree "
+                            f"{tree_idx} has more categorical nodes than "
+                            "cat_boundaries entries")
                     w = words[bounds[ci]: bounds[ci + 1]]
                     bitset[i, : len(w)] = w.astype(np.uint32)
                     ci += 1

@@ -12,13 +12,15 @@ from .fuzzing import (TestObject, discover_stage_classes,
                       experiment_fuzz, getter_setter_fuzz,
                       serialization_fuzz)
 from .benchmarks import Benchmarks
-from .chaos import (ChaosHTTP, ChaosSchedule, FaultInjected,
-                    FlakyHTTPServer, canned_json_responder,
-                    chaos_collectives, chaotic_handler)
+from .chaos import (ChaosHTTP, ChaosPreemption, ChaosSchedule, FaultInjected,
+                    FlakyHTTPServer, bit_flip, canned_json_responder,
+                    chaos_collectives, chaos_nan_batches, chaotic_handler,
+                    torn_write)
 
 __all__ = [
     "TestObject", "discover_stage_classes", "experiment_fuzz",
     "getter_setter_fuzz", "serialization_fuzz", "Benchmarks",
-    "ChaosHTTP", "ChaosSchedule", "FaultInjected", "FlakyHTTPServer",
-    "canned_json_responder", "chaos_collectives", "chaotic_handler",
+    "ChaosHTTP", "ChaosPreemption", "ChaosSchedule", "FaultInjected",
+    "FlakyHTTPServer", "bit_flip", "canned_json_responder",
+    "chaos_collectives", "chaos_nan_batches", "chaotic_handler", "torn_write",
 ]

@@ -374,3 +374,163 @@ def canned_json_responder(obj) -> Callable:
         return 200, body
 
     return responder
+
+
+# ---------------------------------------------------------------------------
+# Training-path chaos: preemption kills, checkpoint corruptors, NaN batches
+# (tests/test_checkpoint_recovery.py drives all of it on CPU)
+# ---------------------------------------------------------------------------
+
+class ChaosPreemption:
+    """Context manager killing a training loop at its
+    :func:`~synapseml_tpu.core.checkpoint.preemption_point` boundaries —
+    the deterministic stand-in for a TPU-pod preemption (SIGTERM mid-step).
+
+    Kill triggers, combinable:
+
+    * ``at`` — mapping of phase name (or phase prefix ending in ``.``) to a
+      set of step indices; the FIRST matching call raises
+      :class:`~synapseml_tpu.core.checkpoint.PreemptionError`. Each entry
+      fires once (a resumed run re-visits the same step and must survive).
+    * ``kill_rate`` — seeded probability of dying at any boundary.
+    * ``max_kills`` — stop injecting after this many kills (default 1).
+
+    ``calls`` records every boundary visited, ``kills`` every injected
+    death. PreemptionError derives from BaseException, so no library
+    except-Exception handler can swallow the kill. Nesting is not supported
+    (single global hook)."""
+
+    def __init__(self, at: Optional[dict] = None, kill_rate: float = 0.0,
+                 seed: int = 0, max_kills: int = 1):
+        self.at = {k: set(v) for k, v in (at or {}).items()}
+        self.kill_rate = kill_rate
+        self.rng = random.Random(seed)
+        self.max_kills = max_kills
+        self.calls: List[Tuple[str, int]] = []
+        self.kills: List[Tuple[str, int]] = []
+        self._lock = threading.Lock()
+
+    def _hook(self, phase: str, step: int) -> None:
+        from ..core.checkpoint import PreemptionError
+        from ..core.logging import record_failure
+
+        with self._lock:
+            self.calls.append((phase, step))
+            if len(self.kills) >= self.max_kills:
+                return
+            die = False
+            for pat, steps in self.at.items():
+                if (phase == pat or (pat.endswith(".")
+                                     and phase.startswith(pat))) \
+                        and step in steps:
+                    steps.discard(step)   # one-shot: resume survives this step
+                    die = True
+                    break
+            if not die and self.kill_rate and \
+                    self.rng.random() < self.kill_rate:
+                die = True
+            if not die:
+                return
+            self.kills.append((phase, step))
+        record_failure("chaos.preemption", phase=phase, step=int(step))
+        raise PreemptionError(f"chaos: preempted at {phase}[{step}]")
+
+    def __enter__(self) -> "ChaosPreemption":
+        from ..core import checkpoint as _ck
+
+        if _ck._PREEMPT_HOOK is not None:
+            raise RuntimeError("ChaosPreemption does not nest")
+        _ck._PREEMPT_HOOK = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from ..core import checkpoint as _ck
+
+        _ck._PREEMPT_HOOK = None
+
+
+class chaos_nan_batches:
+    """Context manager poisoning DL training batches with NaN at the given
+    step indices (one-shot per step, so a post-rollback replay proceeds) —
+    installs ``dl.trainer._CHAOS_BATCH_HOOK``. The poisoned input makes the
+    LOSS genuinely non-finite, exercising the NonFiniteGuard end to end
+    rather than faking a NaN loss value."""
+
+    def __init__(self, at_steps: Sequence[int]):
+        self.at_steps = set(int(s) for s in at_steps)
+        self.poisoned: List[int] = []
+        self._lock = threading.Lock()
+
+    def _hook(self, step, xb, yb):
+        with self._lock:
+            if step not in self.at_steps:
+                return xb, yb
+            self.at_steps.discard(step)
+            self.poisoned.append(int(step))
+        import numpy as _np
+
+        xb = _np.asarray(xb, _np.float32).copy()
+        xb[0] = _np.nan
+        return xb, yb
+
+    def __enter__(self) -> "chaos_nan_batches":
+        from ..dl import trainer as _t
+
+        if _t._CHAOS_BATCH_HOOK is not None:
+            raise RuntimeError("chaos_nan_batches does not nest")
+        _t._CHAOS_BATCH_HOOK = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from ..dl import trainer as _t
+
+        _t._CHAOS_BATCH_HOOK = None
+
+
+def _newest_checkpoint_artifacts(ckpt_dir: str) -> List[str]:
+    """Artifact files (not the manifest) of the newest checkpoint in a
+    CheckpointStore directory."""
+    import os
+
+    from ..core.checkpoint import MANIFEST_SUFFIX
+
+    manifests = sorted(f for f in os.listdir(ckpt_dir)
+                       if f.endswith(MANIFEST_SUFFIX))
+    if not manifests:
+        raise FileNotFoundError(f"no checkpoint manifests in {ckpt_dir}")
+    base = manifests[-1][: -len(MANIFEST_SUFFIX)]
+    return [os.path.join(ckpt_dir, f) for f in os.listdir(ckpt_dir)
+            if f.startswith(base + ".") and not f.endswith(MANIFEST_SUFFIX)]
+
+
+def torn_write(ckpt_dir: str, keep_bytes: int = 7) -> str:
+    """Corrupt the NEWEST checkpoint like an interrupted write: truncate its
+    artifact to ``keep_bytes`` bytes, leaving the manifest in place. The
+    store must detect the size/digest mismatch and fall back. Returns the
+    truncated file's path."""
+    import os
+
+    path = _newest_checkpoint_artifacts(ckpt_dir)[0]
+    size = os.path.getsize(path)
+    keep = min(max(keep_bytes, 0), max(size - 1, 0))   # always lose >=1 byte
+    with open(path, "rb") as f:
+        head = f.read(keep)
+    with open(path, "wb") as f:
+        f.write(head)
+    return path
+
+
+def bit_flip(ckpt_dir: str, offset: Optional[int] = None, bit: int = 3) -> str:
+    """Corrupt the NEWEST checkpoint like storage bit rot: flip one bit in
+    its artifact (middle byte by default). Size is unchanged, so only the
+    CRC/SHA digests can catch it. Returns the flipped file's path."""
+    path = _newest_checkpoint_artifacts(ckpt_dir)[0]
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if not data:
+        raise ValueError(f"cannot bit-flip empty file {path}")
+    i = len(data) // 2 if offset is None else offset
+    data[i] ^= 1 << (bit & 7)
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    return path

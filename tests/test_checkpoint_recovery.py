@@ -1,0 +1,635 @@
+"""Preemption-tolerant training: crash-safe checkpoint/recovery suite.
+
+CPU-deterministic proof of the training failure model (docs/resilience.md):
+
+* CheckpointStore invariants — atomic writes, digest manifest, keep-last-N
+  retention, corruption detection with fallback to the previous good step.
+* Kill-at-any-step → resume == uninterrupted run, bit for bit, for the gbdt
+  fused path, the gbdt host loop (dart), and the DL trainer.
+* NonFiniteGuard policies (raise | skip | rollback) fired by genuinely
+  NaN-poisoned batches, with structured failure counters.
+* Interrupted hyperparameter search resumes without re-running completed
+  candidates; a crashing candidate no longer aborts the search.
+* Model-string loader rejects truncated/garbage input with clear ValueError.
+
+Everything is seeded; no test reads the wall clock or the network.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.checkpoint import (CheckpointError, CheckpointStore,
+                                           NonFiniteGuard, NonFiniteLossError,
+                                           PreemptionError, preemption_point)
+from synapseml_tpu.core.logging import failure_counts, reset_failure_counts
+from synapseml_tpu.testing import (ChaosPreemption, bit_flip,
+                                   chaos_nan_batches, torn_write)
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    reset_failure_counts()
+    yield
+    reset_failure_counts()
+
+
+# ---------------------------------------------------------------------------
+# CheckpointStore unit behavior
+# ---------------------------------------------------------------------------
+
+class TestCheckpointStore:
+    def test_roundtrip_and_latest(self, tmp_path):
+        s = CheckpointStore(str(tmp_path), keep_last=3)
+        s.save(1, {"a.bin": b"one"}, meta={"k": 1})
+        s.save(2, {"a.bin": b"two", "b.bin": b"extra"}, meta={"k": 2})
+        c = s.load_latest()
+        assert c.step == 2 and c.meta == {"k": 2}
+        assert c.artifacts == {"a.bin": b"two", "b.bin": b"extra"}
+        assert s.load_step(1).artifacts["a.bin"] == b"one"
+        assert s.steps() == [1, 2]
+
+    def test_retention_prunes_oldest(self, tmp_path):
+        s = CheckpointStore(str(tmp_path), keep_last=2)
+        for i in range(1, 5):
+            s.save(i, {"a.bin": bytes([i]) * 8})
+        assert s.steps() == [3, 4]
+        # pruned artifact files are gone, not just their manifests
+        leftover = [f for f in os.listdir(tmp_path)
+                    if f.startswith(("ckpt_00000001", "ckpt_00000002"))]
+        assert leftover == []
+
+    def test_empty_dir_and_missing_dir(self, tmp_path):
+        assert CheckpointStore(str(tmp_path / "nope")).load_latest() is None
+        assert CheckpointStore(str(tmp_path)).load_latest() is None
+        assert CheckpointStore(str(tmp_path)).steps() == []
+
+    def test_torn_write_falls_back_to_previous_good(self, tmp_path):
+        s = CheckpointStore(str(tmp_path), keep_last=3)
+        s.save(1, {"a.bin": b"good checkpoint one"})
+        s.save(2, {"a.bin": b"good checkpoint two"})
+        torn_write(str(tmp_path))
+        c = s.load_latest()
+        assert c.step == 1 and c.artifacts["a.bin"] == b"good checkpoint one"
+        fc = failure_counts()
+        assert fc.get("checkpoint.corrupt", 0) >= 1
+        assert fc.get("checkpoint.fallback", 0) >= 1
+
+    def test_bit_flip_detected_by_digest(self, tmp_path):
+        s = CheckpointStore(str(tmp_path), keep_last=3)
+        s.save(1, {"a.bin": b"good checkpoint one"})
+        s.save(2, {"a.bin": b"good checkpoint two"})
+        bit_flip(str(tmp_path))           # same size — only digests catch it
+        c = s.load_latest()
+        assert c.step == 1
+        assert failure_counts().get("checkpoint.corrupt", 0) >= 1
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        s = CheckpointStore(str(tmp_path), keep_last=3)
+        s.save(1, {"a.bin": b"only checkpoint here"})
+        bit_flip(str(tmp_path))
+        assert s.load_latest() is None
+
+    def test_latest_pointing_at_missing_step(self, tmp_path):
+        s = CheckpointStore(str(tmp_path), keep_last=3)
+        s.save(1, {"a.bin": b"real checkpoint data"})
+        with open(tmp_path / "latest", "w") as f:
+            f.write("ckpt_00000099")
+        c = s.load_latest()               # dangling pointer → scan fallback
+        assert c.step == 1
+        assert failure_counts().get("checkpoint.corrupt", 0) >= 1
+
+    def test_zero_byte_artifact_detected(self, tmp_path):
+        s = CheckpointStore(str(tmp_path), keep_last=3)
+        s.save(1, {"a.bin": b"real checkpoint data"})
+        s.save(2, {"a.bin": b"the newest checkpoint"})
+        torn_write(str(tmp_path), keep_bytes=0)
+        assert s.load_latest().step == 1
+
+    def test_load_step_raises_on_corruption(self, tmp_path):
+        s = CheckpointStore(str(tmp_path))
+        s.save(1, {"a.bin": b"real checkpoint data"})
+        bit_flip(str(tmp_path))
+        with pytest.raises(CheckpointError, match="verification"):
+            s.load_step(1)
+
+    def test_bad_inputs_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="keep_last"):
+            CheckpointStore(str(tmp_path), keep_last=0)
+        s = CheckpointStore(str(tmp_path))
+        with pytest.raises(ValueError, match="artifact"):
+            s.save(1, {})
+        with pytest.raises(ValueError, match="artifact name"):
+            s.save(1, {"../evil": b"x"})
+
+
+class TestPreemptionPoint:
+    def test_noop_without_hook(self):
+        preemption_point("anything", 0)   # must not raise
+
+    def test_scheduled_kill_and_one_shot(self):
+        with ChaosPreemption(at={"phase.x": [1]}, max_kills=2) as cp:
+            preemption_point("phase.x", 0)
+            with pytest.raises(PreemptionError):
+                preemption_point("phase.x", 1)
+            preemption_point("phase.x", 1)   # one-shot: survives the re-visit
+        assert cp.kills == [("phase.x", 1)]
+        assert failure_counts().get("chaos.preemption") == 1
+        preemption_point("phase.x", 1)       # hook uninstalled on exit
+
+    def test_prefix_match_and_no_nesting(self):
+        with ChaosPreemption(at={"gbdt.": [3]}):
+            with pytest.raises(PreemptionError):
+                preemption_point("gbdt.iteration", 3)
+            with pytest.raises(RuntimeError, match="nest"):
+                with ChaosPreemption():
+                    pass
+
+    def test_preemption_error_is_base_exception(self):
+        # except-Exception recovery code must NOT swallow a kill
+        assert not issubclass(PreemptionError, Exception)
+        assert issubclass(PreemptionError, BaseException)
+
+
+class TestNonFiniteGuardUnit:
+    def test_policy_validation(self):
+        with pytest.raises(ValueError, match="policy"):
+            NonFiniteGuard(policy="ignore")
+
+    def test_skip_escalates_after_max_consecutive(self):
+        g = NonFiniteGuard(policy="skip", max_consecutive=2)
+        assert g.check(float("nan"), 0) == "skip"
+        assert g.check(float("inf"), 1) == "skip"
+        with pytest.raises(NonFiniteLossError, match="consecutive"):
+            g.check(float("nan"), 2)
+
+    def test_finite_resets_consecutive(self):
+        g = NonFiniteGuard(policy="skip", max_consecutive=1)
+        assert g.check(float("nan"), 0) == "skip"
+        assert g.check(0.5, 1) == "ok"
+        assert g.check(float("nan"), 2) == "skip"
+        assert g.total == 2
+
+    def test_rollback_caps(self):
+        g = NonFiniteGuard(policy="rollback", max_rollbacks=1)
+        assert g.check(float("nan"), 0) == "rollback"
+        with pytest.raises(NonFiniteLossError, match="rollback"):
+            g.check(float("nan"), 1)
+
+
+# ---------------------------------------------------------------------------
+# gbdt: kill → resume equivalence
+# ---------------------------------------------------------------------------
+
+def _binary_data(n=400, nfeat=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, nfeat)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+class TestGbdtRecovery:
+    def test_fused_kill_resume_bit_equal(self, tmp_path):
+        from synapseml_tpu.gbdt.boosting import BoosterConfig, train_booster
+
+        X, y = _binary_data()
+        mk = lambda: BoosterConfig(objective="binary", num_iterations=12,
+                                   num_leaves=8)
+        ref = train_booster(X, y, mk())
+        d = str(tmp_path / "ck")
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"gbdt.chunk": [6]}):
+                train_booster(X, y, mk(), checkpoint_store=d,
+                              checkpoint_every=3)
+        resumed = train_booster(X, y, mk(), checkpoint_store=d,
+                                checkpoint_every=3)
+        np.testing.assert_array_equal(ref.raw_score(X), resumed.raw_score(X))
+
+    def test_fused_corrupted_latest_falls_back_and_still_matches(
+            self, tmp_path):
+        from synapseml_tpu.gbdt.boosting import BoosterConfig, train_booster
+
+        X, y = _binary_data(seed=3)
+        mk = lambda: BoosterConfig(objective="binary", num_iterations=8,
+                                   num_leaves=8)
+        ref = train_booster(X, y, mk())
+        d = str(tmp_path / "ck")
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"gbdt.chunk": [6]}):
+                train_booster(X, y, mk(), checkpoint_store=d,
+                              checkpoint_every=2)
+        torn_write(d)      # the newest snapshot died mid-write
+        resumed = train_booster(X, y, mk(), checkpoint_store=d,
+                                checkpoint_every=2)
+        np.testing.assert_array_equal(ref.raw_score(X), resumed.raw_score(X))
+        assert failure_counts().get("checkpoint.fallback", 0) >= 1
+
+    def test_host_loop_dart_kill_resume_bit_equal(self, tmp_path):
+        # dart is the hardest resume case: its drop decisions come from a
+        # STATEFUL host Generator, which the snapshot must carry verbatim
+        from synapseml_tpu.gbdt.boosting import BoosterConfig, train_booster
+
+        X, y = _binary_data(seed=1)
+        mk = lambda: BoosterConfig(objective="binary", num_iterations=10,
+                                   num_leaves=8, boosting_type="dart",
+                                   drop_rate=0.5)
+        ref = train_booster(X, y, mk())
+        d = str(tmp_path / "ck")
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"gbdt.iteration": [7]}):
+                train_booster(X, y, mk(), checkpoint_store=d,
+                              checkpoint_every=2)
+        resumed = train_booster(X, y, mk(), checkpoint_store=d,
+                                checkpoint_every=2)
+        np.testing.assert_array_equal(ref.raw_score(X), resumed.raw_score(X))
+
+    def test_host_loop_valid_early_stop_state_resumes(self, tmp_path):
+        # fobj forces the host loop; validation/early-stop bookkeeping
+        # (best_metric/best_iter) must survive the kill
+        from synapseml_tpu.gbdt.boosting import BoosterConfig, train_booster
+        from synapseml_tpu.gbdt.objectives import get_objective
+
+        X, y = _binary_data(seed=2)
+        obj = get_objective("binary")
+        fobj = obj.grad_hess
+        mk = lambda: BoosterConfig(objective="binary", num_iterations=10,
+                                   num_leaves=8, early_stopping_round=8)
+        ref = train_booster(X, y, mk(), valid=(X, y), fobj=fobj)
+        d = str(tmp_path / "ck")
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"gbdt.iteration": [5]}):
+                train_booster(X, y, mk(), valid=(X, y), fobj=fobj,
+                              checkpoint_store=d, checkpoint_every=2)
+        resumed = train_booster(X, y, mk(), valid=(X, y), fobj=fobj,
+                                checkpoint_store=d, checkpoint_every=2)
+        np.testing.assert_array_equal(ref.raw_score(X), resumed.raw_score(X))
+        assert resumed.best_iteration == ref.best_iteration
+
+    def test_fingerprint_mismatch_starts_fresh(self, tmp_path):
+        from synapseml_tpu.gbdt.boosting import BoosterConfig, train_booster
+
+        X, y = _binary_data(seed=4)
+        d = str(tmp_path / "ck")
+        train_booster(X, y, BoosterConfig(objective="binary",
+                                          num_iterations=4, num_leaves=8),
+                      checkpoint_store=d, checkpoint_every=2)
+        # different config → the stale snapshot must be ignored, not resumed
+        cfg2 = BoosterConfig(objective="binary", num_iterations=6,
+                             num_leaves=4)
+        ref = train_booster(X, y, cfg2)
+        b = train_booster(X, y, BoosterConfig(objective="binary",
+                                              num_iterations=6, num_leaves=4),
+                          checkpoint_store=d, checkpoint_every=100)
+        np.testing.assert_array_equal(ref.raw_score(X), b.raw_score(X))
+        assert failure_counts().get("checkpoint.fingerprint_mismatch", 0) >= 1
+
+    def test_resume_false_ignores_snapshots(self, tmp_path):
+        from synapseml_tpu.gbdt.boosting import BoosterConfig, train_booster
+
+        X, y = _binary_data(seed=5)
+        mk = lambda: BoosterConfig(objective="binary", num_iterations=6,
+                                   num_leaves=8)
+        d = str(tmp_path / "ck")
+        ref = train_booster(X, y, mk())
+        train_booster(X, y, mk(), checkpoint_store=d, checkpoint_every=2)
+        b = train_booster(X, y, mk(), checkpoint_store=d, checkpoint_every=2,
+                          resume=False)
+        np.testing.assert_array_equal(ref.raw_score(X), b.raw_score(X))
+
+    @pytest.mark.slow
+    def test_fused_kill_any_chunk_boundary(self, tmp_path):
+        # sweep every snapshot boundary: kill there, resume, compare
+        from synapseml_tpu.gbdt.boosting import BoosterConfig, train_booster
+
+        X, y = _binary_data(n=200, seed=6)
+        mk = lambda: BoosterConfig(objective="binary", num_iterations=8,
+                                   num_leaves=4)
+        ref = train_booster(X, y, mk())
+        for kill_at in (2, 4, 6):
+            d = str(tmp_path / f"ck{kill_at}")
+            with pytest.raises(PreemptionError):
+                with ChaosPreemption(at={"gbdt.chunk": [kill_at]}):
+                    train_booster(X, y, mk(), checkpoint_store=d,
+                                  checkpoint_every=2)
+            resumed = train_booster(X, y, mk(), checkpoint_store=d,
+                                    checkpoint_every=2)
+            np.testing.assert_array_equal(ref.raw_score(X),
+                                          resumed.raw_score(X))
+
+
+# ---------------------------------------------------------------------------
+# DL trainer: kill → resume, restore edge cases, NonFiniteGuard end to end
+# ---------------------------------------------------------------------------
+
+def _dl_data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(size=(n, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 2, n).astype(np.float32)
+    return X, y
+
+
+def _trainer(**kw):
+    from synapseml_tpu.dl import FlaxTrainer, TrainConfig, make_backbone
+
+    cfg = TrainConfig(batch_size=16, seed=1, **kw)
+    return FlaxTrainer(make_backbone("tiny", 2), cfg)
+
+
+class TestDLRecovery:
+    def test_kill_resume_bit_equal(self, tmp_path):
+        X, y = _dl_data()
+        ref = _trainer(max_epochs=4).fit(X, y)
+        d = str(tmp_path / "ck")
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"dl.epoch": [2]}):
+                _trainer(max_epochs=4, checkpoint_dir=d).fit(X, y)
+        t = _trainer(max_epochs=4, checkpoint_dir=d).fit(X, y)
+        np.testing.assert_array_equal(ref.predict_logits(X),
+                                      t.predict_logits(X))
+        assert [h["epoch"] for h in t.history] == [2, 3]
+
+    def test_corrupted_latest_falls_back(self, tmp_path):
+        X, y = _dl_data(seed=1)
+        d = str(tmp_path / "ck")
+        _trainer(max_epochs=3, checkpoint_dir=d).fit(X, y)
+        torn_write(d)
+        t = _trainer(max_epochs=4, checkpoint_dir=d).fit(X, y)
+        # newest (epoch 3) snapshot is torn → resume from epoch 2's
+        assert [h["epoch"] for h in t.history] == [2, 3]
+        assert failure_counts().get("checkpoint.fallback", 0) >= 1
+        assert np.isfinite(t.predict_logits(X)).all()
+
+    def test_latest_pointing_at_missing_file_falls_back(self, tmp_path):
+        X, y = _dl_data(seed=2)
+        d = str(tmp_path / "ck")
+        _trainer(max_epochs=2, checkpoint_dir=d).fit(X, y)
+        with open(os.path.join(d, "latest"), "w") as f:
+            f.write("ckpt_00000042")
+        t = _trainer(max_epochs=3, checkpoint_dir=d).fit(X, y)
+        assert [h["epoch"] for h in t.history] == [2]
+
+    def test_zero_byte_checkpoint_falls_back_or_fresh(self, tmp_path):
+        X, y = _dl_data(seed=3)
+        d = str(tmp_path / "ck")
+        _trainer(max_epochs=1, checkpoint_dir=d, keep_checkpoints=1).fit(X, y)
+        torn_write(d, keep_bytes=0)       # only snapshot, zero bytes
+        t = _trainer(max_epochs=2, checkpoint_dir=d).fit(X, y)
+        # nothing usable → trains from scratch, never loads garbage
+        assert [h["epoch"] for h in t.history] == [0, 1]
+        assert np.isfinite(t.predict_logits(X)).all()
+
+    def test_pytree_mismatch_actionable_error(self, tmp_path):
+        from synapseml_tpu.dl import FlaxTrainer, TrainConfig, make_backbone
+
+        X, y = _dl_data(seed=4)
+        d = str(tmp_path / "ck")
+        _trainer(max_epochs=1, checkpoint_dir=d).fit(X, y)
+        # different architecture: 4-class head no longer matches the snapshot
+        t2 = FlaxTrainer(make_backbone("tiny", 4),
+                         TrainConfig(batch_size=16, seed=1, max_epochs=1,
+                                     checkpoint_dir=d))
+        y4 = (np.arange(len(X)) % 4).astype(np.float32)
+        with pytest.raises(ValueError, match="resume=False"):
+            t2.fit(X, y4)
+        assert failure_counts().get("checkpoint.pytree_mismatch", 0) >= 1
+
+    def test_retention_bounds_disk(self, tmp_path):
+        X, y = _dl_data(seed=5)
+        d = str(tmp_path / "ck")
+        _trainer(max_epochs=5, checkpoint_dir=d, keep_checkpoints=2).fit(X, y)
+        blobs = [f for f in os.listdir(d) if f.endswith(".msgpack")]
+        assert len(blobs) == 2
+
+    def test_nan_raise_policy(self):
+        X, y = _dl_data(seed=6)
+        with chaos_nan_batches(at_steps=[1]):
+            with pytest.raises(NonFiniteLossError, match="non-finite"):
+                _trainer(max_epochs=1).fit(X, y)
+        assert failure_counts().get("train.nonfinite_loss", 0) == 1
+
+    def test_nan_skip_policy_counts_and_recovers(self):
+        X, y = _dl_data(seed=7)
+        with chaos_nan_batches(at_steps=[1]) as cb:
+            t = _trainer(max_epochs=2, nonfinite_policy="skip").fit(X, y)
+        assert cb.poisoned == [1]
+        fc = failure_counts()
+        assert fc.get("train.nonfinite_loss", 0) == 1
+        assert fc.get("train.nonfinite_skipped", 0) == 1
+        assert np.isfinite(t.predict_logits(X)).all()
+        # the epoch containing the skipped step still reports a finite loss
+        assert all(np.isfinite(h["loss"]) for h in t.history)
+
+    def test_nan_rollback_policy_restores_checkpoint(self, tmp_path):
+        X, y = _dl_data(seed=8)
+        d = str(tmp_path / "ck")
+        with chaos_nan_batches(at_steps=[5]) as cb:
+            t = _trainer(max_epochs=3, nonfinite_policy="rollback",
+                         checkpoint_dir=d).fit(X, y)
+        assert cb.poisoned == [5]
+        fc = failure_counts()
+        assert fc.get("train.nonfinite_rollback", 0) == 1
+        assert np.isfinite(t.predict_logits(X)).all()
+        assert [h["epoch"] for h in t.history] == [0, 1, 2]
+
+    def test_nan_rollback_without_checkpoint_raises_actionable(self):
+        X, y = _dl_data(seed=9)
+        with chaos_nan_batches(at_steps=[1]):
+            with pytest.raises(NonFiniteLossError, match="checkpoint_dir"):
+                _trainer(max_epochs=1, nonfinite_policy="rollback").fit(X, y)
+
+
+# ---------------------------------------------------------------------------
+# Hyperparameter search: candidate isolation + resumable search
+# ---------------------------------------------------------------------------
+
+def _tune_fixtures():
+    from synapseml_tpu.core.params import Param
+    from synapseml_tpu.core.pipeline import Estimator, Model
+
+    fits = []
+
+    class ConstModel(Model):
+        const = Param("const", "constant prediction", float, 0.0)
+
+        def _transform(self, df):
+            return df.with_column(
+                "prediction", np.full(df.num_rows, float(self.const)))
+
+    class ConstEstimator(Estimator):
+        const = Param("const", "constant", float, 0.0)
+        crash = Param("crash", "raise on fit", bool, False)
+
+        def _fit(self, df):
+            fits.append(float(self.const))
+            if self.crash:
+                raise RuntimeError("deliberate candidate crash")
+            return ConstModel(const=self.const)
+
+    return ConstEstimator, fits
+
+
+def _tune_df():
+    from synapseml_tpu.core.table import Table
+
+    return Table({"feature": np.arange(20, dtype=np.float64),
+                  "label": np.asarray([0.0, 1.0] * 10)})
+
+
+class TestTuneRecovery:
+    def test_crashing_candidate_does_not_abort_search(self):
+        from synapseml_tpu.automl import TuneHyperparameters
+        from synapseml_tpu.automl.hyperparams import (DiscreteHyperParam,
+                                                      HyperparamBuilder)
+
+        Est, _ = _tune_fixtures()
+        space = (HyperparamBuilder()
+                 .addHyperparam("const", DiscreteHyperParam([0.0, 1.0]))
+                 .addHyperparam("crash", DiscreteHyperParam([False, True]))
+                 .build())
+        m = TuneHyperparameters(
+            model=Est(), paramSpace=space, searchMode="grid", numFolds=2,
+            evaluationMetric="rmse", parallelism=2, labelCol="label",
+        ).fit(_tune_df())
+        # crashing candidates scored NaN; the healthy ones still competed
+        assert m.bestParams["crash"] is False
+        nan_results = [r for r in m.allResults if np.isnan(r["metric"])]
+        assert len(nan_results) == 2
+        assert failure_counts().get("automl.candidate_failure", 0) == 2
+
+    def test_all_candidates_crashing_raises_clear_error(self):
+        from synapseml_tpu.automl import TuneHyperparameters
+        from synapseml_tpu.automl.hyperparams import (DiscreteHyperParam,
+                                                      HyperparamBuilder)
+
+        Est, _ = _tune_fixtures()
+        space = (HyperparamBuilder()
+                 .addHyperparam("crash", DiscreteHyperParam([True]))
+                 .build())
+        with pytest.raises(ValueError, match="every candidate scored NaN"):
+            TuneHyperparameters(
+                model=Est(), paramSpace=space, searchMode="grid", numFolds=2,
+                evaluationMetric="rmse", parallelism=1, labelCol="label",
+            ).fit(_tune_df())
+
+    def test_interrupted_search_skips_completed_candidates(self, tmp_path):
+        from synapseml_tpu.automl import TuneHyperparameters
+        from synapseml_tpu.automl.hyperparams import (DiscreteHyperParam,
+                                                      HyperparamBuilder)
+
+        Est, fits = _tune_fixtures()
+        d = str(tmp_path / "tune")
+        consts = [0.0, 1.0, 2.0, 3.0]
+
+        def tuner():
+            space = (HyperparamBuilder()
+                     .addHyperparam("const", DiscreteHyperParam(consts))
+                     .build())
+            return TuneHyperparameters(
+                model=Est(), paramSpace=space, searchMode="grid", numFolds=2,
+                evaluationMetric="rmse", parallelism=1, labelCol="label",
+                checkpointDir=d)
+
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"automl.candidate": [2]}):
+                tuner().fit(_tune_df())
+        first_run_fits = len(fits)
+        assert first_run_fits < len(consts) * 2   # the search really died
+        m = tuner().fit(_tune_df())
+        # resumed run: 2 CV folds for the killed candidate + 1 best refit;
+        # everything already persisted is NOT refit
+        assert len(fits) - first_run_fits == 2 + 1
+        assert len(m.allResults) == len(consts)
+        assert all(np.isfinite(r["metric"]) for r in m.allResults)
+
+    def test_corrupt_candidate_record_is_recomputed(self, tmp_path):
+        from synapseml_tpu.automl import TuneHyperparameters
+        from synapseml_tpu.automl.hyperparams import (DiscreteHyperParam,
+                                                      HyperparamBuilder)
+
+        Est, fits = _tune_fixtures()
+        d = str(tmp_path / "tune")
+
+        def tuner():
+            space = (HyperparamBuilder()
+                     .addHyperparam("const", DiscreteHyperParam([0.0, 1.0]))
+                     .build())
+            return TuneHyperparameters(
+                model=Est(), paramSpace=space, searchMode="grid", numFolds=2,
+                evaluationMetric="rmse", parallelism=1, labelCol="label",
+                checkpointDir=d)
+
+        tuner().fit(_tune_df())
+        rec = sorted(f for f in os.listdir(d) if f.startswith("cand_"))[0]
+        with open(os.path.join(d, rec), "w") as f:
+            f.write("{ torn json")
+        n_before = len(fits)
+        m = tuner().fit(_tune_df())
+        assert failure_counts().get("automl.candidate_record_corrupt", 0) == 1
+        assert len(fits) > n_before       # the corrupt record was recomputed
+        assert all(np.isfinite(r["metric"]) for r in m.allResults)
+
+
+# ---------------------------------------------------------------------------
+# Model-string loader hardening (satellite: clear ValueError, no tracebacks)
+# ---------------------------------------------------------------------------
+
+class TestModelStringHardening:
+    def _model(self):
+        from synapseml_tpu.gbdt.boosting import BoosterConfig, train_booster
+
+        X, y = _binary_data(n=200, seed=7)
+        return train_booster(X, y, BoosterConfig(objective="binary",
+                                                 num_iterations=3,
+                                                 num_leaves=8)), X
+
+    def test_roundtrip_still_exact(self):
+        from synapseml_tpu.gbdt.boosting import Booster
+
+        bst, X = self._model()
+        loaded = Booster.from_model_string(bst.model_string())
+        np.testing.assert_allclose(bst.raw_score(X), loaded.raw_score(X),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_truncation_raises_valueerror_everywhere(self):
+        from synapseml_tpu.gbdt.boosting import Booster
+
+        s = self._model()[0].model_string()
+        cut_points = sorted({len(s) // 8, len(s) // 3, len(s) // 2,
+                             s.index("Tree=1"), s.index("end of trees") - 1})
+        for c in cut_points:
+            with pytest.raises(ValueError):
+                Booster.from_model_string(s[:c])
+
+    def test_garbage_fields_raise_with_context(self):
+        from synapseml_tpu.gbdt.boosting import Booster
+
+        s = self._model()[0].model_string()
+        bad = s.replace("split_feature=", "split_feature=banana ", 1)
+        with pytest.raises(ValueError, match="split_feature"):
+            Booster.from_model_string(bad)
+
+    def test_garbage_header_raises_with_context(self):
+        from synapseml_tpu.gbdt.boosting import Booster
+
+        s = self._model()[0].model_string()
+        bad = s.replace("num_class=1", "num_class=banana", 1)
+        with pytest.raises(ValueError, match="num_class"):
+            Booster.from_model_string(bad)
+
+    def test_missing_required_tree_field_raises(self):
+        from synapseml_tpu.gbdt.boosting import Booster
+
+        s = self._model()[0].model_string()
+        lines = [ln for ln in s.splitlines()
+                 if not ln.startswith("left_child=")]
+        with pytest.raises(ValueError, match="left_child"):
+            Booster.from_model_string("\n".join(lines))
+
+    def test_binary_garbage_raises(self):
+        from synapseml_tpu.gbdt.boosting import Booster
+
+        with pytest.raises(ValueError):
+            Booster.from_model_string("tree\x00\x01\x02 garbage")
+        with pytest.raises(ValueError):
+            Booster.from_model_string("not a model at all")

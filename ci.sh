@@ -37,6 +37,9 @@ assert any(n.endswith(".so") for n in names), "native lib missing from wheel"
 print(f"wheel ok: {whl[0]} ({len(names)} files)")
 EOF
 
+echo "== static analysis (trace-safety / recompile / determinism / locks / blocking-io / codegen-drift) =="
+JAX_PLATFORMS=cpu python tools/analysis/run.py
+
 echo "== unit tests (8-device CPU mesh) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -m pytest tests/ -x -q -m 'not slow'

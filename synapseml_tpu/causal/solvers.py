@@ -9,6 +9,7 @@ exponentiated-gradient loop (`lax.fori_loop`) on device.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import numpy as np
@@ -56,15 +57,27 @@ def constrained_least_squares(A: np.ndarray, b: np.ndarray,
     ``while_loop`` keeps the best iterate seen and stops after
     ``num_iter_no_change`` iterations without a > ``tol`` improvement.
     """
+    A = np.asarray(A, dtype=np.float32)
+    b = np.asarray(b, dtype=np.float32)
+    patience = max_iter if num_iter_no_change is None else int(num_iter_no_change)
+    w, c = _simplex_solver(float(lambda_), bool(fit_intercept),
+                           int(max_iter), int(patience), float(tol))(A, b)
+    return np.asarray(w, dtype=np.float64), float(c)
+
+
+@functools.lru_cache(maxsize=32)
+def _simplex_solver(lambda_: float, fit_intercept: bool, max_iter: int,
+                    patience: int, tol: float):
+    """One jitted exponentiated-gradient solver per hyperparameter tuple.
+    jax.jit keys its compile cache on the wrapper object, so building
+    ``jax.jit(_solve)`` inside ``constrained_least_squares`` recompiled the
+    whole loop on every fit; caching the wrapper reuses the compilation for
+    repeated solves with the same hyperparameters (placebo loops, SDID)."""
     import jax
     import jax.numpy as jnp
 
-    A = np.asarray(A, dtype=np.float32)
-    b = np.asarray(b, dtype=np.float32)
-    m, n = A.shape
-    patience = max_iter if num_iter_no_change is None else int(num_iter_no_change)
-
     def _solve(Aj, bj):
+        n = Aj.shape[1]
         # lambda_ is applied as-is (callers pre-scale, e.g. SDID passes
         # zeta^2 * T_pre — reference SyntheticEstimator.scala:111-115 passes the
         # scaled value unchanged into the solver)
@@ -109,5 +122,4 @@ def constrained_least_squares(A: np.ndarray, b: np.ndarray,
         _, c = loss_and_intercept(best_w)
         return best_w, c
 
-    w, c = jax.jit(_solve)(A, b)
-    return np.asarray(w, dtype=np.float64), float(c)
+    return jax.jit(_solve)

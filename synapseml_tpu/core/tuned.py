@@ -80,8 +80,10 @@ def backend_is_tpu() -> bool:
 
 @functools.lru_cache(maxsize=4)
 def _load(path: str) -> dict:
+    # deliberate trace-time read: tuned defaults must be resolved while the
+    # kernel is being built, and the lru_cache bounds it to once per path
     try:
-        with open(path) as f:
+        with open(path) as f:  # lint-ok: blocking-io
             data = json.load(f)
         return data if isinstance(data, dict) else {}
     except (OSError, json.JSONDecodeError):

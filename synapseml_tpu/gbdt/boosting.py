@@ -1096,8 +1096,9 @@ def train_booster(
         valid_mask = to_global_rows(mesh, P(_DA), valid_mask_np)
         if cfg.boost_from_average:
             # base score from GLOBAL label stats: jit over the sharded labels
-            # inserts the cross-process reductions
-            base_g = jax.jit(obj.init_score,
+            # inserts the cross-process reductions (one-shot per fit, so the
+            # throwaway jit wrapper is deliberate)
+            base_g = jax.jit(obj.init_score,  # lint-ok: recompile
                              out_shardings=NamedSharding(mesh, P()))(yj, wj)
             base = np.atleast_1d(np.asarray(jax.device_get(base_g), np.float64))
         else:

@@ -16,6 +16,7 @@ Reference: recommendation/SAR.scala:36-210 and SARModel.scala. Semantics kept:
 
 from __future__ import annotations
 
+import functools
 from datetime import datetime, timezone
 from typing import Optional
 
@@ -26,6 +27,17 @@ from ..core.pipeline import Estimator, Model
 from ..core.table import Table
 
 _SIMS = ("cooccurrence", "jaccard", "lift")
+
+
+@functools.lru_cache(maxsize=1)
+def _jit_matmul():
+    """jax.jit keys its compile cache on the wrapper object, so building
+    ``jax.jit(jnp.matmul)`` inside ``_scores`` recompiled the product on
+    every scoring call; the cached wrapper compiles once per shape."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(jnp.matmul)
 
 
 class _SARParams(Params):
@@ -111,14 +123,13 @@ class SARModel(Model, _SARParams):
         """affinity[users] @ similarity — only the requested user rows are
         multiplied (the full [U,I]·[I,I] product is never materialized for
         subset queries)."""
-        import jax
         import jax.numpy as jnp
 
         aff = self.get("userAffinity")
         if users is not None:
             aff = aff[users]
         sim = jnp.asarray(self.get("itemSimilarity"))
-        return np.asarray(jax.jit(jnp.matmul)(jnp.asarray(aff), sim))
+        return np.asarray(_jit_matmul()(jnp.asarray(aff), sim))
 
     def _transform(self, df: Table) -> Table:
         """Score (user, item) pairs — predicted rating column."""

@@ -1,0 +1,28 @@
+"""JAX-aware static analysis suite (the ci.sh Style-gate, grown up).
+
+``tools/lint.py``'s three generic AST checks caught NameError-class bugs;
+the classes of defect that actually burn TPU time — host syncs and silent
+recompiles inside ``jit`` regions — or that break the PR 2 bit-for-bit
+resume guarantee (unseeded RNG, wall-clock logic) or that the chaos harness
+can only hit probabilistically (lock-discipline races) need analyses that
+understand the package: which functions are traced, which modules sit on
+the checkpoint path, which attributes are lock-protected.
+
+Layout::
+
+    core.py       shared infrastructure — file discovery, per-module symbol
+                  tables, cross-module import resolution, Finding objects,
+                  fingerprints, inline suppression
+    jitmap.py     jit-boundary inference (jax.jit/pjit/shard_map/lax.scan
+                  through decorators, wrappers and call edges) + taint
+                  propagation from traced arguments
+    analyzers/    one module per analyzer; see analyzers/__init__.py for the
+                  registry
+    baseline.py   committed-findings suppression (fail only on regressions)
+    drift.py      codegen-drift check (regenerate stubs/R bindings in memory,
+                  diff against the committed files)
+    run.py        CLI: ``python tools/analysis/run.py [paths...]``
+
+Suppress a finding inline with ``# lint-ok: <analyzer-id>`` on the flagged
+line (or bare ``# lint-ok`` for all analyzers); see docs/static-analysis.md.
+"""

@@ -1,0 +1,47 @@
+"""Analyzer registry.
+
+Each analyzer module exposes ``ID`` (the finding/suppression id),
+``DESCRIPTION`` (one line for ``--list`` and the docs) and
+``run(ctx) -> list[Finding]``. The shared :class:`Context` carries the
+parsed :class:`~tools.analysis.core.Project` and a lazily-built
+:class:`~tools.analysis.jitmap.JitMap` so the jit-boundary inference runs
+once no matter how many analyzers consume it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..core import Project, SourceFile
+from ..jitmap import JitMap
+
+
+@dataclass
+class Context:
+    project: Project
+    _jitmap: Optional[JitMap] = field(default=None, repr=False)
+
+    @property
+    def jitmap(self) -> JitMap:
+        if self._jitmap is None:
+            self._jitmap = JitMap(self.project)
+        return self._jitmap
+
+    def package_files(self) -> List[SourceFile]:
+        return [sf for sf in self.project.files
+                if sf.rel.startswith("synapseml_tpu/")]
+
+    def files_under(self, prefixes) -> List[SourceFile]:
+        return [sf for sf in self.project.files
+                if any(sf.rel.startswith(p) or sf.rel == p.rstrip("/")
+                       for p in prefixes)]
+
+
+def registry() -> Dict[str, object]:
+    from . import (blocking_io, cycles, determinism, drift, imports, locks,
+                   names, recompile, trace_safety)
+
+    mods = [trace_safety, recompile, determinism, locks, blocking_io,
+            names, imports, cycles, drift]
+    return {m.ID: m for m in mods}

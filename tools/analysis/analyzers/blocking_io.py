@@ -1,0 +1,62 @@
+"""blocking-io — host I/O reachable from inside a traced region.
+
+A ``requests.get``/``open``/``socket`` call inside a jitted function does
+NOT run per step — it runs once, at trace time, blocking compilation and
+silently freezing its result into the program (and in a collective path it
+stalls every process in the mesh while one host waits on the network).
+Anything that must run per step belongs outside the jit boundary or behind
+``jax.pure_callback``/``io_callback`` (which this analyzer treats as
+deliberate host escapes and does not flag).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core import Finding, dotted_name
+
+ID = "blocking-io"
+DESCRIPTION = ("socket/file/HTTP/sleep calls reachable from inside a traced "
+               "region")
+
+SCOPE = ("synapseml_tpu/",)
+
+#: canonical prefixes that are blocking host I/O
+_BLOCKING_PREFIXES = (
+    "requests.", "urllib.request.", "urllib3.", "http.client.",
+    "socket.", "subprocess.", "shutil.", "ftplib.", "smtplib.",
+)
+
+_BLOCKING_EXACT = {
+    "open", "input", "os.system", "os.popen", "time.sleep",
+    "socket.socket", "urllib.request.urlopen",
+}
+
+
+def _is_blocking(canon: Optional[str]) -> bool:
+    if not canon:
+        return False
+    return canon in _BLOCKING_EXACT or canon.startswith(_BLOCKING_PREFIXES)
+
+
+def run(ctx) -> List[Finding]:
+    jm = ctx.jitmap
+    project = ctx.project
+    scoped = {sf.module for sf in ctx.files_under(SCOPE)}
+    findings: List[Finding] = []
+    for full, tinfo in jm.traced.items():
+        if tinfo.func.module not in scoped:
+            continue
+        sf = project.by_module[tinfo.func.module]
+        for call in jm._calls_in_body(tinfo.func):
+            canon = project.canonical(sf, dotted_name(call.func))
+            if _is_blocking(canon):
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=call.lineno,
+                    col=call.col_offset,
+                    message=(f"blocking host I/O `{canon}()` inside traced "
+                             f"`{tinfo.func.qualname}` ({tinfo.reason}): "
+                             "runs once at trace time, not per step — move "
+                             "outside the jit boundary or use "
+                             "jax.pure_callback/io_callback")))
+    return findings

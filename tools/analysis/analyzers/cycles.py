@@ -1,0 +1,69 @@
+"""import-cycles — strongly-connected components in the intra-package
+import graph (Tarjan). Ported from tools/lint.py check (3); only
+import-time (module top-level) edges count — lazy in-function imports are
+the sanctioned way to break a cycle.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Set
+
+from ..core import PACKAGE, Finding
+
+ID = "import-cycles"
+DESCRIPTION = "import-time cycles in the intra-package import graph"
+
+
+def _find_sccs(edges: Dict[str, Set[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        for w in edges.get(v, ()):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in onstack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            scc = []
+            while True:
+                w = stack.pop()
+                onstack.discard(w)
+                scc.append(w)
+                if w == v:
+                    break
+            if len(scc) > 1 or v in edges.get(v, ()):
+                sccs.append(sorted(scc))
+
+    sys.setrecursionlimit(10000)
+    for v in list(edges):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def run(ctx) -> List[Finding]:
+    edges: Dict[str, Set[str]] = {}
+    for sf in ctx.project.files:
+        if not sf.module.startswith(PACKAGE):
+            continue
+        for m in sf.symbols.top_level_modules:
+            if m.startswith(PACKAGE):
+                edges.setdefault(sf.module, set()).add(m)
+    findings: List[Finding] = []
+    for scc in _find_sccs(edges):
+        first = ctx.project.by_module.get(scc[0])
+        findings.append(Finding(
+            analyzer=ID, path=first.rel if first else scc[0], line=1, col=0,
+            message="import cycle: " + " <-> ".join(scc)))
+    return findings

@@ -1,0 +1,165 @@
+"""determinism — wall-clock, unseeded RNG, and order-sensitive iteration on
+the checkpoint/resume and model-fingerprint paths.
+
+PR 2's guarantee is *bit-for-bit* resume: kill the run anywhere, restore,
+and the final model equals the uninterrupted one. Anything that samples a
+different value on the resumed half of the run breaks that silently:
+
+* **wall clock** — ``time.time()``/``datetime.now()``/``time.localtime()``
+  feeding training logic or fingerprints (``time.monotonic``/
+  ``perf_counter`` are fine: durations, never state);
+* **unseeded RNG** — ``np.random.default_rng()`` with no seed, the legacy
+  global ``np.random.*`` distributions, ``random.*`` module-level calls,
+  and ``random.Random()``/``np.random.Generator`` construction without an
+  explicit seed;
+* **set iteration** — ``for x in set(...)``/set literals: string hash
+  randomization makes the order differ between the original and resumed
+  process;
+* **directory-order iteration** — ``for f in os.listdir(...)`` where the
+  loop is order-sensitive (first-match ``break``/``return``, or appending
+  to a list that is never ``sorted``): listdir order is filesystem-
+  dependent, so checkpoint discovery must sort.
+
+Scope: the modules the resume guarantee covers (``gbdt/``, ``dl/``,
+``automl/``, ``core/checkpoint.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, dotted_name
+
+ID = "determinism"
+DESCRIPTION = ("wall-clock, unseeded RNG and order-sensitive iteration on "
+               "checkpoint/resume paths")
+
+SCOPE = ("synapseml_tpu/gbdt/", "synapseml_tpu/dl/", "synapseml_tpu/automl/",
+         "synapseml_tpu/core/checkpoint.py")
+
+_WALL_CLOCK = {"time.time", "time.time_ns", "time.localtime", "time.ctime",
+               "datetime.datetime.now", "datetime.datetime.utcnow",
+               "datetime.date.today"}
+
+#: legacy numpy global-state distributions (module-level np.random.*)
+_NP_GLOBAL = {"rand", "randn", "randint", "random", "random_sample", "choice",
+              "shuffle", "permutation", "normal", "uniform", "seed",
+              "standard_normal", "beta", "binomial", "poisson"}
+
+#: stdlib random module-level functions (the shared global Random instance)
+_PY_RANDOM = {"random", "randint", "randrange", "uniform", "choice",
+              "choices", "shuffle", "sample", "gauss", "normalvariate",
+              "betavariate", "seed", "getrandbits"}
+
+
+def _is_set_expr(node: ast.AST, canon) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        c = canon(node.func)
+        return c in ("set", "frozenset")
+    return False
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, project, sf, findings: List[Finding]):
+        self.project = project
+        self.sf = sf
+        self.findings = findings
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(
+            analyzer=ID, path=self.sf.rel, line=node.lineno,
+            col=node.col_offset, message=msg))
+
+    def _canon(self, node: ast.AST) -> Optional[str]:
+        return self.project.canonical(self.sf, dotted_name(node))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        canon = self._canon(node.func)
+        if canon in _WALL_CLOCK:
+            self._flag(node, f"`{canon}()` on a resume path: wall clock "
+                             "differs between the original and resumed run "
+                             "(use a step counter, or time.monotonic for "
+                             "durations only)")
+        elif canon == "numpy.random.default_rng" and not node.args \
+                and not node.keywords:
+            self._flag(node, "`np.random.default_rng()` without a seed on a "
+                             "resume path: the resumed run draws a "
+                             "different stream — pass an explicit seed")
+        elif canon and canon.startswith("numpy.random.") \
+                and canon.rsplit(".", 1)[-1] in _NP_GLOBAL:
+            self._flag(node, f"legacy global-state `np.random."
+                             f"{canon.rsplit('.', 1)[-1]}()` on a resume "
+                             "path: unseedable per-call and process-global "
+                             "— use np.random.default_rng(seed)")
+        elif canon and canon.startswith("random.") \
+                and canon.rsplit(".", 1)[-1] in _PY_RANDOM:
+            self._flag(node, f"`{canon}()` uses the process-global stdlib "
+                             "RNG on a resume path — use a seeded "
+                             "random.Random(seed) / np generator")
+        elif canon == "random.Random" and not node.args and not node.keywords:
+            self._flag(node, "`random.Random()` without a seed on a resume "
+                             "path — pass an explicit seed")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if _is_set_expr(node.iter, self._canon):
+            self._flag(node.iter, "iteration over a set on a resume path: "
+                                  "string hash randomization varies the "
+                                  "order across processes — sort first")
+        elif (isinstance(node.iter, ast.Call)
+              and self._canon(node.iter.func) in ("os.listdir",
+                                                  "os.scandir")):
+            if self._listdir_order_sensitive(node):
+                self._flag(node.iter, "order-sensitive iteration over "
+                                      "`os.listdir()` on a resume path: "
+                                      "directory order is filesystem-"
+                                      "dependent — wrap in sorted()")
+        self.generic_visit(node)
+
+    def _listdir_order_sensitive(self, node: ast.For) -> bool:
+        """break/return inside the loop (first match wins) or appending to a
+        list that the enclosing function never sorts afterwards."""
+        appended: List[str] = []
+        for n in ast.walk(node):
+            if isinstance(n, (ast.Break, ast.Return)):
+                return True
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "append" \
+                    and isinstance(n.func.value, ast.Name):
+                appended.append(n.func.value.id)
+        if not appended:
+            return False
+        # is any appended list later passed through sorted()/.sort()?
+        enclosing = self._enclosing_function(node)
+        scope = enclosing if enclosing is not None else self.sf.tree
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call):
+                if self._canon(n.func) == "sorted" and n.args \
+                        and isinstance(n.args[0], ast.Name) \
+                        and n.args[0].id in appended:
+                    return False
+                if isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "sort" \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id in appended:
+                    return False
+        return True
+
+    def _enclosing_function(self, target: ast.AST) -> Optional[ast.AST]:
+        best = None
+        for info in self.sf.symbols.functions.values():
+            for n in ast.walk(info.node):
+                if n is target:
+                    if best is None or info.node.lineno >= best.lineno:
+                        best = info.node
+        return best
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files_under(SCOPE):
+        _Walker(ctx.project, sf, findings).visit(sf.tree)
+    return findings

@@ -1,0 +1,88 @@
+"""codegen-drift — committed generated artifacts must match a fresh render.
+
+``synapseml_tpu/codegen.py`` derives the ``.pyi`` typing stubs and the
+``R/`` reticulate bindings from the live Param metadata. Regeneration is
+manual, so a param added in a PR silently leaves stale stubs behind (the
+PR 2 stub regeneration was exactly this). This analyzer regenerates both
+artifact sets **in memory** (``render_stubs``/``render_r_bindings``) and
+flags every committed file that differs, is missing, or is stale (committed
+but no longer rendered). Fix with ``python -m synapseml_tpu.codegen``.
+
+Importing the package is comparatively heavy (it walks every module), so
+this analyzer only runs in full-tree mode — ``run.py`` skips it when
+explicit paths are given.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+from ..core import REPO, Finding
+
+ID = "codegen-drift"
+DESCRIPTION = ("committed .pyi stubs / R bindings differ from an in-memory "
+               "regeneration")
+
+#: run.py only includes this analyzer on full-tree runs
+FULL_TREE_ONLY = True
+
+
+def _compare(rendered: Dict[str, str], root: str, label: str,
+             committed_exts: tuple, findings: List[Finding]) -> None:
+    rel_root = os.path.relpath(root, REPO).replace(os.sep, "/")
+    for rel, content in sorted(rendered.items()):
+        path = os.path.join(root, rel)
+        rel_repo = f"{rel_root}/{rel}".replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                on_disk = f.read()
+        except OSError:
+            findings.append(Finding(
+                analyzer=ID, path=rel_repo, line=1, col=0,
+                message=f"{label} file is missing — regenerate with "
+                        "`python -m synapseml_tpu.codegen`"))
+            continue
+        if on_disk != content:
+            line = 1
+            for i, (a, b) in enumerate(zip(on_disk.splitlines(),
+                                           content.splitlines()), 1):
+                if a != b:
+                    line = i
+                    break
+            findings.append(Finding(
+                analyzer=ID, path=rel_repo, line=line, col=0,
+                message=f"{label} file differs from a fresh render (first "
+                        f"diff at line {line}) — regenerate with "
+                        "`python -m synapseml_tpu.codegen`"))
+    # stale committed artifacts no render produces anymore
+    rendered_paths = {os.path.normpath(os.path.join(root, r))
+                      for r in rendered}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(committed_exts):
+                continue
+            path = os.path.normpath(os.path.join(dirpath, fn))
+            if path not in rendered_paths:
+                findings.append(Finding(
+                    analyzer=ID,
+                    path=os.path.relpath(path, REPO).replace(os.sep, "/"),
+                    line=1, col=0,
+                    message=f"stale committed {label} file: no module "
+                            "renders it anymore — delete it or regenerate"))
+
+
+def run(ctx) -> List[Finding]:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import sys
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from synapseml_tpu import codegen
+
+    findings: List[Finding] = []
+    pkg_root = os.path.join(REPO, "synapseml_tpu")
+    _compare(codegen.render_stubs(), pkg_root, "stub", (".pyi",), findings)
+    _compare(codegen.render_r_bindings(), os.path.join(REPO, "R"),
+             "R binding", (".R",), findings)
+    return findings

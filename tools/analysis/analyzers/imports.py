@@ -1,0 +1,53 @@
+"""unused-imports — an imported binding never referenced in the file.
+
+Ported from tools/lint.py check (2) onto the shared symbol-table layer.
+``__init__.py`` re-export surfaces and ``_``-prefixed deliberate
+side-effect imports are exempt; names exported via ``__all__`` strings
+count as used.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set
+
+from ..core import Finding
+
+ID = "unused-imports"
+DESCRIPTION = "imported bindings never referenced in the file"
+
+
+def _used_names(tree: ast.AST) -> Set[str]:
+    used: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Name):
+            used.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            root = n
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+        elif (isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in n.targets)):
+            for c in ast.walk(n.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    used.add(c.value)
+    return used
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.project.files:
+        if sf.syntax_error or os.path.basename(sf.path) == "__init__.py":
+            continue
+        used = _used_names(sf.tree)
+        for name, lineno in sorted(sf.symbols.import_linenos.items(),
+                                   key=lambda kv: kv[1]):
+            if name not in used and not name.startswith("_"):
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=lineno, col=0,
+                    message=f"unused import '{name}'"))
+    return findings

@@ -1,0 +1,285 @@
+"""locks — mixed lock discipline on shared mutable state.
+
+The chaos harness can only hit a data race probabilistically; this analyzer
+finds the *discipline* violation deterministically: a module-global or
+instance attribute that is written under a lock at some sites (so somebody
+decided it IS shared state) and written without that lock at others.
+
+Mechanics, per scoped module:
+
+1. discover lock objects — module globals bound to ``threading.Lock()``/
+   ``RLock()``/``Condition()`` and ``self.<attr>`` bound to one in any
+   method;
+2. walk every function tracking the stack of ``with <lock>:`` blocks;
+3. record write events (attribute stores, subscript stores and mutating
+   method calls on module globals / instance attributes) with the set of
+   locks held lexically at the site;
+4. **guarded-caller propagation** — a helper whose every call site inside
+   the module holds the lock inherits that lock (fixpoint), so the
+   ``def _open(self): self.state = ...`` called only under ``self._lock``
+   does not false-positive;
+5. flag every write whose effective lock set is empty while other writes to
+   the same name hold a lock. ``__init__``/``__post_init__``/``__new__``
+   and module top level are pre-publication and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..core import Finding, dotted_name
+
+ID = "locks"
+DESCRIPTION = ("module/instance state written both under and outside a lock "
+               "(deterministic race-discipline check)")
+
+SCOPE = ("synapseml_tpu/io/serving.py",
+         "synapseml_tpu/io/distributed_serving.py",
+         "synapseml_tpu/core/resilience.py",
+         "synapseml_tpu/core/logging.py")
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock",
+                   "threading.Condition", "multiprocessing.Lock",
+                   "multiprocessing.RLock"}
+
+_MUTATING_METHODS = {"append", "extend", "add", "update", "clear", "pop",
+                     "popitem", "remove", "discard", "insert",
+                     "setdefault", "sort"}
+
+_PRE_PUBLICATION = {"__init__", "__post_init__", "__new__", "__enter__"}
+
+
+@dataclass
+class _Write:
+    key: str                    # attribute or global name
+    func_qual: Optional[str]    # enclosing function (None = module level)
+    node: ast.AST
+    held: FrozenSet[str]        # lock ids held lexically at the site
+
+
+@dataclass
+class _CallSite:
+    callee_qual: str
+    held: FrozenSet[str]
+    caller_qual: Optional[str]
+
+
+def _discover_locks(project, sf) -> Set[str]:
+    """Names (global names / attribute names) bound to lock objects."""
+    locks: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        canon = project.canonical(sf, dotted_name(value.func))
+        if canon not in _LOCK_FACTORIES:
+            continue
+        target = node.targets[0]
+        if isinstance(target, ast.Name):
+            locks.add(target.id)
+        elif isinstance(target, ast.Attribute):
+            locks.add(target.attr)
+    return locks
+
+
+class _FuncWalker(ast.NodeVisitor):
+    """Collect writes + call sites for ONE function body (nested defs are
+    walked as their own functions by the caller)."""
+
+    def __init__(self, project, sf, info, locks: Set[str],
+                 module_globals: Set[str],
+                 writes: List[_Write], calls: List[_CallSite]):
+        self.project = project
+        self.sf = sf
+        self.info = info
+        self.locks = locks
+        self.module_globals = module_globals
+        self.writes = writes
+        self.calls = calls
+        self._held: List[str] = []
+        self._globals: Set[str] = set()
+        self.root = info.node if info is not None else sf.tree
+
+    def walk(self) -> None:
+        body = getattr(self.root, "body", [])
+        for stmt in body:
+            self.visit(stmt)
+
+    # do not descend into nested defs — separate functions
+    def visit_FunctionDef(self, node) -> None:
+        pass
+    visit_AsyncFunctionDef = visit_ClassDef = visit_FunctionDef
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._globals.update(node.names)
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        name = dotted_name(expr)
+        if not name:
+            return None
+        last = name.split(".")[-1]
+        return last if last in self.locks else None
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = [lid for item in node.items
+                    if (lid := self._lock_id(item.context_expr))]
+        self._held.extend(acquired)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self._held.pop()
+    visit_AsyncWith = visit_With
+
+    def _record(self, key: str, node: ast.AST) -> None:
+        qual = self.info.qualname if self.info is not None else None
+        self.writes.append(_Write(key=key, func_qual=qual, node=node,
+                                  held=frozenset(self._held)))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._direct_target(t, node)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._direct_target(node.target, node)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._direct_target(node.target, node)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def _direct_target(self, target: ast.AST, node: ast.AST) -> None:
+        if isinstance(target, ast.Subscript):
+            base = target.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute):
+                self._record(base.attr, node)
+            elif isinstance(base, ast.Name) \
+                    and base.id in self.module_globals:
+                self._record(base.id, node)
+        elif isinstance(target, ast.Attribute):
+            self._record(target.attr, node)
+        elif isinstance(target, ast.Name):
+            if target.id in self._globals \
+                    and target.id in self.module_globals:
+                self._record(target.id, node)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._direct_target(elt, node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # mutating method call on a global or instance attribute
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATING_METHODS:
+            base = fn.value
+            if isinstance(base, ast.Name) \
+                    and base.id in self.module_globals:
+                self._record(base.id, node)
+            elif isinstance(base, ast.Attribute):
+                self._record(base.attr, node)
+        # intra-module call sites, for guarded-caller propagation
+        name = dotted_name(fn)
+        if name:
+            head, _, rest = name.partition(".")
+            qual = None
+            if head in ("self", "cls") and rest and "." not in rest \
+                    and self.info is not None and self.info.class_name:
+                qual = f"{self.info.class_name}.{rest}"
+            elif "." not in name and name in self.sf.symbols.functions:
+                qual = name
+            if qual is not None and qual in self.sf.symbols.functions:
+                self.calls.append(_CallSite(
+                    callee_qual=qual, held=frozenset(self._held),
+                    caller_qual=(self.info.qualname
+                                 if self.info is not None else None)))
+        self.generic_visit(node)
+
+
+def _module_globals(sf) -> Set[str]:
+    out: Set[str] = set()
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) \
+                and isinstance(node.target, ast.Name):
+            out.add(node.target.id)
+    return out
+
+
+def _analyze_module(project, sf) -> List[Finding]:
+    locks = _discover_locks(project, sf)
+    if not locks:
+        return []
+    module_globals = _module_globals(sf)
+    writes: List[_Write] = []
+    calls: List[_CallSite] = []
+    for info in sf.symbols.functions.values():
+        _FuncWalker(project, sf, info, locks, module_globals,
+                    writes, calls).walk()
+
+    # guarded-caller fixpoint: context(F) = ⋂ over call sites of
+    # (site.held ∪ context(caller)); no known call sites -> no context
+    context: Dict[str, FrozenSet[str]] = {}
+    sites_by_callee: Dict[str, List[_CallSite]] = {}
+    for s in calls:
+        sites_by_callee.setdefault(s.callee_qual, []).append(s)
+    for _ in range(5):
+        changed = False
+        for qual, sites in sites_by_callee.items():
+            eff = None
+            for s in sites:
+                held = s.held | context.get(s.caller_qual or "", frozenset())
+                eff = held if eff is None else (eff & held)
+            eff = eff or frozenset()
+            if context.get(qual, frozenset()) != eff:
+                context[qual] = eff
+                changed = True
+        if not changed:
+            break
+
+    # mixed-discipline detection per written name
+    by_key: Dict[str, List[Tuple[_Write, FrozenSet[str]]]] = {}
+    for w in writes:
+        leaf = (w.func_qual or "").split(".")[-1]
+        if leaf in _PRE_PUBLICATION or w.func_qual is None:
+            continue
+        eff = w.held | context.get(w.func_qual, frozenset())
+        by_key.setdefault(w.key, []).append((w, eff))
+
+    findings: List[Finding] = []
+    for key, events in by_key.items():
+        locked = [(w, eff) for w, eff in events if eff]
+        unlocked = [(w, eff) for w, eff in events if not eff]
+        if not locked or not unlocked:
+            continue
+        lock_names = sorted({l for _, eff in locked for l in eff})
+        guarded_at = sorted({f"{w.func_qual}:{w.node.lineno}"
+                             for w, _ in locked})[:3]
+        for w, _ in unlocked:
+            findings.append(Finding(
+                analyzer=ID, path=sf.rel, line=w.node.lineno,
+                col=w.node.col_offset,
+                message=(f"`{key}` is written without holding "
+                         f"`{'`/`'.join(lock_names)}` (in "
+                         f"`{w.func_qual}`), but other writes hold it "
+                         f"({', '.join(guarded_at)}) — racy "
+                         "read-modify-write")))
+    return findings
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files_under(SCOPE):
+        findings.extend(_analyze_module(ctx.project, sf))
+    return findings

@@ -1,0 +1,32 @@
+"""undefined-names — a Name load never bound anywhere in the file.
+
+Ported from tools/lint.py check (1) onto the shared symbol-table layer.
+The binding union is scope-blind by design: it cannot model shadowing, but
+anything it DOES flag is a genuine unbound name (NameError on a code path
+tests may not reach).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import BUILTINS, Finding
+
+ID = "undefined-names"
+DESCRIPTION = "Name loads never bound in the file (NameError at runtime)"
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.project.files:
+        if sf.syntax_error:
+            continue
+        bound = sf.symbols.bound
+        for n in ast.walk(sf.tree):
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id not in bound and n.id not in BUILTINS):
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=n.lineno,
+                    col=n.col_offset, message=f"undefined name '{n.id}'"))
+    return findings

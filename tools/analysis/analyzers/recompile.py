@@ -1,0 +1,155 @@
+"""recompile — silent retrace/recompile hazards around jit boundaries.
+
+XLA compilation is the most expensive host-side event in the system; these
+patterns recompile on every call (or every loop iteration) without raising
+anything — the profile just quietly fills with `jit_` compilations:
+
+* **R1 jit-then-call** — ``jax.jit(f)(x)`` builds a fresh wrapper per
+  evaluation; its cache dies with the expression, so every call retraces.
+* **R2 jit-in-loop** — ``g = jax.jit(f)`` inside a ``for``/``while`` body
+  (not stored into a cache dict/attribute): a new wrapper — and a new
+  compile — per iteration.
+* **R3 f-string / str() static argument** — a jitted callee fed an f-string
+  (or ``str(...)``) argument: strings are only hashable-static, and a
+  per-call-varying string means a per-call cache miss.
+* **R4 loop-varying slice shape** — a jitted callee fed ``x[:i]``/``x[i:]``
+  where ``i`` is the enclosing loop variable: the argument shape changes
+  every iteration, so every iteration compiles a new program (pad to a
+  fixed shape or use ``lax.dynamic_slice``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Finding, dotted_name
+from ..jitmap import is_jit_like
+
+ID = "recompile"
+DESCRIPTION = ("jit wrappers rebuilt per call/iteration and per-call-varying "
+               "static arguments (silent recompiles)")
+
+SCOPE = ("synapseml_tpu/",)
+
+
+def _is_cached_store(parents: List[ast.AST]) -> bool:
+    """Is the jit() result stored into a cache (subscript/attribute store or
+    a .setdefault(...) call) rather than a throwaway local?"""
+    for p in reversed(parents):
+        if isinstance(p, ast.Assign):
+            return any(isinstance(t, (ast.Subscript, ast.Attribute))
+                       for t in p.targets)
+        if isinstance(p, ast.Call):
+            fn = p.func
+            if isinstance(fn, ast.Attribute) and fn.attr in (
+                    "setdefault", "update", "append", "put"):
+                return True
+    return False
+
+
+def _loop_vars(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, ast.For):
+        for n in ast.walk(node.target):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, project, sf, jitmap, findings: List[Finding]):
+        self.project = project
+        self.sf = sf
+        self.jitmap = jitmap
+        self.findings = findings
+        self._parents: List[ast.AST] = []
+        self._loops: List[ast.AST] = []
+        self._loop_vars: Set[str] = set()
+        self._func_stack: List[ast.AST] = []
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(
+            analyzer=ID, path=self.sf.rel, line=node.lineno,
+            col=node.col_offset, message=msg))
+
+    def _canon(self, node: ast.AST) -> Optional[str]:
+        return self.project.canonical(self.sf, dotted_name(node))
+
+    def generic_visit(self, node: ast.AST) -> None:
+        self._parents.append(node)
+        if isinstance(node, (ast.For, ast.While)):
+            self._loops.append(node)
+            added = _loop_vars(node)
+            self._loop_vars |= added
+            super().generic_visit(node)
+            self._loops.pop()
+            self._loop_vars -= added
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._func_stack.append(node)
+            super().generic_visit(node)
+            self._func_stack.pop()
+        else:
+            super().generic_visit(node)
+        self._parents.pop()
+
+    def _callee_is_jitted(self, call: ast.Call) -> bool:
+        info = None
+        # innermost enclosing function, for method resolution
+        for sf_info in self.sf.symbols.functions.values():
+            if self._func_stack and sf_info.node is self._func_stack[-1]:
+                info = sf_info
+                break
+        callee = self.jitmap.resolve_callee(self.sf, info, call)
+        return (callee is not None
+                and callee.full_name in self.jitmap.traced
+                and self.jitmap.traced[callee.full_name].direct)
+
+    def visit_Call(self, call: ast.Call) -> None:
+        canon = self._canon(call.func)
+
+        # R1: jax.jit(f)(x) — wrapper and cache rebuilt per evaluation
+        if isinstance(call.func, ast.Call):
+            inner = self._canon(call.func.func)
+            if is_jit_like(inner):
+                self._flag(call, f"`{inner}(...)` built and called in one "
+                                 "expression: the wrapper (and its compile "
+                                 "cache) is rebuilt on every evaluation — "
+                                 "hoist the jitted wrapper out")
+
+        # R2: jit() inside a loop body without a cached store
+        if is_jit_like(canon) and self._loops \
+                and not _is_cached_store(self._parents):
+            self._flag(call, f"`{canon}(...)` inside a loop creates a fresh "
+                             "wrapper (one recompile) per iteration — hoist "
+                             "it or store it in a cache")
+
+        # R3/R4 only apply to calls INTO a known-jitted function
+        if call.args and self._callee_is_jitted(call):
+            for arg in call.args:
+                if isinstance(arg, ast.JoinedStr) or (
+                        isinstance(arg, ast.Call)
+                        and self._canon(arg.func) == "str"):
+                    self._flag(arg, "f-string/str() argument to a jitted "
+                                    "function: a per-call-varying string is "
+                                    "a per-call cache miss (recompile)")
+                if (isinstance(arg, ast.Subscript)
+                        and isinstance(arg.slice, ast.Slice)):
+                    for part in (arg.slice.lower, arg.slice.upper):
+                        if isinstance(part, ast.Name) \
+                                and part.id in self._loop_vars:
+                            self._flag(arg, f"slice `[{part.id}]`-bounded "
+                                            "argument to a jitted function "
+                                            "varies in shape per loop "
+                                            "iteration — one recompile per "
+                                            "shape (pad or use lax."
+                                            "dynamic_slice)")
+                            break
+        self.generic_visit(call)
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files_under(SCOPE):
+        _Walker(ctx.project, sf, ctx.jitmap, findings).visit(sf.tree)
+    return findings

@@ -1,0 +1,96 @@
+"""trace-safety — host syncs and concretization errors inside jit regions.
+
+Inside a traced function (see ``jitmap``), a value derived from a traced
+argument must stay on-device: ``bool()``/``int()``/``float()``, ``.item()``/
+``.tolist()``, ``np.asarray``/``np.array`` and Python ``if``/``while`` on
+such a value either raise a ``TracerBoolConversionError`` at trace time or —
+worse, when the value happens to be concrete on the failing path — silently
+serialize the mesh with a device→host transfer per step (the host-sync class
+the learned-TPU-cost-model paper measures as the dominant avoidable stall).
+
+The taint fixpoint is interprocedural: parameters of directly-jitted
+functions seed the taint (minus ``static_argnums``/``static_argnames``);
+call edges propagate per-argument taint into helpers reachable from the
+trace, so a ``bool(x)`` three calls below the ``@jax.jit`` is still caught,
+while a helper that only ever receives static config is not flagged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from ..core import Finding
+from ..jitmap import TaintWalker
+
+ID = "trace-safety"
+DESCRIPTION = ("host-sync / TracerBoolConversionError hazards on values "
+               "reachable from traced arguments inside jit regions")
+
+#: analysis scope (finding sites) — the package itself
+SCOPE = ("synapseml_tpu/",)
+
+_MAX_ROUNDS = 10
+
+
+def _seed_params(traced_info) -> Set[str]:
+    node = traced_info.func.node
+    a = node.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    return {n for n in names
+            if n not in traced_info.static_params
+            and n not in ("self", "cls")}
+
+
+def run(ctx) -> List[Finding]:
+    jm = ctx.jitmap
+    project = ctx.project
+    scoped = {sf.module: sf for sf in ctx.files_under(SCOPE)}
+
+    # parameter-taint fixpoint: direct jit boundaries taint all non-static
+    # params; propagated callees start empty and accumulate from call sites
+    param_taint: Dict[str, Set[str]] = {}
+    for full, tinfo in jm.traced.items():
+        param_taint[full] = _seed_params(tinfo) if tinfo.direct else set()
+
+    # return taints ride the same fixpoint: a helper returning
+    # (static_shape_stuff, traced_array) taints only the traced element at
+    # its call sites (per-tuple-element precision — see TaintWalker)
+    ret_taint: Dict[str, object] = {}
+    for _ in range(_MAX_ROUNDS):
+        changed = False
+        for full, tinfo in jm.traced.items():
+            sf = project.by_module.get(tinfo.func.module)
+            if sf is None:
+                continue
+            walker = TaintWalker(project, sf, tinfo.func,
+                                 param_taint[full], jm,
+                                 fn_return_taint=ret_taint)
+            walker.run()
+            if walker.returns is not None \
+                    and ret_taint.get(full) != walker.returns:
+                ret_taint[full] = walker.returns
+                changed = True
+            for callee, tset in walker.callee_arg_taint.items():
+                if callee in param_taint and tset - param_taint[callee]:
+                    param_taint[callee] |= tset
+                    changed = True
+        if not changed:
+            break
+
+    findings: List[Finding] = []
+    for full, tinfo in jm.traced.items():
+        sf = scoped.get(tinfo.func.module)
+        if sf is None:
+            continue
+
+        def on_sink(kind, node, detail, tinfo=tinfo, sf=sf):
+            findings.append(Finding(
+                analyzer=ID, path=sf.rel, line=node.lineno,
+                col=node.col_offset,
+                message=(f"{detail} — in `{tinfo.func.qualname}` "
+                         f"(traced: {tinfo.reason})")))
+
+        walker = TaintWalker(project, sf, tinfo.func, param_taint[full],
+                             jm, on_sink=on_sink, fn_return_taint=ret_taint)
+        walker.run()
+    return findings

@@ -1,0 +1,58 @@
+"""Baseline — committed pre-existing findings so CI fails only on regressions.
+
+``baseline.json`` maps finding fingerprints (analyzer + path + source-line
+text + occurrence index; see ``core.Project.finalize``) to their recorded
+context. A run FAILS on findings whose fingerprint is not in the baseline;
+baselined findings are reported as suppressed counts. Stale entries (in the
+baseline but no longer produced) are reported so the file shrinks over time
+— regenerate with ``python tools/analysis/run.py --update-baseline``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .core import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def load(path: str = DEFAULT_BASELINE) -> Dict[str, dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return {}
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def save(findings: List[Finding], path: str = DEFAULT_BASELINE) -> None:
+    entries = [{"fingerprint": f.fingerprint, "analyzer": f.analyzer,
+                "path": f.path, "line": f.line, "message": f.message}
+               for f in findings]
+    payload = {
+        "version": 1,
+        "note": ("Accepted pre-existing findings. CI fails only on findings "
+                 "NOT in this file; regenerate with `python "
+                 "tools/analysis/run.py --update-baseline` and review the "
+                 "diff — every addition is a new accepted defect."),
+        "findings": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def split(findings: List[Finding], baseline: Dict[str, dict]
+          ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(new, suppressed, stale_fingerprints)."""
+    new, suppressed = [], []
+    seen = set()
+    for f in findings:
+        seen.add(f.fingerprint)
+        (suppressed if f.fingerprint in baseline else new).append(f)
+    stale = [fp for fp in baseline if fp not in seen]
+    return new, suppressed, stale

@@ -1,0 +1,137 @@
+"""CLI for the static-analysis suite.
+
+Usage::
+
+    python tools/analysis/run.py                     # full tree + baseline
+    python tools/analysis/run.py path/ file.py       # explicit targets
+    python tools/analysis/run.py --analyzers trace-safety,locks
+    python tools/analysis/run.py --update-baseline   # re-accept findings
+    python tools/analysis/run.py --no-baseline       # raw findings
+    python tools/analysis/run.py --list              # analyzer inventory
+
+Exit code 0 when every finding is baseline-accepted (or none), 1 when new
+findings exist. The codegen-drift analyzer (package import = slow) only
+runs on full-tree runs; fixture/partial runs skip it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+if __package__ in (None, ""):                       # `python tools/analysis/run.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    __package__ = "tools.analysis"
+
+from tools.analysis import baseline as baseline_mod            # noqa: E402
+from tools.analysis.analyzers import Context, registry         # noqa: E402
+from tools.analysis.core import Finding, Project               # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/analysis/run.py",
+        description="JAX-aware static analysis suite (see "
+                    "docs/static-analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the whole tree)")
+    ap.add_argument("--analyzers", default=None,
+                    help="comma-separated analyzer ids (default: all)")
+    ap.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE,
+                    help="baseline file (default: tools/analysis/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept the current findings as the new baseline")
+    ap.add_argument("--list", action="store_true", dest="list_analyzers",
+                    help="list analyzer ids and exit")
+    ap.add_argument("--repo", default=None,
+                    help="analyze this tree instead of the repository "
+                         "(fixture corpora; implies --no-baseline)")
+    args = ap.parse_args(argv)
+    if args.repo:
+        args.no_baseline = True
+
+    reg = registry()
+    if args.list_analyzers:
+        for aid, mod in sorted(reg.items()):
+            print(f"{aid:18s} {mod.DESCRIPTION}")
+        return 0
+
+    # drift (and any FULL_TREE_ONLY analyzer) runs only against the real
+    # repository as a whole — not on partial targets or fixture corpora
+    full_tree = not args.paths and not args.repo
+    selected = (args.analyzers.split(",") if args.analyzers
+                else list(reg))
+    unknown = [a for a in selected if a.strip() not in reg]
+    if unknown:
+        print(f"unknown analyzer(s): {', '.join(unknown)} "
+              f"(see --list)", file=sys.stderr)
+        return 2
+    selected = [a.strip() for a in selected]
+    if not full_tree:
+        selected = [a for a in selected
+                    if not getattr(reg[a], "FULL_TREE_ONLY", False)]
+
+    t0 = time.perf_counter()
+    if args.repo:
+        repo = os.path.abspath(args.repo)
+        project = Project.from_targets(args.paths or ["."], repo=repo)
+    else:
+        project = Project.from_targets(args.paths or None)
+    ctx = Context(project)
+
+    findings = []
+    for sf in project.files:
+        if sf.syntax_error:
+            findings.append(Finding(analyzer="syntax", path=sf.rel, line=1,
+                                    col=0, message=sf.syntax_error))
+    counts = {}
+    for aid in selected:
+        got = reg[aid].run(ctx)
+        counts[aid] = len(got)
+        findings.extend(got)
+    findings = project.finalize(findings)
+
+    if args.update_baseline:
+        baseline_mod.save(findings, args.baseline)
+        print(f"baseline updated: {len(findings)} accepted finding(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    known = {} if args.no_baseline else baseline_mod.load(args.baseline)
+    new, suppressed, stale = baseline_mod.split(findings, known)
+
+    for f in new:
+        print(f.format())
+    # per-analyzer summary (the ci.sh requirement): total/new per analyzer
+    new_by = {}
+    for f in new:
+        new_by[f.analyzer] = new_by.get(f.analyzer, 0) + 1
+    parts = []
+    for aid in selected:
+        n = new_by.get(aid, 0)
+        parts.append(f"{aid}={n}" if n == counts.get(aid, 0)
+                     else f"{aid}={n}(+{counts[aid] - n} suppressed)")
+    dt = time.perf_counter() - t0
+    print(f"analysis: {len(project.files)} files in {dt:.2f}s · "
+          + " ".join(parts))
+    if suppressed:
+        print(f"analysis: {len(suppressed)} baseline-suppressed finding(s)")
+    if stale:
+        print(f"analysis: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (no longer produced — "
+              "consider --update-baseline)")
+    if new:
+        print(f"analysis: FAIL — {len(new)} new finding(s)")
+        return 1
+    print("analysis: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

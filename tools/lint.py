@@ -1,287 +1,48 @@
-"""Offline AST lint gate (ci.sh Style-job analog).
+"""Offline AST lint gate (ci.sh Style-job analog) — thin shim.
 
-The reference's CI runs a dedicated Style job (scalastyle + black,
-pipeline.yaml); this environment has no linters installed, so this tool
-implements the highest-signal checks directly on the AST — the ones that
-catch real NameError/ImportError bugs rather than formatting taste:
+The three original checks (undefined names, unused imports, import cycles)
+now live in the static-analysis framework as analyzers sharing its symbol
+tables and import resolution:
 
-  1. undefined names   — a Name load never bound anywhere in the file and
-                         not a builtin (catches typos that become NameError
-                         on a code path tests may not reach)
-  2. unused imports    — an imported binding never referenced in the file
-                         (dead weight; frequently a refactor leftover)
-  3. import cycles     — strongly-connected components in the intra-package
-                         import graph (break lazily or at call time)
+    tools/analysis/analyzers/names.py     undefined-names
+    tools/analysis/analyzers/imports.py   unused-imports
+    tools/analysis/analyzers/cycles.py    import-cycles
 
-Design choice for zero false positives on (1): the check unions ALL bindings
-in the file (any scope) plus builtins — so it cannot model shadowing
-mistakes, but anything it DOES flag is a genuine unbound name.
-
-Usage: python tools/lint.py [paths...]   (default: synapseml_tpu/ tools/
-bench.py __graft_entry__.py).  Exit 1 on any finding.
+``python tools/lint.py [paths...]`` keeps working with the same exit
+semantics (1 on any finding, no baseline). The full suite — trace-safety,
+recompile, determinism, locks, blocking-io, codegen-drift — runs via
+``python tools/analysis/run.py`` (see docs/static-analysis.md).
 """
 
 from __future__ import annotations
 
-import ast
-import builtins
 import os
 import sys
-from collections import defaultdict
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_BUILTINS = set(dir(builtins)) | {
-    "__file__", "__name__", "__doc__", "__package__", "__spec__",
-    "__loader__", "__builtins__", "__debug__", "__annotations__",
-    "__dict__", "__class__", "__path__", "__version__", "__all__",
-    "WindowsError",  # guarded platform-specific uses
-}
+from tools.analysis.analyzers import Context, registry  # noqa: E402
+from tools.analysis.core import Project                 # noqa: E402
+
+LINT_ANALYZERS = ("undefined-names", "unused-imports", "import-cycles")
 
 
-class _Bindings(ast.NodeVisitor):
-    """Every name the file binds in any scope + every imported binding."""
-
-    def __init__(self):
-        self.bound: set[str] = set()
-        self.imports: dict[str, int] = {}       # name -> lineno
-        self.import_modules: set[str] = set()   # dotted modules imported
-        self._func_depth = 0
-
-    def visit_Import(self, node):
-        for a in node.names:
-            name = a.asname or a.name.split(".")[0]
-            self.bound.add(name)
-            self.imports.setdefault(name, node.lineno)
-            if self._func_depth == 0:   # cycle edges: import-time only —
-                self.import_modules.add(a.name)   # lazy imports break cycles
-
-    def visit_ImportFrom(self, node):
-        for a in node.names:
-            if a.name == "*":
-                continue
-            name = a.asname or a.name
-            self.bound.add(name)
-            if node.module != "__future__":
-                self.imports.setdefault(name, node.lineno)
-        if node.module and self._func_depth == 0:
-            self.import_modules.add("." * node.level + node.module)
-        self.generic_visit(node)
-
-    def _bind_target(self, t):
-        for n in ast.walk(t):
-            if isinstance(n, ast.Name):
-                self.bound.add(n.id)
-
-    def visit_Assign(self, node):
-        for t in node.targets:
-            self._bind_target(t)
-        self.generic_visit(node)
-
-    def visit_AnnAssign(self, node):
-        self._bind_target(node.target)
-        self.generic_visit(node)
-
-    def visit_AugAssign(self, node):
-        self._bind_target(node.target)
-        self.generic_visit(node)
-
-    def visit_NamedExpr(self, node):
-        self._bind_target(node.target)
-        self.generic_visit(node)
-
-    def visit_For(self, node):
-        self._bind_target(node.target)
-        self.generic_visit(node)
-    visit_AsyncFor = visit_For
-
-    def visit_withitem(self, node):
-        if node.optional_vars:
-            self._bind_target(node.optional_vars)
-        self.generic_visit(node)
-
-    def visit_ExceptHandler(self, node):
-        if node.name:
-            self.bound.add(node.name)
-        self.generic_visit(node)
-
-    def visit_comprehension(self, node):
-        self._bind_target(node.target)
-        self.generic_visit(node)
-
-    def _visit_func(self, node):
-        self.bound.add(node.name)
-        a = node.args
-        for arg in (a.posonlyargs + a.args + a.kwonlyargs
-                    + ([a.vararg] if a.vararg else [])
-                    + ([a.kwarg] if a.kwarg else [])):
-            self.bound.add(arg.arg)
-        self._func_depth += 1
-        self.generic_visit(node)
-        self._func_depth -= 1
-    visit_FunctionDef = visit_AsyncFunctionDef = _visit_func
-
-    def visit_ClassDef(self, node):
-        self.bound.add(node.name)
-        self.generic_visit(node)
-
-    def visit_Global(self, node):
-        self.bound.update(node.names)
-
-    def visit_Nonlocal(self, node):
-        self.bound.update(node.names)
-
-    def visit_Lambda(self, node):
-        a = node.args
-        for arg in (a.posonlyargs + a.args + a.kwonlyargs
-                    + ([a.vararg] if a.vararg else [])
-                    + ([a.kwarg] if a.kwarg else [])):
-            self.bound.add(arg.arg)
-        self.generic_visit(node)
-
-
-def lint_file(path: str):
-    with open(path, "rb") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"], set()
-
-    b = _Bindings()
-    b.visit(tree)
+def main(argv) -> int:
+    targets = [a for a in argv[1:] if not a.startswith("-")] or None
+    project = Project.from_targets(targets)
+    ctx = Context(project)
+    reg = registry()
     findings = []
-
-    used: set[str] = set()
-    for n in ast.walk(tree):
-        if isinstance(n, ast.Name):
-            used.add(n.id)
-        elif isinstance(n, ast.Attribute):
-            root = n
-            while isinstance(root, ast.Attribute):
-                root = root.value
-            if isinstance(root, ast.Name):
-                used.add(root.id)
-
-    # 1. undefined names (loads only)
-    for n in ast.walk(tree):
-        if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
-                and n.id not in b.bound and n.id not in _BUILTINS):
-            findings.append(f"{path}:{n.lineno}: undefined name '{n.id}'")
-
-    # 2. unused imports (skip __init__.py re-export surfaces and _-prefixed
-    #    deliberate side-effect imports)
-    if os.path.basename(path) != "__init__.py":
-        # names exported via __all__ strings count as used
-        for n in ast.walk(tree):
-            if (isinstance(n, ast.Assign) and any(
-                    isinstance(t, ast.Name) and t.id == "__all__"
-                    for t in n.targets)):
-                for c in ast.walk(n.value):
-                    if isinstance(c, ast.Constant) and isinstance(c.value,
-                                                                  str):
-                        used.add(c.value)
-        for name, lineno in sorted(b.imports.items(), key=lambda kv: kv[1]):
-            if name not in used and not name.startswith("_"):
-                findings.append(f"{path}:{lineno}: unused import '{name}'")
-
-    return findings, b.import_modules
-
-
-def _module_name(path: str):
-    """(dotted module name, is_package) for a repo file."""
-    rel = os.path.relpath(path, REPO).replace(os.sep, ".")
-    rel = rel[:-3] if rel.endswith(".py") else rel
-    if rel.endswith(".__init__"):
-        return rel[:-9], True
-    return rel, False
-
-
-def _resolve_relative(mod: str, importer: str, is_pkg: bool) -> str:
-    """'..ops.foo' imported from synapseml_tpu.gbdt.grower -> absolute.
-    For a package __init__, level-1 imports resolve against the package
-    itself (no leaf to strip)."""
-    if not mod.startswith("."):
-        return mod
-    level = len(mod) - len(mod.lstrip("."))
-    base = importer.split(".")
-    if not is_pkg:
-        base = base[:-1]            # strip the module leaf
-    if level > 1:
-        base = base[:-(level - 1)]
-    rest = mod.lstrip(".")
-    return ".".join(base + ([rest] if rest else []))
-
-
-def find_cycles(edges: dict) -> list:
-    """Tarjan SCCs of the import graph; only SCCs with >1 node (or a self
-    edge) are cycles."""
-    index, low, onstack, stack = {}, {}, set(), []
-    counter = [0]
-    sccs = []
-
-    def strongconnect(v):
-        index[v] = low[v] = counter[0]
-        counter[0] += 1
-        stack.append(v)
-        onstack.add(v)
-        for w in edges.get(v, ()):  # noqa: B023
-            if w not in index:
-                strongconnect(w)
-                low[v] = min(low[v], low[w])
-            elif w in onstack:
-                low[v] = min(low[v], index[w])
-        if low[v] == index[v]:
-            scc = []
-            while True:
-                w = stack.pop()
-                onstack.discard(w)
-                scc.append(w)
-                if w == v:
-                    break
-            if len(scc) > 1 or v in edges.get(v, ()):
-                sccs.append(sorted(scc))
-
-    sys.setrecursionlimit(10000)
-    for v in list(edges):
-        if v not in index:
-            strongconnect(v)
-    return sccs
-
-
-def main(argv):
-    targets = argv[1:] or ["synapseml_tpu", "tools", "bench.py",
-                           "__graft_entry__.py", "tests"]
-    files = []
-    for t in targets:
-        t = os.path.join(REPO, t) if not os.path.isabs(t) else t
-        if os.path.isfile(t):
-            files.append(t)
-        else:
-            for root, dirs, names in os.walk(t):
-                dirs[:] = [d for d in dirs if d != "__pycache__"]
-                files.extend(os.path.join(root, n) for n in names
-                             if n.endswith(".py"))
-
-    all_findings = []
-    edges = defaultdict(set)
-    for path in sorted(files):
-        findings, mods = lint_file(path)
-        all_findings.extend(findings)
-        importer, is_pkg = _module_name(path)
-        if importer.startswith("synapseml_tpu"):
-            for m in mods:
-                m = _resolve_relative(m, importer, is_pkg)
-                if m.startswith("synapseml_tpu"):
-                    edges[importer].add(m)
-
-    for scc in find_cycles(edges):
-        all_findings.append("import cycle: " + " <-> ".join(scc))
-
-    for f in all_findings:
+    for sf in project.files:
+        if sf.syntax_error:
+            findings.append(f"{sf.rel}:1: {sf.syntax_error}")
+    for aid in LINT_ANALYZERS:
+        findings.extend(f.format()
+                        for f in project.finalize(reg[aid].run(ctx)))
+    for f in findings:
         print(f)
-    print(f"lint: {len(files)} files, {len(all_findings)} findings")
-    return 1 if all_findings else 0
+    print(f"lint: {len(project.files)} files, {len(findings)} findings")
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

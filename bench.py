@@ -43,6 +43,10 @@ BASELINE_SERVING_P50_MS = 1.0
 # overhead at the reference's serving layer — the comparable end-to-end
 # request latency, not the bare model step
 BASELINE_RESNET_SERVING_P50_MS = 5.0
+# measured pre-bucketing serving throughput at 16 concurrent keep-alive
+# clients (per-observed-shape recompiles + polling serve loop); the serving
+# perf guard (ci.sh) checks the BucketedRunner pipeline clears 2x this
+BASELINE_SERVING_REQS_PER_SEC = 98.0
 # BERT-base seq-128 fine-tune: ~100 ex/s is V100-class mixed-precision
 # training throughput (the reference's DeepTextClassifier hardware);
 # onnxruntime-gpu BERT-base batch inference on the same class: ~400 seq/s
@@ -398,25 +402,37 @@ def _gbdt_serving_handler():
     from synapseml_tpu.gbdt import BoosterConfig, Dataset, train_booster
 
     cpu = _serving_cpu_device()
-    ctx = (jax.default_device(cpu) if cpu is not None
-           else contextlib.nullcontext())
+    # factory, not one instance: jax.default_device() context managers are
+    # single-use (generator-based) — re-entering one raises AttributeError
+    mkctx = ((lambda: jax.default_device(cpu)) if cpu is not None
+             else contextlib.nullcontext)
     rng = np.random.default_rng(0)
     Xtr = rng.normal(size=(4000, 8)).astype(np.float32)
     ytr = (Xtr[:, 0] * Xtr[:, 1] + 0.5 * Xtr[:, 2] > 0).astype(np.float32)
-    with ctx:
+    with mkctx():
         booster = train_booster(
             Dataset(Xtr, ytr), None,
             BoosterConfig(objective="binary", num_iterations=50,
                           num_leaves=31))
-        predict = booster.serving_fn()    # ONE fused dispatch per batch
-        np.asarray(predict(Xtr[:1]))      # compile before serving
+        # bucketed serving path (core/inference.py): one fused dispatch per
+        # batch, one AOT-compiled executable per bucket — zero steady-state
+        # recompiles regardless of the observed micro-batch sizes
+        predict = booster.serving_fn(max_batch_size=32)
 
     def handler(df: Table) -> Table:
         x = np.asarray([v["x"] for v in df["value"]], np.float32)
-        with ctx:
+        with mkctx():
             out = np.asarray(predict(x))
         return Table({"id": df["id"], "reply": out.astype(np.float64)})
 
+    def _warm():
+        with mkctx():
+            return predict.warmup()
+
+    # ServingServer.start() warms the whole bucket ladder through this hook
+    # before the listener opens; the metrics GET surfaces runner.stats()
+    handler.warmup = _warm
+    handler.runner = predict.runner
     return handler
 
 
@@ -436,24 +452,34 @@ def _resnet_serving_handler():
     from synapseml_tpu.onnx.protoio import Model
 
     cpu = _serving_cpu_device()
-    ctx = (jax.default_device(cpu) if cpu is not None
-           else contextlib.nullcontext())
+    # single-use CMs: build one per entry (see _gbdt_serving_handler)
+    mkctx = ((lambda: jax.default_device(cpu)) if cpu is not None
+             else contextlib.nullcontext)
     path = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
                          "tests", "resources", "onnx", "torch_resnet50.onnx")
     with open(path, "rb") as f:
         fn = OnnxFunction(Model.parse(f.read()))
     jf, names = fn.as_jax()
-    jitted = jax.jit(jf)
-    with ctx:
-        jitted(np.zeros((1, 3, 64, 64), np.float32))     # compile
+    # coarse 2-rung ladder (1, 8): the latency probe serves single images,
+    # so warmup compiles the 53-conv net twice, not once per power of two
+    from synapseml_tpu.core.inference import BucketedRunner
+
+    runner = BucketedRunner(jf, max_batch_size=8, growth=8.0,
+                            name="bench.resnet_serving")
 
     def handler(df: Table) -> Table:
         x = np.asarray([v["x"] for v in df["value"]], np.float32)
-        with ctx:
-            out = np.asarray(jitted(x)[0])
+        with mkctx():
+            out = np.asarray(runner(x)[0])
         return Table({"id": df["id"],
                       "reply": [r.tolist() for r in out]})
 
+    def _warm():
+        with mkctx():
+            return runner.warmup(np.zeros((1, 3, 64, 64), np.float32))
+
+    handler.warmup = _warm
+    handler.runner = runner
     return handler
 
 
@@ -505,9 +531,10 @@ def bench_serving(n_requests=200):
     # latency-optimized serving config: no artificial batch-formation wait
     # (batches still form under concurrent backlog); keep-alive client
     # connection as any production caller would hold
-    server = ServingServer(_gbdt_serving_handler(), host="127.0.0.1",
+    handler = _gbdt_serving_handler()
+    server = ServingServer(handler, host="127.0.0.1",
                            port=0, max_batch_size=32, max_batch_latency=0.0)
-    server.start()
+    server.start()     # AOT-warms the bucket ladder before the listener opens
     try:
         p50, p99 = _measure_latency(server.port, server.api_path, n_requests)
         payload = _SERVING_PAYLOAD
@@ -547,9 +574,26 @@ def bench_serving(n_requests=200):
             raise RuntimeError(f"serving concurrency: only {done}/"
                                f"{n_threads * per} requests succeeded")
         rps = done / (time.perf_counter() - t0)
+        stats = handler.runner.stats()
+        steady_compiles = stats["total_compiles"] - stats["warmup_compiles"]
+        if steady_compiles:
+            raise RuntimeError(
+                "serving perf contract broken: %d post-warmup XLA compiles "
+                "(per-bucket counts: %s)" % (steady_compiles,
+                                             stats["compiles"]))
+        # throughput is its own recorded artifact (the CI serving perf guard
+        # and the 2x acceptance floor read this metric, not the unit string)
+        record_measurement({
+            "metric": "serving_requests_per_sec", "value": round(rps, 1),
+            "unit": "req/s (@%d concurrent keep-alive clients; per-bucket "
+                    "compiles %s; %d warmup / 0 steady-state)" % (
+                        n_threads, stats["compiles"],
+                        stats["warmup_compiles"]),
+            "vs_baseline": round(rps / BASELINE_SERVING_REQS_PER_SEC, 3)})
         return {"metric": "serving_latency_p50_ms", "value": round(p50, 3),
                 "unit": "ms (gbdt forest 50x31; p99=%.3f; %.0f req/s @%d "
-                        "concurrent)" % (p99, rps, n_threads),
+                        "concurrent; buckets %s all pre-compiled)" % (
+                            p99, rps, n_threads, stats["buckets"]),
                 "vs_baseline": round(BASELINE_SERVING_P50_MS / max(p50, 1e-9), 3)}
     finally:
         server.stop()
@@ -587,6 +631,7 @@ MEASUREMENTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # artifacts hold on-chip numbers (round-3 policy, now enforced in code
 # instead of by manual cleanup).
 _HOST_SIDE_METRICS = frozenset({"serving_latency_p50_ms",
+                                "serving_requests_per_sec",
                                 "serving_resnet50_latency_p50_ms",
                                 "serving_distributed_latency_p50_ms",
                                 "gbdt_voting_vs_data_parallel_speedup"})

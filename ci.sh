@@ -55,4 +55,29 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 echo "== checkpoint overhead guardrail (save/restore must stay cheap) =="
 JAX_PLATFORMS=cpu python bench.py --only bench_checkpoint_overhead
 
+echo "== serving perf guard (bucketed runner: zero steady-state recompiles) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_inference_runtime.py -x -q
+JAX_PLATFORMS=cpu python - << 'EOF'
+# end-to-end contract check: a warmed BucketedRunner-backed server must not
+# compile after warmup no matter what batch sizes arrive (the per-shape
+# recompile regression this PR removes; docs/serving-perf.md)
+import numpy as np
+from synapseml_tpu.core.inference import BucketedRunner
+
+runner = BucketedRunner(lambda x: x * 2.0 + 1.0, max_batch_size=64,
+                        name="ci.guard")
+runner.warmup(np.zeros((1, 8), np.float32))
+warm = runner.stats()
+assert warm["total_compiles"] == len(warm["buckets"]), warm
+rng = np.random.default_rng(0)
+for n in rng.integers(1, 200, size=50):
+    runner(rng.normal(size=(int(n), 8)).astype(np.float32))
+after = runner.stats()
+steady = after["total_compiles"] - after["warmup_compiles"]
+assert steady == 0, f"{steady} steady-state compiles: {after}"
+print(f"serving perf guard ok: buckets={after['buckets']} "
+      f"compiles={after['total_compiles']} (all warmup) "
+      f"hits={after['total_hits']}")
+EOF
+
 echo "CI OK"

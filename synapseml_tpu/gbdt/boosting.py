@@ -273,6 +273,9 @@ class Booster:
         self.missing_types = missing_types
         self._forest_cache: Optional[Forest] = None
         self._depth_cache: Optional[int] = None
+        # bucketed serving runners keyed by max_batch_size (serving_fn /
+        # batched predict share the same compiled bucket ladder)
+        self._serving_cache: dict = {}
 
     # --- structure ------------------------------------------------------
     @property
@@ -368,14 +371,24 @@ class Booster:
         return self._forest_cache
 
     # --- inference ------------------------------------------------------
-    def serving_fn(self):
-        """ONE fused jitted callable ``X (N, F) -> prediction`` for
-        low-latency serving: forest traversal, base score, and the
-        objective's output transform compiled into a single XLA program —
-        one device dispatch per request batch instead of predict()'s
-        traversal + transform round trips. This is the handler-side analog
-        of the reference's served fitted models (README Spark Serving cell;
-        HTTPSourceV2.scala:485-713 transport + a model transform)."""
+    def serving_fn(self, max_batch_size: int = 64, bucketed: bool = True):
+        """Callable ``X (N, F) -> prediction`` for low-latency serving:
+        forest traversal, base score, and the objective's output transform
+        compiled into a single XLA program — one device dispatch per request
+        batch instead of predict()'s traversal + transform round trips. This
+        is the handler-side analog of the reference's served fitted models
+        (README Spark Serving cell; HTTPSourceV2.scala:485-713 transport +
+        a model transform).
+
+        By default the fused program runs through a shape-bucketed runner
+        (core/inference.py, docs/serving-perf.md): batches pad up to a
+        geometric ladder of bucket sizes so XLA compiles once per bucket —
+        not once per observed batch size — with padded rows masked out of
+        the result. The returned callable carries ``.runner`` (per-bucket
+        compile/hit counters) and ``.warmup()`` (AOT-compile every bucket;
+        ServingServer.start() calls it before accepting traffic).
+        ``bucketed=False`` returns the raw fused jit for callers that manage
+        their own shapes."""
         import jax
 
         forest = self.forest()
@@ -388,7 +401,6 @@ class Booster:
         # different probabilities than predict())
         start = max(int(getattr(self.config, "start_iteration", 0)), 0)
 
-        @jax.jit
         def fn(X):
             if k == 1 and not start and not self.average_output:
                 raw = forest_predict(forest, X, output="sum",
@@ -409,7 +421,26 @@ class Booster:
                     raw = raw[:, 0]
             return obj.transform(raw)
 
-        return fn
+        if not bucketed:
+            return jax.jit(fn)
+
+        from ..core.inference import BucketedRunner
+
+        # fn is deliberately NOT pre-jitted here: the runner owns the jit
+        # boundary (one AOT-compiled executable per bucket)
+        runner = BucketedRunner(fn, max_batch_size=max_batch_size,
+                                name="gbdt.serving_fn")
+        num_features = self.mapper.num_features
+
+        def serve(X):
+            return runner(np.asarray(X))
+
+        def warmup(dtype=np.float32):
+            return runner.warmup(np.zeros((1, num_features), dtype))
+
+        serve.runner = runner
+        serve.warmup = warmup
+        return serve
 
     def raw_score(self, X, binned: bool = False, num_iteration: int = -1,
                   start_iteration: Optional[int] = None) -> np.ndarray:
@@ -451,9 +482,27 @@ class Booster:
         out = per_iter.sum(axis=1) + self.base_score[None, :k]
         return np.asarray(out[:, 0] if k == 1 else out)
 
-    def predict(self, X, binned: bool = False,
-                num_iteration: int = -1) -> np.ndarray:
-        """Probability / response-space prediction."""
+    def predict(self, X, binned: bool = False, num_iteration: int = -1,
+                batch_size: Optional[int] = None) -> np.ndarray:
+        """Probability / response-space prediction.
+
+        ``batch_size`` routes batch predict through the shared bucketed
+        serving runner (core/inference.py): rows are processed in
+        ``batch_size`` chunks with a bucket-padded tail, so repeated calls
+        with varying N reuse one compiled ladder instead of compiling a
+        fresh XLA program per observed shape. The runner is cached per
+        ``batch_size``, shared with ``serving_fn(max_batch_size=...)``."""
+        if batch_size is not None:
+            if binned or (num_iteration and num_iteration > 0):
+                raise ValueError(
+                    "predict(batch_size=...) serves the full raw-value "
+                    "model; binned inputs or an iteration window need the "
+                    "unbatched path")
+            serve = self._serving_cache.get(batch_size)
+            if serve is None:
+                serve = self.serving_fn(max_batch_size=batch_size)
+                self._serving_cache[batch_size] = serve
+            return serve(_densify(X))
         raw = self.raw_score(X, binned=binned, num_iteration=num_iteration)
         obj = self._objective_for_transform()
         return np.asarray(obj.transform(jnp.asarray(raw)))

@@ -29,6 +29,25 @@ tests/test_chaos_serving.py via testing/chaos.py):
 ``ServingServer.metrics`` exposes queue depth/age gauges and shed/error/
 deadline counters; the same events also land in the process-wide
 ``core.logging`` failure counters.
+
+Throughput model (docs/serving-perf.md; perf-tested by
+tests/test_inference_runtime.py):
+
+* **Two-stage pipeline** — the serve loop only *forms* batches (queue drain
+  + JSON decode already happened on the connection threads; here it is
+  deadline triage + Table assembly) and hands them to a dedicated executor
+  thread through a depth-1 handoff, so batch N+1's formation overlaps batch
+  N's handler/device execution and reply encoding.
+* **Blocking batch window** — batch formation waits on
+  ``queue.get(timeout=remaining_window)`` instead of a sleep/poll spin: no
+  burned CPU inside the window and less jitter at low load.
+* **Shape-bucketed handlers** — a handler built on
+  :class:`~synapseml_tpu.core.inference.BucketedRunner` (e.g.
+  ``Booster.serving_fn()``) compiles one XLA program per bucket instead of
+  one per observed batch size; ``start()`` invokes the handler's
+  ``warmup()`` (when it has one) so every bucket is compiled before the
+  first request, and the metrics GET surfaces the runner's per-bucket
+  compile/hit counters under ``"runner"``.
 """
 
 from __future__ import annotations
@@ -117,17 +136,38 @@ def request_to_table(requests: List[_PendingRequest]) -> Table:
 def respond_with(df: Table, id_col: str = "id", value_col: str = "reply",
                  status_col: Optional[str] = None) -> Dict[str, tuple]:
     """Table → {request id: (status, body)} — the reply-UDF analog
-    (ServingUDFs.scala makeReplyUDF)."""
+    (ServingUDFs.scala makeReplyUDF).
+
+    Column lookups are hoisted out of the per-row loop, and homogeneous
+    numeric reply columns take a single vectorized ``tolist()`` pass (one
+    device→host materialization + one bulk conversion) instead of per-row
+    numpy indexing + scalar boxing — the reply-encode side of the serving
+    hot path."""
+    ids = df[id_col].tolist()
+    col = df[value_col]
+    n = df.num_rows
+    if status_col and status_col in df:
+        statuses = [int(s) for s in df[status_col].tolist()]
+    else:
+        statuses = None
+    if col.dtype != object:
+        # homogeneous numeric/bool column (scalar or fixed-width vector
+        # replies): one bulk pass yields plain Python values json.dumps
+        # takes directly
+        vals = col.tolist()
+    else:
+        vals = []
+        for v in col:
+            if isinstance(v, np.ndarray):
+                v = v.tolist()
+            elif isinstance(v, np.generic):
+                v = v.item()
+            vals.append(v)
     out = {}
-    statuses = df[status_col] if status_col and status_col in df else None
-    for i in range(df.num_rows):
-        val = df[value_col][i]
-        if isinstance(val, np.ndarray):
-            val = val.tolist()
-        elif isinstance(val, np.generic):
-            val = val.item()
-        status = int(statuses[i]) if statuses is not None else 200
-        out[str(df[id_col][i])] = (status, _json.dumps(val).encode())
+    dumps = _json.dumps
+    for i in range(n):
+        status = statuses[i] if statuses is not None else 200
+        out[str(ids[i])] = (status, dumps(vals[i]).encode())
     return out
 
 
@@ -153,7 +193,8 @@ class ServingServer:
                  reply_timeout: float = 30.0,
                  max_queue_size: int = 1024,
                  isolate_failures: bool = True,
-                 drain_timeout: float = 10.0):
+                 drain_timeout: float = 10.0,
+                 warmup: bool = True):
         self.handler = handler
         self.host, self.port = host, port
         self.api_path = api_path
@@ -163,13 +204,19 @@ class ServingServer:
         self.max_queue_size = max_queue_size
         self.isolate_failures = isolate_failures
         self.drain_timeout = drain_timeout
+        self.warmup = warmup
         self._queue: "queue.Queue[_PendingRequest]" = queue.Queue(
             maxsize=max_queue_size)
+        # two-stage pipeline handoff (batch formation → execution): depth 1
+        # lets the serve loop form batch N+1 while the executor runs batch N
+        self._handoff: "queue.Queue" = queue.Queue(maxsize=1)
         self.metrics = ServingMetrics(self._queue)
         self._stop = threading.Event()
         self._draining = threading.Event()
-        self._idle = threading.Event()   # serve loop between batches
+        self._idle = threading.Event()   # no batch forming/queued/executing
         self._idle.set()
+        self._inflight_stages = 0        # guarded by _stage_lock
+        self._stage_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
         try:
@@ -269,9 +316,16 @@ class ServingServer:
                 self.wfile.write(payload)
 
             def do_GET(self):  # noqa: N802  — metrics/health endpoint
-                body = _json.dumps({
-                    "draining": outer._draining.is_set(),
-                    **outer.metrics.snapshot()}).encode()
+                snap = {"draining": outer._draining.is_set(),
+                        **outer.metrics.snapshot()}
+                # a BucketedRunner-backed handler surfaces its per-bucket
+                # compile/hit counters (zero steady-state compiles after
+                # warmup is the serving perf contract)
+                runner = getattr(outer.handler, "runner", None)
+                if runner is not None and callable(
+                        getattr(runner, "stats", None)):
+                    snap["runner"] = runner.stats()
+                body = _json.dumps(snap).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -353,32 +407,70 @@ class ServingServer:
                     {"error": str(e)}).encode())
         return replies
 
+    # two-stage idle accounting: _idle is set only when no stage holds work
+    # (forming, queued in the handoff, or executing) — drain() relies on it
+    def _stage_enter(self) -> None:
+        with self._stage_lock:
+            self._inflight_stages += 1
+            self._idle.clear()
+
+    def _stage_exit(self) -> None:
+        with self._stage_lock:
+            self._inflight_stages -= 1
+            if self._inflight_stages == 0:
+                self._idle.set()
+
     def _serve_loop(self) -> None:
-        """Micro-batch trigger: drain queue → handler → reply by id."""
+        """Stage 1 — micro-batch formation: drain queue → batch → handoff.
+
+        Execution happens on the dedicated stage-2 thread (_exec_loop), so
+        forming batch N+1 (queue drain + deadline triage; the JSON decode /
+        ``np`` assembly follows in request_to_table) overlaps batch N's
+        handler/device execution and reply encoding."""
         while True:
             batch: List[_PendingRequest] = []
             try:
                 batch.append(self._queue.get(timeout=0.05))
             except queue.Empty:
                 if self._stop.is_set():
+                    self._handoff.put(None)   # release stage 2, then exit
                     return          # stopped AND queue drained: loop exits
                 continue
-            self._idle.clear()
+            self._stage_enter()     # forming
             try:
                 # drain the existing backlog for free (batching under load
-                # costs no latency), then optionally wait out the
-                # batch-formation window
+                # costs no latency), then wait out the remaining
+                # batch-formation window BLOCKED on the queue (no poll spin:
+                # batch formation costs no CPU and no sleep-quantum jitter)
                 deadline = time.monotonic() + self.max_batch_latency
                 while len(batch) < self.max_batch_size:
                     try:
                         batch.append(self._queue.get_nowait())
+                        continue
                     except queue.Empty:
-                        if time.monotonic() >= deadline:
-                            break
-                        time.sleep(0.0005)
+                        pass
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(self._queue.get(timeout=remaining))
+                    except queue.Empty:
+                        break       # window elapsed with no new arrivals
+                self._stage_enter()           # batch now owned by stage 2
+                self._handoff.put(batch)
+            finally:
+                self._stage_exit()  # formation done
+
+    def _exec_loop(self) -> None:
+        """Stage 2 — execution: handoff → handler → reply by id."""
+        while True:
+            batch = self._handoff.get()
+            if batch is None:
+                return
+            try:
                 self._run_batch(batch)
             finally:
-                self._idle.set()
+                self._stage_exit()
 
     def start(self) -> "ServingServer":
         class _Server(ThreadingHTTPServer):
@@ -386,14 +478,22 @@ class ServingServer:
             request_queue_size = 256
             daemon_threads = True
 
+        # AOT warmup BEFORE the listener opens: a BucketedRunner-backed
+        # handler (Booster.serving_fn(), docs/serving-perf.md) compiles its
+        # whole bucket ladder here, so no request ever waits on XLA
+        warm = getattr(self.handler, "warmup", None)
+        if self.warmup and callable(warm):
+            warm()
         self._httpd = _Server((self.host, self.port),
                               self._make_handler_class())
         self.port = self._httpd.server_address[1]  # resolve port 0
         t1 = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t2 = threading.Thread(target=self._serve_loop, daemon=True)
+        t3 = threading.Thread(target=self._exec_loop, daemon=True)
         t1.start()
         t2.start()
-        self._threads = [t1, t2]
+        t3.start()
+        self._threads = [t1, t2, t3]
         return self
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -417,9 +517,11 @@ class ServingServer:
         if drain and not self._stop.is_set():
             self.drain(drain_timeout)
         self._stop.set()
-        serve_thread = self._threads[1] if len(self._threads) > 1 else None
-        if serve_thread is not None and serve_thread.is_alive():
-            serve_thread.join(timeout=1.0)
+        # join stage 1 (which releases stage 2 via the None sentinel), then
+        # stage 2; both are daemons, so a wedged handler cannot block exit
+        for t in self._threads[1:]:
+            if t.is_alive():
+                t.join(timeout=1.0)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

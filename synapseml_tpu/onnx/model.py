@@ -52,12 +52,12 @@ class ONNXModel(Transformer):
     # class-level defaults so instances materialized by save/load or copy
     # (which bypass __init__) still lazy-init their caches
     _fn_cache: Optional[OnnxFunction] = None
-    _jit_cache: Optional[dict] = None
+    _runner_cache: Optional[dict] = None
 
     # --- model loading (reference setModelLocation / setModelPayload) ----
     def setModelPayload(self, payload: bytes) -> "ONNXModel":
         self._fn_cache = None
-        self._jit_cache = {}
+        self._runner_cache = {}
         return self.set("modelPayload", payload)
 
     def setModelLocation(self, path: str) -> "ONNXModel":
@@ -87,7 +87,7 @@ class ONNXModel(Transformer):
         if (self._fn_cache is not None
                 and self._fn_cache.precision != self.getFloatPrecision()):
             self._fn_cache = None
-            self._jit_cache = None
+            self._runner_cache = None
         if self._fn_cache is None:
             payload = self.get("modelPayload")
             if payload is None:
@@ -113,8 +113,6 @@ class ONNXModel(Transformer):
 
     # --- execution -------------------------------------------------------
     def _transform(self, df: Table) -> Table:
-        import jax
-
         fn = self._onnx_fn()
         feed: Dict[str, str] = self.get("feedDict") or {
             n: n for n in fn.graph_inputs}
@@ -135,37 +133,39 @@ class ONNXModel(Transformer):
         n = df.num_rows
         bs = min(self.getMiniBatchSize(), max(n, 1))
         names = list(cols)
-        jfn = self._jit_for(fn, names)
-
-        chunks: Dict[str, List[np.ndarray]] = {o: [] for o in fn.outputs}
-        for start in range(0, n, bs):
-            batch = [cols[m][start:start + bs] for m in names]
-            pad = bs - batch[0].shape[0]
-            if pad:  # pad the tail batch so jit sees one shape
-                batch = [np.concatenate([b, np.repeat(b[-1:], pad, axis=0)])
-                         for b in batch]
-            res = jfn(*batch)
-            for o, r in zip(fn.outputs, res):
-                r = np.asarray(r)
-                chunks[o].append(r[:bs - pad] if pad else r)
 
         out = df.copy()
-        for o in fn.outputs:
-            col_name = out_of.get(o, o)
-            val = (np.concatenate(chunks[o], axis=0) if chunks[o]
-                   else np.zeros((0,)))
-            out[col_name] = val
+        if n == 0:
+            for o in fn.outputs:
+                out[out_of.get(o, o)] = np.zeros((0,))
+            return self._post_transforms(out)
+
+        # mini-batched execution through the shared bucketed runner
+        # (core/inference.py): full miniBatchSize chunks plus a bucket-padded
+        # tail — the tail pads to a small shape ladder (padded rows are a
+        # vectorized last-row gather, sliced back off the outputs) instead of
+        # the old np.repeat duplication up to the full batch size, and each
+        # bucket's XLA program compiles exactly once per model
+        runner = self._runner_for(fn, names, bs)
+        res = runner(*[cols[m] for m in names])
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        for o, r in zip(fn.outputs, res):
+            out[out_of.get(o, o)] = np.asarray(r)
         return self._post_transforms(out)
 
-    def _jit_for(self, fn: OnnxFunction, names: List[str]):
-        import jax
+    def _runner_for(self, fn: OnnxFunction, names: List[str],
+                    batch_size: int):
+        from ..core.inference import BucketedRunner
 
-        if self._jit_cache is None:
-            self._jit_cache = {}
-        key = tuple(names) + tuple(fn.outputs)
-        if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(fn.as_jax(names)[0])
-        return self._jit_cache[key]
+        if self._runner_cache is None:
+            self._runner_cache = {}
+        key = (tuple(names), tuple(fn.outputs), batch_size)
+        if key not in self._runner_cache:
+            self._runner_cache[key] = BucketedRunner(
+                fn.as_jax(names)[0], max_batch_size=batch_size,
+                name="onnx.model")
+        return self._runner_cache[key]
 
     def _post_transforms(self, df: Table) -> Table:
         import jax

@@ -171,6 +171,78 @@ def test_recompile_flags_jit_in_loop(tmp_path):
     assert "inside a loop" in found[0].message
 
 
+def test_recompile_flags_jitted_call_in_serving_handler(tmp_path):
+    # R5: a jitted callee fed request-sized micro-batches from a serving
+    # handler recompiles once per observed batch size
+    ctx = _ctx(tmp_path, {"synapseml_tpu/srv.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        from synapseml_tpu.io.serving import ServingServer
+
+        @jax.jit
+        def predict(x):
+            return jnp.tanh(x)
+
+        def handler(df):
+            return predict(df["value"])
+
+        server = ServingServer(handler)
+        """})
+    found = recompile.run(ctx)
+    assert len(found) == 1
+    assert "every distinct batch size" in found[0].message
+    assert "BucketedRunner" in found[0].message
+
+
+def test_recompile_flags_factory_built_serving_handler(tmp_path):
+    # the handler is returned by a local factory: defs nested in the factory
+    # are scanned too (the bench/_gbdt_serving_handler construction shape)
+    ctx = _ctx(tmp_path, {"synapseml_tpu/srv.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        from synapseml_tpu.io.serving import ServingServer
+
+        @jax.jit
+        def score(x):
+            return jnp.tanh(x)
+
+        def build_handler(scale):
+            def handler(df):
+                return score(df["value"]) * scale
+
+            return handler
+
+        server = ServingServer(handler=build_handler(2.0))
+        """})
+    found = recompile.run(ctx)
+    assert len(found) == 1
+    assert "ServingServer handler" in found[0].message
+
+
+def test_recompile_allows_runner_backed_serving_handler(tmp_path):
+    # routed through BucketedRunner: the runner owns the jit boundary, the
+    # handler's call resolves to no traced project function — R5 stays quiet
+    ctx = _ctx(tmp_path, {"synapseml_tpu/srv.py": """\
+        import numpy as np
+
+        from synapseml_tpu.core.inference import BucketedRunner
+        from synapseml_tpu.io.serving import ServingServer
+
+        def _affine(x):
+            return x * 2.0 + 1.0
+
+        runner = BucketedRunner(_affine, max_batch_size=64)
+
+        def handler(df):
+            return runner(np.asarray(df["value"]))
+
+        server = ServingServer(handler)
+        """})
+    assert recompile.run(ctx) == []
+
+
 def test_recompile_allows_hoisted_and_cached_wrappers(tmp_path):
     ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
         import jax

@@ -16,6 +16,15 @@ anything — the profile just quietly fills with `jit_` compilations:
   where ``i`` is the enclosing loop variable: the argument shape changes
   every iteration, so every iteration compiles a new program (pad to a
   fixed shape or use ``lax.dynamic_slice``).
+* **R5 shape-unstable serving handler** — a call into a known-jitted
+  function from inside a ``ServingServer``/``DistributedServingServer``
+  handler (the function passed at the construction site, including one
+  returned by a local factory): request-driven micro-batches have
+  essentially arbitrary sizes, so a jitted callee whose batch dimension is
+  not routed through ``core.inference.BucketedRunner`` recompiles once per
+  observed batch size. Calls through a runner instance (a plain variable)
+  resolve to no project function and pass; intentional direct sites take a
+  ``# lint-ok: recompile`` escape.
 """
 
 from __future__ import annotations
@@ -23,7 +32,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional, Set
 
-from ..core import Finding, dotted_name
+from ..core import Finding, dotted_name, walk_calls
 from ..jitmap import is_jit_like
 
 ID = "recompile"
@@ -148,8 +157,77 @@ class _Walker(ast.NodeVisitor):
         self.generic_visit(call)
 
 
+# ---------------------------------------------------------------------- R5
+#: serving entry points whose first argument is the micro-batch handler
+_SERVING_CLASSES = frozenset({"ServingServer", "DistributedServingServer"})
+
+
+def _handler_infos(sf, call: ast.Call) -> list:
+    """FunctionInfos for the handler passed to a serving construction site:
+    a Name referencing a local module-level def, or every def nested in a
+    factory function when the argument is ``factory(...)``."""
+    arg = None
+    if call.args:
+        arg = call.args[0]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "handler":
+                arg = kw.value
+                break
+    infos = sf.symbols.functions
+    if isinstance(arg, ast.Name):
+        return [i for i in infos.values()
+                if i.qualname.split(".")[-1] == arg.id
+                and "." not in i.qualname]
+    if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name):
+        factory = [i for i in infos.values() if i.qualname == arg.func.id]
+        if factory:
+            prefix = factory[0].qualname + "."
+            return [i for i in infos.values()
+                    if i.qualname.startswith(prefix)]
+    return []
+
+
+def _serving_handler_pass(ctx, sf, findings: List[Finding]) -> None:
+    """R5 — shape-stability of jitted calls reachable from serving handlers:
+    every direct call from a handler body into a known-jitted function (or a
+    jit wrapper built inline) is one XLA compile PER OBSERVED BATCH SIZE."""
+    jitmap = ctx.jitmap
+    seen: set = set()
+    for call in walk_calls(sf.tree):
+        canon = ctx.project.canonical(sf, dotted_name(call.func))
+        if not canon or canon.split(".")[-1] not in _SERVING_CLASSES:
+            continue
+        for info in _handler_infos(sf, call):
+            if id(info.node) in seen:
+                continue
+            seen.add(id(info.node))
+            for inner in jitmap._calls_in_body(info):
+                if not (inner.args or inner.keywords):
+                    continue
+                inner_canon = ctx.project.canonical(
+                    sf, dotted_name(inner.func))
+                callee = jitmap.resolve_callee(sf, info, inner)
+                jitted = (callee is not None
+                          and callee.full_name in jitmap.traced
+                          and jitmap.traced[callee.full_name].direct)
+                if jitted or is_jit_like(inner_canon):
+                    target = inner_canon or dotted_name(inner.func) or "call"
+                    findings.append(Finding(
+                        analyzer=ID, path=sf.rel, line=inner.lineno,
+                        col=inner.col_offset,
+                        message=f"`{target}(...)` is jitted and reachable "
+                                "from a ServingServer handler with a "
+                                "request-sized batch: every distinct batch "
+                                "size is a fresh XLA compile — route the "
+                                "batch dimension through core.inference."
+                                "BucketedRunner (e.g. Booster.serving_fn()) "
+                                "or mark the site `# lint-ok: recompile`"))
+
+
 def run(ctx) -> List[Finding]:
     findings: List[Finding] = []
     for sf in ctx.files_under(SCOPE):
         _Walker(ctx.project, sf, ctx.jitmap, findings).visit(sf.tree)
+        _serving_handler_pass(ctx, sf, findings)
     return findings

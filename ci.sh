@@ -37,8 +37,19 @@ assert any(n.endswith(".so") for n in names), "native lib missing from wheel"
 print(f"wheel ok: {whl[0]} ({len(names)} files)")
 EOF
 
-echo "== static analysis (trace-safety / recompile / determinism / locks / blocking-io / codegen-drift) =="
-JAX_PLATFORMS=cpu python tools/analysis/run.py
+echo "== static analysis (trace-safety / recompile / determinism / locks / blocking-io / collectives / sharding / donation / resource-discipline / codegen-drift) =="
+# parallel analyzers + incremental cache: repeat runs on an unchanged tree
+# are near-free; the budget asserts the cache/pool plumbing stays effective
+# (generous enough for a cold cache on a loaded CI box)
+_sa_t0=$(date +%s)
+JAX_PLATFORMS=cpu python tools/analysis/run.py --jobs 4 --cache
+_sa_dt=$(( $(date +%s) - _sa_t0 ))
+echo "static analysis wall time: ${_sa_dt}s"
+if [ "${_sa_dt}" -gt 120 ]; then
+    echo "static analysis exceeded its 120s budget (${_sa_dt}s) — the" \
+         "incremental cache or analyzer perf has regressed" >&2
+    exit 1
+fi
 
 echo "== unit tests (8-device CPU mesh) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

@@ -14,6 +14,22 @@ import functools
 
 import jax
 
+def donate_argnums_if_supported(*argnums):
+    """``donate_argnums`` to pass to ``jax.jit``, or ``()`` on CPU.
+
+    Buffer donation is a silent no-op on CPU: jax logs a warning per call
+    and keeps both buffers, which buries real warnings in CI logs and
+    makes the donation path untested. Gating through this helper turns
+    donation off where it cannot work and keeps the aliasing behaviour
+    identical on TPU/GPU. Call it lazily (inside a cached jit factory,
+    like ``BucketedRunner``) — at module import it would force backend
+    initialisation.
+    """
+    if jax.default_backend() in ("cpu",):
+        return ()
+    return tuple(argnums)
+
+
 if hasattr(jax, "shard_map"):
     shard_map = jax.shard_map
 else:

@@ -8,11 +8,16 @@ forward is a managed subprocess.
 
 from __future__ import annotations
 
+import atexit
 import shutil
 import subprocess
 from typing import Dict, Optional
 
 _forwards: Dict[int, subprocess.Popen] = {}
+
+# a notebook that never calls stop_forwarding would otherwise leave ssh
+# children running (and unreaped) past interpreter exit
+atexit.register(lambda: stop_forwarding())
 
 
 def forward_port(remote_host: str, remote_port: int, local_port: int,

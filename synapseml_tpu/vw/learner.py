@@ -23,14 +23,14 @@ as an XLA program:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.compat import shard_map
+from ..core.compat import donate_argnums_if_supported, shard_map
 from ..parallel.mesh import DATA_AXIS
 
 SPARSE_DTYPE = np.dtype([("idx", "<i4"), ("val", "<f4")])
@@ -211,11 +211,23 @@ def _pack(idx, val, y, sw, batch_size):
             padded(sw).reshape(nb, batch_size))
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
-def _run_pass(state: VWState, batches, cfg: VWConfig):
+def _run_pass_impl(state: VWState, batches, cfg: VWConfig):
     step = _pass_body(cfg)
     state, preds = jax.lax.scan(step, state, batches)
     return state, preds
+
+
+@lru_cache(maxsize=None)
+def _run_pass_jit():
+    # built lazily so donate_argnums_if_supported (which inspects the
+    # backend) never forces backend initialisation at import time; on CPU
+    # donation is dropped instead of warning on every pass
+    return jax.jit(_run_pass_impl, static_argnames=("cfg",),
+                   donate_argnums=donate_argnums_if_supported(0))
+
+
+def _run_pass(state: VWState, batches, cfg: VWConfig):
+    return _run_pass_jit()(state, batches, cfg)
 
 
 def _run_pass_sharded(mesh, cfg: VWConfig):

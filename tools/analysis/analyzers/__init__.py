@@ -13,6 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..axismap import AxisMap
 from ..core import Project, SourceFile
 from ..jitmap import JitMap
 
@@ -21,12 +22,19 @@ from ..jitmap import JitMap
 class Context:
     project: Project
     _jitmap: Optional[JitMap] = field(default=None, repr=False)
+    _axismap: Optional[AxisMap] = field(default=None, repr=False)
 
     @property
     def jitmap(self) -> JitMap:
         if self._jitmap is None:
             self._jitmap = JitMap(self.project)
         return self._jitmap
+
+    @property
+    def axismap(self) -> AxisMap:
+        if self._axismap is None:
+            self._axismap = AxisMap(self.project, self.jitmap)
+        return self._axismap
 
     def package_files(self) -> List[SourceFile]:
         return [sf for sf in self.project.files
@@ -39,9 +47,11 @@ class Context:
 
 
 def registry() -> Dict[str, object]:
-    from . import (blocking_io, cycles, determinism, drift, imports, locks,
-                   names, recompile, trace_safety)
+    from . import (blocking_io, collectives, cycles, determinism, donation,
+                   drift, imports, locks, names, recompile, resources,
+                   sharding, trace_safety)
 
     mods = [trace_safety, recompile, determinism, locks, blocking_io,
+            collectives, sharding, donation, resources,
             names, imports, cycles, drift]
     return {m.ID: m for m in mods}

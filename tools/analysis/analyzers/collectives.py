@@ -1,0 +1,411 @@
+"""collectives — SPMD collective misuse that hangs multi-host jobs.
+
+Three rules over the axis-environment model (``tools/analysis/axismap.py``):
+
+* **C1 out-of-scope axis** — a collective (``psum``/``pmean``/``all_gather``/
+  ``ppermute``/``all_to_all``/``axis_index``/...) whose ``axis_name``
+  resolves to a string that is NOT bound in the function's (complete) axis
+  environment: an unconditional ``NameError``-at-trace-time or, worse, a
+  bind against the wrong mesh. Axis names passed as parameters are resolved
+  per call site; unknown environments are never flagged.
+* **C2 replica-divergent control flow** — a collective (or a call into a
+  function that transitively performs one) lexically inside an ``if``/
+  ``while`` whose condition derives from ``jax.process_index()``, per-shard
+  ``axis_index()``, or host-local values (``time.time``, ``random``,
+  ``os.environ``, hostname/pid): some replicas enter the collective and the
+  rest never will — the job deadlocks instead of failing. Static complement
+  of the chaos harness (docs/resilience.md). A divergent early
+  ``return``/``raise`` followed by a collective in the same body is the
+  same deadlock and also flagged.
+* **C3 mismatched cond arms** — ``lax.cond(pred, tfn, ffn, ...)`` where the
+  two arms issue different collective sequences *and* the predicate derives
+  from a replica-divergent value: devices disagreeing on ``pred`` execute
+  different collective programs and hang. A replicated predicate (e.g. a
+  split decision computed from psummed histograms) is legal even with
+  asymmetric arms — both arms trace everywhere and every device takes the
+  same one — so only divergence-tainted predicates are flagged.
+
+``multihost_utils.process_allgather``/``broadcast_one_to_all``/
+``sync_global_devices`` take no axis name but still synchronize every
+process, so they participate in C2/C3.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..axismap import ParamAxis
+from ..core import Finding, FunctionInfo, SourceFile, dotted_name
+from ..jitmap import _param_names
+
+ID = "collectives"
+DESCRIPTION = ("out-of-scope collective axis names, replica-divergent "
+               "collectives (static deadlocks), mismatched lax.cond arms")
+
+#: canonical suffix -> positional index of ``axis_name``
+_AXIS_OPS = {
+    ".psum": 1, ".pmean": 1, ".pmax": 1, ".pmin": 1, ".psum_scatter": 1,
+    ".all_gather": 1, ".ppermute": 1, ".pshuffle": 1, ".all_to_all": 1,
+    ".axis_index": 0, ".axis_size": 0,
+}
+
+#: axis-free cross-process synchronization points (C2/C3 only)
+_SYNC_SUFFIX = (".process_allgather", ".broadcast_one_to_all",
+                ".sync_global_devices")
+
+#: host-local / per-replica value sources: branching on these diverges
+_DIVERGENT_EXACT = {
+    "time.time", "time.time_ns", "os.getpid", "os.urandom",
+    "socket.gethostname", "platform.node", "uuid.uuid1", "uuid.uuid4",
+    "input",
+}
+_DIVERGENT_SUFFIX = (".process_index", ".axis_index")
+_DIVERGENT_PREFIX = ("random.", "numpy.random.", "os.environ")
+
+#: RNG constructors that are deterministic across processes when seeded —
+#: ``np.random.default_rng(cfg.seed)`` yields the same stream on every
+#: host, so values derived from it are replica-uniform, not divergent.
+_SEEDABLE = {
+    "numpy.random.default_rng", "numpy.random.RandomState",
+    "numpy.random.Generator", "random.Random",
+    "jax.random.PRNGKey", "jax.random.key",
+}
+
+
+def _collective_op(canon: Optional[str]) -> Optional[str]:
+    if not canon:
+        return None
+    for suffix in _AXIS_OPS:
+        if canon.endswith(suffix):
+            return suffix[1:]
+    return None
+
+
+def _is_sync(canon: Optional[str]) -> bool:
+    return bool(canon) and canon.endswith(_SYNC_SUFFIX)
+
+
+def _axis_arg(call: ast.Call, op: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    idx = _AXIS_OPS["." + op]
+    return call.args[idx] if idx < len(call.args) else None
+
+
+def _is_divergent_source(canon: Optional[str],
+                         call: Optional[ast.Call] = None) -> bool:
+    if not canon:
+        return False
+    if canon in _SEEDABLE and call is not None \
+            and (call.args or call.keywords):
+        return False                # seeded -> same stream on every host
+    return (canon in _DIVERGENT_EXACT
+            or canon.endswith(_DIVERGENT_SUFFIX)
+            or canon.startswith(_DIVERGENT_PREFIX))
+
+
+def run(ctx) -> List[Finding]:
+    am = ctx.axismap
+    jm = ctx.jitmap
+    project = ctx.project
+    findings: List[Finding] = []
+    scope = ctx.package_files()
+
+    # pass 0: which functions (transitively) perform a collective/sync?
+    perform_direct: Set[str] = set()
+    for sf in scope:
+        for info in sf.symbols.functions.values():
+            for call in jm._calls_in_body(info):
+                canon = project.canonical(sf, dotted_name(call.func))
+                if _collective_op(canon) or _is_sync(canon):
+                    perform_direct.add(info.full_name)
+                    break
+    performers = set(perform_direct)
+    while True:
+        grew = False
+        for callee, sites in am.callsites.items():
+            if callee not in performers:
+                continue
+            for _sf, caller, _call in sites:
+                if caller.full_name not in performers:
+                    performers.add(caller.full_name)
+                    grew = True
+        if not grew:
+            break
+
+    # C1: axis scoping (+ deferred per-call-site parameter resolution)
+    param_demands: Dict[str, List[Tuple[SourceFile, FunctionInfo, ast.Call,
+                                        str, str]]] = {}
+    for sf in scope:
+        for info in sf.symbols.functions.values():
+            env = am.env_of(info.full_name)
+            for call in jm._calls_in_body(info):
+                canon = project.canonical(sf, dotted_name(call.func))
+                op = _collective_op(canon)
+                if op is None:
+                    continue
+                axis_node = _axis_arg(call, op)
+                if axis_node is None:
+                    continue
+                for v in am.resolve_axis_tuple(sf, info, axis_node):
+                    if isinstance(v, str):
+                        if env.complete and v not in env.axes:
+                            bound = (f"axes {sorted(env.axes)} are"
+                                     if env.axes else "no named axes are")
+                            findings.append(Finding(
+                                analyzer=ID, path=sf.rel, line=call.lineno,
+                                col=call.col_offset,
+                                message=(f"`{op}` over axis '{v}' which is "
+                                         f"not bound here — {bound} in "
+                                         f"scope ({env.source})")))
+                    elif isinstance(v, ParamAxis):
+                        param_demands.setdefault(
+                            info.full_name, []).append(
+                                (sf, info, call, op, v.name))
+
+    # resolve parameter-carried axis names at each (complete) call site
+    for full, demands in param_demands.items():
+        for site_sf, caller, call in am.callsites.get(full, ()):
+            site_env = am.env_of(caller.full_name)
+            if not site_env.complete:
+                continue
+            for sf, info, _op_call, op, pname in demands:
+                value = _site_axis_value(am, site_sf, caller, call,
+                                         sf, info, pname)
+                if isinstance(value, str) and value not in site_env.axes:
+                    bound = (f"axes {sorted(site_env.axes)} are"
+                             if site_env.axes else "no named axes are")
+                    findings.append(Finding(
+                        analyzer=ID, path=site_sf.rel, line=call.lineno,
+                        col=call.col_offset,
+                        message=(f"call into `{info.qualname}` performs "
+                                 f"`{op}` over axis '{value}' (via "
+                                 f"parameter `{pname}`) which is not bound "
+                                 f"here — {bound} in scope "
+                                 f"({site_env.source})")))
+
+    # C2: collectives under replica-divergent control flow; C3 reuses the
+    # same walker's taint state to test each cond predicate
+    for sf in scope:
+        for info in sf.symbols.functions.values():
+            walker = _DivergenceWalker(project, am, jm, sf, info, performers)
+            findings.extend(walker.run())
+            for call in jm._calls_in_body(info):
+                canon = project.canonical(sf, dotted_name(call.func))
+                if not (canon and canon.endswith(".cond")):
+                    continue
+                if len(call.args) < 3 \
+                        or not walker._expr_divergent(call.args[0]):
+                    continue
+                f = _cond_mismatch(project, am, jm, sf, info, call,
+                                   performers)
+                if f is not None:
+                    findings.append(f)
+    return findings
+
+
+def _site_axis_value(am, site_sf, caller, call, sf, info, pname):
+    """The axis value a call site passes for callee parameter ``pname``."""
+    params = _param_names(info.node)
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    for kw in call.keywords:
+        if kw.arg == pname:
+            return am.resolve_axis(site_sf, caller, kw.value)
+    try:
+        idx = params.index(pname)
+    except ValueError:
+        return None
+    if idx < len(call.args):
+        return am.resolve_axis(site_sf, caller, call.args[idx])
+    return am.param_default_axis(sf, info, pname)
+
+
+# -- C2 ----------------------------------------------------------------------
+
+class _DivergenceWalker:
+    """Linear walk of one function body tracking names derived from
+    divergent sources, flagging collectives under divergent branches and
+    collectives following a divergent early exit."""
+
+    def __init__(self, project, am, jm, sf: SourceFile, info: FunctionInfo,
+                 performers: Set[str]):
+        self.project = project
+        self.am = am
+        self.jm = jm
+        self.sf = sf
+        self.info = info
+        self.performers = performers
+        self.divergent: Set[str] = set()
+        self.findings: List[Finding] = []
+        self._reported: Set[int] = set()
+
+    def run(self) -> List[Finding]:
+        # single flow-sensitive pass: divergence taints only code that runs
+        # after the tainting assignment (a later rebinding must not leak
+        # backwards into earlier branches)
+        self._walk_block(list(getattr(self.info.node, "body", ())),
+                         divergent_exit=None)
+        return self.findings
+
+    # -- expression tests --
+    def _expr_divergent(self, node: ast.AST) -> Optional[str]:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                canon = self.project.canonical(self.sf, dotted_name(n.func))
+                if _is_divergent_source(canon, n):
+                    return canon
+            elif isinstance(n, (ast.Name, ast.Attribute)):
+                d = dotted_name(n)
+                if d and d.split(".")[0] in self.divergent:
+                    return d
+                canon = self.project.canonical(self.sf, d) if d else None
+                if canon and canon.startswith("os.environ"):
+                    return canon
+        return None
+
+    def _collectives_in(self, node: ast.AST) -> List[Tuple[ast.Call, str]]:
+        out = []
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            canon = self.project.canonical(self.sf, dotted_name(n.func))
+            op = _collective_op(canon)
+            if op is not None or _is_sync(canon):
+                out.append((n, op or canon.rsplit(".", 1)[-1]))
+                continue
+            callee = self.jm.resolve_callee(self.sf, self.info, n)
+            if callee is not None and callee.full_name in self.performers:
+                out.append((n, f"{callee.qualname} (which performs "
+                               "collectives)"))
+        return out
+
+    def _flag(self, call: ast.Call, what: str, why: str) -> None:
+        if call.lineno in self._reported:
+            return
+        self._reported.add(call.lineno)
+        self.findings.append(Finding(
+            analyzer=ID, path=self.sf.rel, line=call.lineno,
+            col=call.col_offset,
+            message=(f"`{what}` {why} — replicas that take the other "
+                     "path never reach this collective and the job "
+                     "deadlocks instead of failing")))
+
+    # -- statements --
+    def _walk_block(self, stmts, divergent_exit: Optional[str]) -> None:
+        for stmt in stmts:
+            if divergent_exit is not None:
+                for call, what in self._collectives_in(stmt):
+                    self._flag(call, what,
+                               f"runs after a replica-divergent early exit "
+                               f"(branch on `{divergent_exit}`)")
+            if isinstance(stmt, ast.Assign):
+                src = self._expr_divergent(stmt.value)
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            (self.divergent.add if src
+                             else self.divergent.discard)(n.id)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                src = self._expr_divergent(stmt.test)
+                if src:
+                    for call, what in self._collectives_in(stmt):
+                        self._flag(call, what,
+                                   "inside control flow that branches on "
+                                   f"replica-divergent `{src}`")
+                    if _block_exits(stmt.body) and divergent_exit is None:
+                        divergent_exit = src
+                else:
+                    self._walk_block(stmt.body, divergent_exit)
+                    self._walk_block(stmt.orelse, divergent_exit)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._walk_block(stmt.body, divergent_exit)
+                self._walk_block(stmt.orelse, divergent_exit)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._walk_block(stmt.body, divergent_exit)
+            elif isinstance(stmt, ast.Try):
+                self._walk_block(stmt.body, divergent_exit)
+                for h in stmt.handlers:
+                    self._walk_block(h.body, divergent_exit)
+                self._walk_block(stmt.orelse, divergent_exit)
+                self._walk_block(stmt.finalbody, divergent_exit)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue         # separate functions, separate envs
+
+
+def _block_exits(stmts) -> bool:
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Continue,
+                              ast.Break)) for s in stmts)
+
+
+# -- C3 ----------------------------------------------------------------------
+
+def _branch_sequence(project, am, jm, sf, info, node,
+                     performers) -> Optional[List[str]]:
+    """Ordered collective-op sequence of one cond arm, or None if the arm
+    cannot be resolved."""
+    if isinstance(node, ast.Lambda):
+        body: List[ast.AST] = [node.body]
+        target = None
+    elif isinstance(node, ast.Name):
+        target = None
+        parts = info.qualname.split(".")
+        for cut in range(len(parts), -1, -1):
+            cand = sf.symbols.functions.get(".".join(parts[:cut]
+                                                     + [node.id]))
+            if cand is not None:
+                target = cand
+                break
+        if target is None:
+            cands = [i for q, i in sf.symbols.functions.items()
+                     if q.split(".")[-1] == node.id]
+            target = cands[0] if len(cands) == 1 else None
+        if target is None:
+            return None
+        body = list(target.node.body)
+    else:
+        return None
+    seq: List[str] = []
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            canon = project.canonical(sf, dotted_name(n.func))
+            op = _collective_op(canon)
+            if op is not None:
+                axis = None
+                node_axis = _axis_arg(n, op)
+                if node_axis is not None:
+                    v = am.resolve_axis(sf, target or info, node_axis)
+                    axis = v if isinstance(v, str) else "?"
+                seq.append(f"{op}({axis})")
+            elif _is_sync(canon):
+                seq.append(canon.rsplit(".", 1)[-1])
+            else:
+                callee = jm.resolve_callee(sf, target or info, n)
+                if callee is not None and callee.full_name in performers:
+                    seq.append(f"via:{callee.qualname}")
+    return seq
+
+
+def _cond_mismatch(project, am, jm, sf, info, call,
+                   performers) -> Optional[Finding]:
+    if len(call.args) < 3:
+        return None
+    t_seq = _branch_sequence(project, am, jm, sf, info, call.args[1],
+                             performers)
+    f_seq = _branch_sequence(project, am, jm, sf, info, call.args[2],
+                             performers)
+    if t_seq is None or f_seq is None or t_seq == f_seq:
+        return None
+    if not t_seq and not f_seq:
+        return None
+    return Finding(
+        analyzer=ID, path=sf.rel, line=call.lineno, col=call.col_offset,
+        message=(f"`lax.cond` arms issue different collective sequences "
+                 f"(true: {t_seq or ['-']}, false: {f_seq or ['-']}) — "
+                 "devices disagreeing on the predicate execute different "
+                 "collective programs and deadlock"))

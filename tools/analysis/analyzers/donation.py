@@ -1,0 +1,290 @@
+"""donation — buffer-donation misuse around ``jax.jit`` boundaries.
+
+* **D1 read-after-donation** — a call site passes a local name into a
+  donated argument position and the caller *reads that name again* after
+  the call (without rebinding it): on TPU/GPU the buffer was invalidated
+  by XLA aliasing and the read returns garbage or raises — but on the CPU
+  CI runs on, donation is a silent no-op and every test passes. The
+  ``state, _ = f(state, ...)`` rebinding idiom is the clean pattern and is
+  never flagged; the same applies to a donated name a loop re-feeds
+  without rebinding (each iteration after the first reads a dead buffer).
+* **D2 donation silently dropped on CPU** — a *literal* non-empty
+  ``donate_argnums``/``donate_argnames`` with no backend guard in reach:
+  jax warns and ignores donation on CPU, burying the warning in CI logs.
+  The ``BucketedRunner`` auto-off (``donate = jax.default_backend() not in
+  ("cpu",)``) and the ``core.compat.donate_argnums_if_supported`` helper
+  are the sanctioned patterns; a non-literal donate expression is assumed
+  to be computed by one of them.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, FunctionInfo, SourceFile, dotted_name
+from ..jitmap import _param_names, is_jit_like
+
+ID = "donation"
+DESCRIPTION = ("donated jit arguments read after the call; donation "
+               "silently dropped on CPU without a backend guard")
+
+_PARTIAL = {"functools.partial", "partial"}
+
+#: canonical names that gate donation on the backend
+_BACKEND_GUARDS = (".default_backend", ".local_devices", ".devices",
+                   ".donate_argnums_if_supported")
+
+
+@dataclass
+class DonationSite:
+    sf: SourceFile
+    node: ast.AST                   # the jit(...) / partial(...) call
+    target: Optional[FunctionInfo]  # jitted function, if resolvable
+    callable_names: List[str]       # names a call site may use
+    donated_idxs: Tuple[int, ...]
+    donated_names: Tuple[str, ...]
+    literal: bool                   # donate list is a non-empty literal
+
+
+def _donate_values(call: ast.Call) -> Tuple[Optional[Tuple[int, ...]],
+                                            Optional[Tuple[str, ...]],
+                                            bool, bool]:
+    """(argnums, argnames, present, literal) from a jit-like call's kwargs."""
+    idxs: List[int] = []
+    names: List[str] = []
+    present = literal = False
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        present = True
+        v = kw.value
+        elts = (list(v.elts) if isinstance(v, (ast.Tuple, ast.List))
+                else [v] if isinstance(v, ast.Constant) else None)
+        if elts is None:
+            continue            # computed expression — assume guarded
+        literal = literal or bool(elts)
+        for e in elts:
+            if isinstance(e, ast.Constant):
+                if isinstance(e.value, int):
+                    idxs.append(e.value)
+                elif isinstance(e.value, str):
+                    names.append(e.value)
+    return tuple(idxs), tuple(names), present, literal
+
+
+def _has_backend_guard(project, sf: SourceFile,
+                       enclosing: Optional[ast.AST]) -> bool:
+    if enclosing is None:
+        return False
+    for n in ast.walk(enclosing):
+        if isinstance(n, ast.Call):
+            canon = project.canonical(sf, dotted_name(n.func))
+            if canon and canon.endswith(_BACKEND_GUARDS):
+                return True
+        if isinstance(n, ast.Attribute) and n.attr == "platform":
+            return True
+    return False
+
+
+def _collect_sites(ctx) -> List[DonationSite]:
+    project = ctx.project
+    sites: List[DonationSite] = []
+    for sf in ctx.package_files():
+        # decorator form: @partial(jax.jit, donate_argnums=...) /
+        # @jax.jit(donate_argnums=...)
+        for info in sf.symbols.functions.values():
+            for dec in getattr(info.node, "decorator_list", ()):
+                if not isinstance(dec, ast.Call):
+                    continue
+                canon = project.canonical(sf, dotted_name(dec.func))
+                jitty = is_jit_like(canon)
+                if canon in _PARTIAL and dec.args:
+                    jitty = is_jit_like(project.canonical(
+                        sf, dotted_name(dec.args[0])))
+                if not jitty:
+                    continue
+                idxs, names, present, literal = _donate_values(dec)
+                if present and (idxs or names or literal):
+                    sites.append(DonationSite(
+                        sf=sf, node=dec, target=info,
+                        callable_names=[info.qualname.split(".")[-1]],
+                        donated_idxs=idxs, donated_names=names,
+                        literal=literal))
+        # wrapper form: g = jax.jit(f, donate_argnums=...)
+        for n in ast.walk(sf.tree):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            call = n.value
+            canon = project.canonical(sf, dotted_name(call.func))
+            if not is_jit_like(canon):
+                continue
+            idxs, names, present, literal = _donate_values(call)
+            if not (present and (idxs or names or literal)):
+                continue
+            target = None
+            if call.args and isinstance(call.args[0], ast.Name):
+                cands = [i for q, i in sf.symbols.functions.items()
+                         if q.split(".")[-1] == call.args[0].id]
+                target = cands[0] if len(cands) == 1 else None
+            sites.append(DonationSite(
+                sf=sf, node=call, target=target,
+                callable_names=[n.targets[0].id],
+                donated_idxs=idxs, donated_names=names, literal=literal))
+    return sites
+
+
+def run(ctx) -> List[Finding]:
+    project = ctx.project
+    jm = ctx.jitmap
+    findings: List[Finding] = []
+    sites = _collect_sites(ctx)
+
+    # D2: literal non-empty donation with no backend auto-off in reach
+    for site in sites:
+        if not site.literal:
+            continue
+        enclosing = _enclosing_function_node(site)
+        if _has_backend_guard(project, site.sf, enclosing):
+            continue
+        findings.append(Finding(
+            analyzer=ID, path=site.sf.rel, line=site.node.lineno,
+            col=site.node.col_offset,
+            message=("literal donate_argnums/argnames with no backend "
+                     "guard — on CPU jax silently drops donation (warning "
+                     "spam, no aliasing); gate it like BucketedRunner "
+                     "(`jax.default_backend() not in (\"cpu\",)`) or use "
+                     "core.compat.donate_argnums_if_supported")))
+
+    # D1: donated names read after the donating call
+    by_callable: Dict[str, DonationSite] = {}
+    for site in sites:
+        for name in site.callable_names:
+            by_callable[name] = site
+    for sf in ctx.package_files():
+        for info in sf.symbols.functions.values():
+            findings.extend(_read_after_donate(project, jm, sf, info,
+                                               by_callable))
+    return findings
+
+
+def _enclosing_function_node(site: DonationSite) -> Optional[ast.AST]:
+    best = None
+    for info in site.sf.symbols.functions.values():
+        fn = info.node
+        end = getattr(fn, "end_lineno", fn.lineno)
+        if fn.lineno <= site.node.lineno <= end \
+                and not (site.target is not None and fn is site.target.node):
+            if best is None or fn.lineno >= best.lineno:
+                best = fn
+    return best
+
+
+def _donated_arg_names(site: DonationSite, call: ast.Call) -> List[str]:
+    """Local Names the call passes into donated positions."""
+    params = (_param_names(site.target.node) if site.target is not None
+              else [])
+    out: List[str] = []
+    for i in site.donated_idxs:
+        if i < len(call.args) and isinstance(call.args[i], ast.Name):
+            out.append(call.args[i].id)
+    for pname in site.donated_names:
+        for kw in call.keywords:
+            if kw.arg == pname and isinstance(kw.value, ast.Name):
+                out.append(kw.value.id)
+        if pname in params:
+            i = params.index(pname)
+            if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                out.append(call.args[i].id)
+    return out
+
+
+def _read_after_donate(project, jm, sf: SourceFile, info: FunctionInfo,
+                       by_callable: Dict[str, "DonationSite"]
+                       ) -> List[Finding]:
+    findings: List[Finding] = []
+    parents: Dict[int, ast.AST] = {}
+    for parent in ast.walk(info.node):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+
+    def _stmt_of(node: ast.AST) -> ast.AST:
+        while id(node) in parents and not isinstance(node, ast.stmt):
+            node = parents[id(node)]
+        return node
+
+    def _loop_of(node: ast.AST) -> Optional[ast.AST]:
+        while id(node) in parents:
+            node = parents[id(node)]
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                return node
+            if node is info.node:
+                return None
+        return None
+
+    for call in jm._calls_in_body(info):
+        name = dotted_name(call.func)
+        site = by_callable.get(name) if name else None
+        if site is None:
+            continue
+        # only bind to the site if the name actually resolves to it
+        if site.target is not None:
+            callee = jm.resolve_callee(sf, info, call)
+            if callee is not None \
+                    and callee.full_name != site.target.full_name:
+                continue
+        for donated in _donated_arg_names(site, call):
+            stmt = _stmt_of(call)
+            rebound_here = _stmt_binds(stmt, donated)
+            end = getattr(stmt, "end_lineno", stmt.lineno)
+            # reads after the donating statement, before any rebinding
+            next_store = None
+            for n in ast.walk(info.node):
+                if (isinstance(n, ast.Name) and n.id == donated
+                        and isinstance(n.ctx, ast.Store)
+                        and n.lineno > end):
+                    next_store = (n.lineno if next_store is None
+                                  else min(next_store, n.lineno))
+            if not rebound_here:
+                for n in ast.walk(info.node):
+                    if (isinstance(n, ast.Name) and n.id == donated
+                            and isinstance(n.ctx, ast.Load)
+                            and n.lineno > end
+                            and (next_store is None
+                                 or n.lineno <= next_store)):
+                        findings.append(Finding(
+                            analyzer=ID, path=sf.rel, line=n.lineno,
+                            col=n.col_offset,
+                            message=(f"`{donated}` is read after being "
+                                     f"donated to `{name}` at line "
+                                     f"{call.lineno} — the buffer is "
+                                     "invalidated on TPU/GPU (CPU CI "
+                                     "won't catch it); rebind the result "
+                                     "or drop the donation")))
+                        break
+                # donated name re-fed by an enclosing loop without rebinding
+                loop = _loop_of(call)
+                if loop is not None and not _binds_within(loop, donated):
+                    findings.append(Finding(
+                        analyzer=ID, path=sf.rel, line=call.lineno,
+                        col=call.col_offset,
+                        message=(f"`{donated}` is donated to `{name}` "
+                                 "inside a loop without being rebound — "
+                                 "every iteration after the first passes "
+                                 "a dead buffer on TPU/GPU")))
+    return findings
+
+
+def _stmt_binds(stmt: ast.AST, name: str) -> bool:
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Name) and n.id == name \
+                and isinstance(n.ctx, ast.Store):
+            return True
+    return False
+
+
+def _binds_within(node: ast.AST, name: str) -> bool:
+    return _stmt_binds(node, name)

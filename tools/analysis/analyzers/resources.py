@@ -1,0 +1,324 @@
+"""resource-discipline — sockets/threads/executors/files must reach close().
+
+The static complement of the chaos harness (docs/resilience.md): PR 1
+proved the serving stack survives injected faults, but a leaked socket or
+un-reaped subprocess only shows up after hours of chaos. This analyzer
+checks, for the connection-handling modules (``io/serving.py``,
+``io/distributed_serving.py``, ``io/portforward.py``, ``core/fabric.py``),
+that every locally-created resource reaches a ``close()``-like call or a
+context manager **on all paths including exception edges**, or provably
+escapes (stored on ``self``/a module global/a container, returned, or
+handed to another function — ownership transfer).
+
+Interprocedural: a function whose only escape for a created resource is
+``return`` is a *resource factory*; its call sites inside the scope are
+treated as creations and checked the same way. ``threading.Thread`` with
+``daemon=True`` is fire-and-forget by design and exempt; a non-daemon
+thread must be ``join``\\ ed or escape.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..core import Finding, FunctionInfo, SourceFile, dotted_name
+
+ID = "resource-discipline"
+DESCRIPTION = ("sockets/threads/executors/files opened in the serving and "
+               "fabric modules must reach close()/shutdown() on all paths")
+
+SCOPE = ("synapseml_tpu/io/serving.py",
+         "synapseml_tpu/io/distributed_serving.py",
+         "synapseml_tpu/io/portforward.py",
+         "synapseml_tpu/core/fabric.py")
+
+_RESOURCE_EXACT = {
+    "socket.socket": "socket", "socket.create_connection": "socket",
+    "open": "file",
+    "http.client.HTTPConnection": "connection",
+    "http.client.HTTPSConnection": "connection",
+    "subprocess.Popen": "subprocess",
+    "tempfile.NamedTemporaryFile": "file", "tempfile.TemporaryFile": "file",
+}
+_RESOURCE_SUFFIX = (
+    (".ThreadPoolExecutor", "executor"), (".ProcessPoolExecutor", "executor"),
+    ("HTTPServer", "server"), (".TCPServer", "server"),
+)
+
+_CLOSE_METHODS = {"close", "shutdown", "server_close", "terminate", "kill",
+                  "wait", "communicate", "join", "stop", "release"}
+
+#: statements that cannot raise between creation and close
+_SIMPLE_STMTS = (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr,
+                 ast.Pass, ast.Import, ast.ImportFrom)
+
+
+def _resource_kind(project, sf: SourceFile, call: ast.Call) -> Optional[str]:
+    canon = project.canonical(sf, dotted_name(call.func))
+    if not canon:
+        return None
+    kind = _RESOURCE_EXACT.get(canon)
+    if kind:
+        return kind
+    for suffix, k in _RESOURCE_SUFFIX:
+        if canon.endswith(suffix):
+            return k
+    if canon == "threading.Thread" or canon.endswith(".Thread"):
+        for kw in call.keywords:
+            if (kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True):
+                return None         # fire-and-forget by design
+        return "thread"
+    # local subclass of a server/resource base (e.g. a nested
+    # ``class _Server(ThreadingHTTPServer)`` inside start())
+    name = dotted_name(call.func)
+    if name and "." not in name:
+        for qual, cls in sf.symbols.classes.items():
+            if qual.split(".")[-1] != name:
+                continue
+            for base in cls.bases:
+                bcanon = project.canonical(sf, dotted_name(base)) or ""
+                if bcanon.endswith(("HTTPServer", "TCPServer", "UDPServer")):
+                    return "server"
+    return None
+
+
+@dataclass
+class _Tracked:
+    name: str
+    kind: str
+    create_stmt: ast.stmt
+    create_line: int
+    closes: List[ast.stmt] = field(default_factory=list)
+    escaped: bool = False
+    returned: bool = False
+
+
+class _FuncScan:
+    """One function: creations, closes, escapes, exception-safety."""
+
+    def __init__(self, project, sf: SourceFile, info: FunctionInfo,
+                 factories: Dict[str, str], jitmap):
+        self.project = project
+        self.sf = sf
+        self.info = info
+        self.factories = factories
+        self.jitmap = jitmap
+        self.parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(info.node):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[id(child)] = parent
+        self.tracked: Dict[str, _Tracked] = {}
+
+    # -- structure helpers --
+    def _stmt_of(self, node: ast.AST) -> ast.stmt:
+        while not isinstance(node, ast.stmt) and id(node) in self.parents:
+            node = self.parents[id(node)]
+        return node
+
+    def _in_withitem(self, call: ast.Call) -> bool:
+        node: ast.AST = call
+        while id(node) in self.parents:
+            parent = self.parents[id(node)]
+            if isinstance(parent, ast.withitem) \
+                    and parent.context_expr is node:
+                return True
+            if isinstance(parent, ast.stmt):
+                return False
+            node = parent
+        return False
+
+    def _ancestors(self, node: ast.AST) -> List[ast.AST]:
+        out = []
+        while id(node) in self.parents:
+            node = self.parents[id(node)]
+            out.append(node)
+        return out
+
+    # -- creation discovery --
+    def _creation_kind(self, call: ast.Call) -> Optional[str]:
+        kind = _resource_kind(self.project, self.sf, call)
+        if kind is not None:
+            return kind
+        callee = self.jitmap.resolve_callee(self.sf, self.info, call)
+        if callee is not None and callee.full_name in self.factories:
+            return self.factories[callee.full_name]
+        return None
+
+    def scan(self) -> List[Finding]:
+        findings: List[Finding] = []
+        for n in ast.walk(self.info.node):
+            if not isinstance(n, ast.Call):
+                continue
+            kind = self._creation_kind(n)
+            if kind is None or self._in_withitem(n):
+                continue
+            stmt = self._stmt_of(n)
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.value is n):
+                name = stmt.targets[0].id
+                self.tracked[name] = _Tracked(name, kind, stmt, n.lineno)
+            elif isinstance(stmt, ast.Return):
+                continue            # factory: ownership moves to the caller
+            elif (isinstance(stmt, ast.Assign)
+                    and any(isinstance(t, (ast.Attribute, ast.Subscript))
+                            for t in stmt.targets)):
+                continue            # stored on self/a global: escapes
+            elif isinstance(stmt, ast.Expr) and stmt.value is n:
+                findings.append(Finding(
+                    analyzer=ID, path=self.sf.rel, line=n.lineno,
+                    col=n.col_offset,
+                    message=(f"{kind} created and immediately discarded — "
+                             "nothing can ever close it")))
+            # other shapes (call argument, comprehension, chained method)
+            # transfer or consume ownership; the receiver is responsible
+
+        if self.tracked:
+            self._uses()
+            for t in self.tracked.values():
+                findings.extend(self._verdict(t))
+        return findings
+
+    # -- use/close/escape classification --
+    def _uses(self) -> None:
+        for n in ast.walk(self.info.node):
+            if isinstance(n, ast.Call):
+                # close-method on the resource
+                if (isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id in self.tracked
+                        and n.func.attr in _CLOSE_METHODS):
+                    t = self.tracked[n.func.value.id]
+                    t.closes.append(self._stmt_of(n))
+                    continue
+                # resource passed to another call: ownership transfer
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    if isinstance(a, ast.Name) and a.id in self.tracked:
+                        self.tracked[a.id].escaped = True
+            elif isinstance(n, ast.Return) and n.value is not None:
+                for c in ast.walk(n.value):
+                    if isinstance(c, ast.Name) and c.id in self.tracked:
+                        self.tracked[c.id].escaped = True
+                        self.tracked[c.id].returned = True
+            elif isinstance(n, (ast.Yield, ast.YieldFrom)) \
+                    and getattr(n, "value", None) is not None:
+                for c in ast.walk(n.value):
+                    if isinstance(c, ast.Name) and c.id in self.tracked:
+                        self.tracked[c.id].escaped = True
+            elif isinstance(n, ast.Assign):
+                stores_out = any(isinstance(t, (ast.Attribute, ast.Subscript))
+                                 for t in n.targets)
+                aliases = any(isinstance(t, ast.Name)
+                              and t.id not in self.tracked
+                              for t in n.targets)
+                if stores_out or aliases:
+                    for c in ast.walk(n.value):
+                        if isinstance(c, ast.Name) and c.id in self.tracked \
+                                and n is not self.tracked[c.id].create_stmt:
+                            self.tracked[c.id].escaped = True
+            elif isinstance(n, (ast.Tuple, ast.List, ast.Set, ast.Dict)) \
+                    and not isinstance(self.parents.get(id(n)), ast.Assign):
+                for c in n.elts if not isinstance(n, ast.Dict) else \
+                        list(n.keys) + list(n.values):
+                    if c is not None and isinstance(c, ast.Name) \
+                            and c.id in self.tracked:
+                        self.tracked[c.id].escaped = True
+
+    def _verdict(self, t: _Tracked) -> List[Finding]:
+        if t.escaped:
+            return []
+        if not t.closes:
+            return [Finding(
+                analyzer=ID, path=self.sf.rel, line=t.create_line, col=0,
+                message=(f"{t.kind} `{t.name}` is never closed and never "
+                         "escapes this function — close it in a finally "
+                         "block or use a with-block"))]
+        if self._exception_safe(t):
+            return []
+        return [Finding(
+            analyzer=ID, path=self.sf.rel, line=t.create_line, col=0,
+            message=(f"{t.kind} `{t.name}` is closed on the happy path "
+                     "only — an exception between creation and close "
+                     "leaks it; move the close into try/finally or use "
+                     "a with-block"))]
+
+    def _exception_safe(self, t: _Tracked) -> bool:
+        # 1) any enclosing try whose finalbody closes the resource
+        for anc in self._ancestors(t.create_stmt):
+            if isinstance(anc, ast.Try):
+                for cl in t.closes:
+                    if any(cl is s or _contains(s, cl)
+                           for s in anc.finalbody):
+                        return True
+        # 2) a sibling statement after creation closes it (directly or via
+        #    a try/finally) with nothing fallible in between
+        siblings = self._sibling_list(t.create_stmt)
+        if siblings is None:
+            return False
+        i = siblings.index(t.create_stmt)
+        for j in range(i + 1, len(siblings)):
+            stmt = siblings[j]
+            closes_here = any(cl is stmt or _contains(stmt, cl)
+                              for cl in t.closes)
+            in_finally = (isinstance(stmt, ast.Try) and any(
+                any(cl is s or _contains(s, cl) for cl in t.closes)
+                for s in stmt.finalbody))
+            if in_finally:
+                return True
+            if closes_here and not isinstance(stmt, ast.Try):
+                return True
+            if not _infallible(stmt):
+                return False
+        return False
+
+    def _sibling_list(self, stmt: ast.stmt) -> Optional[List[ast.stmt]]:
+        parent = self.parents.get(id(stmt))
+        if parent is None:
+            return None
+        for fld in ("body", "orelse", "finalbody"):
+            lst = getattr(parent, fld, None)
+            if isinstance(lst, list) and stmt in lst:
+                return lst
+        return None
+
+
+def _contains(root: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(root))
+
+
+def _infallible(stmt: ast.stmt) -> bool:
+    if not isinstance(stmt, _SIMPLE_STMTS):
+        return False
+    for n in ast.walk(stmt):
+        if isinstance(n, (ast.Call, ast.Raise, ast.Assert, ast.Await)):
+            return False
+    return True
+
+
+def _find_factories(project, jm, files) -> Dict[str, str]:
+    """Functions whose created resource escapes only via ``return``."""
+    factories: Dict[str, str] = {}
+    for sf in files:
+        for info in sf.symbols.functions.values():
+            scan = _FuncScan(project, sf, info, {}, jm)
+            scan.scan()
+            for t in scan.tracked.values():
+                if t.returned and not t.closes:
+                    factories[info.full_name] = t.kind
+    return factories
+
+
+def run(ctx) -> List[Finding]:
+    project = ctx.project
+    jm = ctx.jitmap
+    files = ctx.files_under(SCOPE)
+    factories = _find_factories(project, jm, files)
+    findings: List[Finding] = []
+    for sf in files:
+        for info in sf.symbols.functions.values():
+            findings.extend(
+                _FuncScan(project, sf, info, factories, jm).scan())
+    return findings

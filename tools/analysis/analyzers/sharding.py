@@ -1,0 +1,329 @@
+"""sharding — spec/mesh/host-access mismatches in the SPMD layer.
+
+* **S1 spec arity** — ``shard_map(fn, in_specs=(...), out_specs=(...))``
+  where the ``in_specs`` tuple length differs from ``fn``'s positional
+  parameter count (or ``out_specs`` from the arity of every ``return``
+  tuple): a pytree-structure TypeError at trace time on TPU, but only once
+  the sharded path actually runs — CI on CPU never gets there.
+  ``(spec,) * K`` literals are evaluated; a bare ``P(...)`` is a legal
+  pytree prefix and is skipped.
+* **S2 unknown mesh axis** — ``NamedSharding(mesh, P("x"))`` or a
+  shard_map ``in_specs``/``out_specs`` PartitionSpec naming an axis that is
+  not on the (resolvable) mesh.
+* **S3 host access on global arrays** — values produced by
+  ``parallel.mesh.to_global_rows`` / ``make_array_from_process_local_data``
+  / ``device_put(..., NamedSharding(...))`` are *globally sharded*: on a
+  multi-host mesh ``np.asarray(x)`` / ``x.tolist()`` raise (non-addressable
+  shards) and ``x.addressable_shards`` silently yields a partial view.
+  Flagged unless the access sits under an explicit
+  ``process_index()``/``process_count()`` guard or the value was first
+  gathered with ``process_allgather``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Finding, SourceFile, dotted_name
+from ..jitmap import _param_names
+
+ID = "sharding"
+DESCRIPTION = ("shard_map spec arity vs. signature, NamedSharding axes "
+               "missing from the mesh, host access on globally-sharded "
+               "arrays")
+
+#: producers of globally-sharded arrays (canonical suffixes)
+_GLOBAL_PRODUCERS = (".to_global_rows", ".make_array_from_process_local_data",
+                     ".shard_rows")
+
+#: host accesses that assume every shard is locally addressable
+_HOST_NUMPY = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+               "numpy.save", "numpy.savez"}
+_HOST_METHODS = {"tolist", "item", "__array__"}
+
+
+def _spec_len(node: ast.AST) -> Optional[int]:
+    """Static length of an in_specs/out_specs tuple literal."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return len(node.elts)
+    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult)):
+        # (spec,) * 3  /  3 * (spec,)
+        for tup, n in ((node.left, node.right), (node.right, node.left)):
+            if (isinstance(tup, ast.Tuple)
+                    and isinstance(n, ast.Constant)
+                    and isinstance(n.value, int)):
+                return len(tup.elts) * n.value
+    return None
+
+
+def _spec_axes(am, sf, info, node: ast.AST) -> Set[str]:
+    """Axis names mentioned by PartitionSpec literals under ``node``."""
+    axes: Set[str] = set()
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        canon = am.project.canonical(sf, dotted_name(n.func))
+        if not (canon and (canon.endswith(".PartitionSpec")
+                           or canon == "PartitionSpec"
+                           or canon.endswith(".P") or canon == "P")):
+            continue
+        for a in list(n.args):
+            for e in (a.elts if isinstance(a, (ast.Tuple, ast.List))
+                      else [a]):
+                v = am.resolve_axis(sf, info, e)
+                if isinstance(v, str):
+                    axes.add(v)
+    return axes
+
+
+def _return_arity(fn_node: ast.AST) -> Optional[int]:
+    """Tuple arity when every return is a same-length tuple literal."""
+    arity: Optional[int] = None
+    for n in ast.walk(fn_node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n is not fn_node:
+            continue
+        if isinstance(n, ast.Return) and n.value is not None:
+            if not isinstance(n.value, ast.Tuple):
+                return None
+            k = len(n.value.elts)
+            if arity is not None and arity != k:
+                return None
+            arity = k
+    return arity
+
+
+def run(ctx) -> List[Finding]:
+    am = ctx.axismap
+    project = ctx.project
+    findings: List[Finding] = []
+    scope = ctx.package_files()
+    scope_rels = {sf.rel for sf in scope}
+
+    # S1 + S2 over every shard_map application the axis map collected
+    for site in am.shard_sites:
+        if site.sf.rel not in scope_rels:
+            continue
+        if site.target is not None and site.in_specs is not None:
+            n_specs = _spec_len(site.in_specs)
+            params = _param_names(site.target.node)
+            has_vararg = site.target.node.args.vararg is not None
+            if n_specs is not None and not has_vararg \
+                    and n_specs != len(params):
+                findings.append(Finding(
+                    analyzer=ID, path=site.sf.rel, line=site.node.lineno,
+                    col=site.node.col_offset,
+                    message=(f"shard_map in_specs has {n_specs} spec(s) but "
+                             f"`{site.target.qualname}` takes "
+                             f"{len(params)} positional argument(s) — "
+                             "pytree structure mismatch at trace time")))
+        if site.target is not None and site.out_specs is not None:
+            n_out = _spec_len(site.out_specs)
+            ret = _return_arity(site.target.node)
+            if n_out is not None and ret is not None and n_out != ret:
+                findings.append(Finding(
+                    analyzer=ID, path=site.sf.rel, line=site.node.lineno,
+                    col=site.node.col_offset,
+                    message=(f"shard_map out_specs has {n_out} spec(s) but "
+                             f"`{site.target.qualname}` returns "
+                             f"{ret}-tuple(s)")))
+        if site.mesh_axes is not None:
+            for specs in (site.in_specs, site.out_specs):
+                if specs is None:
+                    continue
+                bad = _spec_axes(am, site.sf, site.enclosing,
+                                 specs) - site.mesh_axes
+                if bad:
+                    findings.append(Finding(
+                        analyzer=ID, path=site.sf.rel,
+                        line=site.node.lineno, col=site.node.col_offset,
+                        message=(f"shard_map spec names axis/axes "
+                                 f"{sorted(bad)} not on the mesh "
+                                 f"{sorted(site.mesh_axes)}")))
+
+    # S2: NamedSharding(mesh, P(...)) with axes missing from the mesh
+    for sf in scope:
+        for info, call in _calls_with_context(sf):
+            canon = project.canonical(sf, dotted_name(call.func))
+            if not (canon and canon.endswith("NamedSharding")):
+                continue
+            if len(call.args) < 2:
+                continue
+            mesh_axes = am.resolve_mesh_axes(sf, info, call.args[0])
+            if mesh_axes is None:
+                continue
+            bad = _spec_axes(am, sf, info, call.args[1]) - mesh_axes
+            if bad:
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=call.lineno,
+                    col=call.col_offset,
+                    message=(f"NamedSharding names axis/axes {sorted(bad)} "
+                             f"not present on the mesh "
+                             f"{sorted(mesh_axes)} — resharding will fail "
+                             "at dispatch")))
+
+    # S3: host access on globally-sharded values
+    for sf in scope:
+        for info in sf.symbols.functions.values():
+            findings.extend(_host_access_pass(project, sf, info))
+    return findings
+
+
+def _calls_with_context(sf: SourceFile):
+    """(enclosing FunctionInfo or None, call) for every call in the file."""
+    seen = set()
+    for info in sf.symbols.functions.values():
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.Call):
+                seen.add(id(n))
+                yield info, n
+    for n in ast.walk(sf.tree):
+        if isinstance(n, ast.Call) and id(n) not in seen:
+            yield None, n
+
+
+def _is_guard(project, sf, test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            canon = project.canonical(sf, dotted_name(n.func))
+            if canon and canon.endswith((".process_index",
+                                         ".process_count")):
+                return True
+    return False
+
+
+class _HostAccessWalker:
+    def __init__(self, project, sf: SourceFile, info):
+        self.project = project
+        self.sf = sf
+        self.info = info
+        self.tracked: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        # single flow-sensitive pass: taint follows assignment order, and
+        # walking twice would report every access twice
+        self._block(list(getattr(self.info.node, "body", ())),
+                    guarded=False)
+        return self.findings
+
+    def _producer(self, node: ast.AST) -> Optional[str]:
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            canon = self.project.canonical(self.sf, dotted_name(n.func))
+            if canon and canon.endswith(_GLOBAL_PRODUCERS):
+                return canon.rsplit(".", 1)[-1]
+            if canon and canon.endswith(".device_put"):
+                for a in list(n.args[1:]) + [kw.value for kw in n.keywords]:
+                    inner = (self.project.canonical(
+                        self.sf, dotted_name(a.func))
+                        if isinstance(a, ast.Call) else None)
+                    if inner and inner.endswith("NamedSharding"):
+                        return "device_put+NamedSharding"
+        return None
+
+    def _gathered(self, node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                canon = self.project.canonical(self.sf, dotted_name(n.func))
+                if canon and canon.endswith(".process_allgather"):
+                    return True
+        return False
+
+    def _check_expr(self, node: ast.AST, guarded: bool) -> None:
+        if guarded:
+            return
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                canon = self.project.canonical(self.sf, dotted_name(n.func))
+                if canon in _HOST_NUMPY:
+                    for a in n.args:
+                        if isinstance(a, ast.Name) and a.id in self.tracked:
+                            self.findings.append(Finding(
+                                analyzer=ID, path=self.sf.rel,
+                                line=n.lineno, col=n.col_offset,
+                                message=(f"`{canon.replace('numpy', 'np')}"
+                                         f"()` on globally-sharded "
+                                         f"`{a.id}` — non-addressable "
+                                         "shards raise on multi-host "
+                                         "meshes; gather with "
+                                         "process_allgather or guard on "
+                                         "process_index()")))
+                elif (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in _HOST_METHODS
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id in self.tracked):
+                    self.findings.append(Finding(
+                        analyzer=ID, path=self.sf.rel, line=n.lineno,
+                        col=n.col_offset,
+                        message=(f"`.{n.func.attr}()` on globally-sharded "
+                                 f"`{n.func.value.id}` — raises on "
+                                 "multi-host meshes (non-addressable "
+                                 "shards)")))
+            elif (isinstance(n, ast.Attribute)
+                    and n.attr == "addressable_shards"
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id in self.tracked):
+                self.findings.append(Finding(
+                    analyzer=ID, path=self.sf.rel, line=n.lineno,
+                    col=n.col_offset,
+                    message=(f"`.addressable_shards` on globally-sharded "
+                             f"`{n.value.id}` outside a process_index() "
+                             "guard — yields a silently partial per-host "
+                             "view")))
+
+    def _block(self, stmts, guarded: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                self._check_expr(stmt.value, guarded)
+                rhs_tracked = any(isinstance(n, ast.Name)
+                                  and n.id in self.tracked
+                                  for n in ast.walk(stmt.value))
+                # taint flows through pass-through expressions (aliases,
+                # subscripts, tuples) but NOT through other calls: a
+                # function fed a sharded array may gather/reduce, and its
+                # output sharding is its own business. process_allgather
+                # yields a plain host array and clears taint explicitly.
+                sharded = (self._producer(stmt.value) is not None
+                           or (rhs_tracked
+                               and not isinstance(stmt.value, ast.Call)
+                               and not self._gathered(stmt.value)))
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            (self.tracked.add if sharded
+                             else self.tracked.discard)(n.id)
+            elif isinstance(stmt, ast.If):
+                g = guarded or _is_guard(self.project, self.sf, stmt.test)
+                self._check_expr(stmt.test, guarded)
+                self._block(stmt.body, g)
+                self._block(stmt.orelse, guarded)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._check_expr(stmt.iter, guarded)
+                self._block(stmt.body, guarded)
+                self._block(stmt.orelse, guarded)
+            elif isinstance(stmt, (ast.While,)):
+                self._check_expr(stmt.test, guarded)
+                self._block(stmt.body, guarded)
+                self._block(stmt.orelse, guarded)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._block(stmt.body, guarded)
+            elif isinstance(stmt, ast.Try):
+                self._block(stmt.body, guarded)
+                for h in stmt.handlers:
+                    self._block(h.body, guarded)
+                self._block(stmt.orelse, guarded)
+                self._block(stmt.finalbody, guarded)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.expr):
+                        self._check_expr(child, guarded)
+
+
+def _host_access_pass(project, sf, info) -> List[Finding]:
+    return _HostAccessWalker(project, sf, info).run()

@@ -1,0 +1,503 @@
+"""Axis-environment inference — which collective axis names are bound where.
+
+:class:`AxisMap` answers, for every project function, "which mesh axis names
+are in scope when this body executes, and which mesh do they come from?":
+
+* **binding sites** — ``shard_map(fn, mesh=M, ...)`` in every form JitMap
+  recognizes (bare call, ``jax.jit(shard_map(...))`` nesting,
+  ``@partial(shard_map, mesh=M, ...)`` decorators — including the
+  ``core/compat.py`` shim, which re-exports through a module-level alias the
+  symbol tables resolve) binds the axis names of ``M``;
+  ``pmap(fn, axis_name=a)`` binds exactly ``{a}`` (a bare ``pmap`` binds an
+  *unnamed* axis, so the named-axis environment is complete and empty).
+* **mesh resolution** — ``jax.sharding.Mesh(devs, axis_names=(...))``
+  literals, the repo's ``parallel.mesh.make_mesh`` helper (dict-literal
+  axis keys; no-argument form defaults to ``{"data"}``), and single-assignment
+  locals / module constants that reach one of those. Axis-name expressions
+  resolve through string constants, module-level constants
+  (``parallel.mesh.DATA_AXIS`` etc. via ``Project.canonical``), and
+  function-parameter defaults.
+* **propagation** — nested ``def``\\ s inherit the enclosing environment
+  (trace-time lexical scoping), and call edges propagate environments to
+  private/nested callees the way JitMap propagates tracedness. An
+  environment is only *complete* (safe to flag against) when every known
+  binding site is itself fully resolved and the callee cannot be reached
+  from unknown contexts; ``with mesh:`` blocks contribute ambient axes but
+  never completeness (they bind sharding resources, not collective axes —
+  same for ``jax.named_scope``, which introduces no axes at all).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+from .core import FunctionInfo, Project, SourceFile, dotted_name
+from .jitmap import JitMap, _param_names, combinator_fn_args
+
+#: shard_map spellings after canonicalization (the compat shim resolves to
+#: ``jax.shard_map`` / ``jax.experimental.shard_map.shard_map``)
+_SHARD_MAP_SUFFIX = (".shard_map",)
+_PMAP_SUFFIX = (".pmap",)
+_PARTIAL = {"functools.partial", "partial"}
+
+
+def _is_shard_map(canon: Optional[str]) -> bool:
+    return bool(canon) and (canon == "shard_map"
+                            or canon.endswith(_SHARD_MAP_SUFFIX))
+
+
+def _is_pmap(canon: Optional[str]) -> bool:
+    return bool(canon) and (canon == "pmap" or canon.endswith(_PMAP_SUFFIX))
+
+
+@dataclass(frozen=True)
+class ParamAxis:
+    """An axis name that is a parameter of the enclosing function — resolved
+    per call site, never at the definition."""
+    name: str
+
+
+#: resolution result for one axis-name expression
+AxisValue = Union[str, ParamAxis, None]
+
+
+@dataclass
+class AxisEnv:
+    """Axis names bound when a function body executes."""
+    axes: frozenset = frozenset()
+    #: True when ``axes`` is exhaustive — only then may an analyzer flag a
+    #: name as out of scope
+    complete: bool = False
+    source: str = "no known binding site"
+    #: a direct shard_map/pmap boundary; call edges never widen it
+    direct: bool = False
+
+
+UNKNOWN_ENV = AxisEnv()
+
+
+@dataclass
+class ShardSite:
+    """One shard_map application (call, nested-call or decorator form)."""
+    sf: SourceFile
+    node: ast.Call                      # the shard_map(...) / partial(...) call
+    target: Optional[FunctionInfo]      # resolved mapped function, if any
+    mesh_axes: Optional[frozenset]      # None = unresolved mesh
+    in_specs: Optional[ast.AST] = None
+    out_specs: Optional[ast.AST] = None
+    enclosing: Optional[FunctionInfo] = None
+
+
+class AxisMap:
+    """Per-function axis environments for a whole project."""
+
+    def __init__(self, project: Project, jitmap: Optional[JitMap] = None):
+        self.project = project
+        self.jitmap = jitmap or JitMap(project)
+        self.envs: Dict[str, AxisEnv] = {}
+        self.shard_sites: List[ShardSite] = []
+        #: callee full_name -> [(sf, caller info, call node)] — combinator
+        #: fn-arguments count as call sites
+        self.callsites: Dict[str, List[Tuple[SourceFile, FunctionInfo,
+                                             ast.Call]]] = {}
+        self._str_consts: Dict[str, Dict[str, str]] = {}
+        for sf in project.files:
+            self._seed_file(sf)
+        self._inherit_nested()
+        self._build_callsites()
+        self._propagate()
+
+    # -- public queries ----------------------------------------------------
+    def env_of(self, full_name: str) -> AxisEnv:
+        return self.envs.get(full_name, UNKNOWN_ENV)
+
+    # -- constant / axis-name resolution -----------------------------------
+    def _module_str_consts(self, sf: SourceFile) -> Dict[str, str]:
+        cached = self._str_consts.get(sf.module)
+        if cached is None:
+            cached = {}
+            for stmt in sf.tree.body:
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str)):
+                    cached[stmt.targets[0].id] = stmt.value.value
+            self._str_consts[sf.module] = cached
+        return cached
+
+    def _canonical_str_const(self, canon: Optional[str]) -> Optional[str]:
+        """``synapseml_tpu.parallel.mesh.DATA_AXIS`` -> ``"data"``."""
+        if not canon or "." not in canon:
+            return None
+        parts = canon.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            sf2 = self.project.by_module.get(".".join(parts[:cut]))
+            if sf2 is None:
+                continue
+            tail = ".".join(parts[cut:])
+            if "." in tail:
+                return None
+            return self._module_str_consts(sf2).get(tail)
+        return None
+
+    def _local_assignment(self, info: Optional[FunctionInfo],
+                          name: str) -> Optional[ast.AST]:
+        """The value of a single local ``name = <expr>`` assignment."""
+        if info is None:
+            return None
+        hits: List[ast.AST] = []
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        hits.append(n.value)
+        return hits[0] if len(hits) == 1 else None
+
+    def resolve_axis(self, sf: SourceFile, info: Optional[FunctionInfo],
+                     node: ast.AST, _depth: int = 0) -> AxisValue:
+        """One axis-name expression -> str | ParamAxis | None (unknown)."""
+        if _depth > 3 or node is None:
+            return None
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        name = dotted_name(node)
+        if name is None:
+            return None
+        if info is not None and "." not in name:
+            if name in _param_names(info.node):
+                return ParamAxis(name)
+            local = self._local_assignment(info, name)
+            if local is not None and not (isinstance(local, ast.Name)
+                                          and local.id == name):
+                return self.resolve_axis(sf, info, local, _depth + 1)
+        return self._canonical_str_const(self.project.canonical(sf, name))
+
+    def resolve_axis_tuple(self, sf: SourceFile, info: Optional[FunctionInfo],
+                           node: ast.AST) -> List[AxisValue]:
+        """Axis-name arg that may be a single name or a tuple of names."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [self.resolve_axis(sf, info, e) for e in node.elts]
+        return [self.resolve_axis(sf, info, node)]
+
+    def param_default_axis(self, sf: SourceFile, info: FunctionInfo,
+                           pname: str) -> AxisValue:
+        """Resolved default for parameter ``pname``, if it has one."""
+        a = info.node.args
+        pos = a.posonlyargs + a.args
+        defaults = a.defaults
+        for arg, dflt in zip(pos[len(pos) - len(defaults):], defaults):
+            if arg.arg == pname:
+                return self.resolve_axis(sf, None, dflt)
+        for arg, dflt in zip(a.kwonlyargs, a.kw_defaults):
+            if arg.arg == pname and dflt is not None:
+                return self.resolve_axis(sf, None, dflt)
+        return None
+
+    # -- mesh resolution ---------------------------------------------------
+    def resolve_mesh_axes(self, sf: SourceFile, info: Optional[FunctionInfo],
+                          node: ast.AST, _depth: int = 0
+                          ) -> Optional[frozenset]:
+        """Mesh expression -> frozenset of axis names, or None (unknown)."""
+        if node is None or _depth > 3:
+            return None
+        if isinstance(node, ast.Call):
+            canon = self.project.canonical(sf, dotted_name(node.func))
+            if not canon:
+                return None
+            if canon == "Mesh" or canon.endswith(".Mesh"):
+                names_node = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        names_node = kw.value
+                if names_node is None and len(node.args) >= 2:
+                    names_node = node.args[1]
+                return self._axis_name_set(sf, info, names_node)
+            if canon.endswith(".make_mesh") or canon == "make_mesh":
+                # the repo helper: make_mesh() -> 1-D data mesh;
+                # make_mesh({axis: n, ...}) -> those axes.
+                # jax.make_mesh(shape, axis_names) -> second positional.
+                if canon.startswith("jax."):
+                    names_node = None
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            names_node = kw.value
+                    if names_node is None and len(node.args) >= 2:
+                        names_node = node.args[1]
+                    return self._axis_name_set(sf, info, names_node)
+                if not node.args and not node.keywords:
+                    return frozenset({"data"})
+                if node.args and isinstance(node.args[0], ast.Dict):
+                    out = set()
+                    for k in node.args[0].keys:
+                        v = self.resolve_axis(sf, info, k)
+                        if not isinstance(v, str):
+                            return None
+                        out.add(v)
+                    return frozenset(out)
+            return None
+        name = dotted_name(node)
+        if name is None:
+            return None
+        if info is not None and "." not in name:
+            if name in _param_names(info.node):
+                return None
+            local = self._local_assignment(info, name)
+            if local is not None:
+                return self.resolve_mesh_axes(sf, info, local, _depth + 1)
+        # module-level mesh constant (possibly in another module)
+        canon = self.project.canonical(sf, name)
+        if canon and "." not in canon:
+            for stmt in sf.tree.body:
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == canon):
+                    return self.resolve_mesh_axes(sf, None, stmt.value,
+                                                  _depth + 1)
+            return None
+        if canon and "." in canon:
+            parts = canon.split(".")
+            for cut in range(len(parts) - 1, 0, -1):
+                sf2 = self.project.by_module.get(".".join(parts[:cut]))
+                if sf2 is None:
+                    continue
+                tail = ".".join(parts[cut:])
+                if "." in tail:
+                    return None
+                for stmt in sf2.tree.body:
+                    if (isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)
+                            and stmt.targets[0].id == tail):
+                        return self.resolve_mesh_axes(sf2, None, stmt.value,
+                                                      _depth + 1)
+                return None
+        return None
+
+    def _axis_name_set(self, sf: SourceFile, info: Optional[FunctionInfo],
+                       node: Optional[ast.AST]) -> Optional[frozenset]:
+        if node is None:
+            return None
+        elts = (node.elts if isinstance(node, (ast.Tuple, ast.List))
+                else [node])
+        out = set()
+        for e in elts:
+            v = self.resolve_axis(sf, info, e)
+            if not isinstance(v, str):
+                return None
+            out.add(v)
+        return frozenset(out)
+
+    # -- environment seeding -----------------------------------------------
+    def _merge(self, full: str, axes: Optional[frozenset], complete: bool,
+               source: str, direct: bool = False) -> bool:
+        """Returns True when the stored env changed."""
+        if axes is None:
+            axes, complete = frozenset(), False
+        cur = self.envs.get(full)
+        if cur is None:
+            self.envs[full] = AxisEnv(axes, complete, source, direct)
+            return True
+        if cur.direct and not direct:
+            return False        # a direct boundary owns its environment
+        new_axes = cur.axes | axes
+        new_complete = (complete if direct
+                        else (cur.complete and complete))
+        if new_axes == cur.axes and new_complete == cur.complete \
+                and cur.direct == (cur.direct or direct):
+            return False
+        self.envs[full] = AxisEnv(new_axes, new_complete,
+                                  cur.source if cur.direct else source,
+                                  cur.direct or direct)
+        return True
+
+    def _local_functions_named(self, sf: SourceFile,
+                               name: str) -> List[FunctionInfo]:
+        return [i for q, i in sf.symbols.functions.items()
+                if q.split(".")[-1] == name]
+
+    def _enclosing_info(self, sf: SourceFile,
+                        node: ast.AST) -> Optional[FunctionInfo]:
+        """Innermost function whose span contains ``node`` (by lineno)."""
+        best: Optional[FunctionInfo] = None
+        for info in sf.symbols.functions.values():
+            fn = info.node
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= node.lineno <= end:
+                if best is None or fn.lineno >= best.node.lineno:
+                    best = info
+        return best
+
+    def _seed_file(self, sf: SourceFile) -> None:
+        if sf.syntax_error:
+            return
+        # decorator forms: @partial(shard_map, mesh=M, ...) / @partial(pmap,
+        # axis_name=a) — bare @shard_map can't carry a mesh, env stays unknown
+        for info in sf.symbols.functions.values():
+            for dec in getattr(info.node, "decorator_list", ()):
+                if not isinstance(dec, ast.Call):
+                    continue
+                canon = self.project.canonical(sf, dotted_name(dec.func))
+                inner = None
+                if canon in _PARTIAL and dec.args:
+                    inner = self.project.canonical(sf,
+                                                   dotted_name(dec.args[0]))
+                enclosing = self._enclosing_info(sf, dec)
+                if _is_shard_map(canon) or (inner and _is_shard_map(inner)):
+                    self._seed_shard_site(sf, dec, info, enclosing)
+                elif _is_pmap(canon) or (inner and _is_pmap(inner)):
+                    self._seed_pmap(sf, dec, info, enclosing)
+        # call forms: shard_map(fn, mesh=M, ...) / pmap(fn, axis_name=a)
+        for call in ast.walk(sf.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            canon = self.project.canonical(sf, dotted_name(call.func))
+            if not (_is_shard_map(canon) or _is_pmap(canon)):
+                continue
+            target = None
+            if call.args and isinstance(call.args[0], ast.Name):
+                cands = self._local_functions_named(sf, call.args[0].id)
+                target = cands[0] if len(cands) == 1 else None
+            enclosing = self._enclosing_info(sf, call)
+            if _is_shard_map(canon):
+                self._seed_shard_site(sf, call, target, enclosing)
+            else:
+                self._seed_pmap(sf, call, target, enclosing)
+        # `with mesh:` — ambient mesh axes, never completeness
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            enclosing = self._enclosing_info(sf, node)
+            for item in node.items:
+                axes = self.resolve_mesh_axes(sf, enclosing,
+                                              item.context_expr)
+                if axes and enclosing is not None:
+                    self._merge(enclosing.full_name, axes, False,
+                                f"`with mesh:` at line {node.lineno}")
+
+    def _kw(self, call: ast.Call, name: str) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def _seed_shard_site(self, sf: SourceFile, call: ast.Call,
+                         target: Optional[FunctionInfo],
+                         enclosing: Optional[FunctionInfo]) -> None:
+        mesh_node = self._kw(call, "mesh")
+        mesh_axes = (self.resolve_mesh_axes(sf, enclosing, mesh_node)
+                     if mesh_node is not None else None)
+        self.shard_sites.append(ShardSite(
+            sf=sf, node=call, target=target, mesh_axes=mesh_axes,
+            in_specs=self._kw(call, "in_specs"),
+            out_specs=self._kw(call, "out_specs"), enclosing=enclosing))
+        if target is not None:
+            if mesh_axes is not None:
+                self._merge(target.full_name, mesh_axes, True,
+                            f"shard_map over mesh axes "
+                            f"{sorted(mesh_axes)} at {sf.rel}:{call.lineno}",
+                            direct=True)
+            else:
+                self._merge(target.full_name, frozenset(), False,
+                            f"shard_map with unresolved mesh at "
+                            f"{sf.rel}:{call.lineno}", direct=True)
+
+    def _seed_pmap(self, sf: SourceFile, call: ast.Call,
+                   target: Optional[FunctionInfo],
+                   enclosing: Optional[FunctionInfo]) -> None:
+        if target is None:
+            return
+        axis_node = self._kw(call, "axis_name")
+        if axis_node is None:
+            # bare pmap binds one *unnamed* axis: named env complete + empty
+            self._merge(target.full_name, frozenset(), True,
+                        f"pmap without axis_name at {sf.rel}:{call.lineno}",
+                        direct=True)
+            return
+        v = self.resolve_axis(sf, enclosing, axis_node)
+        if isinstance(v, str):
+            self._merge(target.full_name, frozenset({v}), True,
+                        f"pmap(axis_name={v!r}) at {sf.rel}:{call.lineno}",
+                        direct=True)
+        else:
+            self._merge(target.full_name, frozenset(), False,
+                        f"pmap with unresolved axis_name at "
+                        f"{sf.rel}:{call.lineno}", direct=True)
+
+    # -- propagation -------------------------------------------------------
+    def _inherit_nested(self) -> None:
+        # a def nested inside a bound function sees its axes at trace time
+        for sf in self.project.files:
+            seeded = [(q, self.envs[i.full_name])
+                      for q, i in sf.symbols.functions.items()
+                      if i.full_name in self.envs]
+            for qual, info in sf.symbols.functions.items():
+                for parent_qual, env in seeded:
+                    if qual.startswith(parent_qual + "."):
+                        self._merge(info.full_name, env.axes, env.complete,
+                                    f"nested inside {parent_qual} "
+                                    f"({env.source})")
+
+    def _build_callsites(self) -> None:
+        jm = self.jitmap
+        for sf in self.project.files:
+            for info in sf.symbols.functions.values():
+                for call in jm._calls_in_body(info):
+                    callee = jm.resolve_callee(sf, info, call)
+                    if callee is not None:
+                        self.callsites.setdefault(
+                            callee.full_name, []).append((sf, info, call))
+                    # fn arguments of combinators (cond/scan/fori_loop/...)
+                    # execute in the caller's axis environment too
+                    canon = self.project.canonical(sf,
+                                                   dotted_name(call.func))
+                    idxs = combinator_fn_args(canon)
+                    if not idxs:
+                        continue
+                    for i in idxs:
+                        if i < len(call.args) and isinstance(call.args[i],
+                                                             ast.Name):
+                            for fi in self._local_functions_named(
+                                    sf, call.args[i].id):
+                                self.callsites.setdefault(
+                                    fi.full_name, []).append((sf, info,
+                                                              call))
+
+    def _can_complete(self, info: FunctionInfo) -> bool:
+        """Completeness only propagates to callees that cannot be invoked
+        from contexts we cannot see: nested functions and module-private
+        top-level helpers."""
+        return "." in info.qualname or info.qualname.startswith("_")
+
+    def _propagate(self) -> None:
+        by_full = {i.full_name: i for sf in self.project.files
+                   for i in sf.symbols.functions.values()}
+        for _ in range(6):
+            changed = False
+            for callee_full, sites in self.callsites.items():
+                info = by_full.get(callee_full)
+                if info is None:
+                    continue
+                cur = self.envs.get(callee_full)
+                if cur is not None and cur.direct:
+                    continue
+                axes: Set[str] = set()
+                complete = self._can_complete(info)
+                src = ""
+                for sf, caller, _call in sites:
+                    env = self.env_of(caller.full_name)
+                    axes |= env.axes
+                    complete = complete and env.complete
+                    if env.axes and not src:
+                        src = (f"called from {caller.qualname} "
+                               f"({env.source})")
+                if not axes and not complete:
+                    continue
+                changed |= self._merge(
+                    callee_full, frozenset(axes), complete,
+                    src or f"every caller of {info.qualname} runs with no "
+                           "named axes bound")
+            if not changed:
+                break

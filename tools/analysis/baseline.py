@@ -56,3 +56,16 @@ def split(findings: List[Finding], baseline: Dict[str, dict]
         (suppressed if f.fingerprint in baseline else new).append(f)
     stale = [fp for fp in baseline if fp not in seen]
     return new, suppressed, stale
+
+
+def update(findings: List[Finding], path: str = DEFAULT_BASELINE
+           ) -> List[dict]:
+    """Rewrite the baseline from the current findings; return the pruned
+    entries (fingerprints no longer produced) so the caller can print what
+    was dropped — a silent prune would hide that a once-accepted defect
+    either got fixed or moved to a new fingerprint."""
+    previous = load(path)
+    current = {f.fingerprint for f in findings}
+    pruned = [e for fp, e in previous.items() if fp not in current]
+    save(findings, path)
+    return pruned

@@ -1,0 +1,119 @@
+"""Incremental analysis cache — skip re-analysis when nothing changed.
+
+One JSON file under ``.analysis_cache/`` records (a) per-file
+``mtime_ns``/``size``/``sha1`` so unchanged files are never re-hashed
+(the mtime+size fast path) and (b) per-run-key results keyed by the
+**tree hash** — a digest over every target file's content hash *plus* the
+analysis tooling's own sources, so editing an analyzer invalidates its
+cached verdicts just like editing the code under analysis.
+
+Every analyzer in the suite may read cross-module state (the jitmap /
+axismap are interprocedural), so the unit of caching is the whole tree,
+not a file: any content change misses, an untouched tree is a full hit
+that skips parsing entirely. That is exactly the CI shape — repeated runs
+on an unchanged checkout cost ~nothing, and the cold run after a real
+change pays the full price once.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+from .core import Finding
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+CACHE_DIRNAME = ".analysis_cache"
+
+
+def _sha1_file(path: str) -> str:
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def tool_hash() -> str:
+    """Digest of the analysis suite's own sources (self-invalidation)."""
+    h = hashlib.sha1()
+    for root, dirs, names in os.walk(_TOOLS_DIR):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", CACHE_DIRNAME))
+        for name in sorted(names):
+            if name.endswith(".py"):
+                path = os.path.join(root, name)
+                h.update(os.path.relpath(path, _TOOLS_DIR).encode())
+                h.update(_sha1_file(path).encode())
+    return h.hexdigest()
+
+
+class AnalysisCache:
+    def __init__(self, cache_dir: str):
+        self.cache_dir = cache_dir
+        self.path = os.path.join(cache_dir, "cache.json")
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                self.data = json.load(f)
+        except (OSError, ValueError):
+            self.data = {}
+        if self.data.get("version") != 1:
+            self.data = {"version": 1, "files": {}, "runs": {}}
+
+    # -- tree state --
+    def tree_hash(self, files: List[str], repo: str) -> str:
+        """Content digest of the target set, mtime+size fast-pathed."""
+        cached: Dict[str, dict] = self.data.setdefault("files", {})
+        fresh: Dict[str, dict] = {}
+        h = hashlib.sha1()
+        for path in sorted(files):
+            rel = os.path.relpath(path, repo).replace(os.sep, "/")
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            entry = cached.get(rel)
+            if entry is None or entry["mtime_ns"] != st.st_mtime_ns \
+                    or entry["size"] != st.st_size:
+                entry = {"mtime_ns": st.st_mtime_ns, "size": st.st_size,
+                         "sha1": _sha1_file(path)}
+            fresh[rel] = entry
+            h.update(rel.encode())
+            h.update(entry["sha1"].encode())
+        self.data["files"] = fresh
+        h.update(tool_hash().encode())
+        return h.hexdigest()
+
+    # -- run results --
+    def get(self, run_key: str, tree: str) -> Optional[dict]:
+        run = self.data.get("runs", {}).get(run_key)
+        if run is None or run.get("tree") != tree:
+            return None
+        return run
+
+    def put(self, run_key: str, tree: str, findings: List[Finding],
+            counts: Dict[str, int], nfiles: int) -> None:
+        self.data.setdefault("runs", {})[run_key] = {
+            "tree": tree,
+            "nfiles": nfiles,
+            "counts": counts,
+            "findings": [{"analyzer": f.analyzer, "path": f.path,
+                          "line": f.line, "col": f.col,
+                          "message": f.message,
+                          "fingerprint": f.fingerprint}
+                         for f in findings],
+        }
+
+    @staticmethod
+    def findings_of(run: dict) -> List[Finding]:
+        return [Finding(**e) for e in run.get("findings", [])]
+
+    def save(self) -> None:
+        os.makedirs(self.cache_dir, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.data, f)
+        os.replace(tmp, self.path)

@@ -23,10 +23,12 @@ from __future__ import annotations
 import ast
 import builtins
 import hashlib
+import io
 import os
 import re
+import tokenize
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -38,7 +40,12 @@ DEFAULT_TARGETS = ["synapseml_tpu", "tools", "bench.py",
 
 #: ``# lint-ok`` suppresses every analyzer on that line;
 #: ``# lint-ok: trace-safety, determinism`` suppresses the named ones.
-_SUPPRESS_RE = re.compile(r"#\s*lint-ok(?::\s*([A-Za-z0-9_,\- ]+))?")
+#: Trailing justification prose after the ids is encouraged and ignored.
+#: Matched against COMMENT tokens only (never string/docstring contents)
+#: and anchored at the start of the comment.
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint-ok\b"
+    r"(?::\s*([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*))?")
 
 BUILTINS = set(dir(builtins)) | {
     "__file__", "__name__", "__doc__", "__package__", "__spec__",
@@ -350,16 +357,38 @@ class Project:
         return resolved
 
     # -- finding post-processing --
-    def finalize(self, findings: List[Finding]) -> List[Finding]:
-        """Drop suppressed findings, attach fingerprints, sort."""
+    def finalize(self, findings: List[Finding],
+                 ran: Optional[Iterable[str]] = None,
+                 known: Optional[Iterable[str]] = None) -> List[Finding]:
+        """Drop suppressed findings, attach fingerprints, sort.
+
+        When ``ran`` (the analyzer ids that executed this run) is given,
+        every ``# lint-ok`` comment is audited: a suppression naming an
+        analyzer that *ran* yet matched no finding is itself reported (id
+        ``unused-suppression``) — stale suppressions hide future
+        regressions. A named analyzer that did not run is left unjudged; a
+        bare ``# lint-ok`` is only judged when ``ran`` covers the whole
+        registry (``known``). Ids absent from ``known`` are flagged as
+        typos.
+        """
         by_rel = {sf.rel: sf for sf in self.files}
         kept: List[Finding] = []
-        occurrence: Dict[Tuple[str, str, str], int] = {}
-        for f in sorted(findings,
-                        key=lambda f: (f.path, f.line, f.col, f.analyzer)):
+        #: (path, line) -> analyzer ids a suppression actually absorbed
+        matched: Dict[Tuple[str, int], Set[str]] = {}
+        for f in findings:
             sf = by_rel.get(f.path)
             if sf is not None and sf.suppressed(f.line, f.analyzer):
+                matched.setdefault((f.path, f.line), set()).add(f.analyzer)
                 continue
+            kept.append(f)
+        if ran is not None:
+            kept.extend(self._audit_suppressions(set(ran),
+                                                 set(known or ()), matched))
+        occurrence: Dict[Tuple[str, str, str], int] = {}
+        out: List[Finding] = []
+        for f in sorted(kept,
+                        key=lambda f: (f.path, f.line, f.col, f.analyzer)):
+            sf = by_rel.get(f.path)
             line_text = ""
             if sf is not None and 0 < f.line <= len(sf.lines):
                 line_text = sf.lines[f.line - 1].strip()
@@ -368,21 +397,80 @@ class Project:
             occurrence[key] = idx + 1
             raw = f"{f.analyzer}|{f.path}|{line_text}|{idx}"
             f.fingerprint = hashlib.sha1(raw.encode()).hexdigest()[:16]
-            kept.append(f)
-        return kept
+            out.append(f)
+        return out
+
+    def _audit_suppressions(self, ran: Set[str], known: Set[str],
+                            matched: Dict[Tuple[str, int], Set[str]]
+                            ) -> List[Finding]:
+        extra: List[Finding] = []
+        full_run = bool(known) and ran >= known
+        for sf in self.files:
+            for line, ids in sorted(sf.suppressions.items()):
+                hit = matched.get((sf.rel, line), set())
+                if ids == {"*"}:
+                    if full_run and not hit:
+                        extra.append(Finding(
+                            analyzer="unused-suppression", path=sf.rel,
+                            line=line, col=0,
+                            message=("bare `# lint-ok` suppressed nothing "
+                                     "— remove it, or name the analyzer "
+                                     "it is meant for")))
+                    continue
+                for aid in sorted(ids - hit):
+                    if known and aid not in known:
+                        extra.append(Finding(
+                            analyzer="unused-suppression", path=sf.rel,
+                            line=line, col=0,
+                            message=(f"`# lint-ok: {aid}` names an unknown "
+                                     "analyzer id (see --list) — the "
+                                     "suppression can never match")))
+                    elif aid in ran:
+                        extra.append(Finding(
+                            analyzer="unused-suppression", path=sf.rel,
+                            line=line, col=0,
+                            message=(f"`# lint-ok: {aid}` suppressed "
+                                     f"nothing — `{aid}` ran and found no "
+                                     "issue on this line; remove the stale "
+                                     "suppression")))
+        return extra
 
 
 def _scan_suppressions(text: str) -> Dict[int, Set[str]]:
+    """line -> suppressed analyzer ids, from real COMMENT tokens only.
+
+    Tokenizing (instead of grepping lines) keeps ``lint-ok`` inside string
+    literals, docstrings and test fixtures from registering as suppressions;
+    anchoring at the comment start keeps prose *mentioning* the marker from
+    matching. Falls back to a plain line scan when the file doesn't tokenize
+    (the syntax-error path still parses what it can).
+    """
     out: Dict[int, Set[str]] = {}
-    for i, line in enumerate(text.splitlines(), 1):
-        if "lint-ok" not in line:
-            continue
-        m = _SUPPRESS_RE.search(line)
-        if not m:
-            continue
-        ids = m.group(1)
-        out[i] = ({s.strip() for s in ids.split(",")} if ids else {"*"})
+    if "lint-ok" not in text:
+        return out
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type != tokenize.COMMENT or "lint-ok" not in tok.string:
+                continue
+            m = _SUPPRESS_RE.match(tok.string)
+            if m:
+                out[tok.start[0]] = _suppress_ids(m)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        out.clear()
+        for i, line in enumerate(text.splitlines(), 1):
+            if "lint-ok" not in line:
+                continue
+            hash_at = line.find("#")
+            m = _SUPPRESS_RE.match(line[hash_at:]) if hash_at >= 0 else None
+            if m:
+                out[i] = _suppress_ids(m)
     return out
+
+
+def _suppress_ids(m: "re.Match") -> Set[str]:
+    ids = m.group(1)
+    return ({s.strip() for s in ids.split(",") if s.strip()} if ids
+            else {"*"})
 
 
 def walk_calls(root: ast.AST) -> Iterator[ast.Call]:

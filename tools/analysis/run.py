@@ -5,6 +5,10 @@ Usage::
     python tools/analysis/run.py                     # full tree + baseline
     python tools/analysis/run.py path/ file.py       # explicit targets
     python tools/analysis/run.py --analyzers trace-safety,locks
+    python tools/analysis/run.py --jobs 4            # analyzer process pool
+    python tools/analysis/run.py --cache             # incremental cache
+    python tools/analysis/run.py --stats             # per-analyzer timings
+    python tools/analysis/run.py --format sarif      # SARIF 2.1.0 on stdout
     python tools/analysis/run.py --update-baseline   # re-accept findings
     python tools/analysis/run.py --no-baseline       # raw findings
     python tools/analysis/run.py --list              # analyzer inventory
@@ -12,11 +16,19 @@ Usage::
 Exit code 0 when every finding is baseline-accepted (or none), 1 when new
 findings exist. The codegen-drift analyzer (package import = slow) only
 runs on full-tree runs; fixture/partial runs skip it.
+
+``--jobs N`` fans the selected analyzers out over a forked process pool:
+the parsed project and the interprocedural jit/axis maps are built once
+before the fork and shared copy-on-write, so workers pay no re-parse cost.
+``--cache`` keys results on a content hash of the whole target tree (plus
+the analyzer sources themselves); an unchanged tree is a full hit that
+skips parsing entirely — see tools/analysis/cache.py.
 """
 
 from __future__ import annotations
 
 import argparse
+import multiprocessing
 import os
 import sys
 import time
@@ -27,8 +39,40 @@ if __package__ in (None, ""):                       # `python tools/analysis/run
     __package__ = "tools.analysis"
 
 from tools.analysis import baseline as baseline_mod            # noqa: E402
+from tools.analysis import cache as cache_mod                  # noqa: E402
+from tools.analysis import sarif as sarif_mod                  # noqa: E402
 from tools.analysis.analyzers import Context, registry         # noqa: E402
-from tools.analysis.core import Finding, Project               # noqa: E402
+from tools.analysis.core import (Finding, Project,             # noqa: E402
+                                 discover, DEFAULT_TARGETS, REPO)
+
+#: set before fork so pool workers inherit the parsed project (COW)
+_WORKER: dict = {}
+
+
+def _worker_run(aid: str):
+    t0 = time.perf_counter()
+    findings = _WORKER["reg"][aid].run(_WORKER["ctx"])
+    return aid, findings, time.perf_counter() - t0
+
+
+def _run_analyzers(reg, ctx, selected, jobs):
+    """[(analyzer id, findings, seconds)] — serial or forked pool."""
+    if jobs > 1 and hasattr(os, "fork"):
+        # build the shared interprocedural state pre-fork: workers then
+        # read it copy-on-write instead of re-deriving it N times
+        _ = ctx.jitmap
+        _ = ctx.axismap
+        _WORKER["reg"] = reg
+        _WORKER["ctx"] = ctx
+        mp = multiprocessing.get_context("fork")
+        with mp.Pool(processes=min(jobs, len(selected) or 1)) as pool:
+            return pool.map(_worker_run, selected, chunksize=1)
+    results = []
+    for aid in selected:
+        t0 = time.perf_counter()
+        findings = reg[aid].run(ctx)
+        results.append((aid, findings, time.perf_counter() - t0))
+    return results
 
 
 def main(argv=None) -> int:
@@ -46,15 +90,32 @@ def main(argv=None) -> int:
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, ignoring the baseline")
     ap.add_argument("--update-baseline", action="store_true",
-                    help="accept the current findings as the new baseline")
+                    help="accept the current findings as the new baseline "
+                         "(prunes and reports stale entries)")
     ap.add_argument("--list", action="store_true", dest="list_analyzers",
                     help="list analyzer ids and exit")
     ap.add_argument("--repo", default=None,
                     help="analyze this tree instead of the repository "
                          "(fixture corpora; implies --no-baseline)")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="run analyzers over a forked process pool")
+    ap.add_argument("--cache", action="store_true",
+                    help="reuse results when the target tree is unchanged "
+                         "(stored under .analysis_cache/)")
+    ap.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="cache location (implies --cache)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print a per-analyzer wall-time/finding table")
+    ap.add_argument("--format", choices=("text", "sarif"), default="text",
+                    help="sarif: SARIF 2.1.0 log on stdout, messages on "
+                         "stderr")
     args = ap.parse_args(argv)
     if args.repo:
         args.no_baseline = True
+    if args.cache_dir:
+        args.cache = True
+    # SARIF owns stdout; everything human moves to stderr
+    out = sys.stderr if args.format == "sarif" else sys.stdout
 
     reg = registry()
     if args.list_analyzers:
@@ -78,58 +139,115 @@ def main(argv=None) -> int:
                     if not getattr(reg[a], "FULL_TREE_ONLY", False)]
 
     t0 = time.perf_counter()
-    if args.repo:
-        repo = os.path.abspath(args.repo)
-        project = Project.from_targets(args.paths or ["."], repo=repo)
-    else:
-        project = Project.from_targets(args.paths or None)
-    ctx = Context(project)
+    repo = os.path.abspath(args.repo) if args.repo else REPO
+    targets = args.paths or (["."] if args.repo else DEFAULT_TARGETS)
+    files = discover(targets, repo=repo)
 
-    findings = []
-    for sf in project.files:
-        if sf.syntax_error:
-            findings.append(Finding(analyzer="syntax", path=sf.rel, line=1,
-                                    col=0, message=sf.syntax_error))
-    counts = {}
-    for aid in selected:
-        got = reg[aid].run(ctx)
-        counts[aid] = len(got)
-        findings.extend(got)
-    findings = project.finalize(findings)
+    cache = None
+    cached_run = None
+    run_key = tree = None
+    if args.cache:
+        cache_dir = args.cache_dir or os.path.join(
+            repo, cache_mod.CACHE_DIRNAME)
+        cache = cache_mod.AnalysisCache(cache_dir)
+        run_key = f"{','.join(sorted(selected))}|full={int(full_tree)}"
+        tree = cache.tree_hash(files, repo)
+        cached_run = cache.get(run_key, tree)
+
+    timings = []
+    if cached_run is not None:
+        finalized = cache.findings_of(cached_run)
+        counts = dict(cached_run["counts"])
+        nfiles = cached_run["nfiles"]
+        cache.save()                  # persist refreshed mtime fast-path
+    else:
+        project = Project(files, repo=repo)
+        ctx = Context(project)
+        findings = []
+        for sf in project.files:
+            if sf.syntax_error:
+                findings.append(Finding(
+                    analyzer="syntax", path=sf.rel, line=1, col=0,
+                    message=sf.syntax_error))
+        counts = {}
+        for aid, got, dt in _run_analyzers(reg, ctx, selected, args.jobs):
+            counts[aid] = len(got)
+            findings.extend(got)
+            timings.append((aid, len(got), dt))
+        finalized = project.finalize(findings, ran=selected,
+                                     known=set(reg))
+        counts["unused-suppression"] = sum(
+            1 for f in finalized if f.analyzer == "unused-suppression")
+        nfiles = len(project.files)
+        if cache is not None:
+            cache.put(run_key, tree, finalized, counts, nfiles)
+            cache.save()
 
     if args.update_baseline:
-        baseline_mod.save(findings, args.baseline)
-        print(f"baseline updated: {len(findings)} accepted finding(s) -> "
-              f"{args.baseline}")
+        pruned = baseline_mod.update(finalized, args.baseline)
+        print(f"baseline updated: {len(finalized)} accepted finding(s) -> "
+              f"{args.baseline}", file=out)
+        for e in pruned:
+            print(f"baseline pruned: {e['fingerprint']}  "
+                  f"{e['path']}:{e['line']} [{e['analyzer']}] {e['message']}",
+                  file=out)
+        if pruned:
+            print(f"baseline: {len(pruned)} stale entr"
+                  f"{'y' if len(pruned) == 1 else 'ies'} dropped", file=out)
         return 0
 
     known = {} if args.no_baseline else baseline_mod.load(args.baseline)
-    new, suppressed, stale = baseline_mod.split(findings, known)
+    new, suppressed, stale = baseline_mod.split(finalized, known)
 
+    if args.format == "sarif":
+        rules = {aid: reg[aid].DESCRIPTION for aid in selected}
+        rules["syntax"] = "file does not parse"
+        rules["unused-suppression"] = ("`# lint-ok` comments that no "
+                                       "analyzer matched")
+        print(sarif_mod.render(new, rules))
     for f in new:
-        print(f.format())
+        print(f.format(), file=out)
+
+    if args.stats:
+        print("analyzer             findings   new      time", file=out)
+        for aid, n, dt in sorted(timings, key=lambda t: -t[2]):
+            n_new = sum(1 for f in new if f.analyzer == aid)
+            print(f"{aid:20s} {n:8d} {n_new:5d} {dt:8.2f}s", file=out)
+        if cached_run is not None:
+            print("(results served from the incremental cache — no "
+                  "analyzers ran)", file=out)
+
     # per-analyzer summary (the ci.sh requirement): total/new per analyzer
     new_by = {}
     for f in new:
         new_by[f.analyzer] = new_by.get(f.analyzer, 0) + 1
     parts = []
-    for aid in selected:
+    for aid in selected + (["unused-suppression"]
+                           if counts.get("unused-suppression") or
+                           new_by.get("unused-suppression") else []):
         n = new_by.get(aid, 0)
         parts.append(f"{aid}={n}" if n == counts.get(aid, 0)
                      else f"{aid}={n}(+{counts[aid] - n} suppressed)")
     dt = time.perf_counter() - t0
-    print(f"analysis: {len(project.files)} files in {dt:.2f}s · "
-          + " ".join(parts))
+    cached_note = " (cached)" if cached_run is not None else ""
+    print(f"analysis: {nfiles} files in {dt:.2f}s{cached_note} · "
+          + " ".join(parts), file=out)
     if suppressed:
-        print(f"analysis: {len(suppressed)} baseline-suppressed finding(s)")
+        print(f"analysis: {len(suppressed)} baseline-suppressed finding(s)",
+              file=out)
     if stale:
         print(f"analysis: {len(stale)} stale baseline entr"
               f"{'y' if len(stale) == 1 else 'ies'} (no longer produced — "
-              "consider --update-baseline)")
+              "consider --update-baseline)", file=out)
     if new:
-        print(f"analysis: FAIL — {len(new)} new finding(s)")
+        syntax = [f for f in new if f.analyzer == "syntax"]
+        if syntax:
+            print(f"analysis: FAIL — {len(syntax)} file(s) do not parse "
+                  "(fix the syntax errors above; other analyzers only saw "
+                  "the files that parsed)", file=out)
+        print(f"analysis: FAIL — {len(new)} new finding(s)", file=out)
         return 1
-    print("analysis: OK")
+    print("analysis: OK", file=out)
     return 0
 
 

@@ -1,0 +1,62 @@
+"""SARIF 2.1.0 output — machine-readable findings for code-scanning UIs.
+
+``run.py --format sarif`` prints one SARIF log to stdout (human progress
+and summaries move to stderr so the JSON stays parseable in a pipe). Each
+analyzer becomes a rule; each *new* (non-baselined) finding becomes a
+result with a physical location. Fingerprints ride along under
+``partialFingerprints`` so external dedup matches the baseline's.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from .core import Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render(findings: List[Finding], rules: Dict[str, str]) -> str:
+    """SARIF JSON for ``findings``; ``rules`` maps analyzer id -> text."""
+    used = {f.analyzer for f in findings}
+    rule_objs = [
+        {"id": aid,
+         "shortDescription": {"text": rules.get(aid, aid)}}
+        for aid in sorted(used | set(rules))
+    ]
+    index = {r["id"]: i for i, r in enumerate(rule_objs)}
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.analyzer,
+            "ruleIndex": index.get(f.analyzer, -1),
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                }
+            }],
+            "partialFingerprints": {"analysisFingerprint/v1": f.fingerprint},
+        })
+    log = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "synapseml-tpu-analysis",
+                "informationUri":
+                    "docs/static-analysis.md",
+                "rules": rule_objs,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+    return json.dumps(log, indent=1, sort_keys=True)

@@ -634,7 +634,8 @@ _HOST_SIDE_METRICS = frozenset({"serving_latency_p50_ms",
                                 "serving_requests_per_sec",
                                 "serving_resnet50_latency_p50_ms",
                                 "serving_distributed_latency_p50_ms",
-                                "gbdt_voting_vs_data_parallel_speedup"})
+                                "gbdt_voting_vs_data_parallel_speedup",
+                                "gbdt_distributed_auto_vs_manual"})
 
 
 def record_measurement(entry: dict, path: str = None):
@@ -1098,6 +1099,106 @@ def bench_voting_ab(rows=50_000, cols=100, iters=10):
                                  / d["row_iters_per_s"], 3)}
 
 
+def bench_distributed_gbdt_auto(iters=10):
+    """Distributed-GBDT router A/B on the virtual 8-device CPU mesh: every
+    manual parallelism flag (data / voting where F > 2k / feature) vs
+    ``tree_learner='auto'`` with the int8 histogram wire, on the three shapes
+    the router must not misroute — wide (r05's 100-col shape), narrow
+    (20-col) and tall. Same-platform ratios — valid off-chip by construction
+    (all arms ride the identical mesh; each arm's rate is the best of two
+    timed fits, since single fits on a contended host jitter ~10%). The wide
+    dataset also runs the exact r05 configuration (data-parallel, f32 wire)
+    as a same-run baseline: r05's absolute 26.6k r-i/s was captured on
+    different hardware and absolute rates don't transfer, so the 1.5x claim
+    is anchored to the baseline RE-MEASURED in this run. The returned record
+    carries per-dataset rates, the router's recorded decision + cost-model
+    inputs (booster metadata), and the two guard verdicts ci.sh enforces:
+    auto >= 0.95x the best manual flag everywhere, and wide auto >= 1.5x the
+    same-run data-parallel f32 baseline."""
+    import jax
+
+    from synapseml_tpu.gbdt import BoosterConfig, train_booster
+    from synapseml_tpu.gbdt.voting import collective_bytes_per_split
+    from synapseml_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    r05_rate = 26_600.0          # BENCH_r05 8-dev data-parallel r-i/s
+    top_k = 20
+    base = dict(objective="binary", num_leaves=15, max_bin=63, seed=1,
+                top_k=top_k, hist_allreduce_dtype="int8")
+    datasets = {"wide": (50_000, 100), "narrow": (12_288, 20),
+                "tall": (40_960, 20)}
+    results = {}
+    for dname, (rows, cols) in datasets.items():
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(rows, cols)).astype(np.float32)
+        informative = rng.choice(cols, size=8, replace=False)
+        y = (sum(X[:, j] for j in informative)
+             + rng.normal(scale=0.5, size=rows) > 0).astype(np.float32)
+        arms = ["data"] + (["voting"] if cols > 2 * top_k else []) \
+            + ["feature", "auto"]
+        if dname == "wide":
+            arms.append("data_f32")      # the r05 config, re-measured here
+        dres = {}
+        for arm in arms:
+            kw = dict(base, num_iterations=iters, tree_learner=arm)
+            if arm == "data_f32":
+                kw.update(tree_learner="data", hist_allreduce_dtype="f32")
+            # warm separately: compile + router probes land in caches, the
+            # timed fits measure the steady-state production path
+            train_booster(X, y, BoosterConfig(**kw), mesh=mesh)
+            best_dt, cfg = float("inf"), None
+            for _ in range(2):           # best-of-2 damps scheduler noise
+                cfg = BoosterConfig(**kw)
+                t0 = time.perf_counter()
+                b = train_booster(X, y, cfg, mesh=mesh)
+                jax.block_until_ready(b.trees[-1].leaf_value)
+                best_dt = min(best_dt, time.perf_counter() - t0)
+            dres[arm] = {"row_iters_per_s": round(rows * iters / best_dt, 1),
+                         "resolved": cfg.tree_learner}
+            if arm == "auto":
+                dres[arm]["routing"] = b.metadata.get("routing")
+        best_manual = max(v["row_iters_per_s"] for a, v in dres.items()
+                          if a not in ("auto", "data_f32"))
+        auto_rate = dres["auto"]["row_iters_per_s"]
+        resolved = dres["auto"]["resolved"]
+        results[dname] = {
+            "rows": rows, "cols": cols, "arms": dres,
+            "auto_vs_best_manual": round(auto_rate / best_manual, 3),
+            # logical wire bytes per tree at the resolved mode + int8 ladder
+            # rung (feature-parallel reduce-scatter moves half an allreduce)
+            "collective_bytes_per_tree": int(
+                (base["num_leaves"] - 1)
+                * collective_bytes_per_split(
+                    cols, base["max_bin"],
+                    top_k=(top_k if resolved == "voting" else None),
+                    dtype_bytes=2.0)
+                * (0.5 if resolved == "feature" else 1.0)),
+        }
+    min_ratio = min(r["auto_vs_best_manual"] for r in results.values())
+    wide_auto = results["wide"]["arms"]["auto"]["row_iters_per_s"]
+    data_f32 = results["wide"]["arms"]["data_f32"]["row_iters_per_s"]
+    speedup = wide_auto / data_f32
+    return {"metric": "gbdt_distributed_auto_vs_manual",
+            "platform": "cpu-mesh-8",   # honest provenance: never the chip
+            "value": round(min_ratio, 3),
+            "unit": ("x (auto / best manual r-i/s, min over "
+                     "wide/narrow/tall; auto wide "
+                     f"{wide_auto:.0f} r-i/s = {speedup:.2f}x the same-run "
+                     "data-parallel f32 baseline)"),
+            "distributed_row_iters_per_s": wide_auto,
+            "data_parallel_f32_row_iters_per_s": data_f32,
+            "speedup_vs_data_parallel_f32": round(speedup, 2),
+            # context only: the r05 capture ran on different hardware, so
+            # its absolute rate is not comparable to this run's
+            "r05_recorded_rate": r05_rate,
+            "datasets": results,
+            "guard": {"auto_within_5pct_of_best_manual": min_ratio >= 0.95,
+                      "wide_auto_ge_1p5x_data_parallel_f32":
+                          wide_auto >= 1.5 * data_f32},
+            "vs_baseline": round(speedup, 3)}
+
+
 def _extra_workloads():
     bench_onnx_bf16 = functools.partial(bench_onnx_inference,
                                         precision="bfloat16")
@@ -1110,6 +1211,7 @@ def _extra_workloads():
            bench_flash_attention, bench_sparse_ingest,
            bench_serving, bench_serving_resnet,
            bench_serving_distributed, bench_voting_ab,
+           bench_distributed_gbdt_auto,
            bench_checkpoint_overhead)
     return {f.__name__: f for f in fns}
 
@@ -1160,9 +1262,9 @@ def main():
     if "--only" in sys.argv:
         only = sys.argv[sys.argv.index("--only") + 1]
         _ONLY_MODE[0] = only
-    if only == "bench_voting_ab":
-        # mesh workload: virtual 8-device CPU mesh regardless of the chip
-        # (the metric is a same-platform ratio). Must be set before the
+    if only in ("bench_voting_ab", "bench_distributed_gbdt_auto"):
+        # mesh workloads: virtual 8-device CPU mesh regardless of the chip
+        # (the metrics are same-platform ratios). Must be set before the
         # backend initializes; _init_device_with_watchdog honors
         # JAX_PLATFORMS via the config API.
         os.environ["JAX_PLATFORMS"] = "cpu"

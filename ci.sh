@@ -91,4 +91,30 @@ print(f"serving perf guard ok: buckets={after['buckets']} "
       f"hits={after['total_hits']}")
 EOF
 
+echo "== distributed gbdt guard (quantized wire + auto router) =="
+JAX_PLATFORMS=cpu python - << 'EOF'
+# the routed learner must never lose to a hand-picked flag: auto's measured
+# throughput stays within 5% of the best manual arm on every dataset shape,
+# and on the wide shape auto must beat the same-run data-parallel f32
+# baseline (the r05 configuration re-measured on THIS host — absolute rates
+# don't transfer across hardware) by >= 1.5x (docs/distributed-gbdt.md);
+# per-tree collective bytes ride along in the bench record for trending
+import json, subprocess, sys
+out = subprocess.run([sys.executable, "bench.py", "--only",
+                      "bench_distributed_gbdt_auto"],
+                     capture_output=True, text=True, check=True).stdout
+rec = json.loads(out.strip().splitlines()[-1])
+per_ds = {name: ds["auto_vs_best_manual"]
+          for name, ds in rec["datasets"].items()}
+print(f"auto/best-manual per dataset: {per_ds} "
+      f"(wide auto {rec['distributed_row_iters_per_s']} r-i/s, "
+      f"{rec['speedup_vs_data_parallel_f32']}x same-run data-parallel f32)")
+assert rec["guard"]["auto_within_5pct_of_best_manual"], \
+    f"auto routed onto a >5%-slower learner: {per_ds}"
+assert rec["guard"]["wide_auto_ge_1p5x_data_parallel_f32"], \
+    (f"wide auto {rec['distributed_row_iters_per_s']} r-i/s < 1.5x the "
+     f"same-run data-parallel f32 baseline "
+     f"{rec['data_parallel_f32_row_iters_per_s']} r-i/s")
+EOF
+
 echo "CI OK"

@@ -141,6 +141,48 @@ def tuned_default(key: str, env_var: str, fallback):
     return tuned_engine_defaults().get(key, fallback)
 
 
+# ---------------------------------------------------------------------------
+# In-process measurement store. Unlike the tuned FILE above (chip facts,
+# persisted, TPU-gated), these are probe results valid only for the current
+# process+mesh — link bandwidth, selection timing — consumed by the
+# distributed-GBDT router. First caller pays the probe; later boosters on the
+# same mesh read the cached number.
+# ---------------------------------------------------------------------------
+
+_MEASUREMENTS: dict = {}
+
+
+def mesh_fingerprint(mesh) -> tuple:
+    """Hashable identity of a mesh for probe caching: axis layout plus the
+    participating device strings (stable across Mesh-object recreation in one
+    process, distinct across different device subsets)."""
+    axes = tuple((str(k), int(v)) for k, v in dict(mesh.shape).items())
+    devs = tuple(str(d) for d in mesh.devices.flat)
+    return axes + devs
+
+
+def measured_or(key, compute):
+    """Get-or-measure: return the cached value for ``key``, running
+    ``compute()`` (and caching its result) on the first call. Keys should
+    start with a metric name and include ``mesh_fingerprint(mesh)``."""
+    if key not in _MEASUREMENTS:
+        _MEASUREMENTS[key] = compute()
+    return _MEASUREMENTS[key]
+
+
+def get_measurement(key, default=None):
+    return _MEASUREMENTS.get(key, default)
+
+
+def put_measurement(key, value) -> None:
+    _MEASUREMENTS[key] = value
+
+
+def clear_measurements() -> None:
+    """Test hook: forget all probe results (forces re-measurement)."""
+    _MEASUREMENTS.clear()
+
+
 def write_tuned_defaults(values: dict, provenance: dict,
                          path: str = None) -> Optional[str]:
     """Write the measured winners atomically (tmp + replace). Unknown keys

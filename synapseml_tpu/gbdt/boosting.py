@@ -97,10 +97,16 @@ class BoosterConfig:
     feature_fraction_seed: int = 0
     extra_seed: int = 0
     start_iteration: int = 0              # prediction start (predict window)
-    # distributed tree learner: "serial"/"data" aggregate all features'
+    # distributed tree learner: "auto" (default) routes per dataset through
+    # the measured cost model in gbdt/voting.py at fit time (falls back to
+    # "serial" off-mesh; the decision + model inputs land in
+    # Booster.metadata["routing"]); "serial"/"data" aggregate all features'
     # histograms; "voting" selects top-2k features per tree by shard votes
-    # (PV-Tree; LightGBM voting_parallel + topK — LightGBMParams.scala:25-27)
-    tree_learner: str = "serial"
+    # (PV-Tree; LightGBM voting_parallel + topK — LightGBMParams.scala:25-27);
+    # "feature" is the owned-feature reduce-scatter grower (each device keeps
+    # 1/world of the reduced histogram and per-leaf winners are exchanged —
+    # LightGBM data_parallel's actual wire pattern). Explicit values force.
+    tree_learner: str = "auto"
     top_k: int = 20
     # row-partition primitive inside the grower ("sort" | "sort32" | "scan"
     # | "scatter"); see GrowerConfig.partition_impl. Default resolution
@@ -126,10 +132,11 @@ class BoosterConfig:
     # growth policy: "leafwise" (LightGBM parity) | "depthwise"
     # (level-batched opt-in; see grower_depthwise.py)
     growth_policy: str = "leafwise"
-    # histogram allreduce wire precision ("f32" | "bf16") — grad/hess ride
-    # the wire at half width (counts stay exact f32), cutting per-split
-    # collective bytes to 2/3 on multi-host fabrics at one extra rounding
-    # of the grad/hess SUMS; see GrowerConfig.hist_allreduce_dtype
+    # histogram allreduce wire precision ladder ("f32" | "bf16" | "int8") —
+    # grad/hess ride the wire at reduced width (counts stay exact), cutting
+    # per-split collective bytes to 2/3 (bf16) or ~1/2 (int8 blockwise-
+    # quantized allreduce, EQuARX-style incl. per-block scales) on
+    # multi-host fabrics; see GrowerConfig.hist_allreduce_dtype
     hist_allreduce_dtype: str = "f32"
     # lambdarank
     lambdarank_truncation_level: int = 30
@@ -173,11 +180,16 @@ class BoosterConfig:
             raise ValueError(
                 f"BoosterConfig.growth_policy={self.growth_policy!r} is not "
                 "one of ('leafwise', 'depthwise')")
-        if self.hist_allreduce_dtype not in ("f32", "bf16"):
+        if self.hist_allreduce_dtype not in ("f32", "bf16", "int8"):
             raise ValueError(
                 f"BoosterConfig.hist_allreduce_dtype="
                 f"{self.hist_allreduce_dtype!r} is not one of "
-                "('f32', 'bf16')")
+                "('f32', 'bf16', 'int8')")
+        if self.tree_learner not in ("auto", "serial", "data", "voting",
+                                     "feature"):
+            raise ValueError(
+                f"BoosterConfig.tree_learner={self.tree_learner!r} is not "
+                "one of ('auto', 'serial', 'data', 'voting', 'feature')")
 
     def _resolve_tuned(self):
         """Fill sentinel-defaulted engine knobs from env > tuned file >
@@ -214,10 +226,14 @@ class BoosterConfig:
                 setattr(self, field, td.get(field, fallback))
             self._deferred_tuned = []
 
-    def grower(self, has_categorical: bool = False) -> GrowerConfig:
+    def grower(self, has_categorical: bool = False,
+               feature_shards: int = 1) -> GrowerConfig:
         self._finalize_tuned()
         lr = 1.0 if self.boosting_type == "rf" else self.learning_rate
+        feature_mode = self.tree_learner == "feature" and feature_shards > 1
         return GrowerConfig(
+            hist_reduce="scatter" if feature_mode else "allreduce",
+            feature_shards=feature_shards if feature_mode else 1,
             has_categorical=has_categorical,
             num_leaves=self.num_leaves,
             num_bins=self.max_bin,
@@ -254,8 +270,12 @@ class Booster:
                  best_iteration: int = -1,
                  thresholds: Optional[List[np.ndarray]] = None,
                  missing_types: Optional[List[np.ndarray]] = None,
-                 best_score: Optional[float] = None):
+                 best_score: Optional[float] = None,
+                 metadata: Optional[dict] = None):
         self.mapper = mapper
+        # training provenance (e.g. the parallelism router's decision and the
+        # measured inputs it saw); empty for loaded native models
+        self.metadata: dict = dict(metadata) if metadata else {}
         self.config = config
         self.trees = trees
         self.tree_weights = list(tree_weights)
@@ -682,6 +702,96 @@ def _make_grow_fn(grower_cfg, mesh):
     return grow_fn
 
 
+def _auto_route(cfg, mesh, binned, nfeat, n_rows, multiproc,
+                has_categorical):
+    """Resolve ``tree_learner='auto'`` into a concrete learner.
+
+    Single-process mesh: measure the link (one timed ~1MB allreduce) and —
+    when voting is even a candidate (F > 2k) — the selection pass, both
+    cached per mesh in ``core.tuned``'s measurement store, then let
+    ``voting.route_parallelism`` pick data / voting / feature from the
+    quantization-aware cost model. Multi-process training skips the probes
+    (a timed collective would need every process in lockstep before shapes
+    are agreed) and falls back to the static ``recommend_tree_learner``
+    model, as before. Returns ``(choice, info)``; ``info`` lands in
+    ``Booster.metadata['routing']`` so the decision is auditable.
+    """
+    from .voting import recommend_tree_learner, route_parallelism
+
+    if mesh is None:
+        return "data", {"tree_learner": "data", "router": "static",
+                        "reason": "no mesh: serial == data-parallel-of-1"}
+    from ..parallel.mesh import DATA_AXIS as _DA
+
+    n_workers = int(dict(mesh.shape).get(_DA, 1))
+    if multiproc or n_workers <= 1:
+        choice = recommend_tree_learner(
+            nfeat, cfg.max_bin, cfg.top_k, cfg.num_leaves,
+            n_hosts=jax.process_count(), rows_per_host=n_rows,
+            dtype_bytes=(8 / 3 if cfg.hist_allreduce_dtype == "bf16" else 4))
+        reason = "multi-process: static model (no probes)" \
+            if multiproc else "single worker"
+        if choice == "voting" and multiproc:
+            import warnings
+
+            warnings.warn(
+                "tree_learner='auto': the collective cost model prefers "
+                "voting-parallel at this shape, but multi-process training "
+                "does not support the voting learner yet — falling back to "
+                "data-parallel. Set tree_learner='voting' on a "
+                "single-process mesh to use it.")
+            choice = "data"
+        return choice, {"tree_learner": choice, "router": "static",
+                        "reason": reason}
+
+    from ..core import tuned
+    from ..parallel.collectives import probe_link_bandwidth
+
+    try:
+        fp = tuned.mesh_fingerprint(mesh)
+        link = tuned.measured_or(("link_bytes_per_s", fp),
+                                 lambda: probe_link_bandwidth(mesh))
+        sel_s, sel_frac = None, 1.0
+        if nfeat > 2 * cfg.top_k:
+            from .voting import time_selection
+
+            sel_s, sel_frac = tuned.measured_or(
+                ("selection_s_per_tree", fp, int(binned.shape[0]), nfeat,
+                 cfg.max_bin, cfg.top_k),
+                lambda: time_selection(
+                    binned, mesh, cfg.top_k, cfg.max_bin,
+                    lambda_l2=cfg.lambda_l2,
+                    min_data=max(cfg.min_data_in_leaf, 1)))
+        from ..ops.hist_kernel import features_padded as _fpad
+
+        feature_ok = (not has_categorical
+                      and cfg.growth_policy == "leafwise"
+                      and cfg.row_layout == "partition"
+                      and _fpad(nfeat) % n_workers == 0)
+        choice, info = route_parallelism(
+            nfeat, cfg.max_bin, cfg.top_k, cfg.num_leaves,
+            n_workers=n_workers,
+            rows_per_worker=max(n_rows // n_workers, 1),
+            link_bytes_per_s=link,
+            selection_s_per_tree=sel_s,
+            selection_fraction_of_rows=sel_frac,
+            wire_dtype=cfg.hist_allreduce_dtype,
+            feature_parallel_ok=feature_ok)
+        info["router"] = "measured"
+        return choice, info
+    except Exception as e:                   # pragma: no cover - probe escape
+        import warnings
+
+        warnings.warn(f"tree_learner='auto': probe failed ({e!r}); "
+                      "using the static cost model")
+        choice = recommend_tree_learner(
+            nfeat, cfg.max_bin, cfg.top_k, cfg.num_leaves,
+            n_hosts=jax.process_count(), rows_per_host=n_rows,
+            dtype_bytes=(8 / 3 if cfg.hist_allreduce_dtype == "bf16" else 4))
+        return choice, {"tree_learner": choice, "router": "static",
+                        "reason": f"probe failed: {e!r}"}
+
+
 # ---------------------------------------------------------------------------
 # Fused-scan runner cache: the jitted whole-training program is cached ACROSS
 # train_booster calls (keyed by the static config + shapes), so a warmup call
@@ -976,7 +1086,7 @@ def train_booster(
             ("init_score", init_score), ("group_sizes", group_sizes)]
             if v is not None]
         if unsupported or cfg.boosting_type == "dart" \
-                or cfg.tree_learner == "voting":
+                or cfg.tree_learner in ("voting", "feature"):
             raise NotImplementedError(
                 "multi-process training currently supports the fused path "
                 f"only (gbdt/goss/rf, serial learner); got {unsupported or cfg}")
@@ -1214,7 +1324,24 @@ def train_booster(
                 tree_contribs.append((ti % prior_k, per_tree[:, ti].astype(np.float32)))
     n_init_trees = len(trees)
 
-    grower_cfg = cfg.grower(has_categorical=bool(mapper.is_categorical.any()))
+    # tree_learner routing happens BEFORE the grower config is derived: the
+    # resolved learner decides the grower's reduction strategy (feature-
+    # parallel = owned-feature reduce-scatter). The resolved value lands on
+    # cfg for provenance (as the old cost-model block did) and the router's
+    # inputs/decision land in Booster.metadata["routing"].
+    has_cat = bool(mapper.is_categorical.any())
+    routing_info = None
+    if cfg.tree_learner == "auto":
+        choice, routing_info = _auto_route(cfg, mesh, binned, nfeat, n,
+                                           multiproc, has_cat)
+        cfg.tree_learner = choice
+    feature_shards = 1
+    if cfg.tree_learner == "feature" and mesh is not None:
+        from ..parallel.mesh import DATA_AXIS as _DAf
+
+        feature_shards = int(dict(mesh.shape).get(_DAf, 1))
+    grower_cfg = cfg.grower(has_categorical=has_cat,
+                            feature_shards=feature_shards)
     _wrap = np.asarray if multiproc else jnp.asarray
     is_cat = _wrap(mapper.is_categorical)
     nan_bins = _wrap(np.asarray(mapper.nan_bins, np.int32))
@@ -1289,32 +1416,6 @@ def train_booster(
     # tunnel, ~15ms per dispatch) the fused program is essential.
     # dart / custom fobj / callbacks / warm start keep the host loop.
     # ------------------------------------------------------------------
-    if cfg.tree_learner == "auto":
-        # collective cost model (voting.py): voting only when the mesh spans
-        # hosts AND the per-tree allreduce saving beats the selection pass.
-        # The model is consulted unconditionally so its verdict is never
-        # silently dead; when it prefers voting under multi-process training
-        # (which rides the fused path — no voting support yet) the fallback
-        # is EXPLICIT. The resolved value lands on cfg for provenance.
-        from .voting import recommend_tree_learner
-
-        choice = (recommend_tree_learner(
-            nfeat, cfg.max_bin, cfg.top_k, cfg.num_leaves,
-            n_hosts=jax.process_count(), rows_per_host=n,
-            dtype_bytes=(8 / 3 if cfg.hist_allreduce_dtype == "bf16"
-                         else 4))
-            if mesh is not None else "data")
-        if choice == "voting" and multiproc:
-            import warnings
-
-            warnings.warn(
-                "tree_learner='auto': the collective cost model prefers "
-                "voting-parallel at this shape (wide features, multi-host "
-                "fabric), but multi-process training does not support the "
-                "voting learner yet — falling back to data-parallel. Set "
-                "tree_learner='voting' on a single-process mesh to use it.")
-            choice = "data"
-        cfg.tree_learner = choice
     fused = (fobj is None and not callbacks and init_model is None
              and cfg.boosting_type in ("gbdt", "goss", "rf")
              and cfg.tree_learner != "voting")
@@ -1457,7 +1558,9 @@ def train_booster(
         trees = jax.device_get(trees)
         return Booster(mapper, cfg, trees, tree_weights, base, feature_names,
                        best_iteration=(best_iter if has_valid else -1),
-                       best_score=(best_metric if has_valid else None))
+                       best_score=(best_metric if has_valid else None),
+                       metadata=({"routing": routing_info}
+                                 if routing_info else None))
 
     # validation weights converted to device ONCE (per-iteration eval would
     # otherwise redo the H2D transfer every round)
@@ -1697,7 +1800,9 @@ def train_booster(
                    best_iteration=(n_init_trees // max(k, 1) + best_iter
                                    if has_valid else -1),
                    thresholds=merged_thr, missing_types=merged_mt,
-                   best_score=(best_metric if has_valid else None))
+                   best_score=(best_metric if has_valid else None),
+                   metadata=({"routing": routing_info}
+                             if routing_info else None))
 
 
 def _train_fingerprint(cfg, n, nfeat, y, n_init_trees) -> str:

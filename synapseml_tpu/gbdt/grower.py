@@ -41,6 +41,7 @@ identical on every device (uniform control flow by construction).
 
 from __future__ import annotations
 
+import math
 import os
 from functools import partial
 from typing import NamedTuple, Optional
@@ -108,15 +109,27 @@ class GrowerConfig(NamedTuple):
     # sort/permute work per split; which of the three wins is a measured
     # property of the chip (tools/perf_tune.py)
     row_layout: str = "partition"
-    # histogram allreduce wire precision: "f32" (default) or "bf16" — the
-    # quantized-collective idea (EQuARX, arXiv:2506.17615) applied where it
-    # is nearly free: grad/hess are ALREADY bf16-rounded before histogram
-    # accumulation (ops/hist_kernel.py contract), so shipping those two
-    # channels as bf16 cuts per-split collective bytes to 2/3 (counts stay
-    # exact f32 — they gate min_data_in_leaf) at one extra rounding of the
-    # grad/hess SUMS. Multi-host DCN is the payoff regime; off by default
-    # for bit-parity.
+    # histogram allreduce wire precision ladder: "f32" (default), "bf16"
+    # (2/3 wire bytes), or "int8" (blockwise-quantized allreduce — EQuARX,
+    # arXiv:2506.17615 — ~2 bytes/elem effective incl. per-block scales).
+    # grad/hess are ALREADY bf16-rounded before histogram accumulation
+    # (ops/hist_kernel.py contract), so the lossy rungs only round the
+    # SUMS once; the COUNT channel always rides an exact wire (it gates
+    # min_data_in_leaf). The int8 result is dequantized ONCE to f32, so
+    # the parent-minus-sibling histogram subtraction downstream never
+    # compounds quantization error. Multi-host DCN is the payoff regime;
+    # f32 by default for bit-parity.
     hist_allreduce_dtype: str = "f32"
+    # cross-shard histogram reduction shape: "allreduce" (every device gets
+    # the full (FP, B, 3) histogram — LightGBM data_parallel's logical
+    # result) or "scatter" (owned-feature reduce-scatter: each of
+    # ``feature_shards`` devices keeps only its FP/world slice and the
+    # per-leaf best splits are exchanged as tiny (world, 5) candidate
+    # rows — LightGBM data_parallel's ACTUAL wire pattern, ~halving
+    # collective bytes). "scatter" requires partition layout + leafwise
+    # growth + numeric-only features + FP % feature_shards == 0.
+    hist_reduce: str = "allreduce"
+    feature_shards: int = 1      # static world size for hist_reduce="scatter"
 
 
 class TreeArrays(NamedTuple):
@@ -182,7 +195,61 @@ def _maybe_psum(x, axis_name, wire_dtype: str = "f32"):
                       axis_name).astype(x.dtype)
         cnt = lax.psum(x[..., 2:], axis_name)
         return jnp.concatenate([gh, cnt], axis=-1)
+    if wire_dtype == "int8":
+        from ..parallel.collectives import allreduce_sum_quantized
+
+        # channel-major so quantization blocks never mix grad magnitudes
+        # with hess magnitudes (per-block max-abs scales stay tight)
+        gh = jnp.moveaxis(x[..., :2], -1, 0)
+        gh = allreduce_sum_quantized(gh, axis_name).astype(x.dtype)
+        gh = jnp.moveaxis(gh, 0, -1)
+        gh = _pin_totals(gh, lax.psum(x[..., :2].sum(axis=-2), axis_name))
+        cnt = lax.psum(x[..., 2:], axis_name)
+        return jnp.concatenate([gh, cnt], axis=-1)
     return lax.psum(x, axis_name)
+
+
+def _pin_totals(gh, tot):
+    """Pin each feature-channel row of a quantized-wire histogram to its
+    exactly-reduced total (a (..., FP, 2) f32 side wire, 1/B of the payload):
+    the residual is redistributed across bins proportional to |bin|, so empty
+    bins stay exactly zero and the leaf G/H totals the grower reads off the
+    histogram (leaf values, parent terms of every gain) carry no quantization
+    error — only WITHIN-leaf split placement sees the int8 grid."""
+    absg = jnp.abs(gh)
+    mass = absg.sum(axis=-2, keepdims=True)
+    err = (tot - gh.sum(axis=-2))[..., None, :]
+    return gh + err * absg / jnp.where(mass > 0, mass, 1.0)
+
+
+def _hist_reduce_scatter(x, axis_name, wire_dtype: str = "f32"):
+    """Owned-feature histogram reduction: (FP, B, 3) local partials →
+    fully-summed (FP/world, B, 3) slice owned by this device (reduce-scatter
+    over the leading feature axis — LightGBM data_parallel's actual wire
+    pattern, ~half the bytes of a full allreduce). The caller slices every
+    per-feature parameter at rank*FPo and exchanges tiny per-leaf best-split
+    candidates to keep split decisions uniform across devices."""
+    if axis_name is None:
+        return x
+    scatter = partial(lax.psum_scatter, axis_name=axis_name,
+                      scatter_dimension=0, tiled=True)
+    if wire_dtype == "bf16":
+        gh = scatter(x[..., :2].astype(jnp.bfloat16)).astype(x.dtype)
+    elif wire_dtype == "int8":
+        from ..parallel.collectives import reduce_scatter_sum_quantized
+
+        B = x.shape[1]
+        # (FP, 2, B): channel-major within each feature so quantization
+        # blocks never mix grad magnitudes with hess magnitudes
+        ghT = jnp.swapaxes(x[..., :2], 1, 2)
+        ghT = reduce_scatter_sum_quantized(ghT, axis_name,
+                                           block=math.gcd(256, B))
+        gh = jnp.swapaxes(ghT, 1, 2).astype(x.dtype)
+        gh = _pin_totals(gh, scatter(x[..., :2].sum(axis=1)))
+    else:
+        gh = scatter(x[..., :2])
+    cnt = scatter(x[..., 2:])    # counts stay on an exact wire
+    return jnp.concatenate([gh, cnt], axis=-1)
 
 
 def _aligned_window(start, size: int, np_rows: int, chunk: int):
@@ -651,6 +718,16 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
     L = cfg.num_leaves
     B = pad_bins(cfg.num_bins)
     FP = features_padded(f)
+    # owned-feature mode: each of W devices keeps only FP/W features of the
+    # reduced histogram; split decisions are re-unified by a tiny per-leaf
+    # candidate exchange (validated + gated in grow_tree/boosting)
+    scatter_mode = (cfg.hist_reduce == "scatter" and cfg.feature_shards > 1
+                    and axis_name is not None)
+    W = cfg.feature_shards if scatter_mode else 1
+    if scatter_mode and FP % W:
+        raise ValueError(f"hist_reduce='scatter' needs features_padded({f})="
+                         f"{FP} divisible by feature_shards={W}")
+    FPo = FP // W
     chunk = _chunk()     # resolved ONCE per trace: within-trace consistency
     Np = -(-n // chunk) * chunk
     bw = (B + BITS - 1) // BITS
@@ -704,25 +781,59 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
         hist = lax.switch(jnp.minimum(bidx, len(sizes) - 1),
                           [make_branch(s) for s in sizes],
                           (bT, gs, hs, ms, child_start, child_len))
+        if scatter_mode:
+            return _hist_reduce_scatter(hist, axis_name,
+                                        cfg.hist_allreduce_dtype)
         return _maybe_psum(hist, axis_name, cfg.hist_allreduce_dtype)
 
     nmask = _node_mask_fn(cfg, featp, f, node_key)
     catb = _pad_cat_nbins(cat_nbins, f, FP, B)
 
-    def best_of(hist_leaf, fmask):
-        return _best_for_leaf(hist_leaf, fmask, catp, monop, nanp, cfg, l1,
-                              l2, catb)
+    if scatter_mode:
+        off = lax.axis_index(axis_name).astype(jnp.int32) * FPo
+        slice_o = lambda a: lax.dynamic_slice_in_dim(a, off, FPo)
+        catp_o, monop_o = slice_o(catp), slice_o(monop)
+        nanp_o, catb_o = slice_o(nanp), slice_o(catb)
+
+        def best_of(hist_leaf, fmask):
+            # fmask arrives as the full (FP,) node mask; score only the
+            # owned slice — the exchange below restores the global argmax
+            return _best_for_leaf(hist_leaf, slice_o(fmask), catp_o, monop_o,
+                                  nanp_o, cfg, l1, l2, catb_o)
+
+        def exchange_best(g, f_loc, b, dl, cl):
+            """All-gather each shard's best owned candidate (5 floats per
+            leaf) and take the global winner — every device ends up with the
+            SAME (gain, global feature, bin, default_left, left_count), so
+            leaf selection and partitioning stay uniform across the mesh."""
+            vec = jnp.stack([g, (off + f_loc).astype(jnp.float32),
+                             b.astype(jnp.float32), dl.astype(jnp.float32),
+                             cl], axis=-1)                    # (..., 5)
+            allv = lax.all_gather(vec, axis_name)             # (W, ..., 5)
+            win = jnp.argmax(allv[..., 0], axis=0)            # low rank wins ties
+            bv = jnp.take_along_axis(
+                allv, win[None, ..., None], axis=0)[0]
+            return (bv[..., 0], bv[..., 1].astype(jnp.int32),
+                    bv[..., 2].astype(jnp.int32), bv[..., 3] > 0.5,
+                    bv[..., 4])
+    else:
+        def best_of(hist_leaf, fmask):
+            return _best_for_leaf(hist_leaf, fmask, catp, monop, nanp, cfg,
+                                  l1, l2, catb)
+
+        exchange_best = lambda *c: c
 
     # ---- root ------------------------------------------------------------
     hist_root = build_hist(bT0, gs0, hs0, ms0, jnp.int32(0), jnp.int32(Np))
-    rg, rf, rb, rdl, rcl, _ = best_of(hist_root, nmask(jnp.int32(2 * (L - 1))))
+    rg, rf, rb, rdl, rcl = exchange_best(
+        *best_of(hist_root, nmask(jnp.int32(2 * (L - 1))))[:5])
 
     init = _GrowState(
         pos=jnp.arange(Np, dtype=jnp.int32),
         gs=gs0, hs=hs0, ms=ms0, bT=bT0,
         leaf_start=jnp.zeros(L, jnp.int32),
         leaf_len=jnp.zeros(L, jnp.int32).at[0].set(Np),
-        **_init_split_state(L, B, bw, hist_root, rg, rf, rb, rdl, rcl, FP),
+        **_init_split_state(L, B, bw, hist_root, rg, rf, rb, rdl, rcl, FPo),
     )
 
     def partition(pos, gs, hs, ms, bT, start, length, fsel, bsel, dl, bitset,
@@ -791,6 +902,8 @@ def _grow_tree_impl(binned, grad, hess, in_bag, feature_active, is_categorical,
                                 nmask(i_node_id * 2 + 1)])
             bg2, bf2, bb2, bdl2, bcl2, _ = jax.vmap(best_of)(
                 jnp.stack([hist_left, hist_right]), masks2)
+            bg2, bf2, bb2, bdl2, bcl2 = exchange_best(bg2, bf2, bb2, bdl2,
+                                                      bcl2)
 
             new_right = s.num_splits + 1                # leaf id of right child
             return s._replace(
@@ -1118,6 +1231,20 @@ def grow_tree(
     n, f = binned.shape
     if nan_bins is None:
         nan_bins = jnp.full(f, 0x7FFF, jnp.int32)
+    if cfg.hist_reduce not in ("allreduce", "scatter"):
+        raise ValueError("hist_reduce must be 'allreduce' or 'scatter', "
+                         f"got {cfg.hist_reduce!r}")
+    if cfg.hist_reduce == "scatter" and cfg.feature_shards > 1:
+        if cfg.growth_policy != "leafwise" or cfg.row_layout != "partition":
+            raise ValueError(
+                "hist_reduce='scatter' (feature-parallel) supports only "
+                "leafwise growth with the partition row layout")
+        if cfg.has_categorical:
+            raise ValueError("hist_reduce='scatter' does not support "
+                             "categorical features (the winning split's "
+                             "bitset needs the owner's histogram slice)")
+        if axis_name is None:
+            raise ValueError("hist_reduce='scatter' requires a mesh axis")
     if cfg.growth_policy == "depthwise":
         from .grower_depthwise import _grow_tree_impl_depthwise
 

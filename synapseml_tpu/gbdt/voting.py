@@ -17,7 +17,7 @@ remapped to the full feature space afterwards.
 
 from __future__ import annotations
 
-from functools import partial
+import math
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +26,15 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.compat import shard_map
 from ..parallel.mesh import DATA_AXIS
+
+# per-shard row budget for the root-selection pass: the PV-Tree vote is a
+# rank statistic over 2k-of-F features, robust under row subsampling, and an
+# unsampled selection pass at large shards costs a visible fraction of the
+# tree it elects features for (r05: 11 s/tree eager+unsampled). Strided
+# sampling (not a prefix — label-sorted inputs stay representative) with
+# contributions scaled back by the stride keeps G/H/count magnitudes
+# unbiased for the min_data validity filter.
+DEFAULT_SELECTION_SAMPLE_ROWS = 4096
 
 
 def _per_feature_root_gain(binned, g, h, in_bag, num_bins: int,
@@ -51,24 +60,30 @@ def _per_feature_root_gain(binned, g, h, in_bag, num_bins: int,
     return jnp.max(jnp.where(valid, gain, -jnp.inf), axis=1)  # (F,)
 
 
-def voting_select(binned, g, h, in_bag, mesh, top_k: int, num_bins: int,
-                  lambda_l2: float = 0.0, min_data: int = 1,
-                  feature_active=None) -> np.ndarray:
-    """Global top-2k feature indices by per-shard votes (gain-sum tie-break).
-    Returns a sorted int array of 2k (or fewer) feature indices, replicated.
-    ``feature_active`` (F,) bool restricts voting to the feature_fraction
-    sample so selection never wastes slots on masked-out features."""
-    f = binned.shape[1]
-    k = min(top_k, f)
-    out_k = min(2 * k, f)
-    active = (jnp.ones((f,), bool) if feature_active is None
-              else jnp.asarray(feature_active))
+#: compiled selection programs keyed by (mesh, shapes, knobs) — the r05 A/B
+#: measured the EAGER per-call shard_map rebuild at ~11 s/tree; the cached
+#: jit brings steady-state selection to one device dispatch per tree.
+_SELECT_CACHE: dict = {}
+_SELECT_CACHE_MAX = 16
 
-    @partial(shard_map, mesh=mesh,
-             in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                       P(DATA_AXIS), P()),
-             out_specs=P(), check_vma=False)
+
+def _select_fn(mesh, n: int, f: int, k: int, out_k: int, num_bins: int,
+               lambda_l2: float, min_data: int, stride: int):
+    key = (mesh, n, f, k, out_k, num_bins, float(lambda_l2), int(min_data),
+           stride)
+    fn = _SELECT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
     def _select(b_shard, g_shard, h_shard, bag_shard, act):
+        if stride > 1:
+            # strided per-shard subsample (static shapes, no collectives);
+            # scaling contributions by the stride keeps G/H/counts unbiased
+            b_shard, g_shard = b_shard[::stride], g_shard[::stride]
+            h_shard, bag_shard = h_shard[::stride], bag_shard[::stride]
+            g_shard = g_shard * float(stride)
+            h_shard = h_shard * float(stride)
+            bag_shard = bag_shard * float(stride)
         local_gain = _per_feature_root_gain(b_shard, g_shard, h_shard,
                                             bag_shard, num_bins, lambda_l2,
                                             min_data)
@@ -86,7 +101,68 @@ def voting_select(binned, g, h, in_bag, mesh, top_k: int, num_bins: int,
         _, sel = jax.lax.top_k(score, out_k)
         return jnp.sort(sel)
 
-    return np.asarray(_select(binned, g, h, in_bag, active))
+    fn = jax.jit(shard_map(
+        _select, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P()),
+        out_specs=P(), check_vma=False))
+    if len(_SELECT_CACHE) >= _SELECT_CACHE_MAX:
+        _SELECT_CACHE.pop(next(iter(_SELECT_CACHE)))
+    _SELECT_CACHE[key] = fn
+    return fn
+
+
+def _selection_stride(n: int, mesh, sample_rows) -> int:
+    """Static per-shard subsample stride for the selection pass."""
+    if sample_rows is None:
+        sample_rows = DEFAULT_SELECTION_SAMPLE_ROWS
+    if sample_rows <= 0:
+        return 1
+    shard_rows = max(n // int(dict(mesh.shape).get(DATA_AXIS, 1)), 1)
+    return max(-(-shard_rows // int(sample_rows)), 1)
+
+
+def voting_select(binned, g, h, in_bag, mesh, top_k: int, num_bins: int,
+                  lambda_l2: float = 0.0, min_data: int = 1,
+                  feature_active=None, sample_rows=None) -> np.ndarray:
+    """Global top-2k feature indices by per-shard votes (gain-sum tie-break).
+    Returns a sorted int array of 2k (or fewer) feature indices, replicated.
+    ``feature_active`` (F,) bool restricts voting to the feature_fraction
+    sample so selection never wastes slots on masked-out features.
+    ``sample_rows`` caps the per-shard rows the vote scans (default
+    DEFAULT_SELECTION_SAMPLE_ROWS; <=0 disables sampling)."""
+    n, f = binned.shape
+    k = min(top_k, f)
+    out_k = min(2 * k, f)
+    active = (jnp.ones((f,), bool) if feature_active is None
+              else jnp.asarray(feature_active))
+    stride = _selection_stride(n, mesh, sample_rows)
+    fn = _select_fn(mesh, n, f, k, out_k, num_bins, lambda_l2, min_data,
+                    stride)
+    return np.asarray(fn(binned, g, h, in_bag, active))
+
+
+def time_selection(binned, mesh, top_k: int, num_bins: int,
+                   lambda_l2: float = 0.0, min_data: int = 1,
+                   sample_rows=None) -> tuple:
+    """Measured (seconds_per_selection, fraction_of_shard_rows_scanned) of
+    the jitted selection pass on this dataset — synthetic unit gradients,
+    compile excluded (the compiled program lands in _SELECT_CACHE, so the
+    training loop reuses it). Feeds ``route_parallelism``'s measured
+    ``selection_s_per_tree``."""
+    import time
+
+    n, _ = binned.shape
+    ones = jnp.ones((n,), jnp.float32)
+    jax.block_until_ready(
+        voting_select(binned, ones, ones, ones, mesh, top_k, num_bins,
+                      lambda_l2, min_data, sample_rows=sample_rows))
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        voting_select(binned, ones, ones, ones, mesh, top_k, num_bins,
+                      lambda_l2, min_data, sample_rows=sample_rows))
+    dt = time.perf_counter() - t0
+    return dt, 1.0 / _selection_stride(n, mesh, sample_rows)
 
 
 def remap_tree_features(tree, sel_idx: np.ndarray):
@@ -122,11 +198,50 @@ DEFAULT_LINK_BYTES_PER_S = {"ici": 1.0e11, "dcn": 1.25e10}
 # voting); bench_voting_ab records the measured per-tree overhead alongside
 # the model so the estimate is auditable against data.
 DEFAULT_SELECTION_FRACTION = 0.3
-# measured on-chip engine throughput anchor (row-iters/sec/chip, the
-# primary bench capture in docs/measurements.json) — converts rows into
-# seconds for the selection-cost estimate. Conservative: a faster engine
-# shrinks selection cost and favors voting.
+# fallback engine throughput anchor (row-iters/sec/chip) when
+# docs/measurements.json is unreadable — the BENCH_r03 capture. Conservative:
+# a faster engine shrinks selection cost and favors voting.
 DEFAULT_ENGINE_ROW_ITERS_PER_S = 1.69e6
+
+#: effective wire bytes per histogram element for each
+#: BoosterConfig.hist_allreduce_dtype rung: bf16 ships grad/hess at 2 bytes
+#: with counts still f32 (→ 8/3 average); int8 is the blockwise-quantized
+#: allreduce (int16 grid values on the wire + f32 scales per 256-block
+#: ≈ 2 bytes effective, with counts exact — parallel/collectives.py).
+WIRE_DTYPE_BYTES = {"f32": 4.0, "bf16": 8.0 / 3.0, "int8": 2.0}
+
+#: fraction of a full-width histogram pass spent scanning (feature, bin)
+#: cells for split gains rather than building bins from rows. Scatter-mode
+#: feature-parallel scans only its owned 1/W of the features, so its
+#: per-pass compute shrinks by ``scan_fraction * (1 - 1/W)``. Calibrated on
+#: the 8-device CPU-mesh bench (bench_distributed_gbdt_auto): wide, narrow
+#: and tall shapes all measure feature-parallel at 0.90-0.93x data-parallel
+#: seconds/tree, which a pure wire model cannot explain on a host-local
+#: mesh where collective bytes are ~free.
+FEATURE_SCAN_FRACTION = 0.10
+
+
+def default_engine_row_iters_per_s() -> float:
+    """Engine throughput anchor for the selection-cost estimate: the live
+    measured ``gbdt_train_row_iters_per_sec_per_chip`` record in
+    docs/measurements.json when readable (the cost model then tracks the
+    engine as it gets faster), else DEFAULT_ENGINE_ROW_ITERS_PER_S."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "docs",
+        "measurements.json")
+    try:
+        with open(path) as fh:
+            records = json.load(fh)
+        for rec in records:
+            if rec.get("metric") == "gbdt_train_row_iters_per_sec_per_chip":
+                v = float(rec["value"])
+                return v if v > 0 else DEFAULT_ENGINE_ROW_ITERS_PER_S
+    except (OSError, ValueError, TypeError, KeyError, AttributeError):
+        pass
+    return DEFAULT_ENGINE_ROW_ITERS_PER_S
 
 
 def collective_bytes_per_split(num_features: int, max_bin: int,
@@ -184,8 +299,7 @@ def recommend_tree_learner(num_features: int, max_bin: int, top_k: int,
                            num_leaves: int, n_hosts: int,
                            rows_per_host: int = None,
                            link_bytes_per_s: float = None,
-                           engine_row_iters_per_s: float =
-                           DEFAULT_ENGINE_ROW_ITERS_PER_S,
+                           engine_row_iters_per_s: float = None,
                            selection_fraction: float =
                            DEFAULT_SELECTION_FRACTION,
                            selection_s_per_tree: float = None,
@@ -211,6 +325,8 @@ def recommend_tree_learner(num_features: int, max_bin: int, top_k: int,
         return "data"
     if link_bytes_per_s is None:
         link_bytes_per_s = DEFAULT_LINK_BYTES_PER_S["dcn"]
+    if engine_row_iters_per_s is None:
+        engine_row_iters_per_s = default_engine_row_iters_per_s()
     if selection_s_per_tree is None:
         if rows_per_host is None:
             rows_per_host = 1_000_000        # HIGGS-class shard, conservative
@@ -220,3 +336,112 @@ def recommend_tree_learner(num_features: int, max_bin: int, top_k: int,
                           selection_s_per_tree, dtype_bytes=dtype_bytes)
     saved_wire_s = m["bytes_saved_per_tree"] / link_bytes_per_s
     return "voting" if saved_wire_s > selection_s_per_tree else "data"
+
+
+def route_parallelism(num_features: int, max_bin: int, top_k: int,
+                      num_leaves: int, *, n_workers: int,
+                      rows_per_worker: int, link_bytes_per_s: float,
+                      selection_s_per_tree: float = None,
+                      selection_fraction_of_rows: float = 1.0,
+                      wire_dtype: str = "f32",
+                      feature_parallel_ok: bool = False,
+                      hist_passes_per_tree: float = None,
+                      scan_fraction_of_pass: float = None,
+                      engine_row_iters_per_s: float = None) -> tuple:
+    """Measured-input router across the three distributed learners. Unlike
+    :func:`recommend_tree_learner` (the byte-only rule it generalizes — kept
+    for its documented behavior), this prices per-tree COMPUTE as well as
+    wire time, anchored on a measured selection pass, so it can prefer
+    voting even on a host-local mesh where wire bytes are ~free but the
+    in-loop histogram width still dominates.
+
+    Returns ``(choice, info)`` where info records every model input, the
+    per-mode predicted s/tree, and the byte accounting — audited into
+    ``Booster.metadata["routing"]`` by ``train_booster``.
+
+    Terms, per tree (``splits = num_leaves - 1``):
+
+    * wire: ``voting_cost_model`` bytes at the configured wire dtype
+      (``WIRE_DTYPE_BYTES`` — int8 halves data-parallel bytes, shifting the
+      voting crossover ~2x) divided by the measured link bandwidth.
+      Feature-parallel moves ~half the allreduce bytes (reduce-scatter
+      only) plus a tiny per-split (n_workers, 5)-float candidate exchange.
+    * compute: one full-width root pass costs
+      ``selection_s_per_tree / selection_fraction_of_rows`` (the probe may
+      subsample rows); smaller-child subtraction makes a tree cost about
+      ``1 + log2(L)/2`` such passes. Voting's in-loop passes run at the
+      elected ``2k``-of-``F`` width (padded, as the kernel sees it); its
+      selection pass is a flat per-tree add. Feature-parallel builds
+      full-width histograms but split-scans only its owned ``1/W`` of the
+      features, so its pass shrinks by the scan share of a pass
+      (``FEATURE_SCAN_FRACTION``, calibrated on the CPU-mesh bench).
+
+    A 5% hysteresis favors data-parallel: the probe's error bars must not
+    route a marginal predicted win onto a slower mode (the bench guard
+    asserts auto stays within 5% of the best manual flag, so a choice the
+    hysteresis keeps on data is within guard tolerance by construction
+    whenever the model is right to within its own margin).
+    """
+    from .grower import features_padded
+
+    db = WIRE_DTYPE_BYTES.get(wire_dtype, 4.0)
+    splits = max(int(num_leaves) - 1, 1)
+    if hist_passes_per_tree is None:
+        hist_passes_per_tree = 1.0 + 0.5 * math.log2(max(num_leaves, 2))
+    if selection_s_per_tree is None or selection_s_per_tree <= 0:
+        if engine_row_iters_per_s is None:
+            engine_row_iters_per_s = default_engine_row_iters_per_s()
+        selection_s_per_tree = (DEFAULT_SELECTION_FRACTION * rows_per_worker
+                                / engine_row_iters_per_s)
+        selection_fraction_of_rows = DEFAULT_SELECTION_FRACTION
+    t_root_full = selection_s_per_tree / max(selection_fraction_of_rows,
+                                             1e-9)
+    t_hist_full = hist_passes_per_tree * t_root_full
+    m = voting_cost_model(num_features, max_bin, top_k, num_leaves,
+                          selection_s_per_tree, dtype_bytes=db)
+
+    def wire(nbytes):
+        return nbytes / max(link_bytes_per_s, 1.0)
+
+    fp_ratio = (features_padded(min(2 * top_k, num_features))
+                / max(features_padded(num_features), 1))
+    if scan_fraction_of_pass is None:
+        scan_fraction_of_pass = FEATURE_SCAN_FRACTION
+    scatter_compute = 1.0 - scan_fraction_of_pass * (1.0
+                                                     - 1.0 / max(n_workers, 1))
+    exchange_bytes = splits * n_workers * 5 * 4
+    predicted = {
+        "data": t_hist_full + wire(m["bytes_per_tree_data_parallel"]),
+        "voting": (selection_s_per_tree + t_hist_full * fp_ratio
+                   + wire(m["bytes_per_tree_voting"])),
+        "feature": (t_hist_full * scatter_compute
+                    + wire(0.5 * m["bytes_per_tree_data_parallel"]
+                           + exchange_bytes)),
+    }
+    candidates = {"data": predicted["data"]}
+    if num_features > 2 * top_k and n_workers > 1:
+        candidates["voting"] = predicted["voting"]
+    if feature_parallel_ok and n_workers > 1:
+        candidates["feature"] = predicted["feature"]
+    choice = min(candidates, key=candidates.get)
+    if choice != "data" and candidates[choice] > 0.95 * candidates["data"]:
+        choice = "data"
+    info = {
+        "tree_learner": choice,
+        "predicted_s_per_tree": predicted,
+        "considered": sorted(candidates),
+        "inputs": {
+            "num_features": int(num_features), "max_bin": int(max_bin),
+            "top_k": int(top_k), "num_leaves": int(num_leaves),
+            "n_workers": int(n_workers),
+            "rows_per_worker": int(rows_per_worker),
+            "link_bytes_per_s": float(link_bytes_per_s),
+            "selection_s_per_tree": float(selection_s_per_tree),
+            "selection_fraction_of_rows": float(selection_fraction_of_rows),
+            "wire_dtype": wire_dtype, "wire_dtype_bytes": db,
+            "hist_passes_per_tree": float(hist_passes_per_tree),
+            "scan_fraction_of_pass": float(scan_fraction_of_pass),
+        },
+        "cost_model": m,
+    }
+    return choice, info

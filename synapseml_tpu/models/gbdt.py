@@ -227,6 +227,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol,
             min_data_per_group=self.getMinDataPerGroup(),
             xgboost_dart_mode=self.getXGBoostDartMode(),
             tree_learner=("voting" if self.getParallelism() == "voting_parallel"
+                          else "feature" if self.getParallelism() == "feature_parallel"
                           else "auto" if self.getParallelism() == "auto"
                           else "data"),
             top_k=self.getTopK(),

@@ -24,5 +24,7 @@ from .collectives import (  # noqa: F401
     ppermute_ring,
     axis_rank,
     shard_apply,
-    topk_vote,
+    allreduce_sum_quantized,
+    reduce_scatter_sum_quantized,
+    probe_link_bandwidth,
 )

@@ -9,6 +9,7 @@ axis names from :mod:`synapseml_tpu.parallel.mesh`.
 
 from __future__ import annotations
 
+import math
 from typing import Callable
 
 import jax
@@ -57,10 +58,16 @@ def allgather(x, axis: str = DATA_AXIS, tiled: bool = False):
     return jax.lax.all_gather(x, axis_name=axis, tiled=tiled)
 
 
+def _axis_size(axis: str) -> int:
+    """Static mesh-axis size inside shard_map (jax 0.4.x has no
+    ``lax.axis_size``; a unit psum folds to a Python int at trace time)."""
+    return jax.lax.psum(1, axis_name=axis)
+
+
 def ppermute_ring(x, axis: str = DATA_AXIS, shift: int = 1):
     """Ring permute — building block for ring attention / pipelined collectives."""
     _chaos("ppermute_ring")
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name=axis, perm=perm)
 
@@ -75,18 +82,125 @@ def shard_apply(mesh: Mesh, fn: Callable, in_specs, out_specs, check_vma: bool =
                       check_vma=check_vma)
 
 
-def topk_vote(local_gains: jnp.ndarray, k: int, axis: str = DATA_AXIS):
-    """Voting-parallel support (LightGBM `voting_parallel`, SURVEY §2.2):
-    each worker proposes its local top-k features by split gain; global vote
-    counts elect 2k candidate features, and only those features' histogram
-    bins are then exchanged — cutting collective volume on wide datasets.
+# ---------------------------------------------------------------------------
+# Blockwise-quantized collectives (EQuARX structure, PAPERS.md
+# arXiv:2506.17615), quantize-ONCE formulation: one cheap ``pmax`` agrees a
+# per-`block` max-abs scale across the axis, every device snaps its local
+# contribution to that SHARED int8 grid exactly once, and the reduction then
+# runs as a plain integer psum/psum_scatter in int16 — int8 grid values sum
+# exactly (8 * 127 << 32767), so there is no per-hop requantization and the
+# total error is bounded by n * scale/2 regardless of topology. The wire
+# moves 2 bytes/element (+ one f32 scale per block), which is exactly the
+# dtype_bytes=2.0 the router's cost model prices for the int8 ladder rung;
+# XLA lowers the integer all-reduce onto the same ring/tree schedules as a
+# float one, so nothing here hand-rolls a ring and host-local meshes pay
+# only the (fusible) quantize/dequantize elementwise work.
+# ---------------------------------------------------------------------------
 
-    Returns (global_topk_feature_ids, vote_counts). local_gains: [num_features].
+
+def _shared_scale_quantize(blocks, axis: str, bits: int, acc_dtype):
+    """(nblocks, block) f32 -> (integer grid values, f32 per-block scales).
+
+    ``pmax`` makes the symmetric per-block scale identical on every device,
+    so each device's snap error is <= scale/2 and the integer sums below are
+    exact in ``acc_dtype``."""
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jax.lax.pmax(jnp.max(jnp.abs(blocks), axis=-1),
+                         axis_name=axis) / qmax
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(blocks / safe[..., None]),
+                 -qmax, qmax).astype(acc_dtype)
+    return q, safe
+
+
+def _acc_dtype(n: int, bits: int):
+    # exact integer sums need log2(n) headroom above the grid; `n` is the
+    # shard_map-folded static axis size, so this resolves at trace time
+    qmax = 2 ** (bits - 1) - 1
+    return jnp.int16 if n * qmax <= 32767 else jnp.int32  # lint-ok: trace-safety
+
+
+def reduce_scatter_sum_quantized(x, axis: str = DATA_AXIS, *, bits: int = 8,
+                                 block: int = 256):
+    """Quantized reduce-scatter: device ``r`` ends up owning the
+    fully-summed chunk ``r`` of ``x``'s leading axis (which must divide the
+    axis size). Every device snaps its chunks to the shared int8 grid once;
+    ``psum_scatter`` then moves 2-byte integer partials whose sum is exact,
+    and only the owner dequantizes — total error <= n * scale/2.
     """
-    num_features = local_gains.shape[0]
-    k = min(k, num_features)
-    _, local_top = jax.lax.top_k(local_gains, k)
-    votes = jnp.zeros((num_features,), jnp.int32).at[local_top].add(1)
-    votes = jax.lax.psum(votes, axis_name=axis)
-    _, global_top = jax.lax.top_k(votes.astype(jnp.float32), min(2 * k, num_features))
-    return global_top, votes
+    _chaos("reduce_scatter_sum_quantized")
+    n = _axis_size(axis)
+    if n == 1:                      # lint-ok: trace-safety
+        return x.astype(jnp.float32)
+    m = x.shape[0]
+    if m % n:                       # lint-ok: trace-safety
+        raise ValueError(f"leading axis {m} must divide axis size {n}")
+    chunk = m // n
+    if math.prod(x.shape[1:], start=chunk) % block:  # lint-ok: trace-safety
+        raise ValueError(f"chunk elements must divide block={block}")
+    blocks = x.astype(jnp.float32).reshape(n, -1, block)   # (n, nbc, block)
+    q, safe = _shared_scale_quantize(blocks, axis, bits, _acc_dtype(n, bits))
+    s = jax.lax.psum_scatter(q, axis_name=axis, scatter_dimension=0)
+    r = jax.lax.axis_index(axis)
+    out = s.astype(jnp.float32) * safe[r][:, None]
+    return out.reshape(chunk, *x.shape[1:])
+
+
+def allreduce_sum_quantized(x, axis: str = DATA_AXIS, *, bits: int = 8,
+                            block: int = 256):
+    """Blockwise-quantized allreduce: snap to the shared int8 grid once,
+    ``psum`` the int16 grid values (exact), dequantize with the shared
+    scales. The integer psum result is identical on every device, so the
+    f32 output is bit-identical across the axis (collectives downstream
+    stay uniform) and the only loss is each device's one-time snap:
+    |error| <= n * scale/2. Effective wire cost ~2 bytes/element (+ f32
+    scales at ``block`` granularity) vs 4 for f32 — the dtype_bytes=2
+    pricing in ``gbdt.voting.collective_bytes_per_split``.
+    """
+    _chaos("allreduce_sum_quantized")
+    n = _axis_size(axis)
+    if n == 1:                      # lint-ok: trace-safety
+        return x.astype(jnp.float32)
+    shape = x.shape
+    flat = x.astype(jnp.float32).reshape(-1)
+    m = flat.shape[0]
+    mp = -(-m // block) * block
+    flat = jnp.pad(flat, (0, mp - m))
+    blocks = flat.reshape(-1, block)
+    q, safe = _shared_scale_quantize(blocks, axis, bits, _acc_dtype(n, bits))
+    s = jax.lax.psum(q, axis_name=axis)
+    out = (s.astype(jnp.float32) * safe[:, None]).reshape(-1)
+    return out[:m].reshape(shape)
+
+
+def probe_link_bandwidth(mesh: Mesh, axis: str = DATA_AXIS,
+                         size_bytes: int = 1 << 20, repeats: int = 3) -> float:
+    """Measured allreduce bus bandwidth (bytes/s) over ``axis`` of ``mesh``
+    from one cheap timed f32 psum (~``size_bytes`` payload). Used by the
+    distributed-GBDT router; cache the result via
+    ``core.tuned.measured_or`` — this compiles a tiny program per call.
+    """
+    import time
+
+    from jax.sharding import PartitionSpec as P
+
+    n = int(mesh.shape[axis])
+    if n <= 1:
+        return float("inf")
+    words = max(size_bytes // 4 // n * n, n)
+
+    def _body(v):
+        return jax.lax.psum(v, axis_name=axis) / n
+
+    _probe = jax.jit(_shard_map(_body, mesh=mesh, in_specs=P(axis),
+                                out_specs=P(axis), check_vma=False))
+    x = jnp.ones((words,), jnp.float32)
+    _probe(x).block_until_ready()          # compile + warm
+    best = float("inf")
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        _probe(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    # ring algbw convention: an allreduce moves 2*(n-1)/n bytes per payload
+    # byte over the slowest link
+    return 2.0 * (n - 1) / n * (words * 4) / max(best, 1e-9)

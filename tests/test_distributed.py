@@ -10,7 +10,9 @@ import numpy as np
 import pytest
 
 from synapseml_tpu.parallel import (DATA_AXIS, allreduce_mean, allreduce_sum,
-                                    make_mesh, shard_apply, shard_rows, topk_vote)
+                                    allreduce_sum_quantized, make_mesh,
+                                    reduce_scatter_sum_quantized, shard_apply,
+                                    shard_rows)
 from synapseml_tpu.ops.histogram import leaf_histograms, sharded_histogram_fn
 
 
@@ -55,20 +57,50 @@ def test_collectives_inside_shard_map(eight_devices):
     assert float(out[1]) == 3.5
 
 
-def test_topk_vote(eight_devices):
+def test_allreduce_sum_quantized_matches_psum(eight_devices):
+    """The int8 wire must reproduce an exact psum to per-block quantization
+    tolerance, and every device must see bit-identical dequantized bytes."""
     mesh = make_mesh(devices=eight_devices)
-    # every worker's best feature is 3 → global vote elects it
-    gains = np.tile(np.array([[0.1, 0.2, 0.0, 5.0, 1.0, 0.3, 0.0, 0.0]], np.float32), (8, 1))
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(8, 13, 37)).astype(np.float32) * 10.0
 
     from jax.sharding import PartitionSpec as P
 
-    def body(g):
-        top, votes = topk_vote(g[0], k=2)
-        return top, votes
+    def body(xs):
+        return allreduce_sum_quantized(xs[0], block=64), \
+            allreduce_sum(xs[0])
 
-    top, votes = shard_apply(mesh, body, in_specs=P(DATA_AXIS), out_specs=P(None))(gains)
-    assert 3 in np.asarray(top)[:2]
-    assert int(np.asarray(votes)[3]) == 8
+    approx, exact = shard_apply(mesh, body, in_specs=P(DATA_AXIS),
+                                out_specs=(P(None), P(None)))(x)
+    approx, exact = np.asarray(approx), np.asarray(exact)
+    # quantize-once wire: the integer psum is exact, so the only loss is
+    # each device's one snap to the shared int8 grid (<= scale/2 =
+    # maxabs/254 per device) -> total <= n * maxabs / 254
+    tol = np.abs(x).max() * 8 / 254.0
+    np.testing.assert_allclose(approx, exact, atol=tol)
+    assert np.abs(approx - exact).max() > 0          # it really quantized
+    xi = rng.integers(-50, 50, size=(8, 16, 16)).astype(np.float32)
+    approx, exact = shard_apply(mesh, body, in_specs=P(DATA_AXIS),
+                                out_specs=(P(None), P(None)))(xi)
+    tol = np.abs(xi).max() * 8 / 254.0
+    np.testing.assert_allclose(np.asarray(approx), np.asarray(exact), atol=tol)
+
+
+def test_reduce_scatter_sum_quantized_owns_chunks(eight_devices):
+    mesh = make_mesh(devices=eight_devices)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, 16, 64)).astype(np.float32)
+
+    from jax.sharding import PartitionSpec as P
+
+    def body(xs):
+        return reduce_scatter_sum_quantized(xs[0], block=128)
+
+    out = shard_apply(mesh, body, in_specs=P(DATA_AXIS),
+                      out_specs=P(DATA_AXIS))(x)
+    want = x.sum(axis=0)       # concatenated owned chunks == full sum
+    tol = np.abs(x).max() * 8 / 254.0
+    np.testing.assert_allclose(np.asarray(out), want, atol=tol)
 
 
 @pytest.mark.parametrize("layout", ["partition", "gather", "masked"])

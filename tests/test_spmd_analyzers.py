@@ -140,6 +140,82 @@ def test_collectives_accepts_in_scope_axis(tmp_path):
     assert collectives.run(ctx) == []
 
 
+_QUANT = """\
+    def allreduce_sum_quantized(x, axis, *, bits=8, block=256):
+        return x
+
+    def reduce_scatter_sum_quantized(x, axis, *, bits=8, block=256):
+        return x
+    """
+
+
+def test_collectives_flags_quantized_wrapper_out_of_scope(tmp_path):
+    """The repo's int8 wire ops are first-class performers: an axis name
+    the surrounding shard_map never binds is flagged even through the
+    ``axis=`` keyword (jax spells it ``axis_name=``)."""
+    ctx = _ctx(tmp_path, {
+        "synapseml_tpu/compat.py": _COMPAT,
+        "synapseml_tpu/qcoll.py": _QUANT,
+        "synapseml_tpu/mod.py": """\
+        import jax
+        from synapseml_tpu.compat import shard_map
+        from synapseml_tpu.qcoll import allreduce_sum_quantized
+        from jax.sharding import PartitionSpec as P
+
+        mesh = jax.make_mesh((4,), ("data",))
+
+        def _inner(x):
+            return allreduce_sum_quantized(x, axis="model")
+
+        f = shard_map(_inner, mesh=mesh, in_specs=(P("data"),),
+                      out_specs=P("data"))
+        """})
+    found = collectives.run(ctx)
+    assert any("allreduce_sum_quantized" in f.message
+               and "'model'" in f.message and "not bound" in f.message
+               for f in found)
+
+
+def test_collectives_accepts_quantized_wrapper_in_scope(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "synapseml_tpu/compat.py": _COMPAT,
+        "synapseml_tpu/qcoll.py": _QUANT,
+        "synapseml_tpu/mod.py": """\
+        import jax
+        from synapseml_tpu.compat import shard_map
+        from synapseml_tpu.qcoll import reduce_scatter_sum_quantized
+        from jax.sharding import PartitionSpec as P
+
+        mesh = jax.make_mesh((4,), ("data",))
+
+        def _inner(x):
+            return reduce_scatter_sum_quantized(x, "data", bits=8)
+
+        f = shard_map(_inner, mesh=mesh, in_specs=(P("data"),),
+                      out_specs=P("data"))
+        """})
+    assert collectives.run(ctx) == []
+
+
+def test_collectives_flags_quantized_wrapper_divergent_branch(tmp_path):
+    """C2 sees the wrappers too: the int8 allreduce under a
+    ``process_index()`` branch is the same static deadlock as a psum."""
+    ctx = _ctx(tmp_path, {
+        "synapseml_tpu/qcoll.py": _QUANT,
+        "synapseml_tpu/mod.py": """\
+        import jax
+        from synapseml_tpu.qcoll import allreduce_sum_quantized
+
+        def step(x):
+            if jax.process_index() == 0:
+                x = allreduce_sum_quantized(x, "data")
+            return x
+        """})
+    found = collectives.run(ctx)
+    assert any("allreduce_sum_quantized" in f.message
+               and "deadlock" in f.message for f in found)
+
+
 def test_collectives_flags_divergent_branch_deadlock(tmp_path):
     """The seeded deadlock: only process 0 reaches the sync point."""
     ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\

@@ -48,7 +48,17 @@ _AXIS_OPS = {
     ".psum": 1, ".pmean": 1, ".pmax": 1, ".pmin": 1, ".psum_scatter": 1,
     ".all_gather": 1, ".ppermute": 1, ".pshuffle": 1, ".all_to_all": 1,
     ".axis_index": 0, ".axis_size": 0,
+    # repo-level quantized collectives (parallel/collectives.py, the int8
+    # histogram wire): registered as first-class performers so C1-C3 see
+    # through them even at call sites the transitive-call resolver cannot
+    # link (aliased/re-exported imports); their mesh-axis keyword is `axis`
+    ".allreduce_sum_quantized": 1, ".reduce_scatter_sum_quantized": 1,
 }
+
+#: repo wrappers above whose keyword form is ``axis=`` (jax's own collectives
+#: use ``axis_name=``; for ``all_gather``-style ops ``axis=`` is the ARRAY
+#: axis, so the keyword remap is scoped to exactly these ops)
+_REPO_AXIS_KW = ("allreduce_sum_quantized", "reduce_scatter_sum_quantized")
 
 #: axis-free cross-process synchronization points (C2/C3 only)
 _SYNC_SUFFIX = (".process_allgather", ".broadcast_one_to_all",
@@ -87,8 +97,9 @@ def _is_sync(canon: Optional[str]) -> bool:
 
 
 def _axis_arg(call: ast.Call, op: str) -> Optional[ast.AST]:
+    axis_kw = "axis" if op in _REPO_AXIS_KW else "axis_name"
     for kw in call.keywords:
-        if kw.arg == "axis_name":
+        if kw.arg == axis_kw:
             return kw.value
     idx = _AXIS_OPS["." + op]
     return call.args[idx] if idx < len(call.args) else None

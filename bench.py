@@ -634,6 +634,7 @@ _HOST_SIDE_METRICS = frozenset({"serving_latency_p50_ms",
                                 "serving_requests_per_sec",
                                 "serving_resnet50_latency_p50_ms",
                                 "serving_distributed_latency_p50_ms",
+                                "serving_fabric_reqs_per_sec",
                                 "gbdt_voting_vs_data_parallel_speedup",
                                 "gbdt_distributed_auto_vs_manual"})
 
@@ -866,6 +867,73 @@ def bench_serving_distributed(n_requests=200):
         gw.stop()
         for s in workers:
             s.stop()
+
+
+def bench_fabric_scaling(n_threads=8, per_thread=40):
+    """Aggregate fabric throughput vs worker count (1/2/4): the same served
+    GBDT forest replicated behind the gateway, concurrent keep-alive
+    clients, aggregate req/s per replica count — the number the membership
+    layer's autoscaling hook trades on (ISSUE: fabric tentpole). One
+    process, so the curve prices gateway routing overhead honestly rather
+    than claiming linear multi-host speedup."""
+    import http.client as hc
+    import threading
+
+    from synapseml_tpu.io import ServingGateway, ServingServer
+
+    handler = _gbdt_serving_handler()     # trained once, replicated
+    payload = _SERVING_PAYLOAD
+    rates = {}
+    for n_workers in (1, 2, 4):
+        workers = [ServingServer(handler, host="127.0.0.1", port=0,
+                                 max_batch_size=32,
+                                 max_batch_latency=0.0).start()
+                   for _ in range(n_workers)]
+        gw = ServingGateway([s.url for s in workers], port=0,
+                            mode="least_loaded", local_worker=workers[0],
+                            local_index=0).start()
+        try:
+            _measure_latency(gw.port, gw.api_path, 5, warmup=15)  # warm conns
+            ok_counts = [0] * n_threads
+
+            def client(slot):
+                c = hc.HTTPConnection("127.0.0.1", gw.port, timeout=10)
+                try:
+                    for _ in range(per_thread):
+                        c.request("POST", gw.api_path, body=payload,
+                                  headers={"Content-Type":
+                                           "application/json"})
+                        r = c.getresponse()
+                        r.read()
+                        if r.status == 200:
+                            ok_counts[slot] += 1
+                except Exception:
+                    pass      # count only completed requests below
+                finally:
+                    c.close()
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            done = sum(ok_counts)
+            if done < n_threads * per_thread * 0.95:
+                raise RuntimeError(
+                    f"fabric scaling @{n_workers}w: only {done}/"
+                    f"{n_threads * per_thread} requests succeeded")
+            rates[n_workers] = done / (time.perf_counter() - t0)
+        finally:
+            gw.stop()
+            for s in workers:
+                s.stop()
+    return {"metric": "serving_fabric_reqs_per_sec",
+            "value": round(rates[4], 1),
+            "unit": "req/s aggregate (1w=%.0f 2w=%.0f 4w=%.0f; %d clients)"
+                    % (rates[1], rates[2], rates[4], n_threads),
+            "vs_baseline": round(rates[4] / max(rates[1], 1e-9), 3)}
 
 
 def bench_flash_attention(batch=4, seq=4096, heads=8, dim=64, steps=10):
@@ -1210,7 +1278,7 @@ def _extra_workloads():
            bench_onnx_inference, bench_onnx_bf16, bench_onnx_bert,
            bench_flash_attention, bench_sparse_ingest,
            bench_serving, bench_serving_resnet,
-           bench_serving_distributed, bench_voting_ab,
+           bench_serving_distributed, bench_fabric_scaling, bench_voting_ab,
            bench_distributed_gbdt_auto,
            bench_checkpoint_overhead)
     return {f.__name__: f for f in fns}

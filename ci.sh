@@ -91,6 +91,12 @@ print(f"serving perf guard ok: buckets={after['buckets']} "
       f"hits={after['total_hits']}")
 EOF
 
+echo "== fabric chaos (kill-mid-swap + heartbeat partition; invariant: accepted requests never dropped) =="
+JAX_PLATFORMS=cpu python -m pytest -x -q \
+    "tests/test_fabric.py::TestHotSwap" \
+    "tests/test_fabric.py::TestGatewayMembership::test_heartbeat_join_evict_on_silence_then_rejoin" \
+    "tests/test_fabric.py::TestFabricInvariant"
+
 echo "== distributed gbdt guard (quantized wire + auto router) =="
 JAX_PLATFORMS=cpu python - << 'EOF'
 # the routed learner must never lose to a hand-picked flag: auto's measured

@@ -38,6 +38,7 @@ from .resilience import (  # noqa: F401
     DEADLINE_HEADER,
     CircuitBreaker,
     Deadline,
+    Membership,
     RetryBudget,
     default_retry_budget,
 )

@@ -104,6 +104,28 @@ class Checkpoint:
     meta: Dict[str, Any]
     base: str      # e.g. "ckpt_00000007" (for diagnostics)
 
+    @property
+    def digest(self) -> str:
+        """Content digest of the whole checkpoint: SHA-256 over the sorted
+        per-artifact (name, sha256) pairs. Two checkpoints with identical
+        bytes share a digest regardless of step number — the identity the
+        serving model registry keys hot-swap versions on."""
+        h = hashlib.sha256()
+        for name in sorted(self.artifacts):
+            h.update(name.encode("utf-8"))
+            h.update(b"\x00")
+            h.update(hashlib.sha256(self.artifacts[name]).hexdigest()
+                     .encode("ascii"))
+            h.update(b"\x00")
+        return h.hexdigest()
+
+    @property
+    def version(self) -> str:
+        """Human-readable version id (``<base>@<digest12>``) for the serving
+        model registry: names the step AND pins the exact bytes, so a
+        re-written step with different content is a different version."""
+        return f"{self.base}@{self.digest[:12]}"
+
 
 class CheckpointStore:
     """Atomic, manifest-verified, keep-last-N checkpoint directory.

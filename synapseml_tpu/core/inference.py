@@ -299,6 +299,15 @@ class BucketedRunner:
         return self.dispatch(*args).result()
 
     # --- observability ---------------------------------------------------
+    def warm_buckets(self) -> List[int]:
+        """Ascending bucket sizes holding at least one compiled executable —
+        what a fabric worker advertises in its heartbeat so the gateway can
+        prefer replicas whose AOT cache already covers a batch's bucket
+        (docs/resilience.md, "Multi-host fabric"). Advisory: routing built
+        on this must degrade to load-based selection when it is stale."""
+        with self._lock:
+            return sorted(self._compile_counts)
+
     def stats(self) -> dict:
         with self._lock:
             compiles = dict(sorted(self._compile_counts.items()))

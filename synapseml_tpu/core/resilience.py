@@ -14,6 +14,12 @@ Three small, thread-safe, clock-injectable building blocks:
 * :class:`CircuitBreaker` — the classic three-state (closed → open →
   half-open) breaker with escalating re-open cooldowns, used by the serving
   gateway for passive backend health.
+* :class:`Membership` — a heartbeat-driven liveness table for dynamic
+  worker pools. Distinct from the breaker on purpose: a breaker OPEN is a
+  *traffic* judgment (this backend is failing requests right now, keep the
+  link and re-probe it), while a missed-heartbeat expiry is a *membership*
+  judgment (this worker is gone, free its routing state; it may re-register
+  later as a clean rejoin). The serving gateway uses both.
 
 Reference analog: the reference leans on Spark task retry plus
 RESTHelpers.scala's per-call backoff and has no shared-fate machinery; these
@@ -215,3 +221,111 @@ class CircuitBreaker:
             return {"state": self.state,
                     "consecutive_failures": self.consecutive_failures,
                     "open_until": self.open_until}
+
+
+class Membership:
+    """Heartbeat liveness table: ``beat(member)`` marks a member alive now,
+    ``expired()`` names members whose last beat is older than ``timeout``
+    (callers evict them and free whatever routing state they held), and a
+    later ``beat`` from an evicted member is a clean rejoin (``beat``
+    returns True when the member is new or returning).
+
+    Members registered with ``beat(member, static=True)`` are *static*:
+    they never expire, which is the compatibility mode for worker pools
+    configured as a fixed URL list with no heartbeat reporter — liveness
+    for those stays the breaker's job alone.
+
+    Thread-safe and clock-injectable (tests drive it with a fake clock).
+    ``info`` carried by a beat (queue depth, warmed buckets, model version)
+    is stored verbatim for routing/observability reads via ``snapshot()``.
+    """
+
+    def __init__(self, timeout: float = 3.0, clock=time.monotonic):
+        if timeout <= 0:
+            raise ValueError(f"timeout must be > 0, got {timeout}")
+        self.timeout = timeout
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last: dict = {}        # member -> last beat (monotonic)
+        self._info: dict = {}        # member -> latest info dict
+        self._static: set = set()
+        self.joins = 0               # first-time registrations
+        self.rejoins = 0             # beats from previously-evicted members
+        self.evictions = 0
+        self._evicted: set = set()
+
+    def beat(self, member, static: bool = False, **info):
+        """Record a heartbeat; returns ``"join"`` when this beat admits a
+        first-time member, ``"rejoin"`` when it readmits an evicted one,
+        and ``None`` for an ordinary keep-alive beat (truthy iff the beat
+        (re)admitted the member).
+
+        A non-static beat for a member registered static UPGRADES it to
+        dynamic: the member proved it has a live heartbeat reporter, so
+        heartbeat silence becomes meaningful and it is now evictable."""
+        with self._lock:
+            status = None
+            if member not in self._last:
+                if member in self._evicted:
+                    self._evicted.discard(member)
+                    self.rejoins += 1
+                    status = "rejoin"
+                else:
+                    self.joins += 1
+                    status = "join"
+            self._last[member] = self._clock()
+            if info or member not in self._info:
+                self._info[member] = dict(info)
+            if static:
+                self._static.add(member)
+            else:
+                self._static.discard(member)
+            return status
+
+    def info(self, member) -> dict:
+        with self._lock:
+            return dict(self._info.get(member, {}))
+
+    def alive(self, member, now: Optional[float] = None) -> bool:
+        now = self._clock() if now is None else now
+        with self._lock:
+            last = self._last.get(member)
+            if last is None:
+                return False
+            return member in self._static or now - last <= self.timeout
+
+    def expired(self, now: Optional[float] = None) -> list:
+        """Members overdue for eviction (non-static, last beat older than
+        ``timeout``). Non-mutating; callers follow with :meth:`evict`."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            return [m for m, last in self._last.items()
+                    if m not in self._static and now - last > self.timeout]
+
+    def evict(self, member) -> bool:
+        """Drop a member (idempotent); a later beat counts as a rejoin."""
+        with self._lock:
+            if member not in self._last:
+                return False
+            del self._last[member]
+            self._info.pop(member, None)
+            self._static.discard(member)
+            self._evicted.add(member)
+            self.evictions += 1
+            return True
+
+    def members(self) -> list:
+        with self._lock:
+            return list(self._last)
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        now = self._clock() if now is None else now
+        with self._lock:
+            return {
+                "members": {
+                    str(m): {"age_s": round(now - last, 3),
+                             "static": m in self._static,
+                             **self._info.get(m, {})}
+                    for m, last in self._last.items()},
+                "joins": self.joins, "rejoins": self.rejoins,
+                "evictions": self.evictions, "timeout_s": self.timeout}

@@ -13,8 +13,10 @@ micro-batches through a fitted pipeline.
 from .http import (CustomInputParser, CustomOutputParser, HTTPRequestData,
                    HTTPResponseData, HTTPTransformer, JSONInputParser,
                    JSONOutputParser, SimpleHTTPTransformer, StringOutputParser)
-from .distributed_serving import DistributedServingServer, ServingGateway
-from .serving import ServingServer, request_to_table, respond_with
+from .distributed_serving import (DistributedServingServer, FabricSupervisor,
+                                  ServingGateway, WorkerAgent)
+from .serving import (ModelRegistry, ServingServer, SwapError,
+                      request_to_table, respond_with)
 from .binary import read_binary_files, read_image_dir
 from .powerbi import PowerBIWriter
 
@@ -23,6 +25,7 @@ __all__ = [
     "SimpleHTTPTransformer", "JSONInputParser", "CustomInputParser",
     "JSONOutputParser", "StringOutputParser", "CustomOutputParser",
     "ServingServer", "ServingGateway", "DistributedServingServer",
+    "WorkerAgent", "FabricSupervisor", "ModelRegistry", "SwapError",
     "request_to_table", "respond_with",
     "read_binary_files", "read_image_dir", "PowerBIWriter",
 ]

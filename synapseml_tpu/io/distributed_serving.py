@@ -1,5 +1,6 @@
-"""Distributed serving — one embedded server per mesh process, plus a
-load-balancing gateway with cross-process request forwarding.
+"""Distributed serving — a fault-tolerant multi-host fabric: per-process
+workers, a load-balancing gateway with dynamic membership, bucket-aware
+routing, and zero-downtime model hot-swap.
 
 Reference: DistributedHTTPSource (core/.../streaming/DistributedHTTPSource.scala:
 203-312) runs a ``JVMSharedServer`` inside EVERY executor JVM and a
@@ -11,31 +12,68 @@ rehydration. Notably the reference's own cross-machine forwarding
 balancer. Here the same worker-per-process architecture is kept (each process
 on the mesh embeds a :class:`~synapseml_tpu.io.serving.ServingServer` running
 the SAME jitted pipeline on its local shard of capacity), and the internal
-routing layer is actually implemented: a :class:`ServingGateway` pools
-keep-alive connections to every worker, picks the least-loaded one per
-request, relays the reply to the caller (reply-by-id across processes), and
-retries on a sibling when a worker dies mid-request (the rehydration analog).
+routing layer is actually implemented — and made dynamic:
+
+* **Membership** — workers register and heartbeat with the gateway
+  (``POST /__fabric/heartbeat``; :class:`WorkerAgent` is the worker-side
+  reporter). Missed heartbeats EVICT a link — distinct from a breaker OPEN:
+  eviction frees the link's routing state (connection pool, affinity,
+  selection slot) while OPEN keeps the link and re-probes it. An evicted
+  worker that heartbeats again rejoins cleanly, and brand-new workers can
+  join a RUNNING gateway, which is the autoscaling hook
+  :class:`FabricSupervisor` drives from queue-depth gauges.
+* **Bucket-aware routing** — heartbeats advertise each worker's warmed
+  bucket ladder (``BucketedRunner.warm_buckets()``); the gateway prefers
+  the replica whose AOT cache already covers a request's batch bucket and
+  keeps same-shape traffic sticky on one replica, falling back to
+  least-loaded whenever the hint is absent or stale. Routing degrades,
+  never fails: any shape-inference or staleness problem means "route by
+  load", exactly the pre-fabric behavior.
+* **Failover** — per-worker three-state circuit breakers, sibling retry on
+  transport failure, deadline re-anchoring per hop, fast 502 only when no
+  backend remains. The fabric invariant (chaos-proven by
+  ``tests/test_fabric.py``): an ACCEPTED request (non-503) is never
+  dropped — it completes on some worker or fails its own deadline with a
+  504, even under worker kill, heartbeat partition, or kill-mid-swap.
 
 TPU framing: serving is host-side IO; each process owns one chip (or a
 local-device slice), so "the process holding capacity" = the worker whose
-in-flight count is lowest. The pipeline inside each worker is a jitted XLA
-program; micro-batching happens inside ServingServer exactly as in the
-single-node mode.
+in-flight count is lowest — unless a warm-cache hint says a sibling can skip
+an XLA compile. The pipeline inside each worker is a jitted XLA program;
+micro-batching happens inside ServingServer exactly as in the single-node
+mode, and model hot-swap is the worker-local
+:class:`~synapseml_tpu.io.serving.ModelRegistry`.
 """
 
 from __future__ import annotations
 
 import http.client
+import json as _json
 import queue
 import threading
 import time
 import uuid
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core.logging import record_failure
-from ..core.resilience import DEADLINE_HEADER, CircuitBreaker, Deadline
+from ..core.resilience import (DEADLINE_HEADER, CircuitBreaker, Deadline,
+                               Membership)
 from ..core.table import Table
 from .serving import ServingServer, _PendingRequest
+
+#: Gateway control-plane path prefix — requests here are membership traffic,
+#: never forwarded to a worker.
+FABRIC_PATH_PREFIX = "/__fabric/"
+
+#: Optional client hint: row count of a batched payload, for bucket-aware
+#: routing without parsing the body.
+SHAPE_ROWS_HEADER = "X-Batch-Rows"
+
+# Heartbeat chaos hook: WorkerAgent consults it before every beat; a falsy
+# return drops the beat on the floor (a network partition between worker and
+# gateway that leaves the DATA path intact — the nastiest membership case).
+# Installed by testing.chaos.chaos_heartbeat_partition; single global hook.
+_HEARTBEAT_HOOK: Optional[Callable[[str], bool]] = None
 
 
 def _detect_local_ip() -> str:
@@ -55,13 +93,48 @@ def _detect_local_ip() -> str:
         s.close()
 
 
+def _parse_hostport(url: str) -> Tuple[str, int]:
+    """``http://h:p[/...]`` (or bare ``h:p``) -> (host, port)."""
+    hostport = url.split("//", 1)[-1].split("/", 1)[0]
+    h, _, p = hostport.partition(":")
+    return h, int(p or 80)
+
+
+class _GatewayStats:
+    """Locked counters for the gateway (the ServingMetrics pattern from
+    io/serving.py): handler threads increment concurrently, so every
+    mutation and read takes the lock — the bare-dict += this replaces lost
+    updates under contention. ``__getitem__`` keeps the historical
+    ``gw.stats["forwarded"]`` read surface."""
+
+    _COUNTERS = ("forwarded", "retried", "failed", "heartbeats", "joined",
+                 "rejoined", "evicted", "deregistered")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = {k: 0 for k in self._COUNTERS}
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[name] += n
+
+    def __getitem__(self, name: str) -> int:
+        with self._lock:
+            return self._c[name]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._c)
+
+
 class _WorkerLink:
     """Connection pool + in-flight accounting + passive health for one
     downstream worker. Health is a three-state circuit breaker
     (core/resilience.py) fed only by the traffic that flows anyway: repeated
     transport failures OPEN the link (skipped by selection), an elapsed
     cooldown admits exactly one HALF-OPEN probe, and a probe success closes
-    it again."""
+    it again. Membership state (heartbeat-advertised warm buckets, queue
+    depth, model version) rides on the link for routing reads."""
 
     def __init__(self, host: str, port: int, timeout: float,
                  breaker: Optional[CircuitBreaker] = None):
@@ -71,6 +144,12 @@ class _WorkerLink:
         self.breaker = breaker or CircuitBreaker()
         self.ok_count = 0
         self.fail_count = 0
+        # membership-advertised routing state (updated by heartbeats; all
+        # advisory — routing must work with every field at its default)
+        self.worker_id: Optional[str] = None
+        self.warm_buckets: Tuple[int, ...] = ()
+        self.queue_depth: int = 0
+        self.version: Optional[str] = None
         self._pool: "queue.LifoQueue[http.client.HTTPConnection]" = \
             queue.LifoQueue()
         self._lock = threading.Lock()
@@ -88,6 +167,43 @@ class _WorkerLink:
     def down_until(self) -> float:
         return self.breaker.open_until if \
             self.breaker.state == CircuitBreaker.OPEN else 0.0
+
+    def update_membership(self, info: Dict) -> None:
+        with self._lock:
+            if "id" in info and info["id"]:
+                self.worker_id = str(info["id"])
+            if "warm_buckets" in info:
+                try:
+                    self.warm_buckets = tuple(
+                        sorted(int(b) for b in info["warm_buckets"]))
+                except (TypeError, ValueError):
+                    pass    # advisory data: garbage degrades, never breaks
+            if "queue_depth" in info:
+                try:
+                    self.queue_depth = int(info["queue_depth"])
+                except (TypeError, ValueError):
+                    pass
+            if "version" in info and info["version"] is not None:
+                self.version = str(info["version"])
+
+    def covers_bucket(self, rows: int) -> bool:
+        """Does this worker's advertised warm ladder already hold a compiled
+        bucket for a ``rows``-row micro-batch? False when nothing was ever
+        advertised — staleness degrades to load-based routing."""
+        with self._lock:
+            return any(rows <= b for b in self.warm_buckets)
+
+    def close(self) -> None:
+        """Free routing state on eviction: every pooled keep-alive
+        connection is closed (an evicted worker's sockets must not linger
+        until GC)."""
+        while True:
+            try:
+                self._pool.get_nowait().close()
+            except queue.Empty:
+                return
+            except OSError:
+                pass
 
     def _get_conn(self) -> Optional[http.client.HTTPConnection]:
         """Pooled connection or None (callers then dial fresh)."""
@@ -136,27 +252,47 @@ class _WorkerLink:
         record_failure("gateway.backend_failure", worker=self.url)
 
     def health(self, now: float) -> Dict:
+        with self._lock:
+            member = {"worker_id": self.worker_id,
+                      "warm_buckets": list(self.warm_buckets),
+                      "queue_depth": self.queue_depth,
+                      "version": self.version}
         return {"url": self.url, "inflight": self.inflight,
                 "ok": self.ok_count, "failed": self.fail_count,
                 "down": not self.breaker.available(now),
-                **self.breaker.snapshot()}
+                **member, **self.breaker.snapshot()}
 
 
 class ServingGateway:
     """Public endpoint forwarding to per-process workers (the implemented
-    version of the reference's stubbed InternalHandler shuffle routing).
+    version of the reference's stubbed InternalHandler shuffle routing),
+    with dynamic membership.
 
-    ``mode``: ``least_loaded`` (default — route to the worker with the fewest
-    in-flight forwards) or ``round_robin``. A worker that fails a forward
-    trips its circuit breaker toward OPEN (``breaker_threshold`` consecutive
-    transport failures; ``cooldown`` seconds out, escalating on repeated
-    trips) and the request retries on a sibling; an OPEN worker is skipped
-    entirely until its cooldown admits a half-open probe. Only when every
-    worker fails — or every breaker is open — does the client see a fast 502
-    (single-request semantics preserved: at-most-once per worker, the reply
-    returns to the original caller's still-open connection — reply-by-id
-    across processes). A client ``X-Deadline-Ms`` budget is re-anchored here
-    and propagated to the worker, and sibling retries stop once it expires."""
+    ``mode``: ``least_loaded`` (default — route to the worker with the
+    fewest in-flight forwards, upgraded to bucket-aware when heartbeats
+    advertise warm ladders) or ``round_robin``. A worker that fails a
+    forward trips its circuit breaker toward OPEN (``breaker_threshold``
+    consecutive transport failures; ``cooldown`` seconds out, escalating on
+    repeated trips) and the request retries on a sibling; an OPEN worker is
+    skipped entirely until its cooldown admits a half-open probe. Only when
+    every worker fails — or every breaker is open — does the client see a
+    fast 502 (single-request semantics preserved: at-most-once per worker,
+    the reply returns to the original caller's still-open connection —
+    reply-by-id across processes). A client ``X-Deadline-Ms`` budget is
+    re-anchored here and propagated to the worker, and sibling retries stop
+    once it expires.
+
+    Membership: links created from ``worker_urls`` are STATIC members —
+    they never expire, preserving the fixed-list deployment mode. The
+    moment a worker heartbeats (``POST /__fabric/heartbeat``) it becomes a
+    dynamic member: ``heartbeat_timeout`` seconds of silence EVICTS it
+    (link removed, pooled connections closed, affinity forgotten —
+    ``gateway.worker_evicted``), and a later heartbeat from the same url
+    rejoins it with a fresh breaker. New workers may heartbeat-join a
+    running gateway at any time. Breaker OPEN and eviction are deliberately
+    different states: OPEN is "failing traffic right now, keep probing";
+    evicted is "gone — free everything, welcome it back if it returns".
+    """
 
     def __init__(self, worker_urls: Sequence[str], host: str = "127.0.0.1",
                  port: int = 0, api_path: str = "/",
@@ -164,18 +300,23 @@ class ServingGateway:
                  cooldown: float = 1.0, breaker_threshold: int = 3,
                  max_retries: Optional[int] = None,
                  local_worker: Optional[ServingServer] = None,
-                 local_index: Optional[int] = None):
+                 local_index: Optional[int] = None,
+                 heartbeat_timeout: float = 3.0,
+                 clock=time.monotonic):
         if mode not in ("least_loaded", "round_robin"):
             raise ValueError(f"unknown load-balancing mode {mode!r}")
         self.breaker_threshold = breaker_threshold
+        self.forward_timeout = forward_timeout
+        self.cooldown = cooldown
+        self._clock = clock
+        self.membership = Membership(timeout=heartbeat_timeout, clock=clock)
         self.links: List[_WorkerLink] = []
         for u in worker_urls:
-            hostport = u.split("//", 1)[-1].split("/", 1)[0]
-            h, _, p = hostport.partition(":")
-            self.links.append(_WorkerLink(
-                h, int(p or 80), forward_timeout,
-                breaker=CircuitBreaker(failure_threshold=breaker_threshold,
-                                       cooldown=cooldown)))
+            link = self._make_link(u)
+            self.links.append(link)
+            # static member: a configured URL with no heartbeat reporter
+            # stays routable forever (liveness is the breaker's job alone)
+            self.membership.beat(link.url, static=True)
         # the co-located worker (same process as the gateway): requests
         # routed to it enqueue DIRECTLY into its micro-batch queue instead
         # of paying a loopback HTTP round trip — the reference gets the same
@@ -210,18 +351,143 @@ class ServingGateway:
         self.host, self.port = host, port
         self.api_path = api_path
         self.mode = mode
-        self.forward_timeout = forward_timeout
-        self.cooldown = cooldown
-        self.max_retries = (len(self.links) if max_retries is None
-                            else max_retries)
+        # None = dynamic: retry across however many workers exist NOW (the
+        # membership can grow/shrink after start)
+        self._max_retries_cfg = max_retries
         self._rr = 0
         self._lock = threading.Lock()
         self._httpd = None
-        self.stats = {"forwarded": 0, "retried": 0, "failed": 0}
+        self.stats = _GatewayStats()
+        # shape-affinity routing table: shape key -> worker url. Sticky
+        # same-shape traffic concentrates each shape's bucket ladder onto
+        # one replica's AOT cache. Bounded FIFO; purely advisory.
+        self._affinity: Dict = {}
+        self._affinity_cap = 256
+
+    # --- membership -----------------------------------------------------
+    def _make_link(self, url: str) -> _WorkerLink:
+        h, p = _parse_hostport(url)
+        return _WorkerLink(
+            h, p, self.forward_timeout,
+            breaker=CircuitBreaker(failure_threshold=self.breaker_threshold,
+                                   cooldown=self.cooldown))
+
+    def register_worker(self, url: str, **info) -> _WorkerLink:
+        """Programmatic join: add (or refresh) a worker link on a RUNNING
+        gateway. Idempotent by url; an evicted worker re-registering gets a
+        fresh link and breaker (clean rejoin). This is also what a
+        ``/__fabric/heartbeat`` from an unknown url does."""
+        h, p = _parse_hostport(url)
+        canonical = f"http://{h}:{p}"
+        with self._lock:
+            link = next((l for l in self.links if l.url == canonical), None)
+            created = link is None
+            if created:
+                link = self._make_link(canonical)
+                self.links.append(link)
+        admitted = self.membership.beat(canonical, **{
+            k: v for k, v in info.items() if k in (
+                "queue_depth", "warm_buckets", "version", "id")})
+        link.update_membership(info)
+        if created:
+            self.stats.incr("rejoined" if admitted == "rejoin"
+                            else "joined")
+            record_failure("gateway.worker_joined", worker=canonical)
+        return link
+
+    def deregister_worker(self, url: str) -> bool:
+        """Voluntary leave (clean scale-down): evict immediately without
+        waiting for the heartbeat timeout."""
+        h, p = _parse_hostport(url)
+        return self._evict(f"http://{h}:{p}", reason="deregistered")
+
+    def _evict(self, url: str, reason: str = "evicted") -> bool:
+        """Remove a worker from routing entirely and free its state. The
+        counterpart of breaker OPEN: OPEN keeps the link and re-probes;
+        eviction forgets it (until a rejoin)."""
+        with self._lock:
+            link = next((l for l in self.links if l.url == url), None)
+            if link is None:
+                return False
+            self.links.remove(link)
+            if link is self._local_link:
+                self._local_link = None
+            # forget this worker's shape affinities so sticky routing
+            # re-pins surviving replicas on the next request
+            self._affinity = {k: v for k, v in self._affinity.items()
+                              if v != url}
+        link.close()
+        self.membership.evict(url)
+        self.stats.incr("deregistered" if reason == "deregistered"
+                        else "evicted")
+        record_failure(f"gateway.worker_{reason}", worker=url)
+        return True
+
+    def _sweep_expired(self) -> None:
+        """Evict every member whose heartbeat is overdue. Called lazily
+        from the selection path and the health endpoint — no sweeper
+        thread to leak."""
+        for url in self.membership.expired():
+            self._evict(url, reason="evicted")
+
+    def _handle_control(self, path: str, body: bytes) -> Tuple[int, dict]:
+        """Membership control-plane dispatch for ``/__fabric/*`` POSTs."""
+        try:
+            payload = _json.loads(body.decode()) if body else {}
+        except ValueError:
+            return 400, {"error": "control payload must be JSON"}
+        if not isinstance(payload, dict) or not payload.get("url"):
+            return 400, {"error": "control payload needs a worker 'url'"}
+        op = path[len(FABRIC_PATH_PREFIX):].strip("/")
+        if op in ("heartbeat", "register"):
+            before = set(self.membership.members())
+            info = {k: v for k, v in payload.items() if k != "url"}
+            link = self.register_worker(str(payload["url"]), **info)
+            self.stats.incr("heartbeats")
+            self._sweep_expired()
+            return 200, {"ok": True, "worker": link.url,
+                         "known": link.url in before,
+                         "workers": len(self.links)}
+        if op == "deregister":
+            gone = self.deregister_worker(str(payload["url"]))
+            return 200, {"ok": True, "removed": gone,
+                         "workers": len(self.links)}
+        return 404, {"error": f"unknown fabric op {op!r}"}
 
     # --- worker selection ----------------------------------------------
-    def _pick(self, exclude: set) -> Optional[_WorkerLink]:
-        now = time.monotonic()
+    def _shape_hint(self, body: bytes,
+                    headers=None) -> Optional[Tuple[int, Optional[tuple]]]:
+        """(rows, shape_key) inferred from a request, or None. The hint is
+        ADVISORY and this helper must degrade, never fail: any parse
+        problem, oversized body, or unfamiliar payload shape returns None
+        and routing falls back to least-loaded. An explicit
+        ``X-Batch-Rows`` header skips body parsing entirely."""
+        try:
+            if headers is not None:
+                raw = headers.get(SHAPE_ROWS_HEADER)
+                if raw:
+                    return max(int(raw), 1), None
+            if not body or len(body) > 4096 or body[:1] != b"{":
+                return None
+            obj = _json.loads(body)
+            if not isinstance(obj, dict):
+                return None
+            for k in sorted(obj):
+                v = obj[k]
+                if isinstance(v, list) and v:
+                    if isinstance(v[0], list):
+                        # batched payload: rows x features
+                        return len(v), (k, len(v[0]))
+                    return 1, (k, len(v))
+            return None
+        except Exception:  # noqa: BLE001 — a hint must never fail a request
+            return None
+
+    def _pick(self, exclude: set,
+              hint: Optional[Tuple[int, Optional[tuple]]] = None
+              ) -> Optional[_WorkerLink]:
+        now = self._clock()
+        self._sweep_expired()
         with self._lock:
             up = [l for l in self.links
                   if id(l) not in exclude and l.breaker.available(now)]
@@ -234,24 +500,59 @@ class ServingGateway:
                 self._rr += 1
                 order = up[self._rr % len(up):] + up[:self._rr % len(up)]
             else:
-                order = sorted(up, key=lambda l: l.inflight)
+                order = self._bucket_aware_order(up, hint)
             # try_acquire consumes the single half-open probe slot; a link
             # that loses the probe race falls through to the next candidate
             for link in order:
                 if link.breaker.try_acquire(now):
+                    if hint is not None and hint[1] is not None:
+                        self._pin_affinity(hint[1], link.url)
                     return link
             return None
 
+    def _bucket_aware_order(self, up: List[_WorkerLink],
+                            hint) -> List[_WorkerLink]:
+        """Least-loaded order, upgraded by routing hints when present:
+        (1) replicas whose advertised warm ladder already covers the
+        request's bucket sort first (an AOT-cache hit beats an idle replica
+        that would pay an XLA compile), (2) the shape's sticky affinity
+        replica wins ties (same-shape traffic concentrates one cache), and
+        (3) in-flight load breaks the rest. With no hint — or stale/absent
+        bucket info — this IS plain least-loaded. Caller holds _lock."""
+        if hint is None:
+            return sorted(up, key=lambda l: l.inflight)
+        rows, key = hint
+        sticky = self._affinity.get(key) if key is not None else None
+        return sorted(up, key=lambda l: (
+            0 if l.covers_bucket(rows) else 1,
+            0 if sticky is not None and l.url == sticky else 1,
+            l.inflight))
+
+    def _pin_affinity(self, key, url: str) -> None:
+        # caller holds _lock
+        if key not in self._affinity and \
+                len(self._affinity) >= self._affinity_cap:
+            self._affinity.pop(next(iter(self._affinity)))
+        self._affinity[key] = url
+
     def _forward(self, method: str, path: str, body: bytes,
                  headers: Dict[str, str],
-                 deadline: Optional[Deadline] = None) -> tuple:
+                 deadline: Optional[Deadline] = None,
+                 hint: Optional[tuple] = None) -> tuple:
         tried: set = set()
         last_err = None
-        for _ in range(self.max_retries):
+        last_shed: Optional[tuple] = None
+        # dynamic retry bound: one attempt per CURRENT member by default
+        # (membership can grow/shrink while the gateway runs)
+        with self._lock:
+            retries = (self._max_retries_cfg
+                       if self._max_retries_cfg is not None
+                       else max(len(self.links), 1))
+        for _ in range(retries):
             if deadline is not None and deadline.expired():
                 record_failure("gateway.deadline_expired")
                 return 504, b'{"error": "deadline exceeded at gateway"}'
-            link = self._pick(tried)
+            link = self._pick(tried, hint)
             if link is None:
                 break
             tried.add(id(link))
@@ -268,20 +569,33 @@ class ServingGateway:
                     status, payload = link.forward(method, path, body,
                                                    headers)
                 link.mark_ok()
-                with self._lock:
-                    self.stats["forwarded"] += 1
+                if status == 503:
+                    # shed failover: a 503 is the worker's backpressure
+                    # (admission queue full or draining), not a broken
+                    # link — no breaker penalty, but a sibling may have
+                    # capacity, so the request fails over instead of
+                    # surfacing one replica's shed to the client. Only
+                    # when EVERY candidate sheds does the 503 go out.
+                    last_shed = (status, payload)
+                    self.stats.incr("retried")
+                    record_failure("gateway.shed_failover", worker=link.url)
+                    continue
+                self.stats.incr("forwarded")
                 return status, payload
             except Exception as e:  # transport failure -> retry on sibling
                 last_err = e
                 link.mark_failed()
-                with self._lock:
-                    self.stats["retried"] += 1
+                self.stats.incr("retried")
                 record_failure("gateway.retry", worker=link.url)
             finally:
                 with self._lock:
                     link.inflight -= 1
-        with self._lock:
-            self.stats["failed"] += 1
+        if last_shed is not None:
+            # every reachable worker shed: the honest answer is the 503
+            # (client backoff), not a 502 pretending the fabric is down
+            self.stats.incr("forwarded")
+            return last_shed
+        self.stats.incr("failed")
         record_failure("gateway.no_backend")
         return 502, (b'{"error": "no serving worker reachable: %s"}'
                      % str(last_err).encode()[:200])
@@ -302,7 +616,10 @@ class ServingGateway:
         req = _PendingRequest(
             id=uuid.uuid4().hex, method="POST", path=self.api_path,
             headers={}, body=body, deadline=Deadline.after(budget),
-            admitted_at=time.monotonic())
+            admitted_at=time.monotonic(),
+            # the fast path pins the active handler version exactly like
+            # the worker's own admission path (hot-swap consistency)
+            handler=self._local.handler)
         try:
             self._local._queue.put_nowait(req)
         except queue.Full:
@@ -329,9 +646,20 @@ class ServingGateway:
             disable_nagle_algorithm = True
             timeout = 30
 
+            def _reply_json(self, status: int, payload: bytes):
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
             def do_POST(self):  # noqa: N802
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
+                if self.path.startswith(FABRIC_PATH_PREFIX):
+                    status, resp = outer._handle_control(self.path, body)
+                    self._reply_json(status, _json.dumps(resp).encode())
+                    return
                 fwd_headers = {"Content-Type": self.headers.get(
                     "Content-Type", "application/json"),
                     "Content-Length": str(len(body))}
@@ -340,29 +668,30 @@ class ServingGateway:
                 # would starve the sibling retry). An explicit budget is
                 # capped at the gateway's own total-work bound.
                 raw = self.headers.get(DEADLINE_HEADER)
-                deadline = (None if raw is None else Deadline.from_header_ms(
-                    raw, outer.forward_timeout * outer.max_retries))
-                status, payload = outer._forward("POST", outer.api_path,
-                                                 body, fwd_headers,
-                                                 deadline=deadline)
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                with outer._lock:
+                    n_links = max(len(outer.links), 1)
+                cap = outer.forward_timeout * (
+                    outer._max_retries_cfg
+                    if outer._max_retries_cfg is not None else n_links)
+                deadline = (None if raw is None
+                            else Deadline.from_header_ms(raw, cap))
+                status, payload = outer._forward(
+                    "POST", outer.api_path, body, fwd_headers,
+                    deadline=deadline,
+                    hint=outer._shape_hint(body, self.headers))
+                self._reply_json(status, payload)
 
             def do_GET(self):  # noqa: N802  — health/stats endpoint
-                import json as _json
-
-                now = time.monotonic()
+                outer._sweep_expired()
+                now = outer._clock()
+                with outer._lock:
+                    links = list(outer.links)
                 body = _json.dumps({
-                    "workers": [l.health(now) for l in outer.links],
-                    **outer.stats}).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    "workers": [l.health(now) for l in links],
+                    "membership": outer.membership.snapshot(now),
+                    "mode": outer.mode,
+                    **outer.stats.snapshot()}).encode()
+                self._reply_json(200, body)
 
             def log_message(self, *args):
                 pass
@@ -386,6 +715,11 @@ class ServingGateway:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}{self.api_path}"
 
+    @property
+    def control_url(self) -> str:
+        """Base of the membership control plane (heartbeats POST here)."""
+        return f"http://{self.host}:{self.port}{FABRIC_PATH_PREFIX}"
+
     def __enter__(self) -> "ServingGateway":
         return self.start()
 
@@ -393,21 +727,228 @@ class ServingGateway:
         self.stop()
 
 
+class WorkerAgent:
+    """Worker-side membership reporter: a daemon thread POSTing periodic
+    heartbeats to the gateway's control plane. Each beat advertises the
+    worker's reachable url, queue depth, warmed bucket ladder
+    (``BucketedRunner.warm_buckets()`` when the handler exposes a runner),
+    and active model version (when a ``ModelRegistry`` is attached) — the
+    inputs to the gateway's bucket-aware routing and the
+    :class:`FabricSupervisor`'s scaling decisions.
+
+    Failure model: a failed beat (gateway down, partition) is COUNTED and
+    otherwise ignored — the worker keeps serving and keeps beating, so a
+    healed partition rejoins automatically. ``stop()`` sends a best-effort
+    deregister (clean leave) unless ``deregister=False``.
+    """
+
+    def __init__(self, worker: ServingServer, gateway_url: str,
+                 advertise_url: Optional[str] = None,
+                 worker_id: Optional[str] = None,
+                 interval: float = 0.5, timeout: float = 2.0):
+        h, p = _parse_hostport(gateway_url)
+        self._control = f"http://{h}:{p}{FABRIC_PATH_PREFIX}"
+        self.worker = worker
+        wh, wp = _parse_hostport(advertise_url or worker.url)
+        self.advertise_url = f"http://{wh}:{wp}"
+        self.worker_id = worker_id or uuid.uuid4().hex[:12]
+        self.interval = interval
+        self.timeout = timeout
+        self.sent = 0
+        self.dropped = 0          # chaos-partitioned beats
+        self.failed = 0           # transport-failed beats
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def payload(self) -> dict:
+        p = {"id": self.worker_id, "url": self.advertise_url,
+             "queue_depth": int(self.worker._queue.qsize())}
+        runner = getattr(self.worker.handler, "runner", None)
+        if runner is not None and callable(
+                getattr(runner, "warm_buckets", None)):
+            try:
+                p["warm_buckets"] = [int(b) for b in runner.warm_buckets()]
+            except Exception:  # noqa: BLE001 — advertisement is advisory
+                pass
+        registry = getattr(self.worker, "registry", None)
+        if registry is not None:
+            p["version"] = registry.active
+        return p
+
+    def _post(self, op: str, payload: dict) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._control + op, data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            r.read()
+
+    def beat(self) -> bool:
+        """One heartbeat. Returns True when the gateway acknowledged it;
+        False for a chaos-dropped or transport-failed beat (both benign:
+        the next beat retries and a healed partition rejoins)."""
+        hook = _HEARTBEAT_HOOK
+        if hook is not None and not hook(self.worker_id):
+            self.dropped += 1
+            return False
+        try:
+            self._post("heartbeat", self.payload())
+        except Exception:  # noqa: BLE001 — gateway down != worker down
+            self.failed += 1
+            return False
+        self.sent += 1
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.beat()
+            self._stop.wait(self.interval)
+
+    def start(self) -> "WorkerAgent":
+        self.beat()                        # eager join before first interval
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, deregister: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + self.timeout)
+        if deregister:
+            try:
+                self._post("deregister", {"url": self.advertise_url})
+            except Exception:  # noqa: BLE001 — best-effort clean leave
+                pass
+
+    def __enter__(self) -> "WorkerAgent":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class FabricSupervisor:
+    """Queue-depth-driven autoscaling hook over a running gateway.
+
+    The membership layer makes scaling possible (workers join/leave a live
+    gateway); this supervisor makes it a policy: when the mean advertised
+    queue depth across alive workers exceeds ``scale_up_depth`` it calls
+    ``spawn_fn()`` (user-supplied: start a process, schedule a pod — the
+    new worker's own heartbeat joins it), and when depth falls below
+    ``scale_down_depth`` with more than ``min_workers`` alive it calls
+    ``retire_fn(url)`` with the least-loaded worker (whose agent then
+    drains and deregisters). ``decide()`` is pure — deterministic to test —
+    and ``step()`` applies one decision; ``start()`` runs steps on a daemon
+    thread for deployments that want the loop managed here.
+    """
+
+    def __init__(self, gateway: ServingGateway,
+                 spawn_fn: Callable[[], object],
+                 retire_fn: Optional[Callable[[str], object]] = None,
+                 min_workers: int = 1, max_workers: int = 8,
+                 scale_up_depth: float = 4.0, scale_down_depth: float = 0.5,
+                 interval: float = 1.0):
+        if min_workers < 1 or max_workers < min_workers:
+            raise ValueError("need 1 <= min_workers <= max_workers")
+        if scale_down_depth >= scale_up_depth:
+            raise ValueError("scale_down_depth must be < scale_up_depth "
+                             "(hysteresis band)")
+        self.gateway = gateway
+        self.spawn_fn = spawn_fn
+        self.retire_fn = retire_fn
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.scale_up_depth = scale_up_depth
+        self.scale_down_depth = scale_down_depth
+        self.interval = interval
+        self.spawned = 0
+        self.retired = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def observe(self) -> Tuple[int, float]:
+        """(alive workers, mean advertised queue depth)."""
+        with self.gateway._lock:
+            links = list(self.gateway.links)
+        if not links:
+            return 0, 0.0
+        depths = [l.queue_depth for l in links]
+        return len(links), sum(depths) / len(depths)
+
+    def decide(self, n_alive: int, mean_depth: float) -> Optional[str]:
+        """Pure scaling policy: "up", "down", or None (hysteresis band)."""
+        if n_alive < self.min_workers:
+            return "up"
+        if mean_depth > self.scale_up_depth and n_alive < self.max_workers:
+            return "up"
+        if mean_depth < self.scale_down_depth and n_alive > self.min_workers:
+            return "down" if self.retire_fn is not None else None
+        return None
+
+    def step(self) -> Optional[str]:
+        """Observe -> decide -> act once; returns the action taken."""
+        n, depth = self.observe()
+        action = self.decide(n, depth)
+        if action == "up":
+            self.spawn_fn()
+            self.spawned += 1
+            record_failure("gateway.scale_up", workers=n,
+                           mean_depth=round(depth, 3))
+        elif action == "down":
+            with self.gateway._lock:
+                idle = sorted(self.gateway.links,
+                              key=lambda l: (l.queue_depth, l.inflight))
+            victim = next((l for l in idle
+                           if l is not self.gateway._local_link), None)
+            if victim is None:
+                return None
+            self.retire_fn(victim.url)
+            self.retired += 1
+            record_failure("gateway.scale_down", worker=victim.url,
+                           mean_depth=round(depth, 3))
+        return action
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — a bad spawn must not kill
+                record_failure("gateway.supervisor_error")  # the loop
+            self._stop.wait(self.interval)
+
+    def start(self) -> "FabricSupervisor":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 1.0)
+
+
 class DistributedServingServer:
     """Mesh-wide serving: every process starts a worker ServingServer running
     ``handler`` on its local capacity; worker addresses are exchanged over the
     distributed backend (the DCN rendezvous the reference does through Spark's
-    driver); process 0 additionally exposes the public gateway.
+    driver); process 0 additionally exposes the public gateway, and every
+    process runs a :class:`WorkerAgent` heartbeating to it — so the fabric
+    started static becomes dynamic the moment it is up (dead workers evict,
+    restarted ones rejoin, new ones may join).
 
     Single-process fallback: with no distributed backend this degrades to one
-    worker + gateway on the same host (still exercising the forwarding hop).
+    worker + gateway on the same host (still exercising the forwarding hop
+    and the heartbeat loop).
     """
 
     def __init__(self, handler: Callable[[Table], Table],
                  host: Optional[str] = None, gateway_port: int = 0,
                  worker_port: int = 0, mode: str = "least_loaded",
                  max_batch_size: int = 64, max_batch_latency: float = 0.0,
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 heartbeat_interval: float = 0.5,
+                 heartbeat_timeout: float = 3.0):
         self.handler = handler
         # None = auto: loopback single-process; all interfaces when the
         # advertised address must be reachable from OTHER hosts
@@ -420,15 +961,23 @@ class DistributedServingServer:
         self.mode = mode
         self.max_batch_size = max_batch_size
         self.max_batch_latency = max_batch_latency
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_timeout = heartbeat_timeout
         self.worker: Optional[ServingServer] = None
         self.gateway: Optional[ServingGateway] = None
+        self.agent: Optional[WorkerAgent] = None
 
     _local_ip = staticmethod(_detect_local_ip)
 
     def _gather_worker_addrs(self, port: int) -> List[str]:
         """All-gather (ip, port) across processes. Ports ride a tiny int
         array through the collective layer — the only cross-process exchange
-        serving needs (requests themselves flow over plain HTTP)."""
+        serving needs (requests themselves flow over plain HTTP).
+
+        Constraint: the advertised address must be an IPv4 dotted-quad (it
+        ships as exactly 4 octets on the wire). IPv6 and hostnames are
+        rejected with a clear error instead of silently mangling the
+        address — resolve the name / pick the v4 interface address first."""
         import jax
 
         if jax.process_count() == 1:
@@ -441,7 +990,16 @@ class DistributedServingServer:
         ip = self.advertise_host or self._local_ip()
         # IP ships as 4 octets (NOT one packed u32: jax's x64-disabled
         # default would downcast the int64 array to int32 and overflow)
-        octets = [int(b) for b in socket.inet_aton(ip)]
+        try:
+            octets = [int(b) for b in socket.inet_aton(ip)]
+        except OSError as e:
+            raise ValueError(
+                f"advertise_host {ip!r} is not an IPv4 dotted-quad address; "
+                "the worker-address exchange ships exactly 4 octets over "
+                "the collective wire, so IPv6 addresses and hostnames are "
+                "not supported here — pass the host's IPv4 interface "
+                "address (e.g. advertise_host='10.0.0.12'), resolving any "
+                "hostname yourself first") from e
         local = np.asarray([octets + [port]], np.int32)
         allv = np.asarray(multihost_utils.process_allgather(local))
         allv = allv.reshape(-1, 5)
@@ -462,10 +1020,41 @@ class DistributedServingServer:
             self.gateway = ServingGateway(
                 urls, host=bind, port=self.gateway_port,
                 mode=self.mode, local_worker=self.worker,
-                local_index=jax.process_index()).start()
+                local_index=jax.process_index(),
+                heartbeat_timeout=self.heartbeat_timeout).start()
+        # every process learns the gateway address (process 0's advertised
+        # ip + the resolved gateway port) and starts heartbeating to it
+        gw_url = self._gather_gateway_url()
+        if gw_url is not None:
+            self.agent = WorkerAgent(
+                self.worker, gw_url,
+                advertise_url=urls[jax.process_index()],
+                interval=self.heartbeat_interval).start()
         return self
 
+    def _gather_gateway_url(self) -> Optional[str]:
+        """Gateway address on every process: process 0 contributes its
+        advertised ip + gateway port; everyone takes row 0."""
+        import jax
+
+        if jax.process_count() == 1:
+            return self.gateway.url if self.gateway is not None else None
+        import numpy as np
+        import socket
+        from jax.experimental import multihost_utils
+
+        ip = self.advertise_host or self._local_ip()
+        octets = [int(b) for b in socket.inet_aton(ip)]
+        port = self.gateway.port if self.gateway is not None else 0
+        local = np.asarray([octets + [port]], np.int32)
+        allv = np.asarray(
+            multihost_utils.process_allgather(local)).reshape(-1, 5)
+        a, b, c, d, p = allv[0]
+        return f"http://{a}.{b}.{c}.{d}:{int(p)}"
+
     def stop(self) -> None:
+        if self.agent is not None:
+            self.agent.stop()
         if self.gateway is not None:
             self.gateway.stop()
         if self.worker is not None:

@@ -25,6 +25,13 @@ tests/test_chaos_serving.py via testing/chaos.py):
   cannot 500 its co-batched neighbors.
 * **Graceful drain** — ``stop()`` first refuses new work (503) while
   in-flight requests complete, then tears the server down.
+* **Zero-downtime model hot-swap** — :class:`ModelRegistry` stages a new
+  handler version (optionally loaded from a digest-verified
+  ``core.checkpoint.CheckpointStore`` checkpoint), AOT-warms it off the hot
+  path, and atomically flips the serving pointer; every request is pinned
+  at admission to the handler version that accepted it, so a swap can never
+  change the program answering an in-flight request, and a failed
+  load/build/warmup rolls back with the old version never having stopped.
 
 ``ServingServer.metrics`` exposes queue depth/age gauges and shed/error/
 deadline counters; the same events also land in the process-wide
@@ -80,6 +87,10 @@ class _PendingRequest:
     response: Optional[tuple] = None  # (status, headers, body)
     deadline: Optional[Deadline] = None
     admitted_at: float = 0.0          # monotonic enqueue time (queue age)
+    # the handler VERSION this request was admitted under (hot-swap pinning:
+    # a model swap mid-flight must not change the program that answers an
+    # already-accepted request). None -> whatever is active at batch time.
+    handler: Optional[Callable] = None
 
 
 class ServingMetrics:
@@ -205,6 +216,7 @@ class ServingServer:
         self.isolate_failures = isolate_failures
         self.drain_timeout = drain_timeout
         self.warmup = warmup
+        self.registry: Optional["ModelRegistry"] = None  # hot-swap registry
         self._queue: "queue.Queue[_PendingRequest]" = queue.Queue(
             maxsize=max_queue_size)
         # two-stage pipeline handoff (batch formation → execution): depth 1
@@ -219,13 +231,23 @@ class ServingServer:
         self._stage_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
+        # budget-kwarg detection is per HANDLER (hot-swap can install a new
+        # one at any time); keyed by id() with the handler kept alive in the
+        # value so a recycled id can never alias a dead handler's signature
+        self._budget_sig: Dict[int, tuple] = {}
+
+    def _takes_budget(self, handler: Callable) -> bool:
+        hit = self._budget_sig.get(id(handler))
+        if hit is not None and hit[0] is handler:
+            return hit[1]
         try:
             import inspect
 
-            self._handler_takes_budget = ("budget" in inspect.signature(
-                handler).parameters)
+            takes = "budget" in inspect.signature(handler).parameters
         except (TypeError, ValueError):
-            self._handler_takes_budget = False
+            takes = False
+        self._budget_sig[id(handler)] = (handler, takes)
+        return takes
 
     # --- embedded server (WorkerServer analog) -------------------------
     def _make_handler_class(self):
@@ -285,7 +307,11 @@ class ServingServer:
                 req = _PendingRequest(
                     id=uuid.uuid4().hex, method="POST", path=self.path,
                     headers=dict(self.headers), body=body,
-                    deadline=deadline, admitted_at=time.monotonic())
+                    deadline=deadline, admitted_at=time.monotonic(),
+                    # pin the ACTIVE handler version at admission: a model
+                    # hot-swap between now and batch execution must not
+                    # change the program answering this request
+                    handler=outer.handler)
                 try:
                     outer._queue.put_nowait(req)
                 except queue.Full:
@@ -325,6 +351,8 @@ class ServingServer:
                 if runner is not None and callable(
                         getattr(runner, "stats", None)):
                     snap["runner"] = runner.stats()
+                if outer.registry is not None:
+                    snap["model"] = outer.registry.snapshot()
                 body = _json.dumps(snap).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -359,7 +387,19 @@ class ServingServer:
         budgets = [r.deadline.remaining() for r in live
                    if r.deadline is not None]
         budget = min(budgets) if budgets else None
-        replies = self._call_handler(live, budget)
+        # hot-swap pinning: a batch formed across a swap boundary may mix
+        # requests admitted under different handler versions — each group
+        # runs through the version it was admitted under (order preserved)
+        groups: List[tuple] = []
+        for r in live:
+            h = r.handler if r.handler is not None else self.handler
+            if groups and groups[-1][0] is h:
+                groups[-1][1].append(r)
+            else:
+                groups.append((h, [r]))
+        replies: Dict[str, tuple] = {}
+        for h, group in groups:
+            replies.update(self._call_handler(group, budget, h))
         by_id = {r.id: r for r in live}
         for rid, (status, payload) in replies.items():
             req = by_id.get(rid)
@@ -373,16 +413,19 @@ class ServingServer:
                 r.reply_event.set()
         self.metrics.incr("completed", len(live))
 
-    def _invoke(self, df: Table, budget: Optional[float]):
-        if self._handler_takes_budget:
-            return self.handler(df, budget=budget)
-        return self.handler(df)
+    def _invoke(self, df: Table, budget: Optional[float],
+                handler: Optional[Callable] = None):
+        handler = self.handler if handler is None else handler
+        if self._takes_budget(handler):
+            return handler(df, budget=budget)
+        return handler(df)
 
     def _call_handler(self, batch: List[_PendingRequest],
-                      budget: Optional[float]) -> Dict[str, tuple]:
+                      budget: Optional[float],
+                      handler: Optional[Callable] = None) -> Dict[str, tuple]:
         df = request_to_table(batch)
         try:
-            out = self._invoke(df, budget)
+            out = self._invoke(df, budget, handler)
             return respond_with(out) if isinstance(out, Table) else out
         except Exception as e:  # noqa: BLE001
             self.metrics.incr("handler_errors")
@@ -395,7 +438,7 @@ class ServingServer:
         replies: Dict[str, tuple] = {}
         for r in batch:
             try:
-                out = self._invoke(request_to_table([r]), budget)
+                out = self._invoke(request_to_table([r]), budget, handler)
                 one = respond_with(out) if isinstance(out, Table) else out
                 replies[r.id] = one.get(
                     r.id, (500, b'{"error": "no reply produced"}'))
@@ -535,3 +578,213 @@ class ServingServer:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+# --- zero-downtime model hot-swap -----------------------------------------
+# Swap-point hook: the registry calls _swap_point(stage, version) at every
+# state transition; normally a no-op, testing.chaos.ChaosSwap installs a
+# killer here so "die at any swap stage, old version never stops serving"
+# is a CI property instead of a hope.
+
+_SWAP_HOOK: Optional[Callable[[str, str], None]] = None
+
+
+def _swap_point(stage: str, version: str) -> None:
+    hook = _SWAP_HOOK
+    if hook is not None:
+        hook(stage, version)
+
+
+class SwapError(RuntimeError):
+    """A model swap failed (bad checkpoint, builder error, warmup failure,
+    injected kill). The previously active version is still serving —
+    raising this never interrupts traffic."""
+
+
+class ModelRegistry:
+    """Versioned handler registry driving zero-downtime hot-swap for one
+    :class:`ServingServer`.
+
+    Swap state machine (docs/resilience.md, "Multi-host fabric")::
+
+        idle -> load -> build -> warmup -> flip -> done
+                  \\        \\        \\
+                   +--------+--------+--> rolled_back (old version serving)
+
+    * ``load`` — read + digest-verify the checkpoint from a
+      :class:`~synapseml_tpu.core.checkpoint.CheckpointStore` (a corrupt or
+      torn checkpoint fails HERE, via the store's manifest verification).
+    * ``build`` — ``builder(checkpoint) -> handler`` constructs the new
+      version's handler (model deserialization, runner construction).
+    * ``warmup`` — the new handler's AOT bucket ladder compiles OFF the hot
+      path (the old version keeps serving throughout; this is the expensive
+      stage and it costs traffic nothing).
+    * ``flip`` — one atomic assignment of the server's serving pointer.
+      Requests admitted before the flip are PINNED to the old handler
+      (``_PendingRequest.handler``) and complete on it; requests admitted
+      after run the new version. No drain, no gap, no 5xx.
+
+    A failure (or injected kill) at load/build/warmup rolls back: the flip
+    never happened, the old version never stopped serving, and the attempt
+    is recorded (``swap_failures``, ``serving.swap_failed`` counter). A kill
+    AFTER the flip leaves the new version serving — either side of the flip
+    is a consistent fabric.
+
+    Old versions stay registered (instant :meth:`rollback`); :meth:`retire`
+    drops one after waiting for the server's in-flight stages to go idle —
+    the drain machinery's idle accounting, reused so a retire can never
+    yank a handler out from under a pinned in-flight batch.
+    """
+
+    def __init__(self, server: ServingServer,
+                 version: str = "v0", keep_versions: int = 3):
+        if keep_versions < 2:
+            raise ValueError("keep_versions must be >= 2 (active + rollback)")
+        self.server = server
+        self.keep_versions = keep_versions
+        self._lock = threading.Lock()       # registry state
+        self._swap_lock = threading.Lock()  # one swap at a time
+        self.versions: Dict[str, Callable] = {version: server.handler}
+        self.active = version
+        self.history: List[str] = [version]
+        self.swaps = 0
+        self.swap_failures = 0
+        self.last_error: Optional[str] = None
+        server.registry = self
+
+    # -- swap pipeline --
+    def swap_to(self, version: str, handler: Callable,
+                warmup: bool = True) -> str:
+        """Stage ``handler`` as ``version``, warm it off the hot path, and
+        atomically flip the server to it. Raises :class:`SwapError` on any
+        pre-flip failure (old version still serving). Returns ``version``."""
+        with self._swap_lock:
+            # only Exception-derived faults roll back: PreemptionError is
+            # BaseException on purpose (a real SIGTERM kills the process,
+            # it does not roll back a swap)
+            try:
+                _swap_point("build", version)
+                warm = getattr(handler, "warmup", None)
+                if warmup and callable(warm):
+                    _swap_point("warmup", version)
+                    warm()          # old version serves during the compile
+                _swap_point("flip", version)
+            except Exception as e:  # noqa: BLE001
+                with self._lock:
+                    self.swap_failures += 1
+                    self.last_error = f"{type(e).__name__}: {e}"
+                record_failure("serving.swap_failed", version=version,
+                               stage="pre-flip", error=type(e).__name__)
+                raise SwapError(
+                    f"swap to {version!r} failed before the flip "
+                    f"({type(e).__name__}: {e}); "
+                    f"{self.active!r} is still serving") from e
+            # the flip: one atomic pointer assignment — admission pins the
+            # handler per request, so either side of this line is consistent
+            with self._lock:
+                self.versions[version] = handler
+                self.active = version
+                if version in self.history:
+                    self.history.remove(version)
+                self.history.append(version)
+                self.swaps += 1
+                self.last_error = None
+            self.server.handler = handler
+            record_failure("serving.swap_completed", version=version)
+            _swap_point("done", version)
+            self._prune()
+            return version
+
+    def swap_from_store(self, store, builder: Callable,
+                        step: Optional[int] = None,
+                        warmup: bool = True) -> str:
+        """Load a checkpoint (digest-verified by the store's manifest),
+        build a handler from it via ``builder(checkpoint)``, and swap to it.
+        ``step=None`` loads the newest VERIFIABLE checkpoint. A corrupt
+        checkpoint, missing store, or builder failure raises
+        :class:`SwapError` with the old version still serving."""
+        try:
+            _swap_point("load", "?")
+            ckpt = (store.load_step(step) if step is not None
+                    else store.load_latest())
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self.swap_failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+            record_failure("serving.swap_failed", stage="load",
+                           error=type(e).__name__)
+            raise SwapError(
+                f"swap aborted: checkpoint load failed ({e}); "
+                f"{self.active!r} is still serving") from e
+        if ckpt is None:
+            with self._lock:
+                self.swap_failures += 1
+                self.last_error = "no verifiable checkpoint"
+            record_failure("serving.swap_failed", stage="load",
+                           error="CheckpointError")
+            raise SwapError(
+                "swap aborted: the store holds no verifiable checkpoint; "
+                f"{self.active!r} is still serving")
+        version = ckpt.version
+        with self._lock:
+            if version == self.active:
+                return version    # already serving these exact bytes
+        try:
+            handler = builder(ckpt)
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self.swap_failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+            record_failure("serving.swap_failed", version=version,
+                           stage="build", error=type(e).__name__)
+            raise SwapError(
+                f"swap to {version!r} aborted: builder failed ({e}); "
+                f"{self.active!r} is still serving") from e
+        return self.swap_to(version, handler, warmup=warmup)
+
+    # -- rollback / retention --
+    def rollback(self) -> str:
+        """Flip back to the previously active version (still registered).
+        Raises :class:`SwapError` when there is nothing to roll back to."""
+        with self._lock:
+            if len(self.history) < 2:
+                raise SwapError("no previous version to roll back to")
+            prev = self.history[-2]
+            handler = self.versions[prev]
+        return self.swap_to(prev, handler, warmup=False)
+
+    def retire(self, version: str, wait_idle: bool = True,
+               timeout: float = 10.0) -> bool:
+        """Drop an inactive version. With ``wait_idle`` the call first waits
+        for the server's pipeline stages to go idle (the drain machinery's
+        accounting), so a pinned in-flight batch can never lose its handler.
+        Returns False when the version is active or unknown."""
+        with self._lock:
+            if version == self.active or version not in self.versions:
+                return False
+        if wait_idle:
+            self.server._idle.wait(timeout)
+        with self._lock:
+            if version == self.active:   # re-check: a swap may have raced
+                return False
+            self.versions.pop(version, None)
+            if version in self.history:
+                self.history.remove(version)
+        return True
+
+    def _prune(self) -> None:
+        while True:
+            with self._lock:
+                if len(self.history) <= self.keep_versions:
+                    return
+                victim = self.history[0]
+            if not self.retire(victim, wait_idle=True):
+                return
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"active": self.active,
+                    "versions": list(self.history),
+                    "swaps": self.swaps,
+                    "swap_failures": self.swap_failures,
+                    "last_error": self.last_error}

@@ -534,3 +534,136 @@ def bit_flip(ckpt_dir: str, offset: Optional[int] = None, bit: int = 3) -> str:
     with open(path, "wb") as f:
         f.write(bytes(data))
     return path
+
+
+# ---------------------------------------------------------------------------
+# Serving-fabric chaos: worker kills, heartbeat partitions, kill-mid-swap
+# (tests/test_fabric.py drives all of it on CPU; the asserted property is the
+# fabric invariant — an ACCEPTED request (non-503) is never dropped: it
+# completes on some worker or 504s within its own deadline)
+# ---------------------------------------------------------------------------
+
+def kill_worker(worker) -> None:
+    """Hard-kill a ServingServer like a process crash: no drain, no
+    deregister farewell — the listener closes immediately, in-flight
+    connections break, queued requests die with the process. The gateway
+    must discover this the hard way (transport failures tripping the
+    breaker, then heartbeat silence evicting the link) — which is exactly
+    what this primitive exists to exercise. Idempotent."""
+    worker._stop.set()
+    worker._draining.set()
+    if worker._httpd is not None:
+        try:
+            worker._httpd.shutdown()
+            worker._httpd.server_close()
+        except OSError:
+            pass
+
+
+class chaos_heartbeat_partition:
+    """Context manager partitioning worker heartbeats away from the gateway
+    while leaving the DATA path untouched — the nastiest membership case
+    (the gateway evicts a worker that is still perfectly able to serve).
+
+    Installs the ``io.distributed_serving._HEARTBEAT_HOOK`` consulted by
+    every :class:`~synapseml_tpu.io.distributed_serving.WorkerAgent` beat:
+    a partitioned beat is dropped on the floor (never sent). Deterministic
+    control, combinable:
+
+    * ``worker_ids`` — only these agents are affected (default: all).
+    * ``partition()`` / ``heal()`` — explicit toggle (starts partitioned).
+    * ``schedule`` — a :class:`ChaosSchedule` consulted per beat while
+      partitioned is on; any non-"ok" outcome drops the beat.
+
+    ``dropped`` records every dropped (worker_id) for assertions. Nesting
+    is not supported (single global hook)."""
+
+    def __init__(self, worker_ids: Optional[Sequence[str]] = None,
+                 schedule: Optional[ChaosSchedule] = None,
+                 partitioned: bool = True):
+        self.worker_ids = set(worker_ids) if worker_ids is not None else None
+        self.schedule = schedule
+        self._partitioned = partitioned
+        self.dropped: List[str] = []
+        self._lock = threading.Lock()
+
+    def partition(self) -> None:
+        with self._lock:
+            self._partitioned = True
+
+    def heal(self) -> None:
+        with self._lock:
+            self._partitioned = False
+
+    def _hook(self, worker_id: str) -> bool:
+        """True = let the beat through; False = drop it."""
+        with self._lock:
+            if not self._partitioned:
+                return True
+            if self.worker_ids is not None and \
+                    worker_id not in self.worker_ids:
+                return True
+            if self.schedule is not None and \
+                    self.schedule.next_outcome() == "ok":
+                return True
+            self.dropped.append(worker_id)
+            return False
+
+    def __enter__(self) -> "chaos_heartbeat_partition":
+        from ..io import distributed_serving as _ds
+
+        if _ds._HEARTBEAT_HOOK is not None:
+            raise RuntimeError("chaos_heartbeat_partition does not nest")
+        _ds._HEARTBEAT_HOOK = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from ..io import distributed_serving as _ds
+
+        _ds._HEARTBEAT_HOOK = None
+
+
+class ChaosSwap:
+    """Context manager killing a model hot-swap at a chosen stage — the
+    deterministic stand-in for "the process handling the swap hit a bug /
+    bad checkpoint / OOM mid-transition".
+
+    Installs ``io.serving._SWAP_HOOK``, called by
+    :class:`~synapseml_tpu.io.serving.ModelRegistry` at every swap state
+    transition (``load`` → ``build`` → ``warmup`` → ``flip`` → ``done``).
+    ``at`` names the stage(s) to die at; each entry fires once
+    (``max_kills`` total, default 1), raising :class:`FaultInjected` —
+    which the registry maps to a rolled-back
+    :class:`~synapseml_tpu.io.serving.SwapError`. Any pre-flip kill must
+    leave the OLD version serving uninterrupted; that is the property
+    tests/test_fabric.py asserts. ``stages`` records every transition
+    visited. Nesting is not supported (single global hook)."""
+
+    def __init__(self, at: Union[str, Sequence[str]] = "warmup",
+                 max_kills: int = 1):
+        self.at = {at} if isinstance(at, str) else set(at)
+        self.max_kills = max_kills
+        self.stages: List[Tuple[str, str]] = []
+        self.kills: List[Tuple[str, str]] = []
+        self._lock = threading.Lock()
+
+    def _hook(self, stage: str, version: str) -> None:
+        with self._lock:
+            self.stages.append((stage, version))
+            if stage not in self.at or len(self.kills) >= self.max_kills:
+                return
+            self.kills.append((stage, version))
+        raise FaultInjected(f"chaos: killed swap to {version!r} at {stage}")
+
+    def __enter__(self) -> "ChaosSwap":
+        from ..io import serving as _sv
+
+        if _sv._SWAP_HOOK is not None:
+            raise RuntimeError("ChaosSwap does not nest")
+        _sv._SWAP_HOOK = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from ..io import serving as _sv
+
+        _sv._SWAP_HOOK = None

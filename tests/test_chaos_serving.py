@@ -406,6 +406,64 @@ class TestGatewayChaos:
             finally:
                 gw.stop()
 
+    def test_half_open_failed_probe_reopens_then_recovers(self):
+        # 3 resets trip the breaker; the 4th reset eats the single
+        # half-open probe (re-OPEN, escalated cooldown); then the backend
+        # recovers and the next probe closes the breaker for good
+        with FlakyHTTPServer(script=["reset"] * 4) as flaky:
+            gw = ServingGateway([flaky.url], forward_timeout=2.0,
+                                cooldown=0.2, breaker_threshold=3).start()
+            try:
+                for _ in range(3):
+                    assert _post(gw.url, "x")[0] == 502
+                link = gw.links[0]
+                assert link.breaker.state == CircuitBreaker.OPEN
+                time.sleep(0.25)
+                seen = flaky.requests
+                assert _post(gw.url, "x")[0] == 502   # probe, reset again
+                assert flaky.requests == seen + 1     # exactly one probe
+                assert link.breaker.state == CircuitBreaker.OPEN
+                # escalated cooldown: still fast-failing right after
+                status, _, elapsed = _post(gw.url, "x")
+                assert status == 502 and elapsed < 0.2
+                assert flaky.requests == seen + 1
+                time.sleep(1.0)                       # outlast escalation
+                assert _post(gw.url, "x")[0] == 200
+                assert link.breaker.state == CircuitBreaker.CLOSED
+            finally:
+                gw.stop()
+
+    def test_local_fast_path_fails_over_when_local_worker_dies(self):
+        from synapseml_tpu.testing.chaos import kill_worker
+
+        local = ServingServer(_echo, port=0, max_batch_latency=0.0).start()
+        with FlakyHTTPServer() as remote:
+            gw = ServingGateway(
+                [f"http://{local.host}:{local.port}", remote.url],
+                local_worker=local, local_index=0,
+                forward_timeout=2.0, breaker_threshold=1,
+                cooldown=30.0).start()
+            try:
+                assert gw._local_link is gw.links[0]
+                # healthy: the co-located worker serves in-process (no
+                # pooled HTTP connection is ever dialed for it)
+                for i in range(4):
+                    assert _post(gw.url, i)[0] == 200
+                assert gw.links[0]._pool.qsize() == 0
+                assert remote.requests == 0
+                kill_worker(local)        # crash the co-located worker
+                # the fast path degrades exactly like a dead remote: the
+                # enqueue/reply failure trips the breaker and the sibling
+                # serves — accepted requests never dropped
+                for i in range(4):
+                    status, _, elapsed = _post(gw.url, i)
+                    assert status == 200 and elapsed < 3.0
+                assert remote.requests == 4
+                assert gw.stats["failed"] == 0
+            finally:
+                gw.stop()
+                local.stop(drain=False)
+
     def test_deadline_budget_propagates_through_gateway(self):
         seen = {}
 

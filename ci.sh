@@ -97,6 +97,10 @@ JAX_PLATFORMS=cpu python -m pytest -x -q \
     "tests/test_fabric.py::TestGatewayMembership::test_heartbeat_join_evict_on_silence_then_rejoin" \
     "tests/test_fabric.py::TestFabricInvariant"
 
+echo "== online learning chaos (invariant: accepted requests always answered by a gate-approved, never-regressed policy) =="
+JAX_PLATFORMS=cpu python -m pytest -x -q \
+    "tests/test_online.py::TestChaosInvariant"
+
 echo "== distributed gbdt guard (quantized wire + auto router) =="
 JAX_PLATFORMS=cpu python - << 'EOF'
 # the routed learner must never lose to a hand-picked flag: auto's measured

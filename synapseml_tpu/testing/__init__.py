@@ -12,15 +12,17 @@ from .fuzzing import (TestObject, discover_stage_classes,
                       experiment_fuzz, getter_setter_fuzz,
                       serialization_fuzz)
 from .benchmarks import Benchmarks
-from .chaos import (ChaosHTTP, ChaosPreemption, ChaosSchedule, FaultInjected,
-                    FlakyHTTPServer, bit_flip, canned_json_responder,
-                    chaos_collectives, chaos_nan_batches, chaotic_handler,
+from .chaos import (ChaosHTTP, ChaosPreemption, ChaosSchedule, ChaosSwap,
+                    FaultInjected, FlakyHTTPServer, bit_flip,
+                    canned_json_responder, chaos_collectives,
+                    chaos_nan_batches, chaos_reward_stream, chaotic_handler,
                     torn_write)
 
 __all__ = [
     "TestObject", "discover_stage_classes", "experiment_fuzz",
     "getter_setter_fuzz", "serialization_fuzz", "Benchmarks",
-    "ChaosHTTP", "ChaosPreemption", "ChaosSchedule", "FaultInjected",
-    "FlakyHTTPServer", "bit_flip", "canned_json_responder",
-    "chaos_collectives", "chaos_nan_batches", "chaotic_handler", "torn_write",
+    "ChaosHTTP", "ChaosPreemption", "ChaosSchedule", "ChaosSwap",
+    "FaultInjected", "FlakyHTTPServer", "bit_flip", "canned_json_responder",
+    "chaos_collectives", "chaos_nan_batches", "chaos_reward_stream",
+    "chaotic_handler", "torn_write",
 ]

@@ -667,3 +667,89 @@ class ChaosSwap:
         from ..io import serving as _sv
 
         _sv._SWAP_HOOK = None
+
+
+# ---------------------------------------------------------------------------
+# Online-learning chaos: corrupted feedback/reward streams
+# (tests/test_online.py drives it on CPU; the asserted property is the
+# online invariant — the served policy version always passed the
+# counterfactual gate, no matter what the reward stream does)
+# ---------------------------------------------------------------------------
+
+class chaos_reward_stream:
+    """Seeded corruptor for ``(context, action, probability, reward)``
+    feedback event streams — the failure model a real reward pipeline has
+    (``online/feedback.FeedbackLog`` must absorb all of it):
+
+    * **delayed** — an event is held back and released after up to
+      ``max_delay`` later events (out-of-order arrival; join lag).
+    * **duplicated** — the same event (same dedup key) is emitted twice
+      (at-least-once delivery from the log shipper).
+    * **NaN reward** — the reward field arrives non-finite (a poisoned
+      join or a divide-by-zero upstream).
+    * **adversarial reward** — the reward arrives wildly out of the
+      declared ``[reward_min, reward_max]`` range (reward hacking / metric
+      pipeline bugs), as ``adversarial_reward``.
+
+    Wraps any iterable of events whose items expose a ``reward`` field via
+    ``dataclasses.replace`` (e.g. ``online.feedback.FeedbackEvent``).
+    Deterministic per ``seed``: the same stream + seed replays the same
+    corruption sequence. ``delayed``/``duplicated``/``nans``/
+    ``adversarial`` count every injected corruption for assertions; no
+    event is ever silently dropped — every input event is emitted at least
+    once (corrupted or not), so conservation asserts stay simple.
+    """
+
+    def __init__(self, events, seed: int = 0, delay_rate: float = 0.0,
+                 max_delay: int = 4, dup_rate: float = 0.0,
+                 nan_rate: float = 0.0, adversarial_rate: float = 0.0,
+                 adversarial_reward: float = 1e9):
+        self.events = events
+        self.rng = random.Random(seed)
+        self.delay_rate = delay_rate
+        self.max_delay = max(int(max_delay), 1)
+        self.dup_rate = dup_rate
+        self.nan_rate = nan_rate
+        self.adversarial_rate = adversarial_rate
+        self.adversarial_reward = adversarial_reward
+        self.delayed = 0
+        self.duplicated = 0
+        self.nans = 0
+        self.adversarial = 0
+
+    def _corrupt_reward(self, ev):
+        import dataclasses
+
+        r = self.rng.random()
+        if r < self.nan_rate:
+            self.nans += 1
+            return dataclasses.replace(ev, reward=float("nan"))
+        if r < self.nan_rate + self.adversarial_rate:
+            self.adversarial += 1
+            return dataclasses.replace(ev, reward=self.adversarial_reward)
+        return ev
+
+    def __iter__(self):
+        #: (release_after_index, event) — held-back events re-entering later
+        pending: List[Tuple[int, object]] = []
+        i = 0
+        for ev in self.events:
+            i += 1
+            ready = [e for due, e in pending if due <= i]
+            pending = [(due, e) for due, e in pending if due > i]
+            for e in ready:
+                yield e
+            ev = self._corrupt_reward(ev)
+            if self.rng.random() < self.dup_rate:
+                self.duplicated += 1
+                yield ev            # the duplicate leads; the original
+                yield ev            # follows immediately (same dedup key)
+                continue
+            if self.rng.random() < self.delay_rate:
+                self.delayed += 1
+                pending.append((i + self.rng.randint(1, self.max_delay), ev))
+                continue
+            yield ev
+        # stream over: flush every still-held event, original order
+        for _, e in sorted(pending, key=lambda p: p[0]):
+            yield e

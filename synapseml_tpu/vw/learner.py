@@ -112,6 +112,9 @@ class VWState:
 
     _FIELDS = ("weights", "acc", "bias", "bias_acc", "t", "loss_sum", "weight_sum")
 
+    #: artifact name VWState checkpoints use inside a CheckpointStore step
+    STORE_ARTIFACT = "vwstate.npz"
+
     def to_bytes(self) -> bytes:
         """Serialized model bytes — the VW `initialModel` warm-start analog
         (VowpalWabbitBaseLearner.scala:180-182)."""
@@ -122,9 +125,71 @@ class VWState:
 
     @staticmethod
     def from_bytes(data: bytes) -> "VWState":
+        """Parse serialized state; raises ``ValueError`` with a clear message
+        on truncated/garbage payloads (mirroring ``gbdt/model_io.py``: a bad
+        artifact must fail loudly at load, never deserialize into garbage)."""
         import io
-        z = np.load(io.BytesIO(data))
-        return VWState(*(jnp.asarray(z[k]) for k in VWState._FIELDS))
+        import zipfile
+        try:
+            z = np.load(io.BytesIO(bytes(data)), allow_pickle=False)
+        except (ValueError, OSError, zipfile.BadZipFile, EOFError) as e:
+            raise ValueError(
+                f"VWState.from_bytes: payload is not a valid npz archive "
+                f"(truncated write or garbage bytes: {e})") from e
+        missing = [k for k in VWState._FIELDS if k not in z.files]
+        if missing:
+            raise ValueError(
+                f"VWState.from_bytes: archive is missing field(s) {missing} "
+                f"(has {sorted(z.files)}) — not a VWState payload")
+        try:
+            arrays = {k: np.asarray(z[k]) for k in VWState._FIELDS}
+        except (ValueError, OSError, zipfile.BadZipFile, EOFError) as e:
+            raise ValueError(
+                f"VWState.from_bytes: archive member unreadable (truncated "
+                f"payload: {e})") from e
+        w, acc = arrays["weights"], arrays["acc"]
+        if w.ndim != 1 or w.size == 0:
+            raise ValueError(
+                f"VWState.from_bytes: weights must be a non-empty 1-D "
+                f"vector, got shape {w.shape}")
+        if acc.shape != w.shape:
+            raise ValueError(
+                f"VWState.from_bytes: acc shape {acc.shape} does not match "
+                f"weights shape {w.shape} — mixed or corrupt payload")
+        for k in ("bias", "bias_acc", "t", "loss_sum", "weight_sum"):
+            if arrays[k].shape != ():
+                raise ValueError(
+                    f"VWState.from_bytes: field {k!r} must be a scalar, got "
+                    f"shape {arrays[k].shape}")
+        return VWState(*(jnp.asarray(arrays[k]) for k in VWState._FIELDS))
+
+    # -- CheckpointStore round-trip (the artifact path gbdt/dl/automl already
+    # use; the online learner loop snapshots through these) --
+    def save_to_store(self, store, step: int, meta: Optional[dict] = None) -> str:
+        """Persist this state as one digest-verified
+        :class:`~synapseml_tpu.core.checkpoint.CheckpointStore` checkpoint;
+        returns the checkpoint base name."""
+        return store.save(int(step), {VWState.STORE_ARTIFACT: self.to_bytes()},
+                          meta=meta)
+
+    @staticmethod
+    def load_from_store(store, step: Optional[int] = None):
+        """Load ``(VWState, Checkpoint)`` from a CheckpointStore —
+        ``step=None`` takes the newest checkpoint that VERIFIES (corrupt
+        snapshots fall back per the store's recovery contract). Returns
+        ``None`` when the store holds no usable checkpoint; raises
+        ``ValueError`` when a verified checkpoint does not hold a parseable
+        VWState artifact."""
+        ckpt = store.load_step(step) if step is not None else store.load_latest()
+        if ckpt is None:
+            return None
+        data = ckpt.artifacts.get(VWState.STORE_ARTIFACT)
+        if data is None:
+            raise ValueError(
+                f"checkpoint {ckpt.base} holds no {VWState.STORE_ARTIFACT!r} "
+                f"artifact (has {sorted(ckpt.artifacts)}) — not a VWState "
+                "checkpoint")
+        return VWState.from_bytes(data), ckpt
 
 
 def _loss_and_grad(p, y, loss: str, tau: float):

@@ -351,3 +351,113 @@ def test_noconstant_keeps_zero_bias():
     assert float(m.state.bias) == 0.0
     m2 = VowpalWabbitRegressor(numPasses=3).fit(df)
     assert abs(float(m2.state.bias)) > 0.5  # intercept learns the +5 offset
+
+
+# ---------------------------------------------------------------------------
+# policyeval edge cases (ISSUE: online-learning PR satellite) — the gate in
+# online/promotion.py leans on these estimators; the edges it actually hits
+# (empty evidence, one sample, clipped weights, alpha sweeps) get pinned here.
+# ---------------------------------------------------------------------------
+
+def test_policy_eval_zero_reward_logs():
+    from synapseml_tpu.vw import (cressie_read_estimate, cressie_read_interval,
+                                  ips_estimate, snips_estimate)
+
+    n = 50
+    r = np.zeros(n)
+    p_log = np.full(n, 0.5)
+    p_target = np.full(n, 0.9)
+    assert ips_estimate(r, p_log, p_target) == 0.0
+    assert snips_estimate(r, p_log, p_target) == 0.0
+    assert cressie_read_estimate(r, p_log, p_target) == 0.0
+    lo, hi = cressie_read_interval(r, p_log, p_target)
+    assert lo == 0.0 and hi == 0.0    # degenerate and clipped at reward_min
+    # and genuinely empty logs don't crash either
+    assert snips_estimate(np.array([]), np.array([]), np.array([])) == 0.0
+    assert cressie_read_estimate(np.array([]), np.array([]), np.array([])) == 0.0
+
+
+def test_policy_eval_single_sample_interval():
+    from synapseml_tpu.vw import cressie_read_estimate, cressie_read_interval
+
+    r, pl, pt = np.array([0.7]), np.array([0.5]), np.array([1.0])
+    est = cressie_read_estimate(r, pl, pt)
+    assert est == pytest.approx(1.4)   # one sample ⇒ EL degenerates to IPS
+    # no variance estimate: the interval collapses to the point estimate,
+    # clipped into the declared reward range
+    lo, hi = cressie_read_interval(r, pl, pt)
+    assert lo == hi == 1.0
+    lo, hi = cressie_read_interval(r, pl, pt, reward_min=-10.0,
+                                   reward_max=10.0)
+    assert lo == hi == pytest.approx(est)
+
+
+def test_cse_transformer_clips_importance_weights():
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.vw import VowpalWabbitCSETransformer
+
+    # one pathological row: logged propensity 1e-6 vs target 1.0 → raw weight
+    # 1e6 would dominate every estimate without clipping
+    df = Table({"reward": np.array([1.0, 0.5, 0.5, 0.5]),
+                "probability": np.array([1e-6, 0.5, 0.5, 0.5]),
+                "probabilityPredicted": np.array([1.0, 0.5, 0.5, 0.5])})
+    out = VowpalWabbitCSETransformer(maxImportanceWeight=10.0).transform(df)
+    assert float(out["maxWeight"][0]) == 10.0
+    assert float(out["snips"][0]) <= 1.0
+    unclipped = VowpalWabbitCSETransformer(maxImportanceWeight=1e9).transform(df)
+    assert float(unclipped["maxWeight"][0]) == pytest.approx(1e6)
+    # the clip is what keeps the single pathological row from owning snips
+    assert abs(float(out["snips"][0]) - 0.5) < \
+        abs(float(unclipped["snips"][0]) - 0.5) + 1e-12
+
+
+def test_cressie_read_interval_monotone_in_alpha():
+    from synapseml_tpu.vw import cressie_read_interval
+
+    rng = np.random.default_rng(11)
+    n = 400
+    r = rng.random(n)
+    p_log = np.full(n, 0.5)
+    p_target = rng.uniform(0.1, 1.0, n)
+    # wide reward bounds so clipping can't mask the width ordering
+    widths = []
+    for alpha in (0.01, 0.05, 0.2, 0.5):
+        lo, hi = cressie_read_interval(r, p_log, p_target, alpha=alpha,
+                                       reward_min=-10.0, reward_max=10.0)
+        assert lo <= hi
+        widths.append(hi - lo)
+    # more confidence (smaller alpha) → strictly wider interval
+    assert widths[0] > widths[1] > widths[2] > widths[3] > 0.0
+
+
+def test_vwstate_store_roundtrip_and_hardened_from_bytes(tmp_path):
+    from synapseml_tpu.core.checkpoint import CheckpointStore
+    from synapseml_tpu.vw.learner import VWConfig, VWState, train_vw
+
+    rng = np.random.default_rng(3)
+    idx = rng.integers(0, 1 << 10, size=(32, 4)).astype(np.int32)
+    val = rng.normal(size=(32, 4)).astype(np.float32)
+    y = rng.random(32).astype(np.float32)
+    state, _ = train_vw(idx, val, y, VWConfig(num_bits=10, batch_size=8))
+
+    store = CheckpointStore(str(tmp_path), keep_last=2)
+    base = state.save_to_store(store, step=7, meta={"tag": "t"})
+    assert base == "ckpt_00000007"
+    loaded, ckpt = VWState.load_from_store(store)
+    assert ckpt.meta["tag"] == "t"
+    for f in VWState._FIELDS:
+        np.testing.assert_array_equal(np.asarray(getattr(loaded, f)),
+                                      np.asarray(getattr(state, f)), f)
+    assert VWState.load_from_store(CheckpointStore(str(tmp_path / "empty"))) \
+        is None
+
+    blob = state.to_bytes()
+    with pytest.raises(ValueError, match="not a valid npz"):
+        VWState.from_bytes(b"garbage bytes, not a zip")
+    with pytest.raises(ValueError, match="not a valid npz"):
+        VWState.from_bytes(blob[:len(blob) // 2])     # truncated write
+    with pytest.raises(ValueError, match="missing field"):
+        import io as _io
+        buf = _io.BytesIO()
+        np.savez(buf, weights=np.zeros(4, np.float32))
+        VWState.from_bytes(buf.getvalue())

@@ -4,7 +4,9 @@ The static complement of the chaos harness (docs/resilience.md): PR 1
 proved the serving stack survives injected faults, but a leaked socket or
 un-reaped subprocess only shows up after hours of chaos. This analyzer
 checks, for the connection-handling modules (``io/serving.py``,
-``io/distributed_serving.py``, ``io/portforward.py``, ``core/fabric.py``),
+``io/distributed_serving.py``, ``io/portforward.py``, ``core/fabric.py``)
+and the online-learning subsystem (``online/``: background drain threads
+must be join-on-close, feedback queues must not leak on exception paths),
 that every locally-created resource reaches a ``close()``-like call or a
 context manager **on all paths including exception edges**, or provably
 escapes (stored on ``self``/a module global/a container, returned, or
@@ -26,13 +28,15 @@ from typing import Dict, List, Optional
 from ..core import Finding, FunctionInfo, SourceFile, dotted_name
 
 ID = "resource-discipline"
-DESCRIPTION = ("sockets/threads/executors/files opened in the serving and "
-               "fabric modules must reach close()/shutdown() on all paths")
+DESCRIPTION = ("sockets/threads/executors/files opened in the serving, "
+               "fabric, and online-learning modules must reach "
+               "close()/shutdown() on all paths")
 
 SCOPE = ("synapseml_tpu/io/serving.py",
          "synapseml_tpu/io/distributed_serving.py",
          "synapseml_tpu/io/portforward.py",
-         "synapseml_tpu/core/fabric.py")
+         "synapseml_tpu/core/fabric.py",
+         "synapseml_tpu/online/")
 
 _RESOURCE_EXACT = {
     "socket.socket": "socket", "socket.create_connection": "socket",

@@ -127,4 +127,29 @@ assert rec["guard"]["wide_auto_ge_1p5x_data_parallel_f32"], \
      f"{rec['data_parallel_f32_row_iters_per_s']} r-i/s")
 EOF
 
+echo "== dl scaling guard (ZeRO sharding + pipeline parallelism) =="
+# correctness first: fixed-seed parity (zero & pipeline match the replicated
+# loss trajectory), kill->resume through sharded checkpoints bit-for-bit,
+# resharding across mesh shapes — all on the 8-CPU-device forked mesh
+JAX_PLATFORMS=cpu python -m pytest -x -q tests/test_dl_sharded.py
+JAX_PLATFORMS=cpu python - << 'EOF'
+# then the memory/throughput claim (docs/dl-scaling.md): ZeRO's per-device
+# live state (params + optimizer moments, from each leaf's sharding) must be
+# <= 0.6x replicated, at a step time within 1.15x, on both the resnet and
+# bert-style staged configs
+import json, subprocess, sys
+out = subprocess.run([sys.executable, "bench.py", "--only",
+                      "bench_dl_sharded"],
+                     capture_output=True, text=True, check=True).stdout
+rec = json.loads(out.strip().splitlines()[-1])
+per_model = {name: {"bytes": m["zero_bytes_ratio"],
+                    "step": m["zero_step_ratio"]}
+             for name, m in rec["models"].items()}
+print(f"zero/replicated ratios per model: {per_model}")
+assert rec["guard"]["zero_bytes_le_0p6x_replicated"], \
+    f"ZeRO state bytes exceed 0.6x replicated: {per_model}"
+assert rec["guard"]["zero_step_within_1p15x_replicated"], \
+    f"ZeRO step time exceeds 1.15x replicated: {per_model}"
+EOF
+
 echo "CI OK"

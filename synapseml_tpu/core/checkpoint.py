@@ -157,19 +157,25 @@ class CheckpointStore:
 
     # -- write path --
     def save(self, step: int, artifacts: Dict[str, bytes],
-             meta: Optional[Dict[str, Any]] = None) -> str:
+             meta: Optional[Dict[str, Any]] = None,
+             extra_digests: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
         """Persist one checkpoint; returns its base name. Artifact names must
         be relative filenames (no separators). The manifest rename is the
-        commit point; retention prunes only after it."""
+        commit point; retention prunes only after it.
+
+        ``extra_digests`` lists artifacts written out-of-band (other
+        processes' shard files, landed via :meth:`save_artifact_only` before
+        this call) so the manifest covers them without this process ever
+        holding their bytes."""
         if not artifacts:
             raise ValueError("checkpoint needs at least one artifact")
-        for name in artifacts:
+        for name in list(artifacts) + list(extra_digests or {}):
             if os.sep in name or name.startswith(".") or not name:
                 raise ValueError(f"bad artifact name {name!r}")
         os.makedirs(self.dir, exist_ok=True)
         base = self._base(int(step))
         manifest = {"format": 1, "step": int(step), "meta": meta or {},
-                    "artifacts": {}}
+                    "artifacts": dict(extra_digests or {})}
         for name, data in artifacts.items():
             atomic_write_bytes(self._artifact_path(base, name), bytes(data))
             manifest["artifacts"][name] = _digests(bytes(data))
@@ -178,6 +184,21 @@ class CheckpointStore:
         atomic_write_text(os.path.join(self.dir, "latest"), base)
         self._prune()
         return base
+
+    def save_artifact_only(self, step: int, name: str,
+                           data: bytes) -> Dict[str, Any]:
+        """Atomically write ONE artifact file for ``step`` without committing
+        a manifest; returns its digests. Multi-process sharded checkpoints
+        use this: every process lands its own shard artifact, then process 0
+        commits the manifest via ``save(..., extra_digests=...)`` — until
+        that commit the checkpoint does not exist as far as recovery is
+        concerned."""
+        if os.sep in name or name.startswith(".") or not name:
+            raise ValueError(f"bad artifact name {name!r}")
+        os.makedirs(self.dir, exist_ok=True)
+        base = self._base(int(step))
+        atomic_write_bytes(self._artifact_path(base, name), bytes(data))
+        return _digests(bytes(data))
 
     def _prune(self) -> None:
         steps = self.steps()
@@ -209,9 +230,15 @@ class CheckpointStore:
         steps = self.steps()
         return steps[-1] if steps else None
 
-    def _load_base(self, base: str) -> Checkpoint:
+    def _load_base(self, base: str,
+                   artifact_filter: Optional[Callable[[str], bool]] = None
+                   ) -> Checkpoint:
         """Read + verify one checkpoint; raises CheckpointError on any
-        integrity failure."""
+        integrity failure. EVERY manifest artifact is verified regardless of
+        ``artifact_filter`` (so corruption anywhere still triggers fallback);
+        the filter only controls which artifacts' bytes are *retained* — a
+        host restoring a sharded checkpoint keeps just the manifest and the
+        shard files its devices need, never the full state."""
         mpath = self._manifest_path(base)
         try:
             with open(mpath, "rb") as f:
@@ -219,6 +246,8 @@ class CheckpointStore:
         except (OSError, ValueError) as e:
             raise CheckpointError(f"checkpoint {base}: unreadable manifest "
                                   f"({e})") from e
+        if not manifest.get("artifacts"):
+            raise CheckpointError(f"checkpoint {base}: empty manifest")
         arts: Dict[str, bytes] = {}
         for name, want in manifest.get("artifacts", {}).items():
             apath = self._artifact_path(base, name)
@@ -236,20 +265,24 @@ class CheckpointStore:
                         f"checkpoint {base}: artifact {name!r} failed "
                         f"{field} verification (torn write or bit rot): "
                         f"expected {want.get(field)!r}, got {got[field]!r}")
-            arts[name] = data
-        if not arts:
-            raise CheckpointError(f"checkpoint {base}: empty manifest")
+            if artifact_filter is None or artifact_filter(name):
+                arts[name] = data
         return Checkpoint(step=int(manifest.get("step", -1)), artifacts=arts,
                           meta=manifest.get("meta", {}) or {}, base=base)
 
-    def load_step(self, step: int) -> Checkpoint:
-        return self._load_base(self._base(int(step)))
+    def load_step(self, step: int,
+                  artifact_filter: Optional[Callable[[str], bool]] = None
+                  ) -> Checkpoint:
+        return self._load_base(self._base(int(step)), artifact_filter)
 
-    def load_latest(self) -> Optional[Checkpoint]:
+    def load_latest(self,
+                    artifact_filter: Optional[Callable[[str], bool]] = None
+                    ) -> Optional[Checkpoint]:
         """Newest checkpoint that VERIFIES, or None when the directory holds
         no usable checkpoint. A corrupt newest checkpoint is counted
         (``checkpoint.corrupt``) and recovery falls back to the previous
-        good one (``checkpoint.fallback``)."""
+        good one (``checkpoint.fallback``). ``artifact_filter`` bounds which
+        artifacts' bytes are kept (verification still covers all of them)."""
         if not os.path.isdir(self.dir):
             return None
         candidates: List[str] = []
@@ -270,7 +303,7 @@ class CheckpointStore:
         first_failure = None
         for i, base in enumerate(candidates):
             try:
-                ckpt = self._load_base(base)
+                ckpt = self._load_base(base, artifact_filter)
             except CheckpointError as e:
                 record_failure("checkpoint.corrupt", base=base, error=str(e))
                 if first_failure is None:
@@ -281,6 +314,277 @@ class CheckpointStore:
                                skipped=i, first_error=first_failure)
             return ckpt
         return None
+
+
+# --- sharded pytree checkpoints ---------------------------------------------
+# Format (one checkpoint step):
+#   <prefix>.sharding.json      pytree/sharding manifest: per-leaf path,
+#                               global shape, dtype, and the block table —
+#                               each block names (artifact, npz key,
+#                               [start, stop] per dim)
+#   <prefix>.shards_p<P>.npz    process P's host-local shard blocks, one
+#                               uint8 buffer per block (dtype-agnostic: raw
+#                               bytes reshaped on load, so bfloat16 params
+#                               round-trip bit-for-bit)
+# Replicated leaves collapse to a single block (written once); sharded leaves
+# contribute one block per distinct device shard, so no process ever
+# serializes state its devices do not already hold. Restore assembles only
+# the windows the *target* shardings need — which is also what makes loading
+# across a changed mesh shape (resharding) work: any saved block layout can
+# fill any requested window.
+
+def _norm_index(idx, shape):
+    """Normalize a shard ``.index`` (tuple of slices, possibly open) to
+    ((start, stop), ...) against the global ``shape``."""
+    out = []
+    for i, sl in enumerate(idx):
+        s = 0 if sl.start is None else int(sl.start)
+        e = shape[i] if sl.stop is None else int(sl.stop)
+        out.append((s, e))
+    return tuple(out)
+
+
+def _exchange_json(obj):
+    """Allgather one JSON-serializable object per process; returns the list
+    ordered by process index. Doubles as the barrier that sequences
+    every process's shard-artifact write before process 0 commits the
+    manifest. Single-process: ``[obj]``."""
+    import jax
+
+    if jax.process_count() == 1:
+        return [obj]
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    raw = json.dumps(obj, sort_keys=True).encode("utf-8")
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.asarray([len(raw)], np.int64))).reshape(-1)
+    buf = np.zeros(int(lens.max()), np.uint8)
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    rows = np.asarray(multihost_utils.process_allgather(buf[None])).reshape(
+        jax.process_count(), -1)
+    return [json.loads(rows[p, : int(lens[p])].tobytes().decode("utf-8"))
+            for p in range(jax.process_count())]
+
+
+def save_sharded_tree(store: CheckpointStore, step: int, tree,
+                      meta: Optional[Dict[str, Any]] = None,
+                      prefix: str = "state") -> str:
+    """Save a (possibly globally-sharded) pytree as per-process shard
+    artifacts plus a pytree/sharding manifest; returns the checkpoint base.
+
+    Each process packs only its devices' shard blocks into one npz; process 0
+    additionally commits the ``<prefix>.sharding.json`` manifest covering
+    every process's blocks (digests exchanged over the collective fabric), so
+    the full state never lands on one host. Goes through ``CheckpointStore``
+    — atomic writes, digest manifest as the commit point, keep-last-N."""
+    import io
+
+    import jax
+    import numpy as np
+
+    leaves_with_paths, _ = jax.tree_util.tree_flatten_with_path(tree)
+    pid = jax.process_index()
+    shard_name = f"{prefix}.shards_p{pid}.npz"
+    local_blocks: Dict[str, Any] = {}
+    my_leaves = []   # per leaf: the blocks THIS process contributes
+    leaf_heads = []  # per leaf: path/shape/dtype (identical on all processes)
+    for li, (path, leaf) in enumerate(leaves_with_paths):
+        if isinstance(leaf, jax.Array):
+            shape = tuple(int(d) for d in leaf.shape)
+            dtype = np.dtype(leaf.dtype)
+            blocks = []
+            seen = set()
+            for sh in leaf.addressable_shards:
+                win = _norm_index(sh.index, shape)
+                if win in seen:      # replicated across local devices
+                    continue
+                # a fully-replicated leaf is written by process 0 only
+                if pid != 0 and all(s == 0 and e == d
+                                    for (s, e), d in zip(win, shape)):
+                    continue
+                seen.add(win)
+                key = f"l{li}_b{len(blocks)}"
+                local_blocks[key] = np.frombuffer(
+                    np.ascontiguousarray(np.asarray(sh.data)).tobytes(),
+                    np.uint8)
+                blocks.append({"artifact": shard_name, "key": key,
+                               "index": [[s, e] for s, e in win]})
+        else:
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            shape = tuple(arr.shape)
+            dtype = arr.dtype
+            blocks = []
+            if pid == 0:             # host value: identical everywhere
+                key = f"l{li}_b0"
+                local_blocks[key] = np.frombuffer(arr.tobytes(), np.uint8)
+                blocks.append({"artifact": shard_name, "key": key,
+                               "index": [[0, d] for d in shape]})
+        my_leaves.append(blocks)
+        leaf_heads.append({"path": jax.tree_util.keystr(path),
+                           "shape": list(shape), "dtype": dtype.name})
+    buf = io.BytesIO()
+    np.savez(buf, **local_blocks)
+    npz_bytes = buf.getvalue()
+
+    if jax.process_count() > 1:
+        if pid != 0:
+            # land the shard artifact BEFORE the exchange below — the
+            # allgather is the barrier that lets process 0 commit a manifest
+            # covering files already durable on disk
+            digests = store.save_artifact_only(step, shard_name, npz_bytes)
+        else:
+            digests = _digests(npz_bytes)
+        payloads = _exchange_json({"artifact": shard_name, "digests": digests,
+                                   "leaves": my_leaves})
+        if pid != 0:
+            return store._base(int(step))
+        merged = [sum((pl["leaves"][li] for pl in payloads), [])
+                  for li in range(len(leaf_heads))]
+        extra = {pl["artifact"]: pl["digests"] for pl in payloads[1:]}
+    else:
+        merged = my_leaves
+        extra = None
+    manifest = {"format": 1, "prefix": prefix,
+                "processes": jax.process_count(),
+                "leaves": [dict(h, blocks=b)
+                           for h, b in zip(leaf_heads, merged)]}
+    return store.save(
+        int(step),
+        {f"{prefix}.sharding.json": json.dumps(
+            manifest, sort_keys=True).encode("utf-8"),
+         shard_name: npz_bytes},
+        meta=meta, extra_digests=extra)
+
+
+def load_sharded_from_checkpoint(store: CheckpointStore, ckpt: Checkpoint,
+                                 template, shardings=None,
+                                 prefix: str = "state"):
+    """Restore the pytree saved by :func:`save_sharded_tree` from an
+    already-located checkpoint (``ckpt`` needs only the manifest artifact).
+
+    ``template`` fixes the expected pytree structure and leaf shapes; any
+    mismatch raises :class:`CheckpointError` naming the leaf. With
+    ``shardings`` (a matching pytree of NamedShardings) each leaf is
+    assembled directly into a globally-sharded ``jax.Array`` via
+    ``make_array_from_callback`` — only the blocks overlapping this host's
+    target windows are read, and a saved layout restores onto any target
+    layout (resharding on load). Without it, full host numpy leaves are
+    returned."""
+    import io
+
+    import jax
+    import numpy as np
+
+    mname = f"{prefix}.sharding.json"
+    mbytes = ckpt.artifacts.get(mname)
+    if mbytes is None:
+        raise CheckpointError(
+            f"checkpoint {ckpt.base}: no sharded-tree manifest {mname!r}")
+    manifest = json.loads(mbytes.decode("utf-8"))
+    entries = manifest["leaves"]
+    tleaves, ttreedef = jax.tree_util.tree_flatten(template)
+    if len(entries) != len(tleaves):
+        raise CheckpointError(
+            f"checkpoint {ckpt.base}: saved tree has {len(entries)} leaves, "
+            f"template has {len(tleaves)} — the model/optimizer structure "
+            "changed since it was saved")
+    sleaves = (jax.tree_util.tree_leaves(shardings)
+               if shardings is not None else [None] * len(tleaves))
+    if len(sleaves) != len(tleaves):
+        raise CheckpointError(
+            f"shardings tree has {len(sleaves)} leaves, template has "
+            f"{len(tleaves)}")
+    for entry, tl in zip(entries, tleaves):
+        want = tuple(int(d) for d in np.shape(tl))
+        if tuple(entry["shape"]) != want:
+            raise CheckpointError(
+                f"checkpoint {ckpt.base}: leaf {entry['path']} has shape "
+                f"{tuple(entry['shape'])}, model expects {want}")
+
+    # read ONLY the shard artifacts whose blocks overlap a needed window
+    def _overlaps(win, bidx):
+        return all(max(s1, s2) < min(e1, e2) or (s1 == e1 == s2)
+                   for (s1, e1), (s2, e2) in zip(win, bidx))
+
+    needed = set()
+    for entry, sh in zip(entries, sleaves):
+        shape = tuple(entry["shape"])
+        if sh is None:
+            wins = [tuple((0, d) for d in shape)]
+        else:
+            wins = {_norm_index(idx, shape)
+                    for idx in (d_idx for d_idx in (
+                        sh.addressable_devices_indices_map(shape).values()))}
+        for blk in entry["blocks"]:
+            bidx = tuple((s, e) for s, e in blk["index"])
+            if any(_overlaps(w, bidx) for w in wins):
+                needed.add(blk["artifact"])
+    full = store.load_step(ckpt.step,
+                           artifact_filter=lambda n: n in needed)
+    npzs = {name: np.load(io.BytesIO(data), allow_pickle=False)
+            for name, data in full.artifacts.items()}
+
+    def _read_block(blk, dtype):
+        buf = npzs[blk["artifact"]][blk["key"]]
+        bshape = tuple(e - s for s, e in blk["index"])
+        return np.frombuffer(buf.tobytes(), dtype).reshape(bshape)
+
+    def _window(entry, win, dtype):
+        wshape = tuple(e - s for s, e in win)
+        out = np.zeros(wshape, dtype)
+        covered = 0
+        for blk in entry["blocks"]:
+            bidx = tuple((s, e) for s, e in blk["index"])
+            inter = [(max(s1, s2), min(e1, e2))
+                     for (s1, e1), (s2, e2) in zip(win, bidx)]
+            if any(s >= e for s, e in inter):
+                continue
+            if blk["artifact"] not in npzs:
+                raise CheckpointError(
+                    f"checkpoint {ckpt.base}: block in {blk['artifact']!r} "
+                    "needed but its artifact was not loaded")
+            data = _read_block(blk, dtype)
+            src = tuple(slice(s - bs, e - bs)
+                        for (s, e), (bs, _) in zip(inter, bidx))
+            dst = tuple(slice(s - ws, e - ws)
+                        for (s, e), (ws, _) in zip(inter, win))
+            out[dst] = data[src]
+            covered += int(np.prod([e - s for s, e in inter]))
+        if covered != int(np.prod(wshape)):
+            raise CheckpointError(
+                f"checkpoint {ckpt.base}: leaf {entry['path']} window {win} "
+                f"only {covered}/{int(np.prod(wshape))} elements covered — "
+                "a shard artifact from another host is missing")
+        return out
+
+    out_leaves = []
+    for entry, sh in zip(entries, sleaves):
+        shape = tuple(entry["shape"])
+        dtype = np.dtype(entry["dtype"])
+        if sh is None:
+            out_leaves.append(_window(entry, tuple((0, d) for d in shape),
+                                      dtype))
+        else:
+            out_leaves.append(jax.make_array_from_callback(
+                shape, sh,
+                lambda idx, e=entry, s2=shape, d=dtype:
+                    _window(e, _norm_index(idx, s2), d)))
+    return jax.tree_util.tree_unflatten(ttreedef, out_leaves)
+
+
+def load_sharded_tree(store: CheckpointStore, template, shardings=None,
+                      prefix: str = "state"):
+    """Latest-checkpoint convenience wrapper around
+    :func:`load_sharded_from_checkpoint`; returns ``(tree, step, meta)`` or
+    ``None`` when the store holds no usable sharded checkpoint."""
+    mname = f"{prefix}.sharding.json"
+    ckpt = store.load_latest(artifact_filter=lambda n: n == mname)
+    if ckpt is None or mname not in ckpt.artifacts:
+        return None
+    tree = load_sharded_from_checkpoint(store, ckpt, template,
+                                        shardings=shardings, prefix=prefix)
+    return tree, ckpt.step, ckpt.meta
 
 
 # --- preemption points ------------------------------------------------------

@@ -1,5 +1,13 @@
 from .backbones import BACKBONES, ResNet, TinyCNN, make_backbone, resnet18, resnet50  # noqa: F401
-from .trainer import FlaxTrainer, TrainConfig, freeze_mask  # noqa: F401
+from .backbones import (  # noqa: F401
+    StageGroup,
+    StageSequential,
+    make_staged_backbone,
+    partition_stages,
+    stage_units,
+    staged_text_encoder,
+)
+from .trainer import FlaxTrainer, TrainConfig, freeze_mask, per_device_state_bytes  # noqa: F401
 from .vision import DeepVisionClassifier, DeepVisionModel  # noqa: F401
 from .text import DeepTextClassifier, DeepTextModel, TransformerEncoder, hash_tokenize  # noqa: F401
 from .cntk import CNTKModel  # noqa: F401

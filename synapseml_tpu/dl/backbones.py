@@ -154,3 +154,214 @@ def make_backbone(name: str, num_classes: int, dtype=jnp.float32,
     if name == "tiny":
         return BACKBONES[name](num_classes=num_classes)
     return BACKBONES[name](num_classes=num_classes, dtype=dtype, small_images=small_images)
+
+
+# --- pipeline staging --------------------------------------------------------
+# MPMD pipeline parallelism (arXiv:2412.14374; dl/pipeline.py) needs the
+# backbone expressed as a SEQUENCE of units so a partitioner can cut it into
+# stages: StageSequential(stages=(StageGroup(units=...), ...)). Each stage
+# applies standalone on its own device group — the param tree nests as
+# stages_<k>/units_<j>/..., and model.stages[k] (an unbound module) can be
+# .apply'd with just its params[f"stages_{k}"] subtree.
+
+
+class StageGroup(nn.Module):
+    """One pipeline stage: a sequential run of backbone units."""
+
+    units: Any   # tuple of modules, each called as unit(x, train=...)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for u in self.units:
+            x = u(x, train=train)
+        return x
+
+
+class StageSequential(nn.Module):
+    """A backbone split into pipeline stages. Applying the whole module is
+    exactly the unsplit model (so replicated/ZeRO training and inference use
+    it unchanged); dl/pipeline.py instead runs each ``stages[k]`` as its own
+    program on its own device group."""
+
+    stages: Any  # tuple of StageGroup
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for s in self.stages:
+            x = s(x, train=train)
+        return x
+
+
+class ResNetStem(nn.Module):
+    """The ResNet stem as a standalone unit (conv + BN + relu [+ max-pool])."""
+
+    width: int = 64
+    dtype: Any = jnp.float32
+    small_images: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.small_images:
+            x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype, name="stem_conv")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="stem_bn")(x)
+        x = nn.relu(x)
+        if not self.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        return x
+
+
+class ConvReluUnit(nn.Module):
+    """TinyCNN's conv+relu as a unit (BN/dropout-free — the parity-test
+    friendly backbone)."""
+
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, (3, 3), (self.strides, self.strides),
+                    dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class PoolDenseHead(nn.Module):
+    """Global average pool + classifier head unit."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+class TextEmbedUnit(nn.Module):
+    """Token + learned positional embedding (first stage of the staged text
+    encoder)."""
+
+    vocab_size: int
+    hidden: int
+    max_len: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids, train: bool = True):
+        x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype)(ids)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (self.max_len, self.hidden))
+        return x + pos[None, : x.shape[1]].astype(x.dtype)
+
+
+class TransformerLayerUnit(nn.Module):
+    """One pre-LN transformer encoder layer as a pipeline unit. Attends over
+    the full window WITHOUT a padding mask — the activation flowing between
+    stages stays a single array (a mask would have to ride along every
+    stage), which is the right trade for the finetune-throughput benches;
+    PAD embeddings are learned instead."""
+
+    hidden: int
+    heads: int
+    mlp_dim: int
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype,
+            dropout_rate=self.dropout, deterministic=not train)(h, h)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.hidden, dtype=self.dtype)(h)
+        if self.dropout:
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class TextClsHead(nn.Module):
+    """LayerNorm + first-token (CLS) classifier head unit."""
+
+    num_classes: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0])
+
+
+def stage_units(name: str, num_classes: int, dtype=jnp.float32,
+                small_images: bool = False, width: int = 64):
+    """The sequential unit list for a vision backbone — the raw material the
+    stage partitioner groups into pipeline stages."""
+    if name == "tiny":
+        return [ConvReluUnit(16, 2), ConvReluUnit(32, 2),
+                PoolDenseHead(num_classes)]
+    specs = {"resnet18": ([2, 2, 2, 2], ResNetBlock),
+             "resnet34": ([3, 4, 6, 3], ResNetBlock),
+             "resnet50": ([3, 4, 6, 3], BottleneckBlock),
+             "resnet101": ([3, 4, 23, 3], BottleneckBlock)}
+    if name not in specs:
+        raise ValueError(
+            f"no staged form for backbone {name!r}; available: "
+            f"{sorted(specs) + ['tiny']}")
+    stage_sizes, block = specs[name]
+    units: list = [ResNetStem(width, dtype, small_images)]
+    for i, size in enumerate(stage_sizes):
+        for j in range(size):
+            strides = 2 if i > 0 and j == 0 else 1
+            units.append(block(width * 2 ** i, strides, dtype))
+    units.append(PoolDenseHead(num_classes))
+    return units
+
+
+def partition_stages(units, num_stages: int) -> StageSequential:
+    """Cut a unit list into ``num_stages`` contiguous, size-balanced stages
+    (the stage partitioner; remainder units go to the earliest stages, which
+    also carry the smaller activations in a CNN)."""
+    if not 1 <= num_stages <= len(units):
+        raise ValueError(
+            f"num_stages={num_stages} must be in [1, {len(units)}] for a "
+            f"{len(units)}-unit backbone")
+    k, m = divmod(len(units), num_stages)
+    sizes = [k + (1 if i < m else 0) for i in range(num_stages)]
+    groups, at = [], 0
+    for sz in sizes:
+        groups.append(StageGroup(tuple(units[at: at + sz])))
+        at += sz
+    return StageSequential(tuple(groups))
+
+
+def make_staged_backbone(name: str, num_classes: int, num_stages: int,
+                         dtype=jnp.float32, small_images: bool = False,
+                         width: int = 64) -> StageSequential:
+    """A vision backbone pre-cut into ``num_stages`` pipeline stages."""
+    return partition_stages(
+        stage_units(name, num_classes, dtype=dtype, small_images=small_images,
+                    width=width), num_stages)
+
+
+def staged_text_encoder(vocab_size: int, num_classes: int, num_stages: int,
+                        num_layers: int = 4, hidden: int = 128, heads: int = 4,
+                        mlp_dim: int = 0, max_len: int = 128,
+                        dropout: float = 0.0,
+                        dtype=jnp.float32) -> StageSequential:
+    """A BERT-style encoder pre-cut into pipeline stages: embed unit →
+    ``num_layers`` transformer layers → CLS head (see TransformerLayerUnit
+    for the mask-free attention trade)."""
+    units = [TextEmbedUnit(vocab_size, hidden, max_len, dtype)]
+    units += [TransformerLayerUnit(hidden, heads, mlp_dim or hidden * 4,
+                                   dropout, dtype)
+              for _ in range(num_layers)]
+    units.append(TextClsHead(num_classes, dtype))
+    return partition_stages(units, num_stages)

@@ -1,0 +1,402 @@
+"""MPMD pipeline-parallel training over the ``stage`` mesh axis.
+
+Unlike the SPMD paths (one jitted program on one mesh), pipeline mode runs
+one *program per stage group* — the MPMD style of arXiv:2412.14374: the mesh's
+``stage`` axis is split into device groups (`parallel.mesh.stage_submeshes`),
+backbone stages map onto groups circularly (stage s → group s mod G, so more
+model stages than groups share hardware round-robin), and each global batch is
+cut into microbatches that flow through a GPipe fill-drain schedule:
+
+* forward wavefront — microbatch m enters stage s at tick s+m; activations
+  hop between groups with a ``device_put`` (the ICI/DCN transfer);
+* the last stage fuses loss + backward (no bubble between its fwd and bwd);
+* backward wavefront — upstream stages RECOMPUTE their forward inside
+  ``jax.vjp`` (GPipe rematerialization: only stage *inputs* are kept alive,
+  not every intermediate), each producing its param grads and the cotangent
+  shipped to the previous group;
+* per-stage optimizer update once per global batch, gradients averaged over
+  microbatches — mathematically the full-batch step, so a BN/dropout-free
+  model matches the replicated loss trajectory to float-associativity.
+
+Within a group the *other* mesh axes survive (``data``, ``seq``), so the batch
+dimension stays sharded inside every stage and sequence parallelism composes;
+``pipeline_param_sharding="zero"`` additionally ZeRO-shards each stage's
+params/moments over the group's data axis. Dispatch is async (JAX queues the
+per-group programs; real backends overlap them), state checkpoints ride the
+sharded per-stage format of ``core.checkpoint.save_sharded_tree``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.checkpoint import (CheckpointStore, NonFiniteGuard,
+                               NonFiniteLossError, preemption_point)
+from ..core.compat import donate_argnums_if_supported
+from ..parallel.mesh import (DATA_AXIS, STAGE_AXIS, apply_tree_shardings,
+                             host_copy, stage_submeshes, tree_shardings)
+from .backbones import StageSequential
+from . import trainer as _trainer_mod
+from .trainer import (_make_tx, _restore_checkpoint, _save_checkpoint,
+                      freeze_mask, per_device_state_bytes)
+
+
+def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
+                 log_fn: Optional[Callable] = None):
+    """The ``param_sharding="pipeline"`` body of ``FlaxTrainer.fit`` (the
+    trainer dispatches here). Same contract: epoch history with loss/steps/
+    seconds, checkpoint/resume through ``cfg.checkpoint_dir`` (bit-for-bit),
+    NonFiniteGuard policies, chaos hooks."""
+    cfg = tr.cfg
+    model = tr.model
+    if not isinstance(model, StageSequential):
+        raise ValueError(
+            "param_sharding='pipeline' needs a dl.StageSequential model — "
+            "build one with dl.make_staged_backbone(...) or "
+            "dl.staged_text_encoder(...)")
+    if tr.mesh is None or STAGE_AXIS not in tr.mesh.shape:
+        raise ValueError(
+            "param_sharding='pipeline' requires a mesh with a 'stage' axis, "
+            "e.g. parallel.make_mesh({'stage': G, 'data': D})")
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "multi-process pipeline training is not wired up yet (groups "
+            "spanning hosts need per-group process coordination); use "
+            "param_sharding='zero' for multi-host runs")
+    X = np.asarray(X)
+    y = np.asarray(y)
+    if tr.params is None:
+        tr.init(X)
+
+    S = len(model.stages)
+    groups, assign = stage_submeshes(tr.mesh, S)
+    M = int(cfg.pipeline_microbatches) or len(groups)
+    if cfg.batch_size % M:
+        raise ValueError(
+            f"batch_size={cfg.batch_size} must split into "
+            f"pipeline_microbatches={M} equal microbatches")
+    mode = ("zero" if cfg.pipeline_param_sharding in ("zero", "fsdp")
+            else "replicated")
+
+    n = len(X)
+    steps_per_epoch = cfg.steps_per_epoch or max(n // cfg.batch_size, 1)
+    total_steps = steps_per_epoch * cfg.max_epochs
+    full_params = jax.tree.map(np.asarray, tr.params)
+    full_bs = jax.tree.map(np.asarray, tr.batch_stats or {})
+    mask = freeze_mask(full_params, cfg.freeze_regex)
+    compute_dtype = (jnp.bfloat16 if cfg.compute_dtype == "bfloat16"
+                     else jnp.float32)
+    loss_kind = tr.loss
+
+    # --- per-stage state, placed on its group ---------------------------
+    skey = [f"stages_{s}" for s in range(S)]
+    gmesh = [groups[assign[s]] for s in range(S)]
+    psh, bssh, osh = [], [], []
+    stage_params, stage_bs, stage_opt, txs = [], [], [], []
+    for s in range(S):
+        if skey[s] not in full_params:
+            raise ValueError(
+                f"model params have no {skey[s]!r} subtree — was the model "
+                "initialized as a StageSequential?")
+        p_s = full_params[skey[s]]
+        psh.append(tree_shardings(gmesh[s], p_s, mode))
+        stage_params.append(apply_tree_shardings(p_s, psh[s]))
+        b_s = full_bs.get(skey[s], {}) if isinstance(full_bs, dict) else {}
+        bssh.append(tree_shardings(gmesh[s], b_s, "replicated"))
+        stage_bs.append(apply_tree_shardings(b_s, bssh[s]))
+        tx_s = _make_tx(cfg, total_steps,
+                        mask[skey[s]] if mask is not None else None)
+        txs.append(tx_s)
+        o_sh = tree_shardings(gmesh[s],
+                              jax.eval_shape(tx_s.init, stage_params[s]), mode)
+        osh.append(o_sh)
+        # moments born sharded (init under jit with pinned out_shardings);
+        # one program per stage is the MPMD design, not an accidental retrace
+        init_s = jax.jit(tx_s.init, out_shardings=o_sh)  # lint-ok: recompile
+        stage_opt.append(init_s(stage_params[s]))
+
+    act_sh = [NamedSharding(gmesh[s], P(DATA_AXIS)) for s in range(S)]
+    rep = [NamedSharding(gmesh[s], P()) for s in range(S)]
+    has_bs = [bool(jax.tree.leaves(stage_bs[s])) for s in range(S)]
+
+    def cast_in(xb):
+        return (xb.astype(compute_dtype)
+                if jnp.issubdtype(xb.dtype, jnp.floating) else xb)
+
+    def stage_rng(step, s, m):
+        r = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), step)
+        return jax.random.fold_in(jax.random.fold_in(r, s), m)
+
+    def stage_apply(s, p, bs, x, rng):
+        """One stage's forward; returns (out, new_batch_stats)."""
+        variables = {"params": p}
+        rngs = {"dropout": rng}
+        if has_bs[s]:
+            variables["batch_stats"] = bs
+            out, mut = model.stages[s].apply(
+                variables, x, train=True, mutable=["batch_stats"], rngs=rngs)
+            return out, mut["batch_stats"]
+        out = model.stages[s].apply(variables, x, train=True, rngs=rngs)
+        return out, bs
+
+    def make_fwd(s):
+        def fwd(p, bs, x, step, m):
+            if s == 0:
+                x = cast_in(x)
+            return stage_apply(s, p, bs, x, stage_rng(step, s, m))
+        return jax.jit(
+            fwd,
+            in_shardings=(psh[s], bssh[s], act_sh[s], None, None),
+            out_shardings=(act_sh[s], bssh[s]))
+
+    def make_last(s):
+        wrt_x = s > 0   # stage-0 inputs may be integer token ids
+
+        def last(p, bs, x, yb, step, m):
+            rng = stage_rng(step, s, m)
+
+            def f(pp, xx):
+                if s == 0:
+                    xx = cast_in(xx)
+                logits, nb = stage_apply(s, pp, bs, xx, rng)
+                logits = logits.astype(jnp.float32)
+                if loss_kind == "softmax":
+                    loss = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, yb.astype(jnp.int32)).mean()
+                    acc = (logits.argmax(-1) == yb).mean()
+                else:
+                    loss = jnp.mean((logits.squeeze(-1) - yb) ** 2)
+                    acc = -loss
+                return loss, (acc, nb)
+
+            argnums = (0, 1) if wrt_x else 0
+            (loss, (acc, nb)), grads = jax.value_and_grad(
+                f, argnums=argnums, has_aux=True)(p, x)
+            dp, dx = grads if wrt_x else (grads, jnp.zeros((), jnp.float32))
+            return loss, acc, nb, dp, dx
+        return jax.jit(
+            last,
+            in_shardings=(psh[s], bssh[s], act_sh[s], act_sh[s], None, None),
+            out_shardings=(rep[s], rep[s], bssh[s], psh[s],
+                           act_sh[s] if wrt_x else rep[s]))
+
+    def make_bwd(s):
+        wrt_x = s > 0
+
+        def bwd(p, bs, x, gy, step, m):
+            rng = stage_rng(step, s, m)
+
+            # GPipe rematerialization: rebuild the forward from the stage
+            # INPUT under vjp instead of holding intermediates since the
+            # forward wavefront (batch stats treated as constants, exactly
+            # like the SPMD trainer's grad)
+            def f_px(pp, xx):
+                if s == 0:
+                    xx = cast_in(xx)
+                return stage_apply(s, pp, bs, xx, rng)[0]
+
+            if wrt_x:
+                _, vjp = jax.vjp(f_px, p, x)
+                dp, dx = vjp(gy)
+                return dp, dx
+            _, vjp = jax.vjp(lambda pp: f_px(pp, x), p)
+            (dp,) = vjp(gy)
+            return dp, jnp.zeros((), jnp.float32)
+        return jax.jit(
+            bwd,
+            in_shardings=(psh[s], bssh[s], act_sh[s], act_sh[s], None, None),
+            out_shardings=(psh[s], act_sh[s] if wrt_x else rep[s]))
+
+    keep_prev = cfg.nonfinite_policy != "raise"
+    donate = (donate_argnums_if_supported(0, 1)
+              if cfg.donate_buffers and not keep_prev else ())
+
+    def make_upd(s):
+        tx_s = txs[s]
+
+        def upd(p, o, g):
+            g = jax.tree.map(lambda x: x / M, g)   # mean over microbatches
+            updates, o = tx_s.update(g, o, p)
+            return optax.apply_updates(p, updates), o
+        return jax.jit(upd, donate_argnums=donate,
+                       in_shardings=(psh[s], osh[s], psh[s]),
+                       out_shardings=(psh[s], osh[s]))
+
+    fwd_fns = [make_fwd(s) for s in range(S - 1)]
+    last_fn = make_last(S - 1)
+    bwd_fns = [make_bwd(s) for s in range(S - 1)]
+    upd_fns = [make_upd(s) for s in range(S)]
+    grad_add = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
+    label_sh = act_sh[S - 1]
+
+    def pipeline_step(step_idx, xb, yb):
+        """One global batch through the fill-drain schedule; returns
+        (mean loss, mean acc) as floats. Mutates stage_params/bs/opt."""
+        step = np.int32(step_idx)
+        xmb = np.split(np.asarray(xb), M)
+        ymb = np.split(np.asarray(yb), M)
+        x_in = [[None] * M for _ in range(S)]   # kept alive for remat-bwd
+        bs_in = [[None] * M for _ in range(S)]
+        gacc = [None] * S
+        losses, accs = [], []
+        dx_last = [None] * M
+        # forward wavefront (last stage fuses loss+backward)
+        for t in range(S + M - 1):
+            for s in range(S):
+                m = t - s
+                if not 0 <= m < M:
+                    continue
+                if s == 0:
+                    xin = jax.device_put(xmb[m], act_sh[0])
+                else:
+                    xin = x_in[s][m]
+                bs_in[s][m] = stage_bs[s]
+                if s < S - 1:
+                    x_in[s][m] = xin
+                    ys, nb = fwd_fns[s](stage_params[s], stage_bs[s], xin,
+                                        step, np.int32(m))
+                    stage_bs[s] = nb
+                    # the inter-group hop (ICI/DCN): next stage's input
+                    x_in[s + 1][m] = jax.device_put(ys, act_sh[s + 1])
+                else:
+                    x_in[s][m] = xin
+                    lab = jax.device_put(ymb[m], label_sh)
+                    loss_m, acc_m, nb, dp, dx = last_fn(
+                        stage_params[s], stage_bs[s], xin, lab, step,
+                        np.int32(m))
+                    stage_bs[s] = nb
+                    gacc[s] = dp if gacc[s] is None else grad_add(gacc[s], dp)
+                    dx_last[m] = dx
+                    losses.append(loss_m)
+                    accs.append(acc_m)
+        # backward wavefront over the upstream stages
+        gy = [[None] * M for _ in range(S - 1)]
+        for m in range(M):
+            if S > 1:
+                gy[S - 2][m] = jax.device_put(dx_last[m], act_sh[S - 2])
+        for t in range(M + S - 1):
+            for s in range(S - 2, -1, -1):
+                m = t - (S - 2 - s)
+                if not 0 <= m < M or gy[s][m] is None:
+                    continue
+                dp, dx = bwd_fns[s](stage_params[s], bs_in[s][m], x_in[s][m],
+                                    gy[s][m], step, np.int32(m))
+                gacc[s] = dp if gacc[s] is None else grad_add(gacc[s], dp)
+                if s > 0:
+                    gy[s - 1][m] = jax.device_put(dx, act_sh[s - 1])
+        for s in range(S):
+            stage_params[s], stage_opt[s] = upd_fns[s](
+                stage_params[s], stage_opt[s], gacc[s])
+        return (float(np.mean([float(v) for v in losses])),
+                float(np.mean([float(v) for v in accs])))
+
+    # --- checkpoint plumbing (sharded per-stage format) -----------------
+    def as_trees():
+        return ({skey[s]: stage_params[s] for s in range(S)},
+                {skey[s]: stage_bs[s] for s in range(S)},
+                {skey[s]: stage_opt[s] for s in range(S)})
+
+    sh_trees = ({skey[s]: psh[s] for s in range(S)},
+                {skey[s]: bssh[s] for s in range(S)},
+                {skey[s]: osh[s] for s in range(S)})
+
+    def set_trees(params_tree, bs_tree, opt_tree):
+        for s in range(S):
+            stage_params[s] = params_tree[skey[s]]
+            stage_bs[s] = (bs_tree or {}).get(skey[s], {})
+            stage_opt[s] = opt_tree[skey[s]]
+
+    store = (CheckpointStore(cfg.checkpoint_dir,
+                             keep_last=max(cfg.keep_checkpoints, 1))
+             if cfg.checkpoint_dir else None)
+    start_epoch = 0
+    if store is not None and cfg.resume:
+        restored = _restore_checkpoint(store, *as_trees(),
+                                       shardings=sh_trees)
+        if restored is not None:
+            p_t, b_t, o_t, start_epoch, _placed = restored
+            set_trees(p_t, b_t, o_t)
+
+    tr.stats = {"state_bytes_per_device":
+                per_device_state_bytes(*stage_params, *stage_opt),
+                "stages": S, "groups": len(groups), "microbatches": M}
+    guard = NonFiniteGuard(policy=cfg.nonfinite_policy,
+                           counter_prefix="train")
+    history = []
+    step_idx = start_epoch * steps_per_epoch
+    epoch = start_epoch
+    while epoch < cfg.max_epochs:
+        preemption_point("dl.epoch", epoch)
+        # same derived-stream discipline as the SPMD trainer: epoch replay
+        # after resume sees the identical batch order
+        rng_e = np.random.default_rng([cfg.seed, epoch])
+        losses = []
+        nsteps = 0
+        t0 = time.perf_counter()
+        rolled_back = False
+        for i, (xb, yb) in enumerate(tr._batches(X, y, rng_e)):
+            hook = _trainer_mod._CHAOS_BATCH_HOOK
+            if hook is not None:
+                xb, yb = hook(epoch * steps_per_epoch + i, xb, yb)
+            prev = as_trees() if keep_prev else None
+            loss, acc = pipeline_step(step_idx, xb, yb)
+            action = guard.check(loss, step_idx)
+            if action == "skip":
+                set_trees(*prev)
+                step_idx += 1
+                continue
+            if action == "rollback":
+                restored = (_restore_checkpoint(store, *as_trees(),
+                                                shardings=sh_trees)
+                            if store is not None else None)
+                if restored is None:
+                    raise NonFiniteLossError(
+                        "nonfinite_policy='rollback' found no checkpoint to "
+                        "restore (set checkpoint_dir and let at least one "
+                        "epoch complete, or use policy 'skip'/'raise')")
+                p_t, b_t, o_t, epoch, _placed = restored
+                set_trees(p_t, b_t, o_t)
+                step_idx = epoch * steps_per_epoch
+                rolled_back = True
+                break
+            step_idx += 1
+            nsteps += 1
+            losses.append(loss)
+        if rolled_back:
+            continue
+        ep = {"epoch": epoch,
+              "loss": float(np.mean(losses)) if losses else float("nan"),
+              "steps": nsteps,
+              "seconds": time.perf_counter() - t0}
+        if valid is not None:
+            hp, hb = _host_state(stage_params, stage_bs, skey)
+            ep["val_acc"] = float(tr.evaluate(valid[0], valid[1],
+                                              params=hp, batch_stats=hb))
+        history.append(ep)
+        if log_fn:
+            log_fn(ep)
+        if store is not None and (epoch + 1) % cfg.save_every_epochs == 0:
+            p_t, b_t, o_t = as_trees()
+            _save_checkpoint(store, p_t, b_t, o_t, epoch + 1, sharded=True)
+        epoch += 1
+
+    tr.params, tr.batch_stats = _host_state(stage_params, stage_bs, skey)
+    tr.history = history
+    return tr
+
+
+def _host_state(stage_params, stage_bs, skey):
+    """Gather the per-stage device state into the full host param/bs trees
+    the trainer's predict/evaluate/save paths expect."""
+    params = {k: host_copy(p) for k, p in zip(skey, stage_params)}
+    bs = {k: host_copy(b) for k, b in zip(skey, stage_bs)
+          if jax.tree.leaves(b)}
+    return params, bs

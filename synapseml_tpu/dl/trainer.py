@@ -3,9 +3,19 @@
 The reference trains via horovod.spark.lightning TorchEstimator: one process per
 executor, NCCL ring allreduce of gradients, petastorm reader feeding torch
 DataLoaders (SURVEY.md §3.4). On TPU the whole stack collapses to one jitted
-train step over a named-axis mesh: the batch is sharded on ``data``, parameters
-are replicated (or sharded on ``model`` for TP — free generality the reference
-lacks, SURVEY §2.2 "NOT PRESENT"), and XLA inserts the gradient psum over ICI.
+train step over a named-axis mesh: the batch is sharded on ``data``, and the
+parameter/optimizer placement is an explicit ``in_shardings``/``out_shardings``
+contract on that jit (docs/dl-scaling.md):
+
+* ``param_sharding="replicated"`` — plain data parallel; XLA inserts the
+  gradient psum over ICI (the NCCL-ring analog).
+* ``param_sharding="zero"`` (alias ``"fsdp"``) — ZeRO-style (arXiv:2004.13336):
+  params and optimizer moments are PINNED to 1/N shards over ``data``; XLA
+  all-gathers params at use and reduce-scatters gradients, so each device
+  updates only its slice and replicated-state memory stops capping batch size.
+* ``param_sharding="pipeline"`` — MPMD pipeline parallelism over a ``stage``
+  mesh axis (arXiv:2412.14374; dl/pipeline.py): per-stage programs with a
+  GPipe microbatch schedule and circular stage→group placement.
 
 Layer freezing mirrors LitDeepVisionModel._update_transfer_learning
 (reference LitDeepVisionModel.py:56-110): a regex over parameter paths selects
@@ -16,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import time
 from typing import Callable, Iterator, Optional, Tuple
 
 import jax
@@ -28,8 +39,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.checkpoint import (CheckpointStore, NonFiniteGuard,
                                NonFiniteLossError, preemption_point)
+from ..core.compat import donate_argnums_if_supported
 from ..core.logging import record_failure
-from ..parallel.mesh import DATA_AXIS
+from ..parallel.mesh import DATA_AXIS, apply_tree_shardings, tree_shardings
 
 # Batch-corruption hook for the chaos suite (testing/chaos.py installs it):
 # called as hook(step, xb, yb) -> (xb, yb) on HOST batches before they are
@@ -66,12 +78,31 @@ class TrainConfig:
     # "raise" stops the run, "skip" drops the poisoned step, "rollback"
     # restores the last good checkpoint (requires checkpoint_dir)
     nonfinite_policy: str = "raise"
-    # parameter placement over the mesh: "replicated" (plain data-parallel)
-    # or "fsdp" (ZeRO-3-style — each param's largest divisible axis is
-    # sharded over the data axis; XLA all-gathers at use and reduce-scatters
-    # gradients, from shardings alone). The reference's Horovod stack has no
-    # sharded-parameter mode at all (SURVEY §2.2 "NOT PRESENT").
-    param_sharding: str = "replicated"  # replicated | fsdp
+    # parameter placement over the mesh (module docstring / docs/dl-scaling.md):
+    # "replicated" (plain data-parallel), "zero"/"fsdp" (ZeRO-sharded params +
+    # optimizer moments over the data axis), or "pipeline" (MPMD stages over a
+    # "stage" mesh axis; needs a dl.StageSequential model). The reference's
+    # Horovod stack has none of these (SURVEY §2.2 "NOT PRESENT").
+    param_sharding: str = "replicated"  # replicated | zero | fsdp | pipeline
+    # microbatch gradient accumulation INSIDE train_step: the global batch is
+    # split into accum_steps microbatches scanned sequentially, trading the
+    # ZeRO all-gather count against live activation memory (one gather set
+    # per step regardless of accum). batch_size must divide evenly. Note:
+    # BatchNorm stats and the dropout stream see microbatches, so accum > 1
+    # is not bit-identical to accum=1 for models with BN/dropout.
+    accum_steps: int = 1
+    # host->device input pipeline depth (_prefetch): how many future batches
+    # are sharded/device_put ahead of the step consuming them
+    prefetch_batches: int = 2
+    # donate params/opt_state buffers to the train_step jit (in-place update
+    # on TPU/GPU via core.compat.donate_argnums_if_supported; no-op on CPU).
+    # Only takes effect with nonfinite_policy="raise": "skip"/"rollback" must
+    # read the pre-step state back after the step, which donation forbids.
+    donate_buffers: bool = True
+    # pipeline mode: microbatches in flight per global batch (0 -> one per
+    # stage group) and the within-group param placement (replicated | zero)
+    pipeline_microbatches: int = 0
+    pipeline_param_sharding: str = "replicated"
 
 
 def _make_tx(cfg: TrainConfig, total_steps: int, trainable_mask=None):
@@ -143,6 +174,11 @@ class FlaxTrainer:
 
     # --- data -----------------------------------------------------------
     def _batches(self, X, y, rng: np.random.Generator) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Shuffled fixed-size batches. When ``n >= batch_size`` the epoch
+        tail (``n % batch_size`` rows) is DROPPED — every step sees a full
+        batch so jit shapes stay static and per-device shards stay equal;
+        with shuffling each epoch drops a different tail. Datasets smaller
+        than one batch train on all rows each step instead."""
         n = len(X)
         if n == 0:
             raise ValueError("cannot train on an empty dataset")
@@ -159,14 +195,18 @@ class FlaxTrainer:
             sel = idx[start: start + bs]
             yield X[sel], y[sel]
 
-    def _prefetch(self, batches, size: int = 2):
+    def _prefetch(self, batches, size: Optional[int] = None):
         """Host→device input pipelining (the petastorm-loader role,
-        TPU-style): the next ``size`` batches are sharded/device_put ahead of
-        the step that consumes them, so the transfer — expensive through a
-        tunnel, nontrivial on real HBM — overlaps the current step's compute
-        (JAX dispatch is async; holding the arrays keeps the transfers in
+        TPU-style): the next ``size`` batches (default
+        ``cfg.prefetch_batches``) are sharded/device_put ahead of the step
+        that consumes them, so the transfer — expensive through a tunnel,
+        nontrivial on real HBM — overlaps the current step's compute (JAX
+        dispatch is async; holding the arrays keeps the transfers in
         flight)."""
         from collections import deque
+
+        if size is None:
+            size = self.cfg.prefetch_batches
 
         q: deque = deque()
 
@@ -198,30 +238,18 @@ class FlaxTrainer:
             return to_global_rows(self.mesh, spec, arr)
         return jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
 
-    def _fsdp_sharding(self, x):
-        """NamedSharding putting the param's largest data-axis-divisible
-        dimension on DATA_AXIS (replicated when none divides)."""
-        ndata = self.mesh.shape[DATA_AXIS]
-        shape = getattr(x, "shape", ())
-        best = None
-        for i in sorted(range(len(shape)), key=lambda j: -shape[j]):
-            if shape[i] >= ndata and shape[i] % ndata == 0:
-                best = i
-                break
-        if best is None:
-            return NamedSharding(self.mesh, P())
-        spec = [None] * len(shape)
-        spec[best] = DATA_AXIS
-        return NamedSharding(self.mesh, P(*spec))
-
-    def _apply_fsdp(self, tree):
-        return jax.tree.map(
-            lambda x: jax.device_put(x, self._fsdp_sharding(x)), tree)
-
     # --- train ----------------------------------------------------------
     def fit(self, X, y, valid: Optional[tuple] = None,
             log_fn: Optional[Callable] = None):
         cfg = self.cfg
+        if cfg.param_sharding == "pipeline":
+            from .pipeline import fit_pipeline
+
+            return fit_pipeline(self, X, y, valid=valid, log_fn=log_fn)
+        if cfg.param_sharding not in ("replicated", "zero", "fsdp"):
+            raise ValueError(
+                f"unknown param_sharding {cfg.param_sharding!r}; expected "
+                "replicated | zero | fsdp | pipeline")
         X = np.asarray(X)
         y = np.asarray(y)
         if self.params is None:
@@ -231,6 +259,10 @@ class FlaxTrainer:
         total_steps = steps_per_epoch * cfg.max_epochs
         mask = freeze_mask(self.params, cfg.freeze_regex)
         tx = _make_tx(cfg, total_steps, mask)
+        zero = cfg.param_sharding in ("zero", "fsdp")
+        if zero and self.mesh is None:
+            raise ValueError(
+                f"param_sharding={cfg.param_sharding!r} requires a mesh")
         multiproc = self.mesh is not None and jax.process_count() > 1
         if multiproc:
             from ..parallel.mesh import (assert_equal_across_processes,
@@ -240,22 +272,33 @@ class FlaxTrainer:
             # unequal shards would desynchronize per-step collectives and
             # hang, not raise
             assert_equal_across_processes((len(X),), "local row count")
-            if cfg.param_sharding == "fsdp":
-                raise NotImplementedError(
-                    "multi-process training supports param_sharding="
-                    "'replicated' (pure data parallel) for now")
-            # identical host-side params on every process: jit replicates them
-            # onto the global mesh (committed single-device arrays would clash)
+            # identical host-side params on every process:
+            # apply_tree_shardings then places each process's blocks
+            # (committed single-device arrays would clash)
             self.params = jax.tree.map(np.asarray, self.params)
             self.batch_stats = jax.tree.map(np.asarray, self.batch_stats)
-        if cfg.param_sharding == "fsdp":
-            if self.mesh is None:
-                raise ValueError("param_sharding='fsdp' requires a mesh")
-            self.params = self._apply_fsdp(self.params)
-        opt_state = tx.init(self.params)
-        if cfg.param_sharding == "fsdp":
-            # optimizer moments inherit each param's sharding
-            opt_state = self._apply_fsdp(opt_state)
+
+        params, batch_stats = self.params, self.batch_stats or {}
+        shardings = None
+        mode = "zero" if zero else "replicated"
+        if self.mesh is not None:
+            # the explicit placement contract: params + optimizer moments
+            # pinned to their shards (ZeRO) or the full mesh (replicated);
+            # batch stats are tiny and stay replicated
+            param_sh = tree_shardings(self.mesh, params, mode)
+            bs_sh = tree_shardings(self.mesh, batch_stats, "replicated")
+            params = apply_tree_shardings(params, param_sh)
+            batch_stats = apply_tree_shardings(batch_stats, bs_sh)
+            # moments born sharded: init runs under jit with out_shardings
+            # pinned, so a full replicated copy never exists (and multi-host
+            # needs the jit anyway — eager ops on global arrays don't fly)
+            opt_sh = tree_shardings(self.mesh, jax.eval_shape(tx.init, params),
+                                    mode)
+            init_fn = jax.jit(tx.init, out_shardings=opt_sh)
+            opt_state = init_fn(params)
+            shardings = (param_sh, bs_sh, opt_sh)
+        else:
+            opt_state = tx.init(params)
 
         compute_dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
         has_bn = bool(self.batch_stats)
@@ -288,16 +331,53 @@ class FlaxTrainer:
                 acc = -loss
             return loss, (new_bs, acc)
 
-        @jax.jit
+        accum = max(int(cfg.accum_steps), 1)
+        if cfg.batch_size % accum:
+            raise ValueError(
+                f"accum_steps={accum} must divide batch_size={cfg.batch_size}")
+
         def train_step(params, batch_stats, opt_state, xb, yb, step):
             rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), step)
-            (loss, (new_bs, acc)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, batch_stats, xb, yb, rng)
+            if accum == 1:
+                (loss, (new_bs, acc)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, batch_stats, xb, yb, rng)
+            else:
+                # microbatch accumulation: grads summed in a scan carry (one
+                # optimizer update and ONE ZeRO gather set per global batch)
+                xmb = xb.reshape((accum, xb.shape[0] // accum) + xb.shape[1:])
+                ymb = yb.reshape((accum, yb.shape[0] // accum) + yb.shape[1:])
+
+                def micro(carry, inp):
+                    bs, gacc = carry
+                    xm, ym, i = inp
+                    (l_m, (bs2, a_m)), g = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params, bs, xm, ym,
+                                               jax.random.fold_in(rng, i))
+                    return (bs2, jax.tree.map(jnp.add, gacc, g)), (l_m, a_m)
+
+                (new_bs, gsum), (ls, accs) = jax.lax.scan(
+                    micro, (batch_stats, jax.tree.map(jnp.zeros_like, params)),
+                    (xmb, ymb, jnp.arange(accum)))
+                grads = jax.tree.map(lambda g: g / accum, gsum)
+                loss, acc = ls.mean(), accs.mean()
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, new_bs, opt_state, loss, acc
 
-        params, batch_stats = self.params, self.batch_stats
+        # "skip"/"rollback" read the pre-step state AFTER the step ran, so
+        # donation is only legal under the default "raise" policy
+        keep_prev = cfg.nonfinite_policy != "raise"
+        donate = (donate_argnums_if_supported(0, 2)
+                  if cfg.donate_buffers and not keep_prev else ())
+        jit_kwargs: dict = {"donate_argnums": donate}
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            row_sh = NamedSharding(self.mesh, P(DATA_AXIS))  # prefix spec
+            jit_kwargs["in_shardings"] = (param_sh, bs_sh, opt_sh,
+                                          row_sh, row_sh, None)
+            jit_kwargs["out_shardings"] = (param_sh, bs_sh, opt_sh, rep, rep)
+        train_step = jax.jit(train_step, **jit_kwargs)
+
         history = []
         step_idx = 0
         start_epoch = 0
@@ -306,14 +386,18 @@ class FlaxTrainer:
                  if cfg.checkpoint_dir else None)
         if store is not None and cfg.resume:
             restored = _restore_checkpoint(store, params, batch_stats,
-                                           opt_state)
+                                           opt_state, shardings=shardings)
             if restored is not None:
-                params, batch_stats, opt_state, start_epoch = restored
+                params, batch_stats, opt_state, start_epoch, placed = restored
+                batch_stats = batch_stats or {}
                 step_idx = start_epoch * steps_per_epoch
-                if cfg.param_sharding == "fsdp":
-                    # restored leaves are host numpy: re-apply the shardings
-                    params = self._apply_fsdp(params)
-                    opt_state = self._apply_fsdp(opt_state)
+                if shardings is not None and not placed:
+                    # legacy host-numpy restore: re-apply the placements
+                    params = apply_tree_shardings(params, param_sh)
+                    batch_stats = apply_tree_shardings(batch_stats, bs_sh)
+                    opt_state = apply_tree_shardings(opt_state, opt_sh)
+        self.stats = {"state_bytes_per_device":
+                      per_device_state_bytes(params, opt_state)}
         guard = NonFiniteGuard(policy=cfg.nonfinite_policy,
                                counter_prefix="train")
 
@@ -332,10 +416,12 @@ class FlaxTrainer:
             # exact batch order of the uninterrupted run
             rng_e = np.random.default_rng([cfg.seed, epoch])
             losses = []
+            nsteps = 0
+            t0 = time.perf_counter()
             rolled_back = False
             for xb, yb in self._prefetch(
                     batches_with_chaos(rng_e, epoch * steps_per_epoch)):
-                prev = (params, batch_stats, opt_state)
+                prev = (params, batch_stats, opt_state) if keep_prev else None
                 params, batch_stats, opt_state, loss, acc = train_step(
                     params, batch_stats, opt_state, xb, yb, step_idx)
                 action = guard.check(float(loss), step_idx)
@@ -346,26 +432,32 @@ class FlaxTrainer:
                     step_idx += 1
                     continue
                 if action == "rollback":
-                    restored = (_restore_checkpoint(store, *prev)
+                    restored = (_restore_checkpoint(store, *prev,
+                                                    shardings=shardings)
                                 if store is not None else None)
                     if restored is None:
                         raise NonFiniteLossError(
                             "nonfinite_policy='rollback' found no checkpoint "
                             "to restore (set checkpoint_dir and let at least "
                             "one epoch complete, or use policy 'skip'/'raise')")
-                    params, batch_stats, opt_state, epoch = restored
-                    if cfg.param_sharding == "fsdp":
-                        params = self._apply_fsdp(params)
-                        opt_state = self._apply_fsdp(opt_state)
+                    params, batch_stats, opt_state, epoch, placed = restored
+                    batch_stats = batch_stats or {}
+                    if shardings is not None and not placed:
+                        params = apply_tree_shardings(params, param_sh)
+                        batch_stats = apply_tree_shardings(batch_stats, bs_sh)
+                        opt_state = apply_tree_shardings(opt_state, opt_sh)
                     step_idx = epoch * steps_per_epoch
                     rolled_back = True
                     break
                 step_idx += 1
+                nsteps += 1
                 losses.append(float(loss))
             if rolled_back:
                 continue
             ep = {"epoch": epoch,
-                  "loss": float(np.mean(losses)) if losses else float("nan")}
+                  "loss": float(np.mean(losses)) if losses else float("nan"),
+                  "steps": nsteps,
+                  "seconds": time.perf_counter() - t0}
             if valid is not None:
                 ep["val_acc"] = float(self.evaluate(valid[0], valid[1],
                                                     params=params, batch_stats=batch_stats))
@@ -374,7 +466,7 @@ class FlaxTrainer:
                 log_fn(ep)
             if store is not None and (epoch + 1) % cfg.save_every_epochs == 0:
                 _save_checkpoint(store, params, batch_stats, opt_state,
-                                 epoch + 1)
+                                 epoch + 1, sharded=zero)
             epoch += 1
         self.params, self.batch_stats = params, batch_stats
         self.history = history
@@ -429,11 +521,45 @@ class FlaxTrainer:
         return -float(np.mean((logits.squeeze(-1) - np.asarray(y)) ** 2))
 
 
+def per_device_state_bytes(*trees) -> int:
+    """Max over devices of the live state bytes resident per device, computed
+    from each leaf's sharding (``shard_shape`` × itemsize). Allocator-stat
+    independent, so it works on the forked-CPU test mesh where there is no
+    HBM accounting — this is the number the ZeRO memory guard in ci.sh
+    asserts on. Host (non-jax) leaves are ignored."""
+    per_dev: dict = {}
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            if not isinstance(leaf, jax.Array):
+                continue
+            nbytes = (int(np.prod(leaf.sharding.shard_shape(leaf.shape)))
+                      * leaf.dtype.itemsize)
+            for d in leaf.sharding.device_set:
+                per_dev[d] = per_dev.get(d, 0) + nbytes
+    return max(per_dev.values()) if per_dev else 0
+
+
 def _save_checkpoint(store: CheckpointStore, params, batch_stats, opt_state,
-                     epoch: int) -> None:
-    """Epoch checkpoint (params + optimizer + batch stats) as one flax
-    msgpack artifact in the CheckpointStore — atomic write, digest manifest,
-    keep-last-N retention (the Lightning-checkpoint analog, hardened)."""
+                     epoch: int, sharded: bool = False) -> None:
+    """Epoch checkpoint (params + optimizer + batch stats) through the
+    CheckpointStore — atomic write, digest manifest, keep-last-N retention
+    (the Lightning-checkpoint analog, hardened).
+
+    ``sharded=False`` writes one flax msgpack blob (replicated state).
+    ``sharded=True`` writes the per-shard format of
+    ``core.checkpoint.save_sharded_tree``: one npz of host-local shard blocks
+    per process plus a pytree/sharding manifest, so ZeRO/pipeline state is
+    saved without ever materializing a full copy on one host."""
+    if sharded:
+        from ..core.checkpoint import save_sharded_tree
+
+        save_sharded_tree(
+            store, epoch,
+            {"params": params, "batch_stats": batch_stats or {},
+             "opt_state": opt_state},
+            meta={"kind": "dl-trainer", "epoch": int(epoch),
+                  "format": "sharded"})
+        return
     from flax.serialization import to_bytes
 
     blob = to_bytes({"params": params, "batch_stats": batch_stats or {},
@@ -443,17 +569,47 @@ def _save_checkpoint(store: CheckpointStore, params, batch_stats, opt_state,
 
 
 def _restore_checkpoint(store: CheckpointStore, params, batch_stats,
-                        opt_state):
-    """(params, batch_stats, opt_state, next_epoch) from the newest VERIFIED
-    checkpoint, or None when the dir holds no usable one (missing, torn, or
-    corrupt snapshots are counted and skipped by the store). A checkpoint
-    whose pytree no longer matches the model raises a ValueError naming the
-    fix instead of returning garbage params."""
-    from flax.serialization import from_bytes
-
-    ckpt = store.load_latest()
+                        opt_state, shardings=None):
+    """(params, batch_stats, opt_state, next_epoch, placed) from the newest
+    VERIFIED checkpoint, or None when the dir holds no usable one (missing,
+    torn, or corrupt snapshots are counted and skipped by the store).
+    ``placed`` says whether the leaves are already globally-sharded arrays
+    (sharded-format restore with target ``shardings`` — resharding on load
+    handles a changed mesh shape) or host numpy (legacy msgpack). A
+    checkpoint whose pytree no longer matches the model raises a ValueError
+    naming the fix instead of returning garbage params."""
+    # the probe keeps only the small artifacts; shard npz files are verified
+    # but not retained until the sharded loader knows which blocks it needs
+    ckpt = store.load_latest(artifact_filter=lambda n: n in (
+        "state.msgpack", "state.sharding.json"))
     if ckpt is None:
         return None
+    template = {"params": params, "batch_stats": batch_stats or {},
+                "opt_state": opt_state}
+    if "state.sharding.json" in ckpt.artifacts:
+        from ..core.checkpoint import (CheckpointError,
+                                       load_sharded_from_checkpoint)
+
+        sh_tree = None
+        if shardings is not None:
+            param_sh, bs_sh, opt_sh = shardings
+            sh_tree = {"params": param_sh, "batch_stats": bs_sh or {},
+                       "opt_state": opt_sh}
+        try:
+            tree = load_sharded_from_checkpoint(store, ckpt, template,
+                                                shardings=sh_tree)
+        except CheckpointError as e:
+            record_failure("checkpoint.pytree_mismatch", base=ckpt.base,
+                           error=str(e)[:200])
+            raise ValueError(
+                f"checkpoint {ckpt.base} in {store.dir} does not match the "
+                "current model/optimizer structure (architecture or "
+                f"optimizer changed since it was saved): {e}. Delete the "
+                "checkpoint directory or set resume=False to train from "
+                "scratch") from e
+        epoch = int(ckpt.meta.get("epoch", ckpt.step))
+        return (tree["params"], tree["batch_stats"] or None,
+                tree["opt_state"], epoch, sh_tree is not None)
     blob_bytes = ckpt.artifacts.get("state.msgpack")
     if blob_bytes is None:
         record_failure("checkpoint.pytree_mismatch", base=ckpt.base,
@@ -462,15 +618,14 @@ def _restore_checkpoint(store: CheckpointStore, params, batch_stats,
             f"checkpoint {ckpt.base} in {store.dir} has no trainer state "
             "artifact — it was written by something else; point "
             "checkpoint_dir at a fresh directory")
-    template = {"params": params, "batch_stats": batch_stats or {},
-                "opt_state": opt_state, "epoch": 0}
+    from flax.serialization import from_bytes
+
+    template["epoch"] = 0
     try:
         blob = from_bytes(template, blob_bytes)
         # from_bytes matches names, not shapes: a head that changed width
         # restores "successfully" with wrong-shaped arrays. Compare leaf
         # shapes explicitly so the failure is loud and immediate.
-        import jax
-
         for cur, new in zip(jax.tree_util.tree_leaves(template["params"]),
                             jax.tree_util.tree_leaves(blob["params"])):
             if getattr(cur, "shape", None) != getattr(new, "shape", None):
@@ -486,7 +641,7 @@ def _restore_checkpoint(store: CheckpointStore, params, batch_stats,
             f"changed since it was saved): {e}. Delete the checkpoint "
             "directory or set resume=False to train from scratch") from e
     return (blob["params"], blob["batch_stats"] or None, blob["opt_state"],
-            int(blob["epoch"]))
+            int(blob["epoch"]), False)
 
 
 def softmax_np(logits: np.ndarray) -> np.ndarray:

@@ -3,12 +3,18 @@ from .mesh import (  # noqa: F401
     MODEL_AXIS,
     SEQ_AXIS,
     EXPERT_AXIS,
+    STAGE_AXIS,
     initialize_distributed,
     make_mesh,
     data_sharding,
     replicated,
     shard_rows,
     process_topology,
+    zero_sharding,
+    tree_shardings,
+    apply_tree_shardings,
+    host_copy,
+    stage_submeshes,
 )
 from .ulysses import ulysses_self_attention  # noqa: F401
 from .ring_attention import (  # noqa: F401

@@ -14,6 +14,10 @@ Canonical axis names (fixed across the framework so shardings compose):
   ``model`` — tensor parallelism (not in the reference; free on TPU, SURVEY §2.2)
   ``seq``   — sequence/context parallelism (ring attention, §5.7 stance)
   ``expert``— expert parallelism
+  ``stage`` — MPMD pipeline stages (arXiv:2412.14374): each index of the axis
+              is a device *group* running its own jitted program; the trainer
+              maps backbone stages onto groups circularly (stage s → group
+              s mod G) and microbatches flow between groups
 """
 
 from __future__ import annotations
@@ -29,6 +33,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
+STAGE_AXIS = "stage"
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
@@ -153,6 +158,107 @@ def local_cpu_devices(n: int = 8):
             "XLA_FLAGS=--xla_force_host_platform_device_count={n} JAX_PLATFORMS=cpu "
             "before importing jax (see tests/conftest.py)")
     yield jax.devices()[:n]
+
+
+def zero_sharding(mesh: Mesh, x, axis: str = DATA_AXIS) -> NamedSharding:
+    """ZeRO-style placement for one array (arXiv:2004.13336, native to XLA
+    SPMD): the largest dimension divisible by the ``axis`` size is sharded
+    over that axis, everything else replicated. Arrays with no divisible
+    dimension (biases smaller than the axis, scalars) stay replicated — XLA
+    all-gathers sharded params at use and reduce-scatters their gradients
+    purely from these shardings."""
+    nshard = mesh.shape[axis]
+    shape = getattr(x, "shape", ())
+    best = None
+    for i in sorted(range(len(shape)), key=lambda j: -shape[j]):
+        if shape[i] >= nshard and shape[i] % nshard == 0:
+            best = i
+            break
+    if best is None:
+        return NamedSharding(mesh, P())
+    spec = [None] * len(shape)
+    spec[best] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def tree_shardings(mesh: Mesh, tree, mode: str = "replicated",
+                   axis: str = DATA_AXIS):
+    """A pytree of NamedShardings matching ``tree``: ``"zero"``/``"fsdp"``
+    gives each leaf its :func:`zero_sharding`; ``"replicated"`` pins every
+    leaf to the full mesh unsharded. Feed the result to
+    ``jax.jit(in_shardings=..., out_shardings=...)`` and
+    :func:`apply_tree_shardings`."""
+    if mode in ("zero", "fsdp"):
+        return jax.tree.map(lambda x: zero_sharding(mesh, x, axis), tree)
+    if mode != "replicated":
+        raise ValueError(f"unknown sharding mode {mode!r}")
+    return jax.tree.map(lambda x: NamedSharding(mesh, P()), tree)
+
+
+def apply_tree_shardings(tree, shardings):
+    """Place every leaf of ``tree`` per the matching NamedSharding in
+    ``shardings`` and return the globally-sharded pytree.
+
+    Single-process this is a plain (re)``device_put``. Multi-process, leaves
+    must be host-replicated numpy (identical on every process — the trainer
+    guarantees this); each process contributes only the blocks its local
+    devices own via ``make_array_from_callback``, so no device ever holds a
+    full copy of a sharded leaf."""
+    multiproc = jax.process_count() > 1
+
+    def place(x, sh):
+        if multiproc:
+            host = np.asarray(x)
+            return jax.make_array_from_callback(
+                host.shape, sh, lambda idx, h=host: h[idx])
+        return jax.device_put(x, sh)
+
+    return jax.tree.map(place, tree, shardings)
+
+
+def host_copy(tree):
+    """Host (numpy) copy of a possibly globally-sharded pytree. Multi-process,
+    sharded leaves are gathered with ``process_allgather`` so every host gets
+    the full arrays; single-process ``np.asarray`` assembles across local
+    devices."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return jax.tree.map(
+            lambda a: np.asarray(
+                multihost_utils.process_allgather(a, tiled=True)), tree)
+    return jax.tree.map(lambda a: np.asarray(a), tree)
+
+
+def stage_submeshes(mesh: Mesh, num_stages: int):
+    """Split a mesh with a ``stage`` axis into per-group submeshes for MPMD
+    pipeline parallelism, plus the circular stage→group assignment.
+
+    Returns ``(groups, assignment)``: ``groups[g]`` is a Mesh over the
+    devices at stage-axis index ``g`` keeping every *other* axis (so
+    ``data``/``seq`` parallelism composes inside each stage), and
+    ``assignment[s] = s % len(groups)`` — the circular/looped placement of
+    arXiv:2412.14374, which lets more model stages than device groups share
+    hardware round-robin."""
+    if STAGE_AXIS not in mesh.shape:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)} has no {STAGE_AXIS!r} axis; build one "
+            "with make_mesh({'stage': G, 'data': D})")
+    if num_stages < 1:
+        raise ValueError(f"num_stages must be >= 1, got {num_stages}")
+    k = mesh.axis_names.index(STAGE_AXIS)
+    names = tuple(n for n in mesh.axis_names if n != STAGE_AXIS)
+    groups = []
+    for g in range(mesh.shape[STAGE_AXIS]):
+        sub = np.take(mesh.devices, g, axis=k)
+        if not names:
+            # stage-only mesh: give each group a singleton data axis so
+            # activation shardings (P("data", ...)) stay well-formed
+            groups.append(Mesh(sub.reshape(1), (DATA_AXIS,)))
+        else:
+            groups.append(Mesh(sub, names))
+    assignment = [s % len(groups) for s in range(num_stages)]
+    return groups, assignment
 
 
 def process_topology() -> dict:

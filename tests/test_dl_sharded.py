@@ -1,0 +1,356 @@
+"""ZeRO-sharded training, pipeline parallelism, sharded checkpoints (ISSUE 9).
+
+Runs on the forked 8-CPU-device mesh from conftest. The load-bearing claims:
+
+* zero/pipeline modes reproduce the replicated loss trajectory (same math,
+  different placement) to <= 1e-5;
+* ZeRO actually shards: per-device state bytes <= 0.6x replicated, and
+  param/moment leaves are physically distributed;
+* kill->resume through the per-shard checkpoint format is bit-for-bit, and a
+  checkpoint written on one mesh shape restores onto another (resharding on
+  load);
+* structure mismatches fail loudly with the pytree_mismatch counter bumped.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from synapseml_tpu import dl, parallel
+from synapseml_tpu.core.checkpoint import (CheckpointError, CheckpointStore,
+                                           PreemptionError, load_sharded_tree,
+                                           save_sharded_tree)
+from synapseml_tpu.core.logging import failure_counts, reset_failure_counts
+from synapseml_tpu.dl.backbones import partition_stages, stage_units
+from synapseml_tpu.parallel.mesh import stage_submeshes, tree_shardings
+from synapseml_tpu.testing import ChaosPreemption
+
+
+def _data(n=64, num_classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, num_classes, size=n)
+    return X, y
+
+
+def _cfg(**kw):
+    base = dict(batch_size=16, max_epochs=3, learning_rate=1e-2, seed=7)
+    base.update(kw)
+    return dl.TrainConfig(**base)
+
+
+def _losses(tr):
+    return [e["loss"] for e in tr.history]
+
+
+class TestZero:
+    def test_parity_and_memory(self, eight_devices):
+        X, y = _data()
+        mesh = parallel.make_mesh({"data": 8})
+        rep = dl.FlaxTrainer(dl.make_backbone("tiny", 4), _cfg(), mesh=mesh)
+        rep.fit(X, y)
+        zero = dl.FlaxTrainer(dl.make_backbone("tiny", 4),
+                              _cfg(param_sharding="zero"), mesh=mesh)
+        zero.fit(X, y)
+        np.testing.assert_allclose(_losses(zero), _losses(rep), atol=1e-5)
+        # the memory claim the ci.sh guard also enforces
+        assert (zero.stats["state_bytes_per_device"]
+                <= 0.6 * rep.stats["state_bytes_per_device"])
+
+    def test_state_actually_sharded(self, eight_devices):
+        X, y = _data()
+        mesh = parallel.make_mesh({"data": 8})
+        tr = dl.FlaxTrainer(dl.make_backbone("tiny", 4),
+                            _cfg(param_sharding="zero", max_epochs=1),
+                            mesh=mesh)
+        tr.fit(X, y)
+        # fit leaves host numpy on tr.params; re-derive the placement spec
+        # and check at least the big leaves split over the data axis
+        sh = tree_shardings(mesh, tr.params, "zero")
+        split = [s for s in jax.tree.leaves(sh)
+                 if s.spec != P()]
+        assert split, "no parameter leaf was sharded under zero mode"
+
+    def test_accum_steps_parity(self, eight_devices):
+        X, y = _data()
+        mesh = parallel.make_mesh({"data": 8})
+        one = dl.FlaxTrainer(dl.make_backbone("tiny", 4), _cfg(), mesh=mesh)
+        one.fit(X, y)
+        four = dl.FlaxTrainer(dl.make_backbone("tiny", 4),
+                              _cfg(accum_steps=4, param_sharding="zero"),
+                              mesh=mesh)
+        four.fit(X, y)
+        # BN/dropout-free model: sum of microbatch grads == full-batch grad
+        np.testing.assert_allclose(_losses(four), _losses(one), atol=1e-5)
+
+    def test_bad_accum_rejected(self, eight_devices):
+        X, y = _data()
+        mesh = parallel.make_mesh({"data": 8})
+        tr = dl.FlaxTrainer(dl.make_backbone("tiny", 4),
+                            _cfg(accum_steps=5), mesh=mesh)
+        with pytest.raises(ValueError, match="accum_steps"):
+            tr.fit(X, y)
+
+    def test_unknown_sharding_rejected(self, eight_devices):
+        X, y = _data()
+        tr = dl.FlaxTrainer(dl.make_backbone("tiny", 4),
+                            _cfg(param_sharding="zorro"),
+                            mesh=parallel.make_mesh({"data": 8}))
+        with pytest.raises(ValueError, match="param_sharding"):
+            tr.fit(X, y)
+
+
+class TestZeroCheckpoints:
+    def _run(self, mesh, d=None, max_epochs=4, **kw):
+        kw.setdefault("param_sharding", "zero")
+        tr = dl.FlaxTrainer(
+            dl.make_backbone("tiny", 4),
+            _cfg(max_epochs=max_epochs, checkpoint_dir=d, **kw),
+            mesh=mesh)
+        return tr
+
+    def test_kill_resume_bit_equal(self, eight_devices, tmp_path):
+        X, y = _data()
+        mesh = parallel.make_mesh({"data": 8})
+        ref = self._run(mesh).fit(X, y)
+        d = str(tmp_path / "ck")
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"dl.epoch": [2]}):
+                self._run(mesh, d).fit(X, y)
+        # the interrupted run wrote the sharded format, not a msgpack blob
+        store = CheckpointStore(d)
+        ckpt = store.load_latest()
+        assert "state.sharding.json" in ckpt.artifacts
+        assert "state.msgpack" not in ckpt.artifacts
+        assert any(n.startswith("state.shards_p") for n in ckpt.artifacts)
+        resumed = self._run(mesh, d).fit(X, y)
+        np.testing.assert_array_equal(ref.predict_logits(X),
+                                      resumed.predict_logits(X))
+
+    def test_restore_across_mesh_shape(self, eight_devices, tmp_path):
+        """A checkpoint saved on data=8 restores onto data=4 (resharding on
+        load). The restored state itself is bit-identical; the continued
+        trajectory matches to float-reduction tolerance (psum order over 4
+        devices differs from 8)."""
+        X, y = _data()
+        d = str(tmp_path / "ck")
+        big = self._run(parallel.make_mesh({"data": 8}), d, max_epochs=2)
+        big.fit(X, y)
+        # restore-only on the smaller mesh: max_epochs == saved epoch, so fit
+        # reshards the checkpoint and exits without training a step
+        small = self._run(parallel.make_mesh({"data": 4}), d, max_epochs=2)
+        small.fit(X, y)
+        for a, b in zip(jax.tree.leaves(big.params),
+                        jax.tree.leaves(small.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        ref = self._run(parallel.make_mesh({"data": 4}), max_epochs=3)
+        ref.fit(X, y)
+        cont = self._run(parallel.make_mesh({"data": 4}), d, max_epochs=3)
+        cont.fit(X, y)
+        # epochs 0-1 ran on data=8, epoch 2 on data=4: same math, different
+        # reduction order — trajectory agrees to tolerance, not bitwise
+        np.testing.assert_allclose(cont.history[-1]["loss"],
+                                   ref.history[-1]["loss"], atol=1e-4)
+
+    def test_freeze_regex_survives_resume(self, eight_devices, tmp_path):
+        X, y = _data()
+        mesh = parallel.make_mesh({"data": 8})
+        kw = dict(param_sharding="fsdp", freeze_regex="Conv_0")
+        d = str(tmp_path / "ck")
+        tr0 = self._run(mesh, d, max_epochs=2, **kw)
+        tr0.fit(X, y)
+        frozen0 = np.asarray(jax.tree.leaves(tr0.params)[0])
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"dl.epoch": [3]}):
+                self._run(mesh, d, max_epochs=4, **kw).fit(X, y)
+        tr1 = self._run(mesh, d, max_epochs=4, **kw)
+        tr1.fit(X, y)
+        # identify the frozen leaf by path and confirm it never moved
+        from flax import traverse_util
+        flat0 = traverse_util.flatten_dict(tr0.params)
+        flat1 = traverse_util.flatten_dict(tr1.params)
+        froze = [k for k in flat0 if "Conv_0" in "/".join(map(str, k))]
+        assert froze
+        for k in froze:
+            np.testing.assert_array_equal(np.asarray(flat0[k]),
+                                          np.asarray(flat1[k]))
+        del frozen0
+
+    def test_shape_mismatch_is_loud(self, eight_devices, tmp_path):
+        X, y = _data()
+        mesh = parallel.make_mesh({"data": 8})
+        d = str(tmp_path / "ck")
+        self._run(mesh, d, max_epochs=2).fit(X, y)
+        reset_failure_counts()
+        wrong = dl.FlaxTrainer(
+            dl.make_backbone("tiny", 7),   # head width changed
+            _cfg(param_sharding="zero", max_epochs=3, checkpoint_dir=d),
+            mesh=mesh)
+        with pytest.raises(ValueError, match="resume=False"):
+            wrong.fit(X, y)
+        assert failure_counts().get("checkpoint.pytree_mismatch", 0) >= 1
+
+
+class TestPipeline:
+    def _staged(self):
+        return dl.make_staged_backbone("tiny", num_classes=4, num_stages=2)
+
+    def test_parity_with_replicated(self, eight_devices):
+        X, y = _data()
+        model = self._staged()
+        rep = dl.FlaxTrainer(model, _cfg(),
+                             mesh=parallel.make_mesh({"data": 8}))
+        rep.fit(X, y)
+        pipe = dl.FlaxTrainer(
+            model, _cfg(param_sharding="pipeline", pipeline_microbatches=2),
+            mesh=parallel.make_mesh({"stage": 2, "data": 4}))
+        pipe.fit(X, y)
+        np.testing.assert_allclose(_losses(pipe), _losses(rep), atol=1e-5)
+        assert pipe.stats["stages"] == 2 and pipe.stats["groups"] == 2
+
+    def test_circular_placement_more_stages_than_groups(self, eight_devices):
+        """4 model stages on 2 stage groups: stage s -> group s % 2."""
+        X, y = _data()
+        model = dl.make_staged_backbone("tiny", num_classes=4, num_stages=3)
+        rep = dl.FlaxTrainer(model, _cfg(max_epochs=2),
+                             mesh=parallel.make_mesh({"data": 8}))
+        rep.fit(X, y)
+        pipe = dl.FlaxTrainer(
+            model, _cfg(max_epochs=2, param_sharding="pipeline",
+                        pipeline_microbatches=2,
+                        pipeline_param_sharding="zero"),
+            mesh=parallel.make_mesh({"stage": 2, "data": 4}))
+        pipe.fit(X, y)
+        np.testing.assert_allclose(_losses(pipe), _losses(rep), atol=1e-5)
+
+    @pytest.mark.slow   # ~7s: 2-stage transformer compile; ci.sh's dl
+    # scaling guard runs this file unfiltered, so the path stays covered
+    def test_text_pipeline_runs(self, eight_devices):
+        rng = np.random.default_rng(1)
+        X = rng.integers(0, 128, size=(32, 16)).astype(np.int32)
+        y = rng.integers(0, 2, size=32)
+        model = dl.staged_text_encoder(vocab_size=128, num_classes=2,
+                                       num_stages=2, num_layers=2, hidden=32,
+                                       heads=2, max_len=16)
+        tr = dl.FlaxTrainer(
+            model, _cfg(batch_size=16, max_epochs=2,
+                        param_sharding="pipeline", pipeline_microbatches=2),
+            mesh=parallel.make_mesh({"stage": 2, "data": 4}))
+        tr.fit(X, y)
+        assert np.isfinite(_losses(tr)).all()
+        assert 0.0 <= tr.evaluate(X, y) <= 1.0
+
+    def test_kill_resume_bit_equal(self, eight_devices, tmp_path):
+        X, y = _data()
+        model = self._staged()
+        mk = lambda d=None: dl.FlaxTrainer(
+            model, _cfg(max_epochs=4, param_sharding="pipeline",
+                        pipeline_microbatches=2, checkpoint_dir=d),
+            mesh=parallel.make_mesh({"stage": 2, "data": 4}))
+        ref = mk().fit(X, y)
+        d = str(tmp_path / "ck")
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"dl.epoch": [2]}):
+                mk(d).fit(X, y)
+        resumed = mk(d).fit(X, y)
+        np.testing.assert_array_equal(ref.predict_logits(X),
+                                      resumed.predict_logits(X))
+
+    def test_requires_staged_model_and_stage_axis(self, eight_devices):
+        X, y = _data()
+        tr = dl.FlaxTrainer(dl.make_backbone("tiny", 4),
+                            _cfg(param_sharding="pipeline"),
+                            mesh=parallel.make_mesh({"stage": 2, "data": 4}))
+        with pytest.raises(ValueError, match="StageSequential"):
+            tr.fit(X, y)
+        tr = dl.FlaxTrainer(self._staged(), _cfg(param_sharding="pipeline"),
+                            mesh=parallel.make_mesh({"data": 8}))
+        with pytest.raises(ValueError, match="stage"):
+            tr.fit(X, y)
+
+
+class TestStaging:
+    def test_partition_stages_balanced_contiguous(self):
+        units = stage_units("resnet18", num_classes=10)
+        seq = partition_stages(units, 3)
+        sizes = [len(s.units) for s in seq.stages]
+        assert sum(sizes) == len(units)
+        assert max(sizes) - min(sizes) <= 1
+        # contiguity: concatenation in order reproduces the unit list
+        flat = [u for s in seq.stages for u in s.units]
+        assert [type(u) for u in flat] == [type(u) for u in units]
+
+    def test_staged_equals_unsplit_forward(self, eight_devices):
+        X, _ = _data(8)
+        model = dl.make_staged_backbone("tiny", num_classes=4, num_stages=2)
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(X),
+                               train=False)
+        whole = model.apply(variables, jnp.asarray(X), train=False)
+        h = jnp.asarray(X)
+        for s, stage in enumerate(model.stages):
+            h = stage.apply({"params": variables["params"][f"stages_{s}"]},
+                            h, train=False)
+        np.testing.assert_allclose(np.asarray(whole), np.asarray(h),
+                                   rtol=1e-6)
+
+    def test_stage_submeshes(self, eight_devices):
+        mesh = parallel.make_mesh({"stage": 4, "data": 2})
+        groups, assign = stage_submeshes(mesh, 6)
+        assert len(groups) == 4 and assign == [0, 1, 2, 3, 0, 1]
+        for g in groups:
+            assert "stage" not in g.shape and g.shape["data"] == 2
+        seen = set()
+        for g in groups:
+            devs = {d.id for d in g.devices.flat}
+            assert not devs & seen   # groups are disjoint
+            seen |= devs
+        with pytest.raises(ValueError):
+            stage_submeshes(parallel.make_mesh({"data": 8}), 2)
+
+
+class TestShardedStoreRoundtrip:
+    def _tree(self):
+        rng = np.random.default_rng(3)
+        return {"w": rng.normal(size=(16, 4)).astype(np.float32),
+                "b": rng.normal(size=(4,)).astype(np.float32),
+                "n": {"scale": rng.normal(size=(16,)).astype(np.bfloat16
+                      if hasattr(np, "bfloat16") else np.float32)}}
+
+    def test_roundtrip_and_reshard(self, eight_devices, tmp_path):
+        host = jax.tree.map(np.asarray, self._tree())
+        mesh8 = parallel.make_mesh({"data": 8})
+        sh8 = tree_shardings(mesh8, host, "zero")
+        placed = parallel.apply_tree_shardings(host, sh8)
+        store = CheckpointStore(str(tmp_path / "s"))
+        save_sharded_tree(store, 1, placed)
+        # reload onto a DIFFERENT mesh shape
+        mesh4 = parallel.make_mesh({"data": 4})
+        sh4 = tree_shardings(mesh4, host, "zero")
+        out = load_sharded_tree(store, placed, shardings=sh4)
+        assert out is not None
+        tree, step, _meta = out
+        assert step == 1
+        for a, b in zip(jax.tree.leaves(host), jax.tree.leaves(tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # and onto the host (no shardings): plain numpy
+        tree_h, _, _ = load_sharded_tree(store, placed)
+        for a, b in zip(jax.tree.leaves(host), jax.tree.leaves(tree_h)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_template_mismatch_raises(self, eight_devices, tmp_path):
+        host = jax.tree.map(np.asarray, self._tree())
+        mesh = parallel.make_mesh({"data": 8})
+        placed = parallel.apply_tree_shardings(
+            host, tree_shardings(mesh, host, "zero"))
+        store = CheckpointStore(str(tmp_path / "s"))
+        save_sharded_tree(store, 1, placed)
+        bad = dict(host)
+        bad["w"] = np.zeros((16, 5), np.float32)
+        ckpt = store.load_latest(
+            artifact_filter=lambda n: n.endswith(".sharding.json"))
+        from synapseml_tpu.core.checkpoint import load_sharded_from_checkpoint
+        with pytest.raises(CheckpointError, match="shape"):
+            load_sharded_from_checkpoint(store, ckpt, bad)

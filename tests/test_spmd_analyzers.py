@@ -417,6 +417,29 @@ def test_sharding_host_access_guarded_or_gathered_is_clean(tmp_path):
     assert sharding.run(ctx) == []
 
 
+def test_sharding_flags_host_access_on_placed_tree(tmp_path):
+    """apply_tree_shardings is a global-array producer (the ZeRO trainer's
+    param placement): np.asarray on its output must flag, while the
+    host_copy gather (a call output) clears the taint."""
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import numpy as np
+        from synapseml_tpu.parallel.mesh import (apply_tree_shardings,
+                                                 host_copy)
+
+        def export(tree, sh):
+            placed = apply_tree_shardings(tree, sh)
+            return np.asarray(placed)
+
+        def export_gathered(tree, sh):
+            placed = apply_tree_shardings(tree, sh)
+            h = host_copy(placed)
+            return np.asarray(h)
+        """})
+    found = sharding.run(ctx)
+    assert len(found) == 1
+    assert "placed" in found[0].message and "globally-sharded" in found[0].message
+
+
 def test_sharding_call_outputs_do_not_inherit_taint(tmp_path):
     """A jitted function fed a sharded array may psum/gather internally —
     its output sharding is unknown, so np.asarray on it stays quiet (the

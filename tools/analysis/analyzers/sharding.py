@@ -12,6 +12,7 @@
   not on the (resolvable) mesh.
 * **S3 host access on global arrays** — values produced by
   ``parallel.mesh.to_global_rows`` / ``make_array_from_process_local_data``
+  / ``apply_tree_shardings`` (the ZeRO/pipeline trainer's param placement)
   / ``device_put(..., NamedSharding(...))`` are *globally sharded*: on a
   multi-host mesh ``np.asarray(x)`` / ``x.tolist()`` raise (non-addressable
   shards) and ``x.addressable_shards`` silently yields a partial view.
@@ -35,7 +36,7 @@ DESCRIPTION = ("shard_map spec arity vs. signature, NamedSharding axes "
 
 #: producers of globally-sharded arrays (canonical suffixes)
 _GLOBAL_PRODUCERS = (".to_global_rows", ".make_array_from_process_local_data",
-                     ".shard_rows")
+                     ".shard_rows", ".apply_tree_shardings")
 
 #: host accesses that assume every shard is locally addressable
 _HOST_NUMPY = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray",

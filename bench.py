@@ -1107,6 +1107,96 @@ def bench_checkpoint_overhead(rows=50_000, cols=100, iters=20):
             "vs_baseline": None}
 
 
+def bench_elastic_recovery(rows=20_000, cols=50, iters=12):
+    """Elastic-training recovery price (docs/resilience.md "Elastic
+    training"): how long from a peer dying inside a collective to training
+    being ready to run again. The three host-side components are timed
+    separately because each is bounded by a different knob — stall detection
+    (CollectiveWatchdog budget -> PeerLostError), survivor consensus (the
+    digest-verified file barrier), and restore-to-ready (loading the agreed
+    gbdt snapshot back into a runnable carry, bounded by the checkpoint
+    interval)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from synapseml_tpu.core.checkpoint import (CheckpointStore,
+                                               PreemptionError)
+    from synapseml_tpu.gbdt import BoosterConfig, train_booster
+    from synapseml_tpu.parallel.elastic import (CollectiveWatchdog,
+                                                HeartbeatMonitor,
+                                                HeartbeatWriter,
+                                                PeerLostError,
+                                                consensus_restart_step)
+    from synapseml_tpu.testing.chaos import ChaosPreemption
+
+    # -- detection: a hung call with one stale peer heartbeat -> error
+    budget_s = 0.2
+    hb = tempfile.mkdtemp(prefix="bench_elastic_hb_")
+    det = []
+    try:
+        HeartbeatWriter(hb, rank=1).beat("allreduce_sum")
+        past = time.time() - 60
+        os.utime(os.path.join(hb, "hb_p1.json"), (past, past))
+        mon = HeartbeatMonitor(hb, timeout=0.5, expected=[0, 1], self_rank=0)
+        wd = CollectiveWatchdog(timeout=budget_s, monitor=mon, poll=0.01)
+        for _ in range(5):
+            t0 = time.perf_counter()
+            try:
+                wd.run(lambda: threading.Event().wait(60), op="bench.hang")
+            except PeerLostError:
+                det.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        shutil.rmtree(hb, ignore_errors=True)
+    detect_ms = sorted(det)[len(det) // 2]
+
+    # -- kill mid-train, then price the consensus barrier and the resume
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.normal(size=rows) > 0).astype(np.float32)
+    mk = lambda: BoosterConfig(objective="binary", num_iterations=iters,
+                               seed=1)
+    every = 3
+    ck = tempfile.mkdtemp(prefix="bench_elastic_ck_")
+    cons = tempfile.mkdtemp(prefix="bench_elastic_cons_")
+    try:
+        try:
+            with ChaosPreemption(at={"gbdt.chunk": [iters // 2]}):
+                train_booster(X, y, mk(), checkpoint_store=ck,
+                              checkpoint_every=every)
+        except PreemptionError:
+            pass
+        store = CheckpointStore(ck)
+        t0 = time.perf_counter()
+        agreed = consensus_restart_step(store, cons, rank=0, expected=[0],
+                                        timeout=10.0)
+        consensus_ms = (time.perf_counter() - t0) * 1e3
+        # restore-to-ready: the resume is preempted at its very first loop
+        # boundary (done == agreed step), so the elapsed time is exactly
+        # setup + verified load + carry placement, no training iterations
+        t0 = time.perf_counter()
+        try:
+            with ChaosPreemption(at={"gbdt.chunk": [agreed]}):
+                train_booster(X, y, mk(), checkpoint_store=ck,
+                              checkpoint_every=every)
+        except PreemptionError:
+            pass
+        ready_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        shutil.rmtree(ck, ignore_errors=True)
+        shutil.rmtree(cons, ignore_errors=True)
+
+    total = detect_ms + consensus_ms + ready_ms
+    return {"metric": "elastic_recovery_total_ms",
+            "value": round(total, 1),
+            "unit": (f"ms detect->agree->resume (detect {detect_ms:.0f} ms "
+                     f"at a {budget_s:.1f}s watchdog budget, consensus "
+                     f"{consensus_ms:.1f} ms, restore-to-ready "
+                     f"{ready_ms:.0f} ms from step {agreed}/{iters}, "
+                     f"checkpoint interval {every})"),
+            "vs_baseline": None}
+
+
 def bench_online_learning(n_events=8192, batch_size=64, n_requests=200):
     """Online bandit loop under live serving (docs/online-learning.md):
     sustained learner updates/s while the epsilon-greedy policy answers
@@ -1459,7 +1549,8 @@ def _extra_workloads():
            bench_serving, bench_serving_resnet,
            bench_serving_distributed, bench_fabric_scaling, bench_voting_ab,
            bench_distributed_gbdt_auto, bench_dl_sharded,
-           bench_checkpoint_overhead, bench_online_learning)
+           bench_checkpoint_overhead, bench_elastic_recovery,
+           bench_online_learning)
     return {f.__name__: f for f in fns}
 
 
@@ -1510,9 +1601,10 @@ def main():
         only = sys.argv[sys.argv.index("--only") + 1]
         _ONLY_MODE[0] = only
     if only in ("bench_voting_ab", "bench_distributed_gbdt_auto",
-                "bench_dl_sharded"):
-        # mesh workloads: virtual 8-device CPU mesh regardless of the chip
-        # (the metrics are same-platform ratios). Must be set before the
+                "bench_dl_sharded", "bench_elastic_recovery"):
+        # mesh/host workloads: virtual 8-device CPU mesh regardless of the
+        # chip (the metrics are same-platform ratios or host-side recovery
+        # latencies). Must be set before the
         # backend initializes; _init_device_with_watchdog honors
         # JAX_PLATFORMS via the config API.
         os.environ["JAX_PLATFORMS"] = "cpu"

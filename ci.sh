@@ -152,4 +152,13 @@ assert rec["guard"]["zero_step_within_1p15x_replicated"], \
     f"ZeRO step time exceeds 1.15x replicated: {per_model}"
 EOF
 
+echo "== elastic training guard (kill/hang a rank -> detect, agree, reshard, resume) =="
+# the chaos battery behind docs/resilience.md "Elastic training": watchdog
+# stall detection (stale peer vs slow straggler vs wedged collective),
+# digest-verified consensus restart over survivors, gbdt + dl-zero
+# shrink/regrow resume (no committed step ever lost; bit-for-bit on an
+# unchanged mesh), and the respawn-or-shrink TrainingSupervisor — runs the
+# file unfiltered so the slow multi-process leg stays covered here
+JAX_PLATFORMS=cpu python -m pytest -x -q tests/test_elastic.py
+
 echo "CI OK"

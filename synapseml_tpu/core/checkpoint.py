@@ -344,11 +344,20 @@ def _norm_index(idx, shape):
     return tuple(out)
 
 
-def _exchange_json(obj):
+def _exchange_json(obj, timeout: Optional[float] = None):
     """Allgather one JSON-serializable object per process; returns the list
     ordered by process index. Doubles as the barrier that sequences
     every process's shard-artifact write before process 0 commits the
-    manifest. Single-process: ``[obj]``."""
+    manifest. Single-process: ``[obj]``.
+
+    A dead or hung peer would stall the allgather forever, wedging the
+    pre-manifest barrier — so the gather runs on a daemon worker thread and
+    ``timeout`` (default: env ``SYNAPSEML_BARRIER_TIMEOUT_S``, 300s; <= 0
+    disables) bounds the wait, converting the stall into
+    ``CheckpointError("barrier timeout, peers=[...]")`` naming the other
+    process indices. Survivors then agree on a restart point out-of-band via
+    ``parallel.elastic.consensus_restart_step`` (a file barrier — this
+    collective fabric is exactly what just broke)."""
     import jax
 
     if jax.process_count() == 1:
@@ -356,15 +365,52 @@ def _exchange_json(obj):
     import numpy as np
     from jax.experimental import multihost_utils
 
-    raw = json.dumps(obj, sort_keys=True).encode("utf-8")
-    lens = np.asarray(multihost_utils.process_allgather(
-        np.asarray([len(raw)], np.int64))).reshape(-1)
-    buf = np.zeros(int(lens.max()), np.uint8)
-    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
-    rows = np.asarray(multihost_utils.process_allgather(buf[None])).reshape(
-        jax.process_count(), -1)
-    return [json.loads(rows[p, : int(lens[p])].tobytes().decode("utf-8"))
-            for p in range(jax.process_count())]
+    def _gather():
+        raw = json.dumps(obj, sort_keys=True).encode("utf-8")
+        lens = np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(raw)], np.int64))).reshape(-1)
+        buf = np.zeros(int(lens.max()), np.uint8)
+        buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+        rows = np.asarray(multihost_utils.process_allgather(
+            buf[None])).reshape(jax.process_count(), -1)
+        return [json.loads(rows[p, : int(lens[p])].tobytes().decode("utf-8"))
+                for p in range(jax.process_count())]
+
+    if timeout is None:
+        timeout = float(os.environ.get("SYNAPSEML_BARRIER_TIMEOUT_S", "300"))
+    # every replica reads the same env knob / passes the same argument, so
+    # the timeout branch is replica-CONSISTENT: all processes take the same
+    # path and the gather below is reached (or not) collectively
+    if timeout <= 0:
+        return _gather()  # lint-ok: collectives
+    import threading
+
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["out"] = _gather()  # lint-ok: collectives
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            box["err"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True, name="ckpt-barrier")
+    t.start()
+    if not done.wait(timeout):
+        peers = [p for p in range(jax.process_count())
+                 if p != jax.process_index()]
+        record_failure("checkpoint.barrier_timeout", peers=peers,
+                       timeout_s=timeout)
+        raise CheckpointError(
+            f"barrier timeout, peers={peers} — a peer process died or hung "
+            f"before the pre-manifest exchange completed ({timeout:.1f}s); "
+            "run parallel.elastic.consensus_restart_step over the survivors "
+            "to agree on the last committed step")
+    if "err" in box:
+        raise box["err"]
+    return box["out"]
 
 
 def save_sharded_tree(store: CheckpointStore, step: int, tree,

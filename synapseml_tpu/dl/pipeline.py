@@ -41,6 +41,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.checkpoint import (CheckpointStore, NonFiniteGuard,
                                NonFiniteLossError, preemption_point)
 from ..core.compat import donate_argnums_if_supported
+from ..parallel.elastic import ElasticUnsupportedError, current_watchdog
 from ..parallel.mesh import (DATA_AXIS, STAGE_AXIS, apply_tree_shardings,
                              host_copy, stage_submeshes, tree_shardings)
 from .backbones import StageSequential
@@ -67,10 +68,19 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
             "param_sharding='pipeline' requires a mesh with a 'stage' axis, "
             "e.g. parallel.make_mesh({'stage': G, 'data': D})")
     if jax.process_count() > 1:
-        raise NotImplementedError(
-            "multi-process pipeline training is not wired up yet (groups "
-            "spanning hosts need per-group process coordination); use "
-            "param_sharding='zero' for multi-host runs")
+        # the supported-config matrix lives in docs/dl-scaling.md; keep the
+        # two in sync when a row changes
+        raise ElasticUnsupportedError(
+            "multi-process pipeline training (stage groups spanning hosts "
+            "need per-group process coordination)",
+            matrix={
+                "single-process pipeline (any #stages/groups)": True,
+                "multi-process param_sharding='replicated'": True,
+                "multi-process param_sharding='zero'/'fsdp'": True,
+                "multi-process param_sharding='pipeline'": False,
+                "elastic shrink/regrow resume (zero/fsdp, gbdt fused)": True,
+            },
+            hint="use param_sharding='zero' for multi-host runs")
     X = np.asarray(X)
     y = np.asarray(y)
     if tr.params is None:
@@ -248,8 +258,13 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
         gacc = [None] * S
         losses, accs = [], []
         dx_last = [None] * M
+        wd = current_watchdog()
         # forward wavefront (last stage fuses loss+backward)
         for t in range(S + M - 1):
+            if wd is not None:
+                # one beat per schedule tick: a rank hung inside an
+                # inter-group hop leaves the tick index on record
+                wd.beat("dl.pipeline.hop", t)
             for s in range(S):
                 m = t - s
                 if not 0 <= m < M:
@@ -347,7 +362,16 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
             if hook is not None:
                 xb, yb = hook(epoch * steps_per_epoch + i, xb, yb)
             prev = as_trees() if keep_prev else None
-            loss, acc = pipeline_step(step_idx, xb, yb)
+            wd = current_watchdog()
+            if wd is not None:
+                # the whole fill-drain schedule (with its host-synced loss)
+                # runs under the stall guard; a hung hop or wedged stage
+                # program surfaces as PeerLostError instead of a dead loop
+                loss, acc = wd.run(pipeline_step, step_idx, xb, yb,
+                                   op="dl.pipeline.step")
+                wd.beat("dl.pipeline.step", step_idx)
+            else:
+                loss, acc = pipeline_step(step_idx, xb, yb)
             action = guard.check(loss, step_idx)
             if action == "skip":
                 set_trees(*prev)

@@ -41,6 +41,7 @@ from ..core.checkpoint import (CheckpointStore, NonFiniteGuard,
                                NonFiniteLossError, preemption_point)
 from ..core.compat import donate_argnums_if_supported
 from ..core.logging import record_failure
+from ..parallel.elastic import current_watchdog
 from ..parallel.mesh import DATA_AXIS, apply_tree_shardings, tree_shardings
 
 # Batch-corruption hook for the chaos suite (testing/chaos.py installs it):
@@ -422,8 +423,23 @@ class FlaxTrainer:
             for xb, yb in self._prefetch(
                     batches_with_chaos(rng_e, epoch * steps_per_epoch)):
                 prev = (params, batch_stats, opt_state) if keep_prev else None
-                params, batch_stats, opt_state, loss, acc = train_step(
-                    params, batch_stats, opt_state, xb, yb, step_idx)
+                wd = current_watchdog()
+                if wd is not None:
+                    # elastic mode: the step AND its host sync (the blocking
+                    # point a hung peer's psum actually stalls) run under the
+                    # collective watchdog, so a lost rank surfaces as
+                    # PeerLostError instead of an indefinite stall
+                    def _synced_step(*a):
+                        out = train_step(*a)
+                        jax.block_until_ready(out[3])
+                        return out
+                    params, batch_stats, opt_state, loss, acc = wd.run(
+                        _synced_step, params, batch_stats, opt_state, xb, yb,
+                        step_idx, op="dl.step")
+                    wd.beat("dl.step", step_idx)
+                else:
+                    params, batch_stats, opt_state, loss, acc = train_step(
+                        params, batch_stats, opt_state, xb, yb, step_idx)
                 action = guard.check(float(loss), step_idx)
                 if action == "skip":
                     # drop the poisoned update; the step index still advances

@@ -38,6 +38,7 @@ from .grower import (Forest, GrowerConfig, TreeArrays, forest_max_depth,
 from .objectives import (METRICS, HIGHER_IS_BETTER, Objective, get_objective,
                          lambdarank_objective, make_grouped,
                          map_at_k, metric_kwargs, ndcg_at_k)
+from ..parallel.elastic import current_watchdog
 
 
 @dataclasses.dataclass
@@ -966,11 +967,11 @@ def train_booster(
         ckpt_store = CheckpointStore(ckpt_store)
     if ckpt_store is not None and checkpoint_every <= 0:
         checkpoint_every = 10
-    if ckpt_store is not None and mesh is not None and jax.process_count() > 1:
-        # multi-process snapshots need a global-array gather protocol; until
-        # then fail loudly rather than silently training without protection
-        raise NotImplementedError(
-            "checkpoint_store is not supported for multi-process training yet")
+    # multi-process snapshots: the carry is gathered to host on every rank
+    # (_pack_gbdt_carry is collective) and committed by rank 0 through a
+    # shared checkpoint directory; snapshots are trimmed to the original
+    # unpadded global rows so a shrunken/regrown mesh can resume them
+    # (parallel/elastic.py consensus restart path)
     if _is_sparse(X):
         if mesh is not None or init_model is not None:
             # these paths need raw dense rows anyway (padding / rescoring) and
@@ -1340,6 +1341,26 @@ def train_booster(
         from ..parallel.mesh import DATA_AXIS as _DAf
 
         feature_shards = int(dict(mesh.shape).get(_DAf, 1))
+        from ..ops.hist_kernel import features_padded as _fpad
+
+        if feature_shards > 1 and _fpad(nfeat) % feature_shards:
+            # an elastic shrink/regrow can change the data-axis size after a
+            # previous call routed this cfg to feature-parallel; the owned-
+            # feature scatter needs the padded feature count to divide evenly,
+            # so degrade to data-parallel histograms rather than raising at
+            # trace time mid-restart
+            import warnings
+
+            warnings.warn(
+                f"tree_learner='feature': features_padded({nfeat})="
+                f"{_fpad(nfeat)} is not divisible by the {feature_shards}-way "
+                f"data axis of this mesh; falling back to data-parallel "
+                f"histograms")
+            cfg.tree_learner = "data"
+            feature_shards = 1
+            if routing_info is not None:
+                routing_info = dict(routing_info, tree_learner="data",
+                                    fallback="feature_shards_indivisible")
     grower_cfg = cfg.grower(has_categorical=has_cat,
                             feature_shards=feature_shards)
     _wrap = np.asarray if multiproc else jnp.asarray
@@ -1485,7 +1506,9 @@ def train_booster(
             # snapshot boundaries must fall on chunk boundaries (the carry is
             # only exact between scan invocations)
             chunk = min(chunk, max(1, checkpoint_every))
-            fingerprint = _train_fingerprint(cfg, n, nfeat, y, n_init_trees)
+            n_fp, y_fp = _elastic_label_identity(y, n_orig, multiproc)
+            fingerprint = _train_fingerprint(cfg, n_fp, nfeat, y_fp,
+                                             n_init_trees)
             state = _ckpt_load_gbdt(ckpt_store, fingerprint, "fused") \
                 if resume else None
             if state is not None:
@@ -1493,21 +1516,33 @@ def train_booster(
                 trees = list(state["trees"])
                 tree_weights = list(state["tree_weights"])
                 mvals_list = [np.asarray(m) for m in state["mvals"]]
-                carry = tuple(jnp.asarray(a) for a in state["carry"])
-                if mesh is not None:
-                    carry = (jax.device_put(carry[0], row2),
-                             jax.device_put(carry[1], row1), carry[2])
+                carry = _place_gbdt_carry(
+                    state["carry"], n, n_orig, mesh, multiproc,
+                    row2 if mesh is not None else None,
+                    row1 if mesh is not None else None, score_v0)
         with measures.span("trainingIterations"):
+            wd = current_watchdog()
             while done < T:
                 if ckpt_store is not None:
                     preemption_point("gbdt.chunk", done)
+                if wd is not None:
+                    wd.beat("gbdt.chunk", done)
                 c = min(chunk, T - done)
-                carry, (stacked_trees, mv) = run_scan(
-                    binned, yj, wj, valid_mask, key0, is_cat, mono, nan_bins,
-                    cat_nbins, base_k, gidx_arr, bv_arg, yv_j, wv_j, gidx_v,
-                    *carry,
-                    done, c)
-                stacked_trees = jax.device_get(stacked_trees)
+
+                def _run_chunk(_d=done, _c=c):
+                    cc, (st, mv_) = run_scan(
+                        binned, yj, wj, valid_mask, key0, is_cat, mono,
+                        nan_bins, cat_nbins, base_k, gidx_arr, bv_arg, yv_j,
+                        wv_j, gidx_v, *carry, _d, _c)
+                    # device_get INSIDE the guard: this transfer is the host
+                    # sync point where a hung peer's psum would stall forever
+                    return cc, (jax.device_get(st), mv_)
+
+                if wd is not None:
+                    carry, (stacked_trees, mv) = wd.run(
+                        _run_chunk, op="gbdt.chunk")
+                else:
+                    carry, (stacked_trees, mv) = _run_chunk()
                 for ti in range(c):
                     for cls in range(k):
                         trees.append(jax.tree.map(lambda a: a[ti, cls],
@@ -1524,12 +1559,17 @@ def train_booster(
                         stop = (done - 1 - int(b[-1])
                                 >= cfg.early_stopping_round)
                 if ckpt_store is not None and (done >= T or not stop):
-                    _ckpt_save_gbdt(
-                        ckpt_store, done,
-                        {"iteration": done, "trees": trees,
-                         "tree_weights": tree_weights, "mvals": mvals_list,
-                         "carry": jax.device_get(carry)},
-                        fingerprint, "fused", measures)
+                    # pack is collective (all ranks); only rank 0 commits to
+                    # the (shared) store — one writer, no torn races
+                    carry_h = _pack_gbdt_carry(carry, n, n_orig, multiproc)
+                    if not multiproc or jax.process_index() == 0:
+                        _ckpt_save_gbdt(
+                            ckpt_store, done,
+                            {"iteration": done, "trees": trees,
+                             "tree_weights": tree_weights,
+                             "mvals": mvals_list, "carry": carry_h,
+                             "n_orig": n_fp},
+                            fingerprint, "fused", measures)
                 if stop:
                     break
         score = carry[0]
@@ -1569,18 +1609,22 @@ def train_booster(
         wv_dev = jnp.asarray(np.asarray(valid[2], np.float32))
     start_it = 0
     if ckpt_store is not None:
-        from ..core.checkpoint import preemption_point
+        from ..core.checkpoint import CheckpointError, preemption_point
 
-        fingerprint = _train_fingerprint(cfg, n, nfeat, y, n_init_trees)
+        # host path is single-process only; fingerprint + snapshots use the
+        # original unpadded rows so a resume survives a mesh-shape change
+        n_fp, y_fp = _elastic_label_identity(y, n_orig, False)
+        fingerprint = _train_fingerprint(cfg, n_fp, nfeat, y_fp, n_init_trees)
         state = _ckpt_load_gbdt(ckpt_store, fingerprint, "host") \
             if resume else None
         if state is not None:
             start_it = int(state["iteration"])
             trees = list(state["trees"])
             tree_weights = list(state["tree_weights"])
-            tree_contribs = list(state["tree_contribs"])
-            score = jnp.asarray(state["score"])
-            in_bag_cur = jnp.asarray(state["in_bag_cur"])
+            tree_contribs = [(c, jnp.asarray(_repad_rows(v, n)))
+                             for c, v in state["tree_contribs"]]
+            score = jnp.asarray(_repad_rows(state["score"], n))
+            in_bag_cur = jnp.asarray(_repad_rows(state["in_bag_cur"], n))
             if mesh is not None:
                 score = jax.device_put(score, row2)
                 in_bag_cur = jax.device_put(in_bag_cur, row1)
@@ -1588,13 +1632,22 @@ def train_booster(
             # restoring it is what makes the resumed drop sequence identical
             rng = state["rng"]
             if has_valid:
-                score_v = jnp.asarray(state["score_v"])
+                sv = np.asarray(state["score_v"], np.float32)
+                if sv.shape != tuple(np.shape(score_v)):
+                    raise CheckpointError(
+                        f"validation score shape changed {sv.shape} -> "
+                        f"{tuple(np.shape(score_v))}; resume with the "
+                        "original validation set (or pass resume=False)")
+                score_v = jnp.asarray(sv)
                 valid_contribs = list(state["valid_contribs"])
                 best_metric = state["best_metric"]
                 best_iter = int(state["best_iter"])
+    wd = current_watchdog()
     for it in range(start_it, cfg.num_iterations):
         if ckpt_store is not None:
             preemption_point("gbdt.iteration", it)
+        if wd is not None:
+            wd.beat("gbdt.iteration", it)
         # ---- dart: drop trees and de-weight the score -------------------
         if dart_mode and trees:
             nt = len(trees)
@@ -1762,15 +1815,18 @@ def train_booster(
                 cb(it, trees)
 
         if ckpt_store is not None and (it + 1) % checkpoint_every == 0:
+            # per-row state trimmed to the original rows (mesh-independent;
+            # see _pack_gbdt_carry for why dropping padding rows is exact)
             payload = {
                 "iteration": it + 1,
                 "trees": jax.device_get(trees),
                 "tree_weights": list(tree_weights),
-                "tree_contribs": [(c, np.asarray(jax.device_get(v)))
+                "tree_contribs": [(c, np.asarray(jax.device_get(v))[:n_orig])
                                   for c, v in tree_contribs],
-                "score": np.asarray(jax.device_get(score)),
-                "in_bag_cur": np.asarray(jax.device_get(in_bag_cur)),
+                "score": np.asarray(jax.device_get(score))[:n_orig],
+                "in_bag_cur": np.asarray(jax.device_get(in_bag_cur))[:n_orig],
                 "rng": rng,
+                "n_orig": n_orig,
             }
             if has_valid:
                 payload["score_v"] = np.asarray(jax.device_get(score_v))
@@ -1818,6 +1874,113 @@ def _train_fingerprint(cfg, n, nfeat, y, n_init_trees) -> str:
                    zlib.crc32(np.ascontiguousarray(
                        np.asarray(y, np.float32)).tobytes()))).encode())
     return h.hexdigest()
+
+
+def _elastic_label_identity(y, n_orig, multiproc):
+    """(global original row count, global original labels) for the resume
+    fingerprint. Padded counts/labels are MESH-DEPENDENT (padding varies
+    with the data-axis size), so hashing them would pin a snapshot to one
+    mesh shape and block the elastic shrink/regrow resume path
+    (parallel/elastic.py); the original rows identify the run for any mesh.
+    Collective in multi-process mode (label allgather — every rank calls)."""
+    y_loc = np.ascontiguousarray(np.asarray(y, np.float32)[:n_orig])
+    if not multiproc:
+        return int(n_orig), y_loc
+    from jax.experimental import multihost_utils
+
+    # stacked (nproc, n_orig) -> rank-order concat == global row order,
+    # because to_global_rows lays process blocks contiguously
+    g = np.asarray(multihost_utils.process_allgather(y_loc))
+    return int(n_orig) * jax.process_count(), g.reshape(-1)
+
+
+def _repad_rows(a, n):
+    """Zero-pad trimmed per-row snapshot state back to THIS run's padded row
+    count. Exact, not approximate: padding rows carry in_bag=0 / weight 0,
+    so their (discarded) evolved values never touched a histogram or leaf
+    stat and zeros are indistinguishable going forward."""
+    from ..core.checkpoint import CheckpointError
+
+    a = np.asarray(a, np.float32)
+    if a.shape[0] > n:
+        raise CheckpointError(
+            f"snapshot has {a.shape[0]} rows but this run has {n}; the "
+            "snapshot belongs to different data")
+    if a.shape[0] == n:
+        return a
+    pad = np.zeros((n - a.shape[0],) + a.shape[1:], np.float32)
+    return np.concatenate([a, pad])
+
+
+def _pack_gbdt_carry(carry, n, n_orig, multiproc):
+    """Host snapshot of the fused-scan carry trimmed to the ORIGINAL rows in
+    global row order — mesh-independent, so a shrunken/regrown mesh can
+    restore it (_place_gbdt_carry re-pads for the new layout). Collective in
+    multi-process mode: the host_copy allgather runs on EVERY rank even
+    though only rank 0 commits the resulting checkpoint."""
+    score, in_bag, score_v = carry
+    if multiproc:
+        from ..parallel.mesh import host_copy
+
+        nproc = jax.process_count()
+        blk = n // nproc                    # padded rows per process block
+        keep = np.concatenate([np.arange(p * blk, p * blk + n_orig)
+                               for p in range(nproc)])
+        score = np.asarray(host_copy(score))[keep]
+        in_bag = np.asarray(host_copy(in_bag))[keep]
+        if isinstance(score_v, jax.Array) and not (
+                score_v.is_fully_addressable or score_v.is_fully_replicated):
+            score_v = host_copy(score_v)
+    else:
+        score = np.asarray(jax.device_get(score))[:n_orig]
+        in_bag = np.asarray(jax.device_get(in_bag))[:n_orig]
+    return score, in_bag, np.asarray(jax.device_get(score_v))
+
+
+def _place_gbdt_carry(saved, n, n_orig, mesh, multiproc, row2, row1,
+                      score_v_like):
+    """Inverse of _pack_gbdt_carry: zero-pad the trimmed carry back to THIS
+    run's padded row count and place it on THIS run's mesh. A resume across
+    a different mesh shape therefore converges to the same model as the
+    uninterrupted run, and a same-shape resume stays bit-for-bit (trees
+    never read padded-row state)."""
+    from ..core.checkpoint import CheckpointError
+
+    sc = np.asarray(saved[0], np.float32)
+    ib = np.asarray(saved[1], np.float32)
+    sv = np.asarray(saved[2], np.float32)
+    if sv.shape != tuple(np.shape(score_v_like)):
+        raise CheckpointError(
+            f"validation score shape changed {sv.shape} -> "
+            f"{tuple(np.shape(score_v_like))}; resume with the original "
+            "validation set (or pass resume=False)")
+    if multiproc:
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS as _DA
+        from ..parallel.mesh import to_global_rows
+
+        nproc, rank = jax.process_count(), jax.process_index()
+        if sc.shape[0] != n_orig * nproc:
+            raise CheckpointError(
+                f"snapshot has {sc.shape[0]} rows but this run has "
+                f"{n_orig * nproc} original rows; different data")
+        blk = n // nproc
+
+        def _mine(a):
+            loc = a[rank * n_orig:(rank + 1) * n_orig]
+            pad = np.zeros((blk - n_orig,) + a.shape[1:], np.float32)
+            return np.concatenate([loc, pad])
+
+        score = to_global_rows(mesh, P(_DA, None), _mine(sc))
+        in_bag = to_global_rows(mesh, P(_DA), _mine(ib))
+        return score, in_bag, sv        # multiproc keeps host-side score_v
+    sc, ib = _repad_rows(sc, n), _repad_rows(ib, n)
+    score, in_bag = jnp.asarray(sc), jnp.asarray(ib)
+    if mesh is not None:
+        score = jax.device_put(score, row2)
+        in_bag = jax.device_put(in_bag, row1)
+    return score, in_bag, jnp.asarray(sv)
 
 
 def _ckpt_save_gbdt(store, iteration, payload, fingerprint, path, measures):

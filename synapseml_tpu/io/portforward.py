@@ -9,15 +9,18 @@ forward is a managed subprocess.
 from __future__ import annotations
 
 import atexit
+import shlex
 import shutil
 import subprocess
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence
 
 _forwards: Dict[int, subprocess.Popen] = {}
+_remotes: List[subprocess.Popen] = []
 
 # a notebook that never calls stop_forwarding would otherwise leave ssh
 # children running (and unreaped) past interpreter exit
 atexit.register(lambda: stop_forwarding())
+atexit.register(lambda: reap_remote())
 
 
 def forward_port(remote_host: str, remote_port: int, local_port: int,
@@ -54,3 +57,58 @@ def stop_forwarding(local_port: Optional[int] = None) -> None:
             except subprocess.TimeoutExpired:
                 proc.kill()
         proc.wait()  # reap — no zombies
+
+
+def remote_spawn(host: Optional[str], argv: Sequence[str],
+                 ssh_user: Optional[str] = None,
+                 ssh_opts: Optional[list] = None,
+                 env: Optional[dict] = None) -> subprocess.Popen:
+    """Start a worker command on ``host`` — the cross-host ``spawn_fn`` hook
+    for ``parallel.elastic.TrainingSupervisor`` (the supervisor itself is
+    placement-agnostic; this closes the ROADMAP "spawn_fn is process-local"
+    gap). ``host`` None/""/"localhost"/"127.0.0.1" runs the command as a
+    plain local subprocess (no ssh dependency — what tests and single-box
+    gangs use); anything else runs it over the same managed-``ssh``
+    discipline as :func:`forward_port`. The returned ``Popen`` is tracked
+    and reaped at interpreter exit (:func:`reap_remote`)."""
+    argv = [str(a) for a in argv]
+    if host in (None, "", "localhost", "127.0.0.1"):
+        proc = subprocess.Popen(argv, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+    else:
+        if shutil.which("ssh") is None:
+            raise EnvironmentError(
+                "ssh binary not available for cross-host spawn")
+        target = f"{ssh_user}@{host}" if ssh_user else host
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+        if ssh_opts:
+            cmd += list(ssh_opts)
+        # env is exported inline: ssh has no Popen-style env plumbing
+        exports = " ".join(f"{k}={shlex.quote(str(v))}"
+                           for k, v in (env or {}).items())
+        remote_cmd = " ".join(shlex.quote(a) for a in argv)
+        cmd += [target, f"{exports} {remote_cmd}".strip()]
+        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+    _remotes.append(proc)
+    return proc
+
+
+def reap_remote(proc: Optional[subprocess.Popen] = None,
+                timeout: float = 5.0) -> None:
+    """Terminate + reap one spawned worker (or all when ``proc`` is None).
+    Same no-zombies discipline as :func:`stop_forwarding`."""
+    victims = [proc] if proc is not None else list(_remotes)
+    for p in victims:
+        try:
+            _remotes.remove(p)
+        except ValueError:
+            pass   # already reaped by an earlier call
+        if p.poll() is None:
+            p.terminate()
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        p.wait()

@@ -34,3 +34,16 @@ from .collectives import (  # noqa: F401
     reduce_scatter_sum_quantized,
     probe_link_bandwidth,
 )
+from .elastic import (  # noqa: F401
+    CollectiveWatchdog,
+    ElasticUnsupportedError,
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    PeerLostError,
+    TrainingSupervisor,
+    consensus_restart_step,
+    current_watchdog,
+    elastic_train,
+    elastic_watchdog,
+    verified_steps,
+)

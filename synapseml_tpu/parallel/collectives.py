@@ -26,8 +26,18 @@ from .mesh import DATA_AXIS
 # device in the loop. None in production; the branch costs one global read.
 _CHAOS_HOOK = None
 
+# Elastic-training heartbeat hook (parallel.elastic.elastic_watchdog): beats
+# this process's heartbeat file with the op name before every collective, so
+# a rank that dies inside one leaves its last op on record for the peers'
+# PeerLostError diagnostics. Fires at trace time for jitted code — the
+# host-side boundary a watchdog can actually observe.
+_WATCHDOG_HOOK = None
+
 
 def _chaos(name: str) -> None:
+    hook = _WATCHDOG_HOOK
+    if hook is not None:
+        hook(name)       # beat BEFORE chaos: a killed op still leaves a trail
     if _CHAOS_HOOK is not None:
         _CHAOS_HOOK(name)
 

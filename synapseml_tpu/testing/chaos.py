@@ -753,3 +753,78 @@ class chaos_reward_stream:
         # stream over: flush every still-held event, original order
         for _, e in sorted(pending, key=lambda p: p[0]):
             yield e
+
+
+# ---------------------------------------------------------------------------
+# Elastic-training chaos: hung collectives and hard-killed ranks
+# (tests/test_elastic.py drives it; the asserted invariant is the elastic
+# one — no committed step is ever lost, and a shrink->resume converges to
+# the same model as the uninterrupted run)
+# ---------------------------------------------------------------------------
+
+class chaos_hang:
+    """Context manager that HANGS a collective instead of failing it — the
+    failure mode retries cannot see and the reason
+    ``parallel.elastic.CollectiveWatchdog`` exists. Installs the
+    ``parallel.collectives`` chaos hook; the ``at_call``-th call whose op
+    name starts with ``op`` ("" matches every op) blocks on an internal
+    event for up to ``hang_s`` seconds or until :meth:`release` /
+    context-manager exit. The watchdog is expected to convert the hang into
+    a :class:`~synapseml_tpu.parallel.elastic.PeerLostError` long before
+    ``hang_s`` elapses — the deadline is only the backstop that keeps a
+    watchdog-less test from deadlocking forever. Nesting is not supported
+    (single global hook, same slot as :class:`chaos_collectives`)."""
+
+    def __init__(self, op: str = "", at_call: int = 1, hang_s: float = 30.0):
+        self.op, self.at_call, self.hang_s = op, int(at_call), float(hang_s)
+        self.calls = 0
+        self.hung: List[str] = []          # ops that actually blocked
+        self._release = threading.Event()
+
+    def release(self) -> None:
+        """Unstick the hung call (it proceeds normally afterwards)."""
+        self._release.set()
+
+    def _hook(self, name: str) -> None:
+        if self.op and not name.startswith(self.op):
+            return
+        self.calls += 1
+        if self.calls == self.at_call:
+            self.hung.append(name)
+            self._release.wait(self.hang_s)
+
+    def __enter__(self) -> "chaos_hang":
+        from ..parallel import collectives as _c
+
+        if _c._CHAOS_HOOK is not None:
+            raise RuntimeError("chaos_hang does not nest")
+        _c._CHAOS_HOOK = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from ..parallel import collectives as _c
+
+        _c._CHAOS_HOOK = None
+        self._release.set()     # never leave a worker thread blocked behind
+
+    def __del__(self):
+        self._release.set()
+
+
+def kill_rank(target, rank: Optional[int] = None) -> int:
+    """Hard-kill one training process (SIGKILL: no atexit, no farewell —
+    its heartbeat file simply stops updating), the process-level analog of
+    :func:`kill_worker`. ``target`` is a ``subprocess.Popen``-like handle
+    (``rank`` ignored) or a ``parallel.elastic.TrainingSupervisor`` whose
+    ``procs[rank]`` is the victim. The corpse is reaped (``wait``) so a
+    supervisor's next ``observe()`` sees a clean exit code, not a zombie.
+    Returns the pid killed."""
+    proc = target
+    if hasattr(target, "procs"):
+        ranks = sorted(target.procs)
+        proc = target.procs[rank if rank is not None else ranks[0]]
+    if proc is None:
+        raise ValueError(f"rank {rank} has no live process to kill")
+    proc.kill()
+    proc.wait()
+    return proc.pid

@@ -38,7 +38,8 @@ DESCRIPTION = ("module/instance state written both under and outside a lock "
 SCOPE = ("synapseml_tpu/io/serving.py",
          "synapseml_tpu/io/distributed_serving.py",
          "synapseml_tpu/core/resilience.py",
-         "synapseml_tpu/core/logging.py")
+         "synapseml_tpu/core/logging.py",
+         "synapseml_tpu/parallel/elastic.py")
 
 _LOCK_FACTORIES = {"threading.Lock", "threading.RLock",
                    "threading.Condition", "multiprocessing.Lock",

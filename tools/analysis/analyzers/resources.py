@@ -36,7 +36,8 @@ SCOPE = ("synapseml_tpu/io/serving.py",
          "synapseml_tpu/io/distributed_serving.py",
          "synapseml_tpu/io/portforward.py",
          "synapseml_tpu/core/fabric.py",
-         "synapseml_tpu/online/")
+         "synapseml_tpu/online/",
+         "synapseml_tpu/parallel/elastic.py")
 
 _RESOURCE_EXACT = {
     "socket.socket": "socket", "socket.create_connection": "socket",

@@ -1053,6 +1053,86 @@ def bench_gbdt_depthwise():
             "vs_baseline": round(v / BASELINE_GBDT_ROW_ITERS, 3)}
 
 
+def bench_oocore_gbdt(rows=200_000, cols=50, iters=6):
+    """Out-of-core streamed GBDT vs the classic resident trainer
+    (docs/out-of-core.md; ROADMAP item 2).
+
+    Three timed runs, one growth policy (depthwise — the resident policy
+    the streamed level-synchronous grower shares its split math with, so
+    the ratio measures STREAMING overhead, not a policy change):
+
+    * resident — classic ``train_booster`` with the whole binned matrix
+      device-resident (the denominator);
+    * streamed @ 1x — the chunk pump with default geometry, everything
+      still fits (pure pump overhead);
+    * streamed @ 10x — ``SYNAPSEML_TPU_STREAM_MEM_BUDGET`` pinned to a
+      tenth of the quantized stream's bytes, so the (depth+1) in-flight
+      chunks simulate a device 10x too small for the dataset — the
+      headline out-of-core claim, guarded in ci.sh at >= 0.7x resident.
+    """
+    import jax
+
+    from synapseml_tpu.gbdt import (BoosterConfig, StreamedDataset,
+                                    train_booster, train_booster_streamed)
+    from synapseml_tpu.ops.hist_kernel import features_padded
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2]
+         + 0.2 * rng.normal(size=rows) > 0).astype(np.float32)
+    cfg = BoosterConfig(objective="binary", num_iterations=iters, seed=1,
+                        growth_policy="depthwise")
+
+    def timed(fn):
+        fn()                                    # compile + cache
+        t0 = time.perf_counter()
+        b = fn()
+        jax.block_until_ready(b.trees[-1].leaf_value)
+        return rows * iters / (time.perf_counter() - t0)
+
+    v_res = timed(lambda: train_booster(X, y, cfg))
+
+    ds1 = StreamedDataset.from_arrays(X, y)
+    ds1.prepare(cfg)
+    v_1x = timed(lambda: train_booster_streamed(ds1, cfg))
+
+    # the quantized stream's device footprint per row (uint8 bins padded to
+    # the feature tile + y/w/m/score f32 + node i32 — gbdt/stream.py)
+    row_bytes = features_padded(cols) + 20
+    stream_bytes = rows * row_bytes
+    budget = stream_bytes // 10
+    old = os.environ.get("SYNAPSEML_TPU_STREAM_MEM_BUDGET")
+    os.environ["SYNAPSEML_TPU_STREAM_MEM_BUDGET"] = str(budget)
+    try:
+        ds10 = StreamedDataset.from_arrays(X, y)
+        ds10.prepare(cfg)                       # geometry resolves NOW
+        v_10x = timed(lambda: train_booster_streamed(ds10, cfg))
+    finally:
+        if old is None:
+            os.environ.pop("SYNAPSEML_TPU_STREAM_MEM_BUDGET", None)
+        else:
+            os.environ["SYNAPSEML_TPU_STREAM_MEM_BUDGET"] = old
+
+    in_flight = (ds10.depth + 1) * ds10.chunk_rows * row_bytes
+    oversize = stream_bytes / max(in_flight, 1)
+    ratio_1x = v_1x / max(v_res, 1e-9)
+    ratio_10x = v_10x / max(v_res, 1e-9)
+    return {"metric": "oocore_gbdt_streamed_row_iters_per_sec",
+            "value": round(v_10x, 1),
+            "unit": (f"row-iterations/sec streamed @ 10x-oversized "
+                     f"({ds10.chunk_rows} rows/chunk x "
+                     f"{len(ds10.chunks)} chunks; resident {v_res:.0f}, "
+                     f"streamed@1x {v_1x:.0f} r-i/s)"),
+            "vs_baseline": round(v_10x / BASELINE_GBDT_ROW_ITERS, 3),
+            "resident_row_iters_per_s": round(v_res, 1),
+            "streamed_1x_row_iters_per_s": round(v_1x, 1),
+            "streamed_vs_resident_1x": round(ratio_1x, 3),
+            "streamed_vs_resident_10x": round(ratio_10x, 3),
+            "oversize_ratio": round(oversize, 1),
+            "guard": {"streamed_10x_ge_0p7x_resident": ratio_10x >= 0.7,
+                      "oversize_ratio_ge_10": oversize >= 10.0}}
+
+
 def bench_checkpoint_overhead(rows=50_000, cols=100, iters=20):
     """Checkpointed vs plain gbdt training at dryrun shapes: the robustness
     layer (core/checkpoint.py) must not silently regress the hot path. The
@@ -1549,6 +1629,7 @@ def _extra_workloads():
            bench_serving, bench_serving_resnet,
            bench_serving_distributed, bench_fabric_scaling, bench_voting_ab,
            bench_distributed_gbdt_auto, bench_dl_sharded,
+           bench_oocore_gbdt,
            bench_checkpoint_overhead, bench_elastic_recovery,
            bench_online_learning)
     return {f.__name__: f for f in fns}

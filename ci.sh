@@ -152,6 +152,35 @@ assert rec["guard"]["zero_step_within_1p15x_replicated"], \
     f"ZeRO step time exceeds 1.15x replicated: {per_model}"
 EOF
 
+echo "== out-of-core guard (streamed gbdt: parity, chaos, throughput) =="
+# correctness first: sketch/resident/sparse parity, chunk-stream chaos,
+# kill->resume bit-for-bit, the dl tail-drop regression (tests/test_oocore.py)
+JAX_PLATFORMS=cpu python -m pytest -x -q tests/test_oocore.py
+JAX_PLATFORMS=cpu python - << 'EOF'
+# then the throughput claim (docs/out-of-core.md): training through the
+# chunk pump with SYNAPSEML_TPU_STREAM_MEM_BUDGET pinned to a TENTH of the
+# quantized stream (a simulated 10x-undersized device) must hold >= 0.7x
+# the classic resident trainer's row-iterations/s at the same depthwise
+# policy, and the in-flight chunk state must genuinely be >= 10x smaller
+# than the stream it trains on
+import json, subprocess, sys
+out = subprocess.run([sys.executable, "bench.py", "--only",
+                      "bench_oocore_gbdt"],
+                     capture_output=True, text=True, check=True).stdout
+rec = json.loads(out.strip().splitlines()[-1])
+print(f"streamed@10x {rec['value']} r-i/s = "
+      f"{rec['streamed_vs_resident_10x']}x resident "
+      f"({rec['resident_row_iters_per_s']} r-i/s); "
+      f"oversize ratio {rec['oversize_ratio']}x; "
+      f"streamed@1x ratio {rec['streamed_vs_resident_1x']}x")
+assert rec["guard"]["oversize_ratio_ge_10"], \
+    f"budget cap did not produce a >=10x-oversized stream: {rec}"
+assert rec["guard"]["streamed_10x_ge_0p7x_resident"], \
+    (f"streamed@10x {rec['value']} r-i/s is "
+     f"{rec['streamed_vs_resident_10x']}x resident "
+     f"{rec['resident_row_iters_per_s']} r-i/s — below the 0.7x floor")
+EOF
+
 echo "== elastic training guard (kill/hang a rank -> detect, agree, reshard, resume) =="
 # the chaos battery behind docs/resilience.md "Elastic training": watchdog
 # stall detection (stale peer vs slow straggler vs wedged collective),

@@ -46,6 +46,11 @@ ALLOWED = {
     # features packed per MXU dot (ops/hist_kernel._pack_for clamps to the
     # tile constraints; the tuner pins this only on a measured win)
     "hist_pack": int,
+    # out-of-core ingest geometry (io/ingest.py): rows per streamed chunk
+    # and in-flight chunk depth, resolved env > tuned file > the h2d
+    # bandwidth micro-probe recorded in the measurement store
+    "stream_chunk_rows": int,
+    "stream_depth": int,
 }
 
 

@@ -203,29 +203,19 @@ class FlaxTrainer:
         that consumes them, so the transfer — expensive through a tunnel,
         nontrivial on real HBM — overlaps the current step's compute (JAX
         dispatch is async; holding the arrays keeps the transfers in
-        flight)."""
-        from collections import deque
+        flight). Runs on the shared ingestion layer (io/ingest.py
+        ChunkPump, synchronous-lookahead mode — the exact refill-before-
+        yield deque semantics this method used to hand-roll; the gbdt
+        out-of-core streamer and online drain share the same layer).
+        ``_batches``'s epoch-tail drop is upstream of the pump and carries
+        over unchanged (regression-tested in tests/test_oocore.py)."""
+        from ..io.ingest import ChunkPump  # lazy: io/__init__ is heavy
 
         if size is None:
             size = self.cfg.prefetch_batches
-
-        q: deque = deque()
-
-        def enqueue():
-            try:
-                xb, yb = next(batches)
-            except StopIteration:
-                return False
-            q.append((self._shard(xb), self._shard(yb)))
-            return True
-
-        for _ in range(max(size, 1)):
-            if not enqueue():
-                break
-        while q:
-            out = q.popleft()
-            enqueue()
-            yield out
+        place = lambda b: (self._shard(b[0]), self._shard(b[1]))
+        return iter(ChunkPump(batches, place=place, depth=max(size, 1),
+                              threaded=False, name="dl-prefetch"))
 
     def _shard(self, arr):
         if self.mesh is None:

@@ -957,6 +957,32 @@ def train_booster(
     if measures is None:
         measures = InstrumentationMeasures()
     cfg = config
+    # out-of-core route: a StreamedDataset carries its own labels/weights and
+    # trains through the chunk-streamed level-synchronous grower
+    # (gbdt/stream.py — local import: stream imports this module)
+    from .stream import StreamedDataset, train_booster_streamed
+
+    if isinstance(X, StreamedDataset):
+        unsupported = [name for name, v in [
+            ("y", y), ("sample_weight", sample_weight),
+            ("init_score", init_score), ("group_sizes", group_sizes),
+            ("valid", valid), ("fobj", fobj), ("init_model", init_model),
+            ("callbacks", callbacks or None), ("mesh", mesh)]
+            if v is not None]
+        if unsupported:
+            raise NotImplementedError(
+                f"train_booster(StreamedDataset) does not take {unsupported}"
+                " — labels/weights ride the stream; the other features are "
+                "resident-path only (see gbdt/stream.py v1 scope)")
+        if mapper is not None and X.mapper is None:
+            X.mapper = mapper
+            X._user_mapper = True
+        if categorical_features is not None and X.categorical_features is None:
+            X.categorical_features = list(categorical_features)
+        return train_booster_streamed(
+            X, config, measures=measures, checkpoint_store=checkpoint_store,
+            checkpoint_every=checkpoint_every, resume=resume,
+            feature_names=feature_names)
     # --- crash-safe snapshots (core/checkpoint.py): periodic forest + loop
     # state, resumable bit-for-bit because all per-iteration sampling is
     # stateless fold_in(seed, it) and the carried score is saved exactly

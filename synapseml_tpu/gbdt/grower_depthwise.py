@@ -78,6 +78,139 @@ class _DepthState(NamedTuple):
     num_splits: jnp.ndarray
 
 
+class _LevelPlan(NamedTuple):
+    """One level's applied split decisions in per-leaf broadcast form —
+    everything a row (resident CAP-array or streamed chunk) needs to route
+    itself: index by the row's current leaf id. Produced by
+    :func:`_apply_level_splits`, consumed by :func:`_route_level`; shared by
+    the resident depthwise grower and the out-of-core streamed grower
+    (gbdt/stream.py), which routes CHUNKS of rows against the same plan."""
+
+    do: jnp.ndarray          # (L,) bool — leaf split this level
+    fsel: jnp.ndarray        # (L,) i32 split feature
+    bsel: jnp.ndarray        # (L,) i32 bin threshold
+    dl: jnp.ndarray          # (L,) bool default-left
+    cat: jnp.ndarray         # (L,) bool categorical split
+    bits: jnp.ndarray        # (L, bw) u32 categorical bitset
+    right_of: jnp.ndarray    # (L,) i32 right-child leaf (identity if unsplit)
+
+
+def _level_candidates(s, cfg: GrowerConfig, L: int):
+    """(do, order) for the level ``s.level``: which leaves split, in gain
+    order, with the num_leaves budget truncating by gain."""
+    exists = jnp.arange(L) <= s.num_splits
+    gains_d = jnp.where(exists & (s.depth == s.level), s.bgain, -jnp.inf)
+    want = gains_d > cfg.min_gain_to_split
+    order = jnp.argsort(-gains_d).astype(jnp.int32)
+    rank = jnp.zeros(L, jnp.int32).at[order].set(
+        jnp.arange(L, dtype=jnp.int32))
+    budget = (L - 1) - s.num_splits
+    return want & (rank < budget), order
+
+
+def _apply_level_splits(s, do, order, catp, catb, cfg: GrowerConfig, B: int,
+                        bw: int, L: int):
+    """Apply one level's splits in gain order (bookkeeping only — small
+    arrays; the heavy per-row work is batched by the caller). ``s`` is any
+    NamedTuple state carrying the tree-bookkeeping fields of
+    :func:`grower._init_split_state` plus ``mask_id`` — the resident
+    ``_DepthState`` and the streamed grower's state both qualify. Returns
+    ``(s2, plan)``: the updated state and the :class:`_LevelPlan` rows route
+    against."""
+    plan0 = _LevelPlan(
+        do=do,
+        fsel=jnp.zeros(L, jnp.int32),
+        bsel=jnp.zeros(L, jnp.int32),
+        dl=jnp.zeros(L, bool),
+        cat=jnp.zeros(L, bool),
+        bits=jnp.zeros((L, bw), jnp.uint32),
+        right_of=jnp.arange(L, dtype=jnp.int32))   # identity when unsplit
+
+    def apply_one(k, carry):
+        s, plan = carry
+        l = order[k]
+
+        def live(args):
+            s, plan = args
+            gain_l = s.bgain[l]
+            fsel, bsel, dl = s.bfeat[l], s.bbin[l], s.bdl[l]
+            hist_parent = s.hist[l]
+            totals = hist_parent[0].sum(axis=0)
+            G_l, H_l, C_l = totals[0], totals[1], totals[2]
+            bitset, cat_split = _winning_cat_bitset(
+                hist_parent, fsel, bsel, catp, cfg, B, bw, catb)
+            i_node = s.num_splits
+            new_right = i_node + 1
+            parent_out = _leaf_output(G_l, H_l, cfg) * cfg.learning_rate
+            p = s.leaf_parent[l]
+            p_idx = jnp.maximum(p, 0)
+            lc = s.left_child.at[p_idx].set(
+                jnp.where((p >= 0) & ~s.leaf_is_right[l], i_node,
+                          s.left_child[p_idx]))
+            rc = s.right_child.at[p_idx].set(
+                jnp.where((p >= 0) & s.leaf_is_right[l], i_node,
+                          s.right_child[p_idx]))
+            lc = lc.at[i_node].set(~l)
+            rc = rc.at[i_node].set(~new_right)
+            s2 = s._replace(
+                depth=s.depth.at[l].add(1).at[new_right].set(
+                    s.depth[l] + 1),
+                leaf_parent=s.leaf_parent.at[l].set(i_node)
+                                        .at[new_right].set(i_node),
+                leaf_is_right=s.leaf_is_right.at[l].set(False)
+                                             .at[new_right].set(True),
+                mask_id=s.mask_id.at[l].set(i_node * 2)
+                                 .at[new_right].set(i_node * 2 + 1),
+                split_feature=s.split_feature.at[i_node].set(fsel),
+                split_bin=s.split_bin.at[i_node].set(bsel),
+                split_gain=s.split_gain.at[i_node].set(gain_l),
+                split_type=s.split_type.at[i_node].set(
+                    cat_split.astype(jnp.int32)),
+                default_left=s.default_left.at[i_node].set(dl),
+                cat_bitset=s.cat_bitset.at[i_node].set(bitset),
+                left_child=lc,
+                right_child=rc,
+                internal_value=s.internal_value.at[i_node].set(parent_out),
+                internal_count=s.internal_count.at[i_node].set(
+                    C_l.astype(jnp.int32)),
+                num_splits=s.num_splits + 1,
+            )
+            plan2 = plan._replace(
+                fsel=plan.fsel.at[l].set(fsel),
+                bsel=plan.bsel.at[l].set(bsel),
+                dl=plan.dl.at[l].set(dl),
+                cat=plan.cat.at[l].set(cat_split),
+                bits=plan.bits.at[l].set(bitset),
+                right_of=plan.right_of.at[l].set(new_right))
+            return (s2, plan2)
+
+        return lax.cond(do[l], live, lambda a: a, (s, plan))
+
+    return lax.fori_loop(0, L, apply_one, (s, plan0))
+
+
+def _route_level(bT, rleaf, plan: _LevelPlan, nanp, cfg: GrowerConfig,
+                 bw: int):
+    """Vectorized per-row routing of one level's applied splits over any row
+    block: ``bT`` (FP, R) bins, ``rleaf`` (R,) current leaf ids → (R,) new
+    leaf ids. Per-row split params come from the plan via the row's leaf (vs
+    ``grower._route_right``'s single-split scalars); the bitset is one word
+    row per row's leaf."""
+    split_row = plan.do[rleaf]
+    fr = plan.fsel[rleaf]
+    binrow = jnp.take_along_axis(bT, fr[None, :], axis=0)[0]
+    gr = binrow > plan.bsel[rleaf]
+    gr = jnp.where(binrow == nanp[fr], ~plan.dl[rleaf], gr)
+    if cfg.has_categorical:
+        w = jnp.take_along_axis(
+            plan.bits[rleaf],
+            jnp.clip(binrow >> 5, 0, bw - 1).astype(jnp.int32)[:, None],
+            axis=1)[:, 0]
+        member = ((w >> (binrow & 31).astype(jnp.uint32)) & 1).astype(bool)
+        gr = jnp.where(plan.cat[rleaf], ~member, gr)
+    return jnp.where(split_row & gr, plan.right_of[rleaf], rleaf)
+
+
 def _grow_tree_impl_depthwise(binned, grad, hess, in_bag, feature_active,
                               is_categorical, monotone, nan_bins,
                               cfg: GrowerConfig, axis_name: Optional[str],
@@ -152,105 +285,15 @@ def _grow_tree_impl_depthwise(binned, grad, hess, in_bag, feature_active,
 
     def body(s: _DepthState) -> _DepthState:
         d = s.level
-        exists = jnp.arange(L) <= s.num_splits
-        gains_d = jnp.where(exists & (s.depth == d), s.bgain, -jnp.inf)
-        want = gains_d > cfg.min_gain_to_split
-        order = jnp.argsort(-gains_d).astype(jnp.int32)
-        rank = jnp.zeros(L, jnp.int32).at[order].set(
-            jnp.arange(L, dtype=jnp.int32))
-        budget = (L - 1) - s.num_splits
-        do = want & (rank < budget)
+        do, order = _level_candidates(s, cfg, L)
 
         # ---- stage (a): apply the level's splits in gain order ----------
         # (bookkeeping only — small arrays; the heavy work is batched below)
-        fsel_a = jnp.zeros(L, jnp.int32)
-        bsel_a = jnp.zeros(L, jnp.int32)
-        dl_a = jnp.zeros(L, bool)
-        cat_a = jnp.zeros(L, bool)
-        bits_a = jnp.zeros((L, bw), jnp.uint32)
-        right_of = jnp.arange(L, dtype=jnp.int32)   # identity when unsplit
-
-        def apply_one(k, carry):
-            (s, fsel_a, bsel_a, dl_a, cat_a, bits_a, right_of) = carry
-            l = order[k]
-
-            def live(args):
-                (s, fsel_a, bsel_a, dl_a, cat_a, bits_a, right_of) = args
-                gain_l = s.bgain[l]
-                fsel, bsel, dl = s.bfeat[l], s.bbin[l], s.bdl[l]
-                hist_parent = s.hist[l]
-                totals = hist_parent[0].sum(axis=0)
-                G_l, H_l, C_l = totals[0], totals[1], totals[2]
-                bitset, cat_split = _winning_cat_bitset(
-                    hist_parent, fsel, bsel, catp, cfg, B, bw, catb)
-                i_node = s.num_splits
-                new_right = i_node + 1
-                parent_out = _leaf_output(G_l, H_l, cfg) * cfg.learning_rate
-                p = s.leaf_parent[l]
-                p_idx = jnp.maximum(p, 0)
-                lc = s.left_child.at[p_idx].set(
-                    jnp.where((p >= 0) & ~s.leaf_is_right[l], i_node,
-                              s.left_child[p_idx]))
-                rc = s.right_child.at[p_idx].set(
-                    jnp.where((p >= 0) & s.leaf_is_right[l], i_node,
-                              s.right_child[p_idx]))
-                lc = lc.at[i_node].set(~l)
-                rc = rc.at[i_node].set(~new_right)
-                s2 = s._replace(
-                    depth=s.depth.at[l].add(1).at[new_right].set(
-                        s.depth[l] + 1),
-                    leaf_parent=s.leaf_parent.at[l].set(i_node)
-                                            .at[new_right].set(i_node),
-                    leaf_is_right=s.leaf_is_right.at[l].set(False)
-                                                 .at[new_right].set(True),
-                    mask_id=s.mask_id.at[l].set(i_node * 2)
-                                     .at[new_right].set(i_node * 2 + 1),
-                    split_feature=s.split_feature.at[i_node].set(fsel),
-                    split_bin=s.split_bin.at[i_node].set(bsel),
-                    split_gain=s.split_gain.at[i_node].set(gain_l),
-                    split_type=s.split_type.at[i_node].set(
-                        cat_split.astype(jnp.int32)),
-                    default_left=s.default_left.at[i_node].set(dl),
-                    cat_bitset=s.cat_bitset.at[i_node].set(bitset),
-                    left_child=lc,
-                    right_child=rc,
-                    internal_value=s.internal_value.at[i_node].set(
-                        parent_out),
-                    internal_count=s.internal_count.at[i_node].set(
-                        C_l.astype(jnp.int32)),
-                    num_splits=s.num_splits + 1,
-                )
-                return (s2, fsel_a.at[l].set(fsel), bsel_a.at[l].set(bsel),
-                        dl_a.at[l].set(dl), cat_a.at[l].set(cat_split),
-                        bits_a.at[l].set(bitset),
-                        right_of.at[l].set(new_right))
-
-            return lax.cond(do[l], live, lambda a: a,
-                            (s, fsel_a, bsel_a, dl_a, cat_a, bits_a,
-                             right_of))
-
-        s, fsel_a, bsel_a, dl_a, cat_a, bits_a, right_of = lax.fori_loop(
-            0, L, apply_one, (s, fsel_a, bsel_a, dl_a, cat_a, bits_a,
-                              right_of))
+        s, plan = _apply_level_splits(s, do, order, catp, catb, cfg, B, bw,
+                                      L)
 
         # ---- route every row by its leaf's split (vectorized) -----------
-        rl = s.rleaf
-        split_row = do[rl]
-        fr = fsel_a[rl]
-        binrow = jnp.take_along_axis(s.bT, fr[None, :], axis=0)[0]
-        # per-row split params (vs _route_right's single-split scalars):
-        # the bitset is (CAP, bw) here, one word row per row's leaf
-        gr = binrow > bsel_a[rl]
-        gr = jnp.where(binrow == nanp[fr], ~dl_a[rl], gr)
-        if cfg.has_categorical:
-            w = jnp.take_along_axis(
-                bits_a[rl],
-                jnp.clip(binrow >> 5, 0, bw - 1).astype(jnp.int32)[:, None],
-                axis=1)[:, 0]
-            member = ((w >> (binrow & 31).astype(jnp.uint32))
-                      & 1).astype(bool)
-            gr = jnp.where(cat_a[rl], ~member, gr)
-        new_rleaf = jnp.where(split_row & gr, right_of[rl], rl)
+        new_rleaf = _route_level(s.bT, s.rleaf, plan, nanp, cfg, bw)
         # padding rows sort to the very end and are regenerated per slot
         is_pad = s.pos >= Np
         sort_leaf = jnp.where(is_pad, L, new_rleaf)

@@ -1,0 +1,698 @@
+"""Out-of-core GBDT: train on datasets far larger than device memory by
+re-streaming host-cached QUANTIZED chunks through the shared ingestion layer.
+
+The resident growers (grower.py / grower_depthwise.py) require the whole
+binned matrix on device; past single-chip HBM the dataset size — not FLOPs —
+is the wall (ROADMAP item 2). GPU tree-boosting work (arXiv:1706.08359)
+showed that streaming a COMPRESSED feature matrix chunk-wise with per-chunk
+histogram accumulation recovers near-resident throughput far beyond memory;
+this module is that data plane:
+
+* :class:`StreamedDataset` — ingests raw row chunks ONCE (dense or scipy
+  sparse), learns bin boundaries with a one-pass
+  :class:`~synapseml_tpu.ops.quantize.StreamingQuantileSketch` (bit-identical
+  to the resident boundaries while the stream fits the sample buffer), and
+  caches the quantized rows host-side as uniform feature-major uint8 chunks
+  — 4x smaller than the raw floats, the compressed stream the device pulls.
+
+* :func:`train_booster_streamed` — level-synchronous depthwise growth.
+  Per level, every chunk makes one device trip: a single jitted program
+  routes the chunk's rows against the previous level's
+  :class:`~synapseml_tpu.gbdt.grower_depthwise._LevelPlan` and scatter-adds
+  the (L, FP, B, 3) frontier histogram (ops/hist_kernel._hist_level_xla);
+  chunk partials sum on device and flow through the SAME
+  ``hist_allreduce_dtype`` ladder / split search / bookkeeping as the
+  resident depthwise grower (the helpers are shared, not copied). Chunks
+  move through a threaded :class:`~synapseml_tpu.io.ingest.ChunkPump`
+  (transfer of chunk k+1 overlaps compute on chunk k), and every chunk
+  boundary is a preemption point + watchdog heartbeat
+  (phase ``"gbdt.stream.chunk"``), so PR 2 checkpoints and PR 10 elastic
+  watchdogs compose with streaming for free.
+
+* :func:`predict_streamed` — out-of-core scoring: raw chunks in, per-chunk
+  predictions out, through the same pump.
+
+Parity contract (tests/test_oocore.py): ``resident=True`` runs the IDENTICAL
+jitted programs over pre-staged device-resident chunks — the pump, the
+double-buffering, and the preemption machinery are bitwise-transparent, so
+streamed == resident-mode trees bit for bit. Versus the classic resident
+``train_booster`` the accumulation GEOMETRY differs (per-chunk partial sums
+vs one whole-matrix scatter), so cross-path parity is a quality bound (AUC
+within 1e-3 on the breast-cancer fixture), while boundary parity is exact
+whenever the sketch never overflowed. See docs/out-of-core.md.
+
+v1 scope (raise loud, never silently degrade): single chip, gbdt boosting,
+binary/regression-family objectives (num_class == 1), no bagging / GOSS /
+DART / feature sampling, no validation-driven early stopping. Multi-chip
+streaming (per-chunk psum over a sharded pump) is the documented follow-up.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Callable, Iterable, List, NamedTuple, Optional, Sequence
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.ingest import ChunkPump, stream_chunk_rows, stream_depth
+from ..ops.hist_kernel import _hist_level_xla, features_padded, pad_bins
+from ..ops.quantize import (BinMapper, CsrBinner, StreamingQuantileSketch,
+                            apply_bins)
+from .boosting import Booster, BoosterConfig, _ckpt_load_gbdt, _ckpt_save_gbdt
+from .grower import (BITS, GrowerConfig, _best_for_leaf, _finalize_tree,
+                     _init_split_state, _maybe_psum)
+from .grower_depthwise import (_apply_level_splits, _level_candidates,
+                               _route_level)
+from .objectives import get_objective
+
+STREAM_PHASE = "gbdt.stream.chunk"
+
+
+def _is_sparse(x) -> bool:
+    return hasattr(x, "tocoo")
+
+
+class StreamedDataset:
+    """Out-of-core training data: a re-iterable chunk source plus the
+    host-cached quantized form ``train_booster_streamed`` streams from.
+
+    ``batches`` is a CALLABLE returning an iterator of chunks — each chunk a
+    dense ``(c, F)`` array or scipy sparse matrix, optionally tupled with
+    per-chunk labels/weights: ``X``, ``(X, y)`` or ``(X, y, w)``. The
+    callable is invoked once per ingest pass (twice total when boundaries
+    must be sketched: sketch pass, then bin+cache pass), so generators must
+    be wrapped in a function, not passed pre-consumed.
+
+    ``prepare(config)`` resolves the chunk geometry (io/ingest.py:
+    explicit > env > tuned file > bandwidth micro-probe, capped by the
+    ``SYNAPSEML_TPU_STREAM_MEM_BUDGET`` device budget), learns boundaries
+    (sketch — or adopts ``mapper``), and re-chunks the stream into uniform
+    ``(FP, C)`` feature-major quantized host chunks (the last chunk padded
+    with zero-mass rows so every device program compiles ONCE). Sparse
+    chunks are quantized on device through
+    :class:`~synapseml_tpu.ops.quantize.CsrBinner` — implicit zeros never
+    densify at dataset scale.
+    """
+
+    def __init__(self, batches: Callable[[], Iterable],
+                 num_features: Optional[int] = None,
+                 mapper: Optional[BinMapper] = None,
+                 categorical_features: Optional[Sequence[int]] = None,
+                 chunk_rows: Optional[int] = None,
+                 depth: Optional[int] = None):
+        if not callable(batches):
+            raise TypeError(
+                "StreamedDataset needs a CALLABLE returning an iterator of "
+                "chunks (a consumed iterator cannot support the multiple "
+                "ingest passes); wrap it: StreamedDataset(lambda: chunks)")
+        self._batches = batches
+        self.num_features = num_features
+        self.mapper = mapper
+        self._user_mapper = mapper is not None
+        self.categorical_features = (list(categorical_features)
+                                     if categorical_features else None)
+        self._chunk_rows_arg = chunk_rows
+        self._depth_arg = depth
+        self.chunk_rows: Optional[int] = None     # C, after prepare()
+        self.depth: Optional[int] = None
+        self.chunks: List[dict] = []              # bT (FP, C), y/w/m (C,)
+        self.chunk_real: List[int] = []           # real (unpadded) rows
+        self.n_rows = 0
+        self.sketch_exact: Optional[bool] = None  # None = mapper was given
+        self._prepared_for = None
+
+    @classmethod
+    def from_arrays(cls, X, y=None, w=None, source_chunk: int = 65536,
+                    **kwargs) -> "StreamedDataset":
+        """Wrap in-memory arrays (dense or scipy sparse rows) as a chunk
+        source — the fits-in-memory path of the parity tests and benches."""
+        n = X.shape[0]
+        f = X.shape[1]
+
+        def batches():
+            for i in range(0, n, source_chunk):
+                sl = slice(i, min(i + source_chunk, n))
+                yield (X[sl],
+                       None if y is None else y[sl],
+                       None if w is None else w[sl])
+
+        return cls(batches, num_features=f, **kwargs)
+
+    # -- ingest ------------------------------------------------------------
+    def _norm_chunk(self, chunk):
+        """(X, y, w) from any accepted chunk shape."""
+        if isinstance(chunk, tuple):
+            X = chunk[0]
+            y = chunk[1] if len(chunk) > 1 else None
+            w = chunk[2] if len(chunk) > 2 else None
+        else:
+            X, y, w = chunk, None, None
+        if self.num_features is None:
+            self.num_features = int(X.shape[1])
+        elif int(X.shape[1]) != self.num_features:
+            raise ValueError(f"chunk has {X.shape[1]} features, dataset has "
+                             f"{self.num_features}")
+        return X, y, w
+
+    def _sketch_pass(self, cfg: BoosterConfig) -> None:
+        seed = (cfg.seed if cfg.data_random_seed is None
+                else int(cfg.data_random_seed))
+        sketch = None
+        for chunk in self._batches():
+            X, _, _ = self._norm_chunk(chunk)
+            if sketch is None:
+                sketch = StreamingQuantileSketch(
+                    self.num_features, cfg.max_bin, cfg.bin_sample_count,
+                    self.categorical_features, seed=seed,
+                    min_data_in_bin=cfg.min_data_in_bin,
+                    max_bin_by_feature=cfg.max_bin_by_feature)
+            if _is_sparse(X):
+                coo = X.tocoo()
+                sketch.update_csr(coo.data, coo.row, coo.col, X.shape[0])
+            else:
+                sketch.update(np.asarray(X, np.float32))
+        if sketch is None or sketch.rows_seen == 0:
+            raise ValueError("StreamedDataset source yielded no rows")
+        self.sketch_exact = sketch.exact
+        self.mapper = sketch.finalize()
+
+    def _bin_chunk(self, X, binner: Optional[CsrBinner]) -> np.ndarray:
+        """(c, F) quantized host rows for one raw chunk."""
+        if _is_sparse(X):
+            coo = X.tocoo()
+            return np.asarray(binner(coo.data, coo.row, coo.col, X.shape[0]))
+        return np.asarray(apply_bins(self.mapper, np.asarray(X, np.float32)))
+
+    def prepare(self, config: BoosterConfig) -> "StreamedDataset":
+        """Idempotent per binning config: sketch (unless a mapper was given),
+        resolve chunk geometry, quantize + cache the stream."""
+        key = (config.max_bin, config.bin_sample_count,
+               config.min_data_in_bin,
+               tuple(config.max_bin_by_feature or ()),
+               config.seed if config.data_random_seed is None
+               else int(config.data_random_seed))
+        if self._prepared_for == key:
+            return self
+        if self._prepared_for is not None and self._user_mapper is False:
+            # re-preparing under different binning would silently retrain on
+            # different boundaries — make the caller rebuild the dataset
+            raise ValueError(
+                f"StreamedDataset already prepared for binning {self._prepared_for}; "
+                f"got {key} — build a fresh StreamedDataset")
+        if self.mapper is None:
+            self._sketch_pass(config)
+        if self.mapper.max_bin != config.max_bin:
+            raise ValueError(
+                f"mapper has max_bin={self.mapper.max_bin} but config asks "
+                f"{config.max_bin}")
+
+        F = self.num_features
+        FP = features_padded(F)
+        # one streamed row's device footprint: quantized bins (feature-major
+        # uint8/16) + y/w/m/score f32 + node i32
+        unit = 1 if self.mapper.max_bin <= 256 else 2
+        row_bytes = FP * unit + 20
+        self.depth = stream_depth(self._depth_arg)
+        C = stream_chunk_rows(row_bytes, explicit=self._chunk_rows_arg,
+                              depth=self.depth)
+        self.chunk_rows = C
+        bin_dtype = np.uint8 if unit == 1 else np.uint16
+
+        self.chunks, self.chunk_real, self.n_rows = [], [], 0
+        binner = CsrBinner(self.mapper)
+        buf_b = np.zeros((C, F), bin_dtype)
+        buf_y = np.zeros(C, np.float32)
+        buf_w = np.zeros(C, np.float32)
+        fill = 0
+
+        def flush():
+            nonlocal fill, C
+            if fill == 0:
+                return
+            if not self.chunks and fill < C:
+                # the whole stream fit one partial chunk: shrink the chunk
+                # to the real row count instead of padding (a probe-derived
+                # C far above n_rows would otherwise make every device
+                # program chew mostly zero-mass padding)
+                C = fill
+                self.chunk_rows = C
+            bT = np.zeros((FP, C), bin_dtype)
+            bT[:F, :fill] = buf_b[:fill].T
+            m = np.zeros(C, np.float32)
+            m[:fill] = 1.0
+            self.chunks.append({
+                "bT": np.ascontiguousarray(bT),
+                "y": buf_y[:C].copy(), "w": buf_w[:C].copy(), "m": m})
+            self.chunk_real.append(fill)
+            buf_y[:] = 0.0
+            buf_w[:] = 0.0
+            fill = 0
+
+        for chunk in self._batches():
+            X, y, w = self._norm_chunk(chunk)
+            c = int(X.shape[0])
+            if c == 0:
+                continue
+            binned = self._bin_chunk(X, binner)
+            y = (np.zeros(c, np.float32) if y is None
+                 else np.asarray(y, np.float32))
+            w = (np.ones(c, np.float32) if w is None
+                 else np.asarray(w, np.float32))
+            off = 0
+            while off < c:
+                take = min(C - fill, c - off)
+                buf_b[fill:fill + take] = binned[off:off + take]
+                buf_y[fill:fill + take] = y[off:off + take]
+                buf_w[fill:fill + take] = w[off:off + take]
+                fill += take
+                off += take
+                if fill == C:
+                    flush()
+        flush()
+        self.n_rows = int(sum(self.chunk_real))
+        if self.n_rows == 0:
+            raise ValueError("StreamedDataset source yielded no rows")
+        self._prepared_for = key
+        return self
+
+    # -- host-side label access (1/F the data size; see docs/out-of-core.md)
+    def labels(self) -> np.ndarray:
+        return np.concatenate([ch["y"][:r] for ch, r in
+                               zip(self.chunks, self.chunk_real)])
+
+    def weights(self) -> np.ndarray:
+        return np.concatenate([ch["w"][:r] for ch, r in
+                               zip(self.chunks, self.chunk_real)])
+
+
+# ---------------------------------------------------------------------------
+# Per-chunk device programs — ONE compile each per (geometry, objective):
+# mapper-dependent vectors (featp/catp/monop/nanp/catb) are ARGUMENTS, never
+# closed-over constants, so the lru_cache can only ever key on static shape
+# ---------------------------------------------------------------------------
+
+class _StreamState(NamedTuple):
+    """Streamed level-synchronous growth state: the shared bookkeeping fields
+    of grower._init_split_state plus the depthwise driver scalars. Satisfies
+    the state contract of _apply_level_splits/_finalize_tree."""
+
+    mask_id: jnp.ndarray
+    level: jnp.ndarray
+    progress: jnp.ndarray
+    hist: jnp.ndarray
+    bgain: jnp.ndarray
+    bfeat: jnp.ndarray
+    bbin: jnp.ndarray
+    bdl: jnp.ndarray
+    bcl: jnp.ndarray
+    depth: jnp.ndarray
+    leaf_parent: jnp.ndarray
+    leaf_is_right: jnp.ndarray
+    split_feature: jnp.ndarray
+    split_bin: jnp.ndarray
+    split_gain: jnp.ndarray
+    split_type: jnp.ndarray
+    default_left: jnp.ndarray
+    cat_bitset: jnp.ndarray
+    left_child: jnp.ndarray
+    right_child: jnp.ndarray
+    internal_value: jnp.ndarray
+    internal_count: jnp.ndarray
+    num_splits: jnp.ndarray
+
+
+class _Programs(NamedTuple):
+    root_chunk: Callable
+    route_chunk: Callable
+    root_finish: Callable
+    plan_level: Callable
+    commit_level: Callable
+    update_score: Callable
+    finalize: Callable
+
+    def cache_sizes(self) -> dict:
+        """Compiled-executable counts per program (steady-state recompile
+        guard in tests/test_oocore.py)."""
+        return {name: getattr(fn, "_cache_size", lambda: -1)()
+                for name, fn in zip(self._fields, self)}
+
+
+@functools.lru_cache(maxsize=16)
+def _stream_programs(gcfg: GrowerConfig, B: int, L: int, FP: int, bw: int,
+                     C: int, obj_key: tuple) -> _Programs:
+    obj = get_objective(obj_key[0], num_class=1, sigmoid=obj_key[1],
+                        alpha=obj_key[2], fair_c=obj_key[3],
+                        poisson_max_delta_step=obj_key[4],
+                        tweedie_variance_power=obj_key[5])
+    l1 = jnp.float32(gcfg.lambda_l1)
+    l2 = jnp.float32(gcfg.lambda_l2)
+    wire = gcfg.hist_allreduce_dtype
+
+    def _gh(score, y, w, m):
+        # padding rows carry w=0 but some objectives floor the hessian
+        # (binary: max(h*w, 1e-16)) — the explicit mask multiply keeps them
+        # at exactly zero, matching the resident growers' grad*in_bag
+        g, h = obj.grad_hess(score, y, w)
+        return g * m, h * m
+
+    @jax.jit
+    def root_chunk(bT, y, w, m, score):
+        g, h = _gh(score, y, w, m)
+        node = jnp.zeros(C, jnp.int32)
+        return _hist_level_xla(bT.astype(jnp.int32), g, h, m, node, B, L)
+
+    @jax.jit
+    def route_chunk(bT, y, w, m, score, node, plan, nanp):
+        bT32 = bT.astype(jnp.int32)
+        node2 = _route_level(bT32, node, plan, nanp, gcfg, bw)
+        g, h = _gh(score, y, w, m)
+        hist = _hist_level_xla(bT32, g, h, m, node2, B, L)
+        return node2, hist
+
+    @jax.jit
+    def root_finish(hist, featp, catp, monop, nanp, catb):
+        exists0 = jnp.arange(L) == 0
+        hist = jnp.where(exists0[:, None, None, None], hist, 0.0)
+        hist = _maybe_psum(hist, None, wire)
+        rg, rf, rb, rdl, rcl, _ = _best_for_leaf(
+            hist[0], featp, catp, monop, nanp, gcfg, l1, l2, catb)
+        base = _init_split_state(L, B, bw, hist[0], rg, rf, rb, rdl, rcl, FP)
+        return _StreamState(
+            mask_id=jnp.full(L, 2 * (L - 1), jnp.int32),
+            level=jnp.int32(0), progress=jnp.bool_(True), **base)
+
+    @jax.jit
+    def plan_level(s, catp, catb):
+        do, order = _level_candidates(s, gcfg, L)
+        s2, plan = _apply_level_splits(s, do, order, catp, catb, gcfg, B, bw,
+                                       L)
+        return s2, plan, do.any()
+
+    @jax.jit
+    def commit_level(s, hist, do_any, featp, catp, monop, nanp, catb):
+        exists2 = jnp.arange(L) <= s.num_splits
+        hist = jnp.where(exists2[:, None, None, None], hist, 0.0)
+        hist = _maybe_psum(hist, None, wire)
+        bg, bf, bb, bdl_, bcl, _ = jax.vmap(
+            lambda hl: _best_for_leaf(hl, featp, catp, monop, nanp, gcfg,
+                                      l1, l2, catb))(hist)
+        return s._replace(
+            hist=hist, bgain=jnp.where(exists2, bg, -jnp.inf),
+            bfeat=bf, bbin=bb, bdl=bdl_, bcl=bcl,
+            level=s.level + 1, progress=do_any)
+
+    @jax.jit
+    def update_score(score, node, leaf_value, m):
+        return score + leaf_value[node] * m
+
+    finalize = jax.jit(lambda s: _finalize_tree(s, gcfg, L))
+    return _Programs(root_chunk, route_chunk, root_finish, plan_level,
+                     commit_level, update_score, finalize)
+
+
+# ---------------------------------------------------------------------------
+# Streamed training
+# ---------------------------------------------------------------------------
+
+def _check_supported(cfg: BoosterConfig) -> None:
+    bad = []
+    if cfg.boosting_type != "gbdt":
+        bad.append(f"boosting_type={cfg.boosting_type!r}")
+    if cfg.objective in ("multiclass", "softmax", "multiclassova",
+                         "lambdarank") or cfg.num_class > 1:
+        bad.append(f"objective={cfg.objective!r}/num_class={cfg.num_class}")
+    if (cfg.bagging_fraction < 1.0 or cfg.bagging_freq > 0
+            or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0):
+        bad.append("bagging")
+    if cfg.feature_fraction < 1.0 or cfg.feature_fraction_bynode < 1.0:
+        bad.append("feature sampling")
+    if cfg.early_stopping_round > 0:
+        bad.append("early stopping (needs a validation stream)")
+    if bad:
+        raise NotImplementedError(
+            "out-of-core streamed training does not support: "
+            + ", ".join(bad) + " (use the resident train_booster path)")
+    if cfg.growth_policy == "leafwise":
+        warnings.warn(
+            "out-of-core streamed training grows depthwise "
+            "(level-synchronous); growth_policy='leafwise' is the resident "
+            "default but is not streamable yet — training depthwise instead",
+            UserWarning, stacklevel=3)
+
+
+def _tree_to_host(tree) -> "tuple":
+    return type(tree)(*(np.asarray(jax.device_get(a)) for a in tree))
+
+
+def _stream_fingerprint(cfg: BoosterConfig, data: StreamedDataset) -> str:
+    """Resume identity: config + chunk geometry + label digest. The chunk
+    geometry is part of the identity because per-chunk partial sums make the
+    accumulation order — and therefore the grown trees — a function of C."""
+    import hashlib
+    import zlib
+
+    h = hashlib.sha256()
+    h.update(repr(sorted(dataclasses.asdict(cfg).items())).encode())
+    h.update(repr((int(data.n_rows), int(data.num_features),
+                   int(data.chunk_rows),
+                   zlib.crc32(np.ascontiguousarray(
+                       data.labels()).tobytes()))).encode())
+    return h.hexdigest()
+
+
+def train_booster_streamed(
+    data: StreamedDataset,
+    config: BoosterConfig,
+    *,
+    resident: bool = False,
+    measures=None,
+    checkpoint_store=None,
+    checkpoint_every: int = 0,
+    resume: bool = True,
+    feature_names: Optional[List[str]] = None,
+) -> Booster:
+    """Grow ``config.num_iterations`` trees over an out-of-core dataset.
+
+    Each tree makes ``levels + 2`` passes over the quantized chunk stream
+    (one root-histogram pass, one route+histogram pass per grown level, one
+    leaf-value score update pass); every pass is a fresh
+    :class:`~synapseml_tpu.io.ingest.ChunkPump` with globally monotonic
+    boundary steps, so a preemption lands at a unique chunk boundary and
+    resume (tree-boundary snapshots through ``checkpoint_store``) replays to
+    a bit-identical model.
+
+    ``resident=True`` pre-stages every chunk on device and drives the SAME
+    jitted programs without the pump — the bitwise baseline the parity tests
+    compare against, and the honest denominator for the streaming-overhead
+    bench (identical math, zero transfer).
+    """
+    from ..core.logging import InstrumentationMeasures
+
+    if measures is None:
+        measures = InstrumentationMeasures()
+    cfg = config
+    _check_supported(cfg)
+    with measures.span("streamIngest"):
+        data.prepare(cfg)
+    mapper = data.mapper
+    F = data.num_features
+    C = int(data.chunk_rows)
+    FP = features_padded(F)
+    B = pad_bins(cfg.max_bin)
+    L = cfg.num_leaves
+    bw = (B + BITS - 1) // BITS
+    has_cat = bool(np.asarray(mapper.is_categorical).any())
+    gcfg = cfg.grower(has_categorical=has_cat)
+    max_levels = gcfg.max_depth if gcfg.max_depth > 0 else L - 1
+
+    # per-feature device constants (arguments to every program — see the
+    # _stream_programs cache-keying note)
+    featp = jnp.zeros(FP, bool).at[:F].set(True)
+    catp = jnp.zeros(FP, bool).at[:F].set(jnp.asarray(mapper.is_categorical))
+    mono = np.zeros(F, np.int32)
+    if cfg.monotone_constraints is not None:
+        mc = np.asarray(cfg.monotone_constraints, np.int32)
+        mono[:len(mc)] = mc
+    monop = jnp.zeros(FP, jnp.int32).at[:F].set(jnp.asarray(mono))
+    nanp = jnp.full(FP, 0x7FFF, jnp.int32).at[:F].set(
+        jnp.asarray(np.asarray(mapper.nan_bins, np.int32)))
+    _cc = (np.asarray(mapper.cat_counts, np.int32)
+           if getattr(mapper, "cat_counts", None) is not None
+           else np.asarray(mapper.num_bins, np.int32) - 1)
+    catb = jnp.full(FP, B, jnp.int32).at[:F].set(jnp.asarray(
+        np.where(np.asarray(mapper.is_categorical), _cc, np.int32(0x7FFF))))
+
+    obj_key = (cfg.objective, cfg.sigmoid, cfg.alpha, cfg.fair_c,
+               cfg.poisson_max_delta_step, cfg.tweedie_variance_power)
+    progs = _stream_programs(gcfg, B, L, FP, bw, C, obj_key)
+
+    obj = get_objective(cfg.objective, num_class=1, sigmoid=cfg.sigmoid,
+                        alpha=cfg.alpha, fair_c=cfg.fair_c,
+                        poisson_max_delta_step=cfg.poisson_max_delta_step,
+                        tweedie_variance_power=cfg.tweedie_variance_power)
+    if cfg.boost_from_average:
+        ys, ws = data.labels(), data.weights()
+        base = np.atleast_1d(np.asarray(
+            obj.init_score(jnp.asarray(ys), jnp.asarray(ws)), np.float64))
+    else:
+        base = np.zeros(1)
+
+    nchunks = len(data.chunks)
+    # per-chunk mutable state. Streamed: host arrays re-placed per pass
+    # (the whole point — only depth+1 chunks of device state exist at once).
+    # Resident: everything device-pinned once; same programs, same values.
+    scores = [np.full(C, np.float32(base[0]), np.float32)
+              for _ in range(nchunks)]
+    nodes = [np.zeros(C, np.int32) for _ in range(nchunks)]
+    dev_static = None
+    if resident:
+        dev_static = [tuple(jax.device_put(ch[k])
+                            for k in ("bT", "y", "w", "m"))
+                      for ch in data.chunks]
+        scores = [jax.device_put(s) for s in scores]
+        nodes = [jax.device_put(nd) for nd in nodes]
+
+    # --- crash-safe snapshots at tree boundaries (PR 2 CheckpointStore) ---
+    ckpt_store = checkpoint_store
+    if isinstance(ckpt_store, str):
+        from ..core.checkpoint import CheckpointStore
+
+        ckpt_store = CheckpointStore(ckpt_store)
+    if ckpt_store is not None and checkpoint_every <= 0:
+        checkpoint_every = 1
+    fingerprint = (None if ckpt_store is None
+                   else _stream_fingerprint(cfg, data))
+    ckpt_path = "train_booster_streamed"
+
+    trees: List = []
+    start_iter = 0
+    if ckpt_store is not None and resume:
+        saved = _ckpt_load_gbdt(ckpt_store, fingerprint, ckpt_path)
+        if saved is not None:
+            start_iter = int(saved["iteration"])
+            from .grower import TreeArrays
+
+            trees = [TreeArrays(*[np.asarray(a) for a in t])
+                     for t in saved["trees"]]
+            flat = np.asarray(saved["score"], np.float32)
+            off = 0
+            for i, r in enumerate(data.chunk_real):
+                sc = np.full(C, np.float32(base[0]), np.float32)
+                sc[:r] = flat[off:off + r]
+                off += r
+                scores[i] = jax.device_put(sc) if resident else sc
+
+    step_base = 0       # globally monotonic chunk-boundary step counter
+
+    def passes():
+        """One pass over the chunk stream: yields (idx, device chunk state).
+        Streamed mode pumps host chunks through a producer thread (place =
+        device_put, so transfer k+1 overlaps compute on k); resident mode
+        walks the pre-staged device list."""
+        nonlocal step_base
+        if resident:
+            for i in range(nchunks):
+                yield i, dev_static[i] + (scores[i], nodes[i])
+            return
+
+        def src():
+            for i, ch in enumerate(data.chunks):
+                yield (i, ch["bT"], ch["y"], ch["w"], ch["m"],
+                       scores[i], nodes[i])
+
+        def place(item):
+            return (item[0],) + tuple(jax.device_put(a) for a in item[1:])
+
+        pump = ChunkPump(src(), place=place, depth=data.depth, threaded=True,
+                         phase=STREAM_PHASE, step_base=step_base,
+                         name="gbdt")
+        try:
+            for item in pump:
+                yield item[0], item[1:]
+        finally:
+            step_base += max(pump.chunks_consumed, pump.chunks_produced)
+
+    with measures.span("trainingIteration"):
+        for t in range(start_iter, cfg.num_iterations):
+            # ---- root histogram pass --------------------------------------
+            hist = None
+            for i, (bT, y, w, m, sc, nd) in passes():
+                hc = progs.root_chunk(bT, y, w, m, sc)
+                hist = hc if hist is None else hist + hc
+                nodes[i] = (jnp.zeros(C, jnp.int32) if resident
+                            else np.zeros(C, np.int32))
+            s = progs.root_finish(hist, featp, catp, monop, nanp, catb)
+
+            # ---- level-synchronous growth ---------------------------------
+            progress, num_splits, level = True, 0, 0
+            while progress and num_splits < L - 1 and level < max_levels:
+                s, plan, do_any = progs.plan_level(s, catp, catb)
+                hist = None
+                for i, (bT, y, w, m, sc, nd) in passes():
+                    node2, hc = progs.route_chunk(bT, y, w, m, sc, nd, plan,
+                                                  nanp)
+                    nodes[i] = node2 if resident else np.asarray(node2)
+                    hist = hc if hist is None else hist + hc
+                s = progs.commit_level(s, hist, do_any, featp, catp, monop,
+                                       nanp, catb)
+                progress = bool(s.progress)
+                num_splits = int(s.num_splits)
+                level = int(s.level)
+
+            tree = _tree_to_host(progs.finalize(s))
+            trees.append(tree)
+
+            # ---- streamed score update ------------------------------------
+            lv = jnp.asarray(tree.leaf_value)
+            for i, (bT, y, w, m, sc, nd) in passes():
+                sc2 = progs.update_score(sc, nd, lv, m)
+                scores[i] = sc2 if resident else np.asarray(sc2)
+
+            if (ckpt_store is not None
+                    and (t + 1) % max(checkpoint_every, 1) == 0):
+                flat = np.concatenate(
+                    [np.asarray(scores[i])[:r]
+                     for i, r in enumerate(data.chunk_real)])
+                _ckpt_save_gbdt(
+                    ckpt_store, t + 1,
+                    {"iteration": t + 1,
+                     "trees": [tuple(np.asarray(a) for a in tr)
+                               for tr in trees],
+                     "score": flat},
+                    fingerprint, ckpt_path, measures)
+
+    booster = Booster(
+        mapper, cfg, trees, [1.0] * len(trees), base,
+        feature_names=feature_names,
+        metadata={"streamed": {
+            "chunk_rows": C, "num_chunks": nchunks,
+            "rows": int(data.n_rows), "resident": bool(resident),
+            "sketch_exact": data.sketch_exact,
+            "chunk_boundaries_visited": int(step_base),
+        }})
+    return booster
+
+
+def predict_streamed(booster: Booster, batches: Iterable,
+                     chunk_rows: Optional[int] = None,
+                     depth: Optional[int] = None, **predict_kwargs):
+    """Out-of-core scoring: iterate raw ``X`` chunks (dense or scipy sparse)
+    through the shared pump and yield one prediction array per chunk. The
+    pump's synchronous lookahead dispatches the next chunk's quantize +
+    transfer while the consumer holds the previous result — the dl
+    ``_prefetch`` overlap shape applied to scoring."""
+    def src():
+        for chunk in batches:
+            X = chunk[0] if isinstance(chunk, tuple) else chunk
+            yield np.asarray(X.todense() if _is_sparse(X) else X, np.float32)
+
+    pump = ChunkPump(src(), place=None, depth=stream_depth(depth),
+                     threaded=False, name="gbdt-predict")
+    for X in pump:
+        yield np.asarray(booster.predict(X, **predict_kwargs))

@@ -1,0 +1,343 @@
+"""Shared double-buffered host→device ingestion layer — ONE chunk pump for
+every streaming consumer in the repo.
+
+Three loops used to own three ad-hoc prefetch pipelines: the dl trainer's
+``_prefetch`` deque (``TrainConfig.prefetch_batches``), the online loops'
+drain-poll thread, and (new, the reason this module exists) the out-of-core
+GBDT data plane (``gbdt/stream.py``), which re-streams the quantized feature
+matrix from host memory once per tree level. They now share this layer:
+
+:class:`ChunkPump`
+    A bounded-depth chunk pipeline. ``place(chunk)`` (typically a sharded
+    ``jax.device_put``) is applied to chunk ``k+1`` while the consumer
+    computes on chunk ``k`` — JAX dispatch is async, so merely HOLDING the
+    placed-but-unconsumed chunks keeps their host→device transfers in
+    flight. Two drive modes:
+
+    * ``threaded=False`` (dl default): a synchronous lookahead deque —
+      exactly the seed ``_prefetch`` semantics, no thread, transfers overlap
+      through async dispatch alone.
+    * ``threaded=True`` (gbdt streaming): a named non-daemon producer thread
+      pulls + places ahead of the consumer so the HOST side of a transfer
+      (pageable-memory copy, binning, decompression) also overlaps compute.
+      The thread is joined on EVERY exit path — ``__iter__`` closes the pump
+      in a ``finally`` so early consumer exits (break, error, preemption)
+      cannot leak it (tools/analysis resource-discipline scope).
+
+    Every chunk boundary is a :func:`~synapseml_tpu.core.checkpoint.
+    preemption_point` and an elastic-watchdog heartbeat (``phase=...``), so
+    the pump composes with the PR 2 checkpoint machinery and the PR 10
+    watchdogs for free: a ``ChaosPreemption`` kill lands BETWEEN chunks, the
+    producer is joined, and the consumer's snapshot/resume contract applies.
+
+:func:`pump_polling`
+    The drain-poll skeleton the online loops run: drive a DESTRUCTIVE
+    ``step()`` (e.g. ``FeedbackLog.drain`` + update) until ``stop`` is set,
+    sleeping ``interval`` when idle. Deliberately NOT a lookahead pump:
+    draining is destructive, and pre-draining in a producer thread would
+    break the preemption-before-drain invariant (a kill at the update
+    boundary must lose no event) — so the shared layer offers the polling
+    shape as a first-class primitive instead of forcing lookahead on it.
+
+Chunk geometry (:func:`stream_chunk_rows` / :func:`stream_depth`) resolves
+explicit arg > ``SYNAPSEML_TPU_STREAM_CHUNK_ROWS`` / ``_STREAM_DEPTH`` env >
+tuned file (``docs/tuned_defaults.json``, TPU-gated) > a one-time
+host→device bandwidth micro-probe recorded in the ``core/tuned.py``
+measurement store, capped by the ``SYNAPSEML_TPU_STREAM_MEM_BUDGET`` byte
+budget (the knob the out-of-core bench uses to simulate a 10x-undersized
+device). See docs/out-of-core.md.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable, Optional
+
+# Chunk-corruption hook for the chaos suite (testing/chaos.py installs it):
+# called as hook(k, chunk) -> chunk on the PRODUCER side before placement, so
+# an injected delay/truncation/kill exercises the exact path a slow or dying
+# data source would. Same single-global-hook pattern as dl.trainer's
+# _CHAOS_BATCH_HOOK.
+_CHAOS_CHUNK_HOOK = None
+
+_DONE = object()     # end-of-stream sentinel on the producer queue
+
+
+class ChunkStreamError(RuntimeError):
+    """The producer died mid-stream (source raised, or chaos killed it);
+    re-raised on the consumer side at the next chunk boundary."""
+
+
+class ChunkPump:
+    """Bounded-depth host→device chunk pipeline over ``source``.
+
+    ``source``: any iterable of host chunks. ``place``: chunk -> placed
+    chunk (``jax.device_put`` / sharding; identity when None). ``depth``:
+    chunks placed AHEAD of the one being consumed (double-buffering = 1+).
+    ``phase``: when set, each boundary fires ``preemption_point(phase,
+    step_base + k)`` and beats the installed elastic watchdog — the
+    composition contract chaos tests rely on. ``step_base`` keeps boundary
+    steps globally monotonic across the many pumps one training run opens
+    (each level pass is a fresh pump), so a chaos kill targets a unique
+    boundary.
+    """
+
+    def __init__(self, source: Iterable, place: Optional[Callable] = None,
+                 depth: int = 2, threaded: bool = False,
+                 phase: Optional[str] = None, step_base: int = 0,
+                 name: str = "ingest"):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._source = iter(source)
+        self._place = place if place is not None else (lambda c: c)
+        self.depth = int(depth)
+        self.threaded = bool(threaded)
+        self.phase = phase
+        self.step_base = int(step_base)
+        self.name = name
+        self.chunks_produced = 0     # pulled from source (producer side)
+        self.chunks_consumed = 0     # yielded to the consumer
+        self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._err: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- producer side ----------------------------------------------------
+    def _pull(self):
+        """One produce step: next source chunk → chaos hook → place."""
+        try:
+            chunk = next(self._source)
+        except StopIteration:
+            return _DONE
+        hook = _CHAOS_CHUNK_HOOK
+        if hook is not None:
+            chunk = hook(self.chunks_produced, chunk)
+        self.chunks_produced += 1
+        return self._place(chunk)
+
+    def _produce(self) -> None:
+        try:
+            while not self._stop.is_set():
+                item = self._pull()
+                if item is _DONE:
+                    break
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — ferried to the consumer
+            self._err = e
+        finally:
+            # always deliver end-of-stream; close() drains concurrently so
+            # this can never deadlock against a vanished consumer
+            while not self._stop.is_set():
+                try:
+                    self._q.put(_DONE, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
+
+    def _start(self) -> None:
+        if self._thread is None and not self._closed:
+            self._thread = threading.Thread(
+                target=self._produce, name=f"chunk-pump.{self.name}")
+            self._thread.start()
+
+    # -- consumer side ----------------------------------------------------
+    def _boundary(self) -> None:
+        """Chunk boundary: preemption point + watchdog heartbeat."""
+        step = self.step_base + self.chunks_consumed
+        if self.phase is not None:
+            from ..core.checkpoint import preemption_point
+
+            preemption_point(self.phase, step)
+        from ..parallel.elastic import current_watchdog
+
+        wd = current_watchdog()
+        if wd is not None:
+            wd.beat(self.phase or self.name, step)
+
+    def __iter__(self):
+        try:
+            if self.threaded:
+                self._start()
+                while True:
+                    item = self._q.get()
+                    if item is _DONE:
+                        if self._err is not None:
+                            raise ChunkStreamError(
+                                f"chunk producer {self.name!r} died at chunk "
+                                f"{self.chunks_produced}: {self._err!r}"
+                            ) from self._err
+                        return
+                    self._boundary()
+                    yield item
+                    self.chunks_consumed += 1
+            else:
+                # synchronous lookahead (the seed dl _prefetch semantics):
+                # refill BEFORE yielding so the next transfer is dispatched
+                # while the consumer computes on the popped chunk
+                q: deque = deque()
+                while len(q) < self.depth:
+                    item = self._pull()
+                    if item is _DONE:
+                        break
+                    q.append(item)
+                while q:
+                    out = q.popleft()
+                    item = self._pull()
+                    if item is not _DONE:
+                        q.append(item)
+                    self._boundary()
+                    yield out
+                    self.chunks_consumed += 1
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop the producer and JOIN it (idempotent; called from every
+        ``__iter__`` exit path and from ``__exit__``). The queue is drained
+        while joining so a blocked ``put`` can never wedge the join."""
+        self._stop.set()
+        t = self._thread
+        while t is not None and t.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(0.05)
+        self._thread = None
+        self._closed = True
+
+    def __enter__(self) -> "ChunkPump":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def pump_polling(step: Callable[[], bool], stop: threading.Event,
+                 interval: float,
+                 on_error: Optional[Callable[[Exception], None]] = None
+                 ) -> None:
+    """Drive a destructive drain ``step`` until ``stop`` is set.
+
+    ``step() -> bool`` returns whether it did work; idle iterations wait
+    ``interval`` on the stop event. ``Exception`` from a step is routed to
+    ``on_error`` (count + keep draining — a poisoned batch must not kill the
+    loop); ``BaseException`` (notably ``PreemptionError``) propagates and
+    kills the loop like a real SIGTERM would. This is the online loops'
+    ``_run`` body hoisted into the shared ingestion layer — the polling
+    shape, NOT a lookahead pump, because the step's drain is destructive and
+    must stay behind its own preemption point."""
+    while not stop.is_set():
+        try:
+            worked = step()
+        except Exception as e:  # noqa: BLE001 — loop must outlive bad input
+            if on_error is not None:
+                on_error(e)
+            worked = False
+        if not worked:
+            stop.wait(interval)
+
+
+# ---------------------------------------------------------------------------
+# Chunk geometry: explicit > env > tuned file > measured micro-probe
+# ---------------------------------------------------------------------------
+
+_PROBE_BYTES = 4 << 20         # one device_put of 4 MiB prices the link
+_TARGET_CHUNK_S = 8e-3         # chunk ≈ 8 ms of transfer: deep enough to
+                               # amortize dispatch, shallow enough that
+                               # depth×chunk stays a sliver of device memory
+_MIN_CHUNK_ROWS = 1024
+_MAX_CHUNK_ROWS = 1 << 20
+_FALLBACK_CHUNK_ROWS = 65536
+
+
+def _probe_h2d_bandwidth() -> float:
+    """Measured host→device bytes/s (one-time; cached in the core/tuned.py
+    measurement store under ``("h2d_bytes_per_s", platform)``)."""
+    import jax
+    import numpy as np
+
+    buf = np.zeros(_PROBE_BYTES, np.uint8)
+    jax.device_put(buf[:1024]).block_until_ready()      # warm the path
+    t0 = time.perf_counter()
+    jax.device_put(buf).block_until_ready()
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return _PROBE_BYTES / dt
+
+
+def mem_budget_bytes() -> Optional[int]:
+    """The simulated device-memory cap for streaming chunk state
+    (``SYNAPSEML_TPU_STREAM_MEM_BUDGET``, bytes), or None. The out-of-core
+    bench sets this to dataset_bytes/10 to prove ≥10x-beyond-memory
+    training on CPU hosts that have no real HBM wall."""
+    v = os.environ.get("SYNAPSEML_TPU_STREAM_MEM_BUDGET")
+    if not v:
+        return None
+    return max(int(v), 1)
+
+
+def stream_chunk_rows(row_bytes: int, explicit: Optional[int] = None,
+                      depth: int = 2) -> int:
+    """Rows per streamed chunk for rows of ``row_bytes`` each.
+
+    Resolution: ``explicit`` arg > ``SYNAPSEML_TPU_STREAM_CHUNK_ROWS`` env >
+    tuned file ``stream_chunk_rows`` (TPU-gated, docs/tuned_defaults.json) >
+    bandwidth micro-probe (chunk ≈ ``_TARGET_CHUNK_S`` of measured link
+    time). Whatever wins is then capped so ``(depth+1)`` in-flight chunks
+    fit the ``SYNAPSEML_TPU_STREAM_MEM_BUDGET`` byte budget when one is
+    set."""
+    from ..core import tuned as _tuned
+
+    row_bytes = max(int(row_bytes), 1)
+    rows = explicit
+    if rows is None:
+        env = os.environ.get("SYNAPSEML_TPU_STREAM_CHUNK_ROWS")
+        if env:
+            rows = int(env)
+    if rows is None:
+        v = _tuned.tuned_engine_defaults().get("stream_chunk_rows")
+        if v is not None:
+            rows = int(v)
+    if rows is None:
+        plat = _tuned.initialized_platform()
+        if plat is None:
+            rows = _FALLBACK_CHUNK_ROWS
+        else:
+            bw = _tuned.measured_or(("h2d_bytes_per_s", plat),
+                                    _probe_h2d_bandwidth)
+            rows = int(bw * _TARGET_CHUNK_S / row_bytes)
+        # the [min, max] clamp disciplines only the PROBE estimate — an
+        # explicit/env/tuned value is operator intent and wins as given
+        rows = min(max(rows, _MIN_CHUNK_ROWS), _MAX_CHUNK_ROWS)
+    rows = max(int(rows), 1)
+    budget = mem_budget_bytes()
+    if budget is not None:
+        cap = budget // (row_bytes * (int(depth) + 1))
+        rows = max(min(rows, cap), 1)
+    return rows
+
+
+def stream_depth(explicit: Optional[int] = None) -> int:
+    """In-flight chunk depth: explicit > ``SYNAPSEML_TPU_STREAM_DEPTH`` env >
+    tuned file ``stream_depth`` > 2 (double buffering)."""
+    from ..core import tuned as _tuned
+
+    if explicit is not None:
+        return max(int(explicit), 1)
+    env = os.environ.get("SYNAPSEML_TPU_STREAM_DEPTH")
+    if env:
+        return max(int(env), 1)
+    v = _tuned.tuned_engine_defaults().get("stream_depth")
+    if v is not None:
+        return max(int(v), 1)
+    return 2

@@ -161,18 +161,21 @@ class StreamLoop:
         return self
 
     def _run(self) -> None:
-        while not self._stop.is_set():
-            try:
-                worked = self.step()
-            except Exception as e:  # noqa: BLE001 — the loop must outlive
-                # a poisoned batch; PreemptionError is BaseException and
-                # still kills the thread like a real SIGTERM would
-                self.errors += 1
-                record_failure(f"{self.counter_prefix}.update_error",
-                               error=type(e).__name__)
-                worked = False
-            if not worked:
-                self._stop.wait(self.drain_interval)
+        # the drain-poll skeleton lives in the shared ingestion layer
+        # (io/ingest.py pump_polling — deliberately the POLLING shape, not a
+        # lookahead pump: step()'s drain is destructive and must stay behind
+        # its own preemption point). Exception → count + keep draining;
+        # PreemptionError is BaseException and still kills the thread like a
+        # real SIGTERM would.
+        from ..io.ingest import pump_polling  # lazy: io/__init__ is heavy
+
+        def on_error(e: Exception) -> None:
+            self.errors += 1
+            record_failure(f"{self.counter_prefix}.update_error",
+                           error=type(e).__name__)
+
+        pump_polling(self.step, self._stop, self.drain_interval,
+                     on_error=on_error)
 
     def close(self, timeout: float = 5.0, final_snapshot: bool = False) -> None:
         """Stop and JOIN the drain thread, then optionally take one last

@@ -537,6 +537,107 @@ def bit_flip(ckpt_dir: str, offset: Optional[int] = None, bit: int = 3) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Chunk-stream chaos: slow, truncated, and killed-mid-chunk data sources
+# (tests/test_oocore.py drives it on CPU; the asserted properties are the
+# out-of-core invariants — a dying producer surfaces as ChunkStreamError with
+# its thread joined, and a preemption kill at a chunk boundary resumes
+# bit-for-bit through the CheckpointStore)
+# ---------------------------------------------------------------------------
+
+class chaos_chunk_stream:
+    """Context manager corrupting the shared ingestion layer's producer side
+    — the deterministic stand-in for a slow, truncating, or dying data
+    source feeding :class:`~synapseml_tpu.io.ingest.ChunkPump`.
+
+    Installs ``io.ingest._CHAOS_CHUNK_HOOK``, called as ``hook(k, chunk) ->
+    chunk`` on the producer side (inside the pump thread for threaded pumps)
+    before placement — exactly where a real loader stalls or dies. Per-pump
+    chunk index ``k`` selects the fault:
+
+    * ``delay`` — mapping of chunk index to seconds slept before the chunk
+      is delivered (a stalled NFS read / slow decompression); the consumer
+      must simply absorb the latency.
+    * ``truncate_at`` — from this chunk index on, rows are sliced to
+      ``truncate_rows`` (a short read). With the default 0 rows this
+      produces an EMPTY chunk — downstream shape checks must reject it
+      loudly rather than train on garbage.
+    * ``kill_at`` — the producer raises :class:`FaultInjected` at this chunk
+      index (the source process died mid-stream). The pump contract:
+      the consumer sees :class:`~synapseml_tpu.io.ingest.ChunkStreamError`
+      at its next boundary and the producer thread is joined.
+
+    Faults fire on EVERY pump that passes the index (a training run opens a
+    fresh pump per pass), subject to ``max_faults`` (default: unlimited for
+    delays, 1 for kills — a resumed run must survive the same chunk).
+    ``seen`` records every (k, rows) the hook observed; ``faults`` every
+    injected corruption. Nesting is not supported (single global hook)."""
+
+    def __init__(self, delay: Optional[dict] = None,
+                 truncate_at: Optional[int] = None, truncate_rows: int = 0,
+                 kill_at: Optional[int] = None, max_kills: int = 1):
+        self.delay = {int(k): float(v) for k, v in (delay or {}).items()}
+        self.truncate_at = truncate_at
+        self.truncate_rows = int(truncate_rows)
+        self.kill_at = kill_at
+        self.max_kills = int(max_kills)
+        self.seen: List[Tuple[int, int]] = []
+        self.faults: List[Tuple[str, int]] = []
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _rows(chunk) -> int:
+        # chunks are arrays or tuples of arrays; rows = leading dim of the
+        # first array-like element
+        first = chunk[0] if isinstance(chunk, tuple) else chunk
+        try:
+            return int(getattr(first, "shape", (len(first),))[0])
+        except TypeError:
+            return -1
+
+    def _truncate(self, chunk):
+        n = self.truncate_rows
+        if isinstance(chunk, tuple):
+            return tuple(c[:n] if hasattr(c, "__getitem__") else c
+                         for c in chunk)
+        return chunk[:n]
+
+    def _hook(self, k: int, chunk):
+        with self._lock:
+            self.seen.append((k, self._rows(chunk)))
+            sleep_s = self.delay.get(k, 0.0)
+            kill = (self.kill_at is not None and k == self.kill_at
+                    and sum(1 for f, _ in self.faults if f == "kill")
+                    < self.max_kills)
+            trunc = (self.truncate_at is not None and k >= self.truncate_at)
+            if sleep_s:
+                self.faults.append(("delay", k))
+            if kill:
+                self.faults.append(("kill", k))
+            elif trunc:
+                self.faults.append(("truncate", k))
+        if sleep_s:
+            time.sleep(sleep_s)
+        if kill:
+            raise FaultInjected(f"chaos: chunk source died at chunk {k}")
+        if trunc:
+            return self._truncate(chunk)
+        return chunk
+
+    def __enter__(self) -> "chaos_chunk_stream":
+        from ..io import ingest as _ing
+
+        if _ing._CHAOS_CHUNK_HOOK is not None:
+            raise RuntimeError("chaos_chunk_stream does not nest")
+        _ing._CHAOS_CHUNK_HOOK = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from ..io import ingest as _ing
+
+        _ing._CHAOS_CHUNK_HOOK = None
+
+
+# ---------------------------------------------------------------------------
 # Serving-fabric chaos: worker kills, heartbeat partitions, kill-mid-swap
 # (tests/test_fabric.py drives all of it on CPU; the asserted property is the
 # fabric invariant — an ACCEPTED request (non-503) is never dropped: it

@@ -34,6 +34,7 @@ DESCRIPTION = ("sockets/threads/executors/files opened in the serving, "
 
 SCOPE = ("synapseml_tpu/io/serving.py",
          "synapseml_tpu/io/distributed_serving.py",
+         "synapseml_tpu/io/ingest.py",
          "synapseml_tpu/io/portforward.py",
          "synapseml_tpu/core/fabric.py",
          "synapseml_tpu/online/",

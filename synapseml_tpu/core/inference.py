@@ -329,3 +329,60 @@ class BucketedRunner:
     def __repr__(self) -> str:
         return (f"BucketedRunner({self.name!r}, buckets={list(self.buckets)},"
                 f" compiled={len(self._compiled)})")
+
+
+class RunnerFleet:
+    """Per-tenant accounting over a SHARED runner pool.
+
+    The multi-tenant serving fleet (docs/resilience.md, "Multi-tenant
+    fleet") runs N tenants' models through one worker process and one
+    on-disk compile cache; each tenant's handler carries its own
+    :class:`BucketedRunner`, and this registry is the fleet-wide view:
+    ``register(tenant, runner)``, ``warm_all()`` off the hot path, and
+    :meth:`stats` — per-tenant compile/hit counters plus fleet totals, the
+    numbers ``bench_multitenant`` and the shared-cache accounting test
+    assert on. Thread-safe; runners stay owned by their handlers (this
+    holds references, never copies)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._runners: Dict[str, BucketedRunner] = {}
+
+    def register(self, tenant: str, runner: BucketedRunner
+                 ) -> "RunnerFleet":
+        with self._lock:
+            self._runners[tenant] = runner
+        return self
+
+    def runner(self, tenant: str) -> Optional[BucketedRunner]:
+        with self._lock:
+            return self._runners.get(tenant)
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._runners)
+
+    def warm_all(self, templates: Dict[str, tuple]) -> dict:
+        """AOT-warm every registered runner whose tenant has a template
+        tuple in ``templates`` (one array-like per runner argument);
+        returns :meth:`stats` after the sweep."""
+        with self._lock:
+            items = list(self._runners.items())
+        for tenant, runner in items:
+            tmpl = templates.get(tenant)
+            if tmpl is not None:
+                runner.warmup(*tmpl)
+        return self.stats()
+
+    def stats(self) -> dict:
+        """{"tenants": {tenant: runner stats}, "total_compiles",
+        "total_hits"} — the shared-fleet accounting: compiles are what the
+        fleet PAID (once per (runner, bucket, spec)), hits are what each
+        tenant's traffic reused."""
+        with self._lock:
+            items = list(self._runners.items())
+        per = {t: r.stats() for t, r in items}
+        return {"tenants": per,
+                "total_compiles": sum(s["total_compiles"]
+                                      for s in per.values()),
+                "total_hits": sum(s["total_hits"] for s in per.values())}

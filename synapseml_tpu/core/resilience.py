@@ -314,6 +314,22 @@ class Membership:
             self.evictions += 1
             return True
 
+    def evict_stale(self, now: Optional[float] = None) -> list:
+        """Evict every expired member in one sweep and return those evicted.
+
+        :meth:`expired` + :meth:`evict` only run when something consults the
+        table (the routing/health path) — an IDLE gateway holds dead workers
+        indefinitely. Supervisor loops call this on their own cadence so
+        membership decays even with zero traffic; each eviction is counted
+        under ``fabric.evicted_idle``."""
+        stale = self.expired(now)
+        evicted = [m for m in stale if self.evict(m)]
+        if evicted:
+            from .logging import record_failure
+            record_failure("fabric.evicted_idle", n=len(evicted),
+                           members=[str(m) for m in evicted])
+        return evicted
+
     def members(self) -> list:
         with self._lock:
             return list(self._last)

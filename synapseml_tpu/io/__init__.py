@@ -13,7 +13,8 @@ micro-batches through a fitted pipeline.
 from .http import (CustomInputParser, CustomOutputParser, HTTPRequestData,
                    HTTPResponseData, HTTPTransformer, JSONInputParser,
                    JSONOutputParser, SimpleHTTPTransformer, StringOutputParser)
-from .distributed_serving import (DistributedServingServer, FabricSupervisor,
+from .distributed_serving import (BroadcastError, DistributedServingServer,
+                                  FabricSupervisor, PromotionBroadcast,
                                   ServingGateway, WorkerAgent)
 from .serving import (ModelRegistry, ServingServer, SwapError,
                       request_to_table, respond_with)
@@ -26,6 +27,7 @@ __all__ = [
     "JSONOutputParser", "StringOutputParser", "CustomOutputParser",
     "ServingServer", "ServingGateway", "DistributedServingServer",
     "WorkerAgent", "FabricSupervisor", "ModelRegistry", "SwapError",
+    "PromotionBroadcast", "BroadcastError",
     "request_to_table", "respond_with",
     "read_binary_files", "read_image_dir", "PowerBIWriter",
 ]

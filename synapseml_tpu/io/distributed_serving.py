@@ -56,10 +56,11 @@ import uuid
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core.logging import record_failure
+from ..core.qos import DEFAULT_TENANT, TENANT_HEADER
 from ..core.resilience import (DEADLINE_HEADER, CircuitBreaker, Deadline,
                                Membership)
 from ..core.table import Table
-from .serving import ServingServer, _PendingRequest
+from .serving import ModelRegistry, ServingServer, _PendingRequest
 
 #: Gateway control-plane path prefix — requests here are membership traffic,
 #: never forwarded to a worker.
@@ -137,7 +138,8 @@ class _WorkerLink:
     depth, model version) rides on the link for routing reads."""
 
     def __init__(self, host: str, port: int, timeout: float,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 tenant_breaker_factory: Optional[Callable] = None):
         self.host, self.port = host, port
         self.timeout = timeout
         self.inflight = 0
@@ -150,6 +152,18 @@ class _WorkerLink:
         self.warm_buckets: Tuple[int, ...] = ()
         self.queue_depth: int = 0
         self.version: Optional[str] = None
+        # per-(tenant, model) advertisement: tenant -> {"version",
+        # "warm_buckets"} — the multi-tenant warm-ladder/version routing
+        # inputs (advisory, like everything heartbeat-carried)
+        self.tenants: Dict[str, dict] = {}
+        # per-tenant passive health: the LINK breaker is transport-level
+        # (this worker is unreachable for everyone); a TENANT breaker is
+        # "this worker is 5xxing tenant T" (bad model version, poisoned
+        # state) — T's traffic skips the replica while other tenants keep
+        # using it
+        self._tenant_breaker_factory = tenant_breaker_factory or \
+            CircuitBreaker
+        self.tenant_breakers: Dict[str, CircuitBreaker] = {}
         self._pool: "queue.LifoQueue[http.client.HTTPConnection]" = \
             queue.LifoQueue()
         self._lock = threading.Lock()
@@ -185,13 +199,66 @@ class _WorkerLink:
                     pass
             if "version" in info and info["version"] is not None:
                 self.version = str(info["version"])
+            if isinstance(info.get("tenants"), dict):
+                tenants = {}
+                for t, entry in info["tenants"].items():
+                    if not isinstance(entry, dict):
+                        continue    # advisory: garbage degrades
+                    parsed = {}
+                    if entry.get("version") is not None:
+                        parsed["version"] = str(entry["version"])
+                    try:
+                        parsed["warm_buckets"] = tuple(sorted(
+                            int(b) for b in entry.get("warm_buckets", ())))
+                    except (TypeError, ValueError):
+                        parsed["warm_buckets"] = ()
+                    tenants[str(t)] = parsed
+                self.tenants = tenants
 
-    def covers_bucket(self, rows: int) -> bool:
+    def covers_bucket(self, rows: int,
+                      tenant: Optional[str] = None) -> bool:
         """Does this worker's advertised warm ladder already hold a compiled
-        bucket for a ``rows``-row micro-batch? False when nothing was ever
+        bucket for a ``rows``-row micro-batch? With a tenant, THAT tenant's
+        advertised ladder is consulted (falling back to the worker-wide one
+        when the tenant never advertised). False when nothing was ever
         advertised — staleness degrades to load-based routing."""
         with self._lock:
-            return any(rows <= b for b in self.warm_buckets)
+            ladder = self.warm_buckets
+            if tenant is not None:
+                entry = self.tenants.get(tenant)
+                if entry is not None and entry.get("warm_buckets"):
+                    ladder = entry["warm_buckets"]
+            return any(rows <= b for b in ladder)
+
+    def tenant_available(self, tenant: Optional[str], now: float) -> bool:
+        """Non-mutating per-tenant health read (selection-loop safe); a
+        tenant with no breaker yet is healthy by definition."""
+        if tenant is None:
+            return True
+        with self._lock:
+            breaker = self.tenant_breakers.get(tenant)
+        return breaker is None or breaker.available(now)
+
+    def mark_tenant(self, tenant: Optional[str], ok: bool) -> None:
+        """Feed a forwarded reply's verdict to the tenant's breaker: 5xx
+        replies for tenant T on this replica eventually OPEN (T's traffic
+        skips it) without touching the transport breaker or other
+        tenants."""
+        if tenant is None:
+            return
+        with self._lock:
+            breaker = self.tenant_breakers.get(tenant)
+            if breaker is None:
+                if ok:
+                    return          # no state to close; don't allocate
+                breaker = self.tenant_breakers[tenant] = \
+                    self._tenant_breaker_factory()
+        if ok:
+            breaker.record_success()
+        else:
+            breaker.record_failure()
+            record_failure("gateway.tenant_backend_failure",
+                           worker=self.url, tenant=tenant)
 
     def close(self) -> None:
         """Free routing state on eviction: every pooled keep-alive
@@ -256,7 +323,14 @@ class _WorkerLink:
             member = {"worker_id": self.worker_id,
                       "warm_buckets": list(self.warm_buckets),
                       "queue_depth": self.queue_depth,
-                      "version": self.version}
+                      "version": self.version,
+                      "tenants": {
+                          t: {**{k: (list(v) if isinstance(v, tuple)
+                                     else v) for k, v in e.items()},
+                              **({"breaker": self.tenant_breakers[t]
+                                  .snapshot()}
+                                 if t in self.tenant_breakers else {})}
+                          for t, e in self.tenants.items()}}
         return {"url": self.url, "inflight": self.inflight,
                 "ok": self.ok_count, "failed": self.fail_count,
                 "down": not self.breaker.available(now),
@@ -367,10 +441,10 @@ class ServingGateway:
     # --- membership -----------------------------------------------------
     def _make_link(self, url: str) -> _WorkerLink:
         h, p = _parse_hostport(url)
-        return _WorkerLink(
-            h, p, self.forward_timeout,
-            breaker=CircuitBreaker(failure_threshold=self.breaker_threshold,
-                                   cooldown=self.cooldown))
+        mk = lambda: CircuitBreaker(  # noqa: E731
+            failure_threshold=self.breaker_threshold, cooldown=self.cooldown)
+        return _WorkerLink(h, p, self.forward_timeout, breaker=mk(),
+                           tenant_breaker_factory=mk)
 
     def register_worker(self, url: str, **info) -> _WorkerLink:
         """Programmatic join: add (or refresh) a worker link on a RUNNING
@@ -387,7 +461,7 @@ class ServingGateway:
                 self.links.append(link)
         admitted = self.membership.beat(canonical, **{
             k: v for k, v in info.items() if k in (
-                "queue_depth", "warm_buckets", "version", "id")})
+                "queue_depth", "warm_buckets", "version", "id", "tenants")})
         link.update_membership(info)
         if created:
             self.stats.incr("rejoined" if admitted == "rejoin"
@@ -429,6 +503,20 @@ class ServingGateway:
         thread to leak."""
         for url in self.membership.expired():
             self._evict(url, reason="evicted")
+
+    def evict_stale(self) -> list:
+        """Explicit idle sweep: the lazy :meth:`_sweep_expired` only runs
+        on the routing/health path, so a gateway receiving ZERO traffic
+        holds dead workers indefinitely. Supervisor loops
+        (:meth:`FabricSupervisor.step`) call this on their own cadence;
+        evictions are counted under ``fabric.evicted_idle``."""
+        stale = self.membership.expired()
+        evicted = [url for url in stale if self._evict(url,
+                                                       reason="evicted")]
+        if evicted:
+            record_failure("fabric.evicted_idle", n=len(evicted),
+                           members=[str(u) for u in evicted])
+        return evicted
 
     def _handle_control(self, path: str, body: bytes) -> Tuple[int, dict]:
         """Membership control-plane dispatch for ``/__fabric/*`` POSTs."""
@@ -484,49 +572,67 @@ class ServingGateway:
             return None
 
     def _pick(self, exclude: set,
-              hint: Optional[Tuple[int, Optional[tuple]]] = None
-              ) -> Optional[_WorkerLink]:
+              hint: Optional[Tuple[int, Optional[tuple]]] = None,
+              tenant: Optional[str] = None) -> Optional[_WorkerLink]:
         now = self._clock()
         self._sweep_expired()
         with self._lock:
             up = [l for l in self.links
-                  if id(l) not in exclude and l.breaker.available(now)]
+                  if id(l) not in exclude and l.breaker.available(now)
+                  and l.tenant_available(tenant, now)]
             if not up:
                 # every remaining worker's breaker is OPEN inside its
-                # cooldown: fail fast (the breaker's whole point) instead of
-                # dialing known-bad backends
+                # cooldown (transport-wide, or for THIS tenant): fail fast
+                # (the breaker's whole point) instead of dialing known-bad
+                # backends
                 return None
             if self.mode == "round_robin":
                 self._rr += 1
                 order = up[self._rr % len(up):] + up[:self._rr % len(up)]
             else:
-                order = self._bucket_aware_order(up, hint)
+                order = self._bucket_aware_order(up, hint, tenant)
             # try_acquire consumes the single half-open probe slot; a link
             # that loses the probe race falls through to the next candidate
             for link in order:
                 if link.breaker.try_acquire(now):
                     if hint is not None and hint[1] is not None:
-                        self._pin_affinity(hint[1], link.url)
+                        self._pin_affinity((tenant, hint[1]), link.url)
                     return link
             return None
 
-    def _bucket_aware_order(self, up: List[_WorkerLink],
-                            hint) -> List[_WorkerLink]:
+    def _bucket_aware_order(self, up: List[_WorkerLink], hint,
+                            tenant: Optional[str] = None
+                            ) -> List[_WorkerLink]:
         """Least-loaded order, upgraded by routing hints when present:
         (1) replicas whose advertised warm ladder already covers the
         request's bucket sort first (an AOT-cache hit beats an idle replica
-        that would pay an XLA compile), (2) the shape's sticky affinity
-        replica wins ties (same-shape traffic concentrates one cache), and
-        (3) in-flight load breaks the rest. With no hint — or stale/absent
-        bucket info — this IS plain least-loaded. Caller holds _lock."""
+        that would pay an XLA compile) — per-TENANT ladders when the
+        workers advertise them, (2) the (tenant, shape) sticky affinity
+        replica wins ties (each tenant's same-shape traffic concentrates
+        one cache), and (3) in-flight load breaks the rest. With no hint —
+        or stale/absent bucket info — this IS plain least-loaded. Caller
+        holds _lock."""
         if hint is None:
             return sorted(up, key=lambda l: l.inflight)
         rows, key = hint
-        sticky = self._affinity.get(key) if key is not None else None
+        sticky = (self._affinity.get((tenant, key))
+                  if key is not None else None)
         return sorted(up, key=lambda l: (
-            0 if l.covers_bucket(rows) else 1,
+            0 if l.covers_bucket(rows, tenant) else 1,
             0 if sticky is not None and l.url == sticky else 1,
             l.inflight))
+
+    def _tenant_blocked(self, tenant: Optional[str]) -> bool:
+        """Is the fabric up but THIS tenant quarantined on every reachable
+        replica? That is a per-tenant 503 (the tenant's own isolation
+        boundary), not a 502 (fabric down)."""
+        if tenant is None:
+            return False
+        now = self._clock()
+        with self._lock:
+            up = [l for l in self.links if l.breaker.available(now)]
+            return bool(up) and not any(
+                l.tenant_available(tenant, now) for l in up)
 
     def _pin_affinity(self, key, url: str) -> None:
         # caller holds _lock
@@ -538,7 +644,8 @@ class ServingGateway:
     def _forward(self, method: str, path: str, body: bytes,
                  headers: Dict[str, str],
                  deadline: Optional[Deadline] = None,
-                 hint: Optional[tuple] = None) -> tuple:
+                 hint: Optional[tuple] = None,
+                 tenant: Optional[str] = None) -> tuple:
         tried: set = set()
         last_err = None
         last_shed: Optional[tuple] = None
@@ -552,7 +659,7 @@ class ServingGateway:
             if deadline is not None and deadline.expired():
                 record_failure("gateway.deadline_expired")
                 return 504, b'{"error": "deadline exceeded at gateway"}'
-            link = self._pick(tried, hint)
+            link = self._pick(tried, hint, tenant)
             if link is None:
                 break
             tried.add(id(link))
@@ -564,11 +671,17 @@ class ServingGateway:
                     headers = {**headers,
                                DEADLINE_HEADER: deadline.header_value()}
                 if link is self._local_link:
-                    status, payload = self._forward_local(body, deadline)
+                    status, payload = self._forward_local(body, deadline,
+                                                          tenant)
                 else:
                     status, payload = link.forward(method, path, body,
                                                    headers)
                 link.mark_ok()
+                # per-tenant passive health: 5xx replies (handler throw,
+                # NaN guard, bad version) count against THIS replica for
+                # THIS tenant; anything below 500 — including the
+                # tenant's own 429s — is a healthy replica for it
+                link.mark_tenant(tenant, ok=status < 500)
                 if status == 503:
                     # shed failover: a 503 is the worker's backpressure
                     # (admission queue full or draining), not a broken
@@ -595,13 +708,23 @@ class ServingGateway:
             # (client backoff), not a 502 pretending the fabric is down
             self.stats.incr("forwarded")
             return last_shed
+        if self._tenant_blocked(tenant):
+            # the fabric is up — it is THIS tenant that is open-circuited
+            # on every replica (bad version, NaN storm): a per-tenant 503
+            # at the gateway boundary, never a 502 that would read as a
+            # fabric outage to every other tenant's operators
+            self.stats.incr("forwarded")
+            record_failure("gateway.tenant_quarantined", tenant=tenant)
+            return 503, _json.dumps(
+                {"error": "tenant quarantined", "tenant": tenant}).encode()
         self.stats.incr("failed")
         record_failure("gateway.no_backend")
         return 502, (b'{"error": "no serving worker reachable: %s"}'
                      % str(last_err).encode()[:200])
 
     def _forward_local(self, body: bytes,
-                       deadline: Optional[Deadline] = None) -> tuple:
+                       deadline: Optional[Deadline] = None,
+                       tenant: Optional[str] = None) -> tuple:
         """In-process fast path: enqueue into the co-located worker's
         micro-batch queue and wait for its reply-by-id, skipping the
         loopback HTTP hop entirely."""
@@ -610,6 +733,13 @@ class ServingGateway:
             # queue accepts puts forever, but a stopped serve loop never
             # replies and a draining one should shed
             raise ConnectionError("local serving worker is stopped/draining")
+        if tenant is not None and self._local.qos is not None:
+            # the fast path honors the worker's per-tenant QoS boundary
+            # exactly like its HTTP admission would
+            decision = self._local.qos.admit(tenant)
+            if not decision.ok:
+                return decision.status, _json.dumps(
+                    {"error": decision.reason, "tenant": tenant}).encode()
         budget = min(self.forward_timeout, self._local.reply_timeout)
         if deadline is not None:
             budget = min(budget, deadline.remaining())
@@ -617,9 +747,11 @@ class ServingGateway:
             id=uuid.uuid4().hex, method="POST", path=self.api_path,
             headers={}, body=body, deadline=Deadline.after(budget),
             admitted_at=time.monotonic(),
-            # the fast path pins the active handler version exactly like
+            # the fast path pins the active (tenant, version) exactly like
             # the worker's own admission path (hot-swap consistency)
-            handler=self._local.handler)
+            handler=(self._local.handler if tenant is None
+                     else self._local.handler_for(tenant)),
+            tenant=tenant if tenant is not None else DEFAULT_TENANT)
         try:
             self._local._queue.put_nowait(req)
         except queue.Full:
@@ -663,6 +795,13 @@ class ServingGateway:
                 fwd_headers = {"Content-Type": self.headers.get(
                     "Content-Type", "application/json"),
                     "Content-Length": str(len(body))}
+                tenant = self.headers.get(TENANT_HEADER)
+                if tenant:
+                    tenant = tenant.strip() or None
+                if tenant:
+                    # the tenant identity rides every hop: the worker's own
+                    # QoS admission and handler pinning key on it
+                    fwd_headers[TENANT_HEADER] = tenant
                 # no header -> no gateway deadline (forward_timeout already
                 # bounds each attempt; a synthetic deadline equal to it
                 # would starve the sibling retry). An explicit budget is
@@ -678,7 +817,8 @@ class ServingGateway:
                 status, payload = outer._forward(
                     "POST", outer.api_path, body, fwd_headers,
                     deadline=deadline,
-                    hint=outer._shape_hint(body, self.headers))
+                    hint=outer._shape_hint(body, self.headers),
+                    tenant=tenant)
                 self._reply_json(status, payload)
 
             def do_GET(self):  # noqa: N802  — health/stats endpoint
@@ -773,6 +913,26 @@ class WorkerAgent:
         registry = getattr(self.worker, "registry", None)
         if registry is not None:
             p["version"] = registry.active
+        # per-(tenant, model) advertisement: each tenant's active version
+        # and warm AOT ladder, so the gateway can route (tenant, shape) →
+        # warmest replica and spot mixed-version fabrics per tenant
+        tenants = {}
+        for t, h in dict(self.worker.tenant_handlers).items():
+            entry: dict = {}
+            reg = self.worker.registries.get(t)
+            if reg is not None:
+                entry["version"] = reg.active
+            runner = getattr(h, "runner", None)
+            if runner is not None and callable(
+                    getattr(runner, "warm_buckets", None)):
+                try:
+                    entry["warm_buckets"] = [
+                        int(b) for b in runner.warm_buckets()]
+                except Exception:  # noqa: BLE001 — advisory
+                    pass
+            tenants[t] = entry
+        if tenants:
+            p["tenants"] = tenants
         return p
 
     def _post(self, op: str, payload: dict) -> None:
@@ -887,7 +1047,11 @@ class FabricSupervisor:
         return None
 
     def step(self) -> Optional[str]:
-        """Observe -> decide -> act once; returns the action taken."""
+        """Observe -> decide -> act once; returns the action taken. Each
+        step also runs the explicit membership sweep — the supervisor is
+        the "own cadence" caller :meth:`ServingGateway.evict_stale` needs
+        so an idle fabric still decays dead workers."""
+        self.gateway.evict_stale()
         n, depth = self.observe()
         action = self.decide(n, depth)
         if action == "up":
@@ -926,6 +1090,116 @@ class FabricSupervisor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=self.interval + 1.0)
+
+
+class BroadcastError(RuntimeError):
+    """A fabric-wide promotion broadcast failed AND recovery converged the
+    fabric back to the old version (or could not complete at all). Either
+    way no worker is left on a half-promoted version — the error reports
+    that the NEW version did not take, not that the fabric is mixed."""
+
+
+class PromotionBroadcast:
+    """Two-phase fabric-wide promotion: one gate approval flips EVERY
+    worker's registry to the same version, atomically per worker, with no
+    mixed-version fabric on any failure path.
+
+    Phase 1 — **prepare**: each worker's :class:`~synapseml_tpu.io.serving.
+    ModelRegistry` stages and AOT-warms the candidate OFF its hot path
+    (:meth:`ModelRegistry.prepare`), holding its swap lock so racing
+    single-shot swaps lose deterministically. Any prepare failure aborts
+    every already-prepared worker → the fabric never left the old version.
+
+    Phase 2 — **commit**: each worker flips (:meth:`ModelRegistry.commit`).
+    A commit failure (injected kill at the ``commit`` swap-point) leaves
+    that worker's version STAGED with the lock held, so the broadcast first
+    retries the commit (kill-once chaos converges forward: all workers on
+    the NEW version). If a worker still cannot commit, recovery converges
+    BACKWARD instead: its stage is aborted and every already-committed
+    worker rolls back — all workers on the OLD gate-approved version.
+
+    Single-coordinator, single-thread by design (the registries' swap locks
+    are owned by the calling thread between prepare and commit); the
+    coordinator itself dying mid-broadcast leaves each worker either fully
+    on the old version (staged-but-uncommitted prepares hold only a lock in
+    the dead coordinator's thread — their OLD handler never stopped
+    serving) or fully on the new one, which is exactly the per-worker
+    atomicity the chaos test kills against.
+    """
+
+    def __init__(self, registries: Sequence[ModelRegistry],
+                 commit_retries: int = 1):
+        if not registries:
+            raise ValueError("broadcast needs at least one registry")
+        self.registries = list(registries)
+        self.commit_retries = commit_retries
+        self.broadcasts = 0
+        self.aborted = 0
+        self.rolled_back = 0
+
+    def active_versions(self) -> List[str]:
+        return [r.active for r in self.registries]
+
+    def converged(self) -> bool:
+        """All workers on one version — the no-mixed-fabric invariant."""
+        return len(set(self.active_versions())) == 1
+
+    def broadcast(self, version: str, handler: Callable,
+                  warmup: bool = True) -> str:
+        old = {id(r): r.active for r in self.registries}
+        prepared: List[ModelRegistry] = []
+        try:
+            for reg in self.registries:
+                reg.prepare(version, handler, warmup=warmup)
+                prepared.append(reg)
+        except Exception as e:  # noqa: BLE001 — abort-all: old version holds
+            for reg in prepared:
+                reg.abort()
+            self.aborted += 1
+            record_failure("gateway.broadcast_aborted", version=version,
+                           stage="prepare", error=type(e).__name__)
+            raise BroadcastError(
+                f"prepare of {version!r} failed on worker "
+                f"{len(prepared)}/{len(self.registries)} "
+                f"({type(e).__name__}: {e}); every worker is still on its "
+                "old version") from e
+        committed: List[ModelRegistry] = []
+        failed: List[ModelRegistry] = []
+        for reg in self.registries:
+            for attempt in range(1 + self.commit_retries):
+                try:
+                    reg.commit(version)
+                    committed.append(reg)
+                    break
+                except Exception as e:  # noqa: BLE001
+                    record_failure("gateway.broadcast_commit_retry",
+                                   version=version,
+                                   error=type(e).__name__)
+                    if attempt == self.commit_retries:
+                        failed.append(reg)
+        if not failed:
+            self.broadcasts += 1
+            record_failure("gateway.broadcast_completed", version=version,
+                           workers=len(self.registries))
+            return version
+        # backward convergence: some worker cannot take the new version —
+        # abort its stage and roll every committed worker back, so the
+        # fabric converges on ONE (old, gate-approved) version
+        for reg in failed:
+            reg.abort()
+        for reg in committed:
+            try:
+                prev = old[id(reg)]
+                reg.swap_to(prev, reg.versions[prev], warmup=False)
+            except Exception:  # noqa: BLE001 — best effort; chaos-bounded
+                record_failure("gateway.broadcast_rollback_failed",
+                               version=version)
+        self.rolled_back += 1
+        record_failure("gateway.broadcast_rolled_back", version=version,
+                       failed=len(failed))
+        raise BroadcastError(
+            f"commit of {version!r} failed on {len(failed)} worker(s); "
+            "fabric rolled back to the old version")
 
 
 class DistributedServingServer:

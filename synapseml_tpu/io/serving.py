@@ -32,6 +32,13 @@ tests/test_chaos_serving.py via testing/chaos.py):
   at admission to the handler version that accepted it, so a swap can never
   change the program answering an in-flight request, and a failed
   load/build/warmup rolls back with the old version never having stopped.
+* **Multi-tenant isolation** (docs/resilience.md, "Multi-tenant fleet") —
+  with a :class:`~synapseml_tpu.core.qos.QoSController`, requests carry
+  ``X-Tenant``; each tenant gets its own serving pointer + registry
+  (``add_tenant``), its own admission contract (token bucket → 429,
+  quarantine breaker → 503, bounded weighted-fair queue lane), and its own
+  failure accounting — a tenant that floods, throws, or NaN-storms is shed
+  at ITS boundary while other tenants' p99 and availability hold.
 
 ``ServingServer.metrics`` exposes queue depth/age gauges and shed/error/
 deadline counters; the same events also land in the process-wide
@@ -71,6 +78,8 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.logging import record_failure
+from ..core.qos import (DEFAULT_TENANT, TENANT_HEADER, QoSController,
+                        WeightedFairQueue)
 from ..core.resilience import DEADLINE_HEADER, Deadline
 from ..core.table import Table
 
@@ -91,6 +100,11 @@ class _PendingRequest:
     # a model swap mid-flight must not change the program that answers an
     # already-accepted request). None -> whatever is active at batch time.
     handler: Optional[Callable] = None
+    # X-Tenant this request was admitted under: pins (tenant, version) so a
+    # per-tenant swap stays atomic per tenant, routes the request through
+    # its tenant's WeightedFairQueue lane, and keys outcome feedback to the
+    # tenant's own QoS breaker
+    tenant: str = DEFAULT_TENANT
 
 
 class ServingMetrics:
@@ -205,7 +219,8 @@ class ServingServer:
                  max_queue_size: int = 1024,
                  isolate_failures: bool = True,
                  drain_timeout: float = 10.0,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 qos: Optional[QoSController] = None):
         self.handler = handler
         self.host, self.port = host, port
         self.api_path = api_path
@@ -217,8 +232,19 @@ class ServingServer:
         self.drain_timeout = drain_timeout
         self.warmup = warmup
         self.registry: Optional["ModelRegistry"] = None  # hot-swap registry
-        self._queue: "queue.Queue[_PendingRequest]" = queue.Queue(
-            maxsize=max_queue_size)
+        # multi-tenant mode: per-tenant serving pointers + registries keyed
+        # by X-Tenant; ``handler`` stays the default-tenant fallback so a
+        # single-tenant server is the degenerate case of the same machinery
+        self.qos = qos
+        self.tenant_handlers: Dict[str, Callable] = {}
+        self.registries: Dict[str, "ModelRegistry"] = {}
+        if qos is not None:
+            # per-tenant bounded lanes + weighted-fair dequeue; same
+            # queue.Queue surface, so the pipeline above is unchanged
+            self._queue = WeightedFairQueue(maxsize=max_queue_size, qos=qos)
+        else:
+            self._queue: "queue.Queue[_PendingRequest]" = queue.Queue(
+                maxsize=max_queue_size)
         # two-stage pipeline handoff (batch formation → execution): depth 1
         # lets the serve loop form batch N+1 while the executor runs batch N
         self._handoff: "queue.Queue" = queue.Queue(maxsize=1)
@@ -248,6 +274,45 @@ class ServingServer:
             takes = False
         self._budget_sig[id(handler)] = (handler, takes)
         return takes
+
+    # --- multi-tenant surface ------------------------------------------
+    def handler_for(self, tenant: str) -> Callable:
+        """Active serving pointer for a tenant (default-tenant fallback:
+        ``self.handler``) — the per-tenant analog of ``self.handler``, read
+        once at admission to pin (tenant, version)."""
+        return self.tenant_handlers.get(tenant, self.handler)
+
+    def add_tenant(self, tenant: str, handler: Callable,
+                   qos_class=None, version: str = "v0",
+                   warmup: Optional[bool] = None) -> "ModelRegistry":
+        """Register a tenant: its serving pointer, its own hot-swap
+        :class:`ModelRegistry`, and (when the server is QoS-enabled) its
+        admission contract. Warms the handler's bucket ladder unless the
+        server was built with ``warmup=False``."""
+        if qos_class is not None and self.qos is not None:
+            self.qos.assign(tenant, qos_class)
+        warm = getattr(handler, "warmup", None)
+        if (self.warmup if warmup is None else warmup) and callable(warm):
+            warm()
+        self.tenant_handlers[tenant] = handler
+        return ModelRegistry(self, version=version, tenant=tenant)
+
+    def tenant_snapshot(self) -> dict:
+        """Per-tenant observability: active version + swap history and the
+        tenant handler's BucketedRunner compile/hit counters — the
+        per-tenant accounting over the SHARED runner fleet/compile cache."""
+        out = {}
+        for tenant, handler in self.tenant_handlers.items():
+            entry: dict = {}
+            reg = self.registries.get(tenant)
+            if reg is not None:
+                entry["model"] = reg.snapshot()
+            runner = getattr(handler, "runner", None)
+            if runner is not None and callable(getattr(runner, "stats",
+                                                       None)):
+                entry["runner"] = runner.stats()
+            out[tenant] = entry
+        return out
 
     # --- embedded server (WorkerServer analog) -------------------------
     def _make_handler_class(self):
@@ -301,6 +366,21 @@ class ServingServer:
                         503, b'{"error": "server is draining"}',
                         retry_after=1)
                     return
+                tenant = (self.headers.get(TENANT_HEADER)
+                          or DEFAULT_TENANT).strip() or DEFAULT_TENANT
+                if outer.qos is not None:
+                    # per-tenant QoS boundary: a quarantined tenant sheds
+                    # at ITS 503, a rate-limited one at ITS 429 — neither
+                    # touches the shared queue or another tenant's budget
+                    decision = outer.qos.admit(tenant)
+                    if not decision.ok:
+                        outer.metrics.incr("shed")
+                        self._reply_error(
+                            decision.status,
+                            _json.dumps({"error": decision.reason,
+                                         "tenant": tenant}).encode(),
+                            retry_after=1)
+                        return
                 deadline = Deadline.from_header_ms(
                     self.headers.get(DEADLINE_HEADER),
                     outer.reply_timeout)
@@ -308,15 +388,19 @@ class ServingServer:
                     id=uuid.uuid4().hex, method="POST", path=self.path,
                     headers=dict(self.headers), body=body,
                     deadline=deadline, admitted_at=time.monotonic(),
-                    # pin the ACTIVE handler version at admission: a model
-                    # hot-swap between now and batch execution must not
-                    # change the program answering this request
-                    handler=outer.handler)
+                    # pin the ACTIVE (tenant, version) at admission: a
+                    # model hot-swap between now and batch execution must
+                    # not change the program answering this request, and a
+                    # swap of tenant A must never touch tenant B's pin
+                    handler=outer.handler_for(tenant),
+                    tenant=tenant)
                 try:
                     outer._queue.put_nowait(req)
                 except queue.Full:
                     # load shedding: bounded queue + immediate 503 — the
-                    # overload contract (fast rejection, not slow timeout)
+                    # overload contract (fast rejection, not slow timeout).
+                    # Under QoS the bound is the TENANT's own lane, so a
+                    # flooding tenant sheds here while others keep landing
                     outer.metrics.incr("shed")
                     record_failure("serving.shed")
                     self._reply_error(
@@ -353,6 +437,10 @@ class ServingServer:
                     snap["runner"] = runner.stats()
                 if outer.registry is not None:
                     snap["model"] = outer.registry.snapshot()
+                if outer.qos is not None:
+                    snap["qos"] = outer.qos.snapshot()
+                if outer.tenant_handlers:
+                    snap["tenants"] = outer.tenant_snapshot()
                 body = _json.dumps(snap).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -403,15 +491,56 @@ class ServingServer:
         by_id = {r.id: r for r in live}
         for rid, (status, payload) in replies.items():
             req = by_id.get(rid)
-            if req is not None:
-                req.response = (status, {}, payload)
-                req.reply_event.set()
+            if req is None:
+                continue
+            if (self.qos is not None and status == 200
+                    and (b"NaN" in payload or b"Infinity" in payload)):
+                # NaN-storm guard: json.dumps emits literal NaN/Infinity
+                # for non-finite floats — a corrupted model must fail at
+                # ITS tenant's 500 boundary (feeding its quarantine
+                # breaker), not hand garbage to the client
+                status = 500
+                payload = _json.dumps(
+                    {"error": "non-finite model output"}).encode()
+                replies[rid] = (status, payload)
+                record_failure("serving.nonfinite_reply",
+                               tenant=req.tenant)
+            req.response = (status, {}, payload)
+            req.reply_event.set()
         # requests the handler dropped get an error instead of a hang
         for r in live:
             if r.response is None:
                 r.response = (500, {}, b'{"error": "no reply produced"}')
                 r.reply_event.set()
+        if self.qos is not None:
+            self._feed_qos(live, replies)
         self.metrics.incr("completed", len(live))
+
+    def _feed_qos(self, live: List[_PendingRequest],
+                  replies: Dict[str, tuple]) -> None:
+        """Feed batch outcomes back to the per-tenant breakers: 5xx rows
+        (handler throw, isolation failure, non-finite reply) count against
+        THEIR tenant only; successes close that tenant's breaker."""
+        ok: Dict[str, int] = {}
+        bad: Dict[str, List[bool]] = {}
+        for r in live:
+            status, payload = replies.get(
+                r.id, (r.response[0] if r.response else 500, b""))
+            if status >= 500:
+                bad.setdefault(r.tenant, []).append(
+                    b"non-finite" in payload)
+            else:
+                ok[r.tenant] = ok.get(r.tenant, 0) + 1
+        for tenant, n in ok.items():
+            self.qos.record_success(tenant, n)
+        for tenant, flags in bad.items():
+            nonfinite = [f for f in flags if f]
+            finite = [f for f in flags if not f]
+            if finite:
+                self.qos.record_failure(tenant, len(finite))
+            if nonfinite:
+                self.qos.record_failure(tenant, len(nonfinite),
+                                        nonfinite=True)
 
     def _invoke(self, df: Table, budget: Optional[float],
                 handler: Optional[Callable] = None):
@@ -634,23 +763,71 @@ class ModelRegistry:
     drops one after waiting for the server's in-flight stages to go idle —
     the drain machinery's idle accounting, reused so a retire can never
     yank a handler out from under a pinned in-flight batch.
+
+    **Multi-tenant mode** (``tenant=...``): the registry drives ONE tenant's
+    serving pointer (``server.tenant_handlers[tenant]``) instead of the
+    server-wide ``server.handler`` — each tenant gets its own registry, its
+    own version history, and its own atomic flip; admission pins
+    ``handler_for(tenant)``, so tenant A's swap can never change the program
+    answering tenant B's in-flight (or future) requests.
+
+    **Swap concurrency**: two racing promoters are resolved by a
+    non-blocking swap lock with a deterministic loser — the second caller
+    gets ``SwapError("swap in progress")`` immediately instead of queueing
+    behind (and then blindly overwriting) the first. The lock is reentrant
+    so :meth:`swap_from_store` can delegate to :meth:`swap_to`, and so the
+    two-phase :meth:`prepare`/:meth:`commit` pair (promotion broadcast)
+    holds it across the prepare window — a racing single-shot swap loses to
+    an in-flight broadcast the same deterministic way.
     """
 
     def __init__(self, server: ServingServer,
-                 version: str = "v0", keep_versions: int = 3):
+                 version: str = "v0", keep_versions: int = 3,
+                 tenant: Optional[str] = None):
         if keep_versions < 2:
             raise ValueError("keep_versions must be >= 2 (active + rollback)")
         self.server = server
         self.keep_versions = keep_versions
+        self.tenant = tenant
         self._lock = threading.Lock()       # registry state
-        self._swap_lock = threading.Lock()  # one swap at a time
-        self.versions: Dict[str, Callable] = {version: server.handler}
+        # one swap at a time, non-blocking acquire (deterministic loser);
+        # reentrant: swap_from_store -> swap_to and prepare -> commit run
+        # on one owning thread
+        self._swap_lock = threading.RLock()
+        self._staged: Optional[tuple] = None   # (version, handler) prepared
+        initial = (server.handler if tenant is None
+                   else server.handler_for(tenant))
+        self.versions: Dict[str, Callable] = {version: initial}
         self.active = version
         self.history: List[str] = [version]
         self.swaps = 0
         self.swap_failures = 0
         self.last_error: Optional[str] = None
-        server.registry = self
+        if tenant is None:
+            server.registry = self
+        else:
+            server.tenant_handlers.setdefault(tenant, initial)
+            server.registries[tenant] = self
+
+    def _acquire_swap(self) -> None:
+        if not self._swap_lock.acquire(blocking=False):
+            record_failure("serving.swap_conflict", tenant=self.tenant)
+            raise SwapError("swap in progress")
+        if self._staged is not None:
+            # the lock is reentrant (prepare -> commit on one thread), so a
+            # same-thread single-shot swap racing an open prepare window
+            # acquires — it must still lose deterministically
+            self._swap_lock.release()
+            record_failure("serving.swap_conflict", tenant=self.tenant)
+            raise SwapError("swap in progress")
+
+    def _install(self, handler: Callable) -> None:
+        """The flip itself: one atomic assignment of this registry's
+        serving pointer (tenant-scoped in multi-tenant mode)."""
+        if self.tenant is None:
+            self.server.handler = handler
+        else:
+            self.server.tenant_handlers[self.tenant] = handler
 
     # -- swap pipeline --
     def swap_to(self, version: str, handler: Callable,
@@ -658,7 +835,8 @@ class ModelRegistry:
         """Stage ``handler`` as ``version``, warm it off the hot path, and
         atomically flip the server to it. Raises :class:`SwapError` on any
         pre-flip failure (old version still serving). Returns ``version``."""
-        with self._swap_lock:
+        self._acquire_swap()
+        try:
             # only Exception-derived faults roll back: PreemptionError is
             # BaseException on purpose (a real SIGTERM kills the process,
             # it does not roll back a swap)
@@ -681,19 +859,89 @@ class ModelRegistry:
                     f"{self.active!r} is still serving") from e
             # the flip: one atomic pointer assignment — admission pins the
             # handler per request, so either side of this line is consistent
-            with self._lock:
-                self.versions[version] = handler
-                self.active = version
-                if version in self.history:
-                    self.history.remove(version)
-                self.history.append(version)
-                self.swaps += 1
-                self.last_error = None
-            self.server.handler = handler
+            self._record_flip(version, handler)
             record_failure("serving.swap_completed", version=version)
             _swap_point("done", version)
             self._prune()
             return version
+        finally:
+            self._swap_lock.release()
+
+    def _record_flip(self, version: str, handler: Callable) -> None:
+        with self._lock:
+            self.versions[version] = handler
+            self.active = version
+            if version in self.history:
+                self.history.remove(version)
+            self.history.append(version)
+            self.swaps += 1
+            self.last_error = None
+        self._install(handler)
+
+    # -- two-phase swap (promotion broadcast) --
+    def prepare(self, version: str, handler: Callable,
+                warmup: bool = True) -> str:
+        """Phase 1 of a fabric-wide swap: stage + AOT-warm ``handler`` OFF
+        the hot path and hold the swap lock, WITHOUT flipping. The old
+        version keeps serving; a racing swap loses with
+        ``SwapError("swap in progress")``. Follow with :meth:`commit` (the
+        atomic flip) or :meth:`abort` (discard, old version untouched) —
+        from the same thread (the lock is owned by it)."""
+        self._acquire_swap()
+        try:
+            _swap_point("prepare", version)
+            warm = getattr(handler, "warmup", None)
+            if warmup and callable(warm):
+                _swap_point("warmup", version)
+                warm()
+        except Exception as e:  # noqa: BLE001
+            self._swap_lock.release()
+            with self._lock:
+                self.swap_failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+            record_failure("serving.swap_failed", version=version,
+                           stage="prepare", error=type(e).__name__)
+            raise SwapError(
+                f"prepare of {version!r} failed "
+                f"({type(e).__name__}: {e}); "
+                f"{self.active!r} is still serving") from e
+        self._staged = (version, handler)
+        return version
+
+    def commit(self, version: Optional[str] = None) -> str:
+        """Phase 2: atomically flip to the prepared version and release the
+        swap lock. A failure AT the commit point (injected kill) leaves the
+        version staged and the lock held — :meth:`commit` may be retried,
+        or :meth:`abort` discards. Without a matching :meth:`prepare` this
+        raises :class:`SwapError`."""
+        staged = self._staged
+        if staged is None:
+            raise SwapError("commit without a prepared version")
+        staged_version, handler = staged
+        if version is not None and version != staged_version:
+            raise SwapError(
+                f"commit of {version!r} but {staged_version!r} is staged")
+        _swap_point("commit", staged_version)   # chaos kill point
+        self._record_flip(staged_version, handler)
+        self._staged = None
+        self._swap_lock.release()
+        record_failure("serving.swap_completed", version=staged_version)
+        _swap_point("done", staged_version)
+        self._prune()
+        return staged_version
+
+    def abort(self) -> bool:
+        """Discard a prepared version and release the swap lock; the old
+        version never stopped serving. Idempotent (False when nothing is
+        staged)."""
+        if self._staged is None:
+            return False
+        version = self._staged[0]
+        self._staged = None
+        self._swap_lock.release()
+        record_failure("serving.swap_aborted", version=version,
+                       tenant=self.tenant)
+        return True
 
     def swap_from_store(self, store, builder: Callable,
                         step: Optional[int] = None,
@@ -703,6 +951,19 @@ class ModelRegistry:
         ``step=None`` loads the newest VERIFIABLE checkpoint. A corrupt
         checkpoint, missing store, or builder failure raises
         :class:`SwapError` with the old version still serving."""
+        # hold the swap lock across load+build as well (reentrant for the
+        # delegated swap_to): two promoters racing swap_from_store must
+        # resolve to one winner and one SwapError("swap in progress"), not
+        # interleaved load/build/flip stages
+        self._acquire_swap()
+        try:
+            return self._swap_from_store_locked(store, builder, step, warmup)
+        finally:
+            self._swap_lock.release()
+
+    def _swap_from_store_locked(self, store, builder: Callable,
+                                step: Optional[int],
+                                warmup: bool) -> str:
         try:
             _swap_point("load", "?")
             ckpt = (store.load_step(step) if step is not None

@@ -73,12 +73,18 @@ class PromotionGate:
                  reward_min: float = 0.0, reward_max: float = 1.0,
                  log_window: int = 4096,
                  regression_window: int = 100,
-                 regression_tolerance: float = 0.05):
+                 regression_tolerance: float = 0.05,
+                 broadcast=None):
         if min_samples < 2:
             raise ValueError(f"min_samples must be >= 2, got {min_samples}")
         if max_weight <= 0:
             raise ValueError(f"max_weight must be > 0, got {max_weight}")
         self.registry = registry
+        # fabric-wide promotion: a PromotionBroadcast (io/distributed_
+        # serving.py) whose two-phase prepare/commit flips EVERY worker to
+        # the approved version, rolling all of them back on any failure —
+        # None keeps the single-registry swap_to path
+        self.broadcast = broadcast
         self.min_samples = min_samples
         self.alpha = alpha
         self.min_improvement = min_improvement
@@ -188,9 +194,16 @@ class PromotionGate:
         if not decision.promoted:
             return decision
         try:
-            self.registry.swap_to(ckpt.version, handler)
-        except SwapError:
-            # pre-flip failure (chaos kill, warmup fault): incumbent serves on
+            if self.broadcast is not None:
+                # fabric-wide: one gate approval flips every worker via
+                # two-phase prepare/commit; any failure path converges the
+                # whole fabric on ONE version (BroadcastError = old one)
+                self.broadcast.broadcast(ckpt.version, handler)
+            else:
+                self.registry.swap_to(ckpt.version, handler)
+        except (SwapError, RuntimeError) as e:
+            # pre-flip failure (chaos kill, warmup fault) or a rolled-back
+            # broadcast: the incumbent version serves on, fabric-wide
             with self._lock:
                 self.decisions.pop()
             return self._finish(GateDecision(

@@ -16,8 +16,8 @@ from .chaos import (ChaosHTTP, ChaosPreemption, ChaosSchedule, ChaosSwap,
                     FaultInjected, FlakyHTTPServer, bit_flip,
                     canned_json_responder, chaos_chunk_stream,
                     chaos_collectives, chaos_hang, chaos_nan_batches,
-                    chaos_reward_stream, chaotic_handler, kill_rank,
-                    torn_write)
+                    chaos_reward_stream, chaos_tenant_flood,
+                    chaotic_handler, kill_rank, torn_write)
 
 __all__ = [
     "TestObject", "discover_stage_classes", "experiment_fuzz",
@@ -25,6 +25,6 @@ __all__ = [
     "ChaosHTTP", "ChaosPreemption", "ChaosSchedule", "ChaosSwap",
     "FaultInjected", "FlakyHTTPServer", "bit_flip", "canned_json_responder",
     "chaos_chunk_stream", "chaos_collectives", "chaos_hang",
-    "chaos_nan_batches", "chaos_reward_stream", "chaotic_handler",
-    "kill_rank", "torn_write",
+    "chaos_nan_batches", "chaos_reward_stream", "chaos_tenant_flood",
+    "chaotic_handler", "kill_rank", "torn_write",
 ]

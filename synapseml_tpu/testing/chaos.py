@@ -770,6 +770,143 @@ class ChaosSwap:
         _sv._SWAP_HOOK = None
 
 
+class chaos_tenant_flood:
+    """Noisy-neighbor generator: ONE tenant floods a serving endpoint with
+    a seeded burst while (optionally) its own handler is sabotaged — slow
+    batches and/or non-finite outputs. tests/test_multitenant.py uses it to
+    assert the isolation invariant: the abusive tenant sheds at its OWN
+    429/503 boundary while every other tenant's p99 and availability hold.
+
+    Two independent knobs, combinable:
+
+    * **Flood** — :meth:`run` fires ``n_requests`` POSTs at ``url`` with
+      the ``X-Tenant: <tenant>`` header from ``threads`` concurrent
+      workers, bodies drawn from ``random.Random(seed)`` (deterministic
+      per seed). Every ``(status, latency_s)`` lands in ``results``;
+      :meth:`status_counts` tallies them for assertions.
+    * **Sabotage** — entering the context manager swaps the victim
+      tenant's handler on ``server`` for a wrapper that sleeps ``slow_s``
+      per batch and/or (``nan=True``) replies with non-finite floats,
+      exercising the serving NaN guard (per-tenant 500 → quarantine
+      breaker). ``__exit__`` restores the original handler.
+
+    No global hook is involved — the wrap is per-(server, tenant) — so
+    unlike the other injectors this one nests freely (one instance per
+    tenant under attack).
+    """
+
+    def __init__(self, url: str, tenant: str, n_requests: int = 100,
+                 threads: int = 4, seed: int = 0, timeout: float = 5.0,
+                 server=None, slow_s: float = 0.0, nan: bool = False):
+        self.url = url
+        self.tenant = tenant
+        self.n_requests = n_requests
+        self.threads = threads
+        self.timeout = timeout
+        self.rng = random.Random(seed)
+        self.server = server
+        self.slow_s = slow_s
+        self.nan = nan
+        self.results: List[Tuple[int, float]] = []
+        self._lock = threading.Lock()
+        self._orig_handler = None
+        self._installed = False
+
+    # -- sabotage: wrap the victim tenant's handler in place --
+    def _sabotaged(self, inner: Callable) -> Callable:
+        import numpy as _np
+
+        from ..core.table import Table as _Table
+
+        slow_s, emit_nan = self.slow_s, self.nan
+
+        def wrapped(df, budget=None):
+            if slow_s:
+                time.sleep(slow_s)
+            if emit_nan:
+                # non-finite replies: json.dumps emits literal NaN, which
+                # the server's qos guard converts to a per-tenant 500
+                return _Table({
+                    "id": df["id"],
+                    "reply": _np.full(df.num_rows, _np.nan)})
+            return inner(df)
+
+        return wrapped
+
+    def __enter__(self) -> "chaos_tenant_flood":
+        if self.server is not None and (self.slow_s or self.nan):
+            handlers = getattr(self.server, "tenant_handlers", None)
+            if handlers and self.tenant in handlers:
+                self._orig_handler = handlers[self.tenant]
+                handlers[self.tenant] = self._sabotaged(self._orig_handler)
+            else:
+                self._orig_handler = self.server.handler
+                self.server.handler = self._sabotaged(self._orig_handler)
+            self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            handlers = getattr(self.server, "tenant_handlers", None)
+            if handlers and self.tenant in handlers:
+                handlers[self.tenant] = self._orig_handler
+            else:
+                self.server.handler = self._orig_handler
+            self._installed = False
+
+    # -- flood --
+    def _one(self, body: bytes) -> None:
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Tenant": self.tenant})
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            status = e.code
+        except (OSError, urllib.error.URLError):
+            status = 599      # transport failure (reset/timeout)
+        with self._lock:
+            self.results.append((status, time.monotonic() - t0))
+
+    def run(self) -> List[Tuple[int, float]]:
+        """Fire the burst; blocks until every request has an outcome."""
+        with self._lock:
+            bodies = [_json.dumps(
+                {"value": self.rng.random()}).encode()
+                for _ in range(self.n_requests)]
+        work = list(bodies)
+        wlock = threading.Lock()
+
+        def worker():
+            while True:
+                with wlock:
+                    if not work:
+                        return
+                    body = work.pop()
+                self._one(body)
+
+        ts = [threading.Thread(target=worker, daemon=True)
+              for _ in range(self.threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return list(self.results)
+
+    def status_counts(self) -> dict:
+        """``{status: count}`` over everything :meth:`run` has sent."""
+        with self._lock:
+            out: dict = {}
+            for status, _ in self.results:
+                out[status] = out.get(status, 0) + 1
+            return out
+
+
 # ---------------------------------------------------------------------------
 # Online-learning chaos: corrupted feedback/reward streams
 # (tests/test_online.py drives it on CPU; the asserted property is the

@@ -129,9 +129,13 @@ EOF
 
 echo "== dl scaling guard (ZeRO sharding + pipeline parallelism) =="
 # correctness first: fixed-seed parity (zero & pipeline match the replicated
-# loss trajectory), kill->resume through sharded checkpoints bit-for-bit,
-# resharding across mesh shapes — all on the 8-CPU-device forked mesh
+# loss trajectory — both schedules), kill->resume through sharded checkpoints
+# bit-for-bit (incl. the overlap schedule), resharding across mesh shapes —
+# all on the 8-CPU-device forked mesh; then the elastic-pipeline battery
+# (hang-in-hop -> PeerLostError naming the hop, kill -> shrunken stage
+# groups resume from per-shard checkpoints)
 JAX_PLATFORMS=cpu python -m pytest -x -q tests/test_dl_sharded.py
+JAX_PLATFORMS=cpu python -m pytest -x -q tests/test_elastic.py -k TestPipelineElastic
 JAX_PLATFORMS=cpu python - << 'EOF'
 # then the memory/throughput claim (docs/dl-scaling.md): ZeRO's per-device
 # live state (params + optimizer moments, from each leaf's sharding) must be
@@ -150,6 +154,24 @@ assert rec["guard"]["zero_bytes_le_0p6x_replicated"], \
     f"ZeRO state bytes exceed 0.6x replicated: {per_model}"
 assert rec["guard"]["zero_step_within_1p15x_replicated"], \
     f"ZeRO step time exceeds 1.15x replicated: {per_model}"
+EOF
+JAX_PLATFORMS=cpu python - << 'EOF'
+# overlap schedule guard (docs/dl-scaling.md "Overlap schedule"): the
+# double-buffered/no-remat schedule must beat fill-drain >=1.05x on the
+# staged-bert pipeline config (median of interleaved paired trials) while
+# both schedules hold <=1e-5 loss parity with the replicated trainer
+import json, subprocess, sys
+out = subprocess.run([sys.executable, "bench.py", "--only",
+                      "bench_dl_overlap_pipeline"],
+                     capture_output=True, text=True, check=True).stdout
+rec = json.loads(out.strip().splitlines()[-1])
+print(f"overlap vs fill_drain: {rec['value']}x "
+      f"(trials {rec['trial_speedups']}), "
+      f"parity {rec['loss_parity_vs_replicated']:.2e}")
+assert rec["guard"]["overlap_ge_1p05x_fill_drain"], \
+    f"overlap schedule under 1.05x fill-drain: {rec['trial_speedups']}"
+assert rec["guard"]["schedule_parity_le_1em5_vs_replicated"], \
+    f"schedule loss parity above 1e-5: {rec['loss_parity_vs_replicated']}"
 EOF
 
 echo "== out-of-core guard (streamed gbdt: parity, chaos, throughput) =="

@@ -445,10 +445,15 @@ def save_sharded_tree(store: CheckpointStore, step: int, tree,
                 win = _norm_index(sh.index, shape)
                 if win in seen:      # replicated across local devices
                     continue
-                # a fully-replicated leaf is written by process 0 only
-                if pid != 0 and all(s == 0 and e == d
-                                    for (s, e), d in zip(win, shape)):
-                    continue
+                # a fully-replicated leaf is written once, by the lowest
+                # process holding an addressable copy — NOT always process
+                # 0: a pipeline stage group's leaves replicate over a device
+                # set that may exclude process 0 entirely
+                if all(s == 0 and e == d for (s, e), d in zip(win, shape)):
+                    owner = min(d.process_index
+                                for d in leaf.sharding.device_set)
+                    if pid != owner:
+                        continue
                 seen.add(win)
                 key = f"l{li}_b{len(blocks)}"
                 local_blocks[key] = np.frombuffer(

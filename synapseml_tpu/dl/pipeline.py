@@ -5,25 +5,51 @@ one *program per stage group* — the MPMD style of arXiv:2412.14374: the mesh's
 ``stage`` axis is split into device groups (`parallel.mesh.stage_submeshes`),
 backbone stages map onto groups circularly (stage s → group s mod G, so more
 model stages than groups share hardware round-robin), and each global batch is
-cut into microbatches that flow through a GPipe fill-drain schedule:
+cut into microbatches that flow through one of two schedules:
 
-* forward wavefront — microbatch m enters stage s at tick s+m; activations
-  hop between groups with a ``device_put`` (the ICI/DCN transfer);
-* the last stage fuses loss + backward (no bubble between its fwd and bwd);
-* backward wavefront — upstream stages RECOMPUTE their forward inside
-  ``jax.vjp`` (GPipe rematerialization: only stage *inputs* are kept alive,
-  not every intermediate), each producing its param grads and the cotangent
-  shipped to the previous group;
-* per-stage optimizer update once per global batch, gradients averaged over
-  microbatches — mathematically the full-batch step, so a BN/dropout-free
-  model matches the replicated loss trajectory to float-associativity.
+* ``pipeline_schedule="fill_drain"`` (default, GPipe): forward wavefront —
+  microbatch m enters stage s at tick s+m; activations hop between groups
+  through :func:`parallel.transfer.device_transfer` (the ICI/DCN transfer);
+  the last stage fuses loss + backward (no bubble between its fwd and bwd);
+  then the backward wavefront, where upstream stages RECOMPUTE their forward
+  inside ``jax.vjp`` (GPipe rematerialization: only stage *inputs* are kept
+  alive), each producing its param grads and the cotangent shipped to the
+  previous group.
+* ``pipeline_schedule="overlap"`` (docs/dl-scaling.md "Overlap schedule"):
+  each stage's weights are double-buffered — fwd/bwd consume a
+  once-per-batch gathered (within-group replicated) copy filled by an
+  identity jit, and the NEXT batch's ZeRO all-gather is enqueued while this
+  batch's backward tail and host-side loss sync still run, hiding the
+  gather behind work that happens anyway. Backward for microbatch m starts
+  as soon as its cotangent lands (1F1B interleave) instead of waiting for
+  the full forward wavefront — and because 1F1B frees each microbatch's
+  buffers at first use, the forward can afford to KEEP its vjp residuals
+  (the pullback closure is a pytree, shipped out of the jit as data), so
+  the backward is transpose-only: no GPipe forward recompute. Residuals
+  and cotangents are donated into the backward and per-stage grads
+  accumulate through a donated running sum (the Megatron main-grad
+  pattern). Gradients stay ZeRO-sharded under BOTH schedules — the
+  per-microbatch reduce-scatter is the cheap half; what overlap removes is
+  the per-program weight traffic plus the remat flops. Costs one
+  replicated param copy per group plus residual storage; wins when
+  microbatches are activation-heavy (the bench.py guard pins the regime).
+
+Per-stage optimizer updates run once per global batch, gradients averaged
+over microbatches — mathematically the full-batch step, so a BN/dropout-free
+model matches the replicated loss trajectory to float-associativity.
 
 Within a group the *other* mesh axes survive (``data``, ``seq``), so the batch
 dimension stays sharded inside every stage and sequence parallelism composes;
 ``pipeline_param_sharding="zero"`` additionally ZeRO-shards each stage's
-params/moments over the group's data axis. Dispatch is async (JAX queues the
-per-group programs; real backends overlap them), state checkpoints ride the
-sharded per-stage format of ``core.checkpoint.save_sharded_tree``.
+params/moments over the group's data axis. Multi-process, stage submeshes may
+land on a subset of processes (even disjoint sets per group): every process
+runs the full schedule, stage programs execute on their group's owners only,
+and every inter-group hop is an all-process rendezvous through
+``parallel.transfer`` — non-owners join with shape placeholders. Dispatch is
+async (JAX queues the per-group programs; real backends overlap them), state
+checkpoints ride the sharded per-stage format of
+``core.checkpoint.save_sharded_tree`` (which reshards on load, so a shrunken
+post-failure mesh restores the same state — see docs/resilience.md).
 """
 
 from __future__ import annotations
@@ -43,11 +69,30 @@ from ..core.checkpoint import (CheckpointStore, NonFiniteGuard,
 from ..core.compat import donate_argnums_if_supported
 from ..parallel.elastic import ElasticUnsupportedError, current_watchdog
 from ..parallel.mesh import (DATA_AXIS, STAGE_AXIS, apply_tree_shardings,
-                             host_copy, stage_submeshes, tree_shardings)
+                             assert_equal_across_processes,
+                             local_mesh_devices, mesh_process_indices,
+                             stage_submeshes, tree_shardings)
+from ..parallel.transfer import device_transfer, host_fetch, share_scalars
 from .backbones import StageSequential
 from . import trainer as _trainer_mod
 from .trainer import (_make_tx, _restore_checkpoint, _save_checkpoint,
                       freeze_mask, per_device_state_bytes)
+
+#: The dl-scaling supported-config matrix. docs/dl-scaling.md renders this
+#: table verbatim and tests/test_dl_sharded.py asserts the two stay in sync —
+#: update BOTH when a row changes. Every cell is True since the multi-process
+#: pipeline gap closed; :class:`ElasticUnsupportedError` carries this matrix
+#: whenever a config falls outside it (today: only unknown schedule names).
+SUPPORTED_MATRIX = {
+    "single-process pipeline (any #stages/groups)": True,
+    "multi-process param_sharding='replicated'": True,
+    "multi-process param_sharding='zero'/'fsdp'": True,
+    "multi-process param_sharding='pipeline'": True,
+    "pipeline schedule='overlap' (double-buffered stage weights)": True,
+    "elastic shrink/regrow resume (zero/fsdp/pipeline, gbdt fused)": True,
+}
+
+_SCHEDULES = ("fill_drain", "overlap")
 
 
 def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
@@ -67,20 +112,12 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
         raise ValueError(
             "param_sharding='pipeline' requires a mesh with a 'stage' axis, "
             "e.g. parallel.make_mesh({'stage': G, 'data': D})")
-    if jax.process_count() > 1:
-        # the supported-config matrix lives in docs/dl-scaling.md; keep the
-        # two in sync when a row changes
+    schedule = cfg.pipeline_schedule
+    if schedule not in _SCHEDULES:
         raise ElasticUnsupportedError(
-            "multi-process pipeline training (stage groups spanning hosts "
-            "need per-group process coordination)",
-            matrix={
-                "single-process pipeline (any #stages/groups)": True,
-                "multi-process param_sharding='replicated'": True,
-                "multi-process param_sharding='zero'/'fsdp'": True,
-                "multi-process param_sharding='pipeline'": False,
-                "elastic shrink/regrow resume (zero/fsdp, gbdt fused)": True,
-            },
-            hint="use param_sharding='zero' for multi-host runs")
+            f"pipeline schedule {schedule!r}", matrix=SUPPORTED_MATRIX,
+            hint=f"pipeline_schedule must be one of {_SCHEDULES}")
+    overlap = schedule == "overlap"
     X = np.asarray(X)
     y = np.asarray(y)
     if tr.params is None:
@@ -106,11 +143,30 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
                      else jnp.float32)
     loss_kind = tr.loss
 
+    # --- multi-process stage groups -------------------------------------
+    # Every process runs the full schedule; per-stage programs execute on
+    # the processes owning that group's devices, and every inter-group hop
+    # is an all-process rendezvous (parallel.transfer), so a group may land
+    # on any subset of processes — docs/dl-scaling.md "Inter-host hops".
+    multiproc = jax.process_count() > 1
+    gmesh = [groups[assign[s]] for s in range(S)]
+    owns_s = [True] * S
+    last_src = 0
+    if multiproc:
+        local_mesh_devices(tr.mesh)   # validates the even per-process split
+        assert_equal_across_processes(
+            [n, S, M, cfg.batch_size, cfg.max_epochs],
+            "pipeline config (rows/stages/microbatches/batch/epochs)")
+        _pid = jax.process_index()
+        gprocs = [mesh_process_indices(g) for g in groups]
+        owns_s = [_pid in gprocs[assign[s]] for s in range(S)]
+        last_src = gprocs[assign[S - 1]][0]
+
     # --- per-stage state, placed on its group ---------------------------
     skey = [f"stages_{s}" for s in range(S)]
-    gmesh = [groups[assign[s]] for s in range(S)]
     psh, bssh, osh = [], [], []
     stage_params, stage_bs, stage_opt, txs = [], [], [], []
+    host_bs = []
     for s in range(S):
         if skey[s] not in full_params:
             raise ValueError(
@@ -120,6 +176,7 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
         psh.append(tree_shardings(gmesh[s], p_s, mode))
         stage_params.append(apply_tree_shardings(p_s, psh[s]))
         b_s = full_bs.get(skey[s], {}) if isinstance(full_bs, dict) else {}
+        host_bs.append(b_s)
         bssh.append(tree_shardings(gmesh[s], b_s, "replicated"))
         stage_bs.append(apply_tree_shardings(b_s, bssh[s]))
         tx_s = _make_tx(cfg, total_steps,
@@ -157,6 +214,60 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
         out = model.stages[s].apply(variables, x, train=True, rngs=rngs)
         return out, bs
 
+    # static per-boundary activation specs: multi-process non-owners join
+    # each hop rendezvous with a ShapeDtypeStruct placeholder of this shape
+    # (act_specs[s] = stage s's input; gy[k] cotangents have act_specs[k+1])
+    act_specs = None
+    if multiproc:
+        mb_rows = cfg.batch_size // M
+        spec = jax.ShapeDtypeStruct((mb_rows,) + X.shape[1:],
+                                    jnp.asarray(X[:1]).dtype)
+        act_specs = [spec]
+        for s in range(S - 1):
+            out = jax.eval_shape(
+                lambda p, b, xx, s=s: stage_apply(
+                    s, p, b, cast_in(xx) if s == 0 else xx,
+                    jax.random.PRNGKey(0))[0],
+                full_params[skey[s]], host_bs[s], spec)
+            spec = jax.ShapeDtypeStruct(out.shape, out.dtype)
+            act_specs.append(spec)
+
+    # overlap schedule: the gathered (within-group replicated) double buffer
+    # the compute programs consume instead of re-gathering per microbatch
+    gpsh = None
+    if overlap:
+        gpsh = [tree_shardings(gmesh[s], full_params[skey[s]], "replicated")
+                for s in range(S)]
+
+    def make_gather(s):
+        # the double-buffer fill: identity jit whose out_shardings force the
+        # within-group all-gather, dispatched ahead of use (async)
+        return jax.jit(lambda t: t,
+                       in_shardings=(psh[s],), out_shardings=gpsh[s])
+
+    gather_fns = [make_gather(s) for s in range(S)] if overlap else None
+    fsh = gpsh if overlap else psh   # param placement the compute fns see
+    gbuf = [None] * S                # prefetched gathered weights
+
+    def invalidate_gbuf():
+        for s in range(S):
+            gbuf[s] = None
+
+    def take_gathered(s):
+        g, gbuf[s] = gbuf[s], None
+        if g is None and owns_s[s]:
+            g = gather_fns[s](stage_params[s])
+        return g
+
+    def prefetch_gather():
+        # dispatched right after the updates: the all-gather for the NEXT
+        # batch's weights is enqueued while this batch's backward tail and
+        # host-side loss sync still run — the overlap the schedule is named
+        # for (double buffer: the gathered copy lives beside the shards)
+        for s in range(S):
+            if owns_s[s]:
+                gbuf[s] = gather_fns[s](stage_params[s])
+
     def make_fwd(s):
         def fwd(p, bs, x, step, m):
             if s == 0:
@@ -164,8 +275,47 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
             return stage_apply(s, p, bs, x, stage_rng(step, s, m))
         return jax.jit(
             fwd,
-            in_shardings=(psh[s], bssh[s], act_sh[s], None, None),
+            in_shardings=(fsh[s], bssh[s], act_sh[s], None, None),
             out_shardings=(act_sh[s], bssh[s]))
+
+    def make_fwd_res(s):
+        # overlap's no-remat forward: jax.vjp's pullback closure is a
+        # pytree, so the residuals cross the jit boundary as data and the
+        # backward never recomputes the stage (fill-drain must remat —
+        # its S*M in-flight stage inputs are all GPipe can afford to hold,
+        # while 1F1B frees each microbatch's buffers at first use)
+        def fwd(p, bs, x, step, m):
+            rng = stage_rng(step, s, m)
+
+            def f_px(pp, xx):
+                if s == 0:
+                    xx = cast_in(xx)
+                return stage_apply(s, pp, bs, xx, rng)
+
+            if s == 0:   # integer token ids: not differentiable wrt x
+                out, f_vjp, nb = jax.vjp(
+                    lambda pp: f_px(pp, x), p, has_aux=True)
+            else:
+                out, f_vjp, nb = jax.vjp(f_px, p, x, has_aux=True)
+            return out, nb, f_vjp
+        return jax.jit(
+            fwd, in_shardings=(fsh[s], bssh[s], act_sh[s], None, None))
+
+    def make_bwd_res(s):
+        # the matching transpose-only backward: consumes (and donates) the
+        # saved residuals and the landed cotangent; dp leaves ZeRO-sharded
+        # exactly like the remat path's
+        wrt_x = s > 0
+
+        def bwd(f_vjp, gy):
+            if wrt_x:
+                dp, dx = f_vjp(gy)
+                return dp, dx
+            (dp,) = f_vjp(gy)
+            return dp, jnp.zeros((), jnp.float32)
+        return jax.jit(
+            bwd, donate_argnums=(0, 1),
+            out_shardings=(psh[s], act_sh[s] if wrt_x else rep[s]))
 
     def make_last(s):
         wrt_x = s > 0   # stage-0 inputs may be integer token ids
@@ -194,7 +344,15 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
             return loss, acc, nb, dp, dx
         return jax.jit(
             last,
-            in_shardings=(psh[s], bssh[s], act_sh[s], act_sh[s], None, None),
+            # dp stays ZeRO-sharded (psh) under BOTH schedules: overlap
+            # hides the weight all-gathers, the per-microbatch gradient
+            # reduce-scatter is the cheap half and keeps grad_add small.
+            # overlap additionally donates the stage input: the last
+            # stage's x is dead after its fused loss+backward, so the
+            # buffer feeds the cotangent output instead of the allocator
+            donate_argnums=(donate_argnums_if_supported(2)
+                            if overlap else ()),
+            in_shardings=(fsh[s], bssh[s], act_sh[s], act_sh[s], None, None),
             out_shardings=(rep[s], rep[s], bssh[s], psh[s],
                            act_sh[s] if wrt_x else rep[s]))
 
@@ -222,7 +380,13 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
             return dp, jnp.zeros((), jnp.float32)
         return jax.jit(
             bwd,
-            in_shardings=(psh[s], bssh[s], act_sh[s], act_sh[s], None, None),
+            # overlap: x and gy are each consumed exactly once (remat-vjp
+            # here is their single use; drain_bwd's bwd_done guard makes
+            # re-entry impossible), so donating them lets the upstream
+            # cotangent reuse the landed buffers in place
+            donate_argnums=(donate_argnums_if_supported(2, 3)
+                            if overlap else ()),
+            in_shardings=(fsh[s], bssh[s], act_sh[s], act_sh[s], None, None),
             out_shardings=(psh[s], act_sh[s] if wrt_x else rep[s]))
 
     keep_prev = cfg.nonfinite_policy != "raise"
@@ -240,25 +404,65 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
                        in_shardings=(psh[s], osh[s], psh[s]),
                        out_shardings=(psh[s], osh[s]))
 
-    fwd_fns = [make_fwd(s) for s in range(S - 1)]
+    fwd_fns = ([make_fwd_res(s) for s in range(S - 1)] if overlap
+               else [make_fwd(s) for s in range(S - 1)])
     last_fn = make_last(S - 1)
-    bwd_fns = [make_bwd(s) for s in range(S - 1)]
+    bwd_fns = ([make_bwd_res(s) for s in range(S - 1)] if overlap
+               else [make_bwd(s) for s in range(S - 1)])
     upd_fns = [make_upd(s) for s in range(S)]
-    grad_add = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
+    if overlap:
+        # the overlap schedule owns its grad accumulator: the running sum is
+        # donated back in (in-place accumulation, the Megatron main-grad
+        # pattern) — safe because nothing else holds the old sum, and it
+        # halves the allocator traffic the 1F1B drain generates
+        grad_add = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b),
+                           donate_argnums=(0,))
+    else:
+        grad_add = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
     label_sh = act_sh[S - 1]
 
     def pipeline_step(step_idx, xb, yb):
-        """One global batch through the fill-drain schedule; returns
+        """One global batch through the configured schedule; returns
         (mean loss, mean acc) as floats. Mutates stage_params/bs/opt."""
         step = np.int32(step_idx)
         xmb = np.split(np.asarray(xb), M)
         ymb = np.split(np.asarray(yb), M)
         x_in = [[None] * M for _ in range(S)]   # kept alive for remat-bwd
         bs_in = [[None] * M for _ in range(S)]
+        vjps = [[None] * M for _ in range(S - 1)]   # overlap: saved pullbacks
         gacc = [None] * S
         losses, accs = [], []
         dx_last = [None] * M
+        gy = [[None] * M for _ in range(S - 1)]
+        bwd_done = [[False] * M for _ in range(S - 1)]
+        gw = [take_gathered(s) for s in range(S)] if overlap else None
+        pw = gw if overlap else stage_params
         wd = current_watchdog()
+
+        def drain_bwd():
+            # overlap/1F1B: dispatch every backward whose cotangent has
+            # landed, upstream-first, microbatches in order (the grad
+            # accumulation order per stage matches fill-drain)
+            progress = True
+            while progress:
+                progress = False
+                for s in range(S - 2, -1, -1):
+                    for m in range(M):
+                        if gy[s][m] is None or bwd_done[s][m]:
+                            continue
+                        dx = None
+                        if owns_s[s]:
+                            dp, dx = bwd_fns[s](vjps[s][m], gy[s][m])
+                            vjps[s][m] = None   # residuals were donated
+                            gacc[s] = (dp if gacc[s] is None
+                                       else grad_add(gacc[s], dp))
+                        bwd_done[s][m] = True
+                        if s > 0:
+                            gy[s - 1][m] = device_transfer(
+                                dx if dx is not None else act_specs[s],
+                                act_sh[s - 1], op="transfer.hop")
+                        progress = True
+
         # forward wavefront (last stage fuses loss+backward)
         for t in range(S + M - 1):
             if wd is not None:
@@ -270,46 +474,89 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
                 if not 0 <= m < M:
                     continue
                 if s == 0:
-                    xin = jax.device_put(xmb[m], act_sh[0])
+                    xin = device_transfer(xmb[m], act_sh[0],
+                                          op="transfer.hop")
                 else:
                     xin = x_in[s][m]
                 bs_in[s][m] = stage_bs[s]
                 if s < S - 1:
                     x_in[s][m] = xin
-                    ys, nb = fwd_fns[s](stage_params[s], stage_bs[s], xin,
-                                        step, np.int32(m))
-                    stage_bs[s] = nb
+                    ys = None
+                    if owns_s[s]:
+                        if overlap:
+                            ys, nb, vjps[s][m] = fwd_fns[s](
+                                pw[s], stage_bs[s], xin, step, np.int32(m))
+                        else:
+                            ys, nb = fwd_fns[s](pw[s], stage_bs[s], xin,
+                                                step, np.int32(m))
+                        stage_bs[s] = nb
                     # the inter-group hop (ICI/DCN): next stage's input
-                    x_in[s + 1][m] = jax.device_put(ys, act_sh[s + 1])
+                    x_in[s + 1][m] = device_transfer(
+                        ys if ys is not None else act_specs[s + 1],
+                        act_sh[s + 1], op="transfer.hop")
                 else:
                     x_in[s][m] = xin
-                    lab = jax.device_put(ymb[m], label_sh)
-                    loss_m, acc_m, nb, dp, dx = last_fn(
-                        stage_params[s], stage_bs[s], xin, lab, step,
-                        np.int32(m))
-                    stage_bs[s] = nb
-                    gacc[s] = dp if gacc[s] is None else grad_add(gacc[s], dp)
-                    dx_last[m] = dx
-                    losses.append(loss_m)
-                    accs.append(acc_m)
-        # backward wavefront over the upstream stages
-        gy = [[None] * M for _ in range(S - 1)]
-        for m in range(M):
-            if S > 1:
-                gy[S - 2][m] = jax.device_put(dx_last[m], act_sh[S - 2])
-        for t in range(M + S - 1):
-            for s in range(S - 2, -1, -1):
-                m = t - (S - 2 - s)
-                if not 0 <= m < M or gy[s][m] is None:
-                    continue
-                dp, dx = bwd_fns[s](stage_params[s], bs_in[s][m], x_in[s][m],
-                                    gy[s][m], step, np.int32(m))
-                gacc[s] = dp if gacc[s] is None else grad_add(gacc[s], dp)
-                if s > 0:
-                    gy[s - 1][m] = jax.device_put(dx, act_sh[s - 1])
+                    lab = device_transfer(ymb[m], label_sh,
+                                          op="transfer.hop")
+                    dx = None
+                    if owns_s[s]:
+                        loss_m, acc_m, nb, dp, dx = last_fn(
+                            pw[s], stage_bs[s], xin, lab, step,
+                            np.int32(m))
+                        stage_bs[s] = nb
+                        gacc[s] = (dp if gacc[s] is None
+                                   else grad_add(gacc[s], dp))
+                        losses.append(loss_m)
+                        accs.append(acc_m)
+                    if overlap and S > 1:
+                        # 1F1B: ship the cotangent now so upstream backward
+                        # interleaves with later microbatches' forward
+                        gy[S - 2][m] = device_transfer(
+                            dx if dx is not None else act_specs[S - 1],
+                            act_sh[S - 2], op="transfer.hop")
+                    else:
+                        dx_last[m] = dx
+            if overlap:
+                drain_bwd()
+        if overlap:
+            drain_bwd()
+        else:
+            # backward wavefront over the upstream stages (fill-drain)
+            for m in range(M):
+                if S > 1:
+                    gy[S - 2][m] = device_transfer(
+                        dx_last[m] if dx_last[m] is not None
+                        else act_specs[S - 1],
+                        act_sh[S - 2], op="transfer.hop")
+            for t in range(M + S - 1):
+                for s in range(S - 2, -1, -1):
+                    m = t - (S - 2 - s)
+                    if not 0 <= m < M or gy[s][m] is None:
+                        continue
+                    dx = None
+                    if owns_s[s]:
+                        dp, dx = bwd_fns[s](pw[s], bs_in[s][m], x_in[s][m],
+                                            gy[s][m], step, np.int32(m))
+                        gacc[s] = (dp if gacc[s] is None
+                                   else grad_add(gacc[s], dp))
+                    if s > 0:
+                        gy[s - 1][m] = device_transfer(
+                            dx if dx is not None else act_specs[s],
+                            act_sh[s - 1], op="transfer.hop")
         for s in range(S):
-            stage_params[s], stage_opt[s] = upd_fns[s](
-                stage_params[s], stage_opt[s], gacc[s])
+            if owns_s[s]:
+                stage_params[s], stage_opt[s] = upd_fns[s](
+                    stage_params[s], stage_opt[s], gacc[s])
+        if overlap:
+            prefetch_gather()
+        if multiproc:
+            if owns_s[S - 1]:
+                vals = [float(np.mean([float(v) for v in losses])),
+                        float(np.mean([float(v) for v in accs]))]
+            else:
+                vals = [float("nan"), float("nan")]
+            loss, acc = share_scalars(vals, src_process=last_src)
+            return loss, acc
         return (float(np.mean([float(v) for v in losses])),
                 float(np.mean([float(v) for v in accs])))
 
@@ -328,6 +575,7 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
             stage_params[s] = params_tree[skey[s]]
             stage_bs[s] = (bs_tree or {}).get(skey[s], {})
             stage_opt[s] = opt_tree[skey[s]]
+        invalidate_gbuf()   # prefetched gathers of replaced params are stale
 
     store = (CheckpointStore(cfg.checkpoint_dir,
                              keep_last=max(cfg.keep_checkpoints, 1))
@@ -342,7 +590,8 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
 
     tr.stats = {"state_bytes_per_device":
                 per_device_state_bytes(*stage_params, *stage_opt),
-                "stages": S, "groups": len(groups), "microbatches": M}
+                "stages": S, "groups": len(groups), "microbatches": M,
+                "schedule": schedule}
     guard = NonFiniteGuard(policy=cfg.nonfinite_policy,
                            counter_prefix="train")
     history = []
@@ -364,9 +613,9 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
             prev = as_trees() if keep_prev else None
             wd = current_watchdog()
             if wd is not None:
-                # the whole fill-drain schedule (with its host-synced loss)
-                # runs under the stall guard; a hung hop or wedged stage
-                # program surfaces as PeerLostError instead of a dead loop
+                # the whole schedule (with its host-synced loss) runs under
+                # the stall guard; a hung hop or wedged stage program
+                # surfaces as PeerLostError instead of a dead loop
                 loss, acc = wd.run(pipeline_step, step_idx, xb, yb,
                                    op="dl.pipeline.step")
                 wd.beat("dl.pipeline.step", step_idx)
@@ -419,8 +668,10 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
 
 def _host_state(stage_params, stage_bs, skey):
     """Gather the per-stage device state into the full host param/bs trees
-    the trainer's predict/evaluate/save paths expect."""
-    params = {k: host_copy(p) for k, p in zip(skey, stage_params)}
-    bs = {k: host_copy(b) for k, b in zip(skey, stage_bs)
+    the trainer's predict/evaluate/save paths expect. Multi-process this
+    rides the transfer rendezvous, which survives stage groups whose owner
+    set excludes this process entirely."""
+    params = {k: host_fetch(p) for k, p in zip(skey, stage_params)}
+    bs = {k: host_fetch(b) for k, b in zip(skey, stage_bs)
           if jax.tree.leaves(b)}
     return params, bs

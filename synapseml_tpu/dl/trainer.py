@@ -104,6 +104,16 @@ class TrainConfig:
     # stage group) and the within-group param placement (replicated | zero)
     pipeline_microbatches: int = 0
     pipeline_param_sharding: str = "replicated"
+    # pipeline schedule (docs/dl-scaling.md "Overlap schedule"):
+    # "fill_drain" runs the full forward wavefront before backward (GPipe:
+    # remat from saved stage inputs); "overlap" double-buffers each stage's
+    # weights — fwd/bwd consume a once-per-batch gathered copy, the NEXT
+    # batch's ZeRO all-gather is dispatched while the current backward is
+    # still in flight, and backward is 1F1B and transpose-only (saved vjp
+    # residuals, no forward recompute) — trading one replicated param copy
+    # plus residual storage per group for the per-program weight traffic
+    # and the remat flops
+    pipeline_schedule: str = "fill_drain"
 
 
 def _make_tx(cfg: TrainConfig, total_steps: int, trainable_mask=None):

@@ -261,6 +261,14 @@ def stage_submeshes(mesh: Mesh, num_stages: int):
     return groups, assignment
 
 
+def mesh_process_indices(mesh: Mesh):
+    """Sorted process indices owning the mesh's devices. Stage submeshes on a
+    multi-host mesh may land on a strict subset of processes (even disjoint
+    sets per group) — the pipeline engine uses this to decide which stage
+    programs THIS process executes and which hops are cross-host."""
+    return tuple(sorted({d.process_index for d in mesh.devices.ravel()}))
+
+
 def process_topology() -> dict:
     """ClusterUtil analog (core/.../core/utils/ClusterUtil.scala:14-161 computes
     executors, tasks/executor, rows/partition from Spark): on TPU the topology is

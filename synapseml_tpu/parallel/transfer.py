@@ -1,0 +1,137 @@
+"""Host-aware device-to-device transfers between stage-group submeshes.
+
+The MPMD pipeline engine (``dl/pipeline.py``) moves microbatch activations
+and backward cotangents between per-stage submeshes. Single-process, that
+hop is a plain ``jax.device_put`` — XLA schedules the ICI copy and nothing
+here adds work. Multi-process, the source and target submeshes may live on
+different (even disjoint) process sets, where a naive ``device_put`` onto
+non-addressable devices raises under the transfer guard. :func:`device_transfer`
+keeps one call site for both:
+
+* **single-process** — ``jax.device_put(x, sharding)``, unchanged math;
+* **multi-process** — an all-process *rendezvous*: every process contributes
+  the blocks its devices hold (zeros elsewhere) plus a coverage mask through
+  one ``process_allgather``, reconstructs the full host value by taking each
+  element from the lowest-indexed process claiming it, and re-places it with
+  ``make_array_from_callback`` so each process materializes only the target
+  blocks its own devices own. Transfer-guard-clean: no direct device_put
+  ever touches a non-addressable device. (Correctness-first DCN path; an
+  XLA collective-permute hop that never leaves the fabric is the follow-up
+  once multi-host hardware is available to measure it.)
+
+Because the cross-host path is a rendezvous, **every process must call it
+for every hop** — processes with no addressable shard of the source pass a
+``jax.ShapeDtypeStruct`` placeholder and still participate.
+
+Every hop beats the watchdog/chaos hook pair shared with
+:mod:`parallel.collectives` BEFORE moving data, so a dead downstream host
+surfaces as ``PeerLostError`` with the hop's op name on record instead of a
+silent wedge, and ``testing.chaos.chaos_hang(op="transfer.hop")`` can stall
+one deterministically.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from . import collectives as _coll
+
+
+def _beat(op: str) -> None:
+    # shared hook pair with parallel.collectives: elastic_watchdog installs
+    # the heartbeat writer, chaos_hang the stall — both see hop op names
+    hook = _coll._WATCHDOG_HOOK
+    if hook is not None:
+        hook(op)
+    if _coll._CHAOS_HOOK is not None:
+        _coll._CHAOS_HOOK(op)
+
+
+def _rendezvous(x):
+    """Full host value of ``x`` on EVERY process via one all-process
+    allgather. ``x`` is a ``jax.Array`` (contributes its addressable
+    blocks), a ``jax.ShapeDtypeStruct`` (contributes nothing — the caller
+    owns no shard), or a host array (already complete; contributes all)."""
+    from jax.experimental import multihost_utils
+
+    if isinstance(x, jax.ShapeDtypeStruct):
+        shape = tuple(int(d) for d in x.shape)
+        dtype = np.dtype(x.dtype)
+        payload = np.zeros(shape, dtype)
+        have = np.zeros(shape, np.bool_)
+    elif isinstance(x, jax.Array):
+        shape = tuple(int(d) for d in x.shape)
+        dtype = np.dtype(x.dtype)
+        payload = np.zeros(shape, dtype)
+        have = np.zeros(shape, np.bool_)
+        for sh in x.addressable_shards:
+            payload[sh.index] = np.asarray(sh.data)
+            have[sh.index] = True
+    else:
+        payload = np.ascontiguousarray(np.asarray(x))
+        shape = payload.shape
+        have = np.ones(shape, np.bool_)
+    payloads = np.asarray(multihost_utils.process_allgather(payload))
+    haves = np.asarray(multihost_utils.process_allgather(have))
+    if not haves.any(axis=0).all():
+        raise ValueError(
+            "device_transfer: no process holds a shard covering part of the "
+            "source array — was the hop called on every process?")
+    # lowest-indexed contributor wins per element (replicated shards agree)
+    src = np.argmax(haves, axis=0)
+    return np.take_along_axis(payloads, src[None], axis=0)[0]
+
+
+def _place(host, sharding):
+    """Host value -> globally-sharded array; each process materializes only
+    the blocks its local devices own."""
+    host = np.asarray(host)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx, h=host: h[idx])
+
+
+def device_transfer(x, sharding, *, op: str = "transfer.hop"):
+    """Move ``x`` onto ``sharding`` (a NamedSharding on a possibly different
+    submesh) — the pipeline's inter-group hop.
+
+    ``x`` may be a ``jax.Array`` (source-group owners), a host numpy array
+    (replicated host inputs: microbatch rows, labels), or a
+    ``jax.ShapeDtypeStruct`` placeholder (multi-process callers with no
+    addressable shard of the source). Multi-process device-to-device hops
+    are an all-process rendezvous — every process must make the call, in
+    the same schedule order.
+    """
+    _beat(op)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    if not isinstance(x, (jax.Array, jax.ShapeDtypeStruct)):
+        # replicated host value: every process already has it — place
+        # locally, no collective needed
+        return _place(x, sharding)
+    return _place(_rendezvous(x), sharding)
+
+
+def host_fetch(tree, *, op: str = "transfer.fetch"):
+    """Full host (numpy) copy of a possibly cross-host sharded pytree, on
+    every process — unlike ``mesh.host_copy`` this survives leaves whose
+    owning submesh excludes the caller entirely (disjoint stage groups):
+    such leaves ride the same rendezvous with zero contributed blocks."""
+    _beat(op)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda a: np.asarray(a), tree)
+    return jax.tree.map(_rendezvous, tree)
+
+
+def share_scalars(values, src_process: int = 0):
+    """Replicate a small list of host floats from ``src_process`` to every
+    process (the pipeline's loss/acc are computed only on the last stage
+    group's owners). Single-process: identity."""
+    if jax.process_count() == 1:
+        return [float(v) for v in values]
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray([float(v) for v in values], np.float64)
+    out = multihost_utils.broadcast_one_to_all(
+        arr, is_source=jax.process_index() == src_process)
+    return [float(v) for v in np.asarray(out)]

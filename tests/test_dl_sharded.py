@@ -259,6 +259,53 @@ class TestPipeline:
         np.testing.assert_array_equal(ref.predict_logits(X),
                                       resumed.predict_logits(X))
 
+    def test_overlap_parity_with_replicated(self, eight_devices):
+        """schedule='overlap' (double-buffered gathered weights, 1F1B drain)
+        is the same math as fill-drain: microbatch grads averaged once per
+        batch — parity with the plain replicated trainer to <= 1e-5."""
+        X, y = _data()
+        model = self._staged()
+        rep = dl.FlaxTrainer(model, _cfg(),
+                             mesh=parallel.make_mesh({"data": 8}))
+        rep.fit(X, y)
+        pipe = dl.FlaxTrainer(
+            model, _cfg(param_sharding="pipeline", pipeline_microbatches=2,
+                        pipeline_param_sharding="zero",
+                        pipeline_schedule="overlap"),
+            mesh=parallel.make_mesh({"stage": 2, "data": 4}))
+        pipe.fit(X, y)
+        np.testing.assert_allclose(_losses(pipe), _losses(rep), atol=1e-5)
+        assert pipe.stats["schedule"] == "overlap"
+
+    def test_overlap_kill_resume_bit_equal(self, eight_devices, tmp_path):
+        """Resume must invalidate the prefetched gather double-buffer: the
+        restored params, not a stale pre-kill gather, feed the next step."""
+        X, y = _data()
+        model = self._staged()
+        mk = lambda d=None: dl.FlaxTrainer(
+            model, _cfg(max_epochs=4, param_sharding="pipeline",
+                        pipeline_microbatches=2,
+                        pipeline_param_sharding="zero",
+                        pipeline_schedule="overlap", checkpoint_dir=d),
+            mesh=parallel.make_mesh({"stage": 2, "data": 4}))
+        ref = mk().fit(X, y)
+        d = str(tmp_path / "ck")
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"dl.epoch": [2]}):
+                mk(d).fit(X, y)
+        resumed = mk(d).fit(X, y)
+        np.testing.assert_array_equal(ref.predict_logits(X),
+                                      resumed.predict_logits(X))
+
+    def test_unknown_schedule_rejected(self, eight_devices):
+        X, y = _data()
+        tr = dl.FlaxTrainer(self._staged(),
+                            _cfg(param_sharding="pipeline",
+                                 pipeline_schedule="zigzag"),
+                            mesh=parallel.make_mesh({"stage": 2, "data": 4}))
+        with pytest.raises(NotImplementedError, match="zigzag"):
+            tr.fit(X, y)
+
     def test_requires_staged_model_and_stage_axis(self, eight_devices):
         X, y = _data()
         tr = dl.FlaxTrainer(dl.make_backbone("tiny", 4),
@@ -354,3 +401,36 @@ class TestShardedStoreRoundtrip:
         from synapseml_tpu.core.checkpoint import load_sharded_from_checkpoint
         with pytest.raises(CheckpointError, match="shape"):
             load_sharded_from_checkpoint(store, ckpt, bad)
+
+
+class TestScalingMatrixDocsSync:
+    def test_docs_table_matches_supported_matrix(self):
+        """docs/dl-scaling.md renders the supported-config matrix verbatim;
+        the authoritative copy is SUPPORTED_MATRIX in dl/pipeline.py (carried
+        by ElasticUnsupportedError). Drift fails here, in either direction."""
+        import os.path
+
+        from synapseml_tpu.dl.pipeline import SUPPORTED_MATRIX
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "docs", "dl-scaling.md")
+        with open(path) as f:
+            lines = f.read().splitlines()
+        try:
+            start = next(i for i, ln in enumerate(lines)
+                         if ln.replace(" ", "") ==
+                         "|configuration|supported|")
+        except StopIteration:
+            pytest.fail("docs/dl-scaling.md lost its "
+                        "'| configuration | supported |' table")
+        rows = {}
+        for ln in lines[start + 2:]:          # skip the |---|---| rule
+            ln = ln.strip()
+            if not ln.startswith("|"):
+                break
+            cells = [c.strip() for c in ln.strip("|").split("|")]
+            key = cells[0].replace("`", "").replace('"', "'")
+            rows[key] = cells[1].lower().lstrip("*").startswith("yes")
+        assert rows == SUPPORTED_MATRIX
+        assert all(SUPPORTED_MATRIX.values()), \
+            "the parallelism matrix is closed; no cell may regress to 'no'"

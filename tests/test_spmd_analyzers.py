@@ -799,3 +799,71 @@ def test_update_baseline_prunes_and_reports(tmp_path):
     assert "baseline pruned:" in second.stdout
     assert "yyy_missing" in second.stdout
     assert "1 stale entry dropped" in second.stdout
+
+
+# --- parallel/transfer.py rendezvous helpers (PR 13) --------------------
+
+def test_collectives_flags_device_transfer_divergent_branch(tmp_path):
+    """A transfer hop is an all-process rendezvous: a hop only process 0
+    reaches is the same static deadlock as a bare collective."""
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax
+        from synapseml_tpu.parallel.transfer import device_transfer
+
+        def hop(x, sh):
+            if jax.process_index() == 0:
+                return device_transfer(x, sh, op="transfer.hop")
+            return x
+        """})
+    found = collectives.run(ctx)
+    assert len(found) == 1
+    assert "deadlock" in found[0].message
+    assert "device_transfer" in found[0].message
+
+
+def test_collectives_unconditional_device_transfer_is_clean(tmp_path):
+    """The pipeline idiom: every process calls the hop; only the payload
+    argument (not control flow) depends on ownership."""
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax
+        from synapseml_tpu.parallel.transfer import (device_transfer,
+                                                     share_scalars)
+
+        def hop(owner, ys, spec, sh):
+            out = device_transfer(ys if owner else spec, sh,
+                                  op="transfer.hop")
+            vals = share_scalars([1.0, 2.0], src_process=0)
+            return out, vals
+        """})
+    assert collectives.run(ctx) == []
+
+
+def test_sharding_flags_host_access_on_device_transfer(tmp_path):
+    """device_transfer places onto the target submesh — its result is a
+    globally-sharded array, not host-addressable everywhere."""
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import numpy as np
+        from synapseml_tpu.parallel.transfer import device_transfer
+
+        def export(x, sh):
+            g = device_transfer(x, sh, op="transfer.hop")
+            return np.asarray(g)
+        """})
+    found = sharding.run(ctx)
+    assert len(found) == 1
+    assert "globally-sharded" in found[0].message
+
+
+def test_sharding_device_transfer_fetched_via_host_fetch_is_clean(tmp_path):
+    """host_fetch is the sanctioned gather: its output is host-local, so
+    numpy access on it is fine (call outputs clear input taint)."""
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import numpy as np
+        from synapseml_tpu.parallel.transfer import device_transfer, host_fetch
+
+        def export(x, sh):
+            g = device_transfer(x, sh, op="transfer.hop")
+            h = host_fetch(g)
+            return np.asarray(h)
+        """})
+    assert sharding.run(ctx) == []

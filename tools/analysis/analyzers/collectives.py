@@ -60,9 +60,14 @@ _AXIS_OPS = {
 #: axis, so the keyword remap is scoped to exactly these ops)
 _REPO_AXIS_KW = ("allreduce_sum_quantized", "reduce_scatter_sum_quantized")
 
-#: axis-free cross-process synchronization points (C2/C3 only)
+#: axis-free cross-process synchronization points (C2/C3 only).
+#: ``device_transfer``/``host_fetch``/``share_scalars`` are the
+#: parallel/transfer.py inter-group rendezvous helpers — every process must
+#: reach each hop, so one under replica-divergent control flow is the same
+#: static deadlock as a bare collective
 _SYNC_SUFFIX = (".process_allgather", ".broadcast_one_to_all",
-                ".sync_global_devices")
+                ".sync_global_devices", ".device_transfer", ".host_fetch",
+                ".share_scalars")
 
 #: host-local / per-replica value sources: branching on these diverges
 _DIVERGENT_EXACT = {

@@ -34,9 +34,12 @@ DESCRIPTION = ("shard_map spec arity vs. signature, NamedSharding axes "
                "missing from the mesh, host access on globally-sharded "
                "arrays")
 
-#: producers of globally-sharded arrays (canonical suffixes)
+#: producers of globally-sharded arrays (canonical suffixes);
+#: parallel/transfer.device_transfer places its payload onto the target
+#: submesh's devices, so its result is global exactly like the others
 _GLOBAL_PRODUCERS = (".to_global_rows", ".make_array_from_process_local_data",
-                     ".shard_rows", ".apply_tree_shardings")
+                     ".shard_rows", ".apply_tree_shardings",
+                     ".device_transfer")
 
 #: host accesses that assume every shard is locally addressable
 _HOST_NUMPY = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray",

@@ -690,6 +690,22 @@ def record_measurement(entry: dict, path: str = None):
         print(f"# measurement log write failed: {e}", file=sys.stderr)
 
 
+def _perf_row(kind: str, arm: str, features: dict, observed_s: float,
+              **extra):
+    """Append one perfmodel training row (core/perfmodel journal). Every
+    bench arm that prices an alternative labels it here, so the model's
+    training set grows with every bench run. Best-effort: a row-write
+    failure must never sink the measurement itself."""
+    try:
+        from synapseml_tpu.core import perfmodel
+
+        perfmodel.append_training_row(kind, arm, features, observed_s,
+                                      **extra)
+    except Exception as e:
+        print(f"# perf row write failed ({kind}/{arm}): {e}",
+              file=sys.stderr)
+
+
 def _read_measurements(path: str = None):
     """All recorded entries in capture order: the legacy/derived array
     (docs/measurements.json) merged with the append-only JSONL journal
@@ -808,6 +824,15 @@ def _emit_fallback_and_exit(why: str):
                    if m in _HOST_SIDE_METRICS]
         if extras:
             out["extras"] = extras
+        # name WHICH metrics are stale, not just that something is: a driver
+        # reading only stderr can tell re-capture targets from fresh numbers
+        stale_names = sorted({e.get("metric") for e in [out] + extras
+                              if e.get("stale_warning") and e.get("metric")})
+        if stale_names:
+            out["stale_metrics"] = stale_names
+            print(f"# WARNING: {len(stale_names)} replayed metric(s) older "
+                  f"than {STALE_AFTER_DAYS} days: {', '.join(stale_names)}",
+                  file=sys.stderr)
         print(json.dumps(out), flush=True)
         os._exit(0)
     print(json.dumps({
@@ -1285,6 +1310,40 @@ def bench_oocore_gbdt(rows=200_000, cols=50, iters=6):
     oversize = stream_bytes / max(in_flight, 1)
     ratio_1x = v_1x / max(v_res, 1e-9)
     ratio_10x = v_10x / max(v_res, 1e-9)
+
+    # chunk-geometry A/B for the io_chunk_rows perfmodel family: short
+    # streamed trains at power-of-two chunk sizes around the probe-formula
+    # default (the default itself included, so the model can only displace
+    # it on a measured win). Features mirror perfmodel.suggest_chunk_rows —
+    # the stream's per-row device bytes, pump depth, arm chunk rows.
+    import dataclasses as _dc
+
+    from synapseml_tpu.core import perfmodel
+    from synapseml_tpu.io.ingest import stream_chunk_rows, stream_depth
+
+    c_default = stream_chunk_rows(row_bytes)
+    p = int(round(np.log2(max(c_default, 2))))
+    chunk_arms = sorted({c_default}
+                        | {1 << q for q in (p - 1, p, p + 1)
+                           if 8192 <= (1 << q) <= (1 << 20)})
+    ab_cfg = _dc.replace(cfg, num_iterations=3)
+    depth = stream_depth()
+    chunk_ab = {}
+    for cr in chunk_arms:
+        ds = StreamedDataset.from_arrays(X, y, chunk_rows=cr)
+        ds.prepare(ab_cfg)
+        t0 = time.perf_counter()
+        b = train_booster_streamed(ds, ab_cfg)
+        jax.block_until_ready(b.trees[-1].leaf_value)
+        dt = time.perf_counter() - t0
+        # observed seconds PER ROW so rows stay comparable across bench
+        # sizes (the analytic prior is also per-row)
+        _perf_row("io_chunk_rows", f"c{cr}",
+                  perfmodel.featurize(row_bytes=row_bytes, depth=depth,
+                                      chunk_rows=cr),
+                  dt / (rows * ab_cfg.num_iterations),
+                  default_arm=(cr == c_default))
+        chunk_ab[str(cr)] = round(rows * ab_cfg.num_iterations / dt, 1)
     return {"metric": "oocore_gbdt_streamed_row_iters_per_sec",
             "value": round(v_10x, 1),
             "unit": (f"row-iterations/sec streamed @ 10x-oversized "
@@ -1297,6 +1356,8 @@ def bench_oocore_gbdt(rows=200_000, cols=50, iters=6):
             "streamed_vs_resident_1x": round(ratio_1x, 3),
             "streamed_vs_resident_10x": round(ratio_10x, 3),
             "oversize_ratio": round(oversize, 1),
+            "chunk_geometry_row_iters_per_s": chunk_ab,
+            "chunk_default_rows": c_default,
             "guard": {"streamed_10x_ge_0p7x_resident": ratio_10x >= 0.7,
                       "oversize_ratio_ge_10": oversize >= 10.0}}
 
@@ -1667,6 +1728,28 @@ def bench_distributed_gbdt_auto(iters=10):
                          "resolved": cfg.tree_learner}
             if arm == "auto":
                 dres[arm]["routing"] = b.metadata.get("routing")
+            else:
+                # manual arms are labelled ground truth for the perfmodel:
+                # same feature schema _auto_route ranks candidates with.
+                # data_f32 is excluded — same learner at a different wire
+                # dtype would confound the learner family's "data" arm (it
+                # prices the WIRE family below instead)
+                if arm != "data_f32":
+                    from synapseml_tpu.gbdt.boosting import _route_features
+
+                    _perf_row("gbdt_tree_learner", arm,
+                              _route_features(cfg, rows, cols, 8), best_dt,
+                              mesh=mesh)
+                if arm in ("data", "data_f32"):
+                    # the same pair of timed fits prices the wire ladder:
+                    # identical routing, int8 vs f32 histogram allreduce
+                    from synapseml_tpu.core import perfmodel
+
+                    wd = cfg.hist_allreduce_dtype
+                    _perf_row("gbdt_wire_dtype", wd, perfmodel.featurize(
+                        wire_dtype=wd, rows=rows, nfeat=cols, workers=8,
+                        max_bin=base["max_bin"],
+                        num_leaves=base["num_leaves"]), best_dt, mesh=mesh)
         best_manual = max(v["row_iters_per_s"] for a, v in dres.items()
                           if a not in ("auto", "data_f32"))
         auto_rate = dres["auto"]["row_iters_per_s"]
@@ -1762,6 +1845,22 @@ def bench_dl_sharded(epochs=3):
                     tr.stats["state_bytes_per_device"],
                 "final_loss": round(tr.history[-1]["loss"], 4),
             }
+            # labelled step time for the dl_param_sharding family (schema of
+            # perfmodel.suggest_param_sharding / trainer autoconfig)
+            import jax
+
+            from synapseml_tpu.core import perfmodel
+
+            pb = int(sum(int(np.prod(p.shape)) * p.dtype.itemsize
+                         for p in jax.tree.leaves(tr.params)))
+            data_axis = int(dict(mesh.shape).get("data", 1))
+            feats = dict(param_bytes=pb, batch=cfg.batch_size,
+                         workers=data_axis)
+            if aname == "pipeline":
+                feats["stages"] = 2
+            _perf_row("dl_param_sharding", aname,
+                      perfmodel.featurize(**feats),
+                      cres[aname]["step_ms"] / 1e3, mesh=mesh)
         rep, zero = cres["replicated"], cres["zero"]
         cres["zero_bytes_ratio"] = round(
             zero["state_bytes_per_device"]
@@ -1837,6 +1936,14 @@ def bench_dl_overlap_pipeline(epochs=3, trials=3):
     speedup = float(np.median(ratios))
     parity = max(abs(a - b) for arm in (fill, over)
                  for a, b in zip(arm["losses"], rep["losses"]))
+    # labelled step times for the dl_pipeline_schedule family (schema of
+    # perfmodel.suggest_pipeline_schedule: 2 stages, M=2 microbatches)
+    from synapseml_tpu.core import perfmodel
+
+    for sched_arm, res in (("fill_drain", fill), ("overlap", over)):
+        _perf_row("dl_pipeline_schedule", sched_arm,
+                  perfmodel.featurize(stages=2, microbatches=2),
+                  res["step_ms"] / 1e3, mesh=mesh_pipe)
     return {"metric": "dl_overlap_vs_fill_drain_speedup",
             "platform": "cpu-mesh-8",   # honest provenance: never the chip
             "value": round(speedup, 3),
@@ -1937,6 +2044,17 @@ def main():
     from synapseml_tpu.core.compile_cache import enable_compile_cache
 
     enable_compile_cache()
+    if not only:
+        # parent only: concurrent children would race the idempotence check
+        try:
+            from synapseml_tpu.core.perfmodel import backfill_training_rows
+
+            nb = backfill_training_rows()
+            if nb:
+                print(f"# backfilled {nb} perfmodel training rows from "
+                      "docs/measurements.json", file=sys.stderr)
+        except Exception as e:
+            print(f"# perf-row backfill failed: {e}", file=sys.stderr)
     if only:
         print(json.dumps(_extra_workloads()[only]()), flush=True)
         return

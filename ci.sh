@@ -203,6 +203,14 @@ assert rec["guard"]["streamed_10x_ge_0p7x_resident"], \
      f"{rec['resident_row_iters_per_s']} r-i/s — below the 0.7x floor")
 EOF
 
+echo "== auto-config guard (perfmodel.choose >= 0.95x best hand-tuned arm) =="
+# runs AFTER the bench-backed guards above so this very CI run's training
+# rows (gbdt router/wire, dl sharding/schedule, chunk geometry) are in the
+# journal; adds its own bucket-growth micro A/B, then asserts the learned
+# model never picks a >5%-slower config than the best hand-tuned arm on any
+# recorded family (docs/perf-model.md "Confidence / fallback rule")
+JAX_PLATFORMS=cpu python tools/autoconfig_guard.py
+
 echo "== elastic training guard (kill/hang a rank -> detect, agree, reshard, resume) =="
 # the chaos battery behind docs/resilience.md "Elastic training": watchdog
 # stall detection (stale peer vs slow straggler vs wedged collective),

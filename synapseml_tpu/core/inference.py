@@ -148,11 +148,18 @@ class BucketedRunner:
     """
 
     def __init__(self, fn: Callable, max_batch_size: int = 64,
-                 growth: float = 2.0, min_bucket: int = 1,
+                 growth: Optional[float] = None, min_bucket: int = 1,
                  donate: Optional[bool] = None, pass_mask: bool = False,
                  name: Optional[str] = None):
         self.fn = fn
         self.max_batch_size = int(max_batch_size)
+        # ladder geometry: an explicit growth bypasses auto-configuration;
+        # None asks core/perfmodel, whose recorded ladder A/Bs can move the
+        # factor off 2.0 only for a confidently matched workload — the
+        # decision (or its fallback) is auditable via stats()["autoconfig"]
+        self._autoconfig: Optional[dict] = None
+        if growth is None:
+            growth = self._auto_growth()
         self.buckets = bucket_ladder(self.max_batch_size, growth, min_bucket)
         self.donate = donate
         self.pass_mask = pass_mask
@@ -163,6 +170,17 @@ class BucketedRunner:
         self._compile_counts: Dict[int, int] = {}
         self._hit_counts: Dict[int, int] = {}
         self._warmup_compiles = 0
+
+    def _auto_growth(self) -> float:
+        """Growth factor from the learned perf model (fallback 2.0)."""
+        try:
+            from . import perfmodel
+
+            g, dec = perfmodel.suggest_bucket_growth(self.max_batch_size)
+            self._autoconfig = dec.provenance()
+            return g
+        except Exception:  # model failure keeps 2.0
+            return 2.0
 
     # --- bucket selection ------------------------------------------------
     def bucket_for(self, n: int) -> int:
@@ -312,13 +330,16 @@ class BucketedRunner:
         with self._lock:
             compiles = dict(sorted(self._compile_counts.items()))
             hits = dict(sorted(self._hit_counts.items()))
-            return {"name": self.name,
-                    "buckets": list(self.buckets),
-                    "compiles": compiles,
-                    "hits": hits,
-                    "warmup_compiles": self._warmup_compiles,
-                    "total_compiles": sum(compiles.values()),
-                    "total_hits": sum(hits.values())}
+            out = {"name": self.name,
+                   "buckets": list(self.buckets),
+                   "compiles": compiles,
+                   "hits": hits,
+                   "warmup_compiles": self._warmup_compiles,
+                   "total_compiles": sum(compiles.values()),
+                   "total_hits": sum(hits.values())}
+            if self._autoconfig is not None:
+                out["autoconfig"] = self._autoconfig
+            return out
 
     def reset_stats(self) -> None:
         """Zero the hit counters (compile counts describe the cache contents

@@ -150,11 +150,93 @@ def tuned_default(key: str, env_var: str, fallback):
 # In-process measurement store. Unlike the tuned FILE above (chip facts,
 # persisted, TPU-gated), these are probe results valid only for the current
 # process+mesh — link bandwidth, selection timing — consumed by the
-# distributed-GBDT router. First caller pays the probe; later boosters on the
-# same mesh read the cached number.
+# distributed-GBDT router and core/perfmodel. First caller pays the probe;
+# later boosters on the same mesh read the cached number.
+#
+# Probe results computed by ``measured_or`` are additionally persisted to a
+# small TTL'd disk cache (docs/probe_cache.json by default) so repeated CI
+# runs on the same machine don't re-pay the probes. Keys embed the mesh
+# fingerprint (device strings), so a cpu cache entry can never serve a tpu
+# mesh. ``put_measurement`` deliberately does NOT persist: it is the test
+# injection hook, and an injected fake must never leak across processes.
 # ---------------------------------------------------------------------------
 
 _MEASUREMENTS: dict = {}
+
+PROBE_CACHE_PATH = os.path.join(_REPO, "docs", "probe_cache.json")
+PROBE_CACHE_TTL_S = 24 * 3600.0
+
+
+def _probe_cache_path() -> Optional[str]:
+    p = os.environ.get("SYNAPSEML_TPU_PROBE_CACHE", PROBE_CACHE_PATH)
+    return None if p in ("", "0", "off") else p
+
+
+def _probe_cache_ttl() -> float:
+    try:
+        return float(os.environ.get("SYNAPSEML_TPU_PROBE_CACHE_TTL_S",
+                                    PROBE_CACHE_TTL_S))
+    except ValueError:
+        return PROBE_CACHE_TTL_S
+
+
+def _key_str(key) -> str:
+    """Canonical string form of a (possibly nested-tuple) cache key."""
+    def listify(k):
+        if isinstance(k, (tuple, list)):
+            return [listify(x) for x in k]
+        return k
+    try:
+        return json.dumps(listify(key), sort_keys=True)
+    except (TypeError, ValueError):
+        return repr(key)
+
+
+def _read_probe_cache(path: str) -> dict:
+    try:
+        with open(path) as f:  # host-side cache read, never under trace
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _disk_probe_get(key):
+    """A fresh (within-TTL) persisted probe value, or None."""
+    path = _probe_cache_path()
+    if path is None:
+        return None
+    entry = _read_probe_cache(path).get(_key_str(key))
+    if not isinstance(entry, dict) or "value" not in entry:
+        return None
+    import time
+    try:
+        if time.time() - float(entry.get("ts", 0)) > _probe_cache_ttl():
+            return None
+    except (TypeError, ValueError):
+        return None
+    return entry["value"]
+
+
+def _disk_probe_put(key, value) -> None:
+    path = _probe_cache_path()
+    if path is None:
+        return
+    try:
+        json.dumps(value)
+    except (TypeError, ValueError):
+        return  # only JSON-representable probe results persist
+    import time
+    try:
+        cache = _read_probe_cache(path)
+        cache[_key_str(key)] = {"value": value, "ts": time.time()}
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(cache, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass  # persistence is best-effort; the in-process cache still holds
 
 
 def mesh_fingerprint(mesh) -> tuple:
@@ -169,9 +251,16 @@ def mesh_fingerprint(mesh) -> tuple:
 def measured_or(key, compute):
     """Get-or-measure: return the cached value for ``key``, running
     ``compute()`` (and caching its result) on the first call. Keys should
-    start with a metric name and include ``mesh_fingerprint(mesh)``."""
+    start with a metric name and include ``mesh_fingerprint(mesh)``.
+    Computed results also land in the TTL'd disk cache; a fresh persisted
+    value short-circuits the probe entirely."""
     if key not in _MEASUREMENTS:
-        _MEASUREMENTS[key] = compute()
+        persisted = _disk_probe_get(key)
+        if persisted is not None:
+            _MEASUREMENTS[key] = persisted
+        else:
+            _MEASUREMENTS[key] = compute()
+            _disk_probe_put(key, _MEASUREMENTS[key])
     return _MEASUREMENTS[key]
 
 
@@ -184,8 +273,16 @@ def put_measurement(key, value) -> None:
 
 
 def clear_measurements() -> None:
-    """Test hook: forget all probe results (forces re-measurement)."""
+    """Test hook: forget all probe results (forces re-measurement). Clears
+    the persisted disk cache too — "clear" must mean the next probe really
+    runs, not that it is re-read from disk."""
     _MEASUREMENTS.clear()
+    path = _probe_cache_path()
+    if path is not None:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
 
 
 def write_tuned_defaults(values: dict, provenance: dict,

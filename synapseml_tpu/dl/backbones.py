@@ -325,16 +325,31 @@ def stage_units(name: str, num_classes: int, dtype=jnp.float32,
     return units
 
 
-def partition_stages(units, num_stages: int) -> StageSequential:
-    """Cut a unit list into ``num_stages`` contiguous, size-balanced stages
-    (the stage partitioner; remainder units go to the earliest stages, which
-    also carry the smaller activations in a CNN)."""
+def partition_stages(units, num_stages: int,
+                     unit_costs=None) -> StageSequential:
+    """Cut a unit list into ``num_stages`` contiguous stages.
+
+    Default (``unit_costs=None``): size-balanced — remainder units go to the
+    earliest stages, which also carry the smaller activations in a CNN.
+    With ``unit_costs`` (one non-negative cost per unit, e.g. parameter bytes
+    or measured per-unit step seconds), cuts are cost-balanced instead via
+    ``core.perfmodel.suggest_stage_cuts`` (min-max contiguous partition);
+    degenerate costs fall back to the size-balanced split."""
     if not 1 <= num_stages <= len(units):
         raise ValueError(
             f"num_stages={num_stages} must be in [1, {len(units)}] for a "
             f"{len(units)}-unit backbone")
-    k, m = divmod(len(units), num_stages)
-    sizes = [k + (1 if i < m else 0) for i in range(num_stages)]
+    if unit_costs is not None:
+        if len(unit_costs) != len(units):
+            raise ValueError(
+                f"unit_costs has {len(unit_costs)} entries for "
+                f"{len(units)} units")
+        from ..core.perfmodel import suggest_stage_cuts
+
+        sizes, _dec = suggest_stage_cuts(unit_costs, num_stages)
+    else:
+        k, m = divmod(len(units), num_stages)
+        sizes = [k + (1 if i < m else 0) for i in range(num_stages)]
     groups, at = [], 0
     for sz in sizes:
         groups.append(StageGroup(tuple(units[at: at + sz])))

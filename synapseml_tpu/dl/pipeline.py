@@ -113,6 +113,20 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
             "param_sharding='pipeline' requires a mesh with a 'stage' axis, "
             "e.g. parallel.make_mesh({'stage': G, 'data': D})")
     schedule = cfg.pipeline_schedule
+    sched_dec = None
+    if schedule == "auto":
+        # defer to core.perfmodel: analytic bubble fractions, displaced by
+        # recorded dl_pipeline_schedule rows (bench_dl_overlap_pipeline);
+        # explicit "fill_drain"/"overlap" bypasses the model entirely
+        from ..core import perfmodel
+
+        m_hint = (int(cfg.pipeline_microbatches)
+                  or int(dict(tr.mesh.shape).get(STAGE_AXIS, 1)))
+        try:
+            schedule, sched_dec = perfmodel.suggest_pipeline_schedule(
+                len(model.stages), m_hint)
+        except Exception:
+            schedule = "fill_drain"
     if schedule not in _SCHEDULES:
         raise ElasticUnsupportedError(
             f"pipeline schedule {schedule!r}", matrix=SUPPORTED_MATRIX,
@@ -592,6 +606,9 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
                 per_device_state_bytes(*stage_params, *stage_opt),
                 "stages": S, "groups": len(groups), "microbatches": M,
                 "schedule": schedule}
+    if sched_dec is not None:
+        tr.stats["autoconfig"] = {
+            "pipeline_schedule": sched_dec.provenance()}
     guard = NonFiniteGuard(policy=cfg.nonfinite_policy,
                            counter_prefix="train")
     history = []

@@ -84,13 +84,17 @@ class TrainConfig:
     # optimizer moments over the data axis), or "pipeline" (MPMD stages over a
     # "stage" mesh axis; needs a dl.StageSequential model). The reference's
     # Horovod stack has none of these (SURVEY §2.2 "NOT PRESENT").
-    param_sharding: str = "replicated"  # replicated | zero | fsdp | pipeline
+    # "auto" defers to core.perfmodel (recorded dl_param_sharding rows from
+    # bench_dl_sharded); low confidence falls back to "replicated" and the
+    # decision provenance lands in trainer.stats["autoconfig"].
+    param_sharding: str = "replicated"  # replicated | zero | fsdp | pipeline | auto
     # microbatch gradient accumulation INSIDE train_step: the global batch is
     # split into accum_steps microbatches scanned sequentially, trading the
     # ZeRO all-gather count against live activation memory (one gather set
     # per step regardless of accum). batch_size must divide evenly. Note:
     # BatchNorm stats and the dropout stream see microbatches, so accum > 1
-    # is not bit-identical to accum=1 for models with BN/dropout.
+    # is not bit-identical to accum=1 for models with BN/dropout. 0 defers
+    # the choice to core.perfmodel (fallback 1, provenance in stats).
     accum_steps: int = 1
     # host->device input pipeline depth (_prefetch): how many future batches
     # are sharded/device_put ahead of the step consuming them
@@ -112,8 +116,10 @@ class TrainConfig:
     # still in flight, and backward is 1F1B and transpose-only (saved vjp
     # residuals, no forward recompute) — trading one replicated param copy
     # plus residual storage per group for the per-program weight traffic
-    # and the remat flops
-    pipeline_schedule: str = "fill_drain"
+    # and the remat flops. "auto" defers the choice to core.perfmodel
+    # (analytic bubble model, displaced by recorded dl_pipeline_schedule
+    # rows); provenance lands in trainer.stats["autoconfig"].
+    pipeline_schedule: str = "fill_drain"  # fill_drain | overlap | auto
 
 
 def _make_tx(cfg: TrainConfig, total_steps: int, trainable_mask=None):
@@ -239,6 +245,49 @@ class FlaxTrainer:
             return to_global_rows(self.mesh, spec, arr)
         return jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
 
+    # --- auto configuration (core/perfmodel) -----------------------------
+    def _resolve_autoconfig(self, cfg: TrainConfig) -> dict:
+        """Resolve the ``param_sharding="auto"`` / ``accum_steps=0`` sentinels.
+
+        Delegates to core.perfmodel (``suggest_param_sharding`` /
+        ``suggest_accum_steps``) with the hand-tuned defaults
+        (``"replicated"``, ``1``) as the low-confidence fallback.  Explicit
+        values bypass the model entirely; Decision provenance is returned
+        for ``trainer.stats["autoconfig"]`` so a fleet operator can audit
+        predicted-vs-observed after the fit.
+        """
+        auto_sharding = cfg.param_sharding == "auto"
+        auto_accum = int(cfg.accum_steps) == 0
+        if not (auto_sharding or auto_accum):
+            return {}
+        info: dict = {}
+        try:
+            from ..core import perfmodel
+
+            pbytes = int(sum(int(np.prod(p.shape)) * p.dtype.itemsize
+                             for p in jax.tree.leaves(self.params)))
+            devices = 1
+            if self.mesh is not None:
+                devices = int(dict(self.mesh.shape).get(DATA_AXIS, 1))
+            if auto_sharding:
+                arm, dec = perfmodel.suggest_param_sharding(
+                    pbytes, int(cfg.batch_size), devices)
+                if arm in ("zero", "fsdp", "pipeline") and self.mesh is None:
+                    arm = "replicated"  # sharded state needs a mesh
+                cfg.param_sharding = arm
+                info["param_sharding"] = dec.provenance()
+            if auto_accum:
+                k, dec = perfmodel.suggest_accum_steps(
+                    int(cfg.batch_size), pbytes, None)
+                cfg.accum_steps = max(1, int(k))
+                info["accum_steps"] = dec.provenance()
+        except Exception:  # model failure must never block training
+            if cfg.param_sharding == "auto":
+                cfg.param_sharding = "replicated"
+            if int(cfg.accum_steps) == 0:
+                cfg.accum_steps = 1
+        return info
+
     # --- train ----------------------------------------------------------
     def fit(self, X, y, valid: Optional[tuple] = None,
             log_fn: Optional[Callable] = None):
@@ -247,14 +296,15 @@ class FlaxTrainer:
             from .pipeline import fit_pipeline
 
             return fit_pipeline(self, X, y, valid=valid, log_fn=log_fn)
-        if cfg.param_sharding not in ("replicated", "zero", "fsdp"):
-            raise ValueError(
-                f"unknown param_sharding {cfg.param_sharding!r}; expected "
-                "replicated | zero | fsdp | pipeline")
         X = np.asarray(X)
         y = np.asarray(y)
         if self.params is None:
             self.init(X)
+        autoconfig_info = self._resolve_autoconfig(cfg)
+        if cfg.param_sharding not in ("replicated", "zero", "fsdp"):
+            raise ValueError(
+                f"unknown param_sharding {cfg.param_sharding!r}; expected "
+                "replicated | zero | fsdp | pipeline | auto")
         n = len(X)
         steps_per_epoch = cfg.steps_per_epoch or max(n // cfg.batch_size, 1)
         total_steps = steps_per_epoch * cfg.max_epochs
@@ -399,6 +449,8 @@ class FlaxTrainer:
                     opt_state = apply_tree_shardings(opt_state, opt_sh)
         self.stats = {"state_bytes_per_device":
                       per_device_state_bytes(params, opt_state)}
+        if autoconfig_info:
+            self.stats["autoconfig"] = autoconfig_info
         guard = NonFiniteGuard(policy=cfg.nonfinite_policy,
                                counter_prefix="train")
 
@@ -486,6 +538,10 @@ class FlaxTrainer:
             epoch += 1
         self.params, self.batch_stats = params, batch_stats
         self.history = history
+        if autoconfig_info:
+            # predicted-vs-observed audit trail for the perfmodel decisions
+            autoconfig_info["observed_fit_s"] = round(
+                sum(ep["seconds"] for ep in history), 6)
         return self
 
     # --- eval / predict ---------------------------------------------------

@@ -19,6 +19,7 @@ import dataclasses
 import functools
 import math
 import os
+import time as _time
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -137,7 +138,11 @@ class BoosterConfig:
     # grad/hess ride the wire at reduced width (counts stay exact), cutting
     # per-split collective bytes to 2/3 (bf16) or ~1/2 (int8 blockwise-
     # quantized allreduce, EQuARX-style incl. per-block scales) on
-    # multi-host fabrics; see GrowerConfig.hist_allreduce_dtype
+    # multi-host fabrics; see GrowerConfig.hist_allreduce_dtype. "auto"
+    # resolves at fit time through core/perfmodel (grower.resolve_wire_dtype):
+    # the learned model picks the ladder rung only on measured evidence for a
+    # matching workload, else the conservative f32 wire; the decision lands
+    # in Booster.metadata["autoconfig"]["wire_dtype"]
     hist_allreduce_dtype: str = "f32"
     # lambdarank
     lambdarank_truncation_level: int = 30
@@ -181,11 +186,11 @@ class BoosterConfig:
             raise ValueError(
                 f"BoosterConfig.growth_policy={self.growth_policy!r} is not "
                 "one of ('leafwise', 'depthwise')")
-        if self.hist_allreduce_dtype not in ("f32", "bf16", "int8"):
+        if self.hist_allreduce_dtype not in ("auto", "f32", "bf16", "int8"):
             raise ValueError(
                 f"BoosterConfig.hist_allreduce_dtype="
                 f"{self.hist_allreduce_dtype!r} is not one of "
-                "('f32', 'bf16', 'int8')")
+                "('auto', 'f32', 'bf16', 'int8')")
         if self.tree_learner not in ("auto", "serial", "data", "voting",
                                      "feature"):
             raise ValueError(
@@ -202,6 +207,7 @@ class BoosterConfig:
         order can't produce a half-tuned config."""
         deferred = []
         closed = not _tuned.backend_is_tpu()
+        untuned = []
         for field, env, fallback in (
                 ("partition_impl", "SYNAPSEML_TPU_PARTITION_IMPL", "sort"),
                 ("row_layout", "SYNAPSEML_TPU_ROW_LAYOUT", "partition"),
@@ -212,20 +218,46 @@ class BoosterConfig:
             if v:
                 setattr(self, field, v)
                 continue
-            setattr(self, field,
-                    _tuned.tuned_engine_defaults().get(field, fallback))
+            td = _tuned.tuned_engine_defaults()
+            setattr(self, field, td.get(field, fallback))
+            if field in ("partition_impl", "row_layout") and field not in td:
+                untuned.append(field)
             if closed:
                 deferred.append((field, fallback))
         self._deferred_tuned = deferred
+        self._autoconfig = {}
+        self._suggest_kernel_variant(untuned)
+
+    def _suggest_kernel_variant(self, untuned):
+        """Where neither env nor tuned file pinned the kernel variant, let
+        the learned perf model suggest one from recorded kernel-sweep rows
+        (same arms tools/perf_tune.py measures). Low confidence — e.g. no
+        rows for this platform — keeps the hardcoded fallback, so behavior
+        off-TPU is unchanged. The decision is auditable via
+        Booster.metadata["autoconfig"]["kernel_variant"]."""
+        if not untuned:
+            return
+        from ..core import perfmodel
+
+        variant, dec = perfmodel.suggest_kernel_variant()
+        self._autoconfig["kernel_variant"] = dec.provenance()
+        if variant:
+            for field in untuned:
+                setattr(self, field, variant[field])
 
     def _finalize_tuned(self):
         """Re-resolve fields whose tuned-file lookup was skipped because the
         backend was uninitialized at construction (called from grower())."""
         if getattr(self, "_deferred_tuned", None) and _tuned.backend_is_tpu():
             td = _tuned.tuned_engine_defaults()
+            untuned = []
             for field, fallback in self._deferred_tuned:
                 setattr(self, field, td.get(field, fallback))
+                if field in ("partition_impl", "row_layout") and \
+                        field not in td:
+                    untuned.append(field)
             self._deferred_tuned = []
+            self._suggest_kernel_variant(untuned)
 
     def grower(self, has_categorical: bool = False,
                feature_shards: int = 1) -> GrowerConfig:
@@ -703,6 +735,61 @@ def _make_grow_fn(grower_cfg, mesh):
     return grow_fn
 
 
+def _route_features(cfg, n_rows, nfeat, n_workers):
+    """The tree-learner featurization shared by the router, bench.py's
+    training-row writer, and the ci.sh auto-config guard — one schema, so
+    rows recorded by a bench arm are matchable by the live router."""
+    from ..core import perfmodel
+
+    return perfmodel.featurize(
+        wire_dtype=cfg.hist_allreduce_dtype, rows=n_rows, nfeat=nfeat,
+        workers=n_workers, max_bin=cfg.max_bin, top_k=cfg.top_k,
+        num_leaves=cfg.num_leaves)
+
+
+def _perfmodel_route(cfg, n_rows, nfeat, n_workers, choice, info,
+                     feature_ok):
+    """Layer the learned perf model over ``route_parallelism``'s analytic
+    choice: the analytic per-tree predictions become priors, and recorded
+    training rows for a matching workload (kind ``gbdt_tree_learner``) can
+    confidently override the hand-tuned cost model. Low confidence — the
+    usual case on shapes never benched — keeps the analytic choice, so
+    this layer strictly adds measured evidence. Provenance lands in
+    ``info["perfmodel"]`` either way."""
+    from ..core import perfmodel
+
+    feats = _route_features(cfg, n_rows, nfeat, n_workers)
+    pred = info.get("predicted_s_per_tree") or {}
+    arms = ["data", "voting"] + (["feature"] if feature_ok else [])
+    cands = [perfmodel.Candidate("gbdt_tree_learner", arm, feats,
+                                 analytic_s=pred.get(arm), config=arm)
+             for arm in arms]
+    try:
+        dec = perfmodel.choose(cands, fallback_arm=choice)
+    except Exception:  # model failure keeps router choice
+        return choice
+    info["perfmodel"] = dec.provenance()
+    if not dec.used_fallback and dec.arm != choice:
+        info["tree_learner"] = dec.arm
+        info["router"] = "measured+perfmodel"
+        return dec.arm
+    return choice
+
+
+def _train_metadata(routing_info, autoconfig_info, fit_t0):
+    """Assemble Booster.metadata: the router's decision plus every
+    auto-configuration decision's provenance, stamped with the observed fit
+    wall time so predicted-vs-observed runtime is auditable per model."""
+    meta = {}
+    if routing_info:
+        meta["routing"] = routing_info
+    if autoconfig_info:
+        autoconfig_info["observed_fit_s"] = round(
+            _time.perf_counter() - fit_t0, 6)
+        meta["autoconfig"] = autoconfig_info
+    return meta or None
+
+
 def _auto_route(cfg, mesh, binned, nfeat, n_rows, multiproc,
                 has_categorical):
     """Resolve ``tree_learner='auto'`` into a concrete learner.
@@ -779,6 +866,8 @@ def _auto_route(cfg, mesh, binned, nfeat, n_rows, multiproc,
             wire_dtype=cfg.hist_allreduce_dtype,
             feature_parallel_ok=feature_ok)
         info["router"] = "measured"
+        choice = _perfmodel_route(cfg, n_rows, nfeat, n_workers, choice,
+                                  info, feature_ok)
         return choice, info
     except Exception as e:                   # pragma: no cover - probe escape
         import warnings
@@ -1357,6 +1446,17 @@ def train_booster(
     # cfg for provenance (as the old cost-model block did) and the router's
     # inputs/decision land in Booster.metadata["routing"].
     has_cat = bool(mapper.is_categorical.any())
+    # decision provenance for the learned auto-configuration layer
+    # (core/perfmodel): every model-made choice — and every fallback — is
+    # auditable from Booster.metadata["autoconfig"]
+    autoconfig_info = dict(getattr(cfg, "_autoconfig", None) or {})
+    _fit_t0 = _time.perf_counter()
+    if cfg.hist_allreduce_dtype == "auto":
+        from .grower import resolve_wire_dtype
+
+        wd, wdec = resolve_wire_dtype(cfg, mesh, n, nfeat)
+        cfg.hist_allreduce_dtype = wd
+        autoconfig_info["wire_dtype"] = wdec.provenance()
     routing_info = None
     if cfg.tree_learner == "auto":
         choice, routing_info = _auto_route(cfg, mesh, binned, nfeat, n,
@@ -1625,8 +1725,8 @@ def train_booster(
         return Booster(mapper, cfg, trees, tree_weights, base, feature_names,
                        best_iteration=(best_iter if has_valid else -1),
                        best_score=(best_metric if has_valid else None),
-                       metadata=({"routing": routing_info}
-                                 if routing_info else None))
+                       metadata=_train_metadata(routing_info,
+                                                autoconfig_info, _fit_t0))
 
     # validation weights converted to device ONCE (per-iteration eval would
     # otherwise redo the H2D transfer every round)
@@ -1883,8 +1983,8 @@ def train_booster(
                                    if has_valid else -1),
                    thresholds=merged_thr, missing_types=merged_mt,
                    best_score=(best_metric if has_valid else None),
-                   metadata=({"routing": routing_info}
-                             if routing_info else None))
+                   metadata=_train_metadata(routing_info,
+                                            autoconfig_info, _fit_t0))
 
 
 def _train_fingerprint(cfg, n, nfeat, y, n_init_trees) -> str:

@@ -132,6 +132,37 @@ class GrowerConfig(NamedTuple):
     feature_shards: int = 1      # static world size for hist_reduce="scatter"
 
 
+def resolve_wire_dtype(cfg, mesh, n_rows, nfeat):
+    """Resolve ``hist_allreduce_dtype='auto'`` to a concrete ladder rung.
+
+    Routed through ``core.perfmodel``: the analytic prior prices each rung's
+    per-tree collective seconds from the cached link-bandwidth probe, but —
+    because the lossy rungs trade accuracy, not just time — only a *measured*
+    match (recorded ``gbdt_wire_dtype`` rows for a log-nearby workload on
+    this platform) may move the choice off the conservative f32 fallback.
+    Explicit ``hist_allreduce_dtype="f32"|"bf16"|"int8"`` bypasses all of
+    this (the caller never invokes the resolver). Returns
+    ``(wire_dtype, perfmodel.Decision)``.
+    """
+    from ..core import perfmodel
+
+    if mesh is None:
+        return "f32", perfmodel.Decision(
+            "gbdt_wire_dtype", "f32", "f32", None, 0.0, True, "f32",
+            "fallback", [], {"workers": 1.0})
+    workers = 1
+    try:
+        from ..parallel.mesh import DATA_AXIS as _DA
+        workers = int(dict(mesh.shape).get(_DA, 1))
+    except Exception:  # mesh without a data axis
+        pass
+    link = perfmodel.link_bandwidth(mesh) if workers > 1 else None
+    return perfmodel.suggest_wire_dtype(
+        n_rows=float(n_rows), nfeat=float(nfeat), workers=float(workers),
+        max_bin=float(cfg.max_bin), num_leaves=float(cfg.num_leaves),
+        link_bps=link)
+
+
 class TreeArrays(NamedTuple):
     """One grown tree in structure-of-arrays form (serializes to the LightGBM
     model-string fields of the same names — gbdt/model_io.py)."""

@@ -50,6 +50,7 @@ streaming (per-chunk psum over a sharded pump) is the documented follow-up.
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 import warnings
 from typing import Callable, Iterable, List, NamedTuple, Optional, Sequence
 
@@ -104,7 +105,8 @@ class StreamedDataset:
                  mapper: Optional[BinMapper] = None,
                  categorical_features: Optional[Sequence[int]] = None,
                  chunk_rows: Optional[int] = None,
-                 depth: Optional[int] = None):
+                 depth: Optional[int] = None,
+                 exact_second_pass: Optional[bool] = None):
         if not callable(batches):
             raise TypeError(
                 "StreamedDataset needs a CALLABLE returning an iterator of "
@@ -118,6 +120,12 @@ class StreamedDataset:
                                      if categorical_features else None)
         self._chunk_rows_arg = chunk_rows
         self._depth_arg = depth
+        # exact second sketch pass when the one-pass sketch overflowed its
+        # sample budget (ROADMAP 2d): None = let core/perfmodel price it,
+        # True/False forces — the explicit bypass
+        self._exact_second_pass = exact_second_pass
+        self.second_pass_decision: Optional[dict] = None
+        self._rows_sketched = 0
         self.chunk_rows: Optional[int] = None     # C, after prepare()
         self.depth: Optional[int] = None
         self.chunks: List[dict] = []              # bT (FP, C), y/w/m (C,)
@@ -179,7 +187,48 @@ class StreamedDataset:
         if sketch is None or sketch.rows_seen == 0:
             raise ValueError("StreamedDataset source yielded no rows")
         self.sketch_exact = sketch.exact
+        self._rows_sketched = int(sketch.rows_seen)
         self.mapper = sketch.finalize()
+
+    def _maybe_exact_second_pass(self, cfg: BoosterConfig,
+                                 pass_s: float) -> None:
+        """ROADMAP 2d: the one-pass sketch overflowed its sample budget, so
+        boundaries are reservoir-sampled. A second full pass with the budget
+        raised to the stream length makes them exact — worth it only when
+        that pass is cheap next to training. core/perfmodel prices the pass
+        (measured sketch rate from THIS stream as the analytic prior) against
+        the estimated training cost: num_iterations x tree levels re-streams
+        of the same data. ``exact_second_pass=True/False`` bypasses."""
+        from ..core import perfmodel
+
+        rows, nfeat = self._rows_sketched, self.num_features
+        if self._exact_second_pass is not None:
+            take = bool(self._exact_second_pass)
+            self.second_pass_decision = {"kind": "gbdt_sketch_pass",
+                                         "arm": "exact" if take else "skip",
+                                         "source": "explicit"}
+        else:
+            levels = max(1, int(np.ceil(np.log2(max(cfg.num_leaves, 2)))))
+            train_est = pass_s * max(cfg.num_iterations, 1) * levels
+            rate = rows / pass_s if pass_s > 0 else None
+            take, dec = perfmodel.suggest_sketch_second_pass(
+                float(rows), float(nfeat), rate, train_est)
+            # an exact sketch buffers the full stream host-side — never
+            # trade boundaries for an OOM
+            if take and rows * nfeat * 4 > (2 << 30):
+                take = False
+                dec.arm, dec.used_fallback = "skip", True
+                dec.source = "host_budget"
+            self.second_pass_decision = dec.audit(observed_s=None)
+        if not take:
+            return
+        t0 = _time.perf_counter()
+        self._sketch_pass(dataclasses.replace(
+            cfg, bin_sample_count=max(rows, cfg.bin_sample_count)))
+        if isinstance(self.second_pass_decision, dict) and \
+                self.second_pass_decision.get("source") != "explicit":
+            self.second_pass_decision["observed_s"] = round(
+                _time.perf_counter() - t0, 6)
 
     def _bin_chunk(self, X, binner: Optional[CsrBinner]) -> np.ndarray:
         """(c, F) quantized host rows for one raw chunk."""
@@ -205,7 +254,11 @@ class StreamedDataset:
                 f"StreamedDataset already prepared for binning {self._prepared_for}; "
                 f"got {key} — build a fresh StreamedDataset")
         if self.mapper is None:
+            t0 = _time.perf_counter()
             self._sketch_pass(config)
+            pass_s = _time.perf_counter() - t0
+            if self.sketch_exact is False:
+                self._maybe_exact_second_pass(config, pass_s)
         if self.mapper.max_bin != config.max_bin:
             raise ValueError(
                 f"mapper has max_bin={self.mapper.max_bin} but config asks "
@@ -221,6 +274,11 @@ class StreamedDataset:
         C = stream_chunk_rows(row_bytes, explicit=self._chunk_rows_arg,
                               depth=self.depth)
         self.chunk_rows = C
+        # perfmodel provenance when the probe branch picked the geometry
+        # (None under the explicit/env/tuned bypass)
+        from ..io import ingest as _ingest
+
+        self.chunk_decision = _ingest.last_chunk_decision()
         bin_dtype = np.uint8 if unit == 1 else np.uint16
 
         self.chunks, self.chunk_real, self.n_rows = [], [], 0
@@ -675,6 +733,10 @@ def train_booster_streamed(
             "rows": int(data.n_rows), "resident": bool(resident),
             "sketch_exact": data.sketch_exact,
             "chunk_boundaries_visited": int(step_base),
+            **({"sketch_second_pass": data.second_pass_decision}
+               if data.second_pass_decision else {}),
+            **({"chunk_decision": data.chunk_decision}
+               if getattr(data, "chunk_decision", None) else {}),
         }})
     return booster
 

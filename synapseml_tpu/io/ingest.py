@@ -286,6 +286,30 @@ def mem_budget_bytes() -> Optional[int]:
     return max(int(v), 1)
 
 
+_LAST_CHUNK_DECISION = None
+
+
+def last_chunk_decision():
+    """Provenance dict of the most recent model-resolved chunk geometry
+    (``core.perfmodel.suggest_chunk_rows``), or None when the probe branch
+    has not run (explicit/env/tuned bypass) or the model was unavailable."""
+    return _LAST_CHUNK_DECISION
+
+
+def _perfmodel_chunk_rows(row_bytes: int, depth: int, fallback_rows: int,
+                          h2d_bps) -> int:
+    global _LAST_CHUNK_DECISION
+    try:
+        from ..core import perfmodel
+
+        rows, dec = perfmodel.suggest_chunk_rows(
+            row_bytes, int(depth), int(fallback_rows), h2d_bps=h2d_bps)
+        _LAST_CHUNK_DECISION = dec.provenance()
+        return int(rows)
+    except Exception:
+        return int(fallback_rows)
+
+
 def stream_chunk_rows(row_bytes: int, explicit: Optional[int] = None,
                       depth: int = 2) -> int:
     """Rows per streamed chunk for rows of ``row_bytes`` each.
@@ -298,6 +322,8 @@ def stream_chunk_rows(row_bytes: int, explicit: Optional[int] = None,
     set."""
     from ..core import tuned as _tuned
 
+    global _LAST_CHUNK_DECISION
+    _LAST_CHUNK_DECISION = None   # set again iff the probe branch runs
     row_bytes = max(int(row_bytes), 1)
     rows = explicit
     if rows is None:
@@ -310,6 +336,7 @@ def stream_chunk_rows(row_bytes: int, explicit: Optional[int] = None,
             rows = int(v)
     if rows is None:
         plat = _tuned.initialized_platform()
+        bw = None
         if plat is None:
             rows = _FALLBACK_CHUNK_ROWS
         else:
@@ -319,6 +346,10 @@ def stream_chunk_rows(row_bytes: int, explicit: Optional[int] = None,
         # the [min, max] clamp disciplines only the PROBE estimate — an
         # explicit/env/tuned value is operator intent and wins as given
         rows = min(max(rows, _MIN_CHUNK_ROWS), _MAX_CHUNK_ROWS)
+        # recorded io_chunk_rows rows (bench_oocore_gbdt) can displace the
+        # probe formula; without a measured match the formula IS the model's
+        # analytic optimum, so this is identity
+        rows = _perfmodel_chunk_rows(row_bytes, depth, rows, bw)
     rows = max(int(rows), 1)
     budget = mem_budget_bytes()
     if budget is not None:

@@ -11,6 +11,17 @@ MUST run before any jax import: sets XLA_FLAGS and pins the platform to cpu
 """
 
 import os
+import tempfile
+
+# tests probe on virtual cpu meshes and sometimes inject fake probe values;
+# none of that may land in (or be served from) the repo's persisted probe
+# cache, so every test session gets a throwaway cache file
+os.environ["SYNAPSEML_TPU_PROBE_CACHE"] = os.path.join(
+    tempfile.mkdtemp(prefix="synapseml-tpu-test-probes."), "probe_cache.json")
+# perfmodel training rows likewise: test workloads must rank against rows
+# they wrote themselves, never against the committed bench journal
+os.environ["SYNAPSEML_TPU_PERF_ROWS"] = os.path.join(
+    tempfile.mkdtemp(prefix="synapseml-tpu-test-perfrows."), "rows.jsonl")
 
 _TPU_E2E = os.environ.get("SYNAPSEML_TPU_E2E") == "1"
 if not _TPU_E2E:

@@ -167,3 +167,115 @@ def test_disable_via_env(tuned_file, monkeypatch):
     monkeypatch.setattr(tuned, "backend_is_tpu", lambda: True)
     monkeypatch.setenv("SYNAPSEML_TPU_TUNED_DEFAULTS", "0")
     assert tuned.tuned_engine_defaults() == {}
+
+
+# ---------------------------------------------------------------------------
+# validated_values / tuned_default direct coverage
+# ---------------------------------------------------------------------------
+
+def test_validated_values_filters_unknown_and_out_of_range():
+    raw = {"partition_impl": "scan", "row_layout": "sideways",
+           "hist_chunk": 0, "stream_chunk_rows": 65536,
+           "use_segmented": True, "provenance": {"winner": "x"},
+           "mystery_knob": 7}
+    assert tuned.validated_values(raw) == {
+        "partition_impl": "scan", "stream_chunk_rows": 65536,
+        "use_segmented": True}
+
+
+def test_tuned_default_env_beats_file(tuned_file, monkeypatch):
+    _write(tuned_file, {"stream_chunk_rows": 4096})
+    monkeypatch.setattr(tuned, "backend_is_tpu", lambda: True)
+    assert tuned.tuned_default("stream_chunk_rows",
+                               "SYNAPSEML_TPU_STREAM_CHUNK_ROWS", 128) == 4096
+    monkeypatch.setenv("SYNAPSEML_TPU_STREAM_CHUNK_ROWS", "9999")
+    assert tuned.tuned_default("stream_chunk_rows",
+                               "SYNAPSEML_TPU_STREAM_CHUNK_ROWS", 128) == "9999"
+    # empty env var means "unset", not "empty-string value"
+    monkeypatch.setenv("SYNAPSEML_TPU_STREAM_CHUNK_ROWS", "")
+    assert tuned.tuned_default("stream_chunk_rows",
+                               "SYNAPSEML_TPU_STREAM_CHUNK_ROWS", 128) == 4096
+    monkeypatch.setattr(tuned, "backend_is_tpu", lambda: False)
+    assert tuned.tuned_default("stream_chunk_rows",
+                               "SYNAPSEML_TPU_STREAM_CHUNK_ROWS", 128) == 128
+
+
+def test_current_file_values_ignores_backend_gate(tuned_file):
+    _write(tuned_file, {"partition_impl": "scatter", "hist_chunk": -1})
+    # CPU backend: the gated reader refuses, the write-side merge helper sees
+    # the validated values anyway
+    assert tuned.tuned_engine_defaults() == {}
+    assert tuned.current_file_values() == {"partition_impl": "scatter"}
+
+
+# ---------------------------------------------------------------------------
+# probe-cache persistence (measured_or -> docs/probe_cache.json analog)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def probe_cache(tmp_path, monkeypatch):
+    path = tmp_path / "probe_cache.json"
+    monkeypatch.setenv("SYNAPSEML_TPU_PROBE_CACHE", str(path))
+    monkeypatch.setattr(tuned, "_MEASUREMENTS", {})
+    return path
+
+
+def test_measured_or_persists_and_short_circuits(probe_cache, monkeypatch):
+    calls = []
+    key = ("link_bytes_per_s", ("data", 8), "cpu:0")
+    v = tuned.measured_or(key, lambda: calls.append(1) or 123.5)
+    assert v == 123.5 and calls == [1]
+    # in-process cache hit: no recompute
+    assert tuned.measured_or(key, lambda: calls.append(1) or -1) == 123.5
+    assert calls == [1]
+    # simulate a fresh process: in-memory store empty, disk cache serves
+    monkeypatch.setattr(tuned, "_MEASUREMENTS", {})
+    assert tuned.measured_or(key, lambda: calls.append(1) or -1) == 123.5
+    assert calls == [1]
+    entry = json.loads(probe_cache.read_text())[tuned._key_str(key)]
+    assert entry["value"] == 123.5 and entry["ts"] > 0
+
+
+def test_probe_cache_ttl_expires(probe_cache, monkeypatch):
+    tuned.measured_or("k", lambda: 1.0)
+    monkeypatch.setattr(tuned, "_MEASUREMENTS", {})
+    monkeypatch.setenv("SYNAPSEML_TPU_PROBE_CACHE_TTL_S", "0")
+    # stale entry: the probe really re-runs
+    assert tuned.measured_or("k", lambda: 2.0) == 2.0
+
+
+def test_put_measurement_never_persists(probe_cache, monkeypatch):
+    """put_measurement is the test-injection hook: an injected fake must not
+    leak across processes via the disk cache."""
+    tuned.put_measurement("fake", 42.0)
+    assert tuned.get_measurement("fake") == 42.0
+    assert not probe_cache.exists()
+    monkeypatch.setattr(tuned, "_MEASUREMENTS", {})
+    # a later measured_or on the same key recomputes (nothing on disk)
+    assert tuned.measured_or("fake", lambda: 7.0) == 7.0
+
+
+def test_clear_measurements_removes_disk_cache(probe_cache, monkeypatch):
+    calls = []
+    tuned.measured_or("k", lambda: calls.append(1) or 1.0)
+    assert probe_cache.exists()
+    tuned.clear_measurements()
+    assert not probe_cache.exists()
+    # "clear" means the next probe really runs, not a disk re-read
+    tuned.measured_or("k", lambda: calls.append(1) or 3.0)
+    assert calls == [1, 1]
+
+
+def test_probe_cache_disabled_by_sentinel(tmp_path, monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_TPU_PROBE_CACHE", "0")
+    monkeypatch.setattr(tuned, "_MEASUREMENTS", {})
+    tuned.measured_or("k", lambda: 5.0)
+    assert tuned._probe_cache_path() is None
+    monkeypatch.setattr(tuned, "_MEASUREMENTS", {})
+    assert tuned.measured_or("k", lambda: 6.0) == 6.0  # nothing persisted
+
+
+def test_probe_cache_skips_unserializable_values(probe_cache, monkeypatch):
+    tuned.measured_or("k", lambda: object())   # not JSON-representable
+    assert not probe_cache.exists()            # in-process cache still holds
+    assert isinstance(tuned.get_measurement("k"), object)

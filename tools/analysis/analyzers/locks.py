@@ -39,6 +39,7 @@ SCOPE = ("synapseml_tpu/io/serving.py",
          "synapseml_tpu/io/distributed_serving.py",
          "synapseml_tpu/core/resilience.py",
          "synapseml_tpu/core/logging.py",
+         "synapseml_tpu/core/perfmodel.py",
          "synapseml_tpu/core/qos.py",
          "synapseml_tpu/parallel/elastic.py")
 

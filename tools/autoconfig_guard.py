@@ -1,0 +1,133 @@
+"""Auto-config guard (ci.sh "== auto-config guard ==").
+
+Asserts that ``core.perfmodel.choose`` selects a configuration achieving
+at least 0.95x the best hand-tuned arm on every bench family that exposes
+alternatives and has recorded training rows:
+
+* ``gbdt_tree_learner``  — bench_distributed_gbdt_auto wide/narrow/tall
+* ``gbdt_wire_dtype``    — the int8-vs-f32 wire pair from the same bench
+* ``dl_param_sharding``  — bench_dl_sharded replicated/zero/pipeline
+* ``dl_pipeline_schedule`` — bench_dl_overlap_pipeline fill_drain/overlap
+* ``io_chunk_rows``      — bench_oocore_gbdt chunk-geometry ladder
+* ``serving_bucket_growth`` — the micro A/B THIS script runs (the bucket
+  ladder has no bench arm of its own): a BucketedRunner at
+  ``max_batch_size=48`` timed across growth factors 1.5/2.0/4.0 including
+  warmup compiles, so the compile-count-vs-padding trade is priced, and
+  48 is log-far from every test fixture's 64/32/8 so guard rows can never
+  near-match a unit-test workload.
+
+Rows are grouped per workload (shared feature keys, arm-dependent keys
+excluded); within each group the guard compares the arm ``choose`` picks
+against the best mean observed arm.  By the model's own hysteresis rule a
+confident fallback is only kept when no rival is >5% faster, so >=0.95x
+holds exactly when the wiring (row schema <-> featurizer <-> choose) is
+intact — which is what this guard pins.
+"""
+import math
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from synapseml_tpu.core import perfmodel  # noqa: E402
+
+FLOOR = 0.95
+
+# arm-dependent feature keys are excluded from the workload grouping key
+# (they vary BY arm within one A/B; everything else identifies the workload)
+FAMILIES = {
+    "gbdt_tree_learner": {"fallback": "data", "arm_keys": ("wire_bytes",)},
+    "gbdt_wire_dtype": {"fallback": "f32", "arm_keys": ("wire_bytes",)},
+    "dl_param_sharding": {"fallback": "replicated", "arm_keys": ("stages",)},
+    "dl_pipeline_schedule": {"fallback": "fill_drain", "arm_keys": ()},
+    "io_chunk_rows": {"fallback": None, "arm_keys": ("chunk_rows",)},
+    "serving_bucket_growth": {"fallback": "g2.0", "arm_keys": ()},
+}
+
+
+def bucket_growth_ab(max_batch_size=48, n_requests=120):
+    """Record serving_bucket_growth rows: total serving seconds (warmup
+    compiles included) for a fixed request-size trace per growth factor."""
+    from synapseml_tpu.core.inference import BucketedRunner
+
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(1, max_batch_size + 1, size=n_requests)
+    feats = perfmodel.featurize(max_batch_size=max_batch_size)
+    for g in (1.5, 2.0, 4.0):
+        runner = BucketedRunner(lambda x: x * 2.0 + 1.0,
+                                max_batch_size=max_batch_size, growth=g,
+                                name=f"guard.g{g}")
+        t0 = time.perf_counter()
+        runner.warmup(np.zeros((1, 8), np.float32))
+        for n in sizes:
+            runner(np.ones((int(n), 8), np.float32))
+        dt = time.perf_counter() - t0
+        perfmodel.append_training_row("serving_bucket_growth", f"g{g}",
+                                      feats, dt)
+        print(f"  bucket growth g{g}: {dt * 1e3:.1f} ms "
+              f"({len(runner.buckets)} buckets)")
+
+
+def workload_key(features, arm_keys):
+    return tuple(sorted((k, round(math.log1p(float(v)), 1))
+                        for k, v in features.items() if k not in arm_keys))
+
+
+def check_family(kind, spec, platform):
+    rows = perfmodel.training_rows(kind=kind, platform=platform)
+    groups = {}
+    for r in rows:
+        wk = workload_key(r["features"], spec["arm_keys"])
+        g = groups.setdefault(wk, {})
+        g.setdefault(r["arm"], []).append(r)
+    checked = 0
+    for wk, by_arm in sorted(groups.items()):
+        fb = spec["fallback"]
+        if fb is None:   # io_chunk_rows: the probe-formula arm is flagged
+            fb = next((a for a, rs in by_arm.items()
+                       if any(r.get("default_arm") for r in rs)), None)
+        if fb is None or fb not in by_arm or len(by_arm) < 2:
+            continue
+        # mean observed per arm — the same aggregation the matched predictor
+        # converges to, so the verdict is deterministic given the journal
+        mean_s = {a: sum(r["observed_s"] for r in rs) / len(rs)
+                  for a, rs in by_arm.items()}
+        cands = [perfmodel.Candidate(kind, a, rs[-1]["features"], config=a)
+                 for a, rs in by_arm.items()]
+        dec = perfmodel.choose(cands, fallback_arm=fb, platform=platform)
+        best_arm = min(mean_s, key=mean_s.get)
+        ratio = mean_s[best_arm] / mean_s[dec.arm]
+        tag = "fallback" if dec.used_fallback else dec.source
+        print(f"  {kind}: chose {dec.arm} ({tag}, conf "
+              f"{dec.confidence:.2f}) = {ratio:.3f}x best arm {best_arm} "
+              f"[{len(by_arm)} arms]")
+        assert ratio >= FLOOR, (
+            f"{kind}: model chose {dec.arm} at {ratio:.3f}x the best "
+            f"hand-tuned arm {best_arm} (floor {FLOOR}); arms {mean_s}")
+        checked += 1
+    return checked
+
+
+def main():
+    platform = "cpu"
+    print("bucket-growth micro A/B (max_batch_size=48):")
+    bucket_growth_ab()
+    total = 0
+    for kind, spec in FAMILIES.items():
+        total += check_family(kind, spec, platform)
+    if total == 0:
+        print("auto-config guard: no recorded families to check — run the "
+              "bench guards first so training rows exist", file=sys.stderr)
+        sys.exit(1)
+    print(f"auto-config guard ok: {total} workload group(s) within "
+          f"{FLOOR}x of best hand-tuned")
+
+
+if __name__ == "__main__":
+    main()

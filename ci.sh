@@ -202,6 +202,31 @@ assert rec["guard"]["streamed_10x_ge_0p7x_resident"], \
      f"{rec['streamed_vs_resident_10x']}x resident "
      f"{rec['resident_row_iters_per_s']} r-i/s — below the 0.7x floor")
 EOF
+python - << 'EOF'
+# mesh arm (docs/out-of-core.md "Mesh data plane"): the SAME 10x-undersized
+# budget streamed through a data-axis mesh — chunk source sharded across
+# workers, per-chunk frontier partials psum'd once per growth step through
+# the wire ladder — must hold >= 0.8x the mesh-RESIDENT rate, i.e.
+# streaming may tax the fabric-parallel path at most 20%. bench.py pins
+# the virtual 8-device CPU mesh for this workload itself.
+import json, subprocess, sys
+out = subprocess.run([sys.executable, "bench.py", "--only",
+                      "bench_oocore_gbdt_mesh"],
+                     capture_output=True, text=True, check=True).stdout
+rec = json.loads(out.strip().splitlines()[-1])
+print(f"mesh-streamed@10x {rec['value']} r-i/s = "
+      f"{rec['mesh_streamed_vs_resident_10x']}x mesh-resident "
+      f"({rec['mesh_resident_row_iters_per_s']} r-i/s, "
+      f"data axis x{rec['workers']}); "
+      f"oversize ratio {rec['oversize_ratio']}x")
+assert rec["guard"]["oversize_ratio_ge_10"], \
+    f"mesh budget cap did not produce a >=10x-oversized stream: {rec}"
+assert rec["guard"]["mesh_streamed_10x_ge_0p8x_mesh_resident"], \
+    (f"mesh-streamed@10x {rec['value']} r-i/s is "
+     f"{rec['mesh_streamed_vs_resident_10x']}x mesh-resident "
+     f"({rec['mesh_resident_row_iters_per_s']} r-i/s) — below the 0.8x "
+     f"floor")
+EOF
 
 echo "== auto-config guard (perfmodel.choose >= 0.95x best hand-tuned arm) =="
 # runs AFTER the bench-backed guards above so this very CI run's training

@@ -28,11 +28,13 @@ See ``docs/perf-model.md`` for the feature schema and the retrain procedure.
 """
 from __future__ import annotations
 
+import collections
 import json
 import math
 import os
 import re
 import threading
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -65,6 +67,78 @@ _FIT_MIN_R2 = 0.5          # reject fits that do not explain the data
 def enabled() -> bool:
     """Global kill switch: ``SYNAPSEML_TPU_PERFMODEL=0`` disables the model."""
     return os.environ.get("SYNAPSEML_TPU_PERFMODEL", "1") not in ("0", "false")
+
+
+# ---------------------------------------------------------------------------
+# calibration drift: demote a family whose audits go bad
+# ---------------------------------------------------------------------------
+
+DRIFT_WINDOW = 8        # audits kept per (kind, platform)
+DRIFT_MIN_AUDITS = 5    # don't judge a family on fewer
+DRIFT_RATIO = 2.0       # median predicted/observed off by >2x either way
+
+
+class PerfModelDriftWarning(UserWarning):
+    """A decision family's predicted-vs-observed calibration degraded past
+    ``DRIFT_RATIO`` (median over the last ``DRIFT_WINDOW`` audits); the
+    family is demoted to its hand-tuned fallback until the process restarts
+    or :func:`reset_drift` clears it."""
+
+
+_drift_lock = threading.Lock()
+_drift_audits: Dict[Tuple[str, str], collections.deque] = {}
+_drift_warned: set = set()
+
+
+def record_audit(kind: str, ratio: float,
+                 platform: Optional[str] = None) -> None:
+    """Feed one predicted-over-observed ratio into the drift monitor.
+
+    Called by :meth:`Decision.audit` whenever a call site reports what a
+    priced decision actually cost — the audit trail every auto-config
+    decision already journals is thereby also the model's health signal.
+    Crossing into drift emits one :class:`PerfModelDriftWarning` per
+    family per process.
+    """
+    if not (ratio and math.isfinite(ratio) and ratio > 0):
+        return
+    key = (str(kind), platform or current_platform())
+    with _drift_lock:
+        dq = _drift_audits.setdefault(key, collections.deque(
+            maxlen=DRIFT_WINDOW))
+        dq.append(float(ratio))
+        drifted, med = _drift_eval(dq)
+        if drifted and key not in _drift_warned:
+            _drift_warned.add(key)
+            warnings.warn(
+                f"perf-model drift: family {key[0]!r} on {key[1]!r} has "
+                f"median predicted/observed {med:.2f}x over the last "
+                f"{len(dq)} audits (bound {DRIFT_RATIO}x) — demoting to the "
+                f"hand-tuned fallback", PerfModelDriftWarning,
+                stacklevel=3)
+
+
+def _drift_eval(ratios) -> Tuple[bool, float]:
+    if len(ratios) < DRIFT_MIN_AUDITS:
+        return False, 0.0
+    med = float(np.median(list(ratios)))
+    return (med > DRIFT_RATIO or med < 1.0 / DRIFT_RATIO), med
+
+
+def drift_demoted(kind: str, platform: Optional[str] = None) -> bool:
+    """True when ``kind``'s audited calibration is past the drift bound —
+    :func:`choose` then returns the hand-tuned fallback unconditionally."""
+    key = (str(kind), platform or current_platform())
+    with _drift_lock:
+        dq = _drift_audits.get(key)
+        return False if dq is None else _drift_eval(dq)[0]
+
+
+def reset_drift() -> None:
+    """Clear the in-process drift state (tests / operator override)."""
+    with _drift_lock:
+        _drift_audits.clear()
+        _drift_warned.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -129,13 +203,18 @@ class Decision:
         }
 
     def audit(self, observed_s: Optional[float] = None) -> Dict[str, Any]:
-        """Provenance plus predicted-vs-observed, for post-hoc calibration."""
+        """Provenance plus predicted-vs-observed, for post-hoc calibration.
+
+        Ratios also feed the in-process drift monitor: a family whose
+        audited median goes past ``DRIFT_RATIO`` is demoted to its
+        hand-tuned fallback (see :func:`record_audit`)."""
         rec = self.provenance()
         if observed_s is not None:
             rec["observed_s"] = float(observed_s)
-            if self.predicted_s:
-                rec["predicted_over_observed"] = round(
-                    float(self.predicted_s) / float(observed_s), 4)
+            if self.predicted_s and observed_s:
+                ratio = float(self.predicted_s) / float(observed_s)
+                rec["predicted_over_observed"] = round(ratio, 4)
+                record_audit(self.kind, ratio)
         return rec
 
 
@@ -472,6 +551,11 @@ def choose(candidates: Sequence[Candidate],
     if not enabled():
         return Decision(kind, fb.arm, fb.config, None, 0.0, True,
                         fallback_arm, "disabled", [], dict(fb.features))
+    if drift_demoted(kind, platform):
+        # audited calibration for this family went bad — the hand-tuned
+        # fallback wins until the process restarts or reset_drift()
+        return Decision(kind, fb.arm, fb.config, None, 0.0, True,
+                        fallback_arm, "drift_demoted", [], dict(fb.features))
 
     rows = training_rows(kind=kind, platform=platform)
     preds = {c.arm: predict(c, rows=rows, platform=platform)
@@ -796,6 +880,7 @@ def suggest_sketch_second_pass(n_rows: float, nfeat: float,
 
 __all__ = [
     "Candidate", "Prediction", "Decision", "featurize", "enabled",
+    "PerfModelDriftWarning", "record_audit", "drift_demoted", "reset_drift",
     "append_training_row", "training_rows", "backfill_training_rows",
     "predict_runtime", "predict", "choose",
     "link_bandwidth", "h2d_bandwidth",

@@ -1055,21 +1055,22 @@ def train_booster(
         unsupported = [name for name, v in [
             ("y", y), ("sample_weight", sample_weight),
             ("init_score", init_score), ("group_sizes", group_sizes),
-            ("valid", valid), ("fobj", fobj), ("init_model", init_model),
-            ("callbacks", callbacks or None), ("mesh", mesh)]
+            ("fobj", fobj), ("init_model", init_model),
+            ("callbacks", callbacks or None)]
             if v is not None]
         if unsupported:
             raise NotImplementedError(
                 f"train_booster(StreamedDataset) does not take {unsupported}"
                 " — labels/weights ride the stream; the other features are "
-                "resident-path only (see gbdt/stream.py v1 scope)")
+                "resident-path only (see gbdt/stream.py)")
         if mapper is not None and X.mapper is None:
             X.mapper = mapper
             X._user_mapper = True
         if categorical_features is not None and X.categorical_features is None:
             X.categorical_features = list(categorical_features)
         return train_booster_streamed(
-            X, config, measures=measures, checkpoint_store=checkpoint_store,
+            X, config, mesh=mesh, valid_data=valid, measures=measures,
+            checkpoint_store=checkpoint_store,
             checkpoint_every=checkpoint_every, resume=resume,
             feature_names=feature_names)
     # --- crash-safe snapshots (core/checkpoint.py): periodic forest + loop

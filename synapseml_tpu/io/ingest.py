@@ -50,6 +50,7 @@ device). See docs/out-of-core.md.
 
 from __future__ import annotations
 
+import mmap as _mmap
 import os
 import queue
 import threading
@@ -57,12 +58,22 @@ import time
 from collections import deque
 from typing import Callable, Iterable, Optional
 
+import numpy as np
+
 # Chunk-corruption hook for the chaos suite (testing/chaos.py installs it):
 # called as hook(k, chunk) -> chunk on the PRODUCER side before placement, so
 # an injected delay/truncation/kill exercises the exact path a slow or dying
 # data source would. Same single-global-hook pattern as dl.trainer's
 # _CHAOS_BATCH_HOOK.
 _CHAOS_CHUNK_HOOK = None
+
+# Disk-read corruption hook (testing/chaos.py installs it): called as
+# hook(k, arr) -> arr on every chunk READ FROM DISK (DiskChunkSource and the
+# StreamedDataset cache_dir readback) — a separate global from
+# _CHAOS_CHUNK_HOOK so a disk fault does not double-fire through the pump's
+# chunk hook. The hook may return a truncated array (torn read) or raise
+# OSError(EIO) (dying disk); both surface loudly at the consumer.
+_CHAOS_DISK_HOOK = None
 
 _DONE = object()     # end-of-stream sentinel on the producer queue
 
@@ -150,6 +161,17 @@ class ChunkPump:
                 target=self._produce, name=f"chunk-pump.{self.name}")
             self._thread.start()
 
+    def _sync_pull(self):
+        """``_pull`` under the threaded-mode error contract: source/place
+        failures surface as :class:`ChunkStreamError` in BOTH modes, so
+        consumers never care which side of the thread the producer ran on."""
+        try:
+            return self._pull()
+        except BaseException as e:  # noqa: BLE001 — same contract as _produce
+            raise ChunkStreamError(
+                f"chunk producer {self.name!r} died at chunk "
+                f"{self.chunks_produced}: {e!r}") from e
+
     # -- consumer side ----------------------------------------------------
     def _boundary(self) -> None:
         """Chunk boundary: preemption point + watchdog heartbeat."""
@@ -186,13 +208,13 @@ class ChunkPump:
                 # while the consumer computes on the popped chunk
                 q: deque = deque()
                 while len(q) < self.depth:
-                    item = self._pull()
+                    item = self._sync_pull()
                     if item is _DONE:
                         break
                     q.append(item)
                 while q:
                     out = q.popleft()
-                    item = self._pull()
+                    item = self._sync_pull()
                     if item is not _DONE:
                         q.append(item)
                     self._boundary()
@@ -311,15 +333,19 @@ def _perfmodel_chunk_rows(row_bytes: int, depth: int, fallback_rows: int,
 
 
 def stream_chunk_rows(row_bytes: int, explicit: Optional[int] = None,
-                      depth: int = 2) -> int:
+                      depth: int = 2,
+                      read_bps: Optional[float] = None) -> int:
     """Rows per streamed chunk for rows of ``row_bytes`` each.
 
     Resolution: ``explicit`` arg > ``SYNAPSEML_TPU_STREAM_CHUNK_ROWS`` env >
     tuned file ``stream_chunk_rows`` (TPU-gated, docs/tuned_defaults.json) >
     bandwidth micro-probe (chunk ≈ ``_TARGET_CHUNK_S`` of measured link
-    time). Whatever wins is then capped so ``(depth+1)`` in-flight chunks
-    fit the ``SYNAPSEML_TPU_STREAM_MEM_BUDGET`` byte budget when one is
-    set."""
+    time). ``read_bps``, when given (disk-backed sources), is the measured
+    disk read bandwidth: a chunk crosses disk→host then host→device
+    serially, so the probe branch prices the HARMONIC combination of the two
+    links rather than the h2d link alone. Whatever wins is then capped so
+    ``(depth+1)`` in-flight chunks fit the
+    ``SYNAPSEML_TPU_STREAM_MEM_BUDGET`` byte budget when one is set."""
     from ..core import tuned as _tuned
 
     global _LAST_CHUNK_DECISION
@@ -342,6 +368,10 @@ def stream_chunk_rows(row_bytes: int, explicit: Optional[int] = None,
         else:
             bw = _tuned.measured_or(("h2d_bytes_per_s", plat),
                                     _probe_h2d_bandwidth)
+            if read_bps:
+                # disk feeds the link back-to-back per chunk: effective
+                # bytes/s is the series combination of the two stages
+                bw = 1.0 / (1.0 / bw + 1.0 / float(read_bps))
             rows = int(bw * _TARGET_CHUNK_S / row_bytes)
         # the [min, max] clamp disciplines only the PROBE estimate — an
         # explicit/env/tuned value is operator intent and wins as given
@@ -372,3 +402,150 @@ def stream_depth(explicit: Optional[int] = None) -> int:
     if v is not None:
         return max(int(v), 1)
     return 2
+
+
+# ---------------------------------------------------------------------------
+# Disk-backed chunk source: mmap'd .npy / raw-uint8 reader
+# ---------------------------------------------------------------------------
+
+def _disk_hook(k, arr):
+    hook = _CHAOS_DISK_HOOK
+    return arr if hook is None else hook(k, arr)
+
+
+def _npy_header(f):
+    """``(shape, dtype, data_offset)`` of an open ``.npy`` file (versions
+    1.0/2.0, C-order only — the layouts ``np.save`` actually writes)."""
+    version = np.lib.format.read_magic(f)
+    if version == (1, 0):
+        shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+    elif version == (2, 0):
+        shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+    else:
+        raise ValueError(f"unsupported .npy format version {version}")
+    if fortran:
+        raise ValueError(".npy file is Fortran-ordered; the disk chunk "
+                         "source needs C-order rows")
+    return shape, dtype, f.tell()
+
+
+def _probe_disk_bandwidth(path: str) -> float:
+    """Measured disk→host bytes/s for ``path``'s filesystem: one sequential
+    read of up to ``_PROBE_BYTES``. An upper bound when the page cache is
+    warm — acceptable, because a warm cache means the disk stage genuinely
+    is that fast for this stream."""
+    n = min(os.path.getsize(path), _PROBE_BYTES)
+    t0 = time.perf_counter()
+    with open(path, "rb") as f:
+        f.read(max(int(n), 1))
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return max(int(n), 1) / dt
+
+
+def read_chunk_file(path: str, k: int = 0):
+    """Read one whole cached ``.npy`` chunk file through the chaos disk hook
+    — the training-time readback path for ``StreamedDataset(cache_dir=...)``
+    spilled chunks. Returns a fresh host array (never a live mmap view)."""
+    with open(path, "rb") as f:
+        shape, dtype, off = _npy_header(f)
+        mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        flat = np.frombuffer(mm, dtype=dtype,
+                             count=int(np.prod(shape)), offset=off)
+        try:
+            out = np.array(flat.reshape(shape))
+        finally:
+            # frombuffer holds an exported pointer into the map: drop it
+            # before close() or it raises BufferError
+            del flat
+            mm.close()
+    return _disk_hook(int(k), out)
+
+
+class DiskChunkSource:
+    """Memory-mapped on-disk chunk reader — host RAM stops being the ceiling.
+
+    A callable usable directly as ``StreamedDataset(batches=...)``: each call
+    opens ``path``, maps it read-only, and yields ``(X, y, w)`` row-chunk
+    tuples (``y``/``w`` are ``None`` unless ``labels``/``weights`` arrays
+    were given — labels are 1/F the stream and stay in RAM). Two layouts:
+
+    * ``.npy`` (default): header parsed for shape/dtype; must be a C-order
+      2-D ``(rows, features)`` array.
+    * raw: a headerless binary of ``rows × num_features`` elements of
+      ``dtype`` (default uint8) — pass ``num_features`` (and ``dtype`` for
+      non-uint8), set ``raw=True``.
+
+    Each yielded chunk is COPIED out of the map (the map is closed when the
+    generator exits, so no view may escape), and routed through the chaos
+    disk hook so the fault suite can inject torn reads / EIO exactly where a
+    real disk would. ``read_bytes_per_s`` is a cached one-time sequential
+    micro-probe of the backing filesystem; ``StreamedDataset.prepare`` folds
+    it into the chunk-geometry pricing.
+    """
+
+    def __init__(self, path: str, rows_per_chunk: int = _FALLBACK_CHUNK_ROWS,
+                 raw: bool = False, num_features: Optional[int] = None,
+                 dtype=None, labels=None, weights=None):
+        self.path = os.fspath(path)
+        self.rows_per_chunk = max(int(rows_per_chunk), 1)
+        self.raw = bool(raw)
+        self.labels = labels
+        self.weights = weights
+        if self.raw:
+            if num_features is None:
+                raise ValueError("raw disk source needs num_features")
+            self._dtype = np.dtype(dtype if dtype is not None else np.uint8)
+            itemsize = self._dtype.itemsize * int(num_features)
+            n = os.path.getsize(self.path) // itemsize
+            self._shape = (int(n), int(num_features))
+            self._offset = 0
+        else:
+            if num_features is not None or dtype is not None:
+                raise ValueError("num_features/dtype are raw-layout knobs; "
+                                 ".npy files carry their own header")
+            with open(self.path, "rb") as f:
+                shape, dt, off = _npy_header(f)
+            if len(shape) != 2:
+                raise ValueError(f".npy disk source must be 2-D (rows, "
+                                 f"features), got shape {shape}")
+            self._shape, self._dtype, self._offset = shape, dt, off
+        self.n_rows, self.num_features = int(self._shape[0]), int(self._shape[1])
+        self._read_bps: Optional[float] = None
+
+    @property
+    def read_bytes_per_s(self) -> float:
+        if self._read_bps is None:
+            from ..core import tuned as _tuned
+
+            plat = _tuned.initialized_platform()
+            if plat is not None:
+                self._read_bps = float(_tuned.measured_or(
+                    ("disk_read_bytes_per_s", plat),
+                    lambda: _probe_disk_bandwidth(self.path)))
+            else:
+                self._read_bps = _probe_disk_bandwidth(self.path)
+        return self._read_bps
+
+    def __call__(self):
+        n, F, R = self.n_rows, self.num_features, self.rows_per_chunk
+        f = open(self.path, "rb")
+        try:
+            mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+            flat = np.frombuffer(mm, dtype=self._dtype,
+                                 count=n * F, offset=self._offset)
+            arr = flat.reshape(n, F)
+            try:
+                for k, a in enumerate(range(0, n, R)):
+                    X = _disk_hook(k, np.array(arr[a:a + R]))
+                    c = int(X.shape[0])       # hook may tear the read short
+                    sl = slice(a, a + c)
+                    y = None if self.labels is None else self.labels[sl]
+                    w = None if self.weights is None else self.weights[sl]
+                    yield (X, y, w)
+            finally:
+                # frombuffer holds an exported pointer into the map: drop
+                # every view before close() or it raises BufferError
+                del flat, arr
+                mm.close()
+        finally:
+            f.close()

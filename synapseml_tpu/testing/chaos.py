@@ -29,6 +29,7 @@ circuit breaker against genuine transport errors.
 
 from __future__ import annotations
 
+import errno as _errno
 import io as _io
 import json as _json
 import random
@@ -566,20 +567,40 @@ class chaos_chunk_stream:
       the consumer sees :class:`~synapseml_tpu.io.ingest.ChunkStreamError`
       at its next boundary and the producer thread is joined.
 
+    The DISK surface (``io.ingest._CHAOS_DISK_HOOK``) is separate: it fires
+    on every chunk read back from disk — :class:`~synapseml_tpu.io.ingest.
+    DiskChunkSource` slices and ``StreamedDataset(cache_dir=...)`` spilled
+    ``.npy`` readbacks — so a disk fault cannot double-fire through the
+    pump-side hook above:
+
+    * ``disk_truncate_at`` — from this disk-read index on, the returned
+      array loses its trailing elements down to ``disk_truncate_rows`` (a
+      torn/short read). Consumers validate shape and must raise ``OSError``
+      rather than bin garbage.
+    * ``disk_eio_at`` — the read at this index raises ``OSError(EIO)``
+      (a dying device / revoked mmap), which must surface to the caller.
+
     Faults fire on EVERY pump that passes the index (a training run opens a
     fresh pump per pass), subject to ``max_faults`` (default: unlimited for
     delays, 1 for kills — a resumed run must survive the same chunk).
     ``seen`` records every (k, rows) the hook observed; ``faults`` every
-    injected corruption. Nesting is not supported (single global hook)."""
+    injected corruption (disk faults as ``("disk_torn", k)`` /
+    ``("disk_eio", k)``). Nesting is not supported (single global hook)."""
 
     def __init__(self, delay: Optional[dict] = None,
                  truncate_at: Optional[int] = None, truncate_rows: int = 0,
-                 kill_at: Optional[int] = None, max_kills: int = 1):
+                 kill_at: Optional[int] = None, max_kills: int = 1,
+                 disk_truncate_at: Optional[int] = None,
+                 disk_truncate_rows: int = 0,
+                 disk_eio_at: Optional[int] = None):
         self.delay = {int(k): float(v) for k, v in (delay or {}).items()}
         self.truncate_at = truncate_at
         self.truncate_rows = int(truncate_rows)
         self.kill_at = kill_at
         self.max_kills = int(max_kills)
+        self.disk_truncate_at = disk_truncate_at
+        self.disk_truncate_rows = int(disk_truncate_rows)
+        self.disk_eio_at = disk_eio_at
         self.seen: List[Tuple[int, int]] = []
         self.faults: List[Tuple[str, int]] = []
         self._lock = threading.Lock()
@@ -623,18 +644,37 @@ class chaos_chunk_stream:
             return self._truncate(chunk)
         return chunk
 
+    def _disk(self, k: int, arr):
+        with self._lock:
+            eio = self.disk_eio_at is not None and k == self.disk_eio_at
+            torn = (self.disk_truncate_at is not None
+                    and k >= self.disk_truncate_at)
+            if eio:
+                self.faults.append(("disk_eio", k))
+            elif torn:
+                self.faults.append(("disk_torn", k))
+        if eio:
+            raise OSError(_errno.EIO,
+                          f"chaos: injected EIO reading chunk {k}")
+        if torn:
+            return arr[..., : self.disk_truncate_rows]
+        return arr
+
     def __enter__(self) -> "chaos_chunk_stream":
         from ..io import ingest as _ing
 
-        if _ing._CHAOS_CHUNK_HOOK is not None:
+        if _ing._CHAOS_CHUNK_HOOK is not None \
+                or _ing._CHAOS_DISK_HOOK is not None:
             raise RuntimeError("chaos_chunk_stream does not nest")
         _ing._CHAOS_CHUNK_HOOK = self._hook
+        _ing._CHAOS_DISK_HOOK = self._disk
         return self
 
     def __exit__(self, *exc) -> None:
         from ..io import ingest as _ing
 
         _ing._CHAOS_CHUNK_HOOK = None
+        _ing._CHAOS_DISK_HOOK = None
 
 
 # ---------------------------------------------------------------------------

@@ -269,18 +269,28 @@ class TestStreamedParity:
     def test_unsupported_configs_raise(self, binary_data):
         Xtr, _, ytr, _ = binary_data
         ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
-        for bad in (dict(bagging_fraction=0.5, bagging_freq=1),
-                    dict(feature_fraction=0.5),
-                    dict(boosting_type="dart")):
+        for bad in (dict(boosting_type="dart"),
+                    dict(boosting_type="rf", bagging_fraction=0.5,
+                         bagging_freq=1),
+                    dict(objective="multiclass", num_class=3),
+                    # early stopping without a held-out stream
+                    dict(early_stopping_round=2)):
             with pytest.raises(NotImplementedError):
                 train_booster_streamed(ds, _mk_cfg(**bad))
 
-    def test_leafwise_config_warns_depthwise_substitution(self, binary_data):
-        Xtr, _, ytr, _ = binary_data
+    def test_both_growth_policies_stream(self, binary_data):
+        # leafwise (the resident default) streams natively; depthwise stays
+        # level-synchronous — each bitwise against its own resident mode
+        Xtr, Xte, ytr, _ = binary_data
         ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
-        with pytest.warns(UserWarning, match="leafwise"):
-            train_booster_streamed(ds, _mk_cfg(num_iterations=2,
-                                               growth_policy="leafwise"))
+        for policy in ("leafwise", "depthwise"):
+            cfg = _mk_cfg(num_iterations=3, growth_policy=policy)
+            b_s = train_booster_streamed(ds, cfg)
+            b_r = train_booster_streamed(ds, cfg, resident=True)
+            np.testing.assert_array_equal(b_s.raw_score(Xte),
+                                          b_r.raw_score(Xte))
+            assert b_s.metadata["streamed"]["growth_policy"] == policy
+        assert _no_pump_threads()
 
     def test_dataset_api_contracts(self):
         with pytest.raises(TypeError, match="CALLABLE"):
@@ -425,6 +435,295 @@ class TestKillResume:
                                          checkpoint_every=1)
         np.testing.assert_array_equal(ref.raw_score(Xtr),
                                       resumed.raw_score(Xtr))
+
+
+# ---------------------------------------------------------------------------
+# streamed sampling: bagging / GOSS / feature sampling (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+class TestStreamedSampling:
+    def _resume_roundtrip(self, tmp_path, ds, cfg, Xte):
+        """Train, kill mid-stream, resume; return (ref, resumed) scores."""
+        ref = train_booster_streamed(ds, cfg)
+        nchunks = len(ds.chunks)
+        d = str(tmp_path / "ck")
+        kill_step = nchunks * 3 * (2 + 2)
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"gbdt.stream.chunk": [kill_step]}) as cp:
+                train_booster_streamed(ds, cfg, checkpoint_store=d,
+                                       checkpoint_every=1)
+        assert cp.kills, "kill step never visited — adjust kill_step"
+        assert _no_pump_threads()
+        resumed = train_booster_streamed(ds, cfg, checkpoint_store=d,
+                                         checkpoint_every=1)
+        return ref.raw_score(Xte), resumed.raw_score(Xte)
+
+    def test_bagging_deterministic_and_resumes_bit_for_bit(self, tmp_path,
+                                                           binary_data):
+        Xtr, Xte, ytr, _ = binary_data
+        cfg = _mk_cfg(num_iterations=6, bagging_fraction=0.6, bagging_freq=2)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        # masks are derived from global row offsets: two fresh runs agree
+        a = train_booster_streamed(ds, cfg).raw_score(Xte)
+        b = train_booster_streamed(ds, cfg).raw_score(Xte)
+        np.testing.assert_array_equal(a, b)
+        # kill -> resume replays the identical per-iteration bagging masks
+        ref, resumed = self._resume_roundtrip(tmp_path, ds, cfg, Xte)
+        np.testing.assert_array_equal(ref, resumed)
+
+    def test_bagging_matches_resident_mode_bitwise(self, binary_data):
+        Xtr, Xte, ytr, _ = binary_data
+        cfg = _mk_cfg(num_iterations=4, bagging_fraction=0.5, bagging_freq=1)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        b_s = train_booster_streamed(ds, cfg)
+        b_r = train_booster_streamed(ds, cfg, resident=True)
+        np.testing.assert_array_equal(b_s.raw_score(Xte), b_r.raw_score(Xte))
+
+    def test_goss_resumes_bit_for_bit(self, tmp_path, binary_data):
+        Xtr, Xte, ytr, yte = binary_data
+        cfg = _mk_cfg(num_iterations=6, boosting_type="goss")
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        ref, resumed = self._resume_roundtrip(tmp_path, ds, cfg, Xte)
+        np.testing.assert_array_equal(ref, resumed)
+        assert _auc(yte, 1.0 / (1.0 + np.exp(-ref))) > 0.9
+
+    def test_goss_matches_classic_auc(self, binary_data):
+        Xtr, Xte, ytr, yte = binary_data
+        cfg = _mk_cfg(num_iterations=8, boosting_type="goss")
+        classic = train_booster(Xtr, ytr, cfg)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        streamed = train_booster_streamed(ds, cfg)
+        a_c = _auc(yte, classic.predict(Xte))
+        a_s = _auc(yte, streamed.predict(Xte))
+        assert abs(a_c - a_s) <= 5e-3
+
+    def test_feature_sampling_streams_bitwise(self, binary_data):
+        Xtr, Xte, ytr, yte = binary_data
+        cfg = _mk_cfg(num_iterations=4, feature_fraction=0.6,
+                      feature_fraction_bynode=0.8)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        b_s = train_booster_streamed(ds, cfg)
+        b_r = train_booster_streamed(ds, cfg, resident=True)
+        np.testing.assert_array_equal(b_s.raw_score(Xte), b_r.raw_score(Xte))
+        assert _auc(yte, b_s.predict(Xte)) > 0.9
+
+
+# ---------------------------------------------------------------------------
+# held-out-stream early stopping (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+class TestStreamedEarlyStop:
+    def test_heldout_stream_early_stop(self, binary_data):
+        Xtr, Xte, ytr, yte = binary_data
+        mk = lambda: _mk_cfg(num_iterations=40, early_stopping_round=3)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        streamed = train_booster_streamed(ds, mk(), valid_data=(Xte, yte))
+        # streamed == resident-mode streaming: identical programs, so the
+        # metric sequence — and hence the stopping point — is bit-identical
+        ds2 = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        res = train_booster_streamed(ds2, mk(), valid_data=(Xte, yte),
+                                     resident=True)
+        assert streamed.best_iteration == res.best_iteration
+        assert len(streamed.trees) == len(res.trees)
+        np.testing.assert_array_equal(streamed.raw_score(Xte),
+                                      res.raw_score(Xte))
+        # and it matches the classic resident early-stop contract on the
+        # same fixture: stops early, truncates to best, comparable score
+        classic = train_booster(Xtr, ytr, mk(), valid=(Xte, yte))
+        assert len(classic.trees) < 40 and len(streamed.trees) < 40
+        assert streamed.best_iteration >= 0
+        assert len(streamed.trees) == streamed.best_iteration + 1
+        assert abs(streamed.best_score - classic.best_score) <= 1e-3
+        assert streamed.metadata["streamed"]["stopped_early"] in (True, False)
+        assert _no_pump_threads()
+
+    def test_valid_stream_without_early_stop_records_best(self, binary_data):
+        Xtr, Xte, ytr, yte = binary_data
+        cfg = _mk_cfg(num_iterations=5)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        b = train_booster_streamed(ds, cfg, valid_data=(Xte, yte))
+        assert len(b.trees) == 5                  # no truncation
+        assert b.best_score is not None and 0.5 < b.best_score <= 1.0
+        assert 0 <= b.best_iteration < 5
+
+
+# ---------------------------------------------------------------------------
+# mesh-streamed training (ISSUE 15 tentpole)
+# ---------------------------------------------------------------------------
+
+class TestMeshStreamed:
+    @pytest.fixture()
+    def mesh4(self, eight_devices):
+        from synapseml_tpu.parallel.mesh import make_mesh
+
+        return make_mesh({"data": 4}, devices=eight_devices[:4])
+
+    def test_mesh_streamed_equals_mesh_resident_bitwise(self, mesh4,
+                                                        binary_data):
+        Xtr, Xte, ytr, yte = binary_data
+        cfg = _mk_cfg(num_iterations=3)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        b_s = train_booster_streamed(ds, cfg, mesh=mesh4)
+        b_r = train_booster_streamed(ds, cfg, mesh=mesh4, resident=True)
+        for ts, tr in zip(b_s.trees, b_r.trees):
+            for a, b in zip(ts, tr):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(b_s.raw_score(Xte), b_r.raw_score(Xte))
+        md = b_s.metadata["streamed"]
+        assert md["workers"] == 4
+        assert _auc(yte, b_s.predict(Xte)) > 0.95
+        assert _no_pump_threads()
+
+    @pytest.mark.parametrize("wire", ["bf16", "int8"])
+    def test_mesh_wire_ladder_auc(self, mesh4, binary_data, wire):
+        Xtr, Xte, ytr, yte = binary_data
+        cfg = _mk_cfg(num_iterations=5, hist_allreduce_dtype=wire)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        b = train_booster_streamed(ds, cfg, mesh=mesh4)
+        assert _auc(yte, b.predict(Xte)) > 0.95
+
+    def test_mesh_auto_config_prices_streamed(self, mesh4, binary_data):
+        Xtr, _, ytr, _ = binary_data
+        cfg = _mk_cfg(num_iterations=1, tree_learner="auto",
+                      hist_allreduce_dtype="auto")
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        b = train_booster_streamed(ds, cfg, mesh=mesh4)
+        assert cfg.hist_allreduce_dtype in ("f32", "bf16", "int8")
+        assert cfg.tree_learner == "data"
+        assert b.metadata["routing"]["tree_learner"] == "data"
+        assert b.metadata["routing"]["router"] == "streamed_data_plane"
+        assert "wire_dtype" in b.metadata["autoconfig"]
+
+    def test_mesh_kill_resume_bit_for_bit(self, tmp_path, mesh4,
+                                          binary_data):
+        Xtr, Xte, ytr, _ = binary_data
+        cfg = _mk_cfg(num_iterations=5)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        ref = train_booster_streamed(ds, cfg, mesh=mesh4)
+        nchunks = len(ds.chunks)
+        d = str(tmp_path / "ck")
+        kill_step = nchunks * 3 * (2 + 2)
+        with pytest.raises(PreemptionError):
+            with ChaosPreemption(at={"gbdt.stream.chunk": [kill_step]}) as cp:
+                train_booster_streamed(ds, cfg, mesh=mesh4,
+                                       checkpoint_store=d,
+                                       checkpoint_every=1)
+        assert cp.kills
+        assert _no_pump_threads()
+        resumed = train_booster_streamed(ds, cfg, mesh=mesh4,
+                                         checkpoint_store=d,
+                                         checkpoint_every=1)
+        np.testing.assert_array_equal(ref.raw_score(Xte),
+                                      resumed.raw_score(Xte))
+        for ts, tr in zip(ref.trees, resumed.trees):
+            for a, b in zip(ts, tr):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_mesh_bagging_and_valid(self, mesh4, binary_data):
+        Xtr, Xte, ytr, yte = binary_data
+        cfg = _mk_cfg(num_iterations=6, bagging_fraction=0.6, bagging_freq=1,
+                      early_stopping_round=3)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        b = train_booster_streamed(ds, cfg, mesh=mesh4,
+                                   valid_data=(Xte, yte))
+        assert b.best_score is not None
+        assert _auc(yte, b.predict(Xte)) > 0.9
+
+    def test_chunk_rows_rounded_to_worker_multiple(self, eight_devices):
+        from synapseml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"data": 8}, devices=eight_devices)
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(400, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        ds = StreamedDataset.from_arrays(X, y, chunk_rows=50)
+        train_booster_streamed(ds, _mk_cfg(num_iterations=1), mesh=mesh)
+        assert ds.chunk_rows % 8 == 0          # 50 -> 56
+
+
+# ---------------------------------------------------------------------------
+# disk-backed chunk source + cache_dir spill (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+class TestDiskChunkSource:
+    def test_npy_source_roundtrip_and_training_parity(self, tmp_path,
+                                                      binary_data):
+        from synapseml_tpu.io.ingest import DiskChunkSource
+
+        Xtr, Xte, ytr, _ = binary_data
+        p = str(tmp_path / "X.npy")
+        np.save(p, Xtr)
+        src = DiskChunkSource(p, rows_per_chunk=100, labels=ytr)
+        assert src.n_rows == len(Xtr)
+        assert src.num_features == Xtr.shape[1]
+        assert src.read_bytes_per_s > 0
+        got = np.concatenate([c[0] for c in src()])
+        np.testing.assert_array_equal(got, Xtr)
+        # training from disk == training from RAM, bit for bit
+        cfg = _mk_cfg(num_iterations=3)
+        b_disk = train_booster_streamed(StreamedDataset(src, chunk_rows=128),
+                                        cfg)
+        b_ram = train_booster_streamed(
+            StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128), cfg)
+        np.testing.assert_array_equal(b_disk.raw_score(Xte),
+                                      b_ram.raw_score(Xte))
+        assert _no_pump_threads()
+
+    def test_raw_uint8_source(self, tmp_path):
+        from synapseml_tpu.io.ingest import DiskChunkSource
+
+        rng = np.random.default_rng(0)
+        arr = rng.integers(0, 255, size=(64, 5), dtype=np.uint8)
+        p = str(tmp_path / "X.u8")
+        arr.tofile(p)
+        src = DiskChunkSource(p, rows_per_chunk=20, raw=True, num_features=5)
+        assert src.n_rows == 64
+        chunks = [c[0] for c in src()]
+        assert [c.shape[0] for c in chunks] == [20, 20, 20, 4]
+        np.testing.assert_array_equal(np.concatenate(chunks), arr)
+
+    def test_cache_dir_spills_and_stays_bitwise(self, tmp_path, binary_data):
+        Xtr, Xte, ytr, _ = binary_data
+        cfg = _mk_cfg(num_iterations=3)
+        spill = tmp_path / "spill"
+        ds_ram = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128)
+        ds_spill = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128,
+                                               cache_dir=str(spill))
+        b_ram = train_booster_streamed(ds_ram, cfg)
+        b_spill = train_booster_streamed(ds_spill, cfg)
+        np.testing.assert_array_equal(b_ram.raw_score(Xte),
+                                      b_spill.raw_score(Xte))
+        # chunks actually live on disk, not in host RAM
+        assert all("bT" not in ch and "bT_path" in ch
+                   for ch in ds_spill.chunks)
+        assert len(list(spill.glob("chunk*.npy"))) == len(ds_spill.chunks)
+
+    def test_disk_eio_mid_stream_surfaces(self, tmp_path, binary_data):
+        Xtr, _, ytr, _ = binary_data
+        cfg = _mk_cfg(num_iterations=2)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128,
+                                         cache_dir=str(tmp_path / "s"))
+        train_booster_streamed(ds, cfg)            # prepare + warm
+        # the fault fires inside the pump's producer thread, so it reaches
+        # the consumer wrapped as ChunkStreamError with the message intact
+        with chaos_chunk_stream(disk_eio_at=1) as cc:
+            with pytest.raises(ChunkStreamError, match="EIO"):
+                train_booster_streamed(ds, cfg)
+        assert ("disk_eio", 1) in cc.faults
+        assert _no_pump_threads()
+
+    def test_disk_torn_read_detected(self, tmp_path, binary_data):
+        Xtr, _, ytr, _ = binary_data
+        cfg = _mk_cfg(num_iterations=2)
+        ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=128,
+                                         cache_dir=str(tmp_path / "s"))
+        train_booster_streamed(ds, cfg)
+        with chaos_chunk_stream(disk_truncate_at=1, disk_truncate_rows=7) \
+                as cc:
+            with pytest.raises(ChunkStreamError, match="torn read"):
+                train_booster_streamed(ds, cfg)
+        assert ("disk_torn", 1) in cc.faults
+        assert _no_pump_threads()
 
 
 # ---------------------------------------------------------------------------

@@ -42,8 +42,20 @@ def _run(tmp_path, extra_env=None, timeout=420):
 
 @pytest.mark.slow
 def test_full_tune_flip_persist(tmp_path):
-    proc, tuned_path, results_path = _run(tmp_path)
+    rows_path = os.path.join(str(tmp_path), "rows.jsonl")
+    proc, tuned_path, results_path = _run(
+        tmp_path, extra_env={"SYNAPSEML_TPU_PERF_ROWS": rows_path})
     assert proc.returncode == 0, proc.stderr[-2000:]
+
+    # phase B journaled its kernel-variant sweep as perf-model rows, in the
+    # arm vocabulary suggest_kernel_variant consumes
+    with open(rows_path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    kernel_rows = [r for r in rows if r.get("kind") == "gbdt_kernel"]
+    assert kernel_rows, "phase B journaled no gbdt_kernel rows"
+    arms = {r["arm"] for r in kernel_rows}
+    assert "partition_sort" in arms and "masked" in arms
+    assert all(r["observed_s"] > 0 for r in kernel_rows)
 
     # raw results landed and cover the phases that can run on CPU
     with open(results_path) as f:

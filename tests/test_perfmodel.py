@@ -417,3 +417,66 @@ def test_trainer_auto_sentinels_resolve_with_provenance(journal):
     assert auto["accum_steps"]["used_fallback"] is True
     # predicted-vs-observed audit trail lands after the fit
     assert auto["observed_fit_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# calibration drift: bad audits demote a family to its fallback
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def drift_clean():
+    perfmodel.reset_drift()
+    yield
+    perfmodel.reset_drift()
+
+
+def _audited_decision(kind, predicted_s):
+    return perfmodel.Decision(kind, "a", None, predicted_s, 0.9, False,
+                              "a", "matched")
+
+
+def test_drift_demotes_after_bad_audit_median(journal, drift_clean):
+    kind = "fam_drift"
+    # healthy audits: ratio ~1, no demotion
+    for _ in range(perfmodel.DRIFT_MIN_AUDITS):
+        _audited_decision(kind, 1.0).audit(observed_s=1.05)
+    assert perfmodel.drift_demoted(kind, "cpu") is False
+    # the window fills with 3x-off audits; crossing warns by name once
+    with pytest.warns(perfmodel.PerfModelDriftWarning, match=kind):
+        for _ in range(perfmodel.DRIFT_WINDOW):
+            _audited_decision(kind, 3.0).audit(observed_s=1.0)
+    assert perfmodel.drift_demoted(kind, "cpu") is True
+    # choose() now returns the fallback unconditionally, tagged by source
+    cands = [perfmodel.Candidate(kind, "a", {}),
+             perfmodel.Candidate(kind, "b", {})]
+    dec = perfmodel.choose(cands, fallback_arm="b", platform="cpu")
+    assert dec.arm == "b" and dec.used_fallback is True
+    assert dec.source == "drift_demoted"
+    # other families are untouched
+    other = perfmodel.choose([perfmodel.Candidate("fam_ok", "a", {})],
+                             fallback_arm="a", platform="cpu")
+    assert other.source != "drift_demoted"
+    # the warning fires once per family per process
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        _audited_decision(kind, 3.0).audit(observed_s=1.0)
+
+
+def test_drift_needs_min_audits_and_both_directions(journal, drift_clean):
+    # under-prediction (model says fast, reality slow) also counts
+    kind = "fam_slowside"
+    for i in range(perfmodel.DRIFT_MIN_AUDITS - 1):
+        perfmodel.record_audit(kind, 0.2, platform="cpu")
+    assert perfmodel.drift_demoted(kind, "cpu") is False   # too few
+    with pytest.warns(perfmodel.PerfModelDriftWarning):
+        perfmodel.record_audit(kind, 0.2, platform="cpu")
+    assert perfmodel.drift_demoted(kind, "cpu") is True
+    # reset clears state
+    perfmodel.reset_drift()
+    assert perfmodel.drift_demoted(kind, "cpu") is False
+    # garbage ratios are ignored
+    perfmodel.record_audit(kind, float("inf"), platform="cpu")
+    perfmodel.record_audit(kind, 0.0, platform="cpu")
+    assert perfmodel.drift_demoted(kind, "cpu") is False

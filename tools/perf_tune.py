@@ -485,6 +485,24 @@ if guard("B: fused train per design"):
         RESULTS["phase_b_train25_row_iters"][name] = round(
             N * ITERS_HI / results[ITERS_HI], 1)
         RESULTS["phase_b_steady_state_row_iters"][name] = round(N / marg, 1)
+        # journal the A/B as a perf-model training row so
+        # suggest_kernel_variant runs on evidence instead of pure fallbacks
+        # (same arm naming + empty-feature convention as the jsonl backfill)
+        try:
+            from synapseml_tpu.core import perfmodel as _pm
+
+            # masked layout is one arm regardless of partition_impl —
+            # matches suggest_kernel_variant's arm vocabulary
+            arm = ("masked" if kw["row_layout"] == "masked"
+                   else f"{kw['row_layout']}_{kw['partition_impl']}")
+            _pm.append_training_row("gbdt_kernel", arm, {},
+                                    observed_s=marg / N,
+                                    unit="s/row-iteration",
+                                    swept_by="perf_tune_phase_b")
+            print(f"[{name:17s}] journaled gbdt_kernel/{arm} row "
+                  f"({marg / N:.3e} s/row-iter)", flush=True)
+        except Exception as e:   # journaling must never sink a TPU window
+            print(f"[{name:17s}] perf-row journal failed: {e}", flush=True)
 
 # --- phase C: num_leaves sweep (fixed vs marginal split cost) ----------------
 if guard("C: num_leaves sweep"):

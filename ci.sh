@@ -97,6 +97,33 @@ JAX_PLATFORMS=cpu python -m pytest -x -q \
     "tests/test_fabric.py::TestGatewayMembership::test_heartbeat_join_evict_on_silence_then_rejoin" \
     "tests/test_fabric.py::TestFabricInvariant"
 
+echo "== federation guard (no single point of failure: kill any one gateway) =="
+# the federated-fabric invariant battery: zero 5xx for accepted requests
+# across a single-gateway kill mid-route / mid-lease / mid-broadcast,
+# exactly one gate-approved version fabric-wide after surviving-peer 2PC
+# recovery, and orphaned workers re-homing within one heartbeat interval
+JAX_PLATFORMS=cpu python -m pytest -x -q \
+    "tests/test_federation.py::TestGatewayKillInvariant" \
+    "tests/test_federation.py::TestBroadcastRecovery" \
+    "tests/test_federation.py::TestWorkerFailover"
+JAX_PLATFORMS=cpu python - << 'EOF'
+# federated req/s must scale >= 0.9x linear per gateway-doubling after
+# core-normalization (on an N-core host a doubling adds at most
+# min(2K,N)/min(K,N) real parallelism; on 1 core the bar degenerates to
+# "federation tax <= 10% per doubling"), with the control plane converging
+# at every width; per-gateway convergence time rides along for trending
+import json, subprocess, sys
+out = subprocess.run([sys.executable, "bench.py", "--only",
+                      "bench_fabric_federation"],
+                     capture_output=True, text=True, check=True).stdout
+rec = json.loads(out.strip().splitlines()[-1])
+print(f"federated req/s per width: {rec['gateway_reqs_per_s']} "
+      f"(per-doubling {rec['scaling_per_doubling']}, convergence "
+      f"{rec['convergence_time_s']} s, {rec['cores']} cores)")
+assert rec["guard"]["scaling_ge_0p9x_linear_core_normalized"], \
+    f"federation tax broke 0.9x-linear core-normalized scaling: {rec}"
+EOF
+
 echo "== online learning chaos (invariant: accepted requests always answered by a gate-approved, never-regressed policy) =="
 JAX_PLATFORMS=cpu python -m pytest -x -q \
     "tests/test_online.py::TestChaosInvariant"

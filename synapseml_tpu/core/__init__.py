@@ -34,6 +34,17 @@ from .logging import (  # noqa: F401
     reset_failure_counts,
     retry_with_timeout,
 )
+from .gossip import (  # noqa: F401
+    ConsistentHashRing,
+    GossipEntry,
+    GossipState,
+)
+from .qos import (  # noqa: F401
+    BudgetLeaseLedger,
+    QoSClass,
+    QoSController,
+    WeightedFairQueue,
+)
 from .resilience import (  # noqa: F401
     DEADLINE_HEADER,
     CircuitBreaker,

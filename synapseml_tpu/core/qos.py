@@ -103,14 +103,20 @@ class _TenantState:
         self.nonfinite = 0
 
     # called with the controller's _lock held (see class docstring)
-    def refill(self, now: float) -> None:
+    def refill(self, now: float, share: float = 1.0) -> None:
+        """Refill at ``share`` of the class contract. ``share`` < 1 is the
+        federated mode: the class rate/burst describe the GLOBAL per-tenant
+        budget and each gateway enforces its leased fraction, so K gateways
+        admitting independently still sum to one global rate (burst floors
+        at one token — a leaseholder must always be able to admit)."""
         rate = self.qos.rate_per_sec
+        burst = max(1.0, self.qos.burst * share)
         if rate is None:
-            self.tokens = self.qos.burst  # lint-ok: locks
+            self.tokens = burst  # lint-ok: locks
         else:
             self.tokens = min(  # lint-ok: locks
-                self.qos.burst,
-                self.tokens + (now - self.last_refill) * rate)
+                burst,
+                self.tokens + (now - self.last_refill) * rate * share)
         self.last_refill = now
 
 
@@ -128,6 +134,10 @@ class QoSController:
         self._lock = threading.Lock()
         self._classes: Dict[str, QoSClass] = dict(classes or {})
         self._tenants: Dict[str, _TenantState] = {}
+        # federated budget leasing: tenant -> this enforcer's fraction of
+        # the GLOBAL class rate (1.0 = sole enforcer, the single-gateway
+        # mode). Written by set_rate_share from the gossip/lease layer.
+        self._shares: Dict[str, float] = {}
 
     def assign(self, tenant: str, qos: QoSClass) -> None:
         """(Re)assign a tenant's QoS class; existing counters are kept but
@@ -144,6 +154,21 @@ class QoSController:
     def qos_class(self, tenant: str) -> QoSClass:
         with self._lock:
             return self._classes.get(tenant, self.default_class)
+
+    # -- federated budget leasing --
+    def set_rate_share(self, tenant: str, share: float) -> None:
+        """Set this enforcer's leased fraction of the tenant's GLOBAL
+        rate/burst contract (:class:`BudgetLeaseLedger` computes it from
+        live leaseholders). Clamped to (0, 1]; takes effect on the next
+        refill — tokens already granted are honored (a shrinking share
+        never claws back admitted requests)."""
+        share = min(max(float(share), 1e-9), 1.0)
+        with self._lock:
+            self._shares[tenant] = share
+
+    def rate_share(self, tenant: str) -> float:
+        with self._lock:
+            return self._shares.get(tenant, 1.0)
 
     def _state_locked(self, tenant: str) -> _TenantState:
         state = self._tenants.get(tenant)
@@ -165,7 +190,7 @@ class QoSController:
                 state.quarantined += 1
                 record_failure("qos.quarantined", tenant=tenant)
                 return AdmitDecision(False, 503, "quarantined")
-            state.refill(now)
+            state.refill(now, self._shares.get(tenant, 1.0))
             if state.tokens < 1.0:
                 state.rate_limited += 1
                 # the failed admission must not hold the half-open probe
@@ -214,6 +239,7 @@ class QoSController:
                 out[tenant] = {
                     "class": s.qos.name, "weight": s.qos.weight,
                     "tokens": round(s.tokens, 3),
+                    "rate_share": self._shares.get(tenant, 1.0),
                     "admitted": s.admitted,
                     "rate_limited": s.rate_limited,
                     "quarantined": s.quarantined,
@@ -221,6 +247,92 @@ class QoSController:
                     "nonfinite": s.nonfinite,
                     "breaker": s.breaker.snapshot()}
             return out
+
+
+class BudgetLeaseLedger:
+    """Who currently holds a sub-budget lease on each tenant's global rate.
+
+    The federated-gateway problem: K edge gateways must together enforce
+    ONE per-tenant rate without a central counter on the hot path. Scheme:
+    a gateway serving tenant T claims a **lease** — a gossip entry
+    (``lease/<tenant>/<gateway>``) it re-publishes every replicator tick.
+    Every gateway feeds the lease entries it sees (its own and merged ones)
+    into this ledger via :meth:`observe`; a leaseholder is **live** while
+    its entry keeps advancing, judged purely on the LOCAL monotonic instant
+    of the last advance (``GossipState.advanced_at`` semantics) — no
+    cross-host clock comparison. Each live holder's share is ``1/n_live``,
+    pushed into :meth:`QoSController.set_rate_share`, so the fleet-wide sum
+    of enforced rates is exactly the global contract.
+
+    Safety when a leaseholder dies: its entry stops advancing everywhere,
+    so after ``ttl`` of silence survivors drop it from ``n_live`` and their
+    shares GROW to reabsorb the freed budget. The failure window errs
+    closed — between the death and the expiry the fleet enforces less than
+    the global rate (the dead gateway's slice goes unused), never more;
+    over-admission is impossible by construction. Thread-safe,
+    clock-injectable, transport-free (the gossip layer drives it).
+    """
+
+    def __init__(self, ttl: float = 2.0, clock=time.monotonic):
+        if ttl <= 0:
+            raise ValueError(f"ttl must be > 0, got {ttl}")
+        self.ttl = float(ttl)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # tenant -> holder -> local monotonic time of last observed advance
+        self._leases: Dict[str, Dict[str, float]] = {}
+        self.expired = 0
+
+    def observe(self, tenant: str, holder: str) -> None:
+        """A lease entry for (tenant, holder) advanced — published locally
+        or accepted in a merge. Resets the holder's liveness window."""
+        with self._lock:
+            self._leases.setdefault(tenant, {})[holder] = self._clock()
+
+    def release(self, tenant: str, holder: str) -> None:
+        """Explicit release (clean gateway shutdown / lease tombstone)."""
+        with self._lock:
+            holders = self._leases.get(tenant)
+            if holders is not None:
+                holders.pop(holder, None)
+                if not holders:
+                    del self._leases[tenant]
+
+    def holders(self, tenant: str, now: Optional[float] = None) -> list:
+        """Live leaseholders, pruning any whose entry went ``ttl`` without
+        advancing (the dead-gateway expiry)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            holders = self._leases.get(tenant, {})
+            dead = [h for h, at in holders.items() if now - at > self.ttl]
+            for h in dead:
+                del holders[h]
+                self.expired += 1
+                record_failure("qos.lease_expired", tenant=tenant,
+                               holder=h)
+            return sorted(holders)
+
+    def share(self, tenant: str, holder: str,
+              now: Optional[float] = None) -> float:
+        """``holder``'s fraction of the tenant's global budget: 1/n over
+        the live holders, counting ``holder`` itself even before its first
+        observed advance (asking for a share IS holding a lease)."""
+        live = set(self.holders(tenant, now))
+        live.add(holder)
+        return 1.0 / len(live)
+
+    def tenants(self) -> list:
+        with self._lock:
+            return sorted(self._leases)
+
+    def snapshot(self) -> dict:
+        now = self._clock()
+        with self._lock:
+            return {"ttl_s": self.ttl, "expired": self.expired,
+                    "tenants": {
+                        t: {h: round(now - at, 3)
+                            for h, at in holders.items()}
+                        for t, holders in self._leases.items()}}
 
 
 class WeightedFairQueue:

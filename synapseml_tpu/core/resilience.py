@@ -314,6 +314,27 @@ class Membership:
             self.evictions += 1
             return True
 
+    def evict_if_expired(self, member, now: Optional[float] = None) -> bool:
+        """Evict ``member`` only if it is STILL overdue, re-checked under
+        the lock. :meth:`expired` + :meth:`evict` is a two-step read/act
+        with a race in the gap: a member that heartbeats between the read
+        and the unconditional evict — a rejoin in the very tick it would
+        die — gets evicted anyway, dropping routing state the beat just
+        refreshed. Lazy sweeps must use this instead; the unconditional
+        :meth:`evict` stays for voluntary leaves (deregister), where the
+        member ASKED to go regardless of beat freshness."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            last = self._last.get(member)
+            if last is None or member in self._static \
+                    or now - last <= self.timeout:
+                return False
+            del self._last[member]
+            self._info.pop(member, None)
+            self._evicted.add(member)
+            self.evictions += 1
+            return True
+
     def evict_stale(self, now: Optional[float] = None) -> list:
         """Evict every expired member in one sweep and return those evicted.
 
@@ -321,9 +342,11 @@ class Membership:
         table (the routing/health path) — an IDLE gateway holds dead workers
         indefinitely. Supervisor loops call this on their own cadence so
         membership decays even with zero traffic; each eviction is counted
-        under ``fabric.evicted_idle``."""
+        under ``fabric.evicted_idle``. Staleness is re-checked per member
+        under the lock (:meth:`evict_if_expired`), so a rejoin beat racing
+        the sweep keeps its membership."""
         stale = self.expired(now)
-        evicted = [m for m in stale if self.evict(m)]
+        evicted = [m for m in stale if self.evict_if_expired(m, now)]
         if evicted:
             from .logging import record_failure
             record_failure("fabric.evicted_idle", n=len(evicted),

@@ -13,9 +13,10 @@ micro-batches through a fitted pipeline.
 from .http import (CustomInputParser, CustomOutputParser, HTTPRequestData,
                    HTTPResponseData, HTTPTransformer, JSONInputParser,
                    JSONOutputParser, SimpleHTTPTransformer, StringOutputParser)
-from .distributed_serving import (BroadcastError, DistributedServingServer,
+from .distributed_serving import (BroadcastError, CoordinatorDied,
+                                  DistributedServingServer,
                                   FabricSupervisor, PromotionBroadcast,
-                                  ServingGateway, WorkerAgent)
+                                  ServingGateway, WorkerAgent, federate)
 from .serving import (ModelRegistry, ServingServer, SwapError,
                       request_to_table, respond_with)
 from .binary import read_binary_files, read_image_dir
@@ -27,7 +28,7 @@ __all__ = [
     "JSONOutputParser", "StringOutputParser", "CustomOutputParser",
     "ServingServer", "ServingGateway", "DistributedServingServer",
     "WorkerAgent", "FabricSupervisor", "ModelRegistry", "SwapError",
-    "PromotionBroadcast", "BroadcastError",
+    "PromotionBroadcast", "BroadcastError", "CoordinatorDied", "federate",
     "request_to_table", "respond_with",
     "read_binary_files", "read_image_dir", "PowerBIWriter",
 ]

@@ -50,13 +50,17 @@ from __future__ import annotations
 import http.client
 import json as _json
 import queue
+import random
 import threading
 import time
 import uuid
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import (Callable, Dict, List, Optional, Sequence, Tuple,
+                    Union)
 
+from ..core.gossip import ConsistentHashRing, GossipState
 from ..core.logging import record_failure
-from ..core.qos import DEFAULT_TENANT, TENANT_HEADER
+from ..core.qos import (DEFAULT_TENANT, TENANT_HEADER, BudgetLeaseLedger,
+                        QoSController)
 from ..core.resilience import (DEADLINE_HEADER, CircuitBreaker, Deadline,
                                Membership)
 from ..core.table import Table
@@ -75,6 +79,13 @@ SHAPE_ROWS_HEADER = "X-Batch-Rows"
 # gateway that leaves the DATA path intact — the nastiest membership case).
 # Installed by testing.chaos.chaos_heartbeat_partition; single global hook.
 _HEARTBEAT_HOOK: Optional[Callable[[str], bool]] = None
+
+# Control-plane chaos hook: the gateway replicator consults it before every
+# gossip exchange with ``(source_gateway_id, peer_url)``; a falsy return
+# drops the exchange — a partition of the REPLICATED control plane that
+# leaves data paths and worker heartbeats intact. Installed by
+# testing.chaos.chaos_control_plane_partition; single global hook.
+_GOSSIP_HOOK: Optional[Callable[[str, str], bool]] = None
 
 
 def _detect_local_ip() -> str:
@@ -109,7 +120,9 @@ class _GatewayStats:
     ``gw.stats["forwarded"]`` read surface."""
 
     _COUNTERS = ("forwarded", "retried", "failed", "heartbeats", "joined",
-                 "rejoined", "evicted", "deregistered")
+                 "rejoined", "evicted", "deregistered",
+                 "gossip_exchanges", "gossip_failed", "entries_merged",
+                 "rate_limited")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -376,7 +389,14 @@ class ServingGateway:
                  local_worker: Optional[ServingServer] = None,
                  local_index: Optional[int] = None,
                  heartbeat_timeout: float = 3.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 gateway_id: Optional[str] = None,
+                 peers: Sequence[str] = (),
+                 gossip_interval: float = 0.25,
+                 gossip_timeout: float = 2.0,
+                 peer_timeout: Optional[float] = None,
+                 lease_ttl: float = 2.0,
+                 qos: Optional[QoSController] = None):
         if mode not in ("least_loaded", "round_robin"):
             raise ValueError(f"unknown load-balancing mode {mode!r}")
         self.breaker_threshold = breaker_threshold
@@ -437,6 +457,34 @@ class ServingGateway:
         # one replica's AOT cache. Bounded FIFO; purely advisory.
         self._affinity: Dict = {}
         self._affinity_cap = 256
+        # --- federation: replicated control plane over /__fabric/gossip ---
+        # Every gateway holds a GossipState whether or not it has peers; the
+        # replicator thread only runs once a peer is configured, so the
+        # single-gateway deployment pays nothing.
+        self.gateway_id = gateway_id or uuid.uuid4().hex[:12]
+        self.gossip = GossipState(self.gateway_id, clock=clock)
+        self.gossip_interval = gossip_interval
+        self.gossip_timeout = gossip_timeout
+        # a peer gateway whose liveness entry stops advancing for this long
+        # is dead: its ring arcs rehash and its leases expire
+        self.peer_timeout = peer_timeout if peer_timeout is not None \
+            else max(4.0 * gossip_interval, 1.0)
+        self.lease_ttl = lease_ttl
+        # edge-tier QoS: when set, THIS gateway admits per-tenant with its
+        # leased share of the class's GLOBAL rate (core/qos.py lease math)
+        self.qos = qos
+        self.leases = BudgetLeaseLedger(ttl=lease_ttl, clock=clock)
+        self.ring = ConsistentHashRing()
+        self._active_tenants: Dict[str, float] = {}
+        self._peer_urls: List[str] = []
+        self._peer_state: Dict[str, dict] = {}   # url -> exchange health
+        self._peer_rr = 0
+        self.public_url: Optional[str] = None    # resolved in start()
+        self._killed = threading.Event()
+        self._repl_stop = threading.Event()
+        self._repl_thread: Optional[threading.Thread] = None
+        for p in peers:
+            self.add_peer(p)
 
     # --- membership -----------------------------------------------------
     def _make_link(self, url: str) -> _WorkerLink:
@@ -446,11 +494,17 @@ class ServingGateway:
         return _WorkerLink(h, p, self.forward_timeout, breaker=mk(),
                            tenant_breaker_factory=mk)
 
-    def register_worker(self, url: str, **info) -> _WorkerLink:
+    def register_worker(self, url: str, _replicate: bool = True,
+                        **info) -> _WorkerLink:
         """Programmatic join: add (or refresh) a worker link on a RUNNING
         gateway. Idempotent by url; an evicted worker re-registering gets a
         fresh link and breaker (clean rejoin). This is also what a
-        ``/__fabric/heartbeat`` from an unknown url does."""
+        ``/__fabric/heartbeat`` from an unknown url does. On a federated
+        gateway the (re)registration replicates as a ``member/<url>``
+        gossip entry so every peer gateway can route to the worker;
+        ``_replicate=False`` is the merge path applying a PEER's entry
+        (replicated state must not re-publish — the origin's epoch already
+        carries it)."""
         h, p = _parse_hostport(url)
         canonical = f"http://{h}:{p}"
         with self._lock:
@@ -459,14 +513,19 @@ class ServingGateway:
             if created:
                 link = self._make_link(canonical)
                 self.links.append(link)
-        admitted = self.membership.beat(canonical, **{
-            k: v for k, v in info.items() if k in (
-                "queue_depth", "warm_buckets", "version", "id", "tenants")})
+        fields = {k: v for k, v in info.items() if k in (
+            "queue_depth", "warm_buckets", "version", "id", "tenants")}
+        admitted = self.membership.beat(canonical, **fields)
         link.update_membership(info)
         if created:
             self.stats.incr("rejoined" if admitted == "rejoin"
                             else "joined")
             record_failure("gateway.worker_joined", worker=canonical)
+        if _replicate and self.federated:
+            self.gossip.publish(
+                f"member/{canonical}",
+                {k: (list(v) if isinstance(v, tuple) else v)
+                 for k, v in fields.items()})
         return link
 
     def deregister_worker(self, url: str) -> bool:
@@ -475,10 +534,22 @@ class ServingGateway:
         h, p = _parse_hostport(url)
         return self._evict(f"http://{h}:{p}", reason="deregistered")
 
-    def _evict(self, url: str, reason: str = "evicted") -> bool:
+    def _evict(self, url: str, reason: str = "evicted",
+               only_if_expired: bool = False,
+               _replicate: bool = True) -> bool:
         """Remove a worker from routing entirely and free its state. The
         counterpart of breaker OPEN: OPEN keeps the link and re-probes;
-        eviction forgets it (until a rejoin)."""
+        eviction forgets it (until a rejoin). ``only_if_expired`` is the
+        lazy-sweep mode: staleness is re-checked under the membership lock
+        (:meth:`Membership.evict_if_expired`), so a worker whose rejoin
+        beat raced the sweep keeps its link and affinity. On a federated
+        gateway the eviction replicates as a tombstone — peers must not
+        resurrect a dead worker at the next exchange."""
+        if only_if_expired:
+            if not self.membership.evict_if_expired(url):
+                return False
+        else:
+            self.membership.evict(url)
         with self._lock:
             link = next((l for l in self.links if l.url == url), None)
             if link is None:
@@ -491,18 +562,20 @@ class ServingGateway:
             self._affinity = {k: v for k, v in self._affinity.items()
                               if v != url}
         link.close()
-        self.membership.evict(url)
         self.stats.incr("deregistered" if reason == "deregistered"
                         else "evicted")
         record_failure(f"gateway.worker_{reason}", worker=url)
+        if _replicate and self.federated:
+            self.gossip.retract(f"member/{url}")
         return True
 
     def _sweep_expired(self) -> None:
         """Evict every member whose heartbeat is overdue. Called lazily
         from the selection path and the health endpoint — no sweeper
-        thread to leak."""
+        thread to leak. Per-member staleness is re-checked under the lock,
+        closing the rejoin-during-lazy-eviction race."""
         for url in self.membership.expired():
-            self._evict(url, reason="evicted")
+            self._evict(url, reason="evicted", only_if_expired=True)
 
     def evict_stale(self) -> list:
         """Explicit idle sweep: the lazy :meth:`_sweep_expired` only runs
@@ -511,8 +584,9 @@ class ServingGateway:
         (:meth:`FabricSupervisor.step`) call this on their own cadence;
         evictions are counted under ``fabric.evicted_idle``."""
         stale = self.membership.expired()
-        evicted = [url for url in stale if self._evict(url,
-                                                       reason="evicted")]
+        evicted = [url for url in stale
+                   if self._evict(url, reason="evicted",
+                                  only_if_expired=True)]
         if evicted:
             record_failure("fabric.evicted_idle", n=len(evicted),
                            members=[str(u) for u in evicted])
@@ -524,9 +598,21 @@ class ServingGateway:
             payload = _json.loads(body.decode()) if body else {}
         except ValueError:
             return 400, {"error": "control payload must be JSON"}
-        if not isinstance(payload, dict) or not payload.get("url"):
-            return 400, {"error": "control payload needs a worker 'url'"}
+        if not isinstance(payload, dict):
+            return 400, {"error": "control payload must be a JSON object"}
         op = path[len(FABRIC_PATH_PREFIX):].strip("/")
+        if op == "gossip":
+            # anti-entropy push-pull: merge the peer's entries, reply with
+            # full local state (their merge of our reply completes the
+            # round — one exchange converges both sides)
+            self._absorb(str(payload.get("from", "")),
+                         payload.get("clock", 0),
+                         payload.get("entries", ()))
+            return 200, {"ok": True, "from": self.gateway_id,
+                         "clock": self.gossip.lamport,
+                         "entries": self.gossip.wire()}
+        if not payload.get("url"):
+            return 400, {"error": "control payload needs a worker 'url'"}
         if op in ("heartbeat", "register"):
             before = set(self.membership.members())
             info = {k: v for k, v in payload.items() if k != "url"}
@@ -535,12 +621,277 @@ class ServingGateway:
             self._sweep_expired()
             return 200, {"ok": True, "worker": link.url,
                          "known": link.url in before,
-                         "workers": len(self.links)}
+                         "workers": len(self.links),
+                         # live gateway peers, so WorkerAgent learns every
+                         # gateway it can fail its beats over to
+                         "gateway_id": self.gateway_id,
+                         "peers": self.gateway_urls()}
         if op == "deregister":
             gone = self.deregister_worker(str(payload["url"]))
             return 200, {"ok": True, "removed": gone,
                          "workers": len(self.links)}
         return 404, {"error": f"unknown fabric op {op!r}"}
+
+    # --- federation: replicated control plane ---------------------------
+    @property
+    def federated(self) -> bool:
+        return bool(self._peer_urls)
+
+    def alive(self) -> bool:
+        """False once chaos hard-killed this gateway (kill_gateway) — the
+        coordinator-liveness input to a survivable PromotionBroadcast."""
+        return not self._killed.is_set()
+
+    def add_peer(self, url: str) -> None:
+        """Teach this gateway a peer gateway's address (idempotent). The
+        replicator thread starts with the first peer on a RUNNING gateway;
+        peers added before :meth:`start` begin exchanging at start."""
+        h, p = _parse_hostport(url)
+        base = f"http://{h}:{p}"
+        with self._lock:
+            if base not in self._peer_urls:
+                self._peer_urls.append(base)
+                self._peer_state[base] = {"last_ok": None, "failures": 0,
+                                          "clock": 0}
+        if self._httpd is not None:
+            self._start_replicator()
+
+    def gateway_urls(self) -> List[str]:
+        """Public urls of every gateway believed alive (self included) —
+        what heartbeat acks advertise so workers can fail over."""
+        urls = [self.public_url] if self.public_url else []
+        now = self._clock()
+        for info in self._peers_alive(now).values():
+            if info["alive"] and info["url"] and info["url"] not in urls:
+                urls.append(info["url"])
+        return urls
+
+    def tenant_home(self, tenant: str) -> Optional[str]:
+        """Consistent-hash tenant→gateway affinity: the public url of the
+        gateway that should front ``tenant``. Every converged gateway
+        computes the same answer; a gateway death rehashes ONLY the dead
+        gateway's tenants (ring minimal movement), so warm-ladder routing
+        keeps seeing stable (tenant, shape) streams on the survivors."""
+        return self.ring.node_for(tenant) or self.public_url
+
+    def _absorb(self, src_id: str, clock, entries) -> List:
+        """Merge a peer's entries + clock (request or reply side) and
+        apply every accepted entry to local routing/QoS state."""
+        if src_id and src_id != self.gateway_id:
+            try:
+                self.gossip.observe_peer_clock(src_id, int(clock))
+            except (TypeError, ValueError):
+                pass
+        accepted = self.gossip.merge(entries)
+        if accepted:
+            self.stats.incr("entries_merged", len(accepted))
+            self._apply_entries(accepted)
+        return accepted
+
+    def _apply_entries(self, accepted) -> None:
+        """Fold accepted gossip entries into live gateway state: member
+        entries register/evict worker links (so ANY gateway routes to ANY
+        worker from converged state), lease entries feed the budget
+        ledger, gateway entries refresh the affinity ring. ``promo/``
+        records are read lazily by broadcast recovery, not here."""
+        ring_dirty = False
+        for e in accepted:
+            if e.key.startswith("member/"):
+                url = e.key[len("member/"):]
+                if e.value is None:
+                    self._evict(url, reason="evicted", _replicate=False)
+                else:
+                    self.register_worker(url, _replicate=False, **e.value)
+            elif e.key.startswith("lease/"):
+                parts = e.key.split("/", 2)
+                if len(parts) != 3:
+                    continue
+                _, tenant, holder = parts
+                if e.value is None:
+                    self.leases.release(tenant, holder)
+                else:
+                    self.leases.observe(tenant, holder)
+                if self.qos is not None:
+                    self.qos.set_rate_share(
+                        tenant, self.leases.share(tenant, self.gateway_id))
+            elif e.key.startswith("gateway/"):
+                ring_dirty = True
+        if ring_dirty:
+            self._refresh_ring(self._clock())
+
+    # --- federation: edge QoS with leased sub-budgets -------------------
+    def edge_admit(self, tenant: str):
+        """Edge-tier admission: this gateway's token bucket refills at its
+        LEASED share of the tenant's global rate (1/n live leaseholders),
+        so K gateways admitting independently enforce one fabric-wide
+        per-tenant contract. First contact claims the lease immediately;
+        the replicator renews it every tick and retracts it after
+        ``lease_ttl`` of tenant silence."""
+        self._touch_tenant(tenant)
+        decision = self.qos.admit(tenant)
+        if not decision.ok:
+            self.stats.incr("rate_limited")
+        return decision
+
+    def _touch_tenant(self, tenant: str) -> None:
+        now = self._clock()
+        with self._lock:
+            new = tenant not in self._active_tenants
+            self._active_tenants[tenant] = now
+        if new and self.federated:
+            self._renew_lease(tenant)
+
+    def _renew_lease(self, tenant: str) -> None:
+        self.gossip.publish(f"lease/{tenant}/{self.gateway_id}",
+                            {"holder": self.gateway_id})
+        self.leases.observe(tenant, self.gateway_id)
+        if self.qos is not None:
+            self.qos.set_rate_share(
+                tenant, self.leases.share(tenant, self.gateway_id))
+
+    def _renew_leases(self, now: float) -> None:
+        with self._lock:
+            active = dict(self._active_tenants)
+        for tenant, last in active.items():
+            if now - last > self.lease_ttl:
+                # tenant went quiet here: release our slice so surviving
+                # enforcers' shares grow back toward the full contract
+                with self._lock:
+                    self._active_tenants.pop(tenant, None)
+                self.gossip.retract(f"lease/{tenant}/{self.gateway_id}")
+                self.leases.release(tenant, self.gateway_id)
+            else:
+                self._renew_lease(tenant)
+        if self.qos is not None:
+            for tenant in set(self.leases.tenants()) | set(active):
+                self.qos.set_rate_share(
+                    tenant, self.leases.share(tenant, self.gateway_id))
+
+    # --- federation: replicator loop ------------------------------------
+    def _peers_alive(self, now: float) -> Dict[str, dict]:
+        """Peer gateways by id, judged on how recently their liveness
+        entry advanced LOCALLY (no cross-host clocks): a peer whose entry
+        went ``peer_timeout`` without advancing is dead — partitioned or
+        killed — and its arcs leave the affinity ring."""
+        out: Dict[str, dict] = {}
+        for key, info in self.gossip.items("gateway/").items():
+            gid = key[len("gateway/"):]
+            if gid == self.gateway_id:
+                continue
+            at = self.gossip.advanced_at(key)
+            age = (now - at) if at is not None else float("inf")
+            out[gid] = {"url": info.get("url"),
+                        "last_advance_age_s": round(age, 3),
+                        "alive": age <= self.peer_timeout}
+        return out
+
+    def _refresh_ring(self, now: float) -> None:
+        want = {self.public_url} if self.public_url else set()
+        for info in self._peers_alive(now).values():
+            if info["alive"] and info["url"]:
+                want.add(info["url"])
+        for node in self.ring.nodes():
+            if node not in want:
+                self.ring.remove(node)
+                record_failure("gateway.peer_left_ring", peer=node)
+        for node in want:
+            self.ring.add(node)
+
+    def _exchange_once(self) -> bool:
+        """One push-pull anti-entropy exchange with the next peer in
+        round-robin order. Chaos (``_GOSSIP_HOOK``) or transport failure
+        drops the exchange — never the gateway."""
+        with self._lock:
+            if not self._peer_urls:
+                return False
+            self._peer_rr += 1
+            peer = self._peer_urls[self._peer_rr % len(self._peer_urls)]
+        hook = _GOSSIP_HOOK
+        if hook is not None and not hook(self.gateway_id, peer):
+            self.stats.incr("gossip_failed")
+            return False
+        body = _json.dumps({"from": self.gateway_id,
+                            "clock": self.gossip.lamport,
+                            "entries": self.gossip.wire()}).encode()
+        h, p = _parse_hostport(peer)
+        try:
+            conn = http.client.HTTPConnection(h, p,
+                                              timeout=self.gossip_timeout)
+            try:
+                conn.request("POST", FABRIC_PATH_PREFIX + "gossip",
+                             body=body,
+                             headers={"Content-Type": "application/json"})
+                reply = _json.loads(conn.getresponse().read().decode())
+            finally:
+                conn.close()
+        except Exception:  # noqa: BLE001 — a dead peer is routine here
+            with self._lock:
+                state = self._peer_state.get(peer)
+                if state is not None:
+                    state["failures"] += 1
+            self.stats.incr("gossip_failed")
+            record_failure("gateway.gossip_exchange_failed", peer=peer)
+            return False
+        self._absorb(str(reply.get("from", "")), reply.get("clock", 0),
+                     reply.get("entries", ()))
+        with self._lock:
+            state = self._peer_state.get(peer)
+            if state is not None:
+                state["last_ok"] = self._clock()
+                try:
+                    state["clock"] = int(reply.get("clock", 0))
+                except (TypeError, ValueError):
+                    pass
+        self.stats.incr("gossip_exchanges")
+        return True
+
+    def _replicate_once(self) -> None:
+        now = self._clock()
+        # our own liveness entry: the advancing epoch IS the heartbeat
+        self.gossip.publish(f"gateway/{self.gateway_id}",
+                            {"url": self.public_url})
+        self._renew_leases(now)
+        self._refresh_ring(now)
+        self._exchange_once()
+
+    def _replicate_loop(self) -> None:
+        while not self._repl_stop.is_set() and not self._killed.is_set():
+            try:
+                self._replicate_once()
+            except Exception:  # noqa: BLE001 — replication must not die
+                record_failure("gateway.gossip_error")
+            self._repl_stop.wait(self.gossip_interval)
+
+    def _start_replicator(self) -> None:
+        with self._lock:
+            if self._repl_thread is not None:
+                return
+            self._repl_thread = threading.Thread(
+                target=self._replicate_loop, daemon=True,
+                name=f"gossip-{self.gateway_id}")
+        self._replicate_once()      # eager first advertisement + exchange
+        self._repl_thread.start()
+
+    def federation_snapshot(self) -> dict:
+        """Control-plane observability: replication lag (entries behind
+        the newest epoch known anywhere), peer liveness, ring membership,
+        lease state — the numbers that show a partition before it bites."""
+        now = self._clock()
+        with self._lock:
+            peer_state = {u: dict(s) for u, s in self._peer_state.items()}
+        for s in peer_state.values():
+            last = s.pop("last_ok", None)
+            s["last_exchange_age_s"] = (round(now - last, 3)
+                                        if last is not None else None)
+        return {"gateway_id": self.gateway_id,
+                "public_url": self.public_url,
+                "clock": self.gossip.lamport,
+                "entries_behind": self.gossip.entries_behind(),
+                "peers": self._peers_alive(now),
+                "exchanges": peer_state,
+                "ring": self.ring.nodes(),
+                "leases": self.leases.snapshot(),
+                "gossip": self.gossip.snapshot()}
 
     # --- worker selection ----------------------------------------------
     def _shape_hint(self, body: bytes,
@@ -802,6 +1153,17 @@ class ServingGateway:
                     # the tenant identity rides every hop: the worker's own
                     # QoS admission and handler pinning key on it
                     fwd_headers[TENANT_HEADER] = tenant
+                if tenant is not None and outer.qos is not None:
+                    # edge-tier admission at the gateway boundary: this
+                    # gateway's leased share of the tenant's GLOBAL rate
+                    # (federation lease math) — shed here costs no
+                    # forward hop and no worker handler time
+                    decision = outer.edge_admit(tenant)
+                    if not decision.ok:
+                        self._reply_json(decision.status, _json.dumps(
+                            {"error": decision.reason,
+                             "tenant": tenant}).encode())
+                        return
                 # no header -> no gateway deadline (forward_timeout already
                 # bounds each attempt; a synthetic deadline equal to it
                 # would starve the sibling retry). An explicit budget is
@@ -830,6 +1192,7 @@ class ServingGateway:
                     "workers": [l.health(now) for l in links],
                     "membership": outer.membership.snapshot(now),
                     "mode": outer.mode,
+                    "federation": outer.federation_snapshot(),
                     **outer.stats.snapshot()}).encode()
                 self._reply_json(200, body)
 
@@ -842,11 +1205,18 @@ class ServingGateway:
 
         self._httpd = _Server((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
+        self.public_url = f"http://{self.host}:{self.port}"
+        self.ring.add(self.public_url)
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
+        if self._peer_urls:
+            self._start_replicator()
         return self
 
     def stop(self) -> None:
+        self._repl_stop.set()
+        if self._repl_thread is not None:
+            self._repl_thread.join(timeout=self.gossip_interval + 1.0)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -867,6 +1237,18 @@ class ServingGateway:
         self.stop()
 
 
+def federate(gateways: Sequence[ServingGateway]) -> None:
+    """Wire started gateways into one federated tier: every gateway learns
+    every other as a gossip peer, starting the anti-entropy replicators.
+    After convergence (a few ``gossip_interval`` ticks) any gateway routes
+    to any worker, tenant homes agree fabric-wide, and per-tenant budgets
+    are enforced as leased shares of one global contract."""
+    for gw in gateways:
+        for other in gateways:
+            if other is not gw:
+                gw.add_peer(f"http://{other.host}:{other.port}")
+
+
 class WorkerAgent:
     """Worker-side membership reporter: a daemon thread POSTing periodic
     heartbeats to the gateway's control plane. Each beat advertises the
@@ -880,14 +1262,36 @@ class WorkerAgent:
     otherwise ignored — the worker keeps serving and keeps beating, so a
     healed partition rejoins automatically. ``stop()`` sends a best-effort
     deregister (clean leave) unless ``deregister=False``.
+
+    **Gateway failover**: ``gateway_url`` may be a list, and every
+    heartbeat ack carries the live gateway set (federation gossip), which
+    the agent learns. When the primary gateway is unreachable the SAME
+    beat retries against each other known gateway with jittered backoff
+    (thundering-herd protection when a whole fleet rehomes at once); the
+    first gateway that acks becomes the new primary — a dead gateway
+    re-homes its workers within one heartbeat interval instead of
+    silently orphaning them. ``failed`` counts beats NO gateway took;
+    ``failed_over`` counts re-homings.
     """
 
-    def __init__(self, worker: ServingServer, gateway_url: str,
+    def __init__(self, worker: ServingServer,
+                 gateway_url: Union[str, Sequence[str]],
                  advertise_url: Optional[str] = None,
                  worker_id: Optional[str] = None,
-                 interval: float = 0.5, timeout: float = 2.0):
-        h, p = _parse_hostport(gateway_url)
-        self._control = f"http://{h}:{p}{FABRIC_PATH_PREFIX}"
+                 interval: float = 0.5, timeout: float = 2.0,
+                 failover_backoff: float = 0.05):
+        urls = [gateway_url] if isinstance(gateway_url, str) \
+            else list(gateway_url)
+        if not urls:
+            raise ValueError("WorkerAgent needs at least one gateway url")
+        self._gw_lock = threading.Lock()
+        self._controls: List[str] = []
+        for u in urls:
+            base = self._control_base(u)
+            if base not in self._controls:
+                self._controls.append(base)
+        self._primary = 0
+        self.failover_backoff = failover_backoff
         self.worker = worker
         wh, wp = _parse_hostport(advertise_url or worker.url)
         self.advertise_url = f"http://{wh}:{wp}"
@@ -896,9 +1300,41 @@ class WorkerAgent:
         self.timeout = timeout
         self.sent = 0
         self.dropped = 0          # chaos-partitioned beats
-        self.failed = 0           # transport-failed beats
+        self.failed = 0           # beats no known gateway acknowledged
+        self.failed_over = 0      # beats that re-homed to another gateway
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _control_base(url: str) -> str:
+        h, p = _parse_hostport(url)
+        return f"http://{h}:{p}{FABRIC_PATH_PREFIX}"
+
+    @property
+    def _control(self) -> str:
+        """Primary control endpoint (back-compat read surface)."""
+        with self._gw_lock:
+            return self._controls[self._primary]
+
+    def gateways(self) -> List[str]:
+        with self._gw_lock:
+            return list(self._controls)
+
+    def _learn_peers(self, ack: dict) -> None:
+        """Fold the gateway's advertised live-peer set into the failover
+        list — a worker pointed at ONE federated gateway learns the rest
+        from its first ack."""
+        peers = ack.get("peers")
+        if not isinstance(peers, list):
+            return
+        for u in peers:
+            try:
+                base = self._control_base(str(u))
+            except (TypeError, ValueError):
+                continue
+            with self._gw_lock:
+                if base not in self._controls:
+                    self._controls.append(base)
 
     def payload(self) -> dict:
         p = {"id": self.worker_id, "url": self.advertise_url,
@@ -935,30 +1371,63 @@ class WorkerAgent:
             p["tenants"] = tenants
         return p
 
-    def _post(self, op: str, payload: dict) -> None:
+    def _post(self, op: str, payload: dict) -> dict:
+        return self._post_to(self._control, op, payload)
+
+    def _post_to(self, control: str, op: str, payload: dict) -> dict:
         import urllib.request
 
         req = urllib.request.Request(
-            self._control + op, data=_json.dumps(payload).encode(),
+            control + op, data=_json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"}, method="POST")
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            r.read()
+            raw = r.read()
+        try:
+            ack = _json.loads(raw.decode())
+        except ValueError:
+            ack = {}
+        return ack if isinstance(ack, dict) else {}
 
     def beat(self) -> bool:
-        """One heartbeat. Returns True when the gateway acknowledged it;
-        False for a chaos-dropped or transport-failed beat (both benign:
-        the next beat retries and a healed partition rejoins)."""
+        """One heartbeat. Returns True when A gateway acknowledged it;
+        False for a chaos-dropped beat or when every known gateway is
+        unreachable (both benign: the next beat retries and a healed
+        partition rejoins). On primary-gateway failure the beat fails over
+        through the other known gateways with jittered backoff; the first
+        responder becomes the new primary."""
         hook = _HEARTBEAT_HOOK
         if hook is not None and not hook(self.worker_id):
             self.dropped += 1
             return False
-        try:
-            self._post("heartbeat", self.payload())
-        except Exception:  # noqa: BLE001 — gateway down != worker down
-            self.failed += 1
-            return False
-        self.sent += 1
-        return True
+        payload = self.payload()
+        with self._gw_lock:
+            primary = self._primary
+            order = [primary] + [i for i in range(len(self._controls))
+                                 if i != primary]
+            controls = list(self._controls)
+        for attempt, idx in enumerate(order):
+            if attempt:
+                # jittered backoff between failover attempts: a dead
+                # gateway rehomes a whole fleet at once, and the jitter
+                # spreads the stampede across the survivors
+                time.sleep(random.uniform(0.5, 1.5)
+                           * self.failover_backoff)
+            try:
+                ack = self._post_to(controls[idx], "heartbeat", payload)
+            except Exception:  # noqa: BLE001 — gateway down != worker down
+                continue
+            if idx != primary:
+                with self._gw_lock:
+                    self._primary = idx
+                self.failed_over += 1
+                record_failure("fabric.heartbeat_failover",
+                               worker=self.worker_id,
+                               gateway=controls[idx])
+            self.sent += 1
+            self._learn_peers(ack)
+            return True
+        self.failed += 1
+        return False
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -976,10 +1445,14 @@ class WorkerAgent:
         if self._thread is not None:
             self._thread.join(timeout=self.interval + self.timeout)
         if deregister:
-            try:
-                self._post("deregister", {"url": self.advertise_url})
-            except Exception:  # noqa: BLE001 — best-effort clean leave
-                pass
+            # best-effort clean leave, trying each known gateway once
+            for control in self.gateways():
+                try:
+                    self._post_to(control, "deregister",
+                                  {"url": self.advertise_url})
+                    break
+                except Exception:  # noqa: BLE001
+                    continue
 
     def __enter__(self) -> "WorkerAgent":
         return self.start()
@@ -1099,6 +1572,16 @@ class BroadcastError(RuntimeError):
     that the NEW version did not take, not that the fabric is mixed."""
 
 
+class CoordinatorDied(RuntimeError):
+    """The gateway coordinating a promotion broadcast died mid-round
+    (chaos ``kill_gateway``). The dead coordinator performs NO cleanup —
+    its thread unwinds with registries possibly mixed between staged and
+    committed. The round is NOT lost: its phase record is replicated
+    control-plane state, and a surviving peer's
+    :meth:`PromotionBroadcast.recover` reads it and drives the round to
+    commit or abort (never leaving workers split across versions)."""
+
+
 class PromotionBroadcast:
     """Two-phase fabric-wide promotion: one gate approval flips EVERY
     worker's registry to the same version, atomically per worker, with no
@@ -1118,24 +1601,56 @@ class PromotionBroadcast:
     BACKWARD instead: its stage is aborted and every already-committed
     worker rolls back — all workers on the OLD gate-approved version.
 
-    Single-coordinator, single-thread by design (the registries' swap locks
-    are owned by the calling thread between prepare and commit); the
-    coordinator itself dying mid-broadcast leaves each worker either fully
-    on the old version (staged-but-uncommitted prepares hold only a lock in
-    the dead coordinator's thread — their OLD handler never stopped
-    serving) or fully on the new one, which is exactly the per-worker
-    atomicity the chaos test kills against.
+    **Coordinator death** (federated mode): pass ``control`` (a
+    :class:`~synapseml_tpu.core.gossip.GossipState` — any publish/items/
+    entry surface) and ``alive`` (a liveness probe for the coordinating
+    gateway, e.g. ``gw.alive``). The round's phase then replicates as a
+    ``promo/<version>`` record at every 2PC transition (``preparing`` →
+    ``prepared`` → ``committed``/``aborted``), and the coordinator checks
+    ``alive()`` before each per-worker step — a chaos kill raises
+    :class:`CoordinatorDied` mid-round, leaving registries mixed between
+    staged (swap lock stranded in the dead thread) and committed. A
+    surviving peer holding the replicated record calls :meth:`recover`:
+    the ``prepared`` decision record drives the round FORWARD (adopt each
+    orphaned stage via :meth:`ModelRegistry.take_over_staged`, commit),
+    while a round still ``preparing`` converges BACKWARD (adopt + abort,
+    roll back any commits) — either way exactly one version serves
+    fabric-wide. Without ``control`` the single-coordinator behavior is
+    unchanged: per-worker atomicity is the chaos-tested floor.
     """
 
     def __init__(self, registries: Sequence[ModelRegistry],
-                 commit_retries: int = 1):
+                 commit_retries: int = 1, control=None,
+                 node_id: str = "coordinator",
+                 alive: Optional[Callable[[], bool]] = None):
         if not registries:
             raise ValueError("broadcast needs at least one registry")
         self.registries = list(registries)
         self.commit_retries = commit_retries
+        self.control = control
+        self.node_id = node_id
+        self.alive = alive
         self.broadcasts = 0
         self.aborted = 0
         self.rolled_back = 0
+        self.recoveries = 0
+
+    def _record_phase(self, version: str, phase: str) -> None:
+        if self.control is not None:
+            self.control.publish(
+                f"promo/{version}",
+                {"phase": phase, "version": version,
+                 "coordinator": self.node_id,
+                 "workers": len(self.registries)})
+
+    def _check_alive(self, version: str) -> None:
+        if self.alive is not None and not self.alive():
+            record_failure("gateway.broadcast_coordinator_died",
+                           version=version)
+            raise CoordinatorDied(
+                f"coordinating gateway died mid-broadcast of {version!r}; "
+                "a surviving peer must recover the round from its "
+                "replicated phase record")
 
     def active_versions(self) -> List[str]:
         return [r.active for r in self.registries]
@@ -1148,14 +1663,22 @@ class PromotionBroadcast:
                   warmup: bool = True) -> str:
         old = {id(r): r.active for r in self.registries}
         prepared: List[ModelRegistry] = []
+        self._record_phase(version, "preparing")
         try:
             for reg in self.registries:
+                self._check_alive(version)
                 reg.prepare(version, handler, warmup=warmup)
                 prepared.append(reg)
+        except CoordinatorDied:
+            # the dead coordinator does NO cleanup (its process is gone);
+            # the replicated "preparing" record tells a surviving peer to
+            # converge the round backward
+            raise
         except Exception as e:  # noqa: BLE001 — abort-all: old version holds
             for reg in prepared:
                 reg.abort()
             self.aborted += 1
+            self._record_phase(version, "aborted")
             record_failure("gateway.broadcast_aborted", version=version,
                            stage="prepare", error=type(e).__name__)
             raise BroadcastError(
@@ -1163,9 +1686,14 @@ class PromotionBroadcast:
                 f"{len(prepared)}/{len(self.registries)} "
                 f"({type(e).__name__}: {e}); every worker is still on its "
                 "old version") from e
+        # every worker is staged: the 2PC decision point. The replicated
+        # "prepared" record IS the commit decision — a surviving peer that
+        # reads it drives the round forward even if we die on the next line
+        self._record_phase(version, "prepared")
         committed: List[ModelRegistry] = []
         failed: List[ModelRegistry] = []
         for reg in self.registries:
+            self._check_alive(version)     # CoordinatorDied mid-commit
             for attempt in range(1 + self.commit_retries):
                 try:
                     reg.commit(version)
@@ -1179,6 +1707,7 @@ class PromotionBroadcast:
                         failed.append(reg)
         if not failed:
             self.broadcasts += 1
+            self._record_phase(version, "committed")
             record_failure("gateway.broadcast_completed", version=version,
                            workers=len(self.registries))
             return version
@@ -1195,11 +1724,95 @@ class PromotionBroadcast:
                 record_failure("gateway.broadcast_rollback_failed",
                                version=version)
         self.rolled_back += 1
+        self._record_phase(version, "aborted")
         record_failure("gateway.broadcast_rolled_back", version=version,
                        failed=len(failed))
         raise BroadcastError(
             f"commit of {version!r} failed on {len(failed)} worker(s); "
             "fabric rolled back to the old version")
+
+    # -- surviving-peer recovery -----------------------------------------
+    def in_doubt(self) -> Optional[Tuple[str, str]]:
+        """(version, phase) of the newest round left in doubt by a dead
+        coordinator — phase ``preparing`` or ``prepared`` — else None."""
+        if self.control is None:
+            return None
+        pending = []
+        for key, rec in self.control.items("promo/").items():
+            if rec.get("phase") in ("preparing", "prepared"):
+                entry = self.control.entry(key)
+                pending.append((entry.epoch if entry is not None else 0,
+                                str(rec.get("version", "")),
+                                str(rec["phase"])))
+        if not pending:
+            return None
+        _, version, phase = max(pending)
+        return version, phase
+
+    def recover(self) -> Optional[Tuple[str, str]]:
+        """Drive a dead coordinator's in-doubt round to its end from the
+        replicated phase record; returns ``(version, outcome)`` with
+        outcome ``"committed"`` or ``"aborted"``, or None when no round
+        needs recovery. Called by a surviving peer gateway (same registry
+        set, converged control plane). A ``prepared`` record means every
+        worker staged and the decision to commit was made: adopt each
+        orphaned stage (:meth:`ModelRegistry.take_over_staged` — legal
+        only because the owning thread is dead) and commit it. A round
+        still ``preparing`` never decided: abort every stage and roll
+        back any stray commit. Either way the fabric ends on exactly one
+        version, and the final phase replicates so other survivors do not
+        re-recover the same round."""
+        pending = self.in_doubt()
+        if pending is None:
+            return None
+        version, phase = pending
+        record_failure("gateway.broadcast_recovery", version=version,
+                       phase=phase)
+        if phase == "prepared":
+            outcome = self._recover_forward(version)
+        else:
+            outcome = self._recover_backward(version)
+        self.recoveries += 1
+        self._record_phase(version, outcome)
+        record_failure("gateway.broadcast_recovered", version=version,
+                       outcome=outcome)
+        return version, outcome
+
+    def _recover_forward(self, version: str) -> str:
+        stranded: List[ModelRegistry] = []
+        for reg in self.registries:
+            if reg.active == version:
+                continue            # the coordinator committed this one
+            try:
+                if reg.take_over_staged():
+                    reg.commit(version)
+                else:
+                    stranded.append(reg)    # no stage, not active
+            except Exception:  # noqa: BLE001 — converge backward below
+                stranded.append(reg)
+        if not stranded:
+            self.broadcasts += 1
+            return "committed"
+        return self._recover_backward(version)
+
+    def _recover_backward(self, version: str) -> str:
+        for reg in self.registries:
+            try:
+                if reg.take_over_staged():
+                    reg.abort()
+            except Exception:  # noqa: BLE001 — a live owner keeps its lock
+                record_failure("gateway.broadcast_recovery_skip",
+                               version=version)
+            if reg.active == version:
+                # committed before the coordinator died: roll back so the
+                # fabric converges on the OLD gate-approved version
+                try:
+                    reg.rollback()
+                except Exception:  # noqa: BLE001
+                    record_failure("gateway.broadcast_rollback_failed",
+                                   version=version)
+        self.aborted += 1
+        return "aborted"
 
 
 class DistributedServingServer:

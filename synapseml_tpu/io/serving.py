@@ -795,6 +795,10 @@ class ModelRegistry:
         # on one owning thread
         self._swap_lock = threading.RLock()
         self._staged: Optional[tuple] = None   # (version, handler) prepared
+        # the thread holding the swap lock across a prepare window — read
+        # by take_over_staged to prove the coordinator is DEAD before a
+        # surviving peer adopts its orphaned stage
+        self._swap_owner: Optional[threading.Thread] = None
         initial = (server.handler if tenant is None
                    else server.handler_for(tenant))
         self.versions: Dict[str, Callable] = {version: initial}
@@ -813,6 +817,8 @@ class ModelRegistry:
         if not self._swap_lock.acquire(blocking=False):
             record_failure("serving.swap_conflict", tenant=self.tenant)
             raise SwapError("swap in progress")
+        with self._lock:
+            self._swap_owner = threading.current_thread()
         if self._staged is not None:
             # the lock is reentrant (prepare -> commit on one thread), so a
             # same-thread single-shot swap racing an open prepare window
@@ -929,6 +935,41 @@ class ModelRegistry:
         _swap_point("done", staged_version)
         self._prune()
         return staged_version
+
+    def take_over_staged(self) -> bool:
+        """Adopt an orphaned prepare window after its coordinator died.
+
+        A prepare holds the swap RLock in the COORDINATOR's thread; if that
+        thread dies mid-broadcast the stage is stranded — an RLock can
+        never be released by another thread, so a surviving peer could
+        neither :meth:`commit` nor :meth:`abort`. This transfers ownership:
+        only when the owning thread is provably dead (``is_alive()`` is
+        False), the abandoned lock object is REPLACED with a fresh one
+        acquired by the caller, who may then drive the staged version to
+        commit or abort exactly as the coordinator would have. A live
+        owner raises :class:`SwapError` — takeover is recovery, never
+        preemption. Returns False when nothing is staged (the coordinator
+        finished or never prepared here); True when the caller now owns
+        the stage (idempotent for the owner itself)."""
+        with self._lock:
+            staged = self._staged
+            owner = self._swap_owner
+        if staged is None:
+            return False
+        if owner is threading.current_thread():
+            return True
+        if owner is not None and owner.is_alive():
+            raise SwapError(
+                f"staged swap to {staged[0]!r} is owned by live thread "
+                f"{owner.name!r}; takeover requires a dead coordinator")
+        fresh = threading.RLock()
+        fresh.acquire()
+        with self._lock:
+            self._swap_lock = fresh
+            self._swap_owner = threading.current_thread()
+        record_failure("serving.swap_takeover", version=staged[0],
+                       tenant=self.tenant)
+        return True
 
     def abort(self) -> bool:
         """Discard a prepared version and release the swap lock; the old

@@ -220,6 +220,32 @@ class PromotionGate:
         record_failure("online.gate_promoted", version=ckpt.version)
         return decision
 
+    def recover_broadcast(self) -> Optional[str]:
+        """Drive a DEAD coordinator's in-doubt promotion round to its end
+        (federated fabric): delegates to
+        :meth:`~synapseml_tpu.io.distributed_serving.PromotionBroadcast.
+        recover`, which reads the replicated 2PC phase record and converges
+        every worker on exactly one version. A recovered COMMIT joins
+        ``approved_versions`` — the round's prepare record only exists
+        because the dead coordinator's gate approved the candidate, and the
+        chaos invariant checks served versions against the survivor's gate.
+        Returns the outcome (``"committed"``/``"aborted"``) or None when
+        there is nothing to recover."""
+        recover = getattr(self.broadcast, "recover", None)
+        if recover is None:
+            return None
+        recovered = recover()
+        if recovered is None:
+            return None
+        version, outcome = recovered
+        if outcome == "committed":
+            with self._lock:
+                self.approved_versions.add(version)
+                self.promotions += 1
+        record_failure("online.broadcast_recovered", version=version,
+                       outcome=outcome)
+        return outcome
+
     # -- post-promotion live watchdog --
     def observe_live(self, reward: float) -> bool:
         """Feed one post-promotion LIVE reward. Once the regression window

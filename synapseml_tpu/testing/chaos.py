@@ -764,6 +764,100 @@ class chaos_heartbeat_partition:
         _ds._HEARTBEAT_HOOK = None
 
 
+def kill_gateway(gateway) -> None:
+    """Hard-kill a ServingGateway like a process crash: the public
+    listener closes immediately (in-flight forwards break back to their
+    clients as connection errors), the gossip replicator stops (its
+    liveness entry stops advancing, so peers declare it dead after
+    ``peer_timeout`` and rehash its ring arcs; its leases expire after
+    ``lease_ttl``), and ``gateway.alive()`` flips False — a
+    :class:`~synapseml_tpu.io.distributed_serving.PromotionBroadcast` it
+    was coordinating dies mid-round with
+    :class:`~synapseml_tpu.io.distributed_serving.CoordinatorDied`,
+    leaving the recovery to a surviving peer. No farewell of any kind is
+    sent: peers and workers must discover the death the hard way, which
+    is exactly what this primitive exists to exercise. Idempotent."""
+    gateway._killed.set()
+    gateway._repl_stop.set()
+    if gateway._httpd is not None:
+        try:
+            gateway._httpd.shutdown()
+            gateway._httpd.server_close()
+        except OSError:
+            pass
+
+
+class chaos_control_plane_partition:
+    """Context manager partitioning the gateways' REPLICATED control plane
+    (gossip anti-entropy exchanges) while leaving data paths and worker
+    heartbeats intact — the split-brain case: every gateway keeps serving
+    from its last converged state while membership/lease/promotion updates
+    stop flowing between the partitioned sides.
+
+    Installs the ``io.distributed_serving._GOSSIP_HOOK`` consulted by every
+    replicator before each exchange with ``(source_gateway_id, peer_url)``;
+    a partitioned exchange is dropped (never dialed). Deterministic
+    control, combinable:
+
+    * ``gateway_ids`` — only exchanges ORIGINATED by these gateways are
+      affected (default: all). One-sided partitions fall out of listing a
+      single side.
+    * ``partition()`` / ``heal()`` — explicit toggle (starts partitioned);
+      after heal the next exchanges re-converge the fabric (anti-entropy
+      is idempotent, so nothing is lost — replication lag just drains).
+    * ``schedule`` — a :class:`ChaosSchedule` consulted per exchange while
+      partitioned; any non-"ok" outcome drops it (flaky control plane).
+
+    ``dropped`` records every dropped (gateway_id, peer_url) pair for
+    assertions. Nesting is not supported (single global hook)."""
+
+    def __init__(self, gateway_ids: Optional[Sequence[str]] = None,
+                 schedule: Optional[ChaosSchedule] = None,
+                 partitioned: bool = True):
+        self.gateway_ids = set(gateway_ids) \
+            if gateway_ids is not None else None
+        self.schedule = schedule
+        self._partitioned = partitioned
+        self.dropped: List[Tuple[str, str]] = []
+        self._lock = threading.Lock()
+
+    def partition(self) -> None:
+        with self._lock:
+            self._partitioned = True
+
+    def heal(self) -> None:
+        with self._lock:
+            self._partitioned = False
+
+    def _hook(self, gateway_id: str, peer_url: str) -> bool:
+        """True = let the exchange through; False = drop it."""
+        with self._lock:
+            if not self._partitioned:
+                return True
+            if self.gateway_ids is not None and \
+                    gateway_id not in self.gateway_ids:
+                return True
+            if self.schedule is not None and \
+                    self.schedule.next_outcome() == "ok":
+                return True
+            self.dropped.append((gateway_id, peer_url))
+            return False
+
+    def __enter__(self) -> "chaos_control_plane_partition":
+        from ..io import distributed_serving as _ds
+
+        if _ds._GOSSIP_HOOK is not None:
+            raise RuntimeError(
+                "chaos_control_plane_partition does not nest")
+        _ds._GOSSIP_HOOK = self._hook
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from ..io import distributed_serving as _ds
+
+        _ds._GOSSIP_HOOK = None
+
+
 class ChaosSwap:
     """Context manager killing a model hot-swap at a chosen stage — the
     deterministic stand-in for "the process handling the swap hit a bug /

@@ -111,6 +111,36 @@ class TestMembership:
         t[0] = 102.0
         assert m.expired() == ["w1"]
 
+    def test_rejoin_racing_lazy_eviction_keeps_membership(self):
+        # the expired()+evict() two-step has a gap: a member whose rejoin
+        # beat lands between the read and the act must NOT be evicted —
+        # evict_if_expired re-checks staleness under the lock
+        t = [0.0]
+        m = Membership(timeout=1.0, clock=lambda: t[0])
+        m.beat("w1")
+        t[0] = 2.5
+        assert m.expired() == ["w1"]          # sweep candidate captured
+        m.beat("w1")                          # rejoin races, same tick
+        assert m.evict_if_expired("w1") is False
+        assert m.alive("w1") and m.evictions == 0
+        # a member still genuinely overdue evicts as before
+        t[0] = 5.0
+        assert m.expired() == ["w1"]
+        assert m.evict_if_expired("w1") is True
+        assert not m.alive("w1") and m.evictions == 1
+        # the unconditional evict (voluntary deregister) ignores freshness
+        assert m.beat("w1") == "rejoin"
+        assert m.evict("w1") is True
+
+    def test_evict_if_expired_skips_static_and_absent(self):
+        t = [0.0]
+        m = Membership(timeout=1.0, clock=lambda: t[0])
+        m.beat("static", static=True)
+        t[0] = 100.0
+        assert m.evict_if_expired("static") is False   # static never lazy
+        assert m.evict_if_expired("ghost") is False    # unknown member
+        assert m.alive("static")
+
     def test_snapshot_carries_info_and_counters(self):
         t = [0.0]
         m = Membership(timeout=5.0, clock=lambda: t[0])
@@ -161,6 +191,31 @@ class TestGatewayMembership:
                 assert gw.stats["deregistered"] == 1
             finally:
                 gw.stop()
+
+    def test_rejoin_racing_gateway_sweep_keeps_link_and_affinity(self):
+        # gateway-level twin of the Membership race: a worker whose
+        # heartbeat lands between the sweep's expired() read and the evict
+        # keeps its link AND its shape-affinity pins
+        t = [0.0]
+        gw = ServingGateway(["http://127.0.0.1:9"],   # static placeholder
+                            heartbeat_timeout=1.0, clock=lambda: t[0])
+        url = "http://127.0.0.1:19999"
+        gw.register_worker(url, queue_depth=0)        # dynamic member
+        gw._pin_affinity(("s", (4, 2)), url)
+        t[0] = 2.5
+        assert gw.membership.expired() == [url]       # sweep candidate
+        gw.register_worker(url, queue_depth=1)        # rejoin, same tick
+        assert gw._evict(url, reason="evicted",
+                         only_if_expired=True) is False
+        assert any(l.url == url for l in gw.links)
+        assert gw._affinity.get(("s", (4, 2))) == url
+        assert gw.stats["evicted"] == 0
+        # genuinely overdue: the sweep evicts and drops the affinity pin
+        t[0] = 5.0
+        gw._sweep_expired()
+        assert not any(l.url == url for l in gw.links)
+        assert ("s", (4, 2)) not in gw._affinity
+        assert gw.stats["evicted"] == 1
 
     def test_static_workers_without_heartbeats_are_never_evicted(self):
         with FlakyHTTPServer() as backend:

@@ -37,6 +37,7 @@ DESCRIPTION = ("module/instance state written both under and outside a lock "
 
 SCOPE = ("synapseml_tpu/io/serving.py",
          "synapseml_tpu/io/distributed_serving.py",
+         "synapseml_tpu/core/gossip.py",
          "synapseml_tpu/core/resilience.py",
          "synapseml_tpu/core/logging.py",
          "synapseml_tpu/core/perfmodel.py",

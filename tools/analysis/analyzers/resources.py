@@ -37,6 +37,7 @@ SCOPE = ("synapseml_tpu/io/serving.py",
          "synapseml_tpu/io/ingest.py",
          "synapseml_tpu/io/portforward.py",
          "synapseml_tpu/core/fabric.py",
+         "synapseml_tpu/core/gossip.py",
          "synapseml_tpu/core/perfmodel.py",
          "synapseml_tpu/core/qos.py",
          "synapseml_tpu/online/",

@@ -2220,6 +2220,107 @@ def bench_dl_overlap_pipeline(epochs=3, trials=3):
                           parity <= 1e-5}}
 
 
+def bench_automl_elastic(rows=1200, cols=10, folds=6):
+    """Elastic successive-halving AutoML vs exhaustive CV (docs/automl.md).
+
+    Three arms over the same 12-candidate LightGBM regression grid:
+    ``exhaustive`` (every candidate × every fold — the pre-bracket searcher),
+    ``halving`` (eta=3 rung ladder: 12×1 + 4×2 + 2×3 = 26 fold-fits, 36% of
+    72), and ``halving_elastic`` (the same bracket with the full resilience
+    stack on: checkpointed bracket state + per-candidate records + budget
+    reaper). Guards: the bracket's winner stays within 2% of the exhaustive
+    best while spending ≤40% of its fold-fit time, and the resilience stack
+    costs ≤1.5× the bare bracket's wall clock. The elastic arm journals one
+    structured "automl_rung" perfmodel row per rung task, so the learned
+    model starts pricing candidate budgets and promotion quotas from real
+    observations."""
+    import shutil
+    import tempfile
+
+    from synapseml_tpu.automl import TuneHyperparameters
+    from synapseml_tpu.automl.hyperparams import (DiscreteHyperParam,
+                                                  HyperparamBuilder)
+    from synapseml_tpu.automl.scheduler import plan_rungs
+    from synapseml_tpu.core import perfmodel
+    from synapseml_tpu.core.table import Table
+    from synapseml_tpu.models import LightGBMRegressor
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    y = (2.0 * X[:, 0] - X[:, 1] + 0.1 * rng.normal(size=rows)
+         ).astype(np.float32)
+    df = Table({"features": X, "label": y})
+
+    fit_s = [0.0]
+
+    class TimedRegressor(LightGBMRegressor):
+        def _fit(self, d):
+            t0 = time.perf_counter()
+            try:
+                return LightGBMRegressor._fit(self, d)
+            finally:
+                fit_s[0] += time.perf_counter() - t0
+
+    space = (HyperparamBuilder()
+             .addHyperparam("numLeaves", DiscreteHyperParam([3, 7, 15, 31]))
+             .addHyperparam("learningRate",
+                            DiscreteHyperParam([0.05, 0.1, 0.3]))
+             .build())
+
+    def run(halving_eta, ckpt="", **kw):
+        fit_s[0] = 0.0
+        t0 = time.perf_counter()
+        m = TuneHyperparameters(
+            model=TimedRegressor(numIterations=8), paramSpace=space,
+            searchMode="grid", numFolds=folds, evaluationMetric="rmse",
+            labelCol="label", parallelism=2, halvingEta=halving_eta,
+            minResourceFolds=1, checkpointDir=ckpt, **kw).fit(df)
+        return {"best_rmse": round(float(m.bestMetric), 5),
+                "best_params": m.bestParams,
+                "wall_s": round(time.perf_counter() - t0, 3),
+                "fit_s": round(fit_s[0], 3)}
+
+    exhaustive = run(0)
+    halving = run(3)
+    ck = tempfile.mkdtemp(prefix="bench_automl_ck_")
+    rows_before = len(perfmodel.training_rows("automl_rung"))
+    try:
+        elastic = run(3, ckpt=ck, candidateBudgetSeconds=120.0,
+                      perfJournal=True)
+    finally:
+        shutil.rmtree(ck, ignore_errors=True)
+    rung_rows = perfmodel.training_rows("automl_rung")[rows_before:]
+    per_rung = {}
+    for r in rung_rows:
+        per_rung[str(r.get("rung"))] = per_rung.get(str(r.get("rung")), 0) + 1
+
+    regret = abs(halving["best_rmse"] - exhaustive["best_rmse"]) / max(
+        abs(exhaustive["best_rmse"]), 1e-12)
+    fit_ratio = halving["fit_s"] / max(exhaustive["fit_s"], 1e-9)
+    ladder = plan_rungs(12, folds, eta=3, min_resource=1)
+    spent, prev = 0, 0
+    for r in ladder:
+        spent += r.survivors * (r.resource - prev)
+        prev = r.resource
+    elastic_overhead = elastic["wall_s"] / max(halving["wall_s"], 1e-9)
+    return {"metric": "automl_halving_fit_time_vs_exhaustive",
+            "platform": "cpu",  # host-side scheduling economics, chip-free
+            "value": round(fit_ratio, 3),
+            "unit": ("x (halving fold-fit seconds / exhaustive fold-fit "
+                     "seconds, 12-candidate LightGBM grid, 6-fold CV, "
+                     "eta=3)"),
+            "best_regret": round(regret, 5),
+            "planned_fold_fits": {"halving": spent, "exhaustive": 12 * folds},
+            "elastic_overhead_x": round(elastic_overhead, 3),
+            "perf_rows_per_rung": per_rung,
+            "arms": {"exhaustive": exhaustive, "halving": halving,
+                     "halving_elastic": elastic},
+            "guard": {"halving_best_within_2pct": regret <= 0.02,
+                      "halving_fit_time_le_40pct": fit_ratio <= 0.40,
+                      "elastic_overhead_le_1p5x": elastic_overhead <= 1.5,
+                      "rung_rows_journaled": len(rung_rows) >= spent // 2}}
+
+
 def _extra_workloads():
     bench_onnx_bf16 = functools.partial(bench_onnx_inference,
                                         precision="bfloat16")
@@ -2238,6 +2339,7 @@ def _extra_workloads():
            bench_dl_overlap_pipeline, bench_oocore_gbdt,
            bench_oocore_gbdt_mesh,
            bench_checkpoint_overhead, bench_elastic_recovery,
+           bench_automl_elastic,
            bench_online_learning)
     return {f.__name__: f for f in fns}
 
@@ -2290,7 +2392,8 @@ def main():
         _ONLY_MODE[0] = only
     if only in ("bench_voting_ab", "bench_distributed_gbdt_auto",
                 "bench_dl_sharded", "bench_dl_overlap_pipeline",
-                "bench_elastic_recovery", "bench_oocore_gbdt_mesh"):
+                "bench_elastic_recovery", "bench_oocore_gbdt_mesh",
+                "bench_automl_elastic"):
         # mesh/host workloads: virtual 8-device CPU mesh regardless of the
         # chip (the metrics are same-platform ratios or host-side recovery
         # latencies). Must be set before the

@@ -272,6 +272,38 @@ echo "== elastic training guard (kill/hang a rank -> detect, agree, reshard, res
 # file unfiltered so the slow multi-process leg stays covered here
 JAX_PLATFORMS=cpu python -m pytest -x -q tests/test_elastic.py
 
+echo "== automl elastic guard (preemptible successive-halving on the gang) =="
+# the chaos battery behind docs/automl.md: seeded crash/hang/NaN/slowdown
+# per candidate, kill->resume to the IDENTICAL best model, hung candidates
+# reaped within budget, duplicate candidates computed once, fingerprint
+# refusal on changed data, and the spool-worker gang (kill_rank -> respawn
+# + re-spool) — runs the file unfiltered so the subprocess gang leg stays
+# covered here
+JAX_PLATFORMS=cpu python -m pytest -x -q tests/test_automl_elastic.py
+JAX_PLATFORMS=cpu python - << 'EOF'
+# halving economics (ISSUE 17 acceptance): the bracket's winner must stay
+# within 2% of the exhaustive-CV best while spending <= 40% of its fold-fit
+# time, the full resilience stack (checkpoints + budget reaper) must cost
+# <= 1.5x the bare bracket, and the elastic arm must journal structured
+# "automl_rung" perfmodel rows per rung
+import json, subprocess, sys
+out = subprocess.run([sys.executable, "bench.py", "--only",
+                      "bench_automl_elastic"],
+                     capture_output=True, text=True, check=True).stdout
+rec = json.loads(out.strip().splitlines()[-1])
+print(f"halving fit time {rec['value']}x exhaustive "
+      f"(regret {rec['best_regret']}, elastic overhead "
+      f"{rec['elastic_overhead_x']}x, rows/rung {rec['perf_rows_per_rung']})")
+assert rec["guard"]["halving_best_within_2pct"], \
+    f"halving winner regressed >2% vs exhaustive: {rec}"
+assert rec["guard"]["halving_fit_time_le_40pct"], \
+    f"halving spent >40% of exhaustive fold-fit time: {rec}"
+assert rec["guard"]["elastic_overhead_le_1p5x"], \
+    f"resilience stack costs >1.5x the bare bracket: {rec}"
+assert rec["guard"]["rung_rows_journaled"], \
+    f"elastic arm journaled too few automl_rung perf rows: {rec}"
+EOF
+
 echo "== multi-tenant guard (per-tenant QoS isolation + atomic broadcast) =="
 # the chaos battery behind docs/resilience.md "Multi-tenant fleet": runs the
 # file UNFILTERED so the slow noisy-neighbor leg (3 tenants x 2 workers,

@@ -2,19 +2,31 @@
 
 Reference: core/.../explainers/{LeastSquaresRegression,LassoRegression,
 RegressionBase}.scala — per-row Breeze solves on executors (SURVEY §2.1 N9).
-Here every row's local regression is solved in ONE vmapped, jitted XLA call:
+Here every row's local regression is solved in ONE vmapped XLA call:
 (R rows) × (S samples, D features[, K targets]) → (R, D, K) coefficients, so a
 whole DataFrame's explanations become a single batched linear-algebra program
 on the MXU instead of R driver-side solves.
+
+The batch dimension R is request-sized (however many rows the caller asked to
+explain), so the solves dispatch through
+:class:`core.inference.BucketedRunner` — one compile per ladder *bucket*
+instead of one per observed R, the same shape-stability contract every
+serving surface follows (docs/serving-perf.md). Runners are cached per
+static configuration (``("lstsq", ridge)`` / ``("lasso", iters)``); the
+per-row ``lam`` rides as a batch-leading array input, padded with the other
+operands.
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import NamedTuple
+import threading
+from typing import Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from ..core.inference import BucketedRunner
 
 
 class FitResult(NamedTuple):
@@ -76,26 +88,64 @@ def _lasso_single(X, y, w, lam: float, iters: int = 200):
     return FitResult(beta, intercept, _weighted_r2(X, y, w, beta, intercept))
 
 
-@partial(jax.jit, static_argnames=("ridge",))
-def batched_lstsq(X, y, w, ridge: float = 1e-6):
-    """vmapped weighted LS: X (R,S,D), y (R,S,K), w (R,S) → FitResult batched."""
-    return jax.vmap(lambda a, b, c: _lstsq_single(a, b, c, ridge))(X, y, w)
+# --- bucketed dispatch -------------------------------------------------------
+# one runner per static solver configuration; the runner owns the jit
+# boundary (its fns are NOT pre-jitted) and compiles once per R-bucket
+
+_MAX_ROWS_PER_CHUNK = 128
+_runner_lock = threading.Lock()
+_runners: Dict[Tuple, BucketedRunner] = {}
 
 
-@partial(jax.jit, static_argnames=("iters",))
-def batched_lasso(X, y, w, lam, iters: int = 200):
-    """vmapped weighted lasso; lam scalar or (R,)."""
-    lam = jnp.broadcast_to(jnp.asarray(lam, X.dtype), (X.shape[0],))
-    return jax.vmap(lambda a, b, c, l: _lasso_single(a, b, c, l, iters))(X, y, w, lam)
+def _runner(kind: str, static) -> BucketedRunner:
+    key = (kind, static)
+    with _runner_lock:
+        runner = _runners.get(key)
+        if runner is None:
+            if kind == "lstsq":
+                def fn(X, y, w, _ridge=static):
+                    return jax.vmap(
+                        lambda a, b, c: _lstsq_single(a, b, c, _ridge)
+                    )(X, y, w)
+            else:
+                def fn(X, y, w, lam, _iters=static):
+                    return jax.vmap(
+                        lambda a, b, c, l: _lasso_single(a, b, c, l, _iters)
+                    )(X, y, w, lam)
+            runner = BucketedRunner(fn, max_batch_size=_MAX_ROWS_PER_CHUNK,
+                                    name=f"explainer_{kind}")
+            _runners[key] = runner
+        return runner
+
+
+def solver_stats() -> Dict[str, dict]:
+    """Per-runner compile/hit counters (observability for the recompile
+    guard: steady-state explanations must not compile)."""
+    with _runner_lock:
+        return {f"{k[0]}:{k[1]}": r.stats() for k, r in _runners.items()}
+
+
+def batched_lstsq(X, y, w, ridge: float = 1e-6) -> FitResult:
+    """Bucketed vmapped weighted LS: X (R,S,D), y (R,S,K), w (R,S) →
+    FitResult batched over R (numpy leaves)."""
+    return _runner("lstsq", float(ridge))(
+        np.asarray(X, np.float32), np.asarray(y, np.float32),
+        np.asarray(w, np.float32))
+
+
+def batched_lasso(X, y, w, lam, iters: int = 200) -> FitResult:
+    """Bucketed vmapped weighted lasso; lam scalar or (R,)."""
+    X = np.asarray(X, np.float32)
+    lam_arr = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(lam, np.float32), (X.shape[0],)))
+    return _runner("lasso", int(iters))(
+        X, np.asarray(y, np.float32), np.asarray(w, np.float32), lam_arr)
 
 
 def solve_batched(X, y, w, regularization: float = 0.0) -> FitResult:
     """Dispatch: lasso when regularization > 0, else (near-)OLS — mirroring
-    LIMEBase's regParam semantics. Host-facing: accepts numpy, returns device
-    arrays."""
-    X = jnp.asarray(X, jnp.float32)
-    y = jnp.asarray(y, jnp.float32)
-    w = jnp.asarray(w, jnp.float32)
+    LIMEBase's regParam semantics. Host-facing: accepts numpy, returns numpy
+    (dispatched through the bucket ladder)."""
     if regularization > 0.0:
         return batched_lasso(X, y, w, regularization)
     return batched_lstsq(X, y, w)

@@ -333,6 +333,19 @@ class CollectiveWatchdog:
         return box["out"]
 
 
+def run_with_budget(fn: Callable, *args, budget_s: float,
+                    op: str = "task", **kwargs):
+    """One-shot :meth:`CollectiveWatchdog.run` without peer heartbeats: run
+    ``fn`` on a reaped daemon thread and raise :class:`PeerLostError`
+    (``lost=[]``) once ``budget_s`` elapses. The straggler extension is
+    disabled (no monitor means no evidence the task is merely slow), so the
+    budget is hard — this is the hang-reaper the elastic AutoML scheduler
+    wraps every candidate fit in: the abandoned thread cannot wedge the
+    pool, and the caller scores the reaped work NaN instead of waiting."""
+    return CollectiveWatchdog(timeout=budget_s, straggler_factor=1.0).run(
+        fn, *args, op=op, **kwargs)
+
+
 # --- global watchdog registry (training loops + collectives consult it) -----
 
 _CURRENT: Optional[CollectiveWatchdog] = None

@@ -16,28 +16,19 @@ Reference: recommendation/SAR.scala:36-210 and SARModel.scala. Semantics kept:
 
 from __future__ import annotations
 
-import functools
 from datetime import datetime, timezone
 from typing import Optional
 
 import numpy as np
 
+from ..core.inference import BucketedRunner
 from ..core.params import Param, Params
 from ..core.pipeline import Estimator, Model
 from ..core.table import Table
 
 _SIMS = ("cooccurrence", "jaccard", "lift")
 
-
-@functools.lru_cache(maxsize=1)
-def _jit_matmul():
-    """jax.jit keys its compile cache on the wrapper object, so building
-    ``jax.jit(jnp.matmul)`` inside ``_scores`` recompiled the product on
-    every scoring call; the cached wrapper compiles once per shape."""
-    import jax
-    import jax.numpy as jnp
-
-    return jax.jit(jnp.matmul)
+_MAX_USERS_PER_CHUNK = 256
 
 
 class _SARParams(Params):
@@ -119,17 +110,35 @@ class SARModel(Model, _SARParams):
         return Table({self.getUserCol(): np.arange(aff.shape[0]),
                       "flatList": aff})
 
+    def _score_runner(self) -> BucketedRunner:
+        """Per-model cached :class:`BucketedRunner` over user rows: the
+        similarity matrix rides as a closed-over device constant, the
+        request-sized user dimension pads to the bucket ladder so scoring
+        compiles once per bucket, not once per distinct query size."""
+        sim_np = self.get("itemSimilarity")
+        cached = getattr(self, "_runner_cache", None)
+        if cached is not None and cached[0] is sim_np:
+            return cached[1]
+        import jax.numpy as jnp
+
+        sim = jnp.asarray(sim_np)
+        runner = BucketedRunner(lambda aff: aff @ sim,
+                                max_batch_size=_MAX_USERS_PER_CHUNK,
+                                name="sar_scores")
+        self._runner_cache = (sim_np, runner)
+        return runner
+
     def _scores(self, users: Optional[np.ndarray] = None) -> np.ndarray:
         """affinity[users] @ similarity — only the requested user rows are
         multiplied (the full [U,I]·[I,I] product is never materialized for
         subset queries)."""
-        import jax.numpy as jnp
-
         aff = self.get("userAffinity")
         if users is not None:
             aff = aff[users]
-        sim = jnp.asarray(self.get("itemSimilarity"))
-        return np.asarray(_jit_matmul()(jnp.asarray(aff), sim))
+        aff = np.asarray(aff, dtype=np.float32)
+        if aff.shape[0] == 0:
+            return np.zeros((0, self.get("itemSimilarity").shape[0]), np.float32)
+        return np.asarray(self._score_runner()(aff))
 
     def _transform(self, df: Table) -> Table:
         """Score (user, item) pairs — predicted rating column."""

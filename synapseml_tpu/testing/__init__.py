@@ -14,17 +14,18 @@ from .fuzzing import (TestObject, discover_stage_classes,
 from .benchmarks import Benchmarks
 from .chaos import (ChaosHTTP, ChaosPreemption, ChaosSchedule, ChaosSwap,
                     FaultInjected, FlakyHTTPServer, bit_flip,
-                    canned_json_responder, chaos_chunk_stream,
-                    chaos_collectives, chaos_hang, chaos_nan_batches,
-                    chaos_reward_stream, chaos_tenant_flood,
-                    chaotic_handler, kill_rank, torn_write)
+                    canned_json_responder, chaos_candidate,
+                    chaos_chunk_stream, chaos_collectives, chaos_hang,
+                    chaos_nan_batches, chaos_reward_stream,
+                    chaos_tenant_flood, chaotic_handler, kill_rank,
+                    torn_write)
 
 __all__ = [
     "TestObject", "discover_stage_classes", "experiment_fuzz",
     "getter_setter_fuzz", "serialization_fuzz", "Benchmarks",
     "ChaosHTTP", "ChaosPreemption", "ChaosSchedule", "ChaosSwap",
     "FaultInjected", "FlakyHTTPServer", "bit_flip", "canned_json_responder",
-    "chaos_chunk_stream", "chaos_collectives", "chaos_hang",
+    "chaos_candidate", "chaos_chunk_stream", "chaos_collectives", "chaos_hang",
     "chaos_nan_batches", "chaos_reward_stream", "chaos_tenant_flood",
     "chaotic_handler", "kill_rank", "torn_write",
 ]

@@ -1,0 +1,452 @@
+"""Elastic AutoML: preemptible successive-halving search on the training gang.
+
+The invariant this file proves (docs/automl.md "resume contract"): a
+checkpointed search — even one with seeded chaos injecting crashes, hangs,
+NaN metrics and slowdowns per candidate — that is killed mid-bracket and
+resumed converges to the IDENTICAL best params/metric as the same search run
+uninterrupted, and no hung candidate can stall the pool past its budget.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.automl.scheduler import (ElasticHalvingScheduler,
+                                            GangCandidatePool, plan_rungs)
+from synapseml_tpu.core.checkpoint import PreemptionError
+from synapseml_tpu.core.logging import failure_counts, reset_failure_counts
+from synapseml_tpu.testing import ChaosPreemption, chaos_candidate
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    reset_failure_counts()
+    yield
+    reset_failure_counts()
+
+
+def _tune_fixtures():
+    from synapseml_tpu.core.params import Param
+    from synapseml_tpu.core.pipeline import Estimator, Model
+
+    fits = []
+
+    class ConstModel(Model):
+        const = Param("const", "constant prediction", float, 0.0)
+
+        def _transform(self, df):
+            return df.with_column(
+                "prediction", np.full(df.num_rows, float(self.const)))
+
+    class ConstEstimator(Estimator):
+        const = Param("const", "constant", float, 0.0)
+        crash = Param("crash", "raise on fit", bool, False)
+        hang = Param("hang", "sleep through the budget on fit", bool, False)
+
+        def _fit(self, df):
+            fits.append(float(self.const))
+            if self.crash:
+                raise RuntimeError("deliberate candidate crash")
+            if self.hang:
+                time.sleep(5.0)
+            return ConstModel(const=self.const)
+
+    return ConstEstimator, fits
+
+
+def _tune_df(seed: int = 0):
+    from synapseml_tpu.core.table import Table
+
+    rng = np.random.default_rng(seed)
+    return Table({"feature": np.arange(24, dtype=np.float64),
+                  "label": rng.normal(size=24)})
+
+
+def _tuner(Est, consts, *, halving=3, folds=3, ckpt="", **kw):
+    from synapseml_tpu.automl import TuneHyperparameters
+    from synapseml_tpu.automl.hyperparams import (DiscreteHyperParam,
+                                                  HyperparamBuilder)
+
+    space = (HyperparamBuilder()
+             .addHyperparam("const", DiscreteHyperParam(consts))
+             .build())
+    return TuneHyperparameters(
+        model=Est(), paramSpace=space, searchMode="grid", numFolds=folds,
+        evaluationMetric="rmse", labelCol="label", halvingEta=halving,
+        checkpointDir=ckpt, **kw)
+
+
+# ---------------------------------------------------------------------------
+# rung ladder math
+# ---------------------------------------------------------------------------
+
+class TestPlanRungs:
+    def test_geometric_ladder(self):
+        rungs = plan_rungs(12, 6, eta=3, min_resource=1)
+        assert [(r.resource, r.survivors) for r in rungs] == \
+            [(1, 12), (3, 4), (6, 2)]
+
+    def test_final_rung_always_full_resource(self):
+        for n, total, eta, lo in [(9, 4, 3, 1), (20, 5, 2, 1), (7, 6, 3, 2)]:
+            rungs = plan_rungs(n, total, eta=eta, min_resource=lo)
+            assert rungs[-1].resource == total
+            assert rungs[0].survivors == n
+            res = [r.resource for r in rungs]
+            assert res == sorted(res)
+
+    def test_eta_disabled_degenerates_to_exhaustive(self):
+        assert [(r.resource, r.survivors) for r in plan_rungs(4, 2, eta=0)] \
+            == [(2, 4)]
+        assert [(r.resource, r.survivors) for r in plan_rungs(4, 2, eta=1)] \
+            == [(2, 4)]
+
+    def test_single_candidate_or_no_headroom(self):
+        assert plan_rungs(1, 5, eta=3)[0].resource == 5
+        assert len(plan_rungs(8, 2, eta=3, min_resource=2)) == 1
+
+    def test_halving_budget_is_under_forty_percent_of_exhaustive(self):
+        # the bench guard's math: 12 candidates × 6 folds
+        rungs = plan_rungs(12, 6, eta=3, min_resource=1)
+        spent, prev = 0, 0
+        for r in rungs:
+            spent += r.survivors * (r.resource - prev)
+            prev = r.resource
+        assert spent / (12 * 6) <= 0.40
+
+    def test_exhaustive_and_halving_agree_on_winner(self):
+        Est, _ = _tune_fixtures()
+        df = _tune_df()
+        exhaustive = _tuner(Est, [0.0, 0.5, 1.0, 2.0], halving=0).fit(df)
+        halved = _tuner(Est, [0.0, 0.5, 1.0, 2.0], halving=2,
+                        minResourceFolds=1).fit(df)
+        assert halved.bestParams == exhaustive.bestParams
+        assert halved.bestMetric == pytest.approx(exhaustive.bestMetric)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the kill→resume invariant
+# ---------------------------------------------------------------------------
+
+class TestChaosInvariant:
+    CONSTS = [0.0, 0.5, 1.0, 1.5, 2.0, 3.0]
+    CHAOS = dict(seed=11, p_crash=0.2, p_nan=0.1, p_slow=0.3, slow_s=0.01)
+
+    def _run(self, Est, ckpt):
+        return _tuner(Est, self.CONSTS, halving=3, folds=3, ckpt=ckpt,
+                      parallelism=2, maxAttempts=2).fit(_tune_df())
+
+    def test_interrupted_chaotic_search_resumes_to_identical_best(
+            self, tmp_path):
+        Est, fits = _tune_fixtures()
+        with chaos_candidate(**self.CHAOS):
+            baseline = self._run(Est, "")
+
+        d = str(tmp_path / "bracket")
+        Est2, fits2 = _tune_fixtures()
+        interrupted = False
+        try:
+            # candidate 4 is the one whose rung-0 attempt the chaos seed
+            # leaves clean, so its preemption boundary is really reached
+            with chaos_candidate(**self.CHAOS), \
+                    ChaosPreemption(at={"automl.candidate": [4]}):
+                self._run(Est2, d)
+        except PreemptionError:
+            interrupted = True
+        assert interrupted, "the mid-bracket kill must really fire"
+        mid_run_fits = len(fits2)
+
+        with chaos_candidate(**self.CHAOS):
+            resumed = self._run(Est2, d)
+
+        # identical winner AND identical per-candidate metrics, chaos and all
+        assert resumed.bestParams == baseline.bestParams
+        assert resumed.bestMetric == pytest.approx(baseline.bestMetric)
+        got = [r["metric"] for r in resumed.allResults]
+        want = [r["metric"] for r in baseline.allResults]
+        np.testing.assert_allclose(got, want, equal_nan=True)
+        # and the resume really reused the interrupted run's work: the two
+        # legs together fit no more than double the uninterrupted total
+        assert mid_run_fits < len(fits)
+        assert len(fits2) <= 2 * len(fits)
+
+    def test_chaos_is_pure_per_coordinates(self):
+        c = chaos_candidate(seed=3, p_crash=0.3, p_hang=0.2, p_nan=0.2)
+        assert c.action("k1", 0, 0) == c.action("k1", 0, 0)
+        draws = {c.action(f"k{i}", r, a)
+                 for i in range(30) for r in range(2) for a in range(2)}
+        assert None in draws and len(draws) > 2   # faults AND clean runs
+
+    def test_chaos_hook_does_not_nest(self):
+        with chaos_candidate(seed=1):
+            with pytest.raises(RuntimeError, match="nest"):
+                with chaos_candidate(seed=2):
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# hang reaping: no candidate stalls the pool past its budget
+# ---------------------------------------------------------------------------
+
+class TestHangReaping:
+    def test_hung_candidate_is_reaped_within_budget(self):
+        from synapseml_tpu.automl import TuneHyperparameters
+        from synapseml_tpu.automl.hyperparams import (DiscreteHyperParam,
+                                                      HyperparamBuilder)
+
+        Est, _ = _tune_fixtures()
+        space = (HyperparamBuilder()
+                 .addHyperparam("const", DiscreteHyperParam([0.0, 1.0]))
+                 .addHyperparam("hang", DiscreteHyperParam([False, True]))
+                 .build())
+        t0 = time.monotonic()
+        m = TuneHyperparameters(
+            model=Est(), paramSpace=space, searchMode="grid", numFolds=2,
+            evaluationMetric="rmse", labelCol="label", parallelism=2,
+            candidateBudgetSeconds=1.0,
+        ).fit(_tune_df())
+        elapsed = time.monotonic() - t0
+        assert elapsed < 8.0, f"hung candidates stalled the search {elapsed}s"
+        assert m.bestParams["hang"] is False
+        assert failure_counts().get("automl.candidate_hang", 0) == 2
+        nan_results = [r for r in m.allResults if np.isnan(r["metric"])]
+        assert len(nan_results) == 2
+
+    def test_chaos_hang_is_reaped_not_retried(self):
+        Est, fits = _tune_fixtures()
+        chaos = chaos_candidate(seed=0, p_hang=1.0, hang_s=30.0)
+        try:
+            with chaos:
+                with pytest.raises(ValueError,
+                                   match="every candidate scored NaN"):
+                    _tuner(Est, [1.0], halving=0, folds=2,
+                           candidateBudgetSeconds=0.5).fit(_tune_df())
+        finally:
+            chaos.release()
+        assert failure_counts().get("automl.candidate_hang", 0) == 1
+        assert failure_counts().get("automl.candidate_retry", 0) == 0
+        assert fits == []   # the hook hangs before the fold fit
+
+
+# ---------------------------------------------------------------------------
+# dedup, all-NaN, fingerprints, stale records
+# ---------------------------------------------------------------------------
+
+class TestSchedulerContracts:
+    def test_duplicate_candidates_compute_once_and_share_score(self):
+        from synapseml_tpu.automl import TuneHyperparameters
+        from synapseml_tpu.automl.hyperparams import (DiscreteHyperParam,
+                                                      HyperparamBuilder)
+
+        Est, fits = _tune_fixtures()
+        # a one-point random space: every draw is the same candidate
+        space = (HyperparamBuilder()
+                 .addHyperparam("const", DiscreteHyperParam([0.5]))
+                 .build())
+        m = TuneHyperparameters(
+            model=Est(), paramSpace=space, searchMode="random", numRuns=4,
+            numFolds=2, evaluationMetric="rmse", labelCol="label",
+        ).fit(_tune_df())
+        assert len(m.allResults) == 4              # every draw reported
+        metrics = [r["metric"] for r in m.allResults]
+        assert len(set(metrics)) == 1              # ...sharing ONE score
+        assert np.isfinite(metrics[0])
+        assert len(fits) == 2 + 1                  # k folds once + best refit
+
+    def test_duplicate_keys_collapse_in_scheduler(self):
+        calls = []
+
+        def run_folds(i, params, lo, hi):
+            calls.append((i, lo, hi))
+            return [float(params["x"])] * (hi - lo)
+
+        sch = ElasticHalvingScheduler(
+            run_folds, [{"x": 1.0}, {"x": 2.0}, {"x": 1.0}],
+            ["ka", "kb", "ka"], maximize=False, total_folds=2, eta=0)
+        res = sch.run()
+        assert sch.duplicates == 1
+        assert sorted(k for k, _, _ in calls) == [0, 1]   # ka once, kb once
+        assert res["ka"]["metric"] == 1.0
+
+    def test_all_nan_raises_under_halving(self):
+        from synapseml_tpu.automl import TuneHyperparameters
+        from synapseml_tpu.automl.hyperparams import (DiscreteHyperParam,
+                                                      HyperparamBuilder)
+
+        Est, _ = _tune_fixtures()
+        space = (HyperparamBuilder()
+                 .addHyperparam("const", DiscreteHyperParam([0.0, 1.0, 2.0]))
+                 .addHyperparam("crash", DiscreteHyperParam([True]))
+                 .build())
+        with pytest.raises(ValueError, match="every candidate scored NaN"):
+            TuneHyperparameters(
+                model=Est(), paramSpace=space, searchMode="grid", numFolds=3,
+                evaluationMetric="rmse", labelCol="label", halvingEta=3,
+            ).fit(_tune_df())
+        assert failure_counts().get("automl.candidate_failure", 0) == 3
+
+    def test_resume_against_changed_data_refuses_loudly(self, tmp_path):
+        Est, _ = _tune_fixtures()
+        d = str(tmp_path / "bracket")
+        _tuner(Est, [0.0, 1.0], halving=0, folds=2, ckpt=d).fit(_tune_df(0))
+        with pytest.raises(ValueError, match="resume refused"):
+            _tuner(Est, [0.0, 1.0], halving=0, folds=2,
+                   ckpt=d).fit(_tune_df(1))
+        # the per-candidate records were recognized as stale, not corrupt
+        assert failure_counts().get("automl.candidate_record_stale", 0) == 2
+        assert failure_counts().get("automl.candidate_record_corrupt", 0) == 0
+
+    def test_stale_candidate_record_is_ignored_with_counter(self, tmp_path):
+        Est, fits = _tune_fixtures()
+        d = str(tmp_path / "bracket")
+        _tuner(Est, [0.0, 1.0], halving=0, folds=2, ckpt=d).fit(_tune_df())
+        rec = sorted(f for f in os.listdir(d) if f.startswith("cand_"))[0]
+        path = os.path.join(d, rec)
+        with open(path) as f:
+            record = json.load(f)
+        record["fingerprint"] = "deadbeef" * 3
+        with open(path, "w") as f:
+            json.dump(record, f)
+        n_before = len(fits)
+        m = _tuner(Est, [0.0, 1.0], halving=0, folds=2, ckpt=d).fit(_tune_df())
+        assert failure_counts().get("automl.candidate_record_stale", 0) == 1
+        assert len(fits) > n_before            # the stale one recomputed
+        assert all(np.isfinite(r["metric"]) for r in m.allResults)
+
+    def test_explicit_budget_wins_over_perfmodel_price(self):
+        sch = ElasticHalvingScheduler(
+            lambda i, p, lo, hi: [0.0] * (hi - lo), [{"x": 1}], ["k"],
+            total_folds=2, eta=0, budget_s=7.5)
+        assert sch._task_budget(2) == 7.5
+        sch2 = ElasticHalvingScheduler(
+            lambda i, p, lo, hi: [0.0] * (hi - lo), [{"x": 1}], ["k"],
+            total_folds=2, eta=0)
+        # no explicit budget + no confident model ⇒ no reaper at all
+        assert sch2._task_budget(2) is None
+
+    def test_perf_journal_writes_automl_rung_rows(self, tmp_path):
+        from synapseml_tpu.core import perfmodel
+
+        rows_before = len(perfmodel.training_rows("automl_rung"))
+        Est, _ = _tune_fixtures()
+        _tuner(Est, [0.0, 1.0], halving=0, folds=2,
+               perfJournal=True).fit(_tune_df())
+        rows = perfmodel.training_rows("automl_rung")
+        assert len(rows) > rows_before
+        assert all(r["arm"] == "cv_fold" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# the gang: spool workers under a TrainingSupervisor
+# ---------------------------------------------------------------------------
+
+def _gang_env():
+    import synapseml_tpu
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(synapseml_tpu.__file__)))
+    pp = os.environ.get("PYTHONPATH", "")
+    return {"PYTHONPATH": root + (os.pathsep + pp if pp else "")}
+
+
+ECHO = "synapseml_tpu.automl.worker:_echo"
+
+
+@pytest.mark.slow
+class TestGangCandidatePool:
+    def test_task_roundtrip_and_failed_task_is_a_result(self, tmp_path):
+        with GangCandidatePool(world_size=1, spool_dir=str(tmp_path / "sp"),
+                               env=_gang_env()) as pool:
+            out = pool.run_task({"entry": ECHO, "payload": {"value": [1, 2]}},
+                                budget_s=120.0)
+            assert out == [1, 2]
+            # the entry raising is a RESULT (RuntimeError), not a hang/crash
+            with pytest.raises(RuntimeError, match="failed in worker"):
+                pool.run_task({"entry": ECHO, "payload": {"crash": True}},
+                              budget_s=120.0)
+
+    def test_kill_rank_mid_task_respawns_and_respools(self, tmp_path):
+        spool = str(tmp_path / "sp")
+        with GangCandidatePool(world_size=1, spool_dir=spool,
+                               env=_gang_env()) as pool:
+            # warm the worker up so the kill hits a claimed task, not import
+            assert pool.run_task({"entry": ECHO, "payload": {"value": 1}},
+                                 budget_s=120.0) == 1
+            box = {}
+
+            def _submit():
+                box["out"] = pool.run_task(
+                    {"entry": ECHO,
+                     "payload": {"value": "ok", "sleep_s": 3.0}},
+                    budget_s=180.0)
+
+            t = threading.Thread(target=_submit, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                claims = [f for f in os.listdir(spool) if ".claimed.r" in f]
+                if claims:
+                    break
+                time.sleep(0.05)
+            assert claims, "worker never claimed the slow task"
+            pool.supervisor.procs[0].kill()        # kill_rank mid-task
+            t.join(timeout=120.0)
+            assert not t.is_alive()
+            # the respawned rank re-ran the orphaned task to completion
+            assert box.get("out") == "ok"
+
+    def test_missing_result_past_budget_raises_peer_lost(self, tmp_path):
+        from synapseml_tpu.parallel.elastic import PeerLostError
+
+        with GangCandidatePool(world_size=1, spool_dir=str(tmp_path / "sp"),
+                               env=_gang_env()) as pool:
+            assert pool.run_task({"entry": ECHO, "payload": {"value": 1}},
+                                 budget_s=120.0) == 1   # worker is warm
+            with pytest.raises(PeerLostError):
+                pool.run_task({"entry": ECHO,
+                               "payload": {"value": 0, "sleep_s": 30.0}},
+                              budget_s=1.0)
+
+
+class TestWorkerModule:
+    def test_run_worker_claims_runs_and_reports(self, tmp_path):
+        from synapseml_tpu.automl.worker import run_worker
+        from synapseml_tpu.core.checkpoint import atomic_write_text
+
+        spool = str(tmp_path)
+        atomic_write_text(
+            os.path.join(spool, "task_000001.json"),
+            json.dumps({"id": "000001", "entry": "json:dumps",
+                        "payload": {"obj": [1, 2]}}))
+        assert run_worker(spool, rank=0, max_tasks=1) == 1
+        with open(os.path.join(spool, "result_000001.json")) as f:
+            rec = json.load(f)
+        assert rec["ok"] and json.loads(rec["value"]) == [1, 2]
+        # the claim was consumed, the heartbeat file exists
+        assert not any(f.startswith("task_") for f in os.listdir(spool))
+        assert any(f.startswith("hb_p0") for f in os.listdir(spool))
+
+    def test_worker_failed_task_writes_error_result(self, tmp_path):
+        from synapseml_tpu.automl.worker import run_worker
+        from synapseml_tpu.core.checkpoint import atomic_write_text
+
+        spool = str(tmp_path)
+        atomic_write_text(
+            os.path.join(spool, "task_000001.json"),
+            json.dumps({"id": "000001", "entry": ECHO,
+                        "payload": {"crash": True}}))
+        run_worker(spool, rank=0, max_tasks=1)
+        with open(os.path.join(spool, "result_000001.json")) as f:
+            rec = json.load(f)
+        assert rec["ok"] is False
+        assert "deliberate _echo crash" in rec["error"]
+
+    def test_worker_stops_on_stop_file(self, tmp_path):
+        from synapseml_tpu.automl.worker import run_worker
+        from synapseml_tpu.core.checkpoint import atomic_write_text
+
+        atomic_write_text(os.path.join(str(tmp_path), "stop"), "stop")
+        assert run_worker(str(tmp_path), rank=0) == 0

@@ -243,6 +243,63 @@ def test_recompile_allows_runner_backed_serving_handler(tmp_path):
     assert recompile.run(ctx) == []
 
 
+def test_recompile_flags_jitted_call_in_batch_surface_method(tmp_path):
+    # R5 extended scope: `_scores`/`_transform` under explainers/ and
+    # recommendation/ are request-sized batch surfaces — a direct jitted
+    # call there is one compile per observed batch size
+    ctx = _ctx(tmp_path, {"synapseml_tpu/recommendation/rec.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _matmul(a, b):
+            return a @ b
+
+        class RecModel:
+            def _scores(self, aff, sim):
+                return _matmul(aff, sim)
+        """})
+    found = recompile.run(ctx)
+    assert len(found) == 1
+    assert "request-sized batch surface" in found[0].message
+    assert "every distinct batch size" in found[0].message
+    assert "BucketedRunner" in found[0].message
+
+
+def test_recompile_allows_runner_backed_batch_surface(tmp_path):
+    # the batch surface goes through a BucketedRunner: the call resolves to
+    # no traced project function, and the same method name OUTSIDE the
+    # explainers/recommendation dirs is not a batch surface at all
+    ctx = _ctx(tmp_path, {
+        "synapseml_tpu/explainers/expl.py": """\
+            import numpy as np
+
+            from synapseml_tpu.core.inference import BucketedRunner
+
+            def _solve(x):
+                return x * 2.0
+
+            runner = BucketedRunner(_solve, max_batch_size=64)
+
+            class Expl:
+                def _transform(self, df):
+                    return runner(np.asarray(df["value"]))
+            """,
+        "synapseml_tpu/train/mod.py": """\
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def _step(x):
+                return jnp.tanh(x)
+
+            class Trainer:
+                def _transform(self, df):
+                    return _step(df["value"])
+            """})
+    assert recompile.run(ctx) == []
+
+
 def test_recompile_allows_hoisted_and_cached_wrappers(tmp_path):
     ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
         import jax

@@ -25,6 +25,13 @@ anything — the profile just quietly fills with `jit_` compilations:
   observed batch size. Calls through a runner instance (a plain variable)
   resolve to no project function and pass; intentional direct sites take a
   ``# lint-ok: recompile`` escape.
+
+  The same pass covers the non-server request-sized surfaces: ``_scores``/
+  ``_transform`` methods under ``synapseml_tpu/explainers/`` and
+  ``synapseml_tpu/recommendation/`` score however many rows the caller
+  hands them, so a jitted call there has the identical
+  one-compile-per-observed-batch-size failure mode and the identical fix
+  (route the batch dimension through ``BucketedRunner``).
 """
 
 from __future__ import annotations
@@ -225,9 +232,50 @@ def _serving_handler_pass(ctx, sf, findings: List[Finding]) -> None:
                                 "or mark the site `# lint-ok: recompile`"))
 
 
+#: request-sized batch surfaces outside the serving server: these methods
+#: are handed however many rows the caller asks about, so a jitted call in
+#: their bodies recompiles once per observed batch size exactly like a
+#: serving handler would
+_BATCH_SURFACE_DIRS = ("synapseml_tpu/explainers/",
+                       "synapseml_tpu/recommendation/")
+_BATCH_SURFACE_METHODS = frozenset({"_scores", "_transform"})
+
+
+def _batch_surface_pass(ctx, sf, findings: List[Finding]) -> None:
+    """R5 (extended) — `_scores`/`_transform` under explainers/ and
+    recommendation/ are request-sized batch surfaces; direct jitted calls
+    there are one XLA compile per observed batch size."""
+    if not any(sf.rel.startswith(d) for d in _BATCH_SURFACE_DIRS):
+        return
+    jitmap = ctx.jitmap
+    for info in sf.symbols.functions.values():
+        if info.qualname.split(".")[-1] not in _BATCH_SURFACE_METHODS:
+            continue
+        for inner in jitmap._calls_in_body(info):
+            if not (inner.args or inner.keywords):
+                continue
+            inner_canon = ctx.project.canonical(sf, dotted_name(inner.func))
+            callee = jitmap.resolve_callee(sf, info, inner)
+            jitted = (callee is not None
+                      and callee.full_name in jitmap.traced
+                      and jitmap.traced[callee.full_name].direct)
+            if jitted or is_jit_like(inner_canon):
+                target = inner_canon or dotted_name(inner.func) or "call"
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=inner.lineno,
+                    col=inner.col_offset,
+                    message=f"`{target}(...)` is jitted and called from "
+                            f"`{info.qualname}`, a request-sized batch "
+                            "surface: every distinct batch size is a fresh "
+                            "XLA compile — route the batch dimension "
+                            "through core.inference.BucketedRunner or mark "
+                            "the site `# lint-ok: recompile`"))
+
+
 def run(ctx) -> List[Finding]:
     findings: List[Finding] = []
     for sf in ctx.files_under(SCOPE):
         _Walker(ctx.project, sf, ctx.jitmap, findings).visit(sf.tree)
         _serving_handler_pass(ctx, sf, findings)
+        _batch_surface_pass(ctx, sf, findings)
     return findings

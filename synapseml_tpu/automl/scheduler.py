@@ -293,7 +293,8 @@ class ElasticHalvingScheduler:
         lock) when a candidate's participation ends — completion at full
         resource or elimination at a barrier. tune.py journals its
         ``cand_<key>.json`` resume records from here."""
-        self._record_hooks.append(hook)
+        with self._lock:
+            self._record_hooks.append(hook)
 
     # -- scores -----------------------------------------------------------
     def _mean(self, key: str) -> float:

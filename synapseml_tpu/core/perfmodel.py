@@ -334,6 +334,31 @@ def append_training_row(kind: str, arm: str, features: Dict[str, float],
     return row
 
 
+def _parse_journal(path: str) -> List[Dict[str, Any]]:
+    rows: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as fh:  # host-side journal read, never under trace
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or not rec.get("perf_row"):
+                continue
+            if not isinstance(rec.get("features"), dict):
+                continue
+            try:
+                rec["observed_s"] = float(rec["observed_s"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if rec["observed_s"] <= 0:
+                continue
+            rows.append(rec)
+    return rows
+
+
 def training_rows(kind: Optional[str] = None,
                   platform: Optional[str] = None,
                   path: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -345,31 +370,18 @@ def training_rows(kind: Optional[str] = None,
     except OSError:
         return []
     with _rows_lock:
-        if _rows_cache["stat"] != stat_key:
-            rows: List[Dict[str, Any]] = []
-            with open(path, "r", encoding="utf-8") as fh:  # host-side journal read, never under trace
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue
-                    if not isinstance(rec, dict) or not rec.get("perf_row"):
-                        continue
-                    if not isinstance(rec.get("features"), dict):
-                        continue
-                    try:
-                        rec["observed_s"] = float(rec["observed_s"])
-                    except (KeyError, TypeError, ValueError):
-                        continue
-                    if rec["observed_s"] <= 0:
-                        continue
-                    rows.append(rec)
+        cached = _rows_cache["stat"] == stat_key
+        rows = list(_rows_cache["rows"]) if cached else None
+    if rows is None:
+        # parse OUTSIDE the lock: the journal read is host file I/O and
+        # heartbeat/monitor threads price steps through this cache — two
+        # racing fills both parse the same snapshot (idempotent), nobody
+        # stalls behind the file
+        parsed = _parse_journal(path)
+        with _rows_lock:
             _rows_cache["stat"] = stat_key
-            _rows_cache["rows"] = rows
-        rows = list(_rows_cache["rows"])
+            _rows_cache["rows"] = parsed
+        rows = list(parsed)
     if kind is not None:
         rows = [r for r in rows if r.get("kind") == kind]
     if platform is not None:

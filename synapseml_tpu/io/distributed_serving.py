@@ -619,23 +619,28 @@ class ServingGateway:
             link = self.register_worker(str(payload["url"]), **info)
             self.stats.incr("heartbeats")
             self._sweep_expired()
+            with self._lock:
+                n_workers = len(self.links)
             return 200, {"ok": True, "worker": link.url,
                          "known": link.url in before,
-                         "workers": len(self.links),
+                         "workers": n_workers,
                          # live gateway peers, so WorkerAgent learns every
                          # gateway it can fail its beats over to
                          "gateway_id": self.gateway_id,
                          "peers": self.gateway_urls()}
         if op == "deregister":
             gone = self.deregister_worker(str(payload["url"]))
+            with self._lock:
+                n_workers = len(self.links)
             return 200, {"ok": True, "removed": gone,
-                         "workers": len(self.links)}
+                         "workers": n_workers}
         return 404, {"error": f"unknown fabric op {op!r}"}
 
     # --- federation: replicated control plane ---------------------------
     @property
     def federated(self) -> bool:
-        return bool(self._peer_urls)
+        with self._lock:
+            return bool(self._peer_urls)
 
     def alive(self) -> bool:
         """False once chaos hard-killed this gateway (kill_gateway) — the
@@ -927,29 +932,36 @@ class ServingGateway:
               tenant: Optional[str] = None) -> Optional[_WorkerLink]:
         now = self._clock()
         self._sweep_expired()
+        # the gateway lock guards only the membership LIST; breaker/tenant
+        # probes take each link's own lock, so they run on a snapshot —
+        # the router never nests the gateway lock around a link lock
         with self._lock:
-            up = [l for l in self.links
-                  if id(l) not in exclude and l.breaker.available(now)
-                  and l.tenant_available(tenant, now)]
-            if not up:
-                # every remaining worker's breaker is OPEN inside its
-                # cooldown (transport-wide, or for THIS tenant): fail fast
-                # (the breaker's whole point) instead of dialing known-bad
-                # backends
-                return None
-            if self.mode == "round_robin":
-                self._rr += 1
-                order = up[self._rr % len(up):] + up[:self._rr % len(up)]
-            else:
-                order = self._bucket_aware_order(up, hint, tenant)
-            # try_acquire consumes the single half-open probe slot; a link
-            # that loses the probe race falls through to the next candidate
-            for link in order:
-                if link.breaker.try_acquire(now):
-                    if hint is not None and hint[1] is not None:
-                        self._pin_affinity((tenant, hint[1]), link.url)
-                    return link
+            candidates = list(self.links)
+        up = [l for l in candidates
+              if id(l) not in exclude and l.breaker.available(now)
+              and l.tenant_available(tenant, now)]
+        if not up:
+            # every remaining worker's breaker is OPEN inside its
+            # cooldown (transport-wide, or for THIS tenant): fail fast
+            # (the breaker's whole point) instead of dialing known-bad
+            # backends
             return None
+        if self.mode == "round_robin":
+            with self._lock:
+                self._rr += 1
+                rr = self._rr
+            order = up[rr % len(up):] + up[:rr % len(up)]
+        else:
+            order = self._bucket_aware_order(up, hint, tenant)
+        # try_acquire consumes the single half-open probe slot; a link
+        # that loses the probe race falls through to the next candidate
+        for link in order:
+            if link.breaker.try_acquire(now):
+                if hint is not None and hint[1] is not None:
+                    with self._lock:
+                        self._pin_affinity((tenant, hint[1]), link.url)
+                return link
+        return None
 
     def _bucket_aware_order(self, up: List[_WorkerLink], hint,
                             tenant: Optional[str] = None
@@ -961,13 +973,15 @@ class ServingGateway:
         workers advertise them, (2) the (tenant, shape) sticky affinity
         replica wins ties (each tenant's same-shape traffic concentrates
         one cache), and (3) in-flight load breaks the rest. With no hint —
-        or stale/absent bucket info — this IS plain least-loaded. Caller
-        holds _lock."""
+        or stale/absent bucket info — this IS plain least-loaded. Takes
+        _lock only for the affinity read; the covers_bucket probes call
+        into each link's own lock and must not nest under it."""
         if hint is None:
             return sorted(up, key=lambda l: l.inflight)
         rows, key = hint
-        sticky = (self._affinity.get((tenant, key))
-                  if key is not None else None)
+        with self._lock:
+            sticky = (self._affinity.get((tenant, key))
+                      if key is not None else None)
         return sorted(up, key=lambda l: (
             0 if l.covers_bucket(rows, tenant) else 1,
             0 if sticky is not None and l.url == sticky else 1,
@@ -981,9 +995,10 @@ class ServingGateway:
             return False
         now = self._clock()
         with self._lock:
-            up = [l for l in self.links if l.breaker.available(now)]
-            return bool(up) and not any(
-                l.tenant_available(tenant, now) for l in up)
+            candidates = list(self.links)
+        up = [l for l in candidates if l.breaker.available(now)]
+        return bool(up) and not any(
+            l.tenant_available(tenant, now) for l in up)
 
     def _pin_affinity(self, key, url: str) -> None:
         # caller holds _lock
@@ -1016,12 +1031,13 @@ class ServingGateway:
             tried.add(id(link))
             with self._lock:
                 link.inflight += 1
+                is_local = link is self._local_link
             try:
                 if deadline is not None:
                     # re-anchor the remaining budget for the next hop
                     headers = {**headers,
                                DEADLINE_HEADER: deadline.header_value()}
-                if link is self._local_link:
+                if is_local:
                     status, payload = self._forward_local(body, deadline,
                                                           tenant)
                 else:
@@ -1205,11 +1221,13 @@ class ServingGateway:
 
         self._httpd = _Server((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
-        self.public_url = f"http://{self.host}:{self.port}"
+        # assigned exactly once, before the serve/replicator threads exist;
+        # read-only afterwards (start() happens-before both thread starts)
+        self.public_url = f"http://{self.host}:{self.port}"  # lint-ok: thread-shared write precedes thread start
         self.ring.add(self.public_url)
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
-        if self._peer_urls:
+        if self.federated:
             self._start_replicator()
         return self
 

@@ -128,7 +128,10 @@ class ChunkPump:
         hook = _CHAOS_CHUNK_HOOK
         if hook is not None:
             chunk = hook(self.chunks_produced, chunk)
-        self.chunks_produced += 1
+        # producer-private while the pump thread runs; the consumer only
+        # reads it after _DONE arrives through _q, and the queue put/get
+        # pair is the happens-before edge
+        self.chunks_produced += 1  # lint-ok: thread-shared queue handoff
         return self._place(chunk)
 
     def _produce(self) -> None:
@@ -144,7 +147,10 @@ class ChunkPump:
                     except queue.Full:
                         continue
         except BaseException as e:  # noqa: BLE001 — ferried to the consumer
-            self._err = e
+            # written before the finally-block puts _DONE; the consumer
+            # reads it only after get() returns _DONE, so the queue
+            # handoff publishes the error
+            self._err = e  # lint-ok: thread-shared queue handoff
         finally:
             # always deliver end-of-stream; close() drains concurrently so
             # this can never deadlock against a vanished consumer

@@ -541,32 +541,38 @@ class TrainingSupervisor:
         self.shrunk = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # guards procs/respawns/spawned/world_size: the supervisor loop
+        # mutates the gang while retire()/start_gang() run on the caller's
+        # thread. Reentrant — step() takes it and calls observe()/retire().
+        self._gang_lock = threading.RLock()
 
     # -- gang management --
     def start_gang(self) -> "TrainingSupervisor":
         os.makedirs(self.heartbeat_dir, exist_ok=True)
-        for rank in range(self.world_size):
-            self.procs[rank] = self.spawn_fn(rank, self.world_size, 0)
-            self.spawned += 1
+        with self._gang_lock:
+            for rank in range(self.world_size):
+                self.procs[rank] = self.spawn_fn(rank, self.world_size, 0)
+                self.spawned += 1
         return self
 
     def retire(self) -> None:
         """Terminate and reap every child (idempotent; called on every exit
         path — a supervisor never leaves zombies)."""
-        for rank, proc in list(self.procs.items()):
-            if proc is None:
-                continue
-            try:
-                if proc.poll() is None:
-                    proc.terminate()
-                    try:
-                        proc.wait(timeout=5)
-                    except Exception:  # noqa: BLE001 — escalate to SIGKILL
-                        proc.kill()
-                proc.wait()
-            except OSError:
-                pass   # already reaped
-            self.procs[rank] = None
+        with self._gang_lock:
+            for rank, proc in list(self.procs.items()):
+                if proc is None:
+                    continue
+                try:
+                    if proc.poll() is None:
+                        proc.terminate()
+                        try:
+                            proc.wait(timeout=5)
+                        except Exception:  # noqa: BLE001 — escalate to SIGKILL
+                            proc.kill()
+                    proc.wait()
+                except OSError:
+                    pass   # already reaped
+                self.procs[rank] = None
 
     # -- observe / decide / act (FabricSupervisor shape) --
     def observe(self):
@@ -574,14 +580,15 @@ class TrainingSupervisor:
         or its heartbeat went stale."""
         stale = set(self.monitor.stale())
         alive, lost = [], []
-        for rank, proc in self.procs.items():
-            if proc is None:
-                continue
-            exited = proc.poll() is not None
-            if exited or rank in stale:
-                lost.append(rank)
-            else:
-                alive.append(rank)
+        with self._gang_lock:
+            for rank, proc in self.procs.items():
+                if proc is None:
+                    continue
+                exited = proc.poll() is not None
+                if exited or rank in stale:
+                    lost.append(rank)
+                else:
+                    alive.append(rank)
         return sorted(alive), sorted(lost)
 
     def decide(self, n_alive: int, lost: Sequence[int]) -> Optional[str]:
@@ -601,29 +608,31 @@ class TrainingSupervisor:
         alive, lost = self.observe()
         action = self.decide(len(alive), lost)
         if action == "respawn":
-            for rank in lost:
-                proc = self.procs.get(rank)
-                if proc is not None:
-                    try:          # reap the corpse before replacing it
-                        if proc.poll() is None:
-                            proc.kill()
-                        proc.wait()
-                    except OSError:
-                        pass
-                attempt = self.respawns.get(rank, 0) + 1
-                self.respawns[rank] = attempt
-                self.procs[rank] = self.spawn_fn(rank, self.world_size,
-                                                 attempt)
-                self.spawned += 1
-                record_failure("elastic.respawn", rank=rank, attempt=attempt,
-                               world=self.world_size)
+            with self._gang_lock:
+                for rank in lost:
+                    proc = self.procs.get(rank)
+                    if proc is not None:
+                        try:      # reap the corpse before replacing it
+                            if proc.poll() is None:
+                                proc.kill()
+                            proc.wait()
+                        except OSError:
+                            pass
+                    attempt = self.respawns.get(rank, 0) + 1
+                    self.respawns[rank] = attempt
+                    self.procs[rank] = self.spawn_fn(rank, self.world_size,
+                                                     attempt)
+                    self.spawned += 1
+                    record_failure("elastic.respawn", rank=rank,
+                                   attempt=attempt, world=self.world_size)
         elif action == "shrink":
             survivors = len(alive)
-            self.retire()                      # drain the old gang fully
-            self.world_size = survivors
-            self.monitor.expected = list(range(survivors))
-            self.respawns.clear()
-            self.shrunk += 1
+            with self._gang_lock:
+                self.retire()                  # drain the old gang fully
+                self.world_size = survivors
+                self.monitor.expected = list(range(survivors))
+                self.respawns.clear()
+                self.shrunk += 1
             record_failure("elastic.shrink", new_world=survivors)
             self.shrink_fn(survivors)
         return action

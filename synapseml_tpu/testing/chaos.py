@@ -1030,7 +1030,8 @@ class chaos_tenant_flood:
             t.start()
         for t in ts:
             t.join()
-        return list(self.results)
+        with self._lock:
+            return list(self.results)
 
     def status_counts(self) -> dict:
         """``{status: count}`` over everything :meth:`run` has sent."""

@@ -1,0 +1,345 @@
+"""Runtime lock-order witness: cross-validate the static lock graph.
+
+The ``lock-order`` analyzer *predicts* "acquires B while holding A" edges
+from source; this module *observes* them. An opt-in instrumentation
+context (:class:`LockWitness`) replaces the ``threading.Lock`` /
+``threading.RLock`` factories with wrappers that attribute each created
+lock to its creation site — the first stack frame inside
+``synapseml_tpu/`` — and record, per thread, every (held-site,
+acquired-site) pair taken by a *blocking* acquire. Locks created outside
+the package pass through unwrapped, so stdlib internals cost nothing and
+never pollute the report. ``threading.Condition()`` with no argument
+allocates its RLock through the patched factory, so a project Condition's
+internal lock resolves to the project's ``Condition(...)`` call site.
+
+The diff against the static model is the cross-validation the tentpole
+asks for, with an explicit contract:
+
+* an **observed cycle** in the runtime edge graph is a real deadlock the
+  test suite actually drove (two orders genuinely executed) — always a
+  failure;
+* an **observed-but-not-predicted** edge between two *statically known*
+  lock sites is an analyzer recall bug: the code took an order the
+  lock-order graph missed — file it against ``tools/analysis/lockmodel``;
+* edges touching sites the static model doesn't know (dynamically created
+  locks, fixtures) are reported separately and are informational.
+
+Enable under pytest with ``SYNAPSEML_TPU_LOCK_WITNESS=/path/report.json``
+(the session fixture in ``tests/conftest.py`` installs the witness and
+writes the report at exit), then::
+
+    python -m synapseml_tpu.testing.lockwitness /path/report.json
+
+loads the report, rebuilds the static model and prints the diff —
+non-zero exit only on an observed cycle. ci.sh runs this as a
+non-blocking report step; the static analyzers remain the hard gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+Site = Tuple[str, int]                  # (repo-relative path, lineno)
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_DIR = os.path.dirname(_PKG_DIR)
+_THIS_FILE = os.path.abspath(__file__)
+
+
+def _creation_site() -> Optional[Site]:
+    """First frame under ``synapseml_tpu/`` below the factory call, as a
+    repo-relative (path, lineno). None → the lock belongs to foreign code."""
+    f = sys._getframe(2)                # skip factory + this helper
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != _THIS_FILE and os.path.abspath(fn).startswith(_PKG_DIR):
+            rel = os.path.relpath(os.path.abspath(fn), _REPO_DIR)
+            return (rel.replace(os.sep, "/"), f.f_lineno)
+        f = f.f_back
+    return None
+
+
+class _WitnessLock:
+    """Delegating wrapper recording acquisition order per thread.
+
+    Implements the full Lock/RLock surface *plus* the private hooks
+    ``Condition`` uses on its underlying lock (``_is_owned``,
+    ``_acquire_restore``, ``_release_save``), so a wrapped RLock drops
+    into a Condition unchanged. ``Condition.wait`` releases the lock via
+    ``_release_save`` — the witness pops the held stack there too, so a
+    waiting thread never appears to hold the lock it released.
+    """
+
+    __slots__ = ("_inner", "_site", "_witness")
+
+    def __init__(self, inner, site: Site, witness: "LockWitness"):
+        self._inner = inner
+        self._site = site
+        self._witness = witness
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._witness._on_acquire(self._site, blocking=blocking)
+        return got
+
+    def release(self):
+        self._witness._on_release(self._site)
+        return self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    # --- Condition integration (RLock protocol) -------------------------
+    # Condition probes these on its lock and substitutes defaults when
+    # absent; the wrapper exposes them unconditionally, so each delegates
+    # when the inner lock has the hook and mimics Condition's plain-Lock
+    # fallback when it doesn't.
+    def _is_owned(self):
+        hook = getattr(self._inner, "_is_owned", None)
+        if hook is not None:
+            return hook()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _acquire_restore(self, state):
+        hook = getattr(self._inner, "_acquire_restore", None)
+        if hook is not None:
+            hook(state)
+        else:
+            self._inner.acquire()
+        self._witness._on_acquire(self._site, blocking=True)
+
+    def _release_save(self):
+        self._witness._on_release(self._site)
+        hook = getattr(self._inner, "_release_save", None)
+        if hook is not None:
+            return hook()
+        self._inner.release()
+
+    def __repr__(self):
+        return f"<witness {self._inner!r} @ {self._site[0]}:{self._site[1]}>"
+
+
+class LockWitness:
+    """Collects observed (held-site → acquired-site) edges suite-wide."""
+
+    def __init__(self) -> None:
+        self.edges: Dict[Tuple[Site, Site], int] = {}
+        self.sites: Set[Site] = set()
+        self._tls = threading.local()
+        self._mu = threading.Lock()     # created BEFORE install: unwrapped
+        self._real_lock = None
+        self._real_rlock = None
+
+    # --- recording ------------------------------------------------------
+    def _stack(self) -> List[Site]:
+        st = getattr(self._tls, "held", None)
+        if st is None:
+            st = self._tls.held = []
+        return st
+
+    def _on_acquire(self, site: Site, blocking: bool) -> None:
+        st = self._stack()
+        with self._mu:
+            self.sites.add(site)
+            if blocking and site not in st:
+                # lockdep edge rule: every held lock orders before the new
+                # one; a non-blocking acquire cannot wait → no edge, and a
+                # reentrant re-acquire is not an ordering
+                for held in st:
+                    if held != site:
+                        key = (held, site)
+                        self.edges[key] = self.edges.get(key, 0) + 1
+        st.append(site)
+
+    def _on_release(self, site: Site) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == site:
+                del st[i]
+                break
+
+    # --- installation ---------------------------------------------------
+    def install(self) -> "LockWitness":
+        if self._real_lock is not None:
+            return self
+        self._real_lock = threading.Lock
+        self._real_rlock = threading.RLock
+        witness = self
+
+        def lock_factory():
+            site = _creation_site()
+            inner = witness._real_lock()
+            return inner if site is None else _WitnessLock(inner, site,
+                                                           witness)
+
+        def rlock_factory():
+            site = _creation_site()
+            inner = witness._real_rlock()
+            return inner if site is None else _WitnessLock(inner, site,
+                                                           witness)
+
+        threading.Lock = lock_factory
+        threading.RLock = rlock_factory
+        return self
+
+    def uninstall(self) -> None:
+        if self._real_lock is None:
+            return
+        threading.Lock = self._real_lock
+        threading.RLock = self._real_rlock
+        self._real_lock = self._real_rlock = None
+
+    def __enter__(self) -> "LockWitness":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # --- reporting ------------------------------------------------------
+    def observed_cycles(self) -> List[List[Site]]:
+        return _site_cycles(set(self.edges))
+
+    def report(self) -> dict:
+        return {
+            "sites": sorted(f"{p}:{ln}" for p, ln in self.sites),
+            "edges": [{"src": f"{a[0]}:{a[1]}", "dst": f"{b[0]}:{b[1]}",
+                       "count": n}
+                      for (a, b), n in sorted(self.edges.items())],
+            "cycles": [[f"{p}:{ln}" for p, ln in cyc]
+                       for cyc in self.observed_cycles()],
+        }
+
+    def write(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self.report(), fh, indent=2, sort_keys=True)
+
+
+def _site_cycles(edges: Set[Tuple[Site, Site]]) -> List[List[Site]]:
+    """Cycles in the observed site graph (DFS, one representative each)."""
+    adj: Dict[Site, List[Site]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    cycles: List[List[Site]] = []
+    seen_keys: Set[frozenset] = set()
+    done: Set[Site] = set()
+    for start in sorted(adj):
+        if start in done:
+            continue
+        stack: List[Tuple[Site, int]] = [(start, 0)]
+        path: List[Site] = [start]
+        on_path = {start}
+        while stack:
+            node, idx = stack[-1]
+            nbrs = adj.get(node, [])
+            if idx >= len(nbrs):
+                stack.pop()
+                path.pop()
+                on_path.discard(node)
+                done.add(node)
+                continue
+            stack[-1] = (node, idx + 1)
+            nxt = nbrs[idx]
+            if nxt in on_path:
+                cyc = path[path.index(nxt):]
+                key = frozenset(cyc)
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(list(cyc))
+            elif nxt not in done:
+                stack.append((nxt, 0))
+                path.append(nxt)
+                on_path.add(nxt)
+    return cycles
+
+
+# --- diff vs the static model ----------------------------------------------
+
+def _parse_site(s: str) -> Site:
+    path, _, ln = s.rpartition(":")
+    return (path, int(ln))
+
+
+def diff_report(report: dict, predicted: Set[Tuple[Site, Site]],
+                known: Dict[Site, str]) -> dict:
+    """Split observed edges into predicted / unpredicted / harness / foreign.
+
+    ``unpredicted`` — both endpoints are statically known product lock
+    sites yet the static graph lacks the edge: an analyzer recall gap.
+    ``harness`` — an endpoint lives under ``synapseml_tpu/testing/``:
+    chaos injectors register runtime hooks the static call graph treats as
+    opaque, so their orderings are outside the recall contract.
+    ``foreign`` — an endpoint the static model never saw (dynamically
+    created locks, stdlib internals of Event/Queue attributed to their
+    project creation line): informational only.
+    """
+    matched, unpredicted, harness, foreign = [], [], [], []
+    for e in report.get("edges", []):
+        a, b = _parse_site(e["src"]), _parse_site(e["dst"])
+        if any(s[0].startswith("synapseml_tpu/testing/") for s in (a, b)):
+            tgt = harness
+        elif a in known and b in known:
+            tgt = matched if (a, b) in predicted else unpredicted
+        else:
+            tgt = foreign
+        tgt.append(e)
+    return {"matched": matched, "unpredicted": unpredicted,
+            "harness": harness, "foreign": foreign,
+            "cycles": report.get("cycles", [])}
+
+
+def _load_static() -> Tuple[Set[Tuple[Site, Site]], Dict[Site, str]]:
+    sys.path.insert(0, _REPO_DIR)
+    from tools.analysis.core import DEFAULT_TARGETS, Project
+    from tools.analysis.jitmap import JitMap
+    from tools.analysis.lockmodel import LockModel
+
+    project = Project.from_targets(DEFAULT_TARGETS)
+    lm = LockModel(project, JitMap(project))
+    return lm.predicted_site_edges(), lm.known_sites()
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 1:
+        print("usage: python -m synapseml_tpu.testing.lockwitness "
+              "<report.json>", file=sys.stderr)
+        return 2
+    try:
+        with open(argv[0], "r", encoding="utf-8") as fh:
+            report = json.load(fh)
+    except OSError as e:
+        print(f"lockwitness: no report to check ({e})", file=sys.stderr)
+        return 0
+    predicted, known = _load_static()
+    d = diff_report(report, predicted, known)
+    print(f"lockwitness: {len(report.get('sites', []))} project lock "
+          f"sites observed, {len(report.get('edges', []))} ordered edges "
+          f"({len(d['matched'])} predicted, {len(d['unpredicted'])} "
+          f"unpredicted, {len(d['harness'])} harness, "
+          f"{len(d['foreign'])} foreign)")
+    for e in d["unpredicted"]:
+        print(f"  UNPREDICTED {e['src']} -> {e['dst']} (x{e['count']}) — "
+              "static lock-order graph missed this order (recall gap)")
+    for cyc in d["cycles"]:
+        print(f"  CYCLE {' -> '.join(cyc)} — observed deadlock-capable "
+              "order inversion")
+    return 1 if d["cycles"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -45,6 +45,27 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lock_witness():
+    """Opt-in runtime lock-order witness: when SYNAPSEML_TPU_LOCK_WITNESS
+    names a report path, wrap every project lock created during the session
+    and write the observed acquisition-order graph at exit.
+    `python -m synapseml_tpu.testing.lockwitness <report>` diffs it against
+    the static lock-order graph (docs/static-analysis.md)."""
+    path = os.environ.get("SYNAPSEML_TPU_LOCK_WITNESS")
+    if not path:
+        yield
+        return
+    from synapseml_tpu.testing.lockwitness import LockWitness
+
+    witness = LockWitness().install()
+    try:
+        yield
+    finally:
+        witness.uninstall()
+        witness.write(path)
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 from ..axismap import AxisMap
 from ..core import Project, SourceFile
 from ..jitmap import JitMap
+from ..lockmodel import LockModel
 
 
 @dataclass
@@ -23,6 +24,7 @@ class Context:
     project: Project
     _jitmap: Optional[JitMap] = field(default=None, repr=False)
     _axismap: Optional[AxisMap] = field(default=None, repr=False)
+    _lockmodel: Optional[LockModel] = field(default=None, repr=False)
 
     @property
     def jitmap(self) -> JitMap:
@@ -36,6 +38,12 @@ class Context:
             self._axismap = AxisMap(self.project, self.jitmap)
         return self._axismap
 
+    @property
+    def lockmodel(self) -> LockModel:
+        if self._lockmodel is None:
+            self._lockmodel = LockModel(self.project, self.jitmap)
+        return self._lockmodel
+
     def package_files(self) -> List[SourceFile]:
         return [sf for sf in self.project.files
                 if sf.rel.startswith("synapseml_tpu/")]
@@ -47,11 +55,13 @@ class Context:
 
 
 def registry() -> Dict[str, object]:
-    from . import (blocking_io, collectives, cycles, determinism, donation,
-                   drift, imports, locks, names, recompile, resources,
-                   sharding, trace_safety)
+    from . import (blocking_io, blocking_lock, collectives, cycles,
+                   determinism, donation, drift, imports, lockorder, locks,
+                   names, recompile, resources, sharding, threadshared,
+                   trace_safety)
 
-    mods = [trace_safety, recompile, determinism, locks, blocking_io,
+    mods = [trace_safety, recompile, determinism, locks, lockorder,
+            threadshared, blocking_lock, blocking_io,
             collectives, sharding, donation, resources,
             names, imports, cycles, drift]
     return {m.ID: m for m in mods}

@@ -1,0 +1,89 @@
+"""blocking-under-lock — host I/O / unbounded waits while holding a hot lock.
+
+The ``blocking-io`` analyzer catches blocking calls inside *traced*
+regions; this one promotes the same fact base into the lock framework: a
+socket/HTTP/file/``time.sleep``/``Thread.join``/``Event.wait`` call made
+**while holding a lock that a hot path also takes** serializes every
+thread behind one slow syscall — the serving formation loop stalls behind
+a registry swap, the heartbeat monitor behind a journal write.
+
+"Hot" is defined structurally: a lock is hot when it is acquired anywhere
+inside a thread-root closure (serving loops, HTTP handlers, daemon
+monitors, executor tasks — the paths that run concurrently by
+construction). Blocking while holding a lock nobody contends is pointless
+but harmless and stays quiet. Receiver-typed method checks only
+(``.join()`` on a ``Thread``-typed attr, ``.get()`` on a queue attr
+without timeout, ``.wait()`` on an Event without timeout) — never
+``",".join(...)``. ``Condition.wait()`` *releases* its lock while waiting
+and is exempt, as are bounded waits (``timeout=``) and non-blocking gets.
+
+Interprocedural: a call made under a hot lock into a function that
+transitively blocks is reported at the call site with the chain, unless
+the callee is *always* called under that lock (then the callee's own
+finding already covers it via the guarded-caller context).
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from ..core import Finding
+
+ID = "blocking-under-lock"
+DESCRIPTION = ("socket/HTTP/file/sleep/join calls while holding a lock a "
+               "hot (threaded) path also takes")
+
+
+def run(ctx) -> List[Finding]:
+    lm = ctx.lockmodel
+    hot: Set[str] = set()
+    for full, fc in lm.funcs.items():
+        if lm.roots_of(full) != {"<main>"}:
+            for a in fc.acquires:
+                hot.add(a.identity)
+    findings: List[Finding] = []
+    seen = set()
+    for full, fc in sorted(lm.funcs.items()):
+        for b in fc.blocking:
+            held_hot = sorted(b.held & hot)
+            if not held_hot:
+                continue
+            key = (fc.sf.rel, b.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                analyzer=ID, path=fc.sf.rel, line=b.line, col=b.col,
+                message=(f"blocking `{b.what}` while holding "
+                         f"`{'`/`'.join(held_hot)}` (in `{_short(full)}`) "
+                         "— a hot threaded path also takes this lock and "
+                         "stalls behind the call; move the blocking work "
+                         "outside the critical section")))
+        for cs in fc.calls:
+            held_hot = cs.held & hot
+            if not held_hot:
+                continue
+            chain = lm.blocks_transitively.get(cs.callee)
+            if chain is None:
+                continue
+            # the callee's own guarded-caller context already holds the
+            # lock -> its own blocking finding covers this chain
+            if lm.context.get(cs.callee, frozenset()) & held_hot:
+                continue
+            key = (fc.sf.rel, cs.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                analyzer=ID, path=fc.sf.rel, line=cs.line, col=cs.col,
+                message=(f"`{_short(full)}` holds "
+                         f"`{'`/`'.join(sorted(held_hot))}` and calls "
+                         f"`{_short(cs.callee)}` which blocks ({chain}) — "
+                         "a hot threaded path also takes this lock; move "
+                         "the blocking work outside the critical section")))
+    return findings
+
+
+def _short(full_name: str) -> str:
+    parts = full_name.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 1 else full_name

@@ -8,6 +8,12 @@ artifact sets **in memory** (``render_stubs``/``render_r_bindings``) and
 flags every committed file that differs, is missing, or is stale (committed
 but no longer rendered). Fix with ``python -m synapseml_tpu.codegen``.
 
+This analyzer also owns the **chaos-docs drift** check: every public
+injector defined at top level in ``synapseml_tpu/testing/chaos.py`` must
+be named in ``docs/resilience.md``. The chaos harness is only useful if
+the failure catalog stays discoverable — an injector added without a doc
+entry is exactly the kind of silent drift a stale ``.pyi`` stub is.
+
 Importing the package is comparatively heavy (it walks every module), so
 this analyzer only runs in full-tree mode — ``run.py`` skips it when
 explicit paths are given.
@@ -15,6 +21,7 @@ explicit paths are given.
 
 from __future__ import annotations
 
+import ast
 import os
 from typing import Dict, List
 
@@ -73,6 +80,42 @@ def _compare(rendered: Dict[str, str], root: str, label: str,
                             "renders it anymore — delete it or regenerate"))
 
 
+CHAOS_MODULE = "synapseml_tpu/testing/chaos.py"
+CHAOS_DOC = "docs/resilience.md"
+
+
+def chaos_exports(chaos_tree: ast.AST) -> Dict[str, int]:
+    """Public top-level injectors of chaos.py: name → definition line.
+
+    Every public top-level class or function in the chaos module is an
+    injector or an injector-facing helper by construction (the module
+    exists for nothing else); private ``_``-prefixed helpers are not part
+    of the documented surface.
+    """
+    out: Dict[str, int] = {}
+    for node in getattr(chaos_tree, "body", []):
+        if isinstance(node, (ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)) \
+                and not node.name.startswith("_"):
+            out[node.name] = node.lineno
+    return out
+
+
+def chaos_doc_findings(chaos_tree: ast.AST, doc_text: str) -> List[Finding]:
+    """Flag every public chaos injector absent from the resilience doc."""
+    import re
+    findings: List[Finding] = []
+    for name, line in sorted(chaos_exports(chaos_tree).items(),
+                             key=lambda kv: kv[1]):
+        if not re.search(rf"\b{re.escape(name)}\b", doc_text):
+            findings.append(Finding(
+                analyzer=ID, path=CHAOS_MODULE, line=line, col=0,
+                message=(f"chaos injector `{name}` is not documented in "
+                         f"{CHAOS_DOC} — add it to the failure catalog "
+                         "(every public injector must be discoverable)")))
+    return findings
+
+
 def run(ctx) -> List[Finding]:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import sys
@@ -85,4 +128,14 @@ def run(ctx) -> List[Finding]:
     _compare(codegen.render_stubs(), pkg_root, "stub", (".pyi",), findings)
     _compare(codegen.render_r_bindings(), os.path.join(REPO, "R"),
              "R binding", (".R",), findings)
+
+    chaos_sf = next((sf for sf in ctx.project.files
+                     if sf.rel == CHAOS_MODULE), None)
+    if chaos_sf is not None:
+        try:
+            with open(os.path.join(REPO, CHAOS_DOC), encoding="utf-8") as f:
+                doc_text = f.read()
+        except OSError:
+            doc_text = ""
+        findings.extend(chaos_doc_findings(chaos_sf.tree, doc_text))
     return findings

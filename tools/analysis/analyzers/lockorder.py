@@ -1,0 +1,71 @@
+"""lock-order — interprocedural lock-acquisition cycles (static lockdep).
+
+A deadlock needs two locks taken in opposite orders by two threads; the
+chaos harness can only sample the interleaving, this analyzer proves the
+*order inversion* exists. The shared :class:`~tools.analysis.lockmodel.
+LockModel` builds the acquisition graph — nodes are lock identities
+(``module.Class.attr`` for ``self``-attribute locks, ``module.NAME`` for
+module globals), edges are "acquires B while provably holding A", both
+lexically (``with a: with b:``, ``.acquire()`` pairs including
+acquire-helper leaks) and through transitive call edges (caller holds A,
+callee's call chain acquires B). Non-blocking acquires
+(``acquire(blocking=False)``, the deterministic-loser swap pattern) cannot
+*wait* and are never edge targets.
+
+A cycle is reported when it is reachable from **two distinct thread entry
+points** — thread targets / timers / executor submits / HTTP handler
+methods, with the implicit ``<main>`` root counting as one entry — i.e.
+whenever at least one edge of the cycle can execute on a non-main thread.
+An inversion only ever exercised single-threaded cannot deadlock and stays
+quiet. The finding message carries the full acquisition path per edge.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Finding
+from ..lockmodel import find_cycles
+
+ID = "lock-order"
+DESCRIPTION = ("lock-acquisition cycles reachable from two thread entry "
+               "points (static deadlock detection)")
+
+
+def run(ctx) -> List[Finding]:
+    lm = ctx.lockmodel
+    findings: List[Finding] = []
+    for cycle in find_cycles(lm.edges):
+        # edges along the representative cycle
+        cycle_edges = []
+        for i, src in enumerate(cycle):
+            dst = cycle[(i + 1) % len(cycle)]
+            edge = lm.edges.get((src, dst))
+            if edge is not None:
+                cycle_edges.append(edge)
+        if len(cycle_edges) < 2:
+            continue
+        entries = set()
+        for edge in cycle_edges:
+            for fn in edge.funcs:
+                entries |= lm.roots_of(fn)
+        if len(entries) < 2:
+            continue                    # single-threaded inversion: no risk
+        order = " -> ".join(cycle + [cycle[0]])
+        witness = "; ".join(e.witness for e in cycle_edges)
+        roots = ", ".join(sorted(_root_label(r) for r in entries))
+        # anchor the finding at the first edge's acquisition site
+        rel, _, line = cycle_edges[0].path.partition(":")
+        findings.append(Finding(
+            analyzer=ID, path=rel, line=int(line or 1), col=0,
+            message=(f"lock-order cycle `{order}` reachable from thread "
+                     f"entry points [{roots}] — potential deadlock. "
+                     f"Acquisition paths: {witness}")))
+    return findings
+
+
+def _root_label(root: str) -> str:
+    if root == "<main>":
+        return root
+    parts = root.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 1 else root

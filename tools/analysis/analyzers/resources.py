@@ -15,8 +15,15 @@ handed to another function — ownership transfer).
 Interprocedural: a function whose only escape for a created resource is
 ``return`` is a *resource factory*; its call sites inside the scope are
 treated as creations and checked the same way. ``threading.Thread`` with
-``daemon=True`` is fire-and-forget by design and exempt; a non-daemon
-thread must be ``join``\\ ed or escape.
+``daemon=True`` (in the constructor or assigned before ``start()``) is
+fire-and-forget by design and exempt; a non-daemon thread must be
+``join``\\ ed or escape.
+
+Thread discipline is checked over the WHOLE package, not just the scoped
+connection-handling modules: a non-daemon thread leaked anywhere hangs
+interpreter shutdown, so every ``threading.Thread`` started under
+``synapseml_tpu/`` must be daemon, joined on all exit paths, or escape to
+an owner that joins it.
 """
 
 from __future__ import annotations
@@ -109,12 +116,14 @@ class _FuncScan:
     """One function: creations, closes, escapes, exception-safety."""
 
     def __init__(self, project, sf: SourceFile, info: FunctionInfo,
-                 factories: Dict[str, str], jitmap):
+                 factories: Dict[str, str], jitmap,
+                 kinds: Optional[tuple] = None):
         self.project = project
         self.sf = sf
         self.info = info
         self.factories = factories
         self.jitmap = jitmap
+        self.kinds = kinds              # None = every resource kind
         self.parents: Dict[int, ast.AST] = {}
         for parent in ast.walk(info.node):
             for child in ast.iter_child_nodes(parent):
@@ -149,12 +158,14 @@ class _FuncScan:
     # -- creation discovery --
     def _creation_kind(self, call: ast.Call) -> Optional[str]:
         kind = _resource_kind(self.project, self.sf, call)
-        if kind is not None:
-            return kind
-        callee = self.jitmap.resolve_callee(self.sf, self.info, call)
-        if callee is not None and callee.full_name in self.factories:
-            return self.factories[callee.full_name]
-        return None
+        if kind is None:
+            callee = self.jitmap.resolve_callee(self.sf, self.info, call)
+            if callee is not None and callee.full_name in self.factories:
+                kind = self.factories[callee.full_name]
+        if kind is not None and self.kinds is not None \
+                and kind not in self.kinds:
+            return None
+        return kind
 
     def scan(self) -> List[Finding]:
         findings: List[Finding] = []
@@ -218,6 +229,17 @@ class _FuncScan:
                     if isinstance(c, ast.Name) and c.id in self.tracked:
                         self.tracked[c.id].escaped = True
             elif isinstance(n, ast.Assign):
+                # `t.daemon = True` before start(): fire-and-forget, same
+                # as daemon=True in the constructor
+                if (len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Attribute)
+                        and n.targets[0].attr == "daemon"
+                        and isinstance(n.targets[0].value, ast.Name)
+                        and n.targets[0].value.id in self.tracked
+                        and isinstance(n.value, ast.Constant)
+                        and n.value.value is True):
+                    self.tracked[n.targets[0].value.id].escaped = True
+                    continue
                 stores_out = any(isinstance(t, (ast.Attribute, ast.Subscript))
                                  for t in n.targets)
                 aliases = any(isinstance(t, ast.Name)
@@ -330,4 +352,15 @@ def run(ctx) -> List[Finding]:
         for info in sf.symbols.functions.values():
             findings.extend(
                 _FuncScan(project, sf, info, factories, jm).scan())
+    # thread discipline is package-wide: outside the scoped modules only
+    # thread creations are checked (a leaked non-daemon thread hangs
+    # interpreter shutdown wherever it is started)
+    scoped = {sf.rel for sf in files}
+    for sf in ctx.package_files():
+        if sf.rel in scoped:
+            continue
+        for info in sf.symbols.functions.values():
+            findings.extend(
+                _FuncScan(project, sf, info, factories, jm,
+                          kinds=("thread",)).scan())
     return findings

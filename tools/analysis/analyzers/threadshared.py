@@ -1,0 +1,124 @@
+"""thread-shared — cross-thread state with no common guarding lock.
+
+RacerX-style static lockset inference over the whole package: infer thread
+roots (every ``threading.Thread(target=...)`` / ``Timer`` / executor
+``submit`` whose target resolves, plus HTTP ``do_*`` handler methods),
+compute the ``self.``-attribute / mutable-module-global accesses each root
+performs transitively, and flag every field written from two or more roots
+— or written in one and read in another — whose cross-thread access set
+shares **no** common lock (the candidate lockset, intersected over every
+cross-root access's effective held set, is empty).
+
+Precision over recall, by construction:
+
+* internally-synchronized values are exempt wholesale — ``queue.Queue``
+  (and project subclasses like ``WeightedFairQueue``), ``deque``,
+  ``Event``/``Semaphore``/``Barrier``, lock objects, ``Thread`` handles;
+* pre-publication accesses don't count: ``__init__``-family methods, and
+  accesses in the thread-creating function lexically before the
+  ``.start()`` call (single-assignment-before-start handoff);
+* the guarded-caller context means a helper only ever called under a lock
+  counts as holding it (no false positive on ``_open``-style helpers);
+* functions outside every thread closure belong to the implicit
+  ``<main>`` root — a main-thread write racing a daemon-loop read is a
+  real race and is reported.
+
+Intentional lock-free sites (atomic-append journal writers, monotonic
+counters read for observability only) carry
+``# lint-ok: thread-shared <why>``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Tuple
+
+from ..core import Finding
+from ..lockmodel import _PRE_PUBLICATION, Access, FuncConc
+
+ID = "thread-shared"
+DESCRIPTION = ("fields written from two thread roots (or written in one, "
+               "read in another) with no common guarding lock")
+
+
+def run(ctx) -> List[Finding]:
+    lm = ctx.lockmodel
+    # identity -> [(root, access, func_conc)]
+    by_state: Dict[str, List[Tuple[str, Access, FuncConc]]] = {}
+    for full, fc in lm.funcs.items():
+        leaf = full.split(".")[-1]
+        pre_pub = leaf in _PRE_PUBLICATION
+        roots = lm.roots_of(full)
+        for acc in fc.accesses:
+            if pre_pub:
+                continue            # pre-publication: object not shared yet
+            if _pre_start_access(lm, full, acc):
+                continue
+            for root in roots:
+                by_state.setdefault(acc.identity, []).append(
+                    (root, acc, fc))
+
+    findings: List[Finding] = []
+    for identity, events in sorted(by_state.items()):
+        writer_roots = {r for r, a, _ in events if a.kind == "write"}
+        all_roots = {r for r, _, _ in events}
+        if not writer_roots or len(all_roots) < 2:
+            continue
+        if len(writer_roots) == 1 and all_roots == writer_roots:
+            continue
+        # candidate lockset: common lock over every cross-thread access
+        lockset: FrozenSet[str] = None  # type: ignore[assignment]
+        for _, acc, _ in events:
+            lockset = acc.held if lockset is None else (lockset & acc.held)
+        if lockset:
+            continue                    # consistently guarded
+        writes = sorted({(fc.sf.rel, a.line)
+                         for r, a, fc in events if a.kind == "write"})
+        reads = sorted({(fc.sf.rel, a.line)
+                        for r, a, fc in events if a.kind == "read"})
+        roots_desc = ", ".join(sorted(_root_label(r) for r in all_roots))
+        # report at the first unguarded write
+        first = min(((a, fc) for r, a, fc in events if a.kind == "write"
+                     and not a.held),
+                    key=lambda t: (t[1].sf.rel, t[0].line),
+                    default=None)
+        if first is None:
+            first = min(((a, fc) for r, a, fc in events
+                         if a.kind == "write"),
+                        key=lambda t: (t[1].sf.rel, t[0].line))
+        acc, fc = first
+        findings.append(Finding(
+            analyzer=ID, path=fc.sf.rel, line=acc.line, col=acc.col,
+            message=(f"`{identity}` is accessed from thread roots "
+                     f"[{roots_desc}] with no common guarding lock "
+                     f"(writes at {_sites(writes)}; reads at "
+                     f"{_sites(reads)}) — cross-thread race; guard every "
+                     "access with one lock, hand off through a "
+                     "queue/Event, or justify with "
+                     "`# lint-ok: thread-shared <why>`")))
+    return findings
+
+
+def _pre_start_access(lm, full: str, acc: Access) -> bool:
+    """Access in a thread-creating function before the `.start()` call:
+    publication-before-start, visible to the new thread by the start()
+    happens-before edge."""
+    for root in lm.roots.values():
+        if root.create_fn == full and root.start_line is not None \
+                and acc.line <= root.start_line:
+            return True
+    return False
+
+
+def _sites(sites: List[Tuple[str, int]]) -> str:
+    if not sites:
+        return "-"
+    shown = [f"{rel}:{line}" for rel, line in sites[:4]]
+    more = len(sites) - len(shown)
+    return ", ".join(shown) + (f" +{more} more" if more > 0 else "")
+
+
+def _root_label(root: str) -> str:
+    if root == "<main>":
+        return root
+    parts = root.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 1 else root

@@ -1,0 +1,1031 @@
+"""Shared concurrency model — lock identities, held sets, thread roots.
+
+The three concurrency analyzers (``lock-order``, ``thread-shared``,
+``blocking-under-lock``) and the runtime lock-order witness
+(``synapseml_tpu/testing/lockwitness.py``) all consume one
+:class:`LockModel` built once per run (``Context.lockmodel``, pre-built
+before the ``--jobs`` fork like the jit/axis maps):
+
+* **lock identities** — ``self.<attr> = threading.Lock()`` resolves per
+  class via the symbol tables to ``module.Class.attr``; a module-global
+  ``LOCK = threading.Lock()`` resolves to ``module.LOCK``. Each identity
+  remembers its definition site(s) so the runtime witness (which can only
+  see creation ``file:lineno``) can match observed locks to static ones.
+* **held sets** — every function body is walked in statement order
+  through ``with <lock>:`` blocks and ``.acquire()``/``.release()`` call
+  pairs. An *acquire-helper* that returns with a lock still held (the
+  ``ModelRegistry._acquire_swap`` pattern) "leaks" that lock to its
+  callers: the caller's held set includes it from the call statement to
+  the matching ``.release()``. Leaks reach a fixpoint over the call graph.
+* **guarded-caller context** — ``context(f)`` = the intersection over all
+  call sites of (locks held at the site ∪ the caller's own context), the
+  interprocedural generalization of the ``locks`` analyzer's per-module
+  fixpoint. A helper only ever called under a lock is treated as holding
+  it.
+* **thread roots** — every ``threading.Thread(target=...)`` /
+  ``threading.Timer`` / ``executor.submit(...)`` whose target resolves to
+  a project function, plus ``do_*``/``handle*`` methods of
+  ``*Handler``-based classes (each HTTP request runs them on its own
+  thread under ``ThreadingHTTPServer``). ``closure(root)`` is the set of
+  functions reachable from the root over resolved call edges; every
+  function outside all closures belongs to the implicit ``<main>`` root.
+* **acquisition-order edges** — ``A -> B`` when some function acquires B
+  (blocking) while A is held, either lexically or through a call chain
+  (caller holds A, callee transitively acquires B). Non-blocking acquires
+  (``acquire(blocking=False)`` — the deterministic-loser swap pattern)
+  cannot wait and are held-set *sources* but never edge *targets*.
+* **shared-state accesses** — per function, reads/writes of
+  ``self.<attr>`` (class-scoped identities) and mutable module globals
+  with the effective held set at each site, for the race inference.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import FunctionInfo, Project, SourceFile, dotted_name
+
+#: constructors that create a lock-like object (identity-tracked)
+LOCK_FACTORIES = {
+    "threading.Lock": "lock", "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "multiprocessing.Lock": "lock", "multiprocessing.RLock": "rlock",
+}
+
+#: constructors whose instances are internally synchronized — method calls
+#: on them are sanctioned cross-thread handoffs, never race findings
+SAFE_FACTORIES = {
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "collections.deque",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "multiprocessing.Queue", "multiprocessing.Event",
+} | set(LOCK_FACTORIES)
+
+#: base-class suffixes that make every instance of a subclass safe too
+#: (e.g. the project's WeightedFairQueue(queue.Queue))
+_SAFE_BASE_SUFFIXES = (".Queue", ".LifoQueue", ".PriorityQueue",
+                       ".SimpleQueue", ".deque")
+
+_PRE_PUBLICATION = {"__init__", "__post_init__", "__new__", "__enter__",
+                    "__set_name__"}
+
+#: handler-class method names that each run on their own server thread
+_HANDLER_METHOD = ("do_", "handle")
+
+
+@dataclass
+class LockInfo:
+    identity: str                       # "module.Class.attr" | "module.NAME"
+    kind: str                           # lock | rlock | condition
+    def_sites: List[Tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class Acq:
+    identity: str
+    line: int
+    col: int
+    blocking: bool
+    held_before: FrozenSet[str]
+
+
+@dataclass
+class CallSite:
+    callee: str                         # full_name
+    line: int
+    col: int
+    held: FrozenSet[str]
+
+
+@dataclass
+class Access:
+    identity: str                       # shared-state identity
+    kind: str                           # "read" | "write"
+    line: int
+    col: int
+    held: FrozenSet[str]
+
+
+@dataclass
+class BlockingCall:
+    what: str                           # human-readable callee
+    line: int
+    col: int
+    held: FrozenSet[str]
+
+
+@dataclass
+class FuncConc:
+    """Concurrency facts for one function."""
+    info: FunctionInfo
+    sf: SourceFile
+    acquires: List[Acq] = field(default_factory=list)
+    leaks: FrozenSet[str] = frozenset()     # held at return
+    calls: List[CallSite] = field(default_factory=list)
+    accesses: List[Access] = field(default_factory=list)
+    blocking: List[BlockingCall] = field(default_factory=list)
+
+
+@dataclass
+class ThreadRoot:
+    name: str                           # target function full_name
+    kind: str                           # thread | timer | submit | handler
+    create_fn: Optional[str]            # function creating/starting it
+    create_line: int
+    start_line: Optional[int] = None    # `.start()` line in create_fn
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    witness: str                        # human-readable acquisition path
+    path: str                           # "rel:line" of the acquiring site
+    funcs: FrozenSet[str] = frozenset()  # functions whose code adds it
+
+
+# -- raw per-function event stream -------------------------------------------
+
+(_E_ENTER, _E_EXIT, _E_ACQ, _E_REL, _E_CALL, _E_ACCESS, _E_BLOCK,
+ _E_SNAP, _E_RESTORE) = range(9)
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    return bool(body) and isinstance(body[-1], (ast.Raise, ast.Return,
+                                                ast.Continue, ast.Break))
+
+
+class _EventWalker(ast.NodeVisitor):
+    """Record lock/call/access events for ONE function in statement order.
+
+    Events are replayed later with callee-leak knowledge, so the walker
+    itself stays single-pass and cheap.
+    """
+
+    def __init__(self, model: "LockModel", sf: SourceFile,
+                 info: FunctionInfo):
+        self.model = model
+        self.sf = sf
+        self.info = info
+        self.events: List[tuple] = []
+        self._globals: Set[str] = set()
+
+    def walk(self) -> List[tuple]:
+        for stmt in getattr(self.info.node, "body", []):
+            self.visit(stmt)
+        return self.events
+
+    # nested defs/classes are separate functions
+    def visit_FunctionDef(self, node) -> None:
+        pass
+    visit_AsyncFunctionDef = visit_ClassDef = visit_FunctionDef
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._globals.update(node.names)
+
+    # -- early-exit branches are excursions: a release on a raise/return
+    # path (`if not acquired: release(); raise`) must not cancel the lock
+    # the fall-through path keeps holding (the acquire-helper pattern)
+    def _excursion(self, body: List[ast.stmt]) -> None:
+        wrap = _terminates(body)
+        if wrap:
+            self.events.append((_E_SNAP,))
+        for stmt in body:
+            self.visit(stmt)
+        if wrap:
+            self.events.append((_E_RESTORE,))
+
+    def visit_If(self, node: ast.If) -> None:
+        self.visit(node.test)
+        self._excursion(node.body)
+        self._excursion(node.orelse)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for stmt in node.body:
+            self.visit(stmt)
+        for handler in node.handlers:
+            self._excursion(handler.body)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        for stmt in node.finalbody:
+            self.visit(stmt)
+    visit_TryStar = visit_Try
+
+    # -- lock resolution --
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        return self.model.resolve_lock(self.sf, self.info, expr)
+
+    def visit_With(self, node: ast.With) -> None:
+        ids = []
+        for item in node.items:
+            lid = self._lock_id(item.context_expr)
+            if lid:
+                ids.append((lid, item.context_expr))
+            self.visit(item.context_expr)
+        for lid, expr in ids:
+            self.events.append((_E_ENTER, lid, expr.lineno,
+                               expr.col_offset))
+        for stmt in node.body:
+            self.visit(stmt)
+        for lid, _ in reversed(ids):
+            self.events.append((_E_EXIT, lid))
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            lid = self._lock_id(fn.value)
+            if lid is not None and fn.attr == "acquire":
+                self.events.append((_E_ACQ, lid, node.lineno,
+                                    node.col_offset,
+                                    _acquire_is_blocking(node)))
+            elif lid is not None and fn.attr == "release":
+                self.events.append((_E_REL, lid))
+        # project-internal call edge
+        callee = self.model.jitmap.resolve_callee(self.sf, self.info, node)
+        if callee is not None:
+            self.events.append((_E_CALL, callee.full_name, node.lineno,
+                                node.col_offset))
+        # blocking call?
+        desc = self.model.blocking_desc(self.sf, self.info, node)
+        if desc is not None:
+            self.events.append((_E_BLOCK, desc, node.lineno,
+                                node.col_offset))
+        # mutating method call on shared state counts as a write
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATING_METHODS:
+            sid = self.model.resolve_state(self.sf, self.info, fn.value,
+                                           self._globals)
+            if sid is not None:
+                self.events.append((_E_ACCESS, sid, "write", node.lineno,
+                                    node.col_offset))
+        self.generic_visit(node)
+
+    # -- shared-state accesses --
+    def _record_target(self, target: ast.AST, node: ast.AST) -> None:
+        if isinstance(target, ast.Subscript):
+            base = target.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            self._record_store(base, node)
+        elif isinstance(target, (ast.Attribute, ast.Name)):
+            self._record_store(target, node)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_target(elt, node)
+
+    def _record_store(self, base: ast.AST, node: ast.AST) -> None:
+        sid = self.model.resolve_state(self.sf, self.info, base,
+                                       self._globals, store=True)
+        if sid is not None:
+            self.events.append((_E_ACCESS, sid, "write", node.lineno,
+                                node.col_offset))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_target(t, node)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        # read-modify-write: both a read and a write
+        self._record_target(node.target, node)
+        sid = self.model.resolve_state(self.sf, self.info, node.target,
+                                       self._globals)
+        if sid is not None:
+            self.events.append((_E_ACCESS, sid, "read", node.lineno,
+                                node.col_offset))
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_target(node.target, node)
+            self.visit(node.value)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.ctx, ast.Load):
+            sid = self.model.resolve_state(self.sf, self.info, node,
+                                           self._globals)
+            if sid is not None:
+                self.events.append((_E_ACCESS, sid, "read", node.lineno,
+                                    node.col_offset))
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            sid = self.model.resolve_state(self.sf, self.info, node,
+                                           self._globals)
+            if sid is not None:
+                self.events.append((_E_ACCESS, sid, "read", node.lineno,
+                                    node.col_offset))
+
+
+_MUTATING_METHODS = {"append", "extend", "add", "update", "clear", "pop",
+                     "popitem", "remove", "discard", "insert",
+                     "setdefault", "sort"}
+
+
+def _acquire_is_blocking(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return False
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value is False:
+        return False
+    return True
+
+
+# -- the model ----------------------------------------------------------------
+
+#: canonical call prefixes that block on the network / a subprocess
+_BLOCKING_PREFIXES = ("requests.", "urllib.request.", "urllib3.",
+                      "http.client.", "ftplib.", "smtplib.", "subprocess.")
+_BLOCKING_EXACT = {"time.sleep", "urllib.request.urlopen",
+                   "socket.create_connection", "open"}
+#: attribute methods that block when called on a thread/queue-typed value
+_BLOCKING_METHODS = {"join": "thread", "get": "queue", "wait": "event",
+                     "recv": "socket", "accept": "socket",
+                     "connect": "socket", "sendall": "socket",
+                     "serve_forever": "server"}
+
+
+class LockModel:
+    def __init__(self, project: Project, jitmap,
+                 files: Optional[List[SourceFile]] = None):
+        self.project = project
+        self.jitmap = jitmap
+        self.files = [sf for sf in (files if files is not None
+                                    else project.files)
+                      if sf.rel.startswith("synapseml_tpu/")]
+        #: identity -> LockInfo
+        self.locks: Dict[str, LockInfo] = {}
+        #: (module, class) -> {attr: identity}; class "" = module globals
+        self._lock_attrs: Dict[Tuple[str, str], Dict[str, str]] = {}
+        #: (module, class, attr) safe-typed instance attrs / globals
+        self._safe: Set[Tuple[str, str, str]] = set()
+        #: (module, class, attr) thread-typed (for `.join()` detection)
+        self._thread_typed: Set[Tuple[str, str, str]] = set()
+        #: module -> mutable global names (written outside module level)
+        self._mutable_globals: Dict[str, Set[str]] = {}
+        self.funcs: Dict[str, FuncConc] = {}
+        self.roots: Dict[str, ThreadRoot] = {}
+        self.closures: Dict[str, Set[str]] = {}
+        self.context: Dict[str, FrozenSet[str]] = {}
+        self.edges: Dict[Tuple[str, str], Edge] = {}
+        #: functions whose body (transitively) performs a blocking call
+        self.blocks_transitively: Dict[str, str] = {}
+
+        self._discover_locks_and_types()
+        self._discover_mutable_globals()
+        events = self._collect_events()
+        self._replay(events)
+        self._find_roots()
+        self._build_closures()
+        self._context_fixpoint()
+        self._apply_context()
+        self._derive_edges()
+        self._transitive_blocking()
+
+    # -- discovery ---------------------------------------------------------
+    def _class_of(self, info: FunctionInfo) -> str:
+        return info.class_name or ""
+
+    def _discover_locks_and_types(self) -> None:
+        for sf in self.files:
+            for info in sf.symbols.functions.values():
+                cls = self._class_of(info)
+                for node in ast.walk(info.node):
+                    if not isinstance(node, ast.Assign) \
+                            or len(node.targets) != 1:
+                        continue
+                    target = node.targets[0]
+                    if not (isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self" and cls):
+                        continue
+                    self._classify_binding(sf, node.value,
+                                           (sf.module, cls, target.attr))
+            # module-level bindings
+            for node in sf.tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    self._classify_binding(
+                        sf, node.value,
+                        (sf.module, "", node.targets[0].id))
+
+    def _classify_binding(self, sf: SourceFile, value: ast.AST,
+                          key: Tuple[str, str, str]) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        canon = self.project.canonical(sf, dotted_name(value.func))
+        module, cls, attr = key
+        if canon in LOCK_FACTORIES:
+            identity = ".".join(p for p in (module, cls, attr) if p)
+            li = self.locks.setdefault(
+                identity, LockInfo(identity, LOCK_FACTORIES[canon]))
+            li.def_sites.append((sf.rel, value.lineno))
+            self._lock_attrs.setdefault((module, cls), {})[attr] = identity
+            self._safe.add(key)         # a lock object itself is never state
+        elif self._is_safe_ctor(sf, canon, value):
+            self._safe.add(key)
+        elif canon == "threading.Thread" or (canon or "").endswith(".Thread"):
+            self._thread_typed.add(key)
+            self._safe.add(key)         # Thread objects are not shared state
+
+    def _is_safe_ctor(self, sf: SourceFile, canon: Optional[str],
+                      value: ast.Call) -> bool:
+        if canon in SAFE_FACTORIES:
+            return True
+        if not canon:
+            return False
+        # a project class subclassing a safe container (WeightedFairQueue)
+        parts = canon.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            target_sf = self.project.by_module.get(mod)
+            if target_sf is None:
+                continue
+            cls = target_sf.symbols.classes.get(".".join(parts[cut:]))
+            if cls is None:
+                break
+            for base in cls.bases:
+                bcanon = self.project.canonical(target_sf,
+                                                dotted_name(base)) or ""
+                if bcanon in SAFE_FACTORIES \
+                        or bcanon.endswith(_SAFE_BASE_SUFFIXES):
+                    return True
+            # a project class that guards itself — any lock-factory binding
+            # to a self attribute in its own methods (CircuitBreaker,
+            # ConsistentHashRing, _WorkerLink) — is internally synchronized:
+            # method calls on its instances are the object's own lock's
+            # responsibility, not the holder's
+            if self._owns_lock(target_sf, cls):
+                return True
+            break
+        return False
+
+    def _owns_lock(self, sf: SourceFile, cls: ast.ClassDef) -> bool:
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Attribute) \
+                        and isinstance(node.targets[0].value, ast.Name) \
+                        and node.targets[0].value.id == "self" \
+                        and isinstance(node.value, ast.Call):
+                    canon = self.project.canonical(
+                        sf, dotted_name(node.value.func))
+                    if canon in LOCK_FACTORIES:
+                        return True
+        return False
+
+    def _discover_mutable_globals(self) -> None:
+        for sf in self.files:
+            top: Set[str] = set()
+            for node in sf.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                top.add(n.id)
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) \
+                        and isinstance(node.target, ast.Name):
+                    top.add(node.target.id)
+            written: Set[str] = set()
+            for info in sf.symbols.functions.values():
+                for n in ast.walk(info.node):
+                    if isinstance(n, ast.Global):
+                        written.update(set(n.names) & top)
+                    elif isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Attribute) \
+                            and n.func.attr in _MUTATING_METHODS \
+                            and isinstance(n.func.value, ast.Name) \
+                            and n.func.value.id in top:
+                        written.add(n.func.value.id)
+                    elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                        targets = (n.targets
+                                   if isinstance(n, ast.Assign)
+                                   else [n.target])
+                        for t in targets:
+                            base = t
+                            while isinstance(base, ast.Subscript):
+                                base = base.value
+                            if isinstance(base, ast.Name) \
+                                    and base.id in top:
+                                written.add(base.id)
+            self._mutable_globals[sf.module] = written
+
+    # -- resolution --------------------------------------------------------
+    def resolve_lock(self, sf: SourceFile, info: Optional[FunctionInfo],
+                     expr: ast.AST) -> Optional[str]:
+        """Lock identity for ``self._lock`` / ``cls._lock`` / global."""
+        name = dotted_name(expr)
+        if not name:
+            return None
+        head, _, rest = name.partition(".")
+        if head in ("self", "cls") and rest and "." not in rest \
+                and info is not None and info.class_name:
+            return self._lock_attrs.get(
+                (sf.module, info.class_name), {}).get(rest)
+        if "." not in name:
+            return self._lock_attrs.get((sf.module, ""), {}).get(name)
+        return None
+
+    def resolve_state(self, sf: SourceFile, info: Optional[FunctionInfo],
+                      expr: ast.AST, declared_globals: Set[str],
+                      store: bool = False) -> Optional[str]:
+        """Shared-state identity for an access, or None if not tracked.
+
+        ``self.<attr>`` in a method resolves class-scoped; a bare name
+        resolves to a module global only when the module mutates it
+        somewhere (constants read everywhere would drown the analysis) —
+        for stores, only under a ``global`` declaration or via
+        subscript/mutation (handled by the caller passing the base).
+        """
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" \
+                and info is not None and info.class_name:
+            key = (sf.module, info.class_name, expr.attr)
+            if key in self._safe:
+                return None
+            return f"{sf.module}.{info.class_name}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            if expr.id not in self._mutable_globals.get(sf.module, ()):
+                return None
+            if store and expr.id not in declared_globals:
+                return None         # a local rebind, not the global
+            if (sf.module, "", expr.id) in self._safe:
+                return None
+            return f"{sf.module}.{expr.id}"
+        return None
+
+    def blocking_desc(self, sf: SourceFile, info: Optional[FunctionInfo],
+                      call: ast.Call) -> Optional[str]:
+        """Human description if ``call`` is a blocking operation."""
+        canon = self.project.canonical(sf, dotted_name(call.func))
+        if canon and (canon in _BLOCKING_EXACT
+                      or canon.startswith(_BLOCKING_PREFIXES)):
+            return f"{canon}()"
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _BLOCKING_METHODS:
+            # typed receivers only: `.join()` on a Thread attr, `.get()` on
+            # a queue attr, `.wait()` on an Event — never `",".join(...)`
+            recv = fn.value
+            if isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self" \
+                    and info is not None and info.class_name:
+                key = (sf.module, info.class_name, recv.attr)
+                if fn.attr == "join" and key in self._thread_typed:
+                    return f"self.{recv.attr}.join()"
+                if fn.attr in ("get", "wait") and key in self._safe \
+                        and key not in self._thread_typed:
+                    lid = self._lock_attrs.get(
+                        (sf.module, info.class_name), {}).get(recv.attr)
+                    if lid is not None:
+                        return None     # Condition.wait handled by caller
+                    if fn.attr == "get" and not _nonblocking_get(call):
+                        return f"self.{recv.attr}.get()"
+                    if fn.attr == "wait" and not call.args \
+                            and not any(kw.arg == "timeout"
+                                        for kw in call.keywords):
+                        return f"self.{recv.attr}.wait()"
+        return None
+
+    # -- event collection / replay ----------------------------------------
+    def _collect_events(self) -> Dict[str, List[tuple]]:
+        out: Dict[str, List[tuple]] = {}
+        for sf in self.files:
+            for info in sf.symbols.functions.values():
+                fc = FuncConc(info=info, sf=sf)
+                self.funcs[info.full_name] = fc
+                out[info.full_name] = _EventWalker(self, sf, info).walk()
+        return out
+
+    def _replay(self, events: Dict[str, List[tuple]]) -> None:
+        """Replay event streams to held-set facts, with callee leaks at a
+        fixpoint (an acquire-helper's lock is held in its caller from the
+        call statement on)."""
+        leaks: Dict[str, FrozenSet[str]] = {f: frozenset() for f in events}
+        for _ in range(4):
+            changed = False
+            for full, evs in events.items():
+                end_held = self._replay_one(full, evs, leaks, record=False)
+                if leaks[full] != end_held:
+                    leaks[full] = end_held
+                    changed = True
+            if not changed:
+                break
+        for full, evs in events.items():
+            self.funcs[full].leaks = leaks[full]
+            self._replay_one(full, evs, leaks, record=True)
+
+    def _replay_one(self, full: str, evs: List[tuple],
+                    leaks: Dict[str, FrozenSet[str]],
+                    record: bool) -> FrozenSet[str]:
+        held: List[str] = []
+        snaps: List[List[str]] = []
+        fc = self.funcs[full]
+        if record:
+            fc.acquires = []
+            fc.calls = []
+            fc.accesses = []
+            fc.blocking = []
+        for ev in evs:
+            tag = ev[0]
+            if tag == _E_SNAP:
+                snaps.append(list(held))
+            elif tag == _E_RESTORE:
+                held = snaps.pop() if snaps else held
+            elif tag == _E_ENTER:
+                _, lid, line, col = ev
+                if record:
+                    fc.acquires.append(Acq(lid, line, col, True,
+                                           frozenset(held)))
+                held.append(lid)
+            elif tag == _E_EXIT:
+                _remove_last(held, ev[1])
+            elif tag == _E_ACQ:
+                _, lid, line, col, blocking = ev
+                if record:
+                    fc.acquires.append(Acq(lid, line, col, blocking,
+                                           frozenset(held)))
+                held.append(lid)
+            elif tag == _E_REL:
+                _remove_last(held, ev[1])
+            elif tag == _E_CALL:
+                _, callee, line, col = ev
+                if record:
+                    fc.calls.append(CallSite(callee, line, col,
+                                             frozenset(held)))
+                for lid in leaks.get(callee, ()):
+                    held.append(lid)
+            elif tag == _E_ACCESS:
+                if record:
+                    _, sid, kind, line, col = ev
+                    fc.accesses.append(Access(sid, kind, line, col,
+                                              frozenset(held)))
+            elif tag == _E_BLOCK:
+                if record:
+                    _, desc, line, col = ev
+                    fc.blocking.append(BlockingCall(desc, line, col,
+                                                    frozenset(held)))
+        return frozenset(held)
+
+    # -- thread roots ------------------------------------------------------
+    def _find_roots(self) -> None:
+        for sf in self.files:
+            for info in sf.symbols.functions.values():
+                self._roots_in(sf, info)
+            # handler-class methods: each request runs them on an HTTP
+            # server thread
+            for qual, cls in sf.symbols.classes.items():
+                if not self._is_handler_class(sf, cls):
+                    continue
+                for fq, finfo in sf.symbols.functions.items():
+                    leaf = fq.split(".")[-1]
+                    if fq.startswith(qual + ".") \
+                            and "." not in fq[len(qual) + 1:] \
+                            and leaf.startswith(_HANDLER_METHOD):
+                        self.roots.setdefault(finfo.full_name, ThreadRoot(
+                            name=finfo.full_name, kind="handler",
+                            create_fn=None, create_line=finfo.lineno))
+
+    def _is_handler_class(self, sf: SourceFile, cls: ast.ClassDef) -> bool:
+        for base in cls.bases:
+            bcanon = self.project.canonical(sf, dotted_name(base)) or ""
+            if bcanon.endswith("Handler"):
+                return True
+        return False
+
+    def _roots_in(self, sf: SourceFile, info: FunctionInfo) -> None:
+        starts: Dict[str, int] = {}     # var/attr name -> .start() line
+        assigned: Dict[int, str] = {}   # id(call node) -> target name
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "start":
+                base = dotted_name(node.func.value)
+                if base:
+                    starts.setdefault(base, node.lineno)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call):
+                name = dotted_name(node.targets[0])
+                if name:
+                    assigned[id(node.value)] = name
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = self.project.canonical(sf, dotted_name(node.func))
+            target_expr = None
+            kind = None
+            if canon == "threading.Thread" \
+                    or (canon or "").endswith("threading.Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target_expr = kw.value
+                kind = "thread"
+            elif canon == "threading.Timer":
+                if len(node.args) >= 2:
+                    target_expr = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "function":
+                        target_expr = kw.value
+                kind = "timer"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "submit" and node.args:
+                target_expr = node.args[0]
+                kind = "submit"
+            if target_expr is None:
+                continue
+            fake = ast.Call(func=target_expr, args=[], keywords=[])
+            ast.copy_location(fake, node)
+            callee = self.jitmap.resolve_callee(sf, info, fake)
+            if callee is None:
+                continue
+            root = self.roots.setdefault(callee.full_name, ThreadRoot(
+                name=callee.full_name, kind=kind,
+                create_fn=info.full_name, create_line=node.lineno))
+            if root.start_line is None:
+                # `t = Thread(...)` matched back to `t.start()`: writes
+                # before the start line are pre-publication for this root
+                name = assigned.get(id(node))
+                line = starts.get(name) if name else None
+                root.start_line = (line if line is not None
+                                   else node.lineno)
+
+    # -- closures / reachability ------------------------------------------
+    def _build_closures(self) -> None:
+        for root in self.roots:
+            seen: Set[str] = set()
+            work = [root]
+            while work:
+                cur = work.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                fc = self.funcs.get(cur)
+                if fc is None:
+                    continue
+                for cs in fc.calls:
+                    if cs.callee not in seen:
+                        work.append(cs.callee)
+            self.closures[root] = seen
+        self._roots_of: Dict[str, Set[str]] = {}
+        for root, clo in self.closures.items():
+            for f in clo:
+                self._roots_of.setdefault(f, set()).add(root)
+
+    def roots_of(self, full_name: str) -> Set[str]:
+        """Thread roots whose closure contains the function; a function in
+        no closure runs on the implicit ``<main>`` root."""
+        got = self._roots_of.get(full_name)
+        return set(got) if got else {"<main>"}
+
+    # -- guarded-caller context -------------------------------------------
+    def _context_fixpoint(self) -> None:
+        sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        for full, fc in self.funcs.items():
+            for cs in fc.calls:
+                sites.setdefault(cs.callee, []).append((full, cs.held))
+        ctx: Dict[str, FrozenSet[str]] = {}
+        for _ in range(6):
+            changed = False
+            for callee, ss in sites.items():
+                eff = None
+                for caller, held in ss:
+                    h = held | ctx.get(caller, frozenset())
+                    eff = h if eff is None else (eff & h)
+                eff = eff or frozenset()
+                if ctx.get(callee, frozenset()) != eff:
+                    ctx[callee] = eff
+                    changed = True
+            if not changed:
+                break
+        self.context = ctx
+
+    def _apply_context(self) -> None:
+        """Fold ``context(f)`` into every recorded held set."""
+        for full, fc in self.funcs.items():
+            extra = self.context.get(full, frozenset())
+            if not extra:
+                continue
+            fc.acquires = [Acq(a.identity, a.line, a.col, a.blocking,
+                               a.held_before | extra) for a in fc.acquires]
+            fc.calls = [CallSite(c.callee, c.line, c.col, c.held | extra)
+                        for c in fc.calls]
+            fc.accesses = [Access(a.identity, a.kind, a.line, a.col,
+                                  a.held | extra) for a in fc.accesses]
+            fc.blocking = [BlockingCall(b.what, b.line, b.col,
+                                        b.held | extra) for b in fc.blocking]
+
+    # -- acquisition-order edges ------------------------------------------
+    def _derive_edges(self) -> None:
+        # transitive blocking acquisitions: identity -> sample chain
+        tacq: Dict[str, Dict[str, str]] = {f: {} for f in self.funcs}
+        for full, fc in self.funcs.items():
+            for a in fc.acquires:
+                if a.blocking:
+                    tacq[full].setdefault(
+                        a.identity,
+                        f"`{_short(full)}` acquires `{a.identity}` at "
+                        f"{fc.sf.rel}:{a.line}")
+        for _ in range(6):
+            changed = False
+            for full, fc in self.funcs.items():
+                for cs in fc.calls:
+                    for lid, chain in tacq.get(cs.callee, {}).items():
+                        if lid not in tacq[full]:
+                            tacq[full][lid] = \
+                                f"`{_short(full)}` -> {chain}"
+                            changed = True
+            if not changed:
+                break
+        self.tacq = tacq
+
+        for full, fc in self.funcs.items():
+            # lexical nesting
+            for a in fc.acquires:
+                if not a.blocking:
+                    continue
+                for src in a.held_before:
+                    if src == a.identity:
+                        continue        # reentrant self-acquire
+                    self._add_edge(src, a.identity, full, fc.sf.rel, a.line,
+                                   f"`{_short(full)}` acquires "
+                                   f"`{a.identity}` at {fc.sf.rel}:{a.line} "
+                                   f"while holding `{src}`")
+            # call-through nesting
+            for cs in fc.calls:
+                if not cs.held:
+                    continue
+                for lid, chain in self.tacq.get(cs.callee, {}).items():
+                    for src in cs.held:
+                        if src == lid:
+                            continue
+                        self._add_edge(
+                            src, lid, full, fc.sf.rel, cs.line,
+                            f"`{_short(full)}` holds `{src}` at "
+                            f"{fc.sf.rel}:{cs.line} and calls {chain}")
+
+    def _add_edge(self, src: str, dst: str, func: str, rel: str,
+                  line: int, witness: str) -> None:
+        key = (src, dst)
+        cur = self.edges.get(key)
+        if cur is None:
+            self.edges[key] = Edge(src, dst, witness, f"{rel}:{line}",
+                                   frozenset({func}))
+        else:
+            cur.funcs = cur.funcs | {func}
+
+    # -- transitive blocking ----------------------------------------------
+    def _transitive_blocking(self) -> None:
+        out: Dict[str, str] = {}
+        for full, fc in self.funcs.items():
+            for b in fc.blocking:
+                out.setdefault(full,
+                               f"{b.what} at {fc.sf.rel}:{b.line}")
+        for _ in range(6):
+            changed = False
+            for full, fc in self.funcs.items():
+                if full in out:
+                    continue
+                for cs in fc.calls:
+                    if cs.callee in out:
+                        out[full] = (f"`{_short(cs.callee)}` "
+                                     f"({out[cs.callee]})")
+                        changed = True
+                        break
+            if not changed:
+                break
+        self.blocks_transitively = out
+
+    # -- witness support ---------------------------------------------------
+    def predicted_site_edges(self) -> Set[Tuple[Tuple[str, int],
+                                                Tuple[str, int]]]:
+        """Static edges expanded to definition-site pairs, the currency the
+        runtime witness can observe (it sees creation ``file:lineno``)."""
+        out = set()
+        for (src, dst) in self.edges:
+            for s_site in self.locks.get(src, LockInfo(src, "")).def_sites:
+                for d_site in self.locks.get(dst,
+                                             LockInfo(dst, "")).def_sites:
+                    out.add((s_site, d_site))
+        return out
+
+    def known_sites(self) -> Dict[Tuple[str, int], str]:
+        return {site: li.identity
+                for li in self.locks.values() for site in li.def_sites}
+
+
+def _remove_last(held: List[str], lid: str) -> None:
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == lid:
+            del held[i]
+            return
+
+
+def _short(full_name: str) -> str:
+    parts = full_name.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 1 else full_name
+
+
+def _nonblocking_get(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+        if kw.arg == "timeout":
+            return True                 # bounded wait: not a deadlock arm
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value is False:
+        return True
+    return False
+
+
+def find_cycles(edges: Dict[Tuple[str, str], Edge]) -> List[List[str]]:
+    """Elementary cycles in the acquisition graph (Tarjan SCCs, then one
+    representative cycle per SCC via DFS — the graphs here are tiny)."""
+    graph: Dict[str, Set[str]] = {}
+    for (src, dst) in edges:
+        graph.setdefault(src, set()).add(dst)
+        graph.setdefault(dst, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    cycles: List[List[str]] = []
+    for scc in sccs:
+        members = set(scc)
+        start = scc[0]
+        # one representative cycle: DFS from start back to start inside scc
+        path = [start]
+        seen = {start}
+
+        def dfs(cur: str) -> Optional[List[str]]:
+            for nxt in sorted(graph[cur]):
+                if nxt not in members:
+                    continue
+                if nxt == start:
+                    return list(path)
+                if nxt in seen:
+                    continue
+                seen.add(nxt)
+                path.append(nxt)
+                got = dfs(nxt)
+                if got is not None:
+                    return got
+                path.pop()
+            return None
+
+        cyc = dfs(start)
+        if cyc:
+            cycles.append(cyc)
+    return cycles

@@ -62,6 +62,7 @@ def _run_analyzers(reg, ctx, selected, jobs):
         # read it copy-on-write instead of re-deriving it N times
         _ = ctx.jitmap
         _ = ctx.axismap
+        _ = ctx.lockmodel
         _WORKER["reg"] = reg
         _WORKER["ctx"] = ctx
         mp = multiprocessing.get_context("fork")

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import json
+import math
 import os
 import sys
 import time
@@ -2220,6 +2221,136 @@ def bench_dl_overlap_pipeline(epochs=3, trials=3):
                           parity <= 1e-5}}
 
 
+def bench_dl_seq(epochs=3):
+    """Sequence-parallel attention A/B on the virtual 8-device CPU mesh
+    (same-platform ratios, valid off-chip), three arms:
+
+    1. **Training parity** — the staged-BERT config at seq 256 trained
+       under zero on a data-only mesh (unsharded attention) vs a
+       ``{"seq": 4, "data": 2}`` mesh with ring and with Ulysses routing.
+       Seq routing is scope-only (docs/dl-scaling.md "Sequence
+       parallelism"): the param tree and update math are identical, so
+       the loss trajectories must agree to <= 1e-5. Per-arm steady step
+       time is journaled as ``seq_attention`` perfmodel rows (the schema
+       of ``perfmodel.suggest_seq_attention``).
+    2. **Long sequence (8k)** — ring vs Ulysses forward at seq 8192
+       (independent algorithms: P2P KV rotation vs two all-to-alls);
+       their outputs must agree to <= 1e-5, a second journaled A/B
+       workload, and the per-host activation bytes of the sharded
+       operands must be <= 0.3x the unsharded arrays (exact sharding
+       arithmetic says 1/4; measured from addressable shard bytes,
+       allocator-independent like ``dl.per_device_state_bytes``).
+    3. **Over-budget (32k)** — a seq-32k config whose full S x S score
+       matrix (4.3 GB) exceeds the documented single-shard host budget
+       (2 GiB) runs the seq-sharded ring forward to a finite result with
+       per-ring-step block scores of only 268 MB. Parity for this regime
+       is carried by arm 1: the 32k path is the same scoped routing,
+       just a bigger shard.
+    """
+    from synapseml_tpu import dl, parallel
+    from synapseml_tpu.core import perfmodel
+    from synapseml_tpu.parallel.ring_attention import ring_self_attention
+    from synapseml_tpu.parallel.ulysses import ulysses_self_attention
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    host_budget_bytes = 2 * 1024**3   # single-shard score-matrix budget
+    rng = np.random.default_rng(0)
+
+    # --- arm 1: training parity + step-time A/B at seq 256 ---------------
+    seq_len, heads, hidden, bs = 256, 4, 64, 32
+    X = rng.integers(0, 2048, size=(128, seq_len)).astype(np.int32)
+    y = rng.integers(0, 2, size=128)
+    model = dl.staged_text_encoder(vocab_size=2048, num_classes=2,
+                                   num_stages=2, num_layers=2, hidden=hidden,
+                                   heads=heads, max_len=seq_len)
+    mesh_data = parallel.make_mesh({"data": 8})
+    mesh_seq = parallel.make_mesh({"seq": 4, "data": 2})
+
+    def run(mesh, seq_attention):
+        cfg = dl.TrainConfig(batch_size=bs, max_epochs=epochs,
+                             learning_rate=1e-3, seed=3,
+                             param_sharding="zero",
+                             seq_attention=seq_attention)
+        tr = dl.FlaxTrainer(model, cfg, mesh=mesh)
+        tr.fit(X, y)
+        steady = tr.history[1:]
+        return {"step_ms": round(min(1e3 * e["seconds"] / max(e["steps"], 1)
+                                     for e in steady), 2),
+                "losses": [round(e["loss"], 7) for e in tr.history],
+                "seq_attention": tr.stats.get("seq_attention")}
+    ref = run(mesh_data, "auto")          # no seq axis: attention unsharded
+    arms = {a: run(mesh_seq, a) for a in ("ring", "ulysses")}
+    parity = max(abs(a - b) for arm in arms.values()
+                 for a, b in zip(arm["losses"], ref["losses"]))
+    feats = perfmodel.featurize(seq_len=seq_len, heads=heads, seq_shards=4,
+                                head_dim=hidden // heads, batch=bs)
+    for aname, res in arms.items():
+        _perf_row("seq_attention", aname, feats, res["step_ms"] / 1e3,
+                  mesh=mesh_seq)
+
+    # --- arm 2: 8k forward A/B + per-host activation bytes ---------------
+    mesh_seq4 = parallel.make_mesh({"seq": 4})
+    b8, s8, h8, d8 = 1, 8192, 4, 8
+    qkv = [jnp.asarray(rng.normal(size=(b8, s8, h8, d8)), jnp.float32)
+           for _ in range(3)]
+    spec = P(None, "seq", None, None)
+    qkv_sh = [jax.device_put(a, NamedSharding(mesh_seq4, spec)) for a in qkv]
+    act_ratio = (qkv_sh[0].addressable_shards[0].data.nbytes
+                 / qkv[0].nbytes)
+
+    def timed(fn, *args, **kw):
+        out = jax.block_until_ready(fn(*args, **kw))   # compile + warm
+        best = math.inf
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(fn(*args, **kw))
+            best = min(best, time.perf_counter() - t0)
+        return out, best
+    ring_out, ring_s = timed(ring_self_attention, *qkv_sh, mesh_seq4,
+                             causal=True)
+    uly_out, uly_s = timed(ulysses_self_attention, *qkv_sh, mesh_seq4,
+                           causal=True)
+    parity_8k = float(jnp.max(jnp.abs(ring_out - uly_out)))
+    feats8k = perfmodel.featurize(seq_len=s8, heads=h8, seq_shards=4,
+                                  head_dim=d8, batch=b8)
+    _perf_row("seq_attention", "ring", feats8k, ring_s, mesh=mesh_seq4)
+    _perf_row("seq_attention", "ulysses", feats8k, uly_s, mesh=mesh_seq4)
+
+    # --- arm 3: seq-32k over the single-shard budget ----------------------
+    s32, h32, d32 = 32768, 1, 8
+    full_score_bytes = 4 * h32 * s32 * s32            # f32 S x S per head
+    shard_score_bytes = 4 * h32 * (s32 // 4) ** 2     # one ring-step block
+    q32 = jax.device_put(
+        jnp.asarray(rng.normal(size=(1, s32, h32, d32)), jnp.float32),
+        NamedSharding(mesh_seq4, spec))
+    out32, s32_s = timed(ring_self_attention, q32, q32, q32, mesh_seq4,
+                         causal=True)
+    seq32k_finite = bool(jnp.all(jnp.isfinite(out32)))
+    over_budget_ok = (full_score_bytes > host_budget_bytes
+                      and shard_score_bytes < host_budget_bytes
+                      and seq32k_finite)
+    return {"metric": "dl_seq_parity_vs_unsharded",
+            "platform": "cpu-mesh-8",   # honest provenance: never the chip
+            "value": parity,
+            "unit": ("max |loss delta| (staged-BERT seq 256, seq x 4 ring "
+                     "and ulysses vs unsharded zero, identical data/seed)"),
+            "arms": {"unsharded": ref, **arms},
+            "parity_8k_ring_vs_ulysses": parity_8k,
+            "forward_8k_s": {"ring": round(ring_s, 4),
+                             "ulysses": round(uly_s, 4)},
+            "activation_bytes_ratio": round(act_ratio, 4),
+            "seq32k": {"full_score_bytes": full_score_bytes,
+                       "shard_block_score_bytes": shard_score_bytes,
+                       "host_budget_bytes": host_budget_bytes,
+                       "forward_s": round(s32_s, 4),
+                       "finite": seq32k_finite},
+            "guard": {"seq_parity_le_1em5_vs_unsharded": parity <= 1e-5,
+                      "activation_bytes_le_0p3x": act_ratio <= 0.3,
+                      "seq32k_over_budget_sharded_ok": over_budget_ok}}
+
+
 def bench_automl_elastic(rows=1200, cols=10, folds=6):
     """Elastic successive-halving AutoML vs exhaustive CV (docs/automl.md).
 
@@ -2336,7 +2467,7 @@ def _extra_workloads():
            bench_fabric_federation,
            bench_multitenant, bench_voting_ab,
            bench_distributed_gbdt_auto, bench_dl_sharded,
-           bench_dl_overlap_pipeline, bench_oocore_gbdt,
+           bench_dl_overlap_pipeline, bench_dl_seq, bench_oocore_gbdt,
            bench_oocore_gbdt_mesh,
            bench_checkpoint_overhead, bench_elastic_recovery,
            bench_automl_elastic,
@@ -2392,8 +2523,8 @@ def main():
         _ONLY_MODE[0] = only
     if only in ("bench_voting_ab", "bench_distributed_gbdt_auto",
                 "bench_dl_sharded", "bench_dl_overlap_pipeline",
-                "bench_elastic_recovery", "bench_oocore_gbdt_mesh",
-                "bench_automl_elastic"):
+                "bench_dl_seq", "bench_elastic_recovery",
+                "bench_oocore_gbdt_mesh", "bench_automl_elastic"):
         # mesh/host workloads: virtual 8-device CPU mesh regardless of the
         # chip (the metrics are same-platform ratios or host-side recovery
         # latencies). Must be set before the

@@ -217,6 +217,35 @@ assert rec["guard"]["schedule_parity_le_1em5_vs_replicated"], \
     f"schedule loss parity above 1e-5: {rec['loss_parity_vs_replicated']}"
 EOF
 
+echo "== seq scaling guard (ring/ulysses sequence parallelism) =="
+# correctness first: ring/ulysses parity vs the reference (causal, uneven
+# heads, padding, gradients) and the scoped trainer routing, on the
+# 8-CPU-device forked mesh
+JAX_PLATFORMS=cpu python -m pytest -x -q tests/test_ring_attention.py
+JAX_PLATFORMS=cpu python - << 'EOF'
+# then the scaling claims (docs/dl-scaling.md "Sequence parallelism"):
+# seq x 4 training must match the unsharded loss trajectory to <= 1e-5
+# (scope-only routing, identical param tree), the sharded operands'
+# per-host activation bytes must be <= 0.3x unsharded, and the seq-32k
+# config whose full score matrix exceeds the single-shard host budget
+# must run seq-sharded to a finite result
+import json, subprocess, sys
+out = subprocess.run([sys.executable, "bench.py", "--only",
+                      "bench_dl_seq"],
+                     capture_output=True, text=True, check=True).stdout
+rec = json.loads(out.strip().splitlines()[-1])
+print(f"seq x 4 parity {rec['value']:.2e}; "
+      f"activation bytes {rec['activation_bytes_ratio']}x; "
+      f"8k ring/ulysses delta {rec['parity_8k_ring_vs_ulysses']:.2e}; "
+      f"32k sharded forward finite={rec['seq32k']['finite']}")
+assert rec["guard"]["seq_parity_le_1em5_vs_unsharded"], \
+    f"seq-sharded loss parity above 1e-5: {rec['arms']}"
+assert rec["guard"]["activation_bytes_le_0p3x"], \
+    f"per-host activation bytes above 0.3x: {rec['activation_bytes_ratio']}"
+assert rec["guard"]["seq32k_over_budget_sharded_ok"], \
+    f"seq-32k over-budget arm failed: {rec['seq32k']}"
+EOF
+
 echo "== out-of-core guard (streamed gbdt: parity, chaos, throughput) =="
 # correctness first: sketch/resident/sparse parity, chunk-stream chaos,
 # kill->resume bit-for-bit, the dl tail-drop regression (tests/test_oocore.py)
@@ -273,8 +302,9 @@ EOF
 
 echo "== auto-config guard (perfmodel.choose >= 0.95x best hand-tuned arm) =="
 # runs AFTER the bench-backed guards above so this very CI run's training
-# rows (gbdt router/wire, dl sharding/schedule, chunk geometry) are in the
-# journal; adds its own bucket-growth micro A/B, then asserts the learned
+# rows (gbdt router/wire, dl sharding/schedule, seq attention, chunk
+# geometry) are in the journal; adds its own bucket-growth micro A/B, then
+# asserts the learned
 # model never picks a >5%-slower config than the best hand-tuned arm on any
 # recorded family (docs/perf-model.md "Confidence / fallback rule")
 JAX_PLATFORMS=cpu python tools/autoconfig_guard.py

@@ -769,6 +769,46 @@ def suggest_pipeline_schedule(stages: float, microbatches: float,
     return dec.arm, dec
 
 
+def suggest_seq_attention(seq_len: float, heads: float, seq_shards: float,
+                          head_dim: float = 64.0, batch: float = 1.0,
+                          link_bps: Optional[float] = None,
+                          fallback: str = "ring",
+                          platform: Optional[str] = None
+                          ) -> Tuple[str, Decision]:
+    """Suggest ring vs Ulysses for seq-sharded self-attention.
+
+    Analytic prior prices per-layer wire bytes over the ``seq`` axis: ring
+    rotates the local K/V blocks ``p-1`` times (each step moves
+    ``2·B·(S/p)·H·D`` activation bytes point-to-point, overlapped with the
+    block compute), while Ulysses re-shards with four all-to-alls (q/k/v in,
+    output back), each moving ``(p-1)/p`` of the full ``B·S·H·D`` activation.
+    Ring's ppermute overlaps with compute, so its wire time is discounted;
+    Ulysses is only a candidate when heads divide by the shard count (the
+    head-scatter all-to-all needs even splits).  Recorded rows from
+    ``bench_dl_seq`` (kind ``seq_attention``) take over once captured on the
+    target fabric.
+    """
+    p = max(1.0, seq_shards)
+    S, H, D, B = (max(1.0, seq_len), max(1.0, heads), max(1.0, head_dim),
+                  max(1.0, batch))
+    elem_bytes = 4.0 * B * S * H * D
+    # probed link bandwidth when the caller has one; a nominal constant
+    # otherwise (the arm ordering is invariant to the constant)
+    link = float(link_bps) if link_bps else 1e9
+    feats = featurize(seq_len=S, heads=H, seq_shards=p, head_dim=D, batch=B)
+    # ring: (p-1) rotations of local K+V, half hidden behind block compute
+    ring_s = (p - 1.0) * 2.0 * (elem_bytes / p) / link * 0.5
+    # ulysses: 4 unoverlapped all-to-alls of (p-1)/p of the activation
+    uly_s = 4.0 * elem_bytes * (p - 1.0) / p / link
+    cands = [Candidate("seq_attention", "ring", feats,
+                       analytic_s=ring_s, config="ring")]
+    if H % p == 0:
+        cands.append(Candidate("seq_attention", "ulysses", feats,
+                               analytic_s=uly_s, config="ulysses"))
+    dec = choose(cands, fallback_arm=fallback, platform=platform)
+    return dec.arm, dec
+
+
 def suggest_stage_cuts(unit_costs: Sequence[float], num_stages: int
                        ) -> Tuple[List[int], Decision]:
     """Cost-balanced contiguous pipeline cuts (min-max stage cost by DP).
@@ -898,7 +938,8 @@ __all__ = [
     "link_bandwidth", "h2d_bandwidth",
     "suggest_kernel_variant", "suggest_wire_dtype", "suggest_bucket_growth",
     "suggest_param_sharding", "suggest_accum_steps",
-    "suggest_pipeline_schedule", "suggest_stage_cuts", "suggest_chunk_rows",
+    "suggest_pipeline_schedule", "suggest_seq_attention",
+    "suggest_stage_cuts", "suggest_chunk_rows",
     "suggest_sketch_second_pass",
     "MEASUREMENTS_JSONL", "MEASUREMENTS_JSON",
 ]

@@ -15,8 +15,9 @@ framework one.
 
 from __future__ import annotations
 
+import contextlib
 from functools import partial
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -258,12 +259,124 @@ class TextEmbedUnit(nn.Module):
         return x + pos[None, : x.shape[1]].astype(x.dtype)
 
 
+# --- sequence parallelism ----------------------------------------------------
+# When the trainer's mesh carries a ``seq`` axis, TransformerLayerUnit routes
+# its self-attention through ring_self_attention or ulysses_self_attention
+# (parallel/) instead of flax's dot_product_attention. The routing is scoped,
+# not a module field: flax modules are frozen dataclasses built by user code
+# long before the trainer knows the mesh, so the trainer activates a scope
+# around its jit traces and the layer picks it up at trace time. The variant
+# choice is a core.perfmodel decision point (suggest_seq_attention) resolved
+# by the trainer; the attention projections live in flax's MHA either way, so
+# the param tree — and therefore checkpoints and parity — is identical with
+# and without sequence sharding.
+
+_SEQ_SCOPE: list = []
+
+
+@contextlib.contextmanager
+def seq_attention_scope(mesh, variant: str = "ring",
+                        flash_interpret: bool = False):
+    """Route TransformerLayerUnit attention over ``mesh``'s ``seq`` axis for
+    every model application traced inside the scope. ``variant`` is "ring"
+    (P2P K/V rotation) or "ulysses" (all-to-all head scatter)."""
+    _SEQ_SCOPE.append((mesh, variant, flash_interpret))
+    try:
+        yield
+    finally:
+        _SEQ_SCOPE.pop()
+
+
+def active_seq_mesh():
+    """The (mesh, variant, flash_interpret) of the innermost active scope
+    whose mesh actually carries a ``seq`` axis of size > 1, else None."""
+    if not _SEQ_SCOPE:
+        return None
+    from ..parallel.mesh import SEQ_AXIS
+
+    mesh, variant, interp = _SEQ_SCOPE[-1]
+    if mesh is None or SEQ_AXIS not in mesh.shape or mesh.shape[SEQ_AXIS] < 2:
+        return None
+    return mesh, variant, interp
+
+
+def sharded_self_attention(q, k, v, mesh, variant: str = "ring",
+                           causal: bool = False, scale=None,
+                           flash_interpret: bool = False):
+    """Seq-sharded self-attention with non-divisible padding at the model
+    boundary: q/k/v [B, S, H, D] are zero-padded up to the shard grid
+    (S % seq_shards == 0), the padded keys masked inside the variant via
+    ``kv_len``, and the padded query rows sliced back off here."""
+    from ..parallel.mesh import SEQ_AXIS
+    from ..parallel.ring_attention import ring_self_attention
+    from ..parallel.ulysses import ulysses_self_attention
+
+    sp = mesh.shape[SEQ_AXIS]
+    s = q.shape[1]
+    pad = (-s) % sp
+    kv_len = s if pad else None
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
+    if variant == "ulysses":
+        out = ulysses_self_attention(q, k, v, mesh, causal=causal,
+                                     scale=scale, kv_len=kv_len,
+                                     flash_interpret=flash_interpret)
+    elif variant == "ring":
+        out = ring_self_attention(q, k, v, mesh, causal=causal, scale=scale,
+                                  kv_len=kv_len,
+                                  flash_interpret=flash_interpret)
+    else:
+        raise ValueError(f"unknown seq attention variant {variant!r}; "
+                         "expected 'ring' or 'ulysses'")
+    return out[:, :s] if pad else out
+
+
+def seq_attention_fn() -> Optional[Any]:
+    """An ``attention_fn`` for flax's MultiHeadDotProductAttention that runs
+    the scoped seq-sharded variant, or None when no scope is active (the
+    default dot_product_attention applies)."""
+    active = active_seq_mesh()
+    if active is None:
+        return None
+    mesh, variant, interp = active
+
+    def _attn(query, key, value, mask=None, dropout_rate: float = 0.0,
+              deterministic: bool = True, **_kw):
+        if mask is not None:
+            raise ValueError("sequence-parallel attention is mask-free "
+                             "(TransformerLayerUnit's contract); got a mask")
+        if dropout_rate and not deterministic:
+            raise ValueError("attention-weight dropout is unsupported under "
+                             "sequence parallelism; set dropout=0.0")
+        return sharded_self_attention(query, key, value, mesh,
+                                      variant=variant, flash_interpret=interp)
+
+    return _attn
+
+
+def model_attention_heads(model) -> int:
+    """The head count of the first TransformerLayerUnit in a (possibly
+    staged) model, or 0 when there is none — feeds the perfmodel's
+    ring-vs-ulysses features without the trainer knowing model internals."""
+    stack = [model]
+    while stack:
+        m = stack.pop(0)
+        if isinstance(m, TransformerLayerUnit):
+            return int(m.heads)
+        for attr in ("stages", "units"):
+            stack.extend(getattr(m, attr, ()) or ())
+    return 0
+
+
 class TransformerLayerUnit(nn.Module):
     """One pre-LN transformer encoder layer as a pipeline unit. Attends over
     the full window WITHOUT a padding mask — the activation flowing between
     stages stays a single array (a mask would have to ride along every
     stage), which is the right trade for the finetune-throughput benches;
-    PAD embeddings are learned instead."""
+    PAD embeddings are learned instead. Inside a ``seq_attention_scope``
+    the attention runs seq-sharded (ring or Ulysses) with an identical
+    param tree."""
 
     hidden: int
     heads: int
@@ -274,9 +387,12 @@ class TransformerLayerUnit(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         h = nn.LayerNorm(dtype=self.dtype)(x)
+        attn_fn = seq_attention_fn()
         h = nn.MultiHeadDotProductAttention(
             num_heads=self.heads, dtype=self.dtype,
-            dropout_rate=self.dropout, deterministic=not train)(h, h)
+            dropout_rate=self.dropout, deterministic=not train,
+            **({"attention_fn": attn_fn} if attn_fn is not None else {}),
+        )(h, h)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)

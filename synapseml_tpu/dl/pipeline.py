@@ -54,6 +54,7 @@ post-failure mesh restores the same state — see docs/resilience.md).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Callable, Optional
 
@@ -73,7 +74,7 @@ from ..parallel.mesh import (DATA_AXIS, STAGE_AXIS, apply_tree_shardings,
                              local_mesh_devices, mesh_process_indices,
                              stage_submeshes, tree_shardings)
 from ..parallel.transfer import device_transfer, host_fetch, share_scalars
-from .backbones import StageSequential
+from .backbones import StageSequential, seq_attention_scope
 from . import trainer as _trainer_mod
 from .trainer import (_make_tx, _restore_checkpoint, _save_checkpoint,
                       freeze_mask, per_device_state_bytes)
@@ -90,6 +91,10 @@ SUPPORTED_MATRIX = {
     "multi-process param_sharding='pipeline'": True,
     "pipeline schedule='overlap' (double-buffered stage weights)": True,
     "elastic shrink/regrow resume (zero/fsdp/pipeline, gbdt fused)": True,
+    "seq-sharded attention (mesh 'seq' axis: ring or ulysses variant)": True,
+    "seq x zero/fsdp (attention over 'seq', state over 'data')": True,
+    "seq within pipeline stage groups (fill_drain and overlap)": True,
+    "multi-process seq-sharded attention": True,
 }
 
 _SCHEDULES = ("fill_drain", "overlap")
@@ -216,17 +221,28 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
         r = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), step)
         return jax.random.fold_in(jax.random.fold_in(r, s), m)
 
+    # seq routing composes per stage: the trainer's fit-wide scope carries
+    # the GLOBAL mesh, but each stage program runs on its group's submesh —
+    # re-scoping here (at trace time, stage_submeshes keeps the data/seq
+    # axes) pins the ring ppermutes / ulysses all-to-alls to the group's own
+    # devices instead of spanning stage boundaries
+    seq_variant = getattr(tr, "_seq_variant", None)
+
     def stage_apply(s, p, bs, x, rng):
         """One stage's forward; returns (out, new_batch_stats)."""
         variables = {"params": p}
         rngs = {"dropout": rng}
-        if has_bs[s]:
-            variables["batch_stats"] = bs
-            out, mut = model.stages[s].apply(
-                variables, x, train=True, mutable=["batch_stats"], rngs=rngs)
-            return out, mut["batch_stats"]
-        out = model.stages[s].apply(variables, x, train=True, rngs=rngs)
-        return out, bs
+        scope = (seq_attention_scope(gmesh[s], seq_variant) if seq_variant
+                 else contextlib.nullcontext())
+        with scope:
+            if has_bs[s]:
+                variables["batch_stats"] = bs
+                out, mut = model.stages[s].apply(
+                    variables, x, train=True, mutable=["batch_stats"],
+                    rngs=rngs)
+                return out, mut["batch_stats"]
+            out = model.stages[s].apply(variables, x, train=True, rngs=rngs)
+            return out, bs
 
     # static per-boundary activation specs: multi-process non-owners join
     # each hop rendezvous with a ShapeDtypeStruct placeholder of this shape
@@ -606,9 +622,13 @@ def fit_pipeline(tr, X, y, valid: Optional[tuple] = None,
                 per_device_state_bytes(*stage_params, *stage_opt),
                 "stages": S, "groups": len(groups), "microbatches": M,
                 "schedule": schedule}
+    if seq_variant:
+        tr.stats["seq_attention"] = seq_variant
+    auto_info = dict(getattr(tr, "_seq_autoconfig", {}) or {})
     if sched_dec is not None:
-        tr.stats["autoconfig"] = {
-            "pipeline_schedule": sched_dec.provenance()}
+        auto_info["pipeline_schedule"] = sched_dec.provenance()
+    if auto_info:
+        tr.stats["autoconfig"] = auto_info
     guard = NonFiniteGuard(policy=cfg.nonfinite_policy,
                            counter_prefix="train")
     history = []

@@ -48,6 +48,12 @@ def hash_tokenize(texts, vocab_size: int, max_len: int) -> np.ndarray:
 
 
 class TransformerEncoder(nn.Module):
+    """``mask_free=True`` drops the PAD attention mask (PAD embeddings are
+    learned instead — the TransformerLayerUnit trade) so the attention is
+    seq-shardable: inside a ``dl.backbones.seq_attention_scope`` it routes
+    through ring/Ulysses, and outside one (predict) the unmasked default
+    computes the same values. The param tree is identical either way."""
+
     vocab_size: int = 32768
     num_layers: int = 4
     num_heads: int = 8
@@ -57,21 +63,28 @@ class TransformerEncoder(nn.Module):
     num_classes: int = 2
     dropout: float = 0.1
     dtype: Any = jnp.float32
+    mask_free: bool = False
 
     @nn.compact
     def __call__(self, ids, train: bool = True):
+        from .backbones import seq_attention_fn
+
         mask = (ids != PAD_ID)
         x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype, name="tok_embed")(ids)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (self.max_len, self.hidden))
         x = x + pos[None, : ids.shape[1]].astype(self.dtype)
-        attn_mask = mask[:, None, None, :] & mask[:, None, :, None]
+        attn_mask = (None if self.mask_free
+                     else mask[:, None, None, :] & mask[:, None, :, None])
+        seq_fn = seq_attention_fn() if self.mask_free else None
         for i in range(self.num_layers):
             y = nn.LayerNorm(dtype=self.dtype)(x)
             y = nn.MultiHeadDotProductAttention(
                 num_heads=self.num_heads, dtype=self.dtype,
                 dropout_rate=self.dropout, deterministic=not train,
-                name=f"attn_{i}")(y, y, mask=attn_mask)
+                name=f"attn_{i}",
+                **({"attention_fn": seq_fn} if seq_fn is not None else {}),
+            )(y, y, mask=attn_mask)
             x = x + y
             y = nn.LayerNorm(dtype=self.dtype)(x)
             y = nn.Dense(self.hidden * self.mlp_ratio, dtype=self.dtype)(y)
@@ -97,6 +110,15 @@ class DeepTextClassifier(Estimator, HasLabelCol, HasPredictionCol):
     hiddenSize = Param("hiddenSize", "Hidden width", int, 256)
     precision = Param("precision", "float32 or bfloat16 compute", str, "float32")
     seed = Param("seed", "Random seed", int, 0)
+    seqParallel = Param(
+        "seqParallel", "Shard attention over a mesh 'seq' axis (mask-free "
+        "attention; attention dropout disabled)", bool, False)
+    seqAxisSize = Param(
+        "seqAxisSize", "Devices on the 'seq' mesh axis (0 = all local "
+        "devices)", int, 0)
+    seqAttention = Param(
+        "seqAttention", "Sequence-attention variant: auto (perfmodel-routed) "
+        "/ ring / ulysses", str, "auto")
 
     def _fit(self, df: Table) -> "DeepTextModel":
         texts = list(df[self.getTextCol()])
@@ -107,18 +129,30 @@ class DeepTextClassifier(Estimator, HasLabelCol, HasPredictionCol):
             return self._fit_hf(texts, y, classes)
 
         ids = hash_tokenize(texts, self.getVocabSize(), self.getMaxTokenLen())
+        seq_on = bool(self.getSeqParallel())
+        mesh = None
+        if seq_on:
+            from ..parallel.mesh import make_mesh
+
+            devs = jax.devices()
+            sp = self.getSeqAxisSize() or len(devs)
+            dp = max(1, len(devs) // sp)
+            mesh = make_mesh({"data": dp, "seq": sp}, devices=devs[: dp * sp])
         model = TransformerEncoder(
             vocab_size=self.getVocabSize(), num_layers=self.getNumLayers(),
             num_heads=self.getNumHeads(), hidden=self.getHiddenSize(),
             max_len=self.getMaxTokenLen(), num_classes=len(classes),
-            dtype=jnp.bfloat16 if self.getPrecision() == "bfloat16" else jnp.float32)
+            dtype=jnp.bfloat16 if self.getPrecision() == "bfloat16" else jnp.float32,
+            mask_free=seq_on, dropout=0.0 if seq_on else 0.1)
         cfg = TrainConfig(batch_size=self.getBatchSize(), max_epochs=self.getMaxEpochs(),
                           learning_rate=self.getLearningRate(), optimizer=self.getOptimizer(),
-                          compute_dtype=self.getPrecision(), seed=self.getSeed())
-        trainer = FlaxTrainer(model, cfg)
+                          compute_dtype=self.getPrecision(), seed=self.getSeed(),
+                          seq_parallel=seq_on, seq_attention=self.getSeqAttention())
+        trainer = FlaxTrainer(model, cfg, mesh=mesh)
         trainer.fit(ids, y, log_fn=lambda ep: self._log_base("epoch", ep))
 
         m = DeepTextModel(trainer=trainer, classes=classes)
+        m.set("seqParallel", seq_on)
         m.set("vocabSize", self.getVocabSize())
         m.set("maxTokenLen", self.getMaxTokenLen())
         m.set("numLayers", self.getNumLayers())
@@ -210,6 +244,9 @@ class DeepTextModel(Model, HasPredictionCol):
     numLayers = Param("numLayers", "Encoder layers", int, 4)
     numHeads = Param("numHeads", "Attention heads", int, 8)
     hiddenSize = Param("hiddenSize", "Hidden width", int, 256)
+    seqParallel = Param(
+        "seqParallel", "Model was trained mask-free for seq sharding", bool,
+        False)
 
     # class-level defaults: instances materialized by PipelineStage.load
     # bypass __init__
@@ -276,7 +313,8 @@ class DeepTextModel(Model, HasPredictionCol):
         model = TransformerEncoder(
             vocab_size=self.getVocabSize(), num_layers=self.getNumLayers(),
             num_heads=self.getNumHeads(), hidden=self.getHiddenSize(),
-            max_len=self.getMaxTokenLen(), num_classes=len(self.classes))
+            max_len=self.getMaxTokenLen(), num_classes=len(self.classes),
+            mask_free=bool(self.getSeqParallel()))
         trainer = FlaxTrainer(model, TrainConfig())
         trainer.init(np.zeros((1, self.getMaxTokenLen()), np.int32))
         with open(os.path.join(path, "params.msgpack"), "rb") as f:

@@ -24,7 +24,9 @@ trainable leaves; frozen leaves get zero updates via optax.masked.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import os
 import re
 import time
 from typing import Callable, Iterator, Optional, Tuple
@@ -120,6 +122,19 @@ class TrainConfig:
     # (analytic bubble model, displaced by recorded dl_pipeline_schedule
     # rows); provenance lands in trainer.stats["autoconfig"].
     pipeline_schedule: str = "fill_drain"  # fill_drain | overlap | auto
+    # sequence parallelism (docs/dl-scaling.md "Sequence parallelism"): when
+    # the mesh carries a "seq" axis (parallel.make_mesh({"seq": p, ...})),
+    # TransformerLayerUnit self-attention runs seq-sharded — "ring" rotates
+    # K/V blocks around the axis (P2P ppermute + online softmax), "ulysses"
+    # re-shards seq<->heads with two all-to-alls and runs exact per-device
+    # attention (needs heads % seq_shards == 0). "auto" defers the variant
+    # to core.perfmodel.suggest_seq_attention (wire-byte prior, displaced by
+    # recorded seq_attention rows from bench_dl_seq; fallback "ring"); the
+    # SYNAPSEML_TPU_SEQ_ATTENTION env var overrides everything, and Decision
+    # provenance lands in trainer.stats["autoconfig"]["seq_attention"].
+    # seq_parallel=False ignores the seq axis entirely (attention unsharded).
+    seq_parallel: bool = True
+    seq_attention: str = "auto"  # auto | ring | ulysses
 
 
 def _make_tx(cfg: TrainConfig, total_steps: int, trainable_mask=None):
@@ -288,19 +303,88 @@ class FlaxTrainer:
                 cfg.accum_steps = 1
         return info
 
+    def _resolve_seq_attention(self, cfg: TrainConfig, X):
+        """Resolve sequence-parallel attention routing for this fit.
+
+        Returns ``(scope, info)``: the context manager the fit body traces
+        its jits under (``backbones.seq_attention_scope``, or a nullcontext
+        when the mesh carries no ``seq`` axis / ``seq_parallel=False``) and
+        Decision provenance for ``stats["autoconfig"]``. The variant
+        resolves as: ``SYNAPSEML_TPU_SEQ_ATTENTION`` env override >
+        explicit ``cfg.seq_attention`` > ``perfmodel.suggest_seq_attention``
+        (fallback "ring" — model failure never blocks training). Unknown
+        variant names raise the structured :class:`ElasticUnsupportedError`
+        carrying the dl-scaling SUPPORTED_MATRIX.
+        """
+        self._seq_variant = None
+        if cfg.seq_attention not in ("auto", "ring", "ulysses"):
+            from ..parallel.elastic import ElasticUnsupportedError
+            from .pipeline import SUPPORTED_MATRIX
+
+            raise ElasticUnsupportedError(
+                f"seq attention variant {cfg.seq_attention!r}",
+                matrix=SUPPORTED_MATRIX,
+                hint="seq_attention must be one of: auto | ring | ulysses")
+        from ..parallel.mesh import SEQ_AXIS
+
+        sp = (int(dict(self.mesh.shape).get(SEQ_AXIS, 1))
+              if self.mesh is not None else 1)
+        if not cfg.seq_parallel or sp < 2:
+            return contextlib.nullcontext(), {}
+        env = os.environ.get("SYNAPSEML_TPU_SEQ_ATTENTION", "").strip().lower()
+        info: dict = {}
+        variant = cfg.seq_attention
+        if env in ("ring", "ulysses"):
+            variant = env
+            info["seq_attention"] = {"arm": env, "source": "env",
+                                     "fallback_used": False}
+        elif variant == "auto":
+            from .backbones import model_attention_heads
+
+            heads = model_attention_heads(self.model)
+            seq_len = int(np.asarray(X).shape[1]) if np.ndim(X) >= 2 else 0
+            try:
+                from ..core import perfmodel
+
+                variant, dec = perfmodel.suggest_seq_attention(
+                    float(seq_len or sp), float(heads or sp), float(sp),
+                    batch=float(cfg.batch_size))
+                info["seq_attention"] = dec.provenance()
+            except Exception:  # model failure must never block training
+                variant = "ring"
+        else:
+            info["seq_attention"] = {"arm": variant, "source": "explicit",
+                                     "fallback_used": False}
+        from .backbones import seq_attention_scope
+
+        self._seq_variant = variant
+        return seq_attention_scope(self.mesh, variant), info
+
     # --- train ----------------------------------------------------------
     def fit(self, X, y, valid: Optional[tuple] = None,
             log_fn: Optional[Callable] = None):
         cfg = self.cfg
-        if cfg.param_sharding == "pipeline":
-            from .pipeline import fit_pipeline
+        # seq routing is scoped around the WHOLE fit body: every jit traced
+        # inside (train_step, the per-stage pipeline programs) picks up the
+        # seq-sharded attention at trace time
+        seq_scope, seq_info = self._resolve_seq_attention(cfg, X)
+        self._seq_autoconfig = seq_info
+        with seq_scope:
+            if cfg.param_sharding == "pipeline":
+                from .pipeline import fit_pipeline
 
-            return fit_pipeline(self, X, y, valid=valid, log_fn=log_fn)
+                return fit_pipeline(self, X, y, valid=valid, log_fn=log_fn)
+            return self._fit_spmd(X, y, valid=valid, log_fn=log_fn)
+
+    def _fit_spmd(self, X, y, valid: Optional[tuple] = None,
+                  log_fn: Optional[Callable] = None):
+        cfg = self.cfg
         X = np.asarray(X)
         y = np.asarray(y)
         if self.params is None:
             self.init(X)
         autoconfig_info = self._resolve_autoconfig(cfg)
+        autoconfig_info.update(getattr(self, "_seq_autoconfig", {}))
         if cfg.param_sharding not in ("replicated", "zero", "fsdp"):
             raise ValueError(
                 f"unknown param_sharding {cfg.param_sharding!r}; expected "
@@ -449,6 +533,8 @@ class FlaxTrainer:
                     opt_state = apply_tree_shardings(opt_state, opt_sh)
         self.stats = {"state_bytes_per_device":
                       per_device_state_bytes(params, opt_state)}
+        if getattr(self, "_seq_variant", None):
+            self.stats["seq_attention"] = self._seq_variant
         if autoconfig_info:
             self.stats["autoconfig"] = autoconfig_info
         guard = NonFiniteGuard(policy=cfg.nonfinite_policy,

@@ -29,17 +29,25 @@ from ..core.compat import shard_map
 from .mesh import SEQ_AXIS
 
 
-def _block_attention(q, k, v, m, l, o, q_offset, k_offset, causal, scale):
+def _block_attention(q, k, v, m, l, o, q_offset, k_offset, causal, scale,
+                     kv_len=None):
     """One blockwise online-softmax update.
 
     q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m,l: [B, H, Sq]; o: [B, Sq, H, D].
     Offsets are the blocks' global sequence starts (for causal masking).
+    ``kv_len`` masks keys at global positions >= kv_len — the padded tail
+    when a non-divisible sequence was padded up to the shard grid.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Sq, Sk]
+    mask = None
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[1])
         k_pos = k_offset + jnp.arange(k.shape[1])
         mask = q_pos[:, None] >= k_pos[None, :]
+    if kv_len is not None:
+        valid = ((k_offset + jnp.arange(k.shape[1])) < kv_len)[None, :]
+        mask = valid if mask is None else mask & valid
+    if mask is not None:
         s = jnp.where(mask[None, None], s, -jnp.inf)
     m_new = jnp.maximum(m, s.max(axis=-1))          # [B, H, Sq]
     # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) -> use 0
@@ -59,13 +67,25 @@ def _finalize(m, l, o):
 
 
 def attention_reference(q, k, v, causal: bool = False,
-                        scale: Optional[float] = None) -> jnp.ndarray:
-    """Plain single-device attention (the correctness oracle for the ring)."""
+                        scale: Optional[float] = None,
+                        kv_len: Optional[int] = None) -> jnp.ndarray:
+    """Plain single-device attention (the correctness oracle for the ring).
+
+    ``kv_len`` masks key positions >= kv_len (padding introduced when a
+    non-divisible sequence was padded to the shard grid); rows of padded
+    queries still normalize over the real keys, and the caller slices them
+    off after unpadding.
+    """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    n_q, n_k = q.shape[1], k.shape[1]
+    mask = None
     if causal:
-        n_q, n_k = q.shape[1], k.shape[1]
         mask = jnp.arange(n_q)[:, None] >= jnp.arange(n_k)[None, :]
+    if kv_len is not None:
+        valid = (jnp.arange(n_k) < kv_len)[None, :]
+        mask = valid if mask is None else mask & valid
+    if mask is not None:
         s = jnp.where(mask[None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
@@ -75,7 +95,8 @@ def ring_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
                         scale: Optional[float] = None,
                         axis: str = SEQ_AXIS,
                         use_flash: Optional[bool] = None,
-                        flash_interpret: bool = False) -> jnp.ndarray:
+                        flash_interpret: bool = False,
+                        kv_len: Optional[int] = None) -> jnp.ndarray:
     """Exact self-attention with q/k/v sharded on ``axis`` over ``mesh``.
 
     Each of the R ring ranks holds S/R of the sequence; the result equals
@@ -96,6 +117,10 @@ def ring_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
 
         use_flash = (jax.default_backend() == "tpu"
                      and _tpu_flash_block_selftest())
+    if kv_len is not None:
+        # padded (non-divisible) sequences need the global key-validity mask,
+        # which the fused block kernel does not plumb — XLA path only
+        use_flash = False
     if use_flash:
         from ..ops.attention_kernel import flash_attention_block
     ring = mesh.shape[axis]
@@ -134,7 +159,7 @@ def ring_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
                 m, l, o = _block_attention(
                     q_blk.astype(jnp.float32), k_cur.astype(jnp.float32),
                     v_cur.astype(jnp.float32), m, l, o, q_offset, k_offset,
-                    causal, scale)
+                    causal, scale, kv_len=kv_len)
             # rotate K/V to the next rank (overlaps next step's compute)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)

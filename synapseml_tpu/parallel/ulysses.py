@@ -22,6 +22,8 @@ parity-plus, designed in from the start per the distributed-first mandate.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -31,12 +33,21 @@ from .ring_attention import attention_reference
 
 
 def ulysses_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
-                           scale=None):
+                           scale=None, use_flash: Optional[bool] = None,
+                           flash_interpret: bool = False,
+                           kv_len: Optional[int] = None):
     """Self-attention over sequence-sharded inputs via all-to-all re-sharding.
 
     q/k/v: [B, S, H, D] GLOBAL shapes, sharded [data, seq, None, None] on
     ``mesh``. The number of heads H must be divisible by the seq-axis size.
     Returns the attention output with the same sharding as the inputs.
+
+    ``use_flash`` runs the per-device full-sequence attention through the
+    fused Pallas kernel (ops/attention_kernel.flash_attention) instead of
+    the lax-composed reference. None = auto: on TPU when the kernel's
+    on-device selftest passes. ``kv_len`` masks padded key positions when a
+    non-divisible sequence was padded to the shard grid (forces the
+    reference path, which plumbs the mask).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -44,6 +55,15 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
     if q.shape[2] % sp:
         raise ValueError(f"heads ({q.shape[2]}) must divide by the seq-axis "
                          f"size ({sp}) for Ulysses attention")
+    if use_flash is None:
+        from ..ops.attention_kernel import _tpu_flash_selftest
+
+        use_flash = (jax.default_backend() == "tpu"
+                     and _tpu_flash_selftest())
+    if kv_len is not None:
+        use_flash = False
+    if use_flash:
+        from ..ops.attention_kernel import flash_attention
 
     def _ulysses(q_blk, k_blk, v_blk):
         # per-device blocks: [B_l, S/p, H, D]
@@ -59,8 +79,14 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
                                       concat_axis=2, tiled=True)
 
         qh, kh, vh = seq_to_heads(q_blk), seq_to_heads(k_blk), seq_to_heads(v_blk)
-        # full sequence per device -> exact attention (the in-repo oracle)
-        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+        # full sequence per device -> exact attention: one fused flash call
+        # on the MXU when available, the lax-composed oracle otherwise
+        if use_flash:
+            out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                                  interpret=flash_interpret)
+        else:
+            out = attention_reference(qh, kh, vh, causal=causal, scale=scale,
+                                      kv_len=kv_len)
         return heads_to_seq(out)
 
     batch_axis = (DATA_AXIS if DATA_AXIS in mesh.shape

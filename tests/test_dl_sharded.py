@@ -403,6 +403,90 @@ class TestShardedStoreRoundtrip:
             load_sharded_from_checkpoint(store, ckpt, bad)
 
 
+def _text_setup(n=64, seq=32, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.integers(0, vocab, size=(n, seq)).astype(np.int32)
+    y = rng.integers(0, 2, size=n)
+    model = dl.staged_text_encoder(vocab_size=vocab, num_classes=2,
+                                   num_stages=2, num_layers=2, hidden=32,
+                                   heads=4, max_len=seq)
+    return model, X, y
+
+
+class TestSeqParallel:
+    """The `seq` mesh axis as a first-class matrix cell: scoped ring/ulysses
+    routing composed with ZeRO and with both pipeline schedules must
+    reproduce the unsharded loss trajectory (same math, same param tree,
+    different placement)."""
+
+    @pytest.mark.parametrize("variant", ["ring", "ulysses", "auto"])
+    def test_zero_seq_parity(self, eight_devices, variant):
+        model, X, y = _text_setup()
+        ref = dl.FlaxTrainer(model, _cfg(param_sharding="zero"),
+                             mesh=parallel.make_mesh({"data": 8}))
+        ref.fit(X, y)
+        tr = dl.FlaxTrainer(model, _cfg(param_sharding="zero",
+                                        seq_attention=variant),
+                            mesh=parallel.make_mesh({"seq": 4, "data": 2}))
+        tr.fit(X, y)
+        np.testing.assert_allclose(_losses(tr), _losses(ref), atol=1e-5)
+        assert tr.stats["seq_attention"] in ("ring", "ulysses")
+        if variant != "auto":
+            assert tr.stats["seq_attention"] == variant
+        prov = tr.stats["autoconfig"]["seq_attention"]
+        assert prov["arm"] == tr.stats["seq_attention"]
+
+    @pytest.mark.parametrize("schedule", ["fill_drain", "overlap"])
+    def test_pipeline_seq_parity(self, eight_devices, schedule):
+        model, X, y = _text_setup()
+        ref = dl.FlaxTrainer(model, _cfg(), mesh=parallel.make_mesh(
+            {"data": 8}))
+        ref.fit(X, y)
+        tr = dl.FlaxTrainer(
+            model, _cfg(param_sharding="pipeline", pipeline_microbatches=2,
+                        pipeline_param_sharding="zero",
+                        pipeline_schedule=schedule, seq_attention="ring"),
+            mesh=parallel.make_mesh({"stage": 2, "seq": 2, "data": 2}))
+        tr.fit(X, y)
+        np.testing.assert_allclose(_losses(tr), _losses(ref), atol=1e-5)
+        assert tr.stats["seq_attention"] == "ring"
+
+    def test_env_override_beats_config(self, eight_devices, monkeypatch):
+        monkeypatch.setenv("SYNAPSEML_TPU_SEQ_ATTENTION", "ulysses")
+        model, X, y = _text_setup()
+        tr = dl.FlaxTrainer(model, _cfg(max_epochs=1, param_sharding="zero",
+                                        seq_attention="ring"),
+                            mesh=parallel.make_mesh({"seq": 4, "data": 2}))
+        tr.fit(X, y)
+        assert tr.stats["seq_attention"] == "ulysses"
+        assert tr.stats["autoconfig"]["seq_attention"]["source"] == "env"
+
+    def test_seq_parallel_off_ignores_axis(self, eight_devices):
+        model, X, y = _text_setup()
+        ref = dl.FlaxTrainer(model, _cfg(param_sharding="zero"),
+                             mesh=parallel.make_mesh({"data": 8}))
+        ref.fit(X, y)
+        tr = dl.FlaxTrainer(model, _cfg(param_sharding="zero",
+                                        seq_parallel=False),
+                            mesh=parallel.make_mesh({"seq": 4, "data": 2}))
+        tr.fit(X, y)
+        np.testing.assert_allclose(_losses(tr), _losses(ref), atol=1e-5)
+        assert "seq_attention" not in tr.stats
+
+    def test_unknown_variant_structured_error(self, eight_devices):
+        from synapseml_tpu.dl.pipeline import SUPPORTED_MATRIX
+        from synapseml_tpu.parallel.elastic import ElasticUnsupportedError
+
+        model, X, y = _text_setup()
+        tr = dl.FlaxTrainer(model, _cfg(seq_attention="megatron"),
+                            mesh=parallel.make_mesh({"seq": 4, "data": 2}))
+        with pytest.raises(ElasticUnsupportedError) as ei:
+            tr.fit(X, y)
+        assert ei.value.matrix == SUPPORTED_MATRIX
+        assert any("seq" in k for k in ei.value.matrix)
+        assert all(ei.value.matrix.values())
+
+
 class TestScalingMatrixDocsSync:
     def test_docs_table_matches_supported_matrix(self):
         """docs/dl-scaling.md renders the supported-config matrix verbatim;

@@ -146,3 +146,206 @@ class TestRingWithFlashKernel:
                                              use_flash=True,
                                              flash_interpret=True))
         np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_inner_ulysses_matches_reference(self, causal):
+        """Ulysses with the fused per-shard kernel (each device holds the
+        full sequence for its head slice after the first all-to-all, so the
+        kernel runs unmodified) must equal plain attention."""
+        from synapseml_tpu.parallel.ulysses import ulysses_self_attention
+
+        q, k, v = _qkv(h=4, d=16)
+        mesh = make_mesh({"seq": 4})
+        ref = np.asarray(attention_reference(q, k, v, causal=causal))
+        out = np.asarray(ulysses_self_attention(q, k, v, mesh, causal=causal,
+                                                use_flash=True,
+                                                flash_interpret=True))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+class TestNonDivisibleSeq:
+    """Padding/unpadding at the model boundary (dl.backbones.
+    sharded_self_attention) with kv_len key-validity masking inside the
+    variants: a sequence that does not divide the shard count must still
+    match the unpadded reference exactly."""
+
+    @pytest.mark.parametrize("variant", ["ring", "ulysses"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_padded_matches_reference(self, variant, causal):
+        from synapseml_tpu.dl.backbones import sharded_self_attention
+
+        q, k, v = _qkv(s=30, h=4)          # 30 % 4 != 0 -> pad to 32
+        mesh = make_mesh({"seq": 4})
+        ref = np.asarray(attention_reference(q, k, v, causal=causal))
+        out = np.asarray(sharded_self_attention(q, k, v, mesh,
+                                                variant=variant,
+                                                causal=causal))
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_divisible_passthrough(self):
+        from synapseml_tpu.dl.backbones import sharded_self_attention
+
+        q, k, v = _qkv(s=32, h=4)
+        mesh = make_mesh({"seq": 4})
+        ref = np.asarray(attention_reference(q, k, v, causal=True))
+        out = np.asarray(sharded_self_attention(q, k, v, mesh, causal=True))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_unknown_variant_rejected(self):
+        from synapseml_tpu.dl.backbones import sharded_self_attention
+
+        q, k, v = _qkv(s=32, h=4)
+        mesh = make_mesh({"seq": 4})
+        with pytest.raises(ValueError, match="variant"):
+            sharded_self_attention(q, k, v, mesh, variant="megatron")
+
+
+class TestUnevenHeads:
+    """heads % seq_shards != 0: ring shards seq only and still works;
+    Ulysses (which scatters heads) must refuse; the perfmodel router must
+    never offer the infeasible arm."""
+
+    def test_ring_three_heads_four_shards(self):
+        q, k, v = _qkv(h=3)
+        mesh = make_mesh({"seq": 4})
+        ref = np.asarray(attention_reference(q, k, v, causal=True))
+        out = np.asarray(ring_self_attention(q, k, v, mesh, causal=True))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_ulysses_three_heads_four_shards_raises(self):
+        from synapseml_tpu.parallel.ulysses import ulysses_self_attention
+
+        q, k, v = _qkv(h=3)
+        mesh = make_mesh({"seq": 4})
+        with pytest.raises(ValueError, match="heads"):
+            ulysses_self_attention(q, k, v, mesh)
+
+    def test_perfmodel_excludes_infeasible_ulysses(self):
+        from synapseml_tpu.core import perfmodel
+
+        arm, dec = perfmodel.suggest_seq_attention(8192.0, 3.0, 4.0)
+        assert arm == "ring"
+        prov = dec.provenance()
+        assert all(c["arm"] != "ulysses" for c in prov["candidates"])
+
+    def test_perfmodel_offers_ulysses_when_divisible(self):
+        from synapseml_tpu.core import perfmodel
+
+        arm, dec = perfmodel.suggest_seq_attention(8192.0, 8.0, 4.0)
+        prov = dec.provenance()
+        assert {c["arm"] for c in prov["candidates"]} == {"ring", "ulysses"}
+
+
+class TestBf16Tolerance:
+    """bf16 inputs through both variants stay within bf16 resolution of the
+    f32 reference (~1e-2 relative: 8 mantissa bits)."""
+
+    @pytest.mark.parametrize("variant", ["ring", "ulysses"])
+    def test_bf16_within_bounds(self, variant):
+        import jax.numpy as jnp
+
+        from synapseml_tpu.parallel.ulysses import ulysses_self_attention
+
+        q, k, v = _qkv(h=4)
+        mesh = make_mesh({"seq": 4})
+        ref = np.asarray(attention_reference(q, k, v, causal=True))
+        fn = (ring_self_attention if variant == "ring"
+              else ulysses_self_attention)
+        qb, kb, vb = (jnp.asarray(x, jnp.bfloat16) for x in (q, k, v))
+        out = np.asarray(fn(qb, kb, vb, mesh, causal=True), np.float32)
+        np.testing.assert_allclose(out, ref, rtol=5e-2, atol=2e-2)
+
+
+class TestGradientParity:
+    """Both variants are reverse-differentiable (the ring's fori_loop has
+    static bounds, so it lowers through scan) and their grads match the
+    reference attention's."""
+
+    @pytest.mark.parametrize("variant", ["ring", "ulysses"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, variant, causal):
+        import jax
+        import jax.numpy as jnp
+
+        from synapseml_tpu.parallel.ulysses import ulysses_self_attention
+
+        q, k, v = _qkv(h=4)
+        mesh = make_mesh({"seq": 4})
+        fn = (ring_self_attention if variant == "ring"
+              else ulysses_self_attention)
+
+        def loss_sharded(q, k, v):
+            return jnp.sum(fn(q, k, v, mesh, causal=causal) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+        g_sh = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+        g_rf = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_sh, g_rf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+
+class TestScopedRouting:
+    """seq_attention_scope routes TransformerLayerUnit's attention through
+    the sharded variants at trace time with an IDENTICAL param tree, so the
+    same params produce the same activations in and out of scope."""
+
+    def _layer_and_params(self):
+        import jax
+
+        from synapseml_tpu.dl.backbones import TransformerLayerUnit
+
+        layer = TransformerLayerUnit(hidden=32, heads=4, mlp_dim=64)
+        x = np.random.default_rng(0).normal(size=(2, 32, 32)).astype(
+            np.float32)
+        params = layer.init(jax.random.PRNGKey(0), x, train=False)
+        return layer, params, x
+
+    @pytest.mark.parametrize("variant", ["ring", "ulysses"])
+    def test_in_scope_matches_out_of_scope(self, variant):
+        from synapseml_tpu.dl.backbones import seq_attention_scope
+
+        layer, params, x = self._layer_and_params()
+        ref = np.asarray(layer.apply(params, x, train=False))
+        mesh = make_mesh({"seq": 4})
+        with seq_attention_scope(mesh, variant):
+            out = np.asarray(layer.apply(params, x, train=False))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_param_tree_identical_under_scope(self):
+        import jax
+
+        from synapseml_tpu.dl.backbones import (TransformerLayerUnit,
+                                                seq_attention_scope)
+
+        layer, params, x = self._layer_and_params()
+        mesh = make_mesh({"seq": 4})
+        with seq_attention_scope(mesh, "ring"):
+            params_sc = TransformerLayerUnit(
+                hidden=32, heads=4, mlp_dim=64).init(
+                    jax.random.PRNGKey(0), x, train=False)
+        assert (jax.tree_util.tree_structure(params)
+                == jax.tree_util.tree_structure(params_sc))
+
+    def test_mask_rejected_under_scope(self):
+        """The scoped attention_fn is mask-free by contract (dl-scaling
+        docs): a model passing an attention mask must fail loudly, not
+        silently drop it."""
+        from synapseml_tpu.dl.backbones import (seq_attention_fn,
+                                                seq_attention_scope)
+
+        mesh = make_mesh({"seq": 4})
+        with seq_attention_scope(mesh, "ring"):
+            fn = seq_attention_fn()
+            assert fn is not None
+            q = np.zeros((1, 8, 2, 4), np.float32)
+            with pytest.raises(ValueError, match="mask"):
+                fn(q, q, q, mask=np.ones((1, 1, 8, 8), bool))
+
+    def test_no_scope_returns_none(self):
+        from synapseml_tpu.dl.backbones import seq_attention_fn
+
+        assert seq_attention_fn() is None

@@ -140,6 +140,126 @@ def test_collectives_accepts_in_scope_axis(tmp_path):
     assert collectives.run(ctx) == []
 
 
+def test_axismap_learns_seq_axis(tmp_path):
+    """make_mesh({"seq": p, "data": d}) binds the seq axis: the axis env of
+    a shard_map'd ring step is complete and includes 'seq'."""
+    ctx = _ctx(tmp_path, {
+        "synapseml_tpu/compat.py": _COMPAT,
+        "synapseml_tpu/mod.py": """\
+        import jax
+        from synapseml_tpu.compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def make_mesh(shape):
+            return jax.make_mesh(tuple(shape.values()), tuple(shape))
+
+        mesh = make_mesh({"seq": 4, "data": 2})
+
+        def _ring_step(k):
+            perm = [(i, (i + 1) % 4) for i in range(4)]
+            return jax.lax.ppermute(k, "seq", perm)
+
+        f = shard_map(_ring_step, mesh=mesh,
+                      in_specs=(P("data", "seq"),),
+                      out_specs=P("data", "seq"))
+        """})
+    env = ctx.axismap.env_of("synapseml_tpu.mod._ring_step")
+    assert env.complete
+    assert env.axes == {"seq", "data"}
+
+
+def test_collectives_accepts_ring_ppermute_idiom(tmp_path):
+    """The ring rotation (ppermute of K/V around the seq axis) is clean:
+    the axis is bound by the enclosing shard_map's seq-bearing mesh."""
+    ctx = _ctx(tmp_path, {
+        "synapseml_tpu/compat.py": _COMPAT,
+        "synapseml_tpu/mod.py": """\
+        import jax
+        from synapseml_tpu.compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = jax.make_mesh((4,), ("seq",))
+
+        def _ring(q, k, v):
+            rank = jax.lax.axis_index("seq")
+            perm = [(i, (i + 1) % 4) for i in range(4)]
+            k = jax.lax.ppermute(k, "seq", perm)
+            v = jax.lax.ppermute(v, "seq", perm)
+            return q + k + v
+
+        f = shard_map(_ring, mesh=mesh,
+                      in_specs=(P(None, "seq"),) * 3,
+                      out_specs=P(None, "seq"))
+        """})
+    assert collectives.run(ctx) == []
+
+
+def test_collectives_accepts_ulysses_all_to_all_idiom(tmp_path):
+    """The Ulysses re-shard (all_to_all seq<->heads, both directions) is
+    clean under a seq-bearing mesh."""
+    ctx = _ctx(tmp_path, {
+        "synapseml_tpu/compat.py": _COMPAT,
+        "synapseml_tpu/mod.py": """\
+        import jax
+        from synapseml_tpu.compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = jax.make_mesh((4,), ("seq",))
+
+        def _ulysses(q):
+            qh = jax.lax.all_to_all(q, "seq", split_axis=2, concat_axis=1,
+                                    tiled=True)
+            return jax.lax.all_to_all(qh, "seq", split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        f = shard_map(_ulysses, mesh=mesh,
+                      in_specs=(P(None, "seq", None, None),),
+                      out_specs=P(None, "seq", None, None))
+        """})
+    assert collectives.run(ctx) == []
+
+
+def test_collectives_flags_seq_collective_on_seqless_mesh(tmp_path):
+    """The same ring/Ulysses idioms under a mesh WITHOUT a seq axis must
+    flag — proves the clean fixtures above aren't vacuously passing."""
+    ctx = _ctx(tmp_path, {
+        "synapseml_tpu/compat.py": _COMPAT,
+        "synapseml_tpu/mod.py": """\
+        import jax
+        from synapseml_tpu.compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = jax.make_mesh((4,), ("data",))
+
+        def _ring(k):
+            perm = [(i, (i + 1) % 4) for i in range(4)]
+            k = jax.lax.ppermute(k, "seq", perm)
+            return jax.lax.all_to_all(k, "seq", split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        f = shard_map(_ring, mesh=mesh, in_specs=(P(None, "data"),),
+                      out_specs=P(None, "data"))
+        """})
+    found = collectives.run(ctx)
+    assert any("ppermute" in f.message and "'seq'" in f.message
+               and "not bound" in f.message for f in found)
+    assert any("all_to_all" in f.message and "'seq'" in f.message
+               and "not bound" in f.message for f in found)
+
+
+def test_axismap_live_tree_sees_seq_attention_sites():
+    """The real ring/Ulysses shard_map applications are detected; their
+    meshes are runtime parameters, so the envs stay conservatively
+    incomplete (no false C1 findings against the seq modules)."""
+    project = Project.from_targets(["synapseml_tpu/parallel"], repo=REPO)
+    am = AxisMap(project)
+    targets = {s.target.full_name for s in am.shard_sites if s.target}
+    assert ("synapseml_tpu.parallel.ring_attention.ring_self_attention."
+            "_ring") in targets
+    assert ("synapseml_tpu.parallel.ulysses.ulysses_self_attention."
+            "_ulysses") in targets
+
+
 _QUANT = """\
     def allreduce_sum_quantized(x, axis, *, bits=8, block=256):
         return x

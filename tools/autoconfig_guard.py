@@ -8,6 +8,7 @@ alternatives and has recorded training rows:
 * ``gbdt_wire_dtype``    — the int8-vs-f32 wire pair from the same bench
 * ``dl_param_sharding``  — bench_dl_sharded replicated/zero/pipeline
 * ``dl_pipeline_schedule`` — bench_dl_overlap_pipeline fill_drain/overlap
+* ``seq_attention``      — bench_dl_seq ring/ulysses A/B on the seq mesh
 * ``io_chunk_rows``      — bench_oocore_gbdt chunk-geometry ladder
 * ``serving_bucket_growth`` — the micro A/B THIS script runs (the bucket
   ladder has no bench arm of its own): a BucketedRunner at
@@ -46,6 +47,7 @@ FAMILIES = {
     "gbdt_wire_dtype": {"fallback": "f32", "arm_keys": ("wire_bytes",)},
     "dl_param_sharding": {"fallback": "replicated", "arm_keys": ("stages",)},
     "dl_pipeline_schedule": {"fallback": "fill_drain", "arm_keys": ()},
+    "seq_attention": {"fallback": "ring", "arm_keys": ()},
     "io_chunk_rows": {"fallback": None, "arm_keys": ("chunk_rows",)},
     "serving_bucket_growth": {"fallback": "g2.0", "arm_keys": ()},
 }

@@ -37,7 +37,7 @@ assert any(n.endswith(".so") for n in names), "native lib missing from wheel"
 print(f"wheel ok: {whl[0]} ({len(names)} files)")
 EOF
 
-echo "== static analysis (trace-safety / recompile / determinism / locks / lock-order / thread-shared / blocking-under-lock / blocking-io / collectives / sharding / donation / resource-discipline / codegen-drift) =="
+echo "== static analysis (trace-safety / recompile / determinism / locks / lock-order / thread-shared / blocking-under-lock / blocking-io / collectives / sharding / donation / resource-discipline / precision-loss / quant-overflow / nonfinite-escape / dtype-drift / codegen-drift) =="
 # parallel analyzers + incremental cache: repeat runs on an unchanged tree
 # are near-free; the budget asserts the cache/pool plumbing stays effective
 # (generous enough for a cold cache on a loaded CI box)
@@ -70,6 +70,23 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 JAX_PLATFORMS=cpu python -m synapseml_tpu.testing.lockwitness \
     "${_lw_report}" || echo "lockwitness: diff reported issues (non-blocking)"
 rm -f "${_lw_report}"
+
+echo "== dtype witness (observed wire/accumulator dtypes vs static dtype-flow prediction) =="
+# re-run the gbdt-wire + dl-seq subset with the product _witness_observe
+# probes live, then diff the observed per-site dtype sets against the
+# static dtype-flow prediction (docs/static-analysis.md "Runtime dtype
+# witness"). Report-only for recall gaps (unpredicted/foreign sites print
+# for triage); an OBSERVED contract violation — a probe with expect= that
+# saw a different dtype at runtime — fails the build (exit 1 from the CLI).
+_dw_report="$(mktemp -t dtypewitness.XXXXXX.json)"
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    SYNAPSEML_TPU_DTYPE_WITNESS="${_dw_report}" \
+    python -m pytest -x -q tests/test_distributed_gbdt_collectives.py \
+    tests/test_ring_attention.py -m 'not slow' \
+    || echo "dtypewitness: instrumented subset failed (non-blocking)"
+JAX_PLATFORMS=cpu python -m synapseml_tpu.testing.dtypewitness \
+    "${_dw_report}"
+rm -f "${_dw_report}"
 
 echo "== perf_tune rehearsal (tune -> flip -> persist on CPU) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

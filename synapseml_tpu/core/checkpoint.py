@@ -48,6 +48,7 @@ import hashlib
 import json
 import os
 import re
+import sys
 import zlib
 from typing import Any, Callable, Dict, List, Optional
 
@@ -413,6 +414,15 @@ def _exchange_json(obj, timeout: Optional[float] = None):
     return box["out"]
 
 
+def _witness_observe(site, tree, expect=None):
+    # dtype-witness probe (testing/dtypewitness.py): inert unless the
+    # witness module is loaded — sys.modules lookup keeps product imports
+    # free of the testing package
+    w = sys.modules.get("synapseml_tpu.testing.dtypewitness")
+    if w is not None and w.active():
+        w.observe(site, tree, expect)
+
+
 def save_sharded_tree(store: CheckpointStore, step: int, tree,
                       meta: Optional[Dict[str, Any]] = None,
                       prefix: str = "state") -> str:
@@ -472,6 +482,7 @@ def save_sharded_tree(store: CheckpointStore, step: int, tree,
                 blocks.append({"artifact": shard_name, "key": key,
                                "index": [[0, d] for d in shape]})
         my_leaves.append(blocks)
+        _witness_observe("core.ckpt.save_leaf", leaf)
         leaf_heads.append({"path": jax.tree_util.keystr(path),
                            "shape": list(shape), "dtype": dtype.name})
     buf = io.BytesIO()
@@ -552,6 +563,16 @@ def load_sharded_from_checkpoint(store: CheckpointStore, ckpt: Checkpoint,
             raise CheckpointError(
                 f"checkpoint {ckpt.base}: leaf {entry['path']} has shape "
                 f"{tuple(entry['shape'])}, model expects {want}")
+        want_dt = getattr(tl, "dtype", None)
+        if want_dt is not None and np.dtype(entry["dtype"]) != \
+                np.dtype(want_dt):
+            # the restore materializes leaves at the MANIFEST dtype — an
+            # unchecked mismatch would silently retype every downstream
+            # computation (e.g. a bf16 template training in f32); leaves
+            # without an explicit dtype (python scalars) stay unchecked
+            raise CheckpointError(
+                f"checkpoint {ckpt.base}: leaf {entry['path']} has dtype "
+                f"{entry['dtype']}, model expects {np.dtype(want_dt).name}")
 
     # read ONLY the shard artifacts whose blocks overlap a needed window
     def _overlaps(win, bidx):
@@ -621,6 +642,7 @@ def load_sharded_from_checkpoint(store: CheckpointStore, ckpt: Checkpoint,
                 shape, sh,
                 lambda idx, e=entry, s2=shape, d=dtype:
                     _window(e, _norm_index(idx, s2), d)))
+    _witness_observe("core.ckpt.load_leaf", out_leaves)
     return jax.tree_util.tree_unflatten(ttreedef, out_leaves)
 
 

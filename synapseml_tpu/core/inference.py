@@ -43,12 +43,22 @@ docs/serving-perf.md for the serving integration and tuning guidance.
 
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 __all__ = ["BucketedRunner", "PendingBatch", "bucket_ladder"]
+
+
+def _witness_observe(site, tree, expect=None):
+    # dtype-witness probe (testing/dtypewitness.py): inert unless the
+    # witness module is loaded — sys.modules lookup keeps product imports
+    # free of the testing package
+    w = sys.modules.get("synapseml_tpu.testing.dtypewitness")
+    if w is not None and w.active():
+        w.observe(site, tree, expect)
 
 
 def bucket_ladder(max_batch_size: int, growth: float = 2.0,
@@ -212,6 +222,7 @@ class BucketedRunner:
     @staticmethod
     def _spec_of(arr) -> Tuple[Tuple[int, ...], Any]:
         a = np.asarray(arr) if not hasattr(arr, "shape") else arr
+        _witness_observe("core.bucketed.spec", a)
         return tuple(a.shape[1:]), np.dtype(getattr(a, "dtype", None) or
                                             np.asarray(arr).dtype)
 

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import math
 import os
+import sys
 from functools import partial
 from typing import NamedTuple, Optional
 
@@ -212,6 +213,15 @@ def _bucket_sizes(np_rows: int) -> list:
     return sizes
 
 
+def _witness_observe(site, tree, expect=None):
+    # dtype-witness probe (testing/dtypewitness.py): inert unless the
+    # witness module is loaded — sys.modules lookup keeps product imports
+    # free of the testing package
+    w = sys.modules.get("synapseml_tpu.testing.dtypewitness")
+    if w is not None and w.active():
+        w.observe(site, tree, expect)
+
+
 def _maybe_psum(x, axis_name, wire_dtype: str = "f32"):
     """Cross-shard histogram allreduce; ``wire_dtype='bf16'`` ships the
     grad/hess channels at half width (their per-row values are bf16-rounded
@@ -224,7 +234,14 @@ def _maybe_psum(x, axis_name, wire_dtype: str = "f32"):
     if wire_dtype == "bf16":
         gh = lax.psum(x[..., :2].astype(jnp.bfloat16),
                       axis_name).astype(x.dtype)
+        # exact f32 totals side wire (1/B of the payload), same as the
+        # int8 rung: leaf G/H totals and parent gain terms must not carry
+        # bf16 rounding accumulated over the whole grid
+        gh = _pin_totals(gh, lax.psum(x[..., :2].sum(axis=-2), axis_name))
         cnt = lax.psum(x[..., 2:], axis_name)
+        # contract: pinned totals and the count channel leave on exact f32
+        _witness_observe("gbdt.wire.hist", gh, expect="float32")
+        _witness_observe("gbdt.wire.count", cnt, expect="float32")
         return jnp.concatenate([gh, cnt], axis=-1)
     if wire_dtype == "int8":
         from ..parallel.collectives import allreduce_sum_quantized
@@ -236,6 +253,8 @@ def _maybe_psum(x, axis_name, wire_dtype: str = "f32"):
         gh = jnp.moveaxis(gh, 0, -1)
         gh = _pin_totals(gh, lax.psum(x[..., :2].sum(axis=-2), axis_name))
         cnt = lax.psum(x[..., 2:], axis_name)
+        _witness_observe("gbdt.wire.hist", gh, expect="float32")
+        _witness_observe("gbdt.wire.count", cnt, expect="float32")
         return jnp.concatenate([gh, cnt], axis=-1)
     return lax.psum(x, axis_name)
 
@@ -266,6 +285,9 @@ def _hist_reduce_scatter(x, axis_name, wire_dtype: str = "f32"):
                       scatter_dimension=0, tiled=True)
     if wire_dtype == "bf16":
         gh = scatter(x[..., :2].astype(jnp.bfloat16)).astype(x.dtype)
+        # pin owned-slice totals over an exact f32 side wire, mirroring
+        # the int8 rung: totals feed leaf values and parent gain terms
+        gh = _pin_totals(gh, scatter(x[..., :2].sum(axis=1)))
     elif wire_dtype == "int8":
         from ..parallel.collectives import reduce_scatter_sum_quantized
 
@@ -280,6 +302,8 @@ def _hist_reduce_scatter(x, axis_name, wire_dtype: str = "f32"):
     else:
         gh = scatter(x[..., :2])
     cnt = scatter(x[..., 2:])    # counts stay on an exact wire
+    _witness_observe("gbdt.wire.scatter_hist", gh, expect="float32")
+    _witness_observe("gbdt.wire.scatter_count", cnt, expect="float32")
     return jnp.concatenate([gh, cnt], axis=-1)
 
 

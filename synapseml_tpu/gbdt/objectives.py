@@ -58,7 +58,9 @@ def multiclass_objective(num_class: int) -> Objective:
 
     def init(y, w):
         counts = jnp.zeros(num_class).at[y.astype(jnp.int32)].add(w)
-        p = jnp.clip(counts / counts.sum(), 1e-12, 1.0)
+        # all-zero weights would make this 0/0 -> NaN before the clip
+        p = jnp.clip(counts / jnp.maximum(counts.sum(), 1e-12),
+                     1e-12, 1.0)
         return jnp.log(p)
 
     return Objective("multiclass", num_class, gh, init,
@@ -77,7 +79,9 @@ def multiclassova_objective(num_class: int, sigmoid: float = 1.0) -> Objective:
 
     def init(y, w):
         counts = jnp.zeros(num_class).at[y.astype(jnp.int32)].add(w)
-        p = jnp.clip(counts / counts.sum(), 1e-12, 1 - 1e-12)
+        # all-zero weights would make this 0/0 -> NaN before the clip
+        p = jnp.clip(counts / jnp.maximum(counts.sum(), 1e-12),
+                     1e-12, 1 - 1e-12)
         return jnp.log(p / (1 - p)) / s
 
     def tf(sc):

@@ -10,6 +10,7 @@ axis names from :mod:`synapseml_tpu.parallel.mesh`.
 from __future__ import annotations
 
 import math
+import sys
 from typing import Callable
 
 import jax
@@ -40,6 +41,15 @@ def _chaos(name: str) -> None:
         hook(name)       # beat BEFORE chaos: a killed op still leaves a trail
     if _CHAOS_HOOK is not None:
         _CHAOS_HOOK(name)
+
+
+def _witness_observe(site, tree, expect=None):
+    # dtype-witness probe (testing/dtypewitness.py): inert unless the
+    # witness module is loaded — sys.modules lookup keeps product imports
+    # free of the testing package
+    w = sys.modules.get("synapseml_tpu.testing.dtypewitness")
+    if w is not None and w.active():
+        w.observe(site, tree, expect)
 
 
 def allreduce_sum(x, axis: str = DATA_AXIS):
@@ -153,7 +163,10 @@ def reduce_scatter_sum_quantized(x, axis: str = DATA_AXIS, *, bits: int = 8,
     s = jax.lax.psum_scatter(q, axis_name=axis, scatter_dimension=0)
     r = jax.lax.axis_index(axis)
     out = s.astype(jnp.float32) * safe[r][:, None]
-    return out.reshape(chunk, *x.shape[1:])
+    out = out.reshape(chunk, *x.shape[1:])
+    _witness_observe("parallel.quant.scatter_dequant", out,
+                     expect="float32")
+    return out
 
 
 def allreduce_sum_quantized(x, axis: str = DATA_AXIS, *, bits: int = 8,
@@ -180,7 +193,9 @@ def allreduce_sum_quantized(x, axis: str = DATA_AXIS, *, bits: int = 8,
     q, safe = _shared_scale_quantize(blocks, axis, bits, _acc_dtype(n, bits))
     s = jax.lax.psum(q, axis_name=axis)
     out = (s.astype(jnp.float32) * safe[:, None]).reshape(-1)
-    return out[:m].reshape(shape)
+    out = out[:m].reshape(shape)
+    _witness_observe("parallel.quant.dequant", out, expect="float32")
+    return out
 
 
 def probe_link_bandwidth(mesh: Mesh, axis: str = DATA_AXIS,

@@ -18,6 +18,7 @@ the math.
 
 from __future__ import annotations
 
+import sys
 from functools import partial
 from typing import Optional
 
@@ -27,6 +28,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.compat import shard_map
 from .mesh import SEQ_AXIS
+
+
+def _witness_observe(site, tree, expect=None):
+    # dtype-witness probe (testing/dtypewitness.py): inert unless the
+    # witness module is loaded — sys.modules lookup keeps product imports
+    # free of the testing package
+    w = sys.modules.get("synapseml_tpu.testing.dtypewitness")
+    if w is not None and w.active():
+        w.observe(site, tree, expect)
 
 
 def _block_attention(q, k, v, m, l, o, q_offset, k_offset, causal, scale,
@@ -167,7 +177,12 @@ def ring_self_attention(q, k, v, mesh: Mesh, causal: bool = False,
 
         _, _, m, l, o = jax.lax.fori_loop(
             0, ring, step, (k_blk, v_blk, m0, l0, o0))
-        return _finalize(m, l, o).astype(q_blk.dtype)
+        # contract: the softmax accumulators stay f32 regardless of the
+        # (possibly bf16) q/k/v wire dtype; output returns at q's dtype
+        _witness_observe("dl.seq.ring_acc", (m, l, o), expect="float32")
+        out = _finalize(m, l, o).astype(q_blk.dtype)
+        _witness_observe("dl.seq.ring_out", out)
+        return out
 
     return _ring(q, k, v)
 
@@ -204,4 +219,7 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
         step, (m0, l0, o0),
         (jnp.arange(n_blocks), kb.transpose(1, 0, 2, 3, 4),
          vb.transpose(1, 0, 2, 3, 4)))
-    return _finalize(m, l, o).astype(q.dtype)
+    _witness_observe("dl.seq.block_acc", (m, l, o), expect="float32")
+    out = _finalize(m, l, o).astype(q.dtype)
+    _witness_observe("dl.seq.block_out", out)
+    return out

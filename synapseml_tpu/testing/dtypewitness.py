@@ -1,0 +1,253 @@
+"""Runtime dtype witness: cross-validate the static dtype-flow model.
+
+The numerics analyzers *predict* what dtype reaches each mixed-precision
+boundary; this module *observes* it. Product code carries lightweight
+probes at annotated boundaries (the gbdt histogram wire, the seq-attention
+accumulators/outputs, checkpoint leaf save/restore, quantized-collective
+dequantization, BucketedRunner specs) of the form::
+
+    _witness_observe("gbdt.wire.count", cnt, expect="float32")
+
+where ``_witness_observe`` is a per-module 3-line shim that forwards to
+:func:`observe` **only when this module is already imported and active**
+(``sys.modules`` lookup — product code never imports the testing package,
+so the probes are inert imports-wise and cost one dict lookup when the
+witness is off). Inside jit the probe fires at trace time and records the
+tracer's static dtype — exactly the quantity the static model predicts.
+
+Per site the witness records the set of observed leaf dtype names; a probe
+with ``expect=`` also records a **contract violation** when a leaf arrives
+outside the allowed set (e.g. an f32 leaf arriving bf16 on the
+exact-totals wire). The diff against the static model classifies each
+(site, dtype) observation:
+
+* **matched** — the static model predicted this dtype (or the site's
+  dtype is provably input-dependent, which the model reports as
+  unconstrained);
+* **unpredicted** — the model pinned a different dtype for the site: a
+  dtype-flow recall bug, file it against ``tools/analysis/dtypemodel``;
+* **foreign** — a site string the static scan never saw (dynamically
+  built probes): informational.
+
+Enable under pytest with ``SYNAPSEML_TPU_DTYPE_WITNESS=/path/report.json``
+(the session fixture in ``tests/conftest.py`` installs the witness and
+writes the report at exit), then::
+
+    python -m synapseml_tpu.testing.dtypewitness /path/report.json
+
+prints the diff — non-zero exit **only on an observed contract
+violation**; the static analyzers remain the hard gate. ci.sh runs this
+over the gbdt-wire + dl-seq test subset.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_DIR = os.path.dirname(_PKG_DIR)
+
+#: lattice element -> runtime dtype name, mirroring dtypemodel's lattice
+LATTICE_TO_RUNTIME = {
+    "bool": "bool", "int8": "int8", "int16": "int16", "int32": "int32",
+    "int64": "int64", "uint8": "uint8", "uint16": "uint16",
+    "uint32": "uint32", "uint64": "uint64", "bf16": "bfloat16",
+    "f16": "float16", "f32": "float32", "f64": "float64",
+}
+
+_ACTIVE: Optional["DtypeWitness"] = None
+
+
+def active() -> bool:
+    return _ACTIVE is not None
+
+
+def observe(site: str, tree, expect=None):
+    """Record the leaf dtypes of ``tree`` under ``site``; returns ``tree``
+    unchanged so probes can wrap expressions. No-op when inactive."""
+    w = _ACTIVE
+    if w is not None:
+        w.record(site, tree, expect)
+    return tree
+
+
+def _leaf_dtype_name(leaf) -> Optional[str]:
+    dt = getattr(leaf, "dtype", None)
+    if dt is None:
+        return None
+    return getattr(dt, "name", None) or str(dt)
+
+
+def _tree_leaves(tree) -> List:
+    try:
+        from jax.tree_util import tree_leaves
+        return tree_leaves(tree)
+    except Exception:                      # jax absent: treat as one leaf
+        return [tree]
+
+
+def _expand_expect(expect) -> Set[str]:
+    if isinstance(expect, str):
+        return {expect}
+    return set(expect)
+
+
+class DtypeWitness:
+    """Collects observed per-site leaf dtypes and contract violations."""
+
+    def __init__(self) -> None:
+        self.sites: Dict[str, Set[str]] = {}
+        self.violations: List[dict] = []
+        self._mu = threading.Lock()
+
+    # --- recording ------------------------------------------------------
+    def record(self, site: str, tree, expect=None) -> None:
+        names = [n for n in (_leaf_dtype_name(lf)
+                             for lf in _tree_leaves(tree)) if n is not None]
+        allowed = _expand_expect(expect) if expect is not None else None
+        with self._mu:
+            got = self.sites.setdefault(site, set())
+            for name in names:
+                got.add(name)
+                if allowed is not None and name not in allowed:
+                    self.violations.append({
+                        "site": site, "observed": name,
+                        "expected": sorted(allowed)})
+
+    # --- installation ---------------------------------------------------
+    def install(self) -> "DtypeWitness":
+        global _ACTIVE
+        _ACTIVE = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+    def __enter__(self) -> "DtypeWitness":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # --- reporting ------------------------------------------------------
+    def report(self) -> dict:
+        return {
+            "sites": {s: sorted(v) for s, v in sorted(self.sites.items())},
+            "violations": list(self.violations),
+        }
+
+    def write(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self.report(), fh, indent=2, sort_keys=True)
+
+
+# --- diff vs the static model ----------------------------------------------
+
+def diff_report(report: dict,
+                predicted: Dict[str, Optional[Set[str]]]) -> dict:
+    """Classify observed (site, dtype) pairs against the static model.
+
+    ``predicted`` maps each statically discovered probe site to the set of
+    runtime dtype names the dtype model pinned for it, or ``None`` when
+    the model found the site but could not constrain the dtype
+    (input-dependent — counts as matched, the model made no claim).
+    """
+    matched, unpredicted, foreign = [], [], []
+    for site, names in sorted(report.get("sites", {}).items()):
+        for name in names:
+            entry = {"site": site, "dtype": name}
+            if site not in predicted:
+                foreign.append(entry)
+            elif predicted[site] is None or name in predicted[site]:
+                matched.append(entry)
+            else:
+                entry["predicted"] = sorted(predicted[site])
+                unpredicted.append(entry)
+    return {"matched": matched, "unpredicted": unpredicted,
+            "foreign": foreign,
+            "violations": report.get("violations", [])}
+
+
+def _load_static() -> Dict[str, Optional[Set[str]]]:
+    """Scan the package for ``_witness_observe("<site>", expr, ...)``
+    probes and predict each site's dtypes with the static model."""
+    import ast
+
+    sys.path.insert(0, _REPO_DIR)
+    from tools.analysis.core import DEFAULT_TARGETS, Project
+    from tools.analysis.dtypemodel import DtypeModel
+
+    project = Project.from_targets(DEFAULT_TARGETS)
+    dtm = DtypeModel(project)
+    predicted: Dict[str, Optional[Set[str]]] = {}
+    for sf in dtm.files:
+        for qual, info in sf.symbols.functions.items():
+            facts = dtm.facts_for(info)
+            for node in ast.walk(info.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "_witness_observe"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                site = node.args[0].value
+                tree_arg = node.args[1] if len(node.args) > 1 else None
+                names: Optional[Set[str]] = set()
+                parts = (tree_arg.elts
+                         if isinstance(tree_arg, (ast.Tuple, ast.List))
+                         else [tree_arg] if tree_arg is not None else [])
+                for part in parts:
+                    lat = facts.info(part).dtype
+                    run = LATTICE_TO_RUNTIME.get(lat)
+                    if run is None:
+                        names = None          # unconstrained
+                        break
+                    names.add(run)
+                if not parts:
+                    names = None
+                cur = predicted.get(site)
+                if site in predicted and (cur is None or names is None):
+                    predicted[site] = None
+                elif cur is not None and names is not None:
+                    predicted[site] = cur | names
+                else:
+                    predicted[site] = names
+    return predicted
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 1:
+        print("usage: python -m synapseml_tpu.testing.dtypewitness "
+              "<report.json>", file=sys.stderr)
+        return 2
+    try:
+        with open(argv[0], "r", encoding="utf-8") as fh:
+            report = json.load(fh)
+    except OSError as e:
+        print(f"dtypewitness: no report to check ({e})", file=sys.stderr)
+        return 0
+    predicted = _load_static()
+    d = diff_report(report, predicted)
+    nsites = len(report.get("sites", {}))
+    print(f"dtypewitness: {nsites} probe sites observed, "
+          f"{len(predicted)} statically known "
+          f"({len(d['matched'])} matched, {len(d['unpredicted'])} "
+          f"unpredicted, {len(d['foreign'])} foreign)")
+    for e in d["unpredicted"]:
+        print(f"  UNPREDICTED {e['site']} observed {e['dtype']}, static "
+              f"model pinned {e['predicted']} — dtype-flow recall gap")
+    for v in d["violations"]:
+        print(f"  VIOLATION {v['site']} observed {v['observed']}, contract "
+              f"allows {v['expected']}")
+    return 1 if d["violations"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -198,7 +198,9 @@ def _loss_and_grad(p, y, loss: str, tau: float):
         return (p - y) ** 2, 2.0 * (p - y)
     if loss == "logistic":
         m = p * y
-        return jnp.log1p(jnp.exp(-m)), -y * jax.nn.sigmoid(-m)
+        # softplus(-m), not log1p(exp(-m)): the naive form overflows to inf
+        # for m <= -88 in f32 (one bad outlier margin poisons the loss)
+        return jax.nn.softplus(-m), -y * jax.nn.sigmoid(-m)
     if loss == "hinge":
         m = p * y
         return jnp.maximum(0.0, 1.0 - m), jnp.where(m < 1.0, -y, 0.0)

@@ -66,6 +66,28 @@ def _lock_witness():
         witness.write(path)
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _dtype_witness():
+    """Opt-in runtime dtype witness: when SYNAPSEML_TPU_DTYPE_WITNESS names
+    a report path, activate the `_witness_observe` probes in the product
+    modules and write the observed per-site dtype sets (plus any expect=
+    contract violations) at exit.
+    `python -m synapseml_tpu.testing.dtypewitness <report>` diffs it against
+    the static dtype-flow prediction (tools/analysis/dtypemodel.py)."""
+    path = os.environ.get("SYNAPSEML_TPU_DTYPE_WITNESS")
+    if not path:
+        yield
+        return
+    from synapseml_tpu.testing.dtypewitness import DtypeWitness
+
+    witness = DtypeWitness().install()
+    try:
+        yield
+    finally:
+        witness.uninstall()
+        witness.write(path)
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

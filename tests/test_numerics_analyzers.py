@@ -1,0 +1,757 @@
+"""Fixture battery for the numerics-safety analyzers + runtime dtype witness.
+
+Each analyzer gets must-flag AND must-not-flag fixtures; the must-not cases
+encode the precision guards the ISSUE demands (born-narrow values, wide
+accumulators via preferred_element_type/dtype=, the exact-side-wire
+exemption with branch scoping, bound-derived quantization accumulators,
+guard-dominated helpers). The witness tests prove the runtime side: probe
+recording, expect= contract violations, the diff classes
+(matched / unpredicted / foreign), and that every live probe site is
+statically discovered. Live-tree regression tests pin the concrete fixes
+this suite forced (the bf16 wire rung's exact totals pin, the vw logistic
+softplus form, the checkpoint manifest-dtype check).
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from tools.analysis.analyzers import (Context, drift, dtype_drift,
+                                      nonfinite_escape, precision_loss,
+                                      quant_overflow)
+from tools.analysis.core import REPO, Project
+
+
+def _ctx(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    project = Project.from_targets(sorted(files), repo=str(tmp_path))
+    return Context(project)
+
+
+def _fn_facts(ctx, rel, name):
+    dtm = ctx.dtypemodel
+    for sf in dtm.files:
+        if sf.rel != rel:
+            continue
+        for _qual, info in sf.symbols.functions.items():
+            if getattr(info.node, "name", None) == name:
+                return dtm.facts_for(info), info
+    raise AssertionError(f"{name} not found in {rel}")
+
+
+def _ret_info(ctx, rel, name):
+    facts, info = _fn_facts(ctx, rel, name)
+    rets = [n for n in ast.walk(info.node) if isinstance(n, ast.Return)]
+    assert rets, f"{name} has no return"
+    return facts.info(rets[-1].value)
+
+
+# ------------------------------------------------------------- dtype model
+
+def test_dtypemodel_weak_scalar_never_widens_strong_dtype(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+
+        def f():
+            x = jnp.zeros((4,), jnp.bfloat16)
+            return x * 2.0
+        """})
+    got = _ret_info(ctx, "synapseml_tpu/mod.py", "f")
+    assert got.dtype == "bf16"          # weak python float does not widen
+    assert not got.downcast
+
+
+def test_dtypemodel_weak_float_with_int_promotes_to_f32(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+
+        def f():
+            x = jnp.zeros((4,), jnp.int32)
+            return x * 2.0
+        """})
+    assert _ret_info(ctx, "synapseml_tpu/mod.py", "f").dtype == "f32"
+
+
+def test_dtypemodel_tracks_downcast_provenance(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+
+        def f():
+            x = jnp.zeros((4,), jnp.float32)
+            y = x.astype(jnp.bfloat16)
+            return y
+        """})
+    got = _ret_info(ctx, "synapseml_tpu/mod.py", "f")
+    assert got.dtype == "bf16"
+    assert got.downcast and got.ever_f32
+    assert got.cast_line > 0
+
+
+def test_dtypemodel_interprocedural_summary_carries_downcast(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+
+        def _narrow():
+            return jnp.zeros((4,), jnp.float32).astype(jnp.bfloat16)
+
+        def caller():
+            y = _narrow()
+            return y
+        """})
+    got = _ret_info(ctx, "synapseml_tpu/mod.py", "caller")
+    assert got.dtype == "bf16"
+    assert got.downcast
+
+
+# ---------------------------------------------------------- precision-loss
+
+def test_precision_loss_flags_downcast_psum(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+        from jax import lax
+
+        def wire(x):
+            g = x.astype(jnp.float32)
+            return lax.psum(g.astype(jnp.bfloat16), "data")
+        """})
+    found = precision_loss.run(ctx)
+    assert len(found) == 1
+    assert "bf16" in found[0].message
+    assert "downcast at line" in found[0].message
+
+
+def test_precision_loss_born_narrow_is_clean(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+        from jax import lax
+
+        def wire():
+            g = jnp.zeros((4, 8), jnp.bfloat16)
+            return lax.psum(g, "data")
+        """})
+    assert precision_loss.run(ctx) == []
+
+
+def test_precision_loss_wide_accumulator_kwarg_is_clean(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+
+        def total(x):
+            g = x.astype(jnp.float32).astype(jnp.bfloat16)
+            return jnp.sum(g, dtype=jnp.float32)
+        """})
+    assert precision_loss.run(ctx) == []
+
+
+def test_precision_loss_exact_side_wire_exempts_same_region(tmp_path):
+    # the _pin_totals pattern: a wide psum of the SAME operand in the same
+    # region makes the narrow wire a sanctioned bandwidth optimization
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+        from jax import lax
+
+        def wire(x):
+            g = x.astype(jnp.float32)
+            narrow = lax.psum(g.astype(jnp.bfloat16), "data")
+            wide = lax.psum(g.sum(axis=0), "data")
+            return narrow, wide
+        """})
+    assert precision_loss.run(ctx) == []
+
+
+def test_precision_loss_sibling_branch_side_wire_does_not_exempt(tmp_path):
+    # the int8 rung's pin must not excuse the bf16 rung: a side wire in a
+    # SIBLING branch never executes together with the lossy reduction
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+        from jax import lax
+
+        def wire(x, flag):
+            g = x.astype(jnp.float32)
+            if flag:
+                out = lax.psum(g.astype(jnp.bfloat16), "data")
+            else:
+                out = lax.psum(g.sum(axis=0), "data")
+            return out
+        """})
+    found = precision_loss.run(ctx)
+    assert len(found) == 1
+    assert "bf16" in found[0].message
+
+
+def test_precision_loss_sees_through_partial_alias(tmp_path):
+    # scatter = partial(lax.psum_scatter, ...) — the _hist_reduce_scatter
+    # idiom must still resolve as a reduction
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        from functools import partial
+
+        import jax.numpy as jnp
+        from jax import lax
+
+        def wire(x):
+            g = x.astype(jnp.float32)
+            scatter = partial(lax.psum_scatter, axis_name="data",
+                              scatter_dimension=0, tiled=True)
+            return scatter(g.astype(jnp.bfloat16))
+        """})
+    found = precision_loss.run(ctx)
+    assert len(found) == 1
+
+
+# ----------------------------------------------------------- quant-overflow
+
+def test_quant_overflow_flags_hardcoded_narrow_accumulator(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+        from jax import lax
+
+        def reduce(q):
+            return lax.psum(q.astype(jnp.int16), "data")
+        """})
+    found = quant_overflow.run(ctx)
+    assert len(found) == 1
+    assert "hard-coded" in found[0].message
+
+
+def test_quant_overflow_bound_derived_within_limit_is_clean(tmp_path):
+    # 258 * 127 = 32766 <= 32767: the last worker count on the int16 side
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+        from jax import lax
+
+        def reduce(q):
+            acc = q.astype(jnp.int16 if 258 * 127 <= 32767 else jnp.int32)
+            return lax.psum(acc, "data")
+        """})
+    assert quant_overflow.run(ctx) == []
+
+
+def test_quant_overflow_over_bound_resolves_to_int32_clean(tmp_path):
+    # past the boundary the conditional folds to int32 — correct code
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+        from jax import lax
+
+        def reduce(q):
+            acc = q.astype(jnp.int16 if 300 * 127 <= 32767 else jnp.int32)
+            return lax.psum(acc, "data")
+        """})
+    assert quant_overflow.run(ctx) == []
+
+
+def test_quant_overflow_flags_broken_bound(tmp_path):
+    # the compare was edited until it passed: 300*127=38100 "fits" a 65535
+    # bound, so the fold picks int16 while the true limit is exceeded
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import jax.numpy as jnp
+        from jax import lax
+
+        def reduce(q):
+            acc = q.astype(jnp.int16 if 300 * 127 <= 65535 else jnp.int32)
+            return lax.psum(acc, "data")
+        """})
+    found = quant_overflow.run(ctx)
+    assert len(found) == 1
+    assert "38100" in found[0].message
+
+
+def test_quant_overflow_flags_out_of_contract_bits(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        from synapseml_tpu.parallel.collectives import allreduce_sum_quantized
+
+        def reduce(x):
+            return allreduce_sum_quantized(x, "data", bits=16)
+
+        def reduce_ok(x):
+            return allreduce_sum_quantized(x, "data", bits=4)
+        """})
+    found = quant_overflow.run(ctx)
+    assert len(found) == 1
+    assert "bits=16" in found[0].message
+
+
+# --------------------------------------------------------- nonfinite-escape
+
+def test_nonfinite_flags_unguarded_log(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/gbdt/mod.py": """\
+        import jax.numpy as jnp
+
+        def loss(p):
+            return jnp.log(p)
+        """})
+    found = nonfinite_escape.run(ctx)
+    assert len(found) == 1
+    assert "log" in found[0].message
+
+
+def test_nonfinite_clip_guard_is_clean(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/gbdt/mod.py": """\
+        import jax.numpy as jnp
+
+        def loss(p):
+            p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+            return jnp.log(p)
+        """})
+    assert nonfinite_escape.run(ctx) == []
+
+
+def test_nonfinite_out_of_scope_module_is_clean(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/core/mod.py": """\
+        import jax.numpy as jnp
+
+        def loss(p):
+            return jnp.log(p)
+        """})
+    assert nonfinite_escape.run(ctx) == []
+
+
+def test_nonfinite_flags_log1p_exp_composition_even_when_guarded(tmp_path):
+    # log1p(exp(x)) overflows for x ~ 88 in f32 regardless of guards —
+    # a guard-root function does not excuse the composition
+    ctx = _ctx(tmp_path, {"synapseml_tpu/vw/mod.py": """\
+        import jax.numpy as jnp
+
+        def loss(m):
+            m = jnp.nan_to_num(m)
+            return jnp.log1p(jnp.exp(-m))
+        """})
+    found = nonfinite_escape.run(ctx)
+    assert len(found) == 1
+    assert "softplus" in found[0].message or "log1p" in found[0].message
+
+
+def test_nonfinite_guard_dominator_exempts_helper(tmp_path):
+    # _raw is only ever called from a finite-checked caller: the guard
+    # dominates every path into the log
+    ctx = _ctx(tmp_path, {"synapseml_tpu/gbdt/mod.py": """\
+        import jax.numpy as jnp
+
+        def safe(p):
+            p = jnp.nan_to_num(p)
+            return _raw(p)
+
+        def _raw(p):
+            return jnp.log(p)
+        """})
+    assert nonfinite_escape.run(ctx) == []
+
+
+def test_nonfinite_flags_sqrt_of_naked_difference(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/dl/mod.py": """\
+        import jax.numpy as jnp
+
+        def std(ex2, ex):
+            return jnp.sqrt(ex2 - ex * ex)
+
+        def std_ok(a, b):
+            return jnp.sqrt((a - b) ** 2)
+        """})
+    found = nonfinite_escape.run(ctx)
+    assert len(found) == 1
+    assert found[0].line == 4
+
+
+def test_nonfinite_flags_division_by_bare_reduction(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/online/mod.py": """\
+        import jax.numpy as jnp
+
+        def normalize(x, w):
+            return x / w.sum()
+
+        def normalize_ok(x, w):
+            return x / jnp.maximum(w.sum(), 1e-12)
+        """})
+    found = nonfinite_escape.run(ctx)
+    assert len(found) == 1
+    assert found[0].line == 4
+
+
+# -------------------------------------------------------------- dtype-drift
+
+_D2_PRODUCER = """\
+    import numpy as np
+
+    class Ckpt:
+        def save_tree(self, leaves):
+            return [{"dtype": str(lf.dtype), "shape": list(lf.shape)}
+                    for lf in leaves]
+
+"""
+
+
+def test_dtype_drift_flags_unchecked_manifest_dtype(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": _D2_PRODUCER + """\
+        def load_tree(self, manifest, template):
+            out = []
+            for entry, tl in zip(manifest, template):
+                if tuple(entry["shape"]) != tuple(tl.shape):
+                    raise ValueError("shape mismatch")
+                out.append(np.zeros(entry["shape"], entry["dtype"]))
+            return out
+        """})
+    found = dtype_drift.run(ctx)
+    assert len(found) == 1
+    assert "never checks the restored" in found[0].message
+
+
+def test_dtype_drift_checked_manifest_dtype_is_clean(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": _D2_PRODUCER + """\
+        def load_tree(self, manifest, template):
+            out = []
+            for entry, tl in zip(manifest, template):
+                if tuple(entry["shape"]) != tuple(tl.shape):
+                    raise ValueError("shape mismatch")
+                if entry["dtype"] != str(tl.dtype):
+                    raise ValueError("dtype mismatch")
+                out.append(np.zeros(entry["shape"], entry["dtype"]))
+            return out
+        """})
+    assert dtype_drift.run(ctx) == []
+
+
+def test_dtype_drift_flags_disjoint_float_dtypes(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import numpy as np
+
+        def encode_block(x):
+            return x.astype(np.float16).tobytes()
+
+        def decode_block(buf):
+            return np.frombuffer(buf, dtype=np.float32)
+        """})
+    found = dtype_drift.run(ctx)
+    assert len(found) == 1
+
+
+def test_dtype_drift_intersecting_float_dtypes_are_clean(tmp_path):
+    ctx = _ctx(tmp_path, {"synapseml_tpu/mod.py": """\
+        import numpy as np
+
+        def encode_block(x):
+            return x.astype(np.float32).tobytes()
+
+        def decode_block(buf):
+            return np.frombuffer(buf, dtype=np.float32)
+        """})
+    assert dtype_drift.run(ctx) == []
+
+
+# ----------------------------------------------------- docs-table drift check
+
+def test_doc_rule_ids_parses_only_backticked_table_rows():
+    text = ("| id | flags |\n"
+            "|---|---|\n"
+            "| `precision-loss` | stuff |\n"
+            "| *matched* | not a rule row |\n"
+            "prose naming `quant-overflow` does not count\n"
+            "| `dtype-drift` | more |\n")
+    got = drift.doc_rule_ids(text)
+    assert set(got) == {"precision-loss", "dtype-drift"}
+    assert got["precision-loss"] == 3
+
+
+def test_analyzer_doc_findings_both_directions():
+    doc = "| `precision-loss` | x |\n| `ghost-rule` | y |\n"
+    found = drift.analyzer_doc_findings(doc, {"precision-loss",
+                                              "quant-overflow"})
+    msgs = sorted(f.message for f in found)
+    assert len(found) == 2
+    assert "ghost-rule" in msgs[1] and "no such analyzer" in msgs[1]
+    assert "quant-overflow" in msgs[0] and "no rules-table row" in msgs[0]
+
+
+def test_analyzer_doc_findings_exempts_framework_pseudo_ids():
+    doc = ("| `unused-suppression` | framework audit |\n"
+           "| `precision-loss` | x |\n")
+    assert drift.analyzer_doc_findings(doc, {"precision-loss"}) == []
+
+
+def test_live_registry_matches_docs_tables():
+    from tools.analysis.analyzers import registry
+    with open(os.path.join(REPO, drift.ANALYSIS_DOC), encoding="utf-8") as f:
+        doc = f.read()
+    found = drift.analyzer_doc_findings(doc, registry().keys())
+    assert found == [], [f.message for f in found]
+
+
+# ----------------------------------------------------------- runtime witness
+
+def test_witness_records_sites_and_contract_violations():
+    import jax.numpy as jnp
+
+    from synapseml_tpu.testing import dtypewitness as dw
+
+    assert not dw.active()
+    x = jnp.zeros((3,), jnp.float32)
+    assert dw.observe("ignored.site", x) is x       # inert when inactive
+    with dw.DtypeWitness() as w:
+        assert dw.active()
+        dw.observe("a.site", (x, x.astype(jnp.bfloat16)))
+        dw.observe("b.site", x, expect="float32")
+        dw.observe("b.site", x.astype(jnp.bfloat16), expect="float32")
+    assert not dw.active()
+    rep = w.report()
+    assert rep["sites"]["a.site"] == ["bfloat16", "float32"]
+    assert len(rep["violations"]) == 1
+    v = rep["violations"][0]
+    assert v["site"] == "b.site" and v["observed"] == "bfloat16"
+
+
+def test_witness_probes_fire_in_product_code():
+    import jax.numpy as jnp
+
+    from synapseml_tpu.parallel import ring_attention
+    from synapseml_tpu.testing import dtypewitness as dw
+
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 8, 2, 4)).astype(np.float32))
+               for _ in range(3))
+    with dw.DtypeWitness() as w:
+        ring_attention.blockwise_attention(q, k, v, block_size=4)
+    rep = w.report()
+    assert rep["sites"]["dl.seq.block_acc"] == ["float32"]
+    assert "dl.seq.block_out" in rep["sites"]
+    assert rep["violations"] == []
+    # and with the witness gone the probe is a no-op again
+    ring_attention.blockwise_attention(q, k, v, block_size=4)
+
+
+def test_witness_diff_report_classifies_observations():
+    from synapseml_tpu.testing import dtypewitness as dw
+
+    report = {"sites": {"a": ["float32"], "b": ["bfloat16"],
+                        "c": ["float16"], "d": ["int8"]},
+              "violations": [{"site": "b", "observed": "bfloat16",
+                              "expected": ["float32"]}]}
+    predicted = {"a": {"float32"}, "b": {"float32"}, "c": None}
+    d = dw.diff_report(report, predicted)
+    assert [e["site"] for e in d["matched"]] == ["a", "c"]
+    assert [e["site"] for e in d["unpredicted"]] == ["b"]
+    assert d["unpredicted"][0]["predicted"] == ["float32"]
+    assert [e["site"] for e in d["foreign"]] == ["d"]
+    assert len(d["violations"]) == 1
+
+
+def test_witness_cli_exits_nonzero_only_on_violations(tmp_path, monkeypatch):
+    from synapseml_tpu.testing import dtypewitness as dw
+
+    monkeypatch.setattr(dw, "_load_static",
+                        lambda: {"a": {"float32"}})
+    clean = {"sites": {"a": ["float32"]}, "violations": []}
+    p = tmp_path / "clean.json"
+    p.write_text(json.dumps(clean))
+    assert dw.main([str(p)]) == 0
+    # an unpredicted observation is a recall gap, not a failure
+    gap = {"sites": {"a": ["bfloat16"]}, "violations": []}
+    p2 = tmp_path / "gap.json"
+    p2.write_text(json.dumps(gap))
+    assert dw.main([str(p2)]) == 0
+    bad = {"sites": {"a": ["float32"]},
+           "violations": [{"site": "a", "observed": "bfloat16",
+                           "expected": ["float32"]}]}
+    p3 = tmp_path / "bad.json"
+    p3.write_text(json.dumps(bad))
+    assert dw.main([str(p3)]) == 1
+    assert dw.main([str(tmp_path / "missing.json")]) == 0
+
+
+def test_live_probe_sites_are_statically_discovered():
+    # every expect="float32" probe in the product tree must be known to the
+    # static scan, and its prediction must not contradict the contract —
+    # the "0 unpredicted contract sites" half of the ci witness step
+    from synapseml_tpu.testing import dtypewitness as dw
+
+    predicted = dw._load_static()
+    expect_f32 = ["gbdt.wire.hist", "gbdt.wire.count",
+                  "gbdt.wire.scatter_hist", "gbdt.wire.scatter_count",
+                  "dl.seq.ring_acc", "dl.seq.block_acc",
+                  "parallel.quant.dequant", "parallel.quant.scatter_dequant"]
+    for site in expect_f32:
+        assert site in predicted, f"probe site {site} not discovered"
+        names = predicted[site]
+        assert names is None or "float32" in names, (site, names)
+    for site in ["dl.seq.ring_out", "dl.seq.block_out",
+                 "core.ckpt.save_leaf", "core.ckpt.load_leaf",
+                 "core.bucketed.spec"]:
+        assert site in predicted, f"probe site {site} not discovered"
+
+
+# ------------------------------------------------------------ cache and infra
+
+def test_tool_hash_covers_numerics_analyzer_sources(tmp_path, monkeypatch):
+    from tools.analysis import cache as cache_mod
+    new_sources = ("dtypemodel.py", "analyzers/precision_loss.py",
+                   "analyzers/quant_overflow.py",
+                   "analyzers/nonfinite_escape.py",
+                   "analyzers/dtype_drift.py")
+    for rel in new_sources:
+        assert os.path.exists(os.path.join(cache_mod._TOOLS_DIR, rel))
+    tools = tmp_path / "analysis"
+    (tools / "analyzers").mkdir(parents=True)
+    for rel in new_sources:
+        (tools / rel).write_text("# v1\n")
+    monkeypatch.setattr(cache_mod, "_TOOLS_DIR", str(tools))
+    h1 = cache_mod.tool_hash()
+    (tools / "analyzers" / "precision_loss.py").write_text("# v2\n")
+    h2 = cache_mod.tool_hash()
+    assert h1 != h2
+
+
+def test_sarif_covers_numerics_rules(tmp_path):
+    (tmp_path / "synapseml_tpu").mkdir()
+    (tmp_path / "synapseml_tpu" / "mod.py").write_text("x = 1\n")
+    ids = "precision-loss,quant-overflow,nonfinite-escape,dtype-drift"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "analysis", "run.py"),
+         "--repo", str(tmp_path), "--format", "sarif",
+         "--analyzers", ids],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    sarif = json.loads(out.stdout)
+    rules = {r["id"] for r in sarif["runs"][0]["tool"]["driver"]["rules"]}
+    assert set(ids.split(",")) <= rules
+
+
+def test_stats_lists_numerics_analyzers(tmp_path):
+    (tmp_path / "synapseml_tpu").mkdir()
+    (tmp_path / "synapseml_tpu" / "mod.py").write_text("x = 1\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "analysis", "run.py"),
+         "--repo", str(tmp_path), "--stats",
+         "--analyzers", "precision-loss,quant-overflow,"
+                        "nonfinite-escape,dtype-drift"],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    for aid in ("precision-loss", "quant-overflow", "nonfinite-escape",
+                "dtype-drift"):
+        assert aid in out.stdout
+
+
+@pytest.mark.slow
+def test_full_suite_meets_timing_budget_warm_cache(tmp_path):
+    # slow lane: two full-suite runs; ci.sh asserts the same budget on its
+    # own analysis step every run
+    cmd = [sys.executable, os.path.join(REPO, "tools", "analysis", "run.py"),
+           "--jobs", "4", "--cache-dir", str(tmp_path / "cache")]
+    prime = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+    assert prime.returncode == 0, prime.stdout + prime.stderr
+    t0 = time.monotonic()
+    warm = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+    elapsed = time.monotonic() - t0
+    assert warm.returncode == 0, warm.stdout + warm.stderr
+    assert elapsed < 120, f"warm-cache run took {elapsed:.1f}s (budget 120s)"
+
+
+# --------------------------------------------------- live-tree fix regressions
+
+def test_grower_bf16_wire_pins_exact_totals(eight_devices):
+    """The bf16 rung of _maybe_psum carries the same exact-totals side wire
+    as the int8 rung: per-feature G/H totals off the reduced histogram must
+    match the exact f32 reduction to f32 round-off (only within-feature bin
+    placement may see bf16 rounding), and counts stay bit-exact."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.gbdt.grower import _maybe_psum
+    from synapseml_tpu.parallel import make_mesh
+    from synapseml_tpu.parallel.collectives import shard_apply
+
+    rng = np.random.default_rng(1)
+    # (workers, features, bins, 3): magnitudes spread enough that a naive
+    # bf16 wire visibly perturbs totals summed over 256 bins
+    x = (rng.normal(size=(8, 4, 256, 3)) * 10.0).astype(np.float32)
+    x[..., 2] = rng.integers(0, 100, size=x.shape[:-1])
+
+    def wire(xs):
+        return _maybe_psum(xs, "data", "bf16")
+
+    mesh = make_mesh(devices=eight_devices)
+    out = np.asarray(shard_apply(mesh, wire, in_specs=P("data"),
+                                 out_specs=P("data"))(jnp.asarray(x)))
+    exact = x.sum(axis=0, keepdims=True).repeat(8, axis=0)
+    np.testing.assert_allclose(out[..., :2].sum(axis=2),
+                               exact[..., :2].sum(axis=2),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_array_equal(out[..., 2], exact[..., 2])
+
+
+def test_vw_logistic_loss_finite_at_extreme_margin():
+    """softplus(-m), not log1p(exp(-m)): an outlier margin of -1e4 must
+    yield a finite loss (~1e4) and a finite gradient, not inf."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.vw.learner import _loss_and_grad
+
+    p = jnp.asarray([-1e4, -200.0, 0.0, 200.0], jnp.float32)
+    y = jnp.ones_like(p)
+    loss, grad = _loss_and_grad(p, y, "logistic", 0.5)
+    assert bool(jnp.all(jnp.isfinite(loss)))
+    assert bool(jnp.all(jnp.isfinite(grad)))
+    np.testing.assert_allclose(np.asarray(loss)[0], 1e4, rtol=1e-5)
+
+
+def test_multiclass_init_finite_with_zero_weights():
+    """The class-prior init guards counts.sum(): an all-zero weight vector
+    (every row masked out of a shard) must yield finite initial scores
+    instead of 0/0 -> NaN through the log."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.gbdt.objectives import (multiclass_objective,
+                                               multiclassova_objective)
+
+    y = jnp.asarray([0, 1, 2, 1], jnp.int32)
+    w = jnp.zeros(4, jnp.float32)
+    for obj in (multiclass_objective(3), multiclassova_objective(3)):
+        init = obj.init_score(y, w)
+        assert bool(jnp.all(jnp.isfinite(init))), obj.name
+    # nonzero weights keep the usual prior: log of the weighted frequency
+    w = jnp.asarray([1.0, 2.0, 1.0, 2.0], jnp.float32)
+    init = multiclass_objective(3).init_score(y, w)
+    np.testing.assert_allclose(
+        np.asarray(init), np.log(np.asarray([1 / 6, 4 / 6, 1 / 6])),
+        rtol=1e-6)
+
+
+def test_checkpoint_dtype_mismatch_raises(eight_devices, tmp_path):
+    """load_sharded_from_checkpoint validates the manifest dtype against the
+    template's, symmetric with the shape check — a bf16 template must not
+    silently restore as f32."""
+    import jax
+
+    from synapseml_tpu import parallel
+    from synapseml_tpu.core.checkpoint import (CheckpointError,
+                                               CheckpointStore,
+                                               load_sharded_from_checkpoint,
+                                               save_sharded_tree)
+    from synapseml_tpu.parallel.mesh import tree_shardings
+
+    rng = np.random.default_rng(5)
+    host = {"w": rng.normal(size=(16, 4)).astype(np.float32),
+            "b": rng.normal(size=(4,)).astype(np.float32)}
+    mesh = parallel.make_mesh({"data": 8})
+    placed = parallel.apply_tree_shardings(
+        host, tree_shardings(mesh, host, "zero"))
+    store = CheckpointStore(str(tmp_path / "s"))
+    save_sharded_tree(store, 1, placed)
+    ckpt = store.load_latest(
+        artifact_filter=lambda n: n.endswith(".sharding.json"))
+
+    bad = dict(host)
+    bad["w"] = np.zeros((16, 4), np.float16)
+    with pytest.raises(CheckpointError, match="dtype"):
+        load_sharded_from_checkpoint(store, ckpt, bad)
+
+    # matching templates still restore
+    tree = load_sharded_from_checkpoint(store, ckpt, host)
+    for a, b in zip(jax.tree.leaves(host), jax.tree.leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 
 from ..axismap import AxisMap
 from ..core import Project, SourceFile
+from ..dtypemodel import DtypeModel
 from ..jitmap import JitMap
 from ..lockmodel import LockModel
 
@@ -25,6 +26,7 @@ class Context:
     _jitmap: Optional[JitMap] = field(default=None, repr=False)
     _axismap: Optional[AxisMap] = field(default=None, repr=False)
     _lockmodel: Optional[LockModel] = field(default=None, repr=False)
+    _dtypemodel: Optional[DtypeModel] = field(default=None, repr=False)
 
     @property
     def jitmap(self) -> JitMap:
@@ -44,6 +46,12 @@ class Context:
             self._lockmodel = LockModel(self.project, self.jitmap)
         return self._lockmodel
 
+    @property
+    def dtypemodel(self) -> DtypeModel:
+        if self._dtypemodel is None:
+            self._dtypemodel = DtypeModel(self.project, self.jitmap)
+        return self._dtypemodel
+
     def package_files(self) -> List[SourceFile]:
         return [sf for sf in self.project.files
                 if sf.rel.startswith("synapseml_tpu/")]
@@ -56,12 +64,14 @@ class Context:
 
 def registry() -> Dict[str, object]:
     from . import (blocking_io, blocking_lock, collectives, cycles,
-                   determinism, donation, drift, imports, lockorder, locks,
-                   names, recompile, resources, sharding, threadshared,
-                   trace_safety)
+                   determinism, donation, drift, dtype_drift, imports,
+                   lockorder, locks, names, nonfinite_escape,
+                   precision_loss, quant_overflow, recompile, resources,
+                   sharding, threadshared, trace_safety)
 
     mods = [trace_safety, recompile, determinism, locks, lockorder,
             threadshared, blocking_lock, blocking_io,
             collectives, sharding, donation, resources,
+            precision_loss, quant_overflow, nonfinite_escape, dtype_drift,
             names, imports, cycles, drift]
     return {m.ID: m for m in mods}

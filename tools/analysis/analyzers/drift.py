@@ -83,6 +83,54 @@ def _compare(rendered: Dict[str, str], root: str, label: str,
 CHAOS_MODULE = "synapseml_tpu/testing/chaos.py"
 CHAOS_DOC = "docs/resilience.md"
 
+ANALYSIS_DOC = "docs/static-analysis.md"
+
+#: finding ids emitted by the framework itself rather than a registered
+#: analyzer module — documented in the rules table, absent from registry()
+PSEUDO_ANALYZERS = frozenset({"unused-suppression", "syntax"})
+
+
+def doc_rule_ids(doc_text: str) -> Dict[str, int]:
+    """Analyzer ids named in the doc's rules tables: id → doc line.
+
+    A rule row is any markdown table row whose first cell is a lone
+    backticked kebab-case id (``| `precision-loss` | ... |``). Prose
+    mentions don't count — only the tables are the contract surface.
+    """
+    import re
+    out: Dict[str, int] = {}
+    for i, raw in enumerate(doc_text.splitlines(), 1):
+        m = re.match(r"^\|\s*`([a-z][a-z0-9-]*)`\s*\|", raw)
+        if m and m.group(1) not in out:
+            out[m.group(1)] = i
+    return out
+
+
+def analyzer_doc_findings(doc_text: str, registered) -> List[Finding]:
+    """Bidirectional analyzer-registry <-> docs-table drift check.
+
+    A registered analyzer missing from the static-analysis doc's rules
+    tables is undiscoverable (nobody learns its suppression name); a
+    documented id with no registered analyzer is a promise CI no longer
+    keeps. Both directions flag.
+    """
+    findings: List[Finding] = []
+    documented = doc_rule_ids(doc_text)
+    registered = set(registered)
+    for aid in sorted(registered - set(documented)):
+        findings.append(Finding(
+            analyzer=ID, path=ANALYSIS_DOC, line=1, col=0,
+            message=(f"analyzer `{aid}` is registered but has no rules-table "
+                     f"row in {ANALYSIS_DOC} — document its rule and "
+                     "suppression name")))
+    for aid in sorted(set(documented) - registered - PSEUDO_ANALYZERS):
+        findings.append(Finding(
+            analyzer=ID, path=ANALYSIS_DOC, line=documented[aid], col=0,
+            message=(f"rules table documents analyzer `{aid}` but no such "
+                     "analyzer is registered — remove the row or restore "
+                     "the analyzer")))
+    return findings
+
 
 def chaos_exports(chaos_tree: ast.AST) -> Dict[str, int]:
     """Public top-level injectors of chaos.py: name → definition line.
@@ -130,7 +178,8 @@ def run(ctx) -> List[Finding]:
              "R binding", (".R",), findings)
 
     chaos_sf = next((sf for sf in ctx.project.files
-                     if sf.rel == CHAOS_MODULE), None)
+                     if sf.rel == CHAOS_MODULE), None) \
+        if ctx is not None else None
     if chaos_sf is not None:
         try:
             with open(os.path.join(REPO, CHAOS_DOC), encoding="utf-8") as f:
@@ -138,4 +187,14 @@ def run(ctx) -> List[Finding]:
         except OSError:
             doc_text = ""
         findings.extend(chaos_doc_findings(chaos_sf.tree, doc_text))
+
+    # analyzer registry <-> docs rules tables (lazy import: registry() pulls
+    # in every analyzer module, and this module is itself one of them)
+    from . import registry
+    try:
+        with open(os.path.join(REPO, ANALYSIS_DOC), encoding="utf-8") as f:
+            analysis_doc = f.read()
+    except OSError:
+        analysis_doc = ""
+    findings.extend(analyzer_doc_findings(analysis_doc, registry().keys()))
     return findings

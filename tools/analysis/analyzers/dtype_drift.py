@@ -1,0 +1,204 @@
+"""Produce/consume dtype disagreement across serialization boundaries.
+
+A checkpoint, wire codec or spec boundary has two halves that compile
+independently — nothing forces ``save``'s leaf dtypes and ``load``'s
+restored dtypes to agree, and a drifted half silently changes the dtype
+of every downstream computation (a bf16 template restored from an f32
+manifest trains in f32 at 2x the memory, or worse, the other way).
+
+This analyzer pairs boundary functions by name inside each module (and
+class): ``save_X``/``load_X*``, ``to_bytes``/``from_bytes``,
+``encode*``/``decode*``, ``write_X``/``read_X``,
+``serialize*``/``deserialize*``. For each pair it reports:
+
+* **unchecked manifest dtype**: the producer records a ``"dtype"``
+  manifest entry and the consumer *uses* it to reconstruct leaves and
+  validates shapes against a caller-supplied template — but never
+  compares the manifest dtype to the template's. The restore then
+  silently returns leaves whose dtype is whatever the file says, not
+  what the template promised.
+* **disjoint float dtypes**: both halves pin concrete float dtypes via
+  literal casts/constructors and the sets don't intersect — the halves
+  were edited apart (int/uint8 casts are byte-buffer plumbing and are
+  ignored; quantize/dequantize codecs keep a shared float scale, so
+  a genuinely intersecting pair stays clean).
+
+Suppress intentional asymmetry with ``# lint-ok: dtype-drift``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, dotted_name
+from ..dtypemodel import _FLOATS
+
+ID = "dtype-drift"
+DESCRIPTION = ("producer/consumer boundary pairs (checkpoint save/load, "
+               "wire encode/decode) whose pinned dtypes disagree")
+
+_PAIR_PREFIXES = [
+    ("save", "load"), ("to_bytes", "from_bytes"),
+    ("encode", "decode"), ("write", "read"),
+    ("serialize", "deserialize"), ("dump", "restore"),
+]
+
+
+#: connective tokens dropped before stem comparison, so
+#: ``load_sharded_from_checkpoint`` still matches ``save_sharded_tree``
+_STOPWORDS = {"from", "to", "tree", "checkpoint", "state", "file", "bytes"}
+
+
+def _pair_key(name: str) -> Optional[Tuple[str, str, Tuple[str, ...]]]:
+    """(role, produce-prefix, stem tokens) for a boundary function."""
+    base = name.lstrip("_")
+    for prod, cons in _PAIR_PREFIXES:
+        if base == prod or base.startswith(prod + "_"):
+            stem = base[len(prod):].lstrip("_")
+            return ("produce", prod, _tokens(stem))
+        if base == cons or base.startswith(cons + "_"):
+            stem = base[len(cons):].lstrip("_")
+            return ("consume", prod, _tokens(stem))
+    return None
+
+
+def _tokens(stem: str) -> Tuple[str, ...]:
+    return tuple(t for t in stem.split("_") if t and t not in _STOPWORDS)
+
+
+def _stems_match(a: Tuple[str, ...], b: Tuple[str, ...]) -> bool:
+    """Equal stems always pair; non-empty stems also pair when one is a
+    token-prefix of the other (``sharded`` vs ``sharded_tree``)."""
+    if a == b:
+        return True
+    if not a or not b:
+        return False
+    k = min(len(a), len(b))
+    return a[:k] == b[:k]
+
+
+def _body_of(info):
+    node = info.node
+    return node.body if isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+        else [node.body]
+
+
+class _Boundary(ast.NodeVisitor):
+    """Syntactic dtype facts about one boundary half."""
+
+    def __init__(self, dtm, sf) -> None:
+        self.dtm = dtm
+        self.sf = sf
+        self.float_dtypes: Set[str] = set()
+        self.writes_dtype_key = False
+        self.reads_dtype_key = False
+        self.compares_shape = False
+        self.compares_dtype = False
+
+    def visit_FunctionDef(self, node):          # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _note_dtype_expr(self, node: Optional[ast.AST]) -> None:
+        got = self.dtm.parse_dtype_name(self.sf, node) if node is not None \
+            else None
+        if got in _FLOATS:
+            self.float_dtypes.add(got)
+
+    def visit_Dict(self, node):                 # noqa: N802
+        for k in node.keys:
+            if isinstance(k, ast.Constant) and k.value == "dtype":
+                self.writes_dtype_key = True
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):            # noqa: N802
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and sl.value == "dtype":
+            self.reads_dtype_key = True
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):              # noqa: N802
+        text = ast.unparse(node)
+        if "shape" in text:
+            self.compares_shape = True
+        if "dtype" in text:
+            self.compares_dtype = True
+        self.generic_visit(node)
+
+    def visit_Call(self, node):                 # noqa: N802
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "astype" \
+                and node.args:
+            self._note_dtype_expr(node.args[0])
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                self._note_dtype_expr(kw.value)
+        name = dotted_name(func)
+        canon = self.dtm.project.canonical(self.sf, name) if name else None
+        if canon in ("numpy.dtype", "jax.numpy.dtype") and node.args:
+            self._note_dtype_expr(node.args[0])
+        self.generic_visit(node)
+
+
+def run(ctx) -> List[Finding]:
+    dtm = ctx.dtypemodel
+    findings: List[Finding] = []
+    for sf in dtm.files:
+        # collect boundary halves per (class, pair-prefix)
+        halves: Dict[Tuple[Optional[str], str],
+                     Dict[str, list]] = {}
+        for qual, info in sf.symbols.functions.items():
+            if isinstance(info.node, ast.Lambda):
+                continue
+            key = _pair_key(info.node.name)
+            if key is None:
+                continue
+            role, prod, stem = key
+            slot = halves.setdefault((info.class_name, prod),
+                                     {"produce": [], "consume": []})
+            slot[role].append((stem, info))
+        pairs = []
+        for (cls, prod), slot in sorted(halves.items(),
+                                        key=lambda kv: str(kv[0])):
+            for cstem, consumer in slot["consume"]:
+                # best-matching producer: longest shared stem wins
+                best = None
+                for pstem, producer in slot["produce"]:
+                    if _stems_match(pstem, cstem):
+                        score = len(pstem)
+                        if best is None or score > best[0]:
+                            best = (score, producer)
+                if best is not None:
+                    pairs.append((best[1], consumer))
+        for producer, consumer in pairs:
+            pb = _Boundary(dtm, sf)
+            for stmt in _body_of(producer):
+                pb.visit(stmt)
+            cb = _Boundary(dtm, sf)
+            for stmt in _body_of(consumer):
+                cb.visit(stmt)
+            pname = producer.node.name
+            cname = consumer.node.name
+            if pb.writes_dtype_key and cb.reads_dtype_key and \
+                    cb.compares_shape and not cb.compares_dtype:
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=consumer.lineno, col=0,
+                    message=(
+                        f"{cname} restores leaves from the manifest "
+                        f"dtype that {pname} recorded and validates "
+                        "template shapes, but never checks the restored "
+                        "dtype against the template — a drifted "
+                        "checkpoint silently changes every leaf dtype")))
+            elif pb.float_dtypes and cb.float_dtypes and \
+                    not (pb.float_dtypes & cb.float_dtypes):
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=consumer.lineno, col=0,
+                    message=(
+                        f"{pname} pins {sorted(pb.float_dtypes)} but "
+                        f"{cname} pins {sorted(cb.float_dtypes)} — the "
+                        "boundary halves disagree on the wire dtype")))
+    return findings

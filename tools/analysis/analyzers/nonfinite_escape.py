@@ -1,0 +1,234 @@
+"""Non-finite-unsafe math escaping its guard scope.
+
+``log(0)``, ``x/0`` and ``sqrt(-eps)`` don't raise under jit — they mint
+NaN/Inf that propagates silently until a NonFiniteGuard (or a user) trips
+over it many steps later. The training/serving modules (``gbdt/``,
+``dl/``, ``vw/``, ``online/``) consistently guard these sinks at the
+source — ``jnp.clip(p, 1e-12, 1 - 1e-12)`` before ``log``,
+``jnp.maximum(den, eps)`` before division — and this analyzer enforces
+that discipline:
+
+* ``log``/``log2``/``log10`` whose argument carries no guard provenance
+  (clip/maximum/abs/exp/sigmoid/softplus/square/``+ eps``/nan_to_num,
+  tracked through local bindings by the dtype model);
+* the ``log1p(exp(x))`` / ``log(1 + exp(x))`` composition, which
+  overflows for moderate ``x`` (~88 in f32) — use ``jax.nn.softplus`` or
+  ``logaddexp``;
+* ``sqrt``/``rsqrt`` over an argument containing a subtraction or
+  negation outside an even power / abs — the classic
+  ``sqrt(var)``-where-``var = E[x^2] - E[x]^2`` cancellation NaN;
+* division whose denominator is a bare reduction (``sum``/``mean``/
+  ``psum``) with no guard — an all-zero weight vector yields 0/0.
+
+Functions *dominated* by a guard are exempt: a function whose body uses
+``NonFiniteGuard``/``isfinite``/``nan_to_num`` is a guard root, and any
+function only ever called from guarded functions inherits the exemption
+(callee guarded iff all its resolved callers are). ``exp`` alone is not a
+sink (it saturates to inf without minting NaN and guards nearly every
+sigmoid); it only flags inside the log-composition above.
+
+Suppress intentional sites with ``# lint-ok: nonfinite-escape``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import Finding, dotted_name
+from ..dtypemodel import DtypeModel
+
+ID = "nonfinite-escape"
+DESCRIPTION = ("log/div/sqrt/rsqrt on unvalidated inputs outside a "
+               "NonFiniteGuard or finite-check dominator "
+               "(gbdt/dl/vw/online)")
+
+_SCOPE = ("synapseml_tpu/gbdt/", "synapseml_tpu/dl/", "synapseml_tpu/vw/",
+          "synapseml_tpu/online/")
+_LOG_SINKS = {"jax.numpy.log", "jax.numpy.log2", "jax.numpy.log10",
+              "jax.lax.log", "numpy.log", "numpy.log2", "numpy.log10"}
+_SQRT_SINKS = {"jax.numpy.sqrt", "jax.lax.sqrt", "jax.lax.rsqrt",
+               "numpy.sqrt"}
+_EXP = {"jax.numpy.exp", "jax.lax.exp", "numpy.exp"}
+_LOG1P = {"jax.numpy.log1p", "numpy.log1p"}
+_REDUCTIONS = {"jax.numpy.sum", "jax.numpy.mean", "jax.numpy.nansum",
+               "jax.lax.psum", "jax.lax.pmean", "numpy.sum", "numpy.mean"}
+#: syntactic guard roots: a function whose body touches any of these is
+#: considered finite-checked
+_GUARD_MARKERS = {"NonFiniteGuard", "isfinite", "nan_to_num",
+                  "isnan", "isinf"}
+#: calls under which a subtraction stops being a sqrt hazard
+_SAFE_WRAPPERS = {"square", "abs", "absolute", "maximum", "clip", "exp",
+                  "relu", "softplus", "sigmoid", "var", "sum", "mean"}
+
+
+class _FnWalk(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.calls: List[ast.Call] = []
+        self.divs: List[ast.BinOp] = []
+        self.names: Set[str] = set()
+
+    def visit_FunctionDef(self, node):          # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node):                 # noqa: N802
+        self.calls.append(node)
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node):                # noqa: N802
+        if isinstance(node.op, ast.Div):
+            self.divs.append(node)
+        self.generic_visit(node)
+
+    def visit_Name(self, node):                 # noqa: N802
+        self.names.add(node.id)
+
+    def visit_Attribute(self, node):            # noqa: N802
+        self.names.add(node.attr)
+        self.generic_visit(node)
+
+
+def _body_of(info):
+    node = info.node
+    return node.body if isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+        else [node.body]
+
+
+def _naked_minus(node: ast.AST) -> bool:
+    """A Sub/USub in the subtree not neutralized by an even power, abs,
+    square or other nonnegativity-preserving wrapper."""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow):
+        exp = node.right
+        if isinstance(exp, ast.Constant) and isinstance(
+                exp.value, (int, float)) and float(exp.value) % 2 == 0:
+            return False
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name and name.split(".")[-1] in _SAFE_WRAPPERS:
+            return False
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SAFE_WRAPPERS:
+            return False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return True
+    return any(_naked_minus(c) for c in ast.iter_child_nodes(node))
+
+
+def _is_exp_call(ctx, sf, node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and ctx.project.canonical(sf, dotted_name(node.func)) in _EXP)
+
+
+def _log_of_one_plus_exp(ctx, sf, arg: ast.AST) -> bool:
+    if not (isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add)):
+        return False
+    return _is_exp_call(ctx, sf, arg.left) or _is_exp_call(ctx, sf,
+                                                           arg.right)
+
+
+def _guarded_functions(dtm: DtypeModel, scoped) -> Set[str]:
+    """Guard roots + the fixpoint of 'all resolved callers are guarded'."""
+    guarded: Set[str] = set()
+    callers: Dict[str, Set[str]] = {}
+    for sf, info in scoped:
+        walk = _FnWalk()
+        for stmt in _body_of(info):
+            walk.visit(stmt)
+        if walk.names & _GUARD_MARKERS:
+            guarded.add(info.full_name)
+        for call in walk.calls:
+            callee = dtm.jitmap.resolve_callee(sf, info, call)
+            if callee is not None:
+                callers.setdefault(callee.full_name, set()).add(
+                    info.full_name)
+    changed = True
+    while changed:
+        changed = False
+        for fn, who in callers.items():
+            if fn not in guarded and who and who <= guarded:
+                guarded.add(fn)
+                changed = True
+    return guarded
+
+
+def run(ctx) -> List[Finding]:
+    dtm = ctx.dtypemodel
+    scoped = [(sf, info)
+              for sf in dtm.files
+              if any(sf.rel.startswith(p) for p in _SCOPE)
+              for _, info in sf.symbols.functions.items()]
+    guarded_fns = _guarded_functions(dtm, scoped)
+    findings: List[Finding] = []
+    for sf, info in scoped:
+        facts = dtm.facts_for(info)
+        walk = _FnWalk()
+        for stmt in _body_of(info):
+            walk.visit(stmt)
+        fn_guarded = info.full_name in guarded_fns
+
+        for call in walk.calls:
+            canon = ctx.project.canonical(sf, dotted_name(call.func))
+            if not call.args or canon is None:
+                continue
+            arg = call.args[0]
+            # the overflow composition flags even inside guarded scopes:
+            # a NonFiniteGuard downstream *detects* the inf, it does not
+            # make the loss finite
+            if (canon in _LOG1P and _is_exp_call(ctx, sf, arg)) or \
+                    (canon in _LOG_SINKS
+                     and _log_of_one_plus_exp(ctx, sf, arg)):
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=call.lineno,
+                    col=call.col_offset,
+                    message=("log(1+exp(x)) overflows for moderate x "
+                             "(~88 in f32); use jax.nn.softplus or "
+                             "jnp.logaddexp")))
+                continue
+            if fn_guarded:
+                continue
+            if canon in _LOG_SINKS and not facts.info(arg).guarded:
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=call.lineno,
+                    col=call.col_offset,
+                    message=(f"{canon.split('.')[-1]} of an unvalidated "
+                             "input can mint -inf/NaN under jit; clip the "
+                             "argument away from 0 or guard the caller")))
+            elif canon in _SQRT_SINKS and not facts.info(arg).guarded \
+                    and _naked_minus(arg):
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=call.lineno,
+                    col=call.col_offset,
+                    message=(f"{canon.split('.')[-1]} over a difference "
+                             "can see a small negative from cancellation "
+                             "and mint NaN; wrap in jnp.maximum(., 0) or "
+                             "square the operand")))
+        if fn_guarded:
+            continue
+        for div in walk.divs:
+            den = div.right
+            if not isinstance(den, ast.Call):
+                continue
+            canon = ctx.project.canonical(sf, dotted_name(den.func))
+            recv = (dotted_name(den.func.value)
+                    if isinstance(den.func, ast.Attribute) else None)
+            # a value receiver is one canonical() can't resolve past itself
+            # (a local/param, or an expression with no dotted name) — module
+            # receivers (np.sum) resolve to their import target instead
+            recv_is_value = isinstance(den.func, ast.Attribute) and (
+                recv is None or ctx.project.canonical(sf, recv) == recv)
+            is_red = canon in _REDUCTIONS or (
+                recv_is_value and den.func.attr in ("sum", "mean"))
+            if is_red and not facts.info(den).guarded:
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=div.lineno,
+                    col=div.col_offset,
+                    message=("division by a bare reduction: an all-zero "
+                             "operand yields 0/0 -> NaN; wrap the "
+                             "denominator in jnp.maximum(., eps)")))
+    return findings

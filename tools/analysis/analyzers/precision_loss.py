@@ -1,0 +1,273 @@
+"""Reductions and accumulations performed in a lossy narrow float.
+
+A bf16/f16 **elementwise** op loses a little precision; a bf16/f16
+**reduction** loses unboundedly much — grid sums over 10^5 histogram rows
+in bf16 drift far past split-decision tolerance, which is exactly why the
+int8 rung of the gbdt wire ladder carries an exact f32 totals side wire.
+This analyzer flags every reduction (``jnp.sum``/``mean``/``cumsum``,
+``lax.psum``/``pmean``/``psum_scatter``, ``lax.scan`` carries, ``+=`` in a
+loop, ``.sum()``/``.mean()`` methods) whose operand is bf16/f16 **and**
+provably carried f32 data at some point (``ever_f32``) or was explicitly
+downcast to the narrow dtype (``downcast``) — values *born* narrow never
+flag.
+
+Exemptions (the sanctioned mixed-precision idioms):
+
+* ``preferred_element_type=``/``dtype=`` naming a wide float — the
+  accumulator is wide even though the operand is narrow;
+* an **exact side wire**: another reduction in the same function whose
+  operand is not narrow and whose expression contains the downcast
+  source, i.e. the ``_pin_totals(gh, lax.psum(x[..., :2].sum(...)))``
+  pattern — the narrow wire is then a bandwidth optimization whose totals
+  are re-pinned exactly.
+
+Suppress intentional sites with ``# lint-ok: precision-loss``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, dotted_name
+from ..dtypemodel import NARROW_FLOATS, WIDE_FLOATS, DtypeInfo
+
+ID = "precision-loss"
+DESCRIPTION = ("bf16/f16 reduction or accumulation of data that was ever "
+               "f32, without a preferred_element_type or exact side wire")
+
+#: canonical reduction entry points (first positional arg is the operand)
+_REDUCTIONS = {
+    "jax.numpy.sum", "jax.numpy.nansum", "jax.numpy.mean",
+    "jax.numpy.nanmean", "jax.numpy.cumsum", "jax.numpy.prod",
+    "jax.numpy.average", "jax.lax.psum", "jax.lax.pmean",
+    "jax.lax.psum_scatter", "jax.lax.cumsum",
+    "numpy.sum", "numpy.mean", "numpy.cumsum",
+}
+_REDUCTION_METHODS = {"sum", "mean", "cumsum", "prod"}
+_WIDE = set(WIDE_FLOATS)
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _partial_aliases(ctx, sf, stmts) -> set:
+    """Local names bound to ``partial(lax.psum_scatter, ...)``-style
+    reduction wrappers (the scatter = partial(...) idiom)."""
+    out = set()
+    for s in stmts:
+        for node in ast.walk(s):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and node.value.args):
+                continue
+            fname = dotted_name(node.value.func)
+            if fname is None or fname.split(".")[-1] != "partial":
+                continue
+            wrapped = ctx.project.canonical(
+                sf, dotted_name(node.value.args[0]))
+            if wrapped in _REDUCTIONS:
+                out.add(node.targets[0].id)
+    return out
+
+
+def _reduction_operand(ctx, sf, call: ast.Call,
+                       aliases=frozenset()) -> Optional[ast.AST]:
+    """The reduced expression when ``call`` is a reduction, else None."""
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in aliases and call.args:
+        return call.args[0]
+    if isinstance(func, ast.Attribute) and func.attr in _REDUCTION_METHODS \
+            and not call.args:
+        # x.sum(axis=...) — receiver is the operand when it is a *value*
+        # (a local/param canonical() can't resolve past itself, or an
+        # expression with no dotted name); module-level np.sum(...)
+        # resolves via canonical below instead
+        recv = dotted_name(func.value)
+        if recv is None or ctx.project.canonical(sf, recv) == recv:
+            return func.value
+    canon = ctx.project.canonical(sf, dotted_name(func))
+    if canon in _REDUCTIONS and call.args:
+        return call.args[0]
+    return None
+
+
+def _lossy(info: DtypeInfo) -> bool:
+    return info.dtype in NARROW_FLOATS and (info.ever_f32 or info.downcast)
+
+
+def _wide_exempt(ctx, sf, call: ast.Call) -> bool:
+    """dtype=/preferred_element_type= naming a wide accumulator."""
+    dtm = ctx.dtypemodel
+    for name in ("preferred_element_type", "dtype"):
+        node = _kw(call, name)
+        if node is not None:
+            got = dtm.parse_dtype_name(sf, node)
+            if got in _WIDE or (name == "preferred_element_type"
+                                and got is None):
+                return True
+    return False
+
+
+def _cast_source(node: ast.AST) -> ast.AST:
+    """Peel the trailing .astype(...)/convert cast off the operand."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in ("astype", "view") and node.func.value:
+        return node.func.value
+    if isinstance(node, ast.Call) and node.args and \
+            dotted_name(node.func) is not None and \
+            dotted_name(node.func).split(".")[-1] in (
+                "convert_element_type", "astype"):
+        return node.args[0]
+    return node
+
+
+class _FnWalk(ast.NodeVisitor):
+    """Collect this function's calls/augassigns without entering nested
+    function bodies (they carry their own facts)."""
+
+    def __init__(self) -> None:
+        self.calls: List[ast.Call] = []
+        self.scans: List[ast.Call] = []
+        self.loop_aug: List[ast.AugAssign] = []
+        self._loops = 0
+
+    def visit_FunctionDef(self, node):          # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node):                 # noqa: N802
+        self.calls.append(node)
+        self.generic_visit(node)
+
+    def _loop(self, node):
+        self._loops += 1
+        self.generic_visit(node)
+        self._loops -= 1
+
+    visit_For = _loop
+    visit_While = _loop
+
+    def visit_AugAssign(self, node):            # noqa: N802
+        if self._loops and isinstance(node.op, ast.Add):
+            self.loop_aug.append(node)
+        self.generic_visit(node)
+
+
+def _body_of(info):
+    node = info.node
+    return node.body if isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+        else [node.body]
+
+
+def _branch_paths(stmts) -> dict:
+    """id(node) -> branch path: the chain of (if-node, arm) regions a node
+    sits in. A side wire only exempts a lossy reduction in the *same or an
+    enclosing* region — never a sibling branch (the int8 rung's pin must
+    not excuse the bf16 rung)."""
+    out: dict = {}
+
+    def rec(body, path):
+        for s in body:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for n in ast.walk(s):
+                out[id(n)] = path
+            if isinstance(s, ast.If):
+                rec(s.body, path + ((id(s), 0),))
+                rec(s.orelse, path + ((id(s), 1),))
+            elif isinstance(s, (ast.For, ast.While, ast.With, ast.Try)):
+                for part in ("body", "orelse", "finalbody"):
+                    rec(getattr(s, part, None) or [], path)
+                for h in getattr(s, "handlers", []):
+                    rec(h.body, path)
+
+    rec(stmts, ())
+    return out
+
+
+def run(ctx) -> List[Finding]:
+    dtm = ctx.dtypemodel
+    findings: List[Finding] = []
+    seen = set()
+    for sf in dtm.files:
+        for qual, info in sf.symbols.functions.items():
+            facts = dtm.facts_for(info)
+            body = _body_of(info)
+            walk = _FnWalk()
+            for stmt in body:
+                walk.visit(stmt)
+            aliases = _partial_aliases(ctx, sf, body)
+            paths = _branch_paths(body)
+
+            # reductions whose operand stays wide (or at least not narrow):
+            # candidates for the exact-side-wire exemption
+            wide_reductions = []
+            lossy_sites = []
+            for call in walk.calls:
+                operand = _reduction_operand(ctx, sf, call, aliases)
+                canon = ctx.project.canonical(sf, dotted_name(call.func))
+                if canon == "jax.lax.scan":
+                    init = call.args[1] if len(call.args) > 1 else \
+                        _kw(call, "init")
+                    if init is not None and _lossy(facts.info(init)):
+                        lossy_sites.append(
+                            (call, facts.info(init), "lax.scan carry"))
+                    continue
+                if operand is None:
+                    continue
+                op_info = facts.info(operand)
+                if _lossy(op_info):
+                    if not _wide_exempt(ctx, sf, call):
+                        label = (canon or "reduction").split(".")[-1]
+                        lossy_sites.append((call, op_info, label))
+                elif op_info.dtype not in NARROW_FLOATS \
+                        and not op_info.downcast:
+                    wide_reductions.append(call)
+            for aug in walk.loop_aug:
+                aug_info = facts.info(aug)
+                if _lossy(aug_info):
+                    lossy_sites.append((aug, aug_info, "+= loop carry"))
+
+            side_srcs = [(ast.unparse(w), paths.get(id(w), ()))
+                         for w in wide_reductions]
+            for node, op_info, label in lossy_sites:
+                operand = None
+                if isinstance(node, ast.Call):
+                    operand = _reduction_operand(ctx, sf, node, aliases)
+                    if operand is None and node.args:
+                        operand = node.args[1] if len(node.args) > 1 \
+                            else node.args[0]       # scan init
+                core = ast.unparse(_cast_source(operand)) if operand is not \
+                    None else ""
+                lossy_path = paths.get(id(node), ())
+                if core and any(
+                        core in src
+                        and lossy_path[:len(sp)] == sp
+                        for src, sp in side_srcs):
+                    continue    # exact side wire in the same/outer region
+                origin = (f" (downcast at line {op_info.cast_line})"
+                          if op_info.cast_line else "")
+                key = (sf.rel, node.lineno, label)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    analyzer=ID, path=sf.rel, line=node.lineno,
+                    col=getattr(node, "col_offset", 0),
+                    message=(
+                        f"{label} accumulates in {op_info.dtype} over data "
+                        f"that was f32{origin}; accumulate wide "
+                        "(preferred_element_type/dtype=f32) or pin totals "
+                        "with an exact side wire")))
+    return findings

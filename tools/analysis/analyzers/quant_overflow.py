@@ -1,0 +1,129 @@
+"""Integer wire math that can overflow the grid-exactness contract.
+
+The EQuARX-style quantized collectives are only *exact* while the integer
+grid sum fits the accumulator: ``n_workers * qmax <= 32767`` for int16
+(at int8/8-bit quantization, qmax=127, that is the <=257-worker bound).
+The sanctioned idiom derives the accumulator from the bound::
+
+    acc = x.astype(jnp.int16 if n * qmax <= 32767 else jnp.int32)
+
+This analyzer flags the two ways the contract breaks statically:
+
+* **hard-coded narrow accumulator**: an int8/int16 value whose dtype came
+  from a *literal* spelling (not a bound-derived conditional) fed into a
+  grid reduction (``lax.psum``/``psum_scatter``) — any worker count past
+  the bound silently wraps;
+* **broken bound**: a bound-derived conditional that statically folds to
+  int16 while its folded left-hand side exceeds 32767 (the compare was
+  edited until it passed, not until it was safe);
+* **out-of-contract bits**: ``allreduce_sum_quantized``/
+  ``reduce_scatter_sum_quantized`` call sites passing a literal ``bits``
+  outside the 2..8 int8-wire envelope.
+
+Param-derived accumulators (the live ``_acc_dtype(n, bits)`` helper) stay
+unknown to the dtype model and are never flagged — precision over recall.
+Suppress intentional sites with ``# lint-ok: quant-overflow``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, dotted_name
+from ..dtypemodel import INT16_LIMIT
+
+ID = "quant-overflow"
+DESCRIPTION = ("int8/int16 arithmetic on quantized-collective paths that "
+               "can exceed the n*qmax<=32767 grid-exactness bound")
+
+_GRID_REDUCTIONS = {"jax.lax.psum", "jax.lax.psum_scatter"}
+_NARROW_INTS = {"int8", "uint8", "int16", "uint16"}
+_QUANT_CALLS = {"allreduce_sum_quantized", "reduce_scatter_sum_quantized"}
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class _FnWalk(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.calls: List[ast.Call] = []
+
+    def visit_FunctionDef(self, node):          # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node):                 # noqa: N802
+        self.calls.append(node)
+        self.generic_visit(node)
+
+
+def _body_of(info):
+    node = info.node
+    return node.body if isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+        else [node.body]
+
+
+def run(ctx) -> List[Finding]:
+    dtm = ctx.dtypemodel
+    findings: List[Finding] = []
+    for sf in dtm.files:
+        for qual, info in sf.symbols.functions.items():
+            facts = dtm.facts_for(info)
+            walk = _FnWalk()
+            for stmt in _body_of(info):
+                walk.visit(stmt)
+            for call in walk.calls:
+                name = dotted_name(call.func)
+                leaf = name.split(".")[-1] if name else ""
+                canon = ctx.project.canonical(sf, name)
+                if canon in _GRID_REDUCTIONS and call.args:
+                    op = facts.info(call.args[0])
+                    if op.dtype not in _NARROW_INTS:
+                        continue
+                    if op.bound_derived:
+                        if op.dtype in ("int16", "uint16") and \
+                                op.guard_lhs is not None and \
+                                op.guard_lhs > INT16_LIMIT:
+                            findings.append(Finding(
+                                analyzer=ID, path=sf.rel, line=call.lineno,
+                                col=call.col_offset,
+                                message=(
+                                    "bound-derived int16 grid accumulator "
+                                    f"whose static bound n*qmax="
+                                    f"{op.guard_lhs} exceeds {INT16_LIMIT}: "
+                                    "the compare no longer protects the "
+                                    "grid-exactness contract")))
+                    elif op.literal_cast:
+                        findings.append(Finding(
+                            analyzer=ID, path=sf.rel, line=call.lineno,
+                            col=call.col_offset,
+                            message=(
+                                f"grid reduction over a hard-coded "
+                                f"{op.dtype} accumulator: the sum wraps "
+                                f"once n*qmax exceeds {INT16_LIMIT}; derive "
+                                "the accumulator from the worker bound "
+                                "(acc = int16 if n*qmax <= 32767 else "
+                                "int32)")))
+                if leaf in _QUANT_CALLS:
+                    bits = _kw(call, "bits")
+                    if bits is None and len(call.args) >= 3:
+                        bits = call.args[2]
+                    if isinstance(bits, ast.Constant) and \
+                            isinstance(bits.value, int) and \
+                            not 2 <= bits.value <= 8:
+                        findings.append(Finding(
+                            analyzer=ID, path=sf.rel, line=call.lineno,
+                            col=call.col_offset,
+                            message=(
+                                f"{leaf} called with bits={bits.value}: "
+                                "the int8 wire contract only holds for "
+                                "2..8-bit quantization")))
+    return findings

@@ -63,6 +63,7 @@ def _run_analyzers(reg, ctx, selected, jobs):
         _ = ctx.jitmap
         _ = ctx.axismap
         _ = ctx.lockmodel
+        _ = ctx.dtypemodel
         _WORKER["reg"] = reg
         _WORKER["ctx"] = ctx
         mp = multiprocessing.get_context("fork")
